@@ -1,0 +1,19 @@
+"""victorialogs_tpu — a TPU-native log database with the capabilities of VictoriaLogs.
+
+Not a port: the storage/server core runs on the host, while the hot query
+path (bloom probes, token/phrase/substring/regex matching, bitmap reductions,
+stats aggregations) executes as JAX/XLA/Pallas programs on TPU, with
+multi-chip aggregation over ICI (`psum`) and cluster fan-out over DCN.
+
+Layer map (mirrors reference layers in /root/repo/SURVEY.md §1):
+  storage/   — columnar LSM engine (parts, blocks, blooms, stream index)
+  logsql/    — LogsQL lexer/parser, filter tree, pipes, stats functions
+  engine/    — search executor: block scheduling, block scan, result batches
+  tpu/       — device plane: block staging + JAX/Pallas kernels
+  parallel/  — mesh/psum distribution, cluster scatter-gather
+  server/    — HTTP apps: vlinsert / vlselect / vlstorage / single binary
+  cli/       — vlogscli REPL, vlogsgenerator load generator
+  native/    — C++ runtime module (zstd, xxhash, tokenizer) via ctypes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,201 @@
+"""Columnar block builder.
+
+A block holds up to MAX_ROWS_PER_BLOCK rows of a *single* stream, sorted by
+timestamp (reference: blocks are per-streamID with sorted timestamps —
+lib/logstorage/block.go:15-24, blockHeader records one streamID —
+block_header.go:17-41).  Per-block, every present field becomes a column
+encoded via the values encoder; columns whose value is identical across all
+rows become const columns (block.go:109-124); non-const/dict columns get a
+token bloom filter (block.go:134-175).
+
+Limits follow consts.go:21-30: 8M rows hard cap; we chunk at TPU-friendlier
+targets (128Ki rows / 2MB uncompressed) so a block maps to one device staging
+unit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..utils.hashing import hash_tokens
+from ..utils.tokenizer import tokenize_arena, tokenize_string, unique_tokens_bytes
+from .bloom import bloom_build
+from .log_rows import StreamID
+from .values_encoder import (EncodedColumn, VT_CONST, VT_DICT, VT_STRING,
+                             encode_values)
+
+MAX_ROWS_PER_BLOCK = 128 * 1024
+MAX_UNCOMPRESSED_BLOCK_SIZE = 2 << 20
+MAX_COLUMNS_PER_BLOCK = 2000
+
+
+@dataclass
+class BlockData:
+    """One decoded columnar block (in-memory or read from a part)."""
+
+    stream_id: StreamID
+    timestamps: np.ndarray                      # int64[R] ns, sorted
+    columns: list[EncodedColumn]                # per-row columns
+    const_columns: list[tuple[str, str]]        # (name, value)
+    stream_tags_str: str = ""                   # canonical {k="v"} labels
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.timestamps.shape[0])
+
+    @property
+    def min_ts(self) -> int:
+        return int(self.timestamps[0]) if self.num_rows else 0
+
+    @property
+    def max_ts(self) -> int:
+        return int(self.timestamps[-1]) if self.num_rows else 0
+
+    def get_column(self, name: str) -> EncodedColumn | None:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        return None
+
+    def get_const(self, name: str) -> str | None:
+        for k, v in self.const_columns:
+            if k == name:
+                return v
+        return None
+
+    def uncompressed_size(self) -> int:
+        sz = 8 * self.num_rows
+        for c in self.columns:
+            if c.vtype == VT_STRING:
+                sz += int(c.lengths.sum()) + 8 * self.num_rows
+            elif c.vtype == VT_DICT:
+                sz += self.num_rows
+            else:
+                sz += c.nums.itemsize * self.num_rows
+        return sz
+
+
+def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
+    """Attach a token bloom filter to a column (skipped for const/dict)."""
+    if col.vtype in (VT_CONST, VT_DICT):
+        return
+    if col.vtype == VT_STRING:
+        ts_, te_, _ = tokenize_arena(col.arena, col.offsets, col.lengths)
+        tokens = unique_tokens_bytes(col.arena, ts_, te_)
+    else:
+        seen: set[str] = set()
+        tokens = []
+        for v in col.to_strings(nrows):
+            for t in tokenize_string(v):
+                if t not in seen:
+                    seen.add(t)
+                    tokens.append(t)
+    col.bloom = bloom_build(hash_tokens(tokens))
+
+
+def build_blocks(
+    stream_id: StreamID,
+    timestamps: np.ndarray,
+    rows: list[list[tuple[str, str]]],
+    stream_tags_str: str = "",
+    max_rows: int = MAX_ROWS_PER_BLOCK,
+    max_bytes: int = MAX_UNCOMPRESSED_BLOCK_SIZE,
+) -> list[BlockData]:
+    """Build columnar blocks from time-sorted rows of one stream."""
+    out: list[BlockData] = []
+    n = len(rows)
+    i = 0
+    while i < n:
+        # size-bounded chunk
+        j = i
+        budget = max_bytes
+        while j < n and j - i < max_rows and budget > 0:
+            for k, v in rows[j]:
+                budget -= len(k) + len(v) + 16
+            budget -= 8
+            j += 1
+        out.append(_build_one_block(stream_id, timestamps[i:j], rows[i:j],
+                                    stream_tags_str))
+        i = j
+    return out
+
+
+def _build_one_block(
+    stream_id: StreamID,
+    timestamps: np.ndarray,
+    rows: list[list[tuple[str, str]]],
+    stream_tags_str: str,
+) -> BlockData:
+    nrows = len(rows)
+    # same-fields fast path (reference block.go:224-244): most batches from a
+    # single source share one field schema, so detect it cheaply first
+    names: list[str] = [k for k, _ in rows[0]]
+    same_schema = True
+    for r in rows[1:]:
+        if len(r) != len(names) or any(r[i][0] != names[i]
+                                       for i in range(len(names))):
+            same_schema = False
+            break
+
+    col_values: dict[str, list[str]] = {}
+    if same_schema:
+        for idx, name in enumerate(names):
+            if name not in col_values:
+                col_values[name] = [r[idx][1] for r in rows]
+    else:
+        all_names: dict[str, None] = {}
+        for r in rows:
+            for k, _ in r:
+                all_names.setdefault(k, None)
+        for name in all_names:
+            col_values[name] = [""] * nrows
+        for ri, r in enumerate(rows):
+            for k, v in r:
+                col_values[k][ri] = v
+
+    columns: list[EncodedColumn] = []
+    const_columns: list[tuple[str, str]] = []
+    for name, values in col_values.items():
+        col = encode_values(name, values)
+        if col.vtype == VT_CONST:
+            const_columns.append((name, col.const_value))
+        else:
+            build_column_bloom(col, nrows)
+            columns.append(col)
+
+    # timestamps must be sorted within a block (reference asserts this:
+    # block.go:177-195)
+    ts = np.asarray(timestamps, dtype=np.int64)
+    assert nrows == ts.shape[0]
+    return BlockData(stream_id=stream_id, timestamps=ts, columns=columns,
+                     const_columns=const_columns,
+                     stream_tags_str=stream_tags_str)
+
+
+def blocks_from_log_rows(lr) -> list[BlockData]:
+    """Sort a LogRows batch by (stream_id, timestamp) and build blocks.
+
+    Reference: datadb flush sorts rows the same way before building an
+    in-memory part (datadb.go:749-763).
+    """
+    n = len(lr)
+    if n == 0:
+        return []
+    order = sorted(range(n), key=lambda i: (lr.stream_ids[i], lr.timestamps[i]))
+    out: list[BlockData] = []
+    i = 0
+    while i < n:
+        sid = lr.stream_ids[order[i]]
+        j = i
+        while j < n and lr.stream_ids[order[j]] == sid:
+            j += 1
+        idxs = order[i:j]
+        ts = np.fromiter((lr.timestamps[k] for k in idxs), dtype=np.int64,
+                         count=j - i)
+        rows = [lr.rows[k] for k in idxs]
+        out.extend(build_blocks(sid, ts, rows,
+                                stream_tags_str=lr.stream_tags_str[idxs[0]]))
+        i = j
+    return out

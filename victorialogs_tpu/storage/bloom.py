@@ -1,0 +1,76 @@
+"""Per-block split bloom filters over column tokens.
+
+Same parameters as the reference (lib/logstorage/bloomfilter.go:15-19):
+6 probe bits per token, 16 bits allotted per distinct token, one filter per
+(block, column).  Probe positions are derived from the token's xxhash64 by an
+iterated splitmix64 stream (the reference iterates xxhash on the hash —
+bloomfilter.go:126-170; splitmix keeps the derivation pure integer math so the
+same positions are computable on device from a staged uint64 hash without any
+string access).
+
+Build and probe are fully vectorized over numpy uint64 words.  The device-side
+probe (tpu/) consumes the same words reinterpreted as 2× uint32 lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.hashing import splitmix64_np
+
+BLOOM_HASHES = 6
+BLOOM_BITS_PER_TOKEN = 16
+
+
+def bloom_num_words(ntokens: int) -> int:
+    bits = max(64, BLOOM_BITS_PER_TOKEN * ntokens)
+    return (bits + 63) // 64
+
+
+def bloom_build(token_hashes: np.ndarray) -> np.ndarray:
+    """Build a bloom filter from uint64 token hashes -> uint64[W] words."""
+    nwords = bloom_num_words(len(token_hashes))
+    nbits = np.uint64(nwords * 64)
+    words = np.zeros(nwords, dtype=np.uint64)
+    h = token_hashes.astype(np.uint64, copy=True)
+    one = np.uint64(1)
+    for _ in range(BLOOM_HASHES):
+        pos = h % nbits
+        np.bitwise_or.at(words, (pos >> np.uint64(6)).astype(np.int64),
+                         one << (pos & np.uint64(63)))
+        h = splitmix64_np(h)
+    return words
+
+
+def bloom_contains_all(words: np.ndarray, token_hashes: np.ndarray) -> bool:
+    """True if every token's 6 probe bits are set (possible false positives)."""
+    if len(token_hashes) == 0:
+        return True
+    nbits = np.uint64(words.shape[0] * 64)
+    h = token_hashes.astype(np.uint64, copy=True)
+    one = np.uint64(1)
+    ok = np.ones(len(h), dtype=bool)
+    for _ in range(BLOOM_HASHES):
+        pos = h % nbits
+        bit = (words[(pos >> np.uint64(6)).astype(np.int64)]
+               >> (pos & np.uint64(63))) & one
+        ok &= bit.astype(bool)
+        if not ok.any():
+            return False
+        h = splitmix64_np(h)
+    return bool(ok.all())
+
+
+def bloom_probe_positions(token_hashes: np.ndarray, nwords: int) -> np.ndarray:
+    """All probe bit positions for the given hashes -> uint64[T, 6].
+
+    Used by the TPU path: positions are computed host-side for the (few) query
+    tokens, the device only tests bits across many block blooms at once.
+    """
+    nbits = np.uint64(nwords * 64)
+    h = token_hashes.astype(np.uint64, copy=True)
+    out = np.empty((len(h), BLOOM_HASHES), dtype=np.uint64)
+    for k in range(BLOOM_HASHES):
+        out[:, k] = h % nbits
+        h = splitmix64_np(h)
+    return out

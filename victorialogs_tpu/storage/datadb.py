@@ -1,0 +1,320 @@
+"""Per-partition LSM over columnar parts.
+
+Three tiers like the reference datadb (lib/logstorage/datadb.go:76-82):
+in-memory parts -> small file parts -> big file parts, with background
+merging, a `parts.json` manifest atomically rewritten on every part-set change
+(datadb.go:909-916), unreferenced part dirs removed at open (datadb.go:158-159)
+and periodic in-memory flush (datadb.go:272-300).
+
+Departures: merging rebuilds blocks via decode+re-encode of the overlapping
+streams instead of a streaming k-way block merge (correct, simpler; a
+streaming merger is a later optimization), and concurrency is one lock plus a
+flusher thread — on TPU hosts the query path gets its parallelism from the
+device, not from goroutine-per-CPU merges.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from .block import BlockData, blocks_from_log_rows, build_blocks
+from .part import Part, write_part
+from .values_encoder import decode_values
+
+DEFAULT_PARTS_TO_MERGE = 15          # reference datadb.go:33-45
+MIN_MERGE_MULTIPLIER = 1.7
+MAX_INMEMORY_PARTS = 8
+BIG_PART_SIZE = 64 << 20             # compressed bytes; small->big promotion
+PARTS_JSON = "parts.json"
+
+
+class InmemoryPart:
+    """A flushed-but-not-yet-durable part: blocks held decoded in memory."""
+
+    def __init__(self, blocks: list[BlockData]):
+        self.blocks = blocks
+        self.num_blocks = len(blocks)
+        self.num_rows = sum(b.num_rows for b in blocks)
+        self.min_ts = min((b.min_ts for b in blocks), default=0)
+        self.max_ts = max((b.max_ts for b in blocks), default=0)
+        self.created_at = time.monotonic()
+        self.path = None
+
+    # ---- uniform block-access API (see part.Part) ----
+    def block_stream_id(self, i):
+        return self.blocks[i].stream_id
+
+    def block_tags(self, i):
+        return self.blocks[i].stream_tags_str
+
+    def block_rows(self, i):
+        return self.blocks[i].num_rows
+
+    def block_min_ts(self, i):
+        return self.blocks[i].min_ts
+
+    def block_max_ts(self, i):
+        return self.blocks[i].max_ts
+
+    def block_consts(self, i):
+        return self.blocks[i].const_columns
+
+    def block_col_names(self, i):
+        return [c.name for c in self.blocks[i].columns]
+
+    def block_column_meta(self, i, name):
+        c = self.blocks[i].get_column(name)
+        if c is None:
+            return None
+        meta = {"n": c.name, "t": c.vtype}
+        if c.dict_values is not None:
+            meta["dict"] = c.dict_values
+        meta["min"] = c.min_val
+        meta["max"] = c.max_val
+        return meta
+
+    def block_column_bloom(self, i, name):
+        c = self.blocks[i].get_column(name)
+        return c.bloom if c is not None else None
+
+    def block_column(self, i, name):
+        return self.blocks[i].get_column(name)
+
+    def block_timestamps(self, i):
+        return self.blocks[i].timestamps
+
+    def read_block(self, i):
+        return self.blocks[i]
+
+    def iter_blocks(self):
+        yield from self.blocks
+
+    def close(self):
+        pass
+
+
+def _part_rows(blocks: list[BlockData]):
+    """Decode part blocks back into per-stream row iterables for merging."""
+    for b in blocks:
+        nrows = b.num_rows
+        col_strs = [(c.name, c.to_strings(nrows)) for c in b.columns]
+        consts = b.const_columns
+        ts = b.timestamps.tolist()
+        for ri in range(nrows):
+            fields = [(n, vals[ri]) for n, vals in col_strs if vals[ri] != ""]
+            fields += [(k, v) for k, v in consts]
+            yield (b.stream_id, ts[ri], fields, b.stream_tags_str)
+
+
+def merge_blocks(parts_blocks: list[list[BlockData]]) -> list[BlockData]:
+    """Merge blocks from several parts into a fresh sorted block list."""
+    rows = []
+    for blocks in parts_blocks:
+        rows.extend(_part_rows(blocks))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    out: list[BlockData] = []
+    i, n = 0, len(rows)
+    while i < n:
+        sid = rows[i][0]
+        j = i
+        while j < n and rows[j][0] == sid:
+            j += 1
+        ts = np.fromiter((rows[k][1] for k in range(i, j)), dtype=np.int64,
+                         count=j - i)
+        out.extend(build_blocks(sid, ts, [rows[k][2] for k in range(i, j)],
+                                stream_tags_str=rows[i][3]))
+        i = j
+    return out
+
+
+class DataDB:
+    def __init__(self, path: str, flush_interval: float = 5.0):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.flush_interval = flush_interval
+        self._lock = threading.Lock()
+        # serializes merge selection+execution so two threads can never pick
+        # overlapping part sets (reference serializes via per-tier merge
+        # worker channels — datadb.go:209-262)
+        self._merge_lock = threading.Lock()
+        self.inmemory_parts: list[InmemoryPart] = []
+        self.small_parts: list[Part] = []
+        self.big_parts: list[Part] = []
+        self._next_part_id = 0
+        self._stop = threading.Event()
+        self._open_existing()
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+        self.merges_done = 0
+
+    # ---- open / recovery ----
+    def _open_existing(self) -> None:
+        manifest = os.path.join(self.path, PARTS_JSON)
+        names: list[str] = []
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                names = json.load(f)["parts"]
+        referenced = set(names)
+        for entry in os.listdir(self.path):
+            full = os.path.join(self.path, entry)
+            if entry == PARTS_JSON or not os.path.isdir(full):
+                continue
+            if entry not in referenced:
+                # leftover from crash mid-merge/mid-write: drop it
+                shutil.rmtree(full, ignore_errors=True)
+        for name in names:
+            p = Part(os.path.join(self.path, name))
+            p.name = name
+            (self.big_parts if p.meta["compressed_size"] >= BIG_PART_SIZE
+             else self.small_parts).append(p)
+            try:
+                num = int(name.split("_")[-1], 16)
+                self._next_part_id = max(self._next_part_id, num + 1)
+            except ValueError:
+                pass
+
+    def _write_manifest_locked(self) -> None:
+        names = [p.name for p in self.small_parts + self.big_parts]
+        tmp = os.path.join(self.path, PARTS_JSON + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"parts": names}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, PARTS_JSON))
+
+    def _new_part_name_locked(self) -> str:
+        name = f"part_{self._next_part_id:016x}"
+        self._next_part_id += 1
+        return name
+
+    # ---- write path ----
+    def must_add_blocks(self, blocks: list[BlockData]) -> None:
+        if not blocks:
+            return
+        with self._lock:
+            self.inmemory_parts.append(InmemoryPart(blocks))
+            need_flush = len(self.inmemory_parts) > MAX_INMEMORY_PARTS
+        if need_flush:
+            self.flush_inmemory_parts()
+
+    def must_add_log_rows(self, lr) -> None:
+        self.must_add_blocks(blocks_from_log_rows(lr))
+
+    # ---- flush / merge ----
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(min(self.flush_interval, 1.0)):
+            with self._lock:
+                oldest = min((p.created_at for p in self.inmemory_parts),
+                             default=None)
+            if oldest is not None and \
+               time.monotonic() - oldest >= self.flush_interval:
+                try:
+                    self.flush_inmemory_parts()
+                except Exception:  # pragma: no cover - keep flusher alive
+                    pass
+
+    def flush_inmemory_parts(self) -> None:
+        """Merge all in-memory parts into one small file part (durable)."""
+        with self._lock:
+            imps = self.inmemory_parts
+            if not imps:
+                return
+            self.inmemory_parts = []
+        if len(imps) == 1:
+            merged = imps[0].blocks
+        else:
+            merged = merge_blocks([im.blocks for im in imps])
+        with self._lock:
+            name = self._new_part_name_locked()
+        write_part(os.path.join(self.path, name), merged)
+        p = Part(os.path.join(self.path, name))
+        p.name = name
+        with self._lock:
+            self.small_parts.append(p)
+            self._write_manifest_locked()
+        self._maybe_merge()
+
+    def _maybe_merge(self) -> None:
+        """Merge small parts when there are too many (bin-pack equivalent)."""
+        with self._merge_lock:
+            with self._lock:
+                if len(self.small_parts) < DEFAULT_PARTS_TO_MERGE:
+                    return
+                to_merge = list(self.small_parts)
+            self._merge_parts(to_merge, big=False)
+
+    def force_merge(self) -> None:
+        """Merge ALL file parts into one big part (reference MustForceMerge)."""
+        self.flush_inmemory_parts()
+        with self._merge_lock:
+            with self._lock:
+                to_merge = list(self.small_parts) + list(self.big_parts)
+            if len(to_merge) > 1:
+                self._merge_parts(to_merge, big=True)
+
+    def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
+        merged = merge_blocks([[p.read_block(i) for i in range(p.num_blocks)]
+                               for p in to_merge])
+        with self._lock:
+            name = self._new_part_name_locked()
+        write_part(os.path.join(self.path, name), merged, big=big)
+        newp = Part(os.path.join(self.path, name))
+        newp.name = name
+        with self._lock:
+            dropped = set(id(p) for p in to_merge)
+            self.small_parts = [p for p in self.small_parts
+                                if id(p) not in dropped]
+            self.big_parts = [p for p in self.big_parts
+                              if id(p) not in dropped]
+            if newp.meta["compressed_size"] >= BIG_PART_SIZE or big:
+                self.big_parts.append(newp)
+            else:
+                self.small_parts.append(newp)
+            self._write_manifest_locked()
+            self.merges_done += 1
+        # do NOT close the merged-away parts: concurrent queries may hold them
+        # via snapshot_parts().  Unlinking is safe — open fds and mmaps stay
+        # readable on POSIX, and Python closes the files when the last snapshot
+        # reference dies (the reference gets the same effect via refcounted
+        # partWrappers — datadb.go:100-149).
+        for p in to_merge:
+            shutil.rmtree(p.path, ignore_errors=True)
+
+    # ---- read path ----
+    def snapshot_parts(self) -> list:
+        """Stable part list for one query (parts are immutable once listed)."""
+        with self._lock:
+            return list(self.inmemory_parts) + list(self.small_parts) + \
+                   list(self.big_parts)
+
+    # ---- stats / lifecycle ----
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "inmemory_parts": len(self.inmemory_parts),
+                "small_parts": len(self.small_parts),
+                "big_parts": len(self.big_parts),
+                "inmemory_rows": sum(p.num_rows for p in self.inmemory_parts),
+                "file_rows": sum(p.num_rows
+                                 for p in self.small_parts + self.big_parts),
+                "compressed_size": sum(p.meta["compressed_size"]
+                                       for p in self.small_parts
+                                       + self.big_parts),
+                "uncompressed_size": sum(p.meta["uncompressed_size"]
+                                         for p in self.small_parts
+                                         + self.big_parts),
+            }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._flusher.join(timeout=5)
+        self.flush_inmemory_parts()
+        with self._lock:
+            for p in self.small_parts + self.big_parts:
+                p.close()

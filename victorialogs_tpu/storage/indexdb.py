@@ -1,0 +1,138 @@
+"""Per-partition stream index.
+
+Indexes *stream labels only* (never message content), like the reference's
+mergeset-backed indexdb (lib/logstorage/indexdb.go:20-31): it answers
+"which streamIDs in this partition match `{label=...}`" and "what are the tags
+of streamID X".
+
+The reference stores three key namespaces in an LSM mergeset table.  Our v1
+representation is an append-only registration log (`streams.jsonl.zst` frames)
+hydrated into an in-memory table at open — same query semantics, with the
+stream-filter result cache keyed by filter string (indexdb.go:55-57).  Stream
+cardinality per day-partition is low relative to row count, so the in-memory
+table is the right trade-off; a mergeset-equivalent SSTable backend can slot in
+behind the same API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .log_rows import StreamID, TenantID
+from .stream_filter import StreamFilter, parse_stream_tags
+
+STREAMS_FILENAME = "streams.jsonl"
+
+
+class IndexDB:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+        # streamID -> canonical tags string
+        self._streams: dict[StreamID, str] = {}
+        # tenant -> list[StreamID] for tenant-scoped scans
+        self._by_tenant: dict[TenantID, list[StreamID]] = {}
+        self._filter_cache: dict[tuple, list[StreamID]] = {}
+        self._file_path = os.path.join(path, STREAMS_FILENAME)
+        if os.path.exists(self._file_path):
+            self._load()
+        self._file = open(self._file_path, "a", buffering=1 << 16)
+
+    def _load(self) -> None:
+        with open(self._file_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write after crash: ignore
+                sid = StreamID(TenantID(rec["a"], rec["p"]),
+                               rec["h"], rec["l"])
+                self._register_mem(sid, rec["t"])
+
+    def _register_mem(self, sid: StreamID, tags_str: str) -> None:
+        if sid in self._streams:
+            return
+        self._streams[sid] = tags_str
+        self._by_tenant.setdefault(sid.tenant, []).append(sid)
+
+    def close(self) -> None:
+        with self._lock:
+            self._file.flush()
+            self._file.close()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    # ---- write path ----
+    def has_stream_id(self, sid: StreamID) -> bool:
+        with self._lock:
+            return sid in self._streams
+
+    def must_register_stream(self, sid: StreamID, tags_str: str) -> None:
+        self.must_register_streams([(sid, tags_str)])
+
+    def must_register_streams(
+            self, streams: list[tuple[StreamID, str]]) -> None:
+        """Durably register new streams (fsynced before returning, so rows
+        that reach a durable part can never reference an unindexed stream —
+        the register-before-rows invariant partition.py relies on)."""
+        with self._lock:
+            wrote = False
+            for sid, tags_str in streams:
+                if sid in self._streams:
+                    continue
+                self._register_mem(sid, tags_str)
+                self._file.write(json.dumps({
+                    "a": sid.tenant.account_id, "p": sid.tenant.project_id,
+                    "h": sid.hi, "l": sid.lo, "t": tags_str,
+                }, separators=(",", ":")) + "\n")
+                wrote = True
+            if wrote:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                # registrations invalidate cached filter results
+                self._filter_cache.clear()
+
+    # ---- read path ----
+    def get_stream_tags(self, sid: StreamID) -> str | None:
+        with self._lock:
+            return self._streams.get(sid)
+
+    def search_stream_ids(self, tenants: list[TenantID],
+                          sf: StreamFilter) -> list[StreamID]:
+        key = (tuple(tenants), sf)
+        with self._lock:
+            cached = self._filter_cache.get(key)
+            if cached is not None:
+                return cached
+            out: list[StreamID] = []
+            for t in tenants:
+                for sid in self._by_tenant.get(t, ()):  # insertion order
+                    tags = parse_stream_tags(self._streams[sid])
+                    if sf.matches(tags):
+                        out.append(sid)
+            out.sort()
+            if len(self._filter_cache) > 512:
+                self._filter_cache.clear()
+            self._filter_cache[key] = out
+            return out
+
+    def all_stream_ids(self, tenants: list[TenantID]) -> list[StreamID]:
+        with self._lock:
+            out: list[StreamID] = []
+            for t in tenants:
+                out.extend(self._by_tenant.get(t, ()))
+            out.sort()
+            return out
+
+    def num_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)

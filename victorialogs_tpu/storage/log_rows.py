@@ -1,0 +1,169 @@
+"""Ingestion row model: tenants, stream IDs, row batches.
+
+Reference semantics: a log stream is identified by (tenantID, 128-bit hash of
+the canonical sorted stream-label string) — lib/logstorage/stream_id.go:11-22,
+tenant = (AccountID, ProjectID) — tenant_id.go.  `LogRows` is the arena-backed
+ingestion batch that computes stream IDs from the configured stream fields and
+applies ignore/extra-field rules — log_rows.go:21-57.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+from ..utils.hashing import stream_id_hash
+
+
+@dataclass(frozen=True, order=True)
+class TenantID:
+    account_id: int = 0
+    project_id: int = 0
+
+    def as_string(self) -> str:
+        return f"{self.account_id}:{self.project_id}"
+
+    @staticmethod
+    def parse(s: str) -> "TenantID":
+        if not s:
+            return TenantID()
+        parts = s.split(":")
+        if len(parts) == 1:
+            return TenantID(int(parts[0]), 0)
+        return TenantID(int(parts[0]), int(parts[1]))
+
+
+@dataclass(frozen=True, order=True)
+class StreamID:
+    tenant: TenantID
+    hi: int
+    lo: int
+
+    def as_string(self) -> str:
+        # matches the reference's _stream_id hex rendering:
+        # 32 hex chars of the 128-bit hash (stream_id.go marshaling)
+        return f"{self.tenant.account_id:08x}{self.tenant.project_id:08x}" \
+               f"{self.hi:016x}{self.lo:016x}"
+
+    @staticmethod
+    def parse(s: str) -> "StreamID | None":
+        if len(s) != 48:
+            return None
+        try:
+            return StreamID(
+                TenantID(int(s[0:8], 16), int(s[8:16], 16)),
+                int(s[16:32], 16), int(s[32:48], 16))
+        except ValueError:
+            return None
+
+
+def canonical_stream_tags(tags: list[tuple[str, str]]) -> str:
+    """Canonical `{k1="v1",k2="v2"}` rendering, sorted by label name."""
+    items = sorted(tags)
+    inner = ",".join(f'{k}={_quote(v)}' for k, v in items)
+    return "{" + inner + "}"
+
+
+def _quote(v: str) -> str:
+    return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+@dataclass
+class Row:
+    """One log row: timestamp in ns + field name/value pairs."""
+
+    timestamp: int
+    fields: list[tuple[str, str]]
+
+    def get(self, name: str) -> str:
+        for k, v in self.fields:
+            if k == name:
+                return v
+        return ""
+
+
+@dataclass
+class LogRows:
+    """A batch of rows destined for one Storage, with per-row stream IDs.
+
+    stream_fields: field names that define the stream (like `_stream_fields`).
+    ignore_fields: field names (or `prefix.*` patterns) dropped at ingestion.
+    extra_fields: fields force-added to every row.
+    """
+
+    stream_fields: list[str] = dc_field(default_factory=list)
+    ignore_fields: list[str] = dc_field(default_factory=list)
+    extra_fields: list[tuple[str, str]] = dc_field(default_factory=list)
+    default_msg_value: str = ""
+
+    timestamps: list[int] = dc_field(default_factory=list)
+    rows: list[list[tuple[str, str]]] = dc_field(default_factory=list)
+    stream_ids: list[StreamID] = dc_field(default_factory=list)
+    stream_tags_str: list[str] = dc_field(default_factory=list)
+    tenants: list[TenantID] = dc_field(default_factory=list)
+    _stream_cache: dict = dc_field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.timestamps)
+
+    def add(self, tenant: TenantID, timestamp: int,
+            fields: list[tuple[str, str]]) -> None:
+        if self.ignore_fields or self.extra_fields:
+            fields = self._apply_field_rules(fields)
+        # rename duplicate handling: keep the first occurrence of each name
+        seen: set[str] = set()
+        clean: list[tuple[str, str]] = []
+        has_msg = False
+        for k, v in fields:
+            if k == "_time":
+                continue
+            if k == "_msg":
+                has_msg = True
+            if k in seen:
+                continue
+            seen.add(k)
+            clean.append((k, v))
+        if not has_msg and self.default_msg_value:
+            clean.append(("_msg", self.default_msg_value))
+
+        stream_tags = [(k, v) for k, v in clean if k in self.stream_fields] \
+            if self.stream_fields else []
+        key = (tenant, tuple(stream_tags))
+        cached = self._stream_cache.get(key)
+        if cached is None:
+            tags_str = canonical_stream_tags(stream_tags)
+            hi, lo = stream_id_hash(tags_str.encode("utf-8"))
+            cached = (StreamID(tenant, hi, lo), tags_str)
+            self._stream_cache[key] = cached
+        sid, tags_str = cached
+
+        self.timestamps.append(timestamp)
+        self.rows.append(clean)
+        self.stream_ids.append(sid)
+        self.stream_tags_str.append(tags_str)
+        self.tenants.append(tenant)
+
+    def _apply_field_rules(
+            self, fields: list[tuple[str, str]]) -> list[tuple[str, str]]:
+        out = []
+        for k, v in fields:
+            drop = False
+            for pat in self.ignore_fields:
+                if pat.endswith("*"):
+                    if k.startswith(pat[:-1]):
+                        drop = True
+                        break
+                elif k == pat:
+                    drop = True
+                    break
+            if not drop:
+                out.append((k, v))
+        for k, v in self.extra_fields:
+            out.append((k, v))
+        return out
+
+    def reset(self) -> None:
+        self.timestamps.clear()
+        self.rows.clear()
+        self.stream_ids.clear()
+        self.stream_tags_str.clear()
+        self.tenants.clear()

@@ -1,0 +1,342 @@
+"""On-disk part format: writer + lazy reader.
+
+A part is an immutable directory of column-oriented files (the reference uses
+13 file kinds — lib/logstorage/filenames.go:3-24, part.go:15-50; we collapse
+to five with the same capabilities):
+
+  metadata.json    part-level stats (rows, blocks, time range, sizes, version)
+  index.bin        zstd-compressed JSON array of block headers (stream id,
+                   row count, time range, per-column regions + min/max + dicts)
+  timestamps.bin   per-block zstd(delta-encoded int64 nanos)
+  columns.bin      per-(block,column) zstd-compressed payload regions
+  blooms.bin       raw uint64 bloom words, memory-mapped at query time
+
+Bloom words stay uncompressed on purpose: they are probed for *every* block a
+query touches (the cheap kill-path), so they must be random-accessible without
+a decompress step — the reader memory-maps them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+import zstandard
+
+from .block import BlockData
+from .log_rows import StreamID, TenantID
+from .values_encoder import (EncodedColumn, VT_DICT, VT_FLOAT64, VT_INT64,
+                             VT_IPV4, VT_STRING, VT_TIMESTAMP_ISO8601,
+                             VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64)
+
+FORMAT_VERSION = 1
+METADATA_FILENAME = "metadata.json"
+INDEX_FILENAME = "index.bin"
+TIMESTAMPS_FILENAME = "timestamps.bin"
+COLUMNS_FILENAME = "columns.bin"
+BLOOMS_FILENAME = "blooms.bin"
+
+_NUM_DTYPES = {
+    VT_UINT8: np.uint8, VT_UINT16: np.uint16, VT_UINT32: np.uint32,
+    VT_UINT64: np.uint64, VT_INT64: np.int64, VT_FLOAT64: np.float64,
+    VT_IPV4: np.uint32, VT_TIMESTAMP_ISO8601: np.int64,
+}
+
+_zc = zstandard.ZstdCompressor(level=1)
+_zc_hi = zstandard.ZstdCompressor(level=3)
+_zd = zstandard.ZstdDecompressor()
+
+
+def _compress(data: bytes, hi: bool = False) -> bytes:
+    return (_zc_hi if hi else _zc).compress(data)
+
+
+def _decompress(data: bytes) -> bytes:
+    return _zd.decompress(data)
+
+
+def write_part(path: str, blocks: list[BlockData], big: bool = False) -> None:
+    """Write blocks (already sorted by (stream_id, ts)) as a part directory."""
+    tmp = path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    headers = []
+    total_rows = 0
+    min_ts, max_ts = None, None
+    comp_size = 0
+    uncomp_size = 0
+    with open(os.path.join(tmp, TIMESTAMPS_FILENAME), "wb") as ts_f, \
+         open(os.path.join(tmp, COLUMNS_FILENAME), "wb") as col_f, \
+         open(os.path.join(tmp, BLOOMS_FILENAME), "wb") as bloom_f:
+        ts_off = col_off = bloom_off = 0
+        for b in blocks:
+            total_rows += b.num_rows
+            if min_ts is None or b.min_ts < min_ts:
+                min_ts = b.min_ts
+            if max_ts is None or b.max_ts > max_ts:
+                max_ts = b.max_ts
+            uncomp_size += b.uncompressed_size()
+            # timestamps: delta-encode then zstd
+            ts = b.timestamps
+            deltas = np.empty_like(ts)
+            deltas[0] = ts[0] if len(ts) else 0
+            np.subtract(ts[1:], ts[:-1], out=deltas[1:])
+            ts_z = _compress(deltas.tobytes(), hi=big)
+            ts_f.write(ts_z)
+            ts_region = [ts_off, len(ts_z)]
+            ts_off += len(ts_z)
+
+            cols_hdr = []
+            for c in b.columns:
+                payload = _column_payload(c)
+                cz = _compress(payload, hi=big)
+                col_f.write(cz)
+                ch = {"n": c.name, "t": c.vtype, "r": [col_off, len(cz)]}
+                col_off += len(cz)
+                if c.bloom is not None:
+                    bloom_f.write(c.bloom.tobytes())
+                    ch["b"] = [bloom_off, int(c.bloom.shape[0])]
+                    bloom_off += c.bloom.shape[0] * 8
+                if c.vtype == VT_DICT:
+                    ch["dict"] = c.dict_values
+                elif c.vtype != VT_STRING:
+                    ch["min"] = c.min_val
+                    ch["max"] = c.max_val
+                    if c.vtype == VT_TIMESTAMP_ISO8601:
+                        ch["fw"] = c.iso_frac_w
+                cols_hdr.append(ch)
+
+            sid = b.stream_id
+            headers.append({
+                "sid": [sid.tenant.account_id, sid.tenant.project_id,
+                        sid.hi, sid.lo],
+                "tags": b.stream_tags_str,
+                "rows": b.num_rows,
+                "min_ts": b.min_ts, "max_ts": b.max_ts,
+                "ts": ts_region,
+                "cols": cols_hdr,
+                "consts": b.const_columns,
+            })
+        comp_size = ts_off + col_off + bloom_off
+
+        for fh in (ts_f, col_f, bloom_f):
+            fh.flush()
+            os.fsync(fh.fileno())
+    index_z = _compress(json.dumps(headers, separators=(",", ":"))
+                        .encode("utf-8"), hi=True)
+    with open(os.path.join(tmp, INDEX_FILENAME), "wb") as f:
+        f.write(index_z)
+        f.flush()
+        os.fsync(f.fileno())
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "rows": total_rows,
+        "blocks": len(blocks),
+        "min_ts": min_ts or 0,
+        "max_ts": max_ts or 0,
+        "compressed_size": comp_size + len(index_z),
+        "uncompressed_size": uncomp_size,
+    }
+    with open(os.path.join(tmp, METADATA_FILENAME), "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # atomic publish: parts are immutable and always written to fresh names,
+    # so a bare rename is the commit point (crash before it leaves only .tmp
+    # garbage, which datadb removes at open — reference datadb.go:158-159).
+    # All part files are fsynced above so the later parts.json fsync can never
+    # durably reference a part whose data didn't hit the disk.
+    if os.path.exists(path):
+        import shutil
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def _column_payload(c: EncodedColumn) -> bytes:
+    if c.vtype == VT_STRING:
+        return (c.lengths.astype(np.int32).tobytes() + c.arena.tobytes())
+    if c.vtype == VT_DICT:
+        return c.ids.tobytes()
+    return c.nums.tobytes()
+
+
+@dataclass
+class BlockHeader:
+    """Parsed header of one block inside a part."""
+
+    stream_id: StreamID
+    stream_tags_str: str
+    rows: int
+    min_ts: int
+    max_ts: int
+    ts_region: tuple[int, int]
+    cols: list[dict]
+    consts: list[tuple[str, str]]
+
+    def col_header(self, name: str) -> dict | None:
+        for ch in self.cols:
+            if ch["n"] == name:
+                return ch
+        return None
+
+
+class Part:
+    """Lazy reader over an immutable part directory (or in-memory buffers)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, METADATA_FILENAME)) as f:
+            self.meta = json.load(f)
+        with open(os.path.join(path, INDEX_FILENAME), "rb") as f:
+            raw = _decompress(f.read())
+        self.headers: list[BlockHeader] = []
+        for h in json.loads(raw):
+            a, p, hi, lo = h["sid"]
+            self.headers.append(BlockHeader(
+                stream_id=StreamID(TenantID(a, p), hi, lo),
+                stream_tags_str=h.get("tags", ""),
+                rows=h["rows"], min_ts=h["min_ts"], max_ts=h["max_ts"],
+                ts_region=tuple(h["ts"]), cols=h["cols"],
+                consts=[tuple(x) for x in h["consts"]],
+            ))
+        self._ts_f = open(os.path.join(path, TIMESTAMPS_FILENAME), "rb")
+        self._col_f = open(os.path.join(path, COLUMNS_FILENAME), "rb")
+        bloom_path = os.path.join(path, BLOOMS_FILENAME)
+        if os.path.getsize(bloom_path) > 0:
+            self._blooms = np.memmap(bloom_path, dtype=np.uint64, mode="r")
+        else:
+            self._blooms = np.zeros(0, dtype=np.uint64)
+
+    # ---- properties ----
+    @property
+    def num_rows(self) -> int:
+        return self.meta["rows"]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.headers)
+
+    @property
+    def min_ts(self) -> int:
+        return self.meta["min_ts"]
+
+    @property
+    def max_ts(self) -> int:
+        return self.meta["max_ts"]
+
+    def close(self) -> None:
+        self._ts_f.close()
+        self._col_f.close()
+
+    # ---- lazy block access ----
+    def read_timestamps(self, block_idx: int) -> np.ndarray:
+        h = self.headers[block_idx]
+        off, ln = h.ts_region
+        self._ts_f.seek(off)
+        deltas = np.frombuffer(_decompress(self._ts_f.read(ln)),
+                               dtype=np.int64)
+        return np.cumsum(deltas)
+
+    def read_bloom(self, ch: dict) -> np.ndarray | None:
+        b = ch.get("b")
+        if b is None:
+            return None
+        off_bytes, nwords = b
+        start = off_bytes // 8
+        return np.asarray(self._blooms[start:start + nwords])
+
+    def read_column(self, block_idx: int, ch: dict) -> EncodedColumn:
+        h = self.headers[block_idx]
+        off, ln = ch["r"]
+        self._col_f.seek(off)
+        payload = _decompress(self._col_f.read(ln))
+        vt = ch["t"]
+        col = EncodedColumn(name=ch["n"], vtype=vt)
+        nrows = h.rows
+        if vt == VT_STRING:
+            lens = np.frombuffer(payload[:4 * nrows], dtype=np.int32) \
+                     .astype(np.int64)
+            col.lengths = lens
+            col.offsets = np.zeros(nrows, dtype=np.int64)
+            np.cumsum(lens[:-1], out=col.offsets[1:])
+            col.arena = np.frombuffer(payload[4 * nrows:], dtype=np.uint8)
+        elif vt == VT_DICT:
+            col.ids = np.frombuffer(payload, dtype=np.uint8)
+            col.dict_values = ch["dict"]
+        else:
+            col.nums = np.frombuffer(payload, dtype=_NUM_DTYPES[vt])
+            col.min_val = ch.get("min", 0.0)
+            col.max_val = ch.get("max", 0.0)
+            col.iso_frac_w = ch.get("fw", 0)
+        return col
+
+    def read_block(self, block_idx: int) -> BlockData:
+        h = self.headers[block_idx]
+        cols = [self.read_column(block_idx, ch) for ch in h.cols]
+        for c, ch in zip(cols, h.cols):
+            c.bloom = self.read_bloom(ch)
+        return BlockData(
+            stream_id=h.stream_id,
+            timestamps=self.read_timestamps(block_idx),
+            columns=cols,
+            const_columns=list(h.consts),
+            stream_tags_str=h.stream_tags_str,
+        )
+
+    def iter_blocks(self):
+        for i in range(self.num_blocks):
+            yield self.read_block(i)
+
+    # ---- uniform block-access API (shared with datadb.InmemoryPart) ----
+    # The search executor schedules blocks through these accessors so that
+    # in-memory and file parts look identical to it (the reference gets the
+    # same effect from inmemoryPart mirroring the part file streams —
+    # inmemory_part.go:13-27).
+
+    def block_stream_id(self, i: int) -> StreamID:
+        return self.headers[i].stream_id
+
+    def block_tags(self, i: int) -> str:
+        return self.headers[i].stream_tags_str
+
+    def block_rows(self, i: int) -> int:
+        return self.headers[i].rows
+
+    def block_min_ts(self, i: int) -> int:
+        return self.headers[i].min_ts
+
+    def block_max_ts(self, i: int) -> int:
+        return self.headers[i].max_ts
+
+    def block_consts(self, i: int) -> list[tuple[str, str]]:
+        return self.headers[i].consts
+
+    def block_col_names(self, i: int) -> list[str]:
+        return [ch["n"] for ch in self.headers[i].cols]
+
+    def block_column_meta(self, i: int, name: str) -> dict | None:
+        """Column metadata without reading the payload (vtype, min/max, dict)."""
+        return self.headers[i].col_header(name)
+
+    def block_column_bloom(self, i: int, name: str) -> np.ndarray | None:
+        ch = self.headers[i].col_header(name)
+        if ch is None:
+            return None
+        return self.read_bloom(ch)
+
+    def block_column(self, i: int, name: str) -> EncodedColumn | None:
+        ch = self.headers[i].col_header(name)
+        if ch is None:
+            return None
+        col = self.read_column(i, ch)
+        col.bloom = self.read_bloom(ch)
+        return col
+
+    def block_timestamps(self, i: int) -> np.ndarray:
+        return self.read_timestamps(i)

@@ -1,0 +1,60 @@
+"""Per-day partition: one indexdb + one datadb.
+
+Reference: lib/logstorage/partition.go:19-35 — a partition pairs the stream
+index with the LSM datadb for one UTC day; new streams are registered in the
+indexdb *before* their rows reach the datadb (partition.go:120-163).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .block import blocks_from_log_rows
+from .datadb import DataDB
+from .indexdb import IndexDB
+from .log_rows import LogRows
+
+INDEXDB_DIRNAME = "indexdb"
+DATADB_DIRNAME = "datadb"
+
+
+class Partition:
+    def __init__(self, path: str, day: int, flush_interval: float = 5.0):
+        """day: days since unix epoch (partition dir named YYYYMMDD)."""
+        self.path = path
+        self.day = day
+        os.makedirs(path, exist_ok=True)
+        self.idb = IndexDB(os.path.join(path, INDEXDB_DIRNAME))
+        self.ddb = DataDB(os.path.join(path, DATADB_DIRNAME),
+                          flush_interval=flush_interval)
+
+    def must_add_rows(self, lr: LogRows) -> None:
+        # register unseen streams first so a crash between index write and
+        # datadb write leaves only a harmless extra index entry
+        seen = set()
+        unseen: list[tuple] = []
+        for sid, tags in zip(lr.stream_ids, lr.stream_tags_str):
+            if sid in seen:
+                continue
+            seen.add(sid)
+            if not self.idb.has_stream_id(sid):
+                unseen.append((sid, tags))
+        if unseen:
+            self.idb.must_register_streams(unseen)
+        self.ddb.must_add_blocks(blocks_from_log_rows(lr))
+
+    def debug_flush(self) -> None:
+        self.idb.flush()
+        self.ddb.flush_inmemory_parts()
+
+    def force_merge(self) -> None:
+        self.ddb.force_merge()
+
+    def stats(self) -> dict:
+        s = self.ddb.stats()
+        s["streams"] = self.idb.num_streams()
+        return s
+
+    def close(self) -> None:
+        self.ddb.close()
+        self.idb.close()

@@ -1,0 +1,230 @@
+"""Storage root: per-day partitions, retention, ingestion entry point.
+
+Reference: lib/logstorage/storage.go — owns the partition list keyed by UTC
+day (dirs named YYYYMMDD — storage.go:326), splits incoming row batches by day
+(storage.go:525-582), runs retention deletion hourly (storage.go:347-387) and
+a max-disk-usage watcher (storage.go:389-443), and exposes DebugFlush /
+MustForceMerge / UpdateStats.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import shutil
+import threading
+import time
+
+from .log_rows import LogRows, TenantID
+from .partition import Partition
+
+NSECS_PER_DAY = 86400 * 1_000_000_000
+PARTITIONS_DIRNAME = "partitions"
+
+
+def day_from_ts(ts_ns: int) -> int:
+    return ts_ns // NSECS_PER_DAY
+
+
+def day_dir_name(day: int) -> str:
+    d = datetime.datetime.fromtimestamp(day * 86400, datetime.timezone.utc)
+    return d.strftime("%Y%m%d")
+
+
+def day_from_dir_name(name: str) -> int:
+    d = datetime.datetime.strptime(name, "%Y%m%d") \
+        .replace(tzinfo=datetime.timezone.utc)
+    return int(d.timestamp()) // 86400
+
+
+class Storage:
+    def __init__(self, path: str, retention_days: float = 7.0,
+                 flush_interval: float = 5.0, future_retention_days: float = 2.0,
+                 max_disk_usage_bytes: int = 0):
+        self.path = path
+        self.retention_days = retention_days
+        self.future_retention_days = future_retention_days
+        self.flush_interval = flush_interval
+        self.max_disk_usage_bytes = max_disk_usage_bytes
+        self._lock = threading.Lock()
+        self.partitions: dict[int, Partition] = {}
+        self.is_read_only = False
+        self.rows_dropped_too_old = 0
+        self.rows_dropped_too_new = 0
+        os.makedirs(self._pdir(), exist_ok=True)
+        for entry in sorted(os.listdir(self._pdir())):
+            if entry.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self._pdir(), entry),
+                              ignore_errors=True)
+                continue
+            try:
+                day = day_from_dir_name(entry)
+            except ValueError:
+                continue
+            self.partitions[day] = Partition(
+                os.path.join(self._pdir(), entry), day,
+                flush_interval=flush_interval)
+        self._stop = threading.Event()
+        self._retention_thread = threading.Thread(
+            target=self._watch_retention, daemon=True)
+        self._retention_thread.start()
+        self._disk_thread = None
+        if max_disk_usage_bytes > 0:
+            self._disk_thread = threading.Thread(
+                target=self._watch_disk_usage, daemon=True)
+            self._disk_thread.start()
+
+    def _pdir(self) -> str:
+        return os.path.join(self.path, PARTITIONS_DIRNAME)
+
+    # ---- ingestion ----
+    def must_add_rows(self, lr: LogRows) -> None:
+        """Split a batch by UTC day and add to the right partitions."""
+        if self.is_read_only:
+            raise RuntimeError("storage is read-only (disk usage limit)")
+        n = len(lr)
+        if n == 0:
+            return
+        now_ns = time.time_ns()
+        min_ts = now_ns - int(self.retention_days * NSECS_PER_DAY)
+        max_ts = now_ns + int(self.future_retention_days * NSECS_PER_DAY)
+        by_day: dict[int, list[int]] = {}
+        for i, ts in enumerate(lr.timestamps):
+            if ts < min_ts:
+                self.rows_dropped_too_old += 1
+                continue
+            if ts > max_ts:
+                self.rows_dropped_too_new += 1
+                continue
+            by_day.setdefault(day_from_ts(ts), []).append(i)
+        for day, idxs in by_day.items():
+            pt = self._get_partition(day)
+            if len(by_day) == 1 and len(idxs) == n:
+                pt.must_add_rows(lr)
+            else:
+                sub = LogRows()
+                for i in idxs:
+                    sub.timestamps.append(lr.timestamps[i])
+                    sub.rows.append(lr.rows[i])
+                    sub.stream_ids.append(lr.stream_ids[i])
+                    sub.stream_tags_str.append(lr.stream_tags_str[i])
+                    sub.tenants.append(lr.tenants[i])
+                pt.must_add_rows(sub)
+
+    def _get_partition(self, day: int) -> Partition:
+        with self._lock:
+            pt = self.partitions.get(day)
+            if pt is None:
+                path = os.path.join(self._pdir(), day_dir_name(day))
+                pt = Partition(path, day, flush_interval=self.flush_interval)
+                self.partitions[day] = pt
+            return pt
+
+    # ---- query support ----
+    def select_partitions(self, min_ts: int, max_ts: int) -> list[Partition]:
+        lo = day_from_ts(min_ts)
+        hi = day_from_ts(max_ts)
+        with self._lock:
+            return [p for d, p in sorted(self.partitions.items())
+                    if lo <= d <= hi]
+
+    # ---- maintenance ----
+    def debug_flush(self) -> None:
+        with self._lock:
+            parts = list(self.partitions.values())
+        for p in parts:
+            p.debug_flush()
+
+    def must_force_merge(self, partition_prefix: str = "") -> None:
+        with self._lock:
+            parts = [(d, p) for d, p in self.partitions.items()
+                     if day_dir_name(d).startswith(partition_prefix)]
+        for _, p in parts:
+            p.force_merge()
+
+    def _watch_retention(self) -> None:
+        while not self._stop.wait(3600.0):
+            try:
+                self.drop_expired_partitions()
+            except Exception:  # pragma: no cover
+                pass
+
+    def _watch_disk_usage(self) -> None:
+        # reference watchMaxDiskSpaceUsage (storage.go:389-443): when the
+        # data dir exceeds the limit, drop the oldest partitions to fit
+        while not self._stop.wait(10.0):
+            try:
+                self.enforce_max_disk_usage()
+            except Exception:  # pragma: no cover
+                pass
+
+    def _disk_usage_bytes(self) -> int:
+        total = 0
+        for root, _dirs, files in os.walk(self.path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+        return total
+
+    def enforce_max_disk_usage(self) -> list[int]:
+        """Drop oldest partitions while over max_disk_usage_bytes."""
+        if self.max_disk_usage_bytes <= 0:
+            return []
+        dropped: list[int] = []
+        while self._disk_usage_bytes() > self.max_disk_usage_bytes:
+            with self._lock:
+                days = sorted(self.partitions)
+                if len(days) <= 1:
+                    break  # never drop the newest partition
+                day = days[0]
+                p = self.partitions.pop(day)
+            p.close()
+            shutil.rmtree(p.path, ignore_errors=True)
+            dropped.append(day)
+        return dropped
+
+    def drop_expired_partitions(self, now_ns: int | None = None) -> list[int]:
+        """Delete partitions fully older than the retention window."""
+        if now_ns is None:
+            now_ns = time.time_ns()
+        min_day = day_from_ts(now_ns - int(self.retention_days
+                                           * NSECS_PER_DAY))
+        dropped = []
+        with self._lock:
+            for day in sorted(self.partitions):
+                if day < min_day:
+                    dropped.append(day)
+            parts = [(d, self.partitions.pop(d)) for d in dropped]
+        for day, p in parts:
+            p.close()
+            shutil.rmtree(p.path, ignore_errors=True)
+        return dropped
+
+    def update_stats(self) -> dict:
+        with self._lock:
+            parts = list(self.partitions.values())
+        agg = {
+            "partitions": len(parts), "streams": 0, "inmemory_rows": 0,
+            "file_rows": 0, "inmemory_parts": 0, "small_parts": 0,
+            "big_parts": 0, "compressed_size": 0, "uncompressed_size": 0,
+            "rows_dropped_too_old": self.rows_dropped_too_old,
+            "rows_dropped_too_new": self.rows_dropped_too_new,
+            "is_read_only": self.is_read_only,
+        }
+        for p in parts:
+            s = p.stats()
+            for k in ("streams", "inmemory_rows", "file_rows",
+                      "inmemory_parts", "small_parts", "big_parts",
+                      "compressed_size", "uncompressed_size"):
+                agg[k] += s[k]
+        return agg
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            parts = list(self.partitions.values())
+            self.partitions.clear()
+        for p in parts:
+            p.close()

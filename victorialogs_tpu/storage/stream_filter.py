@@ -1,0 +1,100 @@
+"""Stream filters: `{label="value", other=~"re.*"}` matching over stream tags.
+
+Reference: lib/logstorage/stream_filter.go (StreamFilter = OR-list of AND-lists
+of tag filters with ops = != =~ !~), evaluated against the per-partition
+stream index (indexdb.go:182-307).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TagFilter:
+    label: str
+    op: str        # '=', '!=', '=~', '!~'
+    value: str
+
+    def matches(self, tags: dict[str, str]) -> bool:
+        have = tags.get(self.label, "")
+        if self.op == "=":
+            return have == self.value
+        if self.op == "!=":
+            return have != self.value
+        rx = _compiled(self.value)
+        if self.op == "=~":
+            return rx.fullmatch(have) is not None
+        if self.op == "!~":
+            return rx.fullmatch(have) is None
+        raise ValueError(f"unknown tag filter op {self.op!r}")
+
+    def to_string(self) -> str:
+        return f'{self.label}{self.op}"{self.value}"'
+
+
+_RX_CACHE: dict[str, re.Pattern] = {}
+
+
+def _compiled(pattern: str) -> re.Pattern:
+    rx = _RX_CACHE.get(pattern)
+    if rx is None:
+        rx = re.compile(pattern)
+        if len(_RX_CACHE) > 1024:
+            _RX_CACHE.clear()
+        _RX_CACHE[pattern] = rx
+    return rx
+
+
+@dataclass(frozen=True)
+class StreamFilter:
+    """OR of AND-groups: [[f1, f2], [f3]] means (f1 AND f2) OR f3."""
+
+    or_groups: tuple[tuple[TagFilter, ...], ...]
+
+    def matches(self, tags: dict[str, str]) -> bool:
+        for grp in self.or_groups:
+            if all(tf.matches(tags) for tf in grp):
+                return True
+        return False
+
+    def to_string(self) -> str:
+        return "{" + " or ".join(
+            ",".join(tf.to_string() for tf in grp) for grp in self.or_groups
+        ) + "}"
+
+
+def parse_stream_tags(tags_str: str) -> dict[str, str]:
+    """Parse the canonical `{k="v",k2="v2"}` rendering back into a dict."""
+    out: dict[str, str] = {}
+    s = tags_str.strip()
+    if not (s.startswith("{") and s.endswith("}")):
+        return out
+    s = s[1:-1]
+    i = 0
+    n = len(s)
+    while i < n:
+        eq = s.find("=", i)
+        if eq < 0:
+            break
+        key = s[i:eq]
+        i = eq + 1
+        if i < n and s[i] == '"':
+            i += 1
+            buf = []
+            while i < n:
+                c = s[i]
+                if c == "\\" and i + 1 < n:
+                    buf.append(s[i + 1])
+                    i += 2
+                    continue
+                if c == '"':
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+            out[key] = "".join(buf)
+        if i < n and s[i] == ",":
+            i += 1
+    return out

@@ -1,0 +1,66 @@
+"""Hashing primitives.
+
+The reference hashes tokens with xxhash64 and derives bloom probe positions by
+iterating the hash (reference: lib/logstorage/bloomfilter.go:126-170).  We keep
+the same *shape* of the scheme — one 64-bit base hash per token, probe
+positions derived by a cheap iterated mixer — but define our own iteration
+(splitmix64) so the device never needs string hashing: probe positions are pure
+integer math on the base hash, computable both on host (numpy) and on device
+(jnp, as two uint32 lanes).
+
+Stream IDs are 128-bit hashes of the canonical stream-label string
+(reference: lib/logstorage/stream_id.go:11-22, hash128.go).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # C-accelerated scalar hashing
+    import xxhash as _xxhash
+
+    def xxh64(data: bytes, seed: int = 0) -> int:
+        return _xxhash.xxh64_intdigest(data, seed)
+
+    def xxh128(data: bytes, seed: int = 0) -> int:
+        return _xxhash.xxh128_intdigest(data, seed)
+
+except ImportError:  # pragma: no cover - xxhash is baked into the image
+    raise
+
+_U64 = (1 << 64) - 1
+
+
+def splitmix64(x: int) -> int:
+    """One splitmix64 round; used to derive bloom probe index streams."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return z ^ (z >> 31)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64 numpy array."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    z = x
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def hash_tokens(tokens: list[bytes] | list[str]) -> np.ndarray:
+    """xxhash64 each token; returns uint64 array."""
+    out = np.empty(len(tokens), dtype=np.uint64)
+    h = _xxhash.xxh64_intdigest
+    for i, t in enumerate(tokens):
+        if isinstance(t, str):
+            t = t.encode("utf-8")
+        out[i] = h(t)
+    return out
+
+
+def stream_id_hash(canonical_tags: bytes) -> tuple[int, int]:
+    """128-bit stream hash -> (hi, lo) uint64 pair."""
+    h = _xxhash.xxh128_intdigest(canonical_tags)
+    return (h >> 64) & _U64, h & _U64

@@ -1,0 +1,101 @@
+"""Word tokenizer for full-text indexing.
+
+Semantics follow the reference tokenizer (lib/logstorage/tokenizer.go:34-148):
+a token is a maximal run of word characters, where word characters are ASCII
+letters, digits and '_' (fast path), plus any unicode letter/digit (slow path).
+Tokens are what bloom filters index and what `word`/`phrase` filters match on
+word boundaries.
+
+The arena tokenizer here is vectorized with numpy over a whole column block at
+once (value boundaries force token boundaries), instead of the reference's
+per-value byte loop — the same boundary semantics, a layout that also matches
+what the TPU staging path needs.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+# ASCII word-char lookup table: A-Z a-z 0-9 _
+_WORD_CHAR = np.zeros(256, dtype=bool)
+for _c in range(ord("A"), ord("Z") + 1):
+    _WORD_CHAR[_c] = True
+for _c in range(ord("a"), ord("z") + 1):
+    _WORD_CHAR[_c] = True
+for _c in range(ord("0"), ord("9") + 1):
+    _WORD_CHAR[_c] = True
+_WORD_CHAR[ord("_")] = True
+# Non-ASCII bytes participate in (possibly multi-byte) unicode tokens; treating
+# every >=0x80 byte as a word char makes UTF-8 letter runs come out as single
+# tokens, matching the reference's unicode slow path for letters/digits.
+_WORD_CHAR[128:] = True
+
+_TOKEN_RE = re.compile("[A-Za-z0-9_" + chr(0x80) + "-" + chr(0x10FFFF) + "]+")
+
+
+def is_word_char_table() -> np.ndarray:
+    return _WORD_CHAR
+
+
+def tokenize_string(s: str) -> list[str]:
+    """Tokenize a single query-side string into word tokens."""
+    if s.isascii():
+        return re.findall(r"[A-Za-z0-9_]+", s)
+    return _TOKEN_RE.findall(s)
+
+
+def tokenize_arena(
+    arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Tokenize a packed string column.
+
+    arena: uint8[N] concatenated value bytes;
+    offsets/lengths: int64[R] per-value spans into the arena.
+
+    Returns (tok_start, tok_end, tok_row): parallel int64 arrays, one entry per
+    token, where arena[tok_start:tok_end] is the token and tok_row is the row
+    it came from.
+    """
+    n = arena.shape[0]
+    if n == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, z
+    mask = _WORD_CHAR[arena]
+    # previous-byte mask, with a forced boundary at every value start
+    prev = np.empty(n, dtype=bool)
+    prev[0] = False
+    prev[1:] = mask[:-1]
+    starts_at_value = offsets[lengths > 0]
+    prev[starts_at_value] = False
+    # next-byte mask, with a forced boundary at every value end
+    nxt = np.empty(n, dtype=bool)
+    nxt[-1] = False
+    nxt[:-1] = mask[1:]
+    ends = offsets + lengths
+    ends_inside = ends[(lengths > 0) & (ends < n)]
+    # ends_inside points at the byte *after* a value; the last byte of the
+    # value is ends_inside-1 and must not join with the next value's first byte
+    nxt[ends_inside - 1] = False
+
+    tok_start = np.nonzero(mask & ~prev)[0]
+    tok_end = np.nonzero(mask & ~nxt)[0] + 1
+    # map token starts to rows
+    tok_row = np.searchsorted(offsets, tok_start, side="right") - 1
+    return tok_start, tok_end, tok_row
+
+
+def unique_tokens_bytes(
+    arena: np.ndarray, tok_start: np.ndarray, tok_end: np.ndarray
+) -> list[bytes]:
+    """Materialize the set of distinct token byte-strings in arena order."""
+    seen: set[bytes] = set()
+    out: list[bytes] = []
+    buf = arena.tobytes()
+    for s, e in zip(tok_start.tolist(), tok_end.tolist()):
+        t = buf[s:e]
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
