@@ -1,0 +1,310 @@
+"""LogsQL parser unit tests (table-driven, after the reference parser tests)."""
+
+import pytest
+
+from victorialogs_tpu.logsql import filters as F
+from victorialogs_tpu.logsql.parser import ParseError, parse_query
+from victorialogs_tpu.logsql.pipes import (PipeFields, PipeLimit, PipeOffset,
+                                           PipeSort, PipeStats, PipeUniq,
+                                           PipeWhere)
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+
+
+def _parse(s):
+    return parse_query(s, timestamp=T0)
+
+
+def test_parse_word():
+    q = _parse("error")
+    assert isinstance(q.filter, F.FilterPhrase)
+    assert q.filter.field == ""
+    assert q.filter.phrase == "error"
+
+
+def test_parse_quoted_phrase():
+    q = _parse('"error message"')
+    assert isinstance(q.filter, F.FilterPhrase)
+    assert q.filter.phrase == "error message"
+
+
+def test_parse_field_scoped():
+    q = _parse("level:error")
+    f = q.filter
+    assert isinstance(f, F.FilterPhrase)
+    assert f.field == "level" and f.phrase == "error"
+
+
+def test_parse_implicit_and():
+    q = _parse("foo bar")
+    assert isinstance(q.filter, F.FilterAnd)
+    assert len(q.filter.filters) == 2
+
+
+def test_parse_or_and_precedence():
+    q = _parse("foo bar or baz")
+    f = q.filter
+    assert isinstance(f, F.FilterOr)
+    assert isinstance(f.filters[0], F.FilterAnd)
+    assert isinstance(f.filters[1], F.FilterPhrase)
+
+
+def test_parse_not():
+    for qs in ("!error", "-error", "not error"):
+        q = _parse(qs)
+        assert isinstance(q.filter, F.FilterNot), qs
+        assert isinstance(q.filter.inner, F.FilterPhrase)
+
+
+def test_parse_parens():
+    q = _parse("level:(error or warn) app")
+    f = q.filter
+    assert isinstance(f, F.FilterAnd)
+    assert isinstance(f.filters[0], F.FilterOr)
+    assert f.filters[0].filters[0].field == "level"
+
+
+def test_parse_prefix():
+    q = _parse("err*")
+    assert isinstance(q.filter, F.FilterPrefix)
+    assert q.filter.prefix == "err"
+
+
+def test_parse_exact():
+    q = _parse("level:=error")
+    assert isinstance(q.filter, F.FilterExact)
+    assert q.filter.value == "error"
+
+
+def test_parse_exact_prefix():
+    q = _parse('level:="err"*')
+    assert isinstance(q.filter, F.FilterExactPrefix)
+    assert q.filter.prefix == "err"
+
+
+def test_parse_ne():
+    q = _parse("level:!=error")
+    assert isinstance(q.filter, F.FilterNot)
+    assert isinstance(q.filter.inner, F.FilterExact)
+
+
+def test_parse_regexp():
+    q = _parse('_msg:~"err.*x"')
+    assert isinstance(q.filter, F.FilterRegexp)
+    assert q.filter.pattern == "err.*x"
+
+
+def test_parse_anycase():
+    q = _parse("level:i(Error)")
+    assert isinstance(q.filter, F.FilterAnyCasePhrase)
+    q = _parse("level:i(Err*)")
+    assert isinstance(q.filter, F.FilterAnyCasePrefix)
+
+
+def test_parse_in():
+    q = _parse("level:in(error, warn)")
+    assert isinstance(q.filter, F.FilterIn)
+    assert q.filter.values == ["error", "warn"]
+
+
+def test_parse_contains():
+    q = _parse('_msg:contains_all("a b", c)')
+    assert isinstance(q.filter, F.FilterContainsAll)
+    assert q.filter.values == ["a b", "c"]
+    q = _parse("_msg:contains_any(a, b)")
+    assert isinstance(q.filter, F.FilterContainsAny)
+
+
+def test_parse_seq():
+    q = _parse('_msg:seq("GET", "/api")')
+    assert isinstance(q.filter, F.FilterSequence)
+    assert q.filter.phrases == ["GET", "/api"]
+
+
+def test_parse_range_comparisons():
+    q = _parse("status:>400")
+    assert isinstance(q.filter, F.FilterRange)
+    assert q.filter.min_value > 400
+    q = _parse("status:>=400")
+    assert q.filter.min_value == 400
+    q = _parse("size:<10KB")
+    assert q.filter.max_value < 10_000
+    q = _parse("size:<=10KB")
+    assert q.filter.max_value == 10_000
+
+
+def test_parse_range_fn():
+    q = _parse("size:range(100, 200]")
+    f = q.filter
+    assert isinstance(f, F.FilterRange)
+    assert f.min_value > 100 and f.max_value == 200
+
+
+def test_parse_ipv4_range():
+    q = _parse("ip:ipv4_range(10.0.0.0/8)")
+    f = q.filter
+    assert isinstance(f, F.FilterIPv4Range)
+    assert f.min_value == 10 << 24
+    assert f.max_value == (10 << 24) | 0xFFFFFF
+    q = _parse("ip:ipv4_range(1.2.3.4, 5.6.7.8)")
+    assert q.filter.min_value == (1 << 24) | (2 << 16) | (3 << 8) | 4
+
+
+def test_parse_len_range():
+    q = _parse("_msg:len_range(5, 10)")
+    f = q.filter
+    assert f.min_len == 5 and f.max_len == 10
+
+
+def test_parse_string_range():
+    q = _parse("w:string_range(a, c)")
+    assert isinstance(q.filter, F.FilterStringRange)
+
+
+def test_parse_value_type():
+    q = _parse("x:value_type(uint64)")
+    assert isinstance(q.filter, F.FilterValueType)
+
+
+def test_parse_field_compare():
+    q = _parse("a:eq_field(b)")
+    assert isinstance(q.filter, F.FilterEqField)
+    q = _parse("a:le_field(b)")
+    assert isinstance(q.filter, F.FilterLeField) and not q.filter.strict
+    q = _parse("a:lt_field(b)")
+    assert q.filter.strict
+
+
+def test_parse_time_duration():
+    q = _parse("_time:5m error")
+    f = q.filter
+    assert isinstance(f, F.FilterAnd)
+    tf = f.filters[0]
+    assert isinstance(tf, F.FilterTime)
+    assert tf.max_ts == T0
+    assert tf.min_ts == T0 - 5 * 60 * NS
+    lo, hi = q.get_time_range()
+    assert (lo, hi) == (tf.min_ts, tf.max_ts)
+
+
+def test_parse_time_range_brackets():
+    q = _parse("_time:[2025-07-01, 2025-07-02)")
+    tf = q.filter
+    assert isinstance(tf, F.FilterTime)
+    # [start of July 1, start of July 2)
+    assert (tf.max_ts - tf.min_ts) == 86400 * NS - 1
+
+
+def test_parse_time_day():
+    q = _parse("_time:2025-07-28")
+    tf = q.filter
+    assert tf.min_ts == T0
+    assert tf.max_ts == T0 + 86400 * NS - 1
+
+
+def test_parse_stream_filter():
+    q = _parse('{app="web",env="prod"} error')
+    f = q.filter
+    assert isinstance(f, F.FilterAnd)
+    sf = f.filters[0]
+    assert isinstance(sf, F.FilterStream)
+    assert len(sf.stream_filter.or_groups) == 1
+    assert len(sf.stream_filter.or_groups[0]) == 2
+
+
+def test_parse_stream_filter_or():
+    q = _parse('{app="web" or app="api"}')
+    sf = q.filter
+    assert len(sf.stream_filter.or_groups) == 2
+
+
+def test_parse_stream_id():
+    q = _parse("_stream_id:in(aaa, bbb)")
+    assert isinstance(q.filter, F.FilterStreamID)
+    assert q.filter.stream_ids == ["aaa", "bbb"]
+
+
+def test_parse_star():
+    q = _parse("*")
+    assert isinstance(q.filter, F.FilterNoop)
+
+
+def test_parse_compound_phrase():
+    q = _parse("foo-bar:baz")
+    # foo-bar is a compound field name
+    assert isinstance(q.filter, F.FilterPhrase)
+    assert q.filter.field == "foo-bar"
+    assert q.filter.phrase == "baz"
+
+
+def test_parse_pipes_basic():
+    q = _parse("error | fields _time, _msg | limit 10 | offset 5")
+    assert isinstance(q.pipes[0], PipeFields)
+    assert q.pipes[0].fields == ["_time", "_msg"]
+    assert isinstance(q.pipes[1], PipeLimit) and q.pipes[1].n == 10
+    assert isinstance(q.pipes[2], PipeOffset) and q.pipes[2].n == 5
+
+
+def test_parse_sort():
+    q = _parse("* | sort by (_time desc, level) limit 3")
+    p = q.pipes[0]
+    assert isinstance(p, PipeSort)
+    assert p.by == [("_time", True), ("level", False)]
+    assert p.limit == 3
+
+
+def test_parse_stats():
+    q = _parse("* | stats by (level) count() hits, sum(size) as total")
+    p = q.pipes[0]
+    assert isinstance(p, PipeStats)
+    assert [b.name for b in p.by] == ["level"]
+    assert p.funcs[0].name == "count" and p.funcs[0].out_name == "hits"
+    assert p.funcs[1].name == "sum" and p.funcs[1].out_name == "total"
+
+
+def test_parse_stats_time_bucket():
+    q = _parse("* | stats by (_time:5m) count() hits")
+    p = q.pipes[0]
+    assert p.by[0].name == "_time" and p.by[0].bucket == "5m"
+
+
+def test_parse_where_pipe():
+    q = _parse("* | where level:error")
+    assert isinstance(q.pipes[0], PipeWhere)
+
+
+def test_parse_uniq():
+    q = _parse("* | uniq by (ip) with hits limit 7")
+    p = q.pipes[0]
+    assert isinstance(p, PipeUniq)
+    assert p.by == ["ip"] and p.with_hits and p.limit == 7
+
+
+def test_parse_options():
+    q = _parse("options(concurrency=4) error")
+    assert q.opts.concurrency == 4
+    assert q.get_concurrency() == 4
+
+
+def test_parse_errors():
+    for bad in ["", "and", "foo |", "| fields x", "foo | unknown_pipe",
+                "_time:", "{unclosed", "(foo", 'x:range(1']:
+        with pytest.raises((ParseError, ValueError)):
+            _parse(bad)
+
+
+def test_to_string_roundtrip():
+    cases = [
+        "error",
+        "level:error app",
+        "foo or bar",
+        "!level:debug",
+        "_time:5m error | fields _time, _msg | limit 10",
+        "* | stats by (level) count(*) as hits",
+        '{app="web"} error | sort by (_time desc) limit 5',
+    ]
+    for s in cases:
+        q = _parse(s)
+        q2 = parse_query(q.to_string(), timestamp=T0)
+        assert q2.to_string() == q.to_string(), s

@@ -1,0 +1,345 @@
+"""End-to-end query tests: ingest -> flush -> LogsQL query -> rows.
+
+This mirrors the reference's storage_search_test.go shape: real Storage in a
+temp dir, real files, real queries — no mocks.
+"""
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import (get_field_names,
+                                              get_field_values,
+                                              run_query_collect)
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z UTC
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("qstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(1000):
+        lr.add(TEN, T0 + i * NS, [
+            ("app", f"app{i % 3}"),
+            ("_msg", f"GET /api/item/{i} status={200 + i % 3} in {i % 50}ms"),
+            ("level", ["info", "warn", "error", "debug"][i % 4]),
+            ("status", str(200 + i % 3)),
+            ("dur_ms", str(i % 50)),
+            ("ip", f"10.1.{i % 4}.{i % 200}"),
+        ])
+    # one row in another tenant
+    lr2 = LogRows(stream_fields=["app"])
+    lr2.add(TenantID(7, 0), T0, [("app", "other"), ("_msg", "tenant7 row")])
+    s.must_add_rows(lr)
+    s.must_add_rows(lr2)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+def q(storage, qs, **kw):
+    return run_query_collect(storage, [TEN], qs, timestamp=T0 + 3600 * NS,
+                             **kw)
+
+
+def test_match_all(storage):
+    rows = q(storage, "*")
+    assert len(rows) == 1000
+
+
+def test_word_filter(storage):
+    rows = q(storage, "GET")
+    assert len(rows) == 1000
+    rows = q(storage, "nonexistentword")
+    assert rows == []
+
+
+def test_phrase_filter_field(storage):
+    rows = q(storage, "level:error")
+    assert len(rows) == 250
+    assert all(r["level"] == "error" for r in rows)
+
+
+def test_word_boundary_semantics(storage):
+    # 'status' appears as a word inside _msg ("status=202")
+    assert len(q(storage, "_msg:status")) == 1000
+    # 'statu' is not a full word: no match
+    assert q(storage, "_msg:statu") == []
+    # but prefix matches
+    assert len(q(storage, "_msg:statu*")) == 1000
+
+
+def test_and_or_not(storage):
+    rows = q(storage, "level:error status:201")
+    for r in rows:
+        assert r["level"] == "error" and r["status"] == "201"
+    n_err = len(q(storage, "level:error"))
+    n_err_or_warn = len(q(storage, "level:error or level:warn"))
+    assert n_err_or_warn == 2 * n_err
+    n_not = len(q(storage, "!level:error"))
+    assert n_not == 1000 - n_err
+
+
+def test_exact_filter(storage):
+    assert len(q(storage, "level:=error")) == 250
+    assert q(storage, "level:=err") == []
+    assert len(q(storage, 'level:="err"*')) == 250
+
+
+def test_in_filter(storage):
+    rows = q(storage, "level:in(error, warn)")
+    assert len(rows) == 500
+
+
+def test_range_filter(storage):
+    rows = q(storage, "status:>=201")
+    assert all(int(r["status"]) >= 201 for r in rows)
+    assert len(rows) == len(q(storage, "status:201 or status:202"))
+    rows = q(storage, "dur_ms:range[10, 19]")
+    assert all(10 <= int(r["dur_ms"]) <= 19 for r in rows)
+    assert len(rows) == 200
+
+
+def test_ipv4_range_filter(storage):
+    rows = q(storage, "ip:ipv4_range(10.1.2.0/24)")
+    assert len(rows) == 250
+    assert all(r["ip"].startswith("10.1.2.") for r in rows)
+
+
+def test_regexp_filter(storage):
+    # regexes with backslashes use backquotes (double quotes follow Go
+    # unquoting rules, where \d is an invalid escape)
+    rows = q(storage, r'_msg:~`item/1\d\d `')
+    # items 100-199: 100 rows
+    assert len(rows) == 100
+    rows = q(storage, '_msg:~"GET /api"')
+    assert len(rows) == 1000
+
+
+def test_sequence_filter(storage):
+    rows = q(storage, '_msg:seq("GET", "status")')
+    assert len(rows) == 1000
+    assert q(storage, '_msg:seq("status", "GET")') == []
+
+
+def test_time_filter(storage):
+    rows = q(storage, f"_time:[2025-07-28T00:00:00Z, 2025-07-28T00:00:09Z]")
+    assert len(rows) == 10
+
+
+def test_stream_filter(storage):
+    rows = q(storage, '{app="app1"}')
+    assert len(rows) == 333
+    rows = q(storage, '{app=~"app[12]"}')
+    assert len(rows) == 666
+    rows = q(storage, '{app="nosuch"}')
+    assert rows == []
+
+
+def test_stream_id_filter(storage):
+    rows = q(storage, '{app="app1"} | fields _stream_id | limit 1')
+    sid = rows[0]["_stream_id"]
+    rows2 = q(storage, f"_stream_id:{sid}")
+    assert len(rows2) == 333
+
+
+def test_tenant_isolation(storage):
+    rows = run_query_collect(storage, [TenantID(7, 0)], "*")
+    assert len(rows) == 1
+    assert rows[0]["_msg"] == "tenant7 row"
+
+
+def test_fields_pipe(storage):
+    rows = q(storage, "level:error | fields _time, level")
+    assert len(rows) == 250
+    for r in rows:
+        assert set(r) == {"_time", "level"}
+
+
+def test_limit_offset(storage):
+    rows = q(storage, "* | limit 17")
+    assert len(rows) == 17
+    rows = q(storage, "* | offset 990")
+    assert len(rows) == 10
+
+
+def test_sort_pipe(storage):
+    rows = q(storage, "* | sort by (_time desc) limit 5 | fields _msg")
+    assert len(rows) == 5
+    assert "item/999" in rows[0]["_msg"]
+    rows = q(storage, "* | sort by (status, _time) limit 1")
+    assert rows[0]["status"] == "200"
+
+
+def test_sort_numeric_ordering(storage):
+    rows = q(storage, "* | sort by (dur_ms desc) limit 3 | fields dur_ms")
+    assert [r["dur_ms"] for r in rows] == ["49", "49", "49"]
+
+
+def test_where_pipe(storage):
+    rows = q(storage, "* | where level:error | fields level")
+    assert len(rows) == 250
+
+
+def test_stats_count(storage):
+    rows = q(storage, "* | stats count() as total")
+    assert rows == [{"total": "1000"}]
+
+
+def test_stats_by_level(storage):
+    rows = q(storage, "* | stats by (level) count() hits")
+    assert len(rows) == 4
+    d = {r["level"]: r["hits"] for r in rows}
+    assert d == {"info": "250", "warn": "250", "error": "250",
+                 "debug": "250"}
+
+
+def test_stats_sum_avg(storage):
+    rows = q(storage, "* | stats sum(dur_ms) s, avg(dur_ms) a, "
+                      "min(dur_ms) mn, max(dur_ms) mx")
+    r = rows[0]
+    total = sum(i % 50 for i in range(1000))
+    assert r["s"] == str(total)
+    assert abs(float(r["a"]) - total / 1000) < 1e-9
+    assert r["mn"] == "0" and r["mx"] == "49"
+
+
+def test_stats_count_uniq(storage):
+    rows = q(storage, "* | stats count_uniq(level) u")
+    assert rows == [{"u": "4"}]
+    rows = q(storage, "* | stats count_uniq(app) u")
+    assert rows == [{"u": "3"}]
+
+
+def test_stats_by_stream(storage):
+    rows = q(storage, "* | stats by (app) count() hits")
+    d = {r["app"]: r["hits"] for r in rows}
+    assert d == {"app0": "334", "app1": "333", "app2": "333"}
+
+
+def test_stats_time_bucket(storage):
+    rows = q(storage, "_time:[2025-07-28T00:00:00Z, 2025-07-28T00:01:39Z] "
+                      "| stats by (_time:10s) count() hits")
+    assert len(rows) == 10
+    assert all(r["hits"] == "10" for r in rows)
+
+
+def test_uniq_pipe(storage):
+    rows = q(storage, "* | uniq by (level)")
+    assert sorted(r["level"] for r in rows) == ["debug", "error", "info",
+                                                "warn"]
+    rows = q(storage, "* | uniq by (level) with hits")
+    assert all(r["hits"] == "250" for r in rows)
+
+
+def test_first_last(storage):
+    rows = q(storage, "* | last 1 by (_time) | fields _msg")
+    assert "item/999" in rows[0]["_msg"]
+    rows = q(storage, "* | first 1 by (_time) | fields _msg")
+    assert "item/0 " in rows[0]["_msg"]
+
+
+def test_rename_copy_delete(storage):
+    rows = q(storage, "* | limit 1 | rename level as lvl | fields lvl")
+    assert "lvl" in rows[0]
+    rows = q(storage, "* | limit 1 | copy level as lvl2")
+    assert rows[0]["lvl2"] == rows[0]["level"]
+    rows = q(storage, "* | limit 1 | delete ip, dur_ms")
+    assert "ip" not in rows[0] and "dur_ms" not in rows[0]
+
+
+def test_subquery_in(storage):
+    rows = q(storage, "level:in(level:error | fields level) | fields level")
+    assert len(rows) == 250
+    assert all(r["level"] == "error" for r in rows)
+
+
+def test_field_names(storage):
+    names = get_field_names(storage, [TEN], "*")
+    got = {d["value"] for d in names}
+    assert {"_time", "_stream", "_msg", "level", "status", "app"} <= got
+
+
+def test_field_values(storage):
+    vals = get_field_values(storage, [TEN], "*", "level")
+    d = {v["value"]: v["hits"] for v in vals}
+    assert d["error"] == "250"
+
+
+def test_eq_field(storage):
+    rows = q(storage, "status:eq_field(status)")
+    assert len(rows) == 1000
+    rows = q(storage, "status:eq_field(dur_ms)")
+    for r in rows:
+        assert r["status"] == r["dur_ms"]
+
+
+def test_len_range(storage):
+    rows = q(storage, "level:len_range(4, 4) | uniq by (level)")
+    assert sorted(r["level"] for r in rows) == ["info", "warn"]
+
+
+def test_value_type(storage):
+    # status is constant within each stream's blocks (i%3 == stream index)
+    rows = q(storage, "status:value_type(const) | limit 1")
+    assert len(rows) == 1
+    # level cycles i%4 inside each stream -> dict-encoded
+    rows = q(storage, "level:value_type(dict) | limit 1")
+    assert len(rows) == 1
+    # dur_ms has 50 distinct small ints -> uint8
+    rows = q(storage, "dur_ms:value_type(uint8) | limit 1")
+    assert len(rows) == 1
+
+
+def test_count_shorthand(storage):
+    rows = q(storage, "level:error | count()")
+    assert rows == [{"count(*)": "250"}]
+
+
+def test_uint64_unbounded_range(tmp_path):
+    # >x on a uint64 column must not overflow on the infinite upper bound
+    s = Storage(str(tmp_path / "u64"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows()
+    for i in range(10):
+        lr.add(TEN, T0 + i, [("big", str(10_000_000_000_000 + i))])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    rows = run_query_collect(s, [TEN], "big:>10000000000005 | count()")
+    assert rows == [{"count(*)": "4"}]
+    rows = run_query_collect(s, [TEN], "big:<10000000000002 | count()")
+    assert rows == [{"count(*)": "2"}]
+    s.close()
+
+
+def test_regex_escape_bloom_tokens():
+    from victorialogs_tpu.logsql.filters import regex_literal_tokens
+    # \n is a newline, not the letter n: must not fuse "bar"+"baz"
+    toks = regex_literal_tokens(r"foo bar\nbaz qux")
+    assert "barnbaz" not in toks
+    assert "bar" in toks and "baz" in toks
+
+
+def test_uniq_mixed_schemas(storage):
+    # blocks with different column sets must not break uniq
+    rows = q(storage, "* | uniq limit 5")
+    assert len(rows) == 5
+
+
+def test_time_filter_roundtrip():
+    from victorialogs_tpu.logsql.parser import parse_query
+    for qs in ["_time:5m offset 1h", "_time:[2025-07-01, 2025-07-02)",
+               "_time:(2025-07-01, 2025-07-02]"]:
+        q1 = parse_query(qs, timestamp=T0)
+        q2 = parse_query(q1.to_string(), timestamp=T0)
+        f1, f2 = q1.filter, q2.filter
+        assert (f1.min_ts, f1.max_ts) == (f2.min_ts, f2.max_ts), qs
+
+
+def test_subquery_requires_single_column(storage):
+    with pytest.raises(ValueError):
+        q(storage, "level:in(level:error | fields level, app)")

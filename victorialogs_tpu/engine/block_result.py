@@ -1,0 +1,170 @@
+"""Columnar result batches flowing through the pipe pipeline.
+
+The CPU analogue of the reference blockResult (lib/logstorage/
+block_result.go): a batch of rows with lazily-materialized columns.  Straight
+from storage it wraps a BlockSearch + selected-row indices (columns decode on
+demand and are filtered through the selection); after transforming pipes it
+is a plain dict of equal-length string lists.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .block_search import BlockSearch
+
+NS = 1_000_000_000
+
+
+def format_rfc3339(ts_ns: int) -> str:
+    """Render int64 nanos as RFC3339 with nanosecond precision (UTC)."""
+    from ..storage.values_encoder import format_iso8601
+    return format_iso8601(ts_ns, 9)
+
+
+_RFC3339_CACHE: dict[str, int | None] = {}
+
+
+def parse_rfc3339(s: str) -> int | None:
+    """Parse an RFC3339-ish timestamp into int64 nanos; None if invalid."""
+    if not s:
+        return None
+    got = _RFC3339_CACHE.get(s)
+    if got is not None or s in _RFC3339_CACHE:
+        return got
+    v = _parse_rfc3339_uncached(s)
+    if len(_RFC3339_CACHE) > 4096:
+        _RFC3339_CACHE.clear()
+    _RFC3339_CACHE[s] = v
+    return v
+
+
+def _parse_rfc3339_uncached(s: str) -> int | None:
+    from ..logsql.duration import PARTIAL_RFC3339_RE
+    m = PARTIAL_RFC3339_RE.match(s)
+    if m is None:
+        return None
+    y, mo, d, h, mi, sec, frac, tz = m.groups()
+    from ..storage.values_encoder import _days_from_civil, _days_in_month
+    mo_i = int(mo) if mo else 1
+    d_i = int(d) if d else 1
+    if not (1 <= mo_i <= 12) or not (1 <= d_i <= _days_in_month(int(y), mo_i)):
+        return None
+    h_i = int(h) if h else 0
+    mi_i = int(mi) if mi else 0
+    s_i = int(sec) if sec else 0
+    if h_i > 23 or mi_i > 59 or s_i > 59:
+        return None
+    days = _days_from_civil(int(y), mo_i, d_i)
+    ns = (days * 86400 + h_i * 3600 + mi_i * 60 + s_i) * NS
+    if frac:
+        ns += int(frac) * 10 ** (9 - len(frac))
+    if tz and tz != "Z":
+        sign = 1 if tz[0] == "+" else -1
+        tzh = int(tz[1:3])
+        tzm = int(tz[-2:])
+        ns -= sign * (tzh * 3600 + tzm * 60) * NS
+    return ns
+
+
+class BlockResult:
+    """A batch of result rows with lazily-materialized string columns."""
+
+    def __init__(self, nrows: int):
+        self.nrows = nrows
+        self._cols: dict[str, list[str]] = {}
+        self._bs: BlockSearch | None = None
+        self._sel: np.ndarray | None = None   # selected row indices into bs
+        self.timestamps: list[int] | None = None
+
+    # ---- constructors ----
+    @staticmethod
+    def from_block_search(bs: BlockSearch, bm: np.ndarray) -> "BlockResult":
+        sel = np.nonzero(bm)[0]
+        br = BlockResult(int(sel.shape[0]))
+        br._bs = bs
+        br._sel = sel
+        br.timestamps = bs.timestamps()[sel].tolist()
+        return br
+
+    @staticmethod
+    def from_columns(cols: dict[str, list[str]],
+                     timestamps: list[int] | None = None) -> "BlockResult":
+        n = len(next(iter(cols.values()))) if cols else 0
+        br = BlockResult(n)
+        br._cols = dict(cols)
+        br.timestamps = timestamps
+        return br
+
+    # ---- access ----
+    def column(self, name: str) -> list[str]:
+        vals = self._cols.get(name)
+        if vals is not None:
+            return vals
+        if self._bs is not None and (name in ("_time", "_stream",
+                                              "_stream_id")
+                                     or self._bs.has_column(name)):
+            full = self._bs.values(name)
+            vals = [full[i] for i in self._sel.tolist()]
+        else:
+            vals = [""] * self.nrows
+        self._cols[name] = vals
+        return vals
+
+    def has_column(self, name: str) -> bool:
+        if name in self._cols:
+            return True
+        return self._bs is not None and self._bs.has_column(name)
+
+    def column_names(self) -> list[str]:
+        names: dict[str, None] = {}
+        if self._bs is not None:
+            names["_time"] = None
+            names["_stream"] = None
+            names["_stream_id"] = None
+            for n in self._bs.column_names():
+                names[n] = None
+        for n in self._cols:
+            names[n] = None
+        return list(names)
+
+    def materialize(self, fields: list[str] | None = None) -> "BlockResult":
+        """Detach from the underlying block (copy out the needed columns)."""
+        names = fields if fields is not None else self.column_names()
+        cols = {n: self.column(n) for n in names}
+        return BlockResult.from_columns(cols, self.timestamps)
+
+    def filter_rows(self, mask: np.ndarray) -> "BlockResult":
+        keep = np.nonzero(mask)[0]
+        br = BlockResult(int(keep.shape[0]))
+        if self._bs is not None and not self._cols:
+            br._bs = self._bs
+            br._sel = self._sel[keep]
+        else:
+            kl = keep.tolist()
+            for n, vals in self._cols.items():
+                br._cols[n] = [vals[i] for i in kl]
+            if self._bs is not None:
+                br._bs = self._bs
+                br._sel = self._sel[keep]
+        if self.timestamps is not None:
+            br.timestamps = [self.timestamps[i] for i in keep.tolist()]
+        return br
+
+    def take_rows(self, idxs: list[int]) -> "BlockResult":
+        br = BlockResult(len(idxs))
+        for n in self.column_names():
+            vals = self.column(n)
+            br._cols[n] = [vals[i] for i in idxs]
+        if self.timestamps is not None:
+            br.timestamps = [self.timestamps[i] for i in idxs]
+        return br
+
+    def rows(self, fields: list[str] | None = None) -> list[dict]:
+        """Materialize as row dicts (empty values omitted, like the API)."""
+        names = fields if fields is not None else self.column_names()
+        cols = [(n, self.column(n)) for n in names]
+        out = []
+        for i in range(self.nrows):
+            out.append({n: vals[i] for n, vals in cols if vals[i] != ""})
+        return out

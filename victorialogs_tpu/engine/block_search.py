@@ -1,0 +1,136 @@
+"""Per-block scan state: lazy column access + result bitmap.
+
+The CPU analogue of the reference's blockSearch (lib/logstorage/
+block_search.go:207-226): wraps one (part, block) pair, caches lazily-read
+timestamps / columns / blooms, and lets the filter tree AND itself into a
+numpy bool bitmap.  This object is also the staging source for the TPU
+runner — device tensors are built from the same cached columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage.values_encoder import (EncodedColumn, VT_CONST, VT_DICT,
+                                      VT_NAMES, VT_STRING, decode_values)
+
+
+class BlockSearch:
+    def __init__(self, part, block_idx: int):
+        self.part = part
+        self.block_idx = block_idx
+        self.nrows = part.block_rows(block_idx)
+        self.stream_id = part.block_stream_id(block_idx)
+        self.stream_tags_str = part.block_tags(block_idx)
+        self._timestamps: np.ndarray | None = None
+        self._columns: dict[str, EncodedColumn | None] = {}
+        self._values: dict[str, list[str]] = {}
+        self._consts: dict[str, str] | None = None
+
+    # ---- lazy reads ----
+    def timestamps(self) -> np.ndarray:
+        if self._timestamps is None:
+            self._timestamps = self.part.block_timestamps(self.block_idx)
+        return self._timestamps
+
+    def consts(self) -> dict[str, str]:
+        if self._consts is None:
+            self._consts = dict(self.part.block_consts(self.block_idx))
+        return self._consts
+
+    def column(self, name: str) -> EncodedColumn | None:
+        if name not in self._columns:
+            self._columns[name] = self.part.block_column(self.block_idx, name)
+        return self._columns[name]
+
+    def column_meta(self, name: str) -> dict | None:
+        return self.part.block_column_meta(self.block_idx, name)
+
+    def bloom(self, name: str) -> np.ndarray | None:
+        return self.part.block_column_bloom(self.block_idx, name)
+
+    def column_names(self) -> list[str]:
+        names = list(self.consts().keys())
+        names.extend(self.part.block_col_names(self.block_idx))
+        return names
+
+    def has_column(self, name: str) -> bool:
+        if name in ("_time", "_stream", "_stream_id"):
+            return True
+        return name in self.consts() or \
+            self.part.block_column_meta(self.block_idx, name) is not None
+
+    def value_type_name(self, name: str) -> str:
+        """Column type name for value_type() filtering."""
+        if name in self.consts():
+            return "const"
+        meta = self.column_meta(name)
+        if meta is None:
+            return ""
+        return VT_NAMES[meta["t"]]
+
+    def values(self, name: str) -> list[str]:
+        """Decoded string values for a column (virtual columns included)."""
+        vals = self._values.get(name)
+        if vals is not None:
+            return vals
+        if name == "_time":
+            from .block_result import format_rfc3339
+            vals = [format_rfc3339(t) for t in self.timestamps().tolist()]
+        elif name == "_stream":
+            vals = [self.stream_tags_str] * self.nrows
+        elif name == "_stream_id":
+            vals = [self.stream_id.as_string()] * self.nrows
+        else:
+            c = self.consts().get(name)
+            if c is not None:
+                vals = [c] * self.nrows
+            else:
+                col = self.column(name)
+                if col is None:
+                    vals = [""] * self.nrows
+                else:
+                    vals = col.to_strings(self.nrows)
+        self._values[name] = vals
+        return vals
+
+
+def new_bitmap(nrows: int) -> np.ndarray:
+    return np.ones(nrows, dtype=bool)
+
+
+def visit_values(bs: BlockSearch, name: str, bm: np.ndarray, pred) -> None:
+    """AND pred(value) into bm, evaluated only on currently-set bits.
+
+    Mirrors the reference visitValues pattern (filter_phrase.go:291-299):
+    dict columns evaluate the predicate once per dict entry, const/missing
+    columns once total.
+    """
+    if not bm.any():
+        return
+    if name in ("_time", "_stream", "_stream_id"):
+        vals = bs.values(name)
+        _apply_pred(vals, bm, pred)
+        return
+    c = bs.consts().get(name)
+    if c is not None:
+        if not pred(c):
+            bm[:] = False
+        return
+    col = bs.column(name)
+    if col is None:
+        if not pred(""):
+            bm[:] = False
+        return
+    if col.vtype == VT_DICT:
+        lut = np.fromiter((pred(v) for v in col.dict_values), dtype=bool,
+                          count=len(col.dict_values))
+        bm &= lut[col.ids]
+        return
+    _apply_pred(col.to_strings(bs.nrows), bm, pred)
+
+
+def _apply_pred(vals: list[str], bm: np.ndarray, pred) -> None:
+    for i in np.nonzero(bm)[0]:
+        if not pred(vals[i]):
+            bm[i] = False

@@ -1,0 +1,230 @@
+"""Query execution: partition/part/block scheduling + pipe chain driving.
+
+The CPU analogue of the reference's storage_search.go: RunQuery materializes
+subqueries, extracts the global time range from the filter tree, resolves
+`{stream}` filters against each partition's index, schedules surviving blocks
+through the filter tree, and feeds resulting batches through the pipe
+processor chain with per-pipe cancellation (storage_search.go:102-185,
+1035-1121).
+
+The per-block scan dispatches to the TPU runner when enabled (tpu/runner.py);
+this module stays the correctness oracle and the fallback path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..logsql.filters import (Filter, FilterAnd, FilterIn, FilterContainsAll,
+                              FilterContainsAny, FilterNone, FilterNoop,
+                              FilterNot, FilterOr, FilterStream, FilterTime)
+from ..logsql.parser import MAX_TS, MIN_TS, Query, parse_query
+from ..logsql.pipes import Processor, SinkProcessor
+from ..storage.log_rows import TenantID
+from .block_result import BlockResult
+from .block_search import BlockSearch, new_bitmap
+
+
+@dataclass
+class SearchContext:
+    partition: object
+    tenants: tuple
+
+
+class QueryCancelled(Exception):
+    pass
+
+
+def build_processor_chain(pipes: list, write_fn) -> Processor:
+    pp: Processor = SinkProcessor(write_fn)
+    for pipe in reversed(pipes):
+        pp = pipe.make_processor(pp)
+    return pp
+
+
+def _iter_subquery_filters(f: Filter):
+    if isinstance(f, (FilterIn, FilterContainsAll, FilterContainsAny)):
+        if f.subquery is not None:
+            yield f
+    elif isinstance(f, (FilterAnd, FilterOr)):
+        for sub in f.filters:
+            yield from _iter_subquery_filters(sub)
+    elif isinstance(f, FilterNot):
+        yield from _iter_subquery_filters(f.inner)
+
+
+def _run_single_column_subquery(storage, tenants, sub, runner=None
+                                ) -> list[str]:
+    """Run a subquery that must yield exactly one result column (the
+    reference errors on multi-column in() subqueries too)."""
+    values: list[str] = []
+    col_name: list = [None]
+
+    def sink(br: BlockResult):
+        if br._bs is not None:
+            # raw storage blocks: require an explicit `| fields x` pipe
+            raise ValueError(
+                "in(<subquery>) must narrow its output to one column, "
+                "e.g. `... | fields x`")
+        names = br.column_names()
+        if len(names) != 1:
+            raise ValueError(
+                f"in(<subquery>) must yield exactly one column, got "
+                f"{names!r}")
+        if col_name[0] is None:
+            col_name[0] = names[0]
+        elif col_name[0] != names[0]:
+            raise ValueError(
+                f"in(<subquery>) yielded inconsistent columns "
+                f"{col_name[0]!r} vs {names[0]!r}")
+        values.extend(br.column(names[0]))
+    run_query(storage, tenants, sub, write_block=sink, runner=runner)
+    return values
+
+
+def init_subqueries(storage, tenants, q: Query, runner=None) -> None:
+    """Materialize in(<subquery>)-style filters (reference
+    storage_search.go:530-553)."""
+    from ..logsql.pipes import PipeWhere
+    subfilters = list(_iter_subquery_filters(q.filter))
+    for p in q.pipes:
+        if isinstance(p, PipeWhere):
+            subfilters.extend(_iter_subquery_filters(p.filter))
+    for f in subfilters:
+        f.set_values(_run_single_column_subquery(storage, tenants,
+                                                 f.subquery, runner=runner))
+
+
+def _collect_stream_filters(f: Filter, out: list) -> None:
+    """Stream filters on the top-level AND path (usable for block pruning)."""
+    if isinstance(f, FilterStream):
+        out.append(f)
+    elif isinstance(f, FilterAnd):
+        for sub in f.filters:
+            _collect_stream_filters(sub, out)
+
+
+def run_query(storage, tenants, q: Query | str, write_block=None,
+              timestamp: int | None = None, runner=None) -> None:
+    """Execute a LogsQL query; write_block(BlockResult) receives results.
+
+    runner: optional TPU block runner (tpu/runner.py BlockRunner) — when
+    given, block filtering dispatches to the device.
+    """
+    if isinstance(q, str):
+        q = parse_query(q, timestamp)
+    if isinstance(tenants, TenantID):
+        tenants = [tenants]
+    tenants = tuple(tenants)
+
+    init_subqueries(storage, tenants, q, runner=runner)
+    min_ts, max_ts = q.get_time_range()
+
+    head = build_processor_chain(q.pipes, write_block or (lambda br: None))
+
+    sfs: list[FilterStream] = []
+    _collect_stream_filters(q.filter, sfs)
+
+    try:
+        for pt in storage.select_partitions(min_ts, max_ts):
+            ctx = SearchContext(partition=pt, tenants=tenants)
+            allowed_sids = None
+            if sfs:
+                allowed_sids = set.intersection(
+                    *(f.resolve(pt, tenants) for f in sfs))
+                if not allowed_sids:
+                    continue
+            tenant_set = set(tenants)
+            for part in pt.ddb.snapshot_parts():
+                if part.num_rows == 0:
+                    continue
+                if part.min_ts > max_ts or part.max_ts < min_ts:
+                    continue
+                for bi in range(part.num_blocks):
+                    if head.is_done():
+                        raise QueryCancelled()
+                    if part.block_min_ts(bi) > max_ts or \
+                       part.block_max_ts(bi) < min_ts:
+                        continue
+                    sid = part.block_stream_id(bi)
+                    if sid.tenant not in tenant_set:
+                        continue
+                    if allowed_sids is not None and sid not in allowed_sids:
+                        continue
+                    bs = BlockSearch(part, bi)
+                    bs.ctx = ctx
+                    if runner is not None:
+                        bm = runner.apply_filter(q.filter, bs)
+                    else:
+                        bm = new_bitmap(bs.nrows)
+                        q.filter.apply_to_block(bs, bm)
+                    if not bm.any():
+                        continue
+                    head.write_block(BlockResult.from_block_search(bs, bm))
+    except QueryCancelled:
+        pass
+    head.flush()
+
+
+def run_query_collect(storage, tenants, q: Query | str,
+                      timestamp: int | None = None, runner=None) -> list[dict]:
+    """Execute and collect result rows as dicts (test/API convenience)."""
+    rows: list[dict] = []
+
+    def sink(br: BlockResult):
+        rows.extend(br.rows())
+    run_query(storage, tenants, q, write_block=sink, timestamp=timestamp,
+              runner=runner)
+    return rows
+
+
+# ---- field/value introspection (vlselect support) ----
+
+def get_field_names(storage, tenants, q: Query | str,
+                    timestamp: int | None = None) -> list[dict]:
+    """Distinct field names with hit counts (reference GetFieldNames)."""
+    if isinstance(q, str):
+        q = parse_query(q, timestamp)
+    hits: dict[str, int] = {}
+
+    def sink(br: BlockResult):
+        for n in br.column_names():
+            cnt = sum(1 for v in br.column(n) if v != "")
+            if n in ("_time", "_stream", "_stream_id"):
+                cnt = br.nrows
+            if cnt:
+                hits[n] = hits.get(n, 0) + cnt
+    run_query(storage, tenants, q, write_block=sink, timestamp=timestamp)
+    return [{"value": k, "hits": str(hits[k])} for k in sorted(hits)]
+
+
+def get_field_values(storage, tenants, q: Query | str, field: str,
+                     limit: int = 0, timestamp: int | None = None
+                     ) -> list[dict]:
+    """Distinct values of a field with hit counts (reference GetFieldValues)."""
+    if isinstance(q, str):
+        q = parse_query(q, timestamp)
+    hits: dict[str, int] = {}
+
+    def sink(br: BlockResult):
+        for v in br.column(field):
+            if v != "":
+                hits[v] = hits.get(v, 0) + 1
+    run_query(storage, tenants, q, write_block=sink, timestamp=timestamp)
+    out = [{"value": k, "hits": str(hits[k])} for k in sorted(hits)]
+    if limit and len(out) > limit:
+        out = out[:limit]
+    return out
+
+
+def get_streams(storage, tenants, q: Query | str, limit: int = 0,
+                timestamp: int | None = None) -> list[dict]:
+    return get_field_values(storage, tenants, q, "_stream", limit, timestamp)
+
+
+def get_stream_ids(storage, tenants, q: Query | str, limit: int = 0,
+                   timestamp: int | None = None) -> list[dict]:
+    return get_field_values(storage, tenants, q, "_stream_id", limit,
+                            timestamp)

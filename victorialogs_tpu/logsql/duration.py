@@ -1,0 +1,81 @@
+"""Duration and timestamp-bound parsing for LogsQL time filters."""
+
+from __future__ import annotations
+
+import re
+
+NS = 1_000_000_000
+
+# shared partial-RFC3339 shape: year down to optional nanos + optional tz.
+# Used both for parsing (engine.block_result) and for bound widening here —
+# one pattern so the two can never disagree on what's a valid timestamp.
+PARTIAL_RFC3339_RE = re.compile(
+    r"^(\d{4})(?:-(\d{2})(?:-(\d{2})(?:[T ](\d{2})(?::(\d{2})"
+    r"(?::(\d{2})(?:\.(\d{1,9}))?)?)?)?)?)?"
+    r"(Z|[+-]\d{2}:?\d{2})?$")
+
+_DUR_UNITS = {
+    "ns": 1, "us": 1_000, "µs": 1_000, "ms": 1_000_000,
+    "s": NS, "m": 60 * NS, "h": 3600 * NS, "d": 86400 * NS,
+    "w": 7 * 86400 * NS, "y": 365 * 86400 * NS,
+}
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h|d|w|y)")
+
+
+def parse_duration(s: str) -> int | None:
+    """Parse `1h30m`-style durations into ns; None if not a duration."""
+    if not s:
+        return None
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    pos = 0
+    total = 0.0
+    for m in _DUR_RE.finditer(s):
+        if m.start() != pos:
+            return None
+        total += float(m.group(1)) * _DUR_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s) or pos == 0:
+        return None
+    return int(-total if neg else total)
+
+
+def is_duration_like(s: str) -> bool:
+    return parse_duration(s) is not None
+
+
+def ts_bounds(s: str) -> tuple[int, int] | None:
+    """Bounds [start, end] (inclusive ns) of a possibly-partial timestamp.
+
+    `2024` covers the year, `2024-01-02` the day, a full RFC3339 stamp covers
+    exactly one ns.  Mirrors how the reference widens partial timestamps in
+    _time filters (parser.go parseFilterTime).
+    """
+    from ..engine.block_result import parse_rfc3339
+    from ..storage.values_encoder import _days_in_month
+    m = PARTIAL_RFC3339_RE.match(s)
+    if m is None:
+        return None
+    start = parse_rfc3339(s)
+    if start is None:
+        return None
+    y, mo, d, h, mi, sec, frac, _tz = m.groups()
+    if frac is not None:
+        span = 10 ** (9 - len(frac))
+    elif sec is not None:
+        span = NS
+    elif mi is not None:
+        span = 60 * NS
+    elif h is not None:
+        span = 3600 * NS
+    elif d is not None:
+        span = 86400 * NS
+    elif mo is not None:
+        span = _days_in_month(int(y), int(mo)) * 86400 * NS
+    else:
+        yy = int(y)
+        leap = yy % 4 == 0 and (yy % 100 != 0 or yy % 400 == 0)
+        span = (366 if leap else 365) * 86400 * NS
+    return start, start + span - 1

@@ -1,0 +1,884 @@
+"""LogsQL filter tree: AST nodes + CPU block evaluation.
+
+The 26 filter kinds of the reference (lib/logstorage/filter_*.go; interface
+filter.go:8-20).  Each node implements:
+
+  apply_to_block(bs, bm)  — AND itself into a numpy bool bitmap over one
+                            storage block (reference applyToBlockSearch)
+  apply_to_values(vals_fn, n) -> mask — re-filtering over in-pipeline rows
+                            (reference applyToBlockResult), used by `filter` pipe
+  needed_fields()         — referenced field names for column pushdown
+  to_string()             — canonical LogsQL rendering
+
+Bloom-assisted pruning: phrase/prefix/exact/sequence/contains filters probe
+the per-column token bloom before touching values (reference
+matchBloomFilterAllTokens — filter_phrase.go:302) — on TPU this same probe is
+the cheap block kill-path.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..storage.bloom import bloom_contains_all
+from ..storage.values_encoder import (VT_FLOAT64, VT_INT64, VT_IPV4,
+                                      VT_TIMESTAMP_ISO8601, VT_UINT8,
+                                      VT_UINT16, VT_UINT32, VT_UINT64,
+                                      VT_NAMES, VT_STRING, VT_DICT)
+from ..utils.hashing import hash_tokens
+from ..utils.tokenizer import tokenize_string
+from ..engine.block_search import BlockSearch, visit_values
+from .matchers import (match_any_case_phrase, match_any_case_prefix,
+                       match_exact_prefix, match_ipv4_range, match_len_range,
+                       match_phrase, match_prefix, match_range, match_sequence,
+                       match_string_range, parse_ipv4, parse_number)
+
+_NUMERIC_VTS = (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64, VT_INT64,
+                VT_FLOAT64)
+
+
+def quote_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _q(field: str) -> str:
+    return f"{field}:" if field else ""
+
+
+class Filter:
+    def apply_to_block(self, bs: BlockSearch, bm: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def apply_to_values(self, get_values, nrows: int) -> np.ndarray:
+        """Evaluate over arbitrary row values: get_values(field)->list[str]."""
+        raise NotImplementedError
+
+    def needed_fields(self) -> set:
+        return set()
+
+    def to_string(self) -> str:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.to_string()}>"
+
+
+def _bloom_prunes(bs: BlockSearch, fld: str, tokens: list[str]) -> bool:
+    """True if the column bloom proves no row can match (all tokens needed)."""
+    if not tokens:
+        return False
+    words = bs.bloom(fld)
+    if words is None or words.shape[0] == 0:
+        return False
+    return not bloom_contains_all(words, hash_tokens(tokens))
+
+
+def canonical_field(field: str) -> str:
+    """Empty field name targets the message column (reference
+    getCanonicalColumnName — a bare `foo` searches `_msg`)."""
+    return field or "_msg"
+
+
+class _ValuePredFilter(Filter):
+    """Base for single-field filters evaluated as a per-value predicate."""
+
+    field: str
+
+    def _pred(self, v: str) -> bool:
+        raise NotImplementedError
+
+    def _tokens(self) -> list[str]:
+        return []
+
+    def apply_to_block(self, bs: BlockSearch, bm: np.ndarray) -> None:
+        fld = canonical_field(self.field)
+        if _bloom_prunes(bs, fld, self._tokens()):
+            bm[:] = False
+            return
+        visit_values(bs, fld, bm, self._pred)
+
+    def apply_to_values(self, get_values, nrows: int) -> np.ndarray:
+        vals = get_values(canonical_field(self.field))
+        return np.fromiter((self._pred(v) for v in vals), dtype=bool,
+                           count=nrows)
+
+    def needed_fields(self) -> set:
+        return {canonical_field(self.field)}
+
+
+# ---------------- composite filters ----------------
+
+@dataclass(repr=False)
+class FilterAnd(Filter):
+    filters: list
+
+    def apply_to_block(self, bs, bm):
+        for f in self.filters:
+            if not bm.any():
+                return
+            f.apply_to_block(bs, bm)
+
+    def apply_to_values(self, get_values, nrows):
+        mask = np.ones(nrows, dtype=bool)
+        for f in self.filters:
+            mask &= f.apply_to_values(get_values, nrows)
+        return mask
+
+    def needed_fields(self):
+        out = set()
+        for f in self.filters:
+            out |= f.needed_fields()
+        return out
+
+    def to_string(self):
+        parts = []
+        for f in self.filters:
+            s = f.to_string()
+            if isinstance(f, FilterOr):
+                s = f"({s})"
+            parts.append(s)
+        return " ".join(parts)
+
+
+@dataclass(repr=False)
+class FilterOr(Filter):
+    filters: list
+
+    def apply_to_block(self, bs, bm):
+        acc = np.zeros(bs.nrows, dtype=bool)
+        for f in self.filters:
+            sub = bm.copy()
+            f.apply_to_block(bs, sub)
+            acc |= sub
+            if acc.all():
+                break
+        bm &= acc
+
+    def apply_to_values(self, get_values, nrows):
+        mask = np.zeros(nrows, dtype=bool)
+        for f in self.filters:
+            mask |= f.apply_to_values(get_values, nrows)
+        return mask
+
+    def needed_fields(self):
+        out = set()
+        for f in self.filters:
+            out |= f.needed_fields()
+        return out
+
+    def to_string(self):
+        return " or ".join(
+            f"({f.to_string()})" if isinstance(f, FilterOr) else f.to_string()
+            for f in self.filters)
+
+
+@dataclass(repr=False)
+class FilterNot(Filter):
+    inner: Filter
+
+    def apply_to_block(self, bs, bm):
+        sub = new_full_bitmap(bs.nrows)
+        self.inner.apply_to_block(bs, sub)
+        bm &= ~sub
+
+    def apply_to_values(self, get_values, nrows):
+        return ~self.inner.apply_to_values(get_values, nrows)
+
+    def needed_fields(self):
+        return self.inner.needed_fields()
+
+    def to_string(self):
+        s = self.inner.to_string()
+        if isinstance(self.inner, (FilterAnd, FilterOr)):
+            s = f"({s})"
+        return f"!{s}"
+
+
+def new_full_bitmap(n: int) -> np.ndarray:
+    return np.ones(n, dtype=bool)
+
+
+@dataclass(repr=False)
+class FilterNoop(Filter):
+    """Matches everything: `*`."""
+
+    def apply_to_block(self, bs, bm):
+        pass
+
+    def apply_to_values(self, get_values, nrows):
+        return np.ones(nrows, dtype=bool)
+
+    def to_string(self):
+        return "*"
+
+
+@dataclass(repr=False)
+class FilterNone(Filter):
+    """Matches nothing (used for pruned subtrees)."""
+
+    def apply_to_block(self, bs, bm):
+        bm[:] = False
+
+    def apply_to_values(self, get_values, nrows):
+        return np.zeros(nrows, dtype=bool)
+
+    def to_string(self):
+        return "_none_"
+
+
+# ---------------- word / phrase family ----------------
+
+@dataclass(repr=False)
+class FilterPhrase(_ValuePredFilter):
+    field: str
+    phrase: str
+
+    def _pred(self, v):
+        return match_phrase(v, self.phrase)
+
+    def _tokens(self):
+        return tokenize_string(self.phrase)
+
+    def to_string(self):
+        return f"{_q(self.field)}{quote_str(self.phrase)}"
+
+
+@dataclass(repr=False)
+class FilterPrefix(_ValuePredFilter):
+    field: str
+    prefix: str
+
+    def _pred(self, v):
+        return match_prefix(v, self.prefix)
+
+    def _tokens(self):
+        # trailing partial token can't be bloom-probed
+        # (reference getTokensSkipLast — filter_prefix.go:354)
+        toks = tokenize_string(self.prefix)
+        if toks and self.prefix and (self.prefix[-1].isalnum()
+                                     or self.prefix[-1] == "_"
+                                     or not self.prefix[-1].isascii()):
+            toks = toks[:-1]
+        return toks
+
+    def to_string(self):
+        return f"{_q(self.field)}{quote_str(self.prefix)}*"
+
+
+@dataclass(repr=False)
+class FilterExact(_ValuePredFilter):
+    field: str
+    value: str
+
+    def _pred(self, v):
+        return v == self.value
+
+    def _tokens(self):
+        return tokenize_string(self.value)
+
+    def apply_to_block(self, bs, bm):
+        # numeric fast path: exact match on typed columns via vectorized ==
+        meta = bs.column_meta(canonical_field(self.field))
+        if meta is not None and meta["t"] in _NUMERIC_VTS:
+            v = parse_number(self.value)
+            if math.isnan(v) or not (meta["min"] <= v <= meta["max"]):
+                # value can't be present (non-numeric or out of range)
+                if not math.isnan(v):
+                    bm[:] = False
+                    return
+        super().apply_to_block(bs, bm)
+
+    def to_string(self):
+        return f"{_q(self.field)}={quote_str(self.value)}"
+
+
+@dataclass(repr=False)
+class FilterExactPrefix(_ValuePredFilter):
+    field: str
+    prefix: str
+
+    def _pred(self, v):
+        return match_exact_prefix(v, self.prefix)
+
+    def _tokens(self):
+        toks = tokenize_string(self.prefix)
+        return toks[:-1] if toks else []
+
+    def to_string(self):
+        return f"{_q(self.field)}={quote_str(self.prefix)}*"
+
+
+@dataclass(repr=False)
+class FilterAnyCasePhrase(_ValuePredFilter):
+    field: str
+    phrase: str
+
+    def __post_init__(self):
+        self._lower = self.phrase.lower()
+
+    def _pred(self, v):
+        return match_any_case_phrase(v, self._lower)
+
+    def to_string(self):
+        return f"{_q(self.field)}i({quote_str(self.phrase)})"
+
+
+@dataclass(repr=False)
+class FilterAnyCasePrefix(_ValuePredFilter):
+    field: str
+    prefix: str
+
+    def __post_init__(self):
+        self._lower = self.prefix.lower()
+
+    def _pred(self, v):
+        return match_any_case_prefix(v, self._lower)
+
+    def to_string(self):
+        return f"{_q(self.field)}i({quote_str(self.prefix)}*)"
+
+
+@dataclass(repr=False)
+class FilterRegexp(_ValuePredFilter):
+    field: str
+    pattern: str
+
+    def __post_init__(self):
+        self._re = re.compile(self.pattern)
+        self._bloom_tokens = regex_literal_tokens(self.pattern)
+
+    def _pred(self, v):
+        return self._re.search(v) is not None
+
+    def _tokens(self):
+        return self._bloom_tokens
+
+    def to_string(self):
+        return f"{_q(self.field)}~{quote_str(self.pattern)}"
+
+
+def regex_literal_tokens(pattern: str) -> list[str]:
+    """Extract word tokens that every matching string must contain.
+
+    The reference derives mandatory literals from the regex parse tree
+    (regexutil GetLiterals — filter_regexp.go:44-51) and skips the first/last
+    token (they may be partial words).  We conservatively extract maximal
+    literal runs outside any metacharacter scope, then drop first/last token
+    of each run boundary the same way.
+    """
+    # bail out on constructs that make literal extraction unsound
+    if re.search(r"\\[wWdDsSbB]|\(\?", pattern):
+        pass  # classes don't invalidate top-level literal concatenation
+    literals = []
+    cur = []
+    i, n = 0, len(pattern)
+    depth_unsafe = 0
+    while i < n:
+        c = pattern[i]
+        if c == "\\":
+            e = pattern[i + 1] if i + 1 < n else ""
+            # control escapes denote real characters, not the escape letter
+            ctrl = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v",
+                    "a": "\a", "0": "\0"}
+            if e in ctrl:
+                if depth_unsafe == 0:
+                    cur.append(ctrl[e])
+                i += 2
+                continue
+            if e and e not in "wWdDsSbBAZxu123456789":
+                if depth_unsafe == 0:
+                    cur.append(e)
+                i += 2
+                continue
+            # class escapes / numeric escapes: unknown chars — break literal
+            cur = _flush_literal(cur, literals, drop_last=True)
+            i += 2
+            continue
+        if c in "|([{" :
+            # alternation/group/class: everything inside is not mandatory
+            if c == "|":
+                return []  # top-level alternation: no mandatory literal
+            cur = _flush_literal(cur, literals, drop_last=True)
+            depth_unsafe += 1
+            i += 1
+            continue
+        if c in ")]}":
+            depth_unsafe = max(0, depth_unsafe - 1)
+            cur = []
+            i += 1
+            continue
+        if c in "*?+":
+            # previous char is optional/repeated: drop it from the literal
+            if cur and depth_unsafe == 0:
+                cur.pop()
+                cur = _flush_literal(cur, literals, drop_last=True)
+            i += 1
+            continue
+        if c in ".^$":
+            cur = _flush_literal(cur, literals, drop_last=True)
+            i += 1
+            continue
+        if depth_unsafe == 0:
+            cur.append(c)
+        i += 1
+    _flush_literal(cur, literals, drop_last=False, final=True)
+    # each literal run: its inner tokens are mandatory; first/last may be
+    # partial words (reference skipFirstLastToken)
+    out = []
+    for lit, drop_last, is_final in literals:
+        toks = tokenize_string(lit)
+        if not toks:
+            continue
+        start = 1 if (lit and (lit[0].isalnum() or lit[0] == "_")) else 0
+        end = len(toks)
+        if drop_last or not is_final:
+            end -= 1
+        else:
+            if lit and (lit[-1].isalnum() or lit[-1] == "_"):
+                end -= 1
+        out.extend(toks[start:end])
+    return out
+
+
+def _flush_literal(cur, literals, drop_last, final=False):
+    if cur:
+        literals.append(("".join(cur), drop_last, final))
+    return []
+
+
+# ---------------- multi-value filters ----------------
+
+@dataclass(repr=False)
+class FilterIn(_ValuePredFilter):
+    field: str
+    values: list
+    subquery: object = None  # parsed Query, materialized by init_subqueries
+
+    def __post_init__(self):
+        self._set = set(self.values)
+
+    def set_values(self, values):
+        self.values = list(values)
+        self._set = set(self.values)
+
+    def _pred(self, v):
+        return v in self._set
+
+    def to_string(self):
+        if self.subquery is not None:
+            return f"{_q(self.field)}in({self.subquery.to_string()})"
+        return f"{_q(self.field)}in({','.join(quote_str(v) for v in self.values)})"
+
+
+@dataclass(repr=False)
+class FilterContainsAll(_ValuePredFilter):
+    field: str
+    values: list
+    subquery: object = None
+
+    def set_values(self, values):
+        self.values = list(values)
+
+    def _pred(self, v):
+        return all(match_phrase(v, p) for p in self.values)
+
+    def _tokens(self):
+        out = []
+        for p in self.values:
+            out.extend(tokenize_string(p))
+        return out
+
+    def to_string(self):
+        return (f"{_q(self.field)}contains_all("
+                f"{','.join(quote_str(v) for v in self.values)})")
+
+
+@dataclass(repr=False)
+class FilterContainsAny(_ValuePredFilter):
+    field: str
+    values: list
+    subquery: object = None
+
+    def set_values(self, values):
+        self.values = list(values)
+
+    def _pred(self, v):
+        return any(match_phrase(v, p) for p in self.values)
+
+    def to_string(self):
+        return (f"{_q(self.field)}contains_any("
+                f"{','.join(quote_str(v) for v in self.values)})")
+
+
+@dataclass(repr=False)
+class FilterSequence(_ValuePredFilter):
+    field: str
+    phrases: list
+
+    def _pred(self, v):
+        return match_sequence(v, self.phrases)
+
+    def _tokens(self):
+        out = []
+        for p in self.phrases:
+            out.extend(tokenize_string(p))
+        return out
+
+    def to_string(self):
+        return (f"{_q(self.field)}seq("
+                f"{','.join(quote_str(v) for v in self.phrases)})")
+
+
+# ---------------- range / numeric filters ----------------
+
+@dataclass(repr=False)
+class FilterRange(_ValuePredFilter):
+    field: str
+    min_value: float
+    max_value: float
+    repr_str: str = ""
+
+    def _pred(self, v):
+        return match_range(v, self.min_value, self.max_value)
+
+    def apply_to_block(self, bs, bm):
+        meta = bs.column_meta(canonical_field(self.field))
+        if meta is not None and meta["t"] in _NUMERIC_VTS:
+            # header-level prune + vectorized numeric compare
+            if meta["max"] < self.min_value or meta["min"] > self.max_value:
+                bm[:] = False
+                return
+            col = bs.column(canonical_field(self.field))
+            nums = col.nums
+            if nums.dtype == np.uint64:
+                # integer-exact bounds: ceil the lower, floor the upper
+                # (guarding inf: >x / <x filters carry infinite bounds)
+                lo = 0 if self.min_value <= 0 else \
+                    2**64 - 1 if math.isinf(self.min_value) else \
+                    min(math.ceil(self.min_value), 2**64 - 1)
+                hi = -1 if self.max_value < 0 else \
+                    2**64 - 1 if math.isinf(self.max_value) else \
+                    min(math.floor(self.max_value), 2**64 - 1)
+                if lo > hi:
+                    bm[:] = False
+                    return
+                mask = (nums >= np.uint64(lo)) & (nums <= np.uint64(hi))
+            else:
+                mask = (nums >= self.min_value) & (nums <= self.max_value)
+            bm &= mask
+            return
+        super().apply_to_block(bs, bm)
+
+    def to_string(self):
+        if self.repr_str:
+            return f"{_q(self.field)}{self.repr_str}"
+        return f"{_q(self.field)}range[{self.min_value},{self.max_value}]"
+
+
+@dataclass(repr=False)
+class FilterStringRange(_ValuePredFilter):
+    field: str
+    min_value: str
+    max_value: str
+    repr_str: str = ""
+
+    def _pred(self, v):
+        return match_string_range(v, self.min_value, self.max_value)
+
+    def to_string(self):
+        if self.repr_str:
+            return f"{_q(self.field)}{self.repr_str}"
+        return (f"{_q(self.field)}string_range({quote_str(self.min_value)},"
+                f"{quote_str(self.max_value)})")
+
+
+@dataclass(repr=False)
+class FilterLenRange(_ValuePredFilter):
+    field: str
+    min_len: int
+    max_len: int
+
+    def _pred(self, v):
+        return match_len_range(v, self.min_len, self.max_len)
+
+    def to_string(self):
+        return f"{_q(self.field)}len_range({self.min_len},{self.max_len})"
+
+
+@dataclass(repr=False)
+class FilterIPv4Range(_ValuePredFilter):
+    field: str
+    min_value: int
+    max_value: int
+
+    def _pred(self, v):
+        return match_ipv4_range(v, self.min_value, self.max_value)
+
+    def apply_to_block(self, bs, bm):
+        meta = bs.column_meta(canonical_field(self.field))
+        if meta is not None and meta["t"] == VT_IPV4:
+            col = bs.column(canonical_field(self.field))
+            nums = col.nums
+            bm &= (nums >= np.uint32(self.min_value)) & \
+                  (nums <= np.uint32(self.max_value))
+            return
+        super().apply_to_block(bs, bm)
+
+    def to_string(self):
+        def ip(v):
+            return f"{(v >> 24) & 255}.{(v >> 16) & 255}." \
+                   f"{(v >> 8) & 255}.{v & 255}"
+        return (f"{_q(self.field)}ipv4_range({ip(self.min_value)},"
+                f"{ip(self.max_value)})")
+
+
+@dataclass(repr=False)
+class FilterValueType(Filter):
+    field: str
+    type_name: str
+
+    def apply_to_block(self, bs, bm):
+        if bs.value_type_name(self.field) != self.type_name:
+            bm[:] = False
+
+    def apply_to_values(self, get_values, nrows):
+        # in-pipeline values have lost their storage type; best effort: all
+        # pass iff requesting 'string'
+        keep = self.type_name == "string"
+        return np.full(nrows, keep, dtype=bool)
+
+    def needed_fields(self):
+        return {self.field}
+
+    def to_string(self):
+        return f"{_q(self.field)}value_type({self.type_name})"
+
+
+# ---------------- cross-field filters ----------------
+
+@dataclass(repr=False)
+class FilterEqField(Filter):
+    field: str
+    other: str
+
+    def apply_to_block(self, bs, bm):
+        a = bs.values(self.field)
+        b = bs.values(self.other)
+        for i in np.nonzero(bm)[0]:
+            if a[i] != b[i]:
+                bm[i] = False
+
+    def apply_to_values(self, get_values, nrows):
+        a = get_values(self.field)
+        b = get_values(self.other)
+        return np.fromiter((x == y for x, y in zip(a, b)), dtype=bool,
+                           count=nrows)
+
+    def needed_fields(self):
+        return {self.field, self.other}
+
+    def to_string(self):
+        return f"{_q(self.field)}eq_field({self.other})"
+
+
+@dataclass(repr=False)
+class FilterLeField(Filter):
+    field: str
+    other: str
+    strict: bool = False  # True => lt_field
+
+    def _cmp(self, x: str, y: str) -> bool:
+        a, b = parse_number(x), parse_number(y)
+        if not (math.isnan(a) or math.isnan(b)):
+            return a < b if self.strict else a <= b
+        return x < y if self.strict else x <= y
+
+    def apply_to_block(self, bs, bm):
+        a = bs.values(self.field)
+        b = bs.values(self.other)
+        for i in np.nonzero(bm)[0]:
+            if not self._cmp(a[i], b[i]):
+                bm[i] = False
+
+    def apply_to_values(self, get_values, nrows):
+        a = get_values(self.field)
+        b = get_values(self.other)
+        return np.fromiter((self._cmp(x, y) for x, y in zip(a, b)),
+                           dtype=bool, count=nrows)
+
+    def needed_fields(self):
+        return {self.field, self.other}
+
+    def to_string(self):
+        fn = "lt_field" if self.strict else "le_field"
+        return f"{_q(self.field)}{fn}({self.other})"
+
+
+# ---------------- time / stream filters ----------------
+
+@dataclass(repr=False)
+class FilterTime(Filter):
+    min_ts: int                      # inclusive, ns
+    max_ts: int                      # inclusive, ns
+    repr_str: str = ""
+
+    def apply_to_block(self, bs, bm):
+        if bs.part.block_min_ts(bs.block_idx) >= self.min_ts and \
+           bs.part.block_max_ts(bs.block_idx) <= self.max_ts:
+            return  # whole block inside the range
+        ts = bs.timestamps()
+        bm &= (ts >= self.min_ts) & (ts <= self.max_ts)
+
+    def apply_to_values(self, get_values, nrows):
+        from ..engine.block_result import parse_rfc3339
+        vals = get_values("_time")
+        out = np.zeros(nrows, dtype=bool)
+        for i, v in enumerate(vals):
+            t = parse_rfc3339(v)
+            out[i] = t is not None and self.min_ts <= t <= self.max_ts
+        return out
+
+    def needed_fields(self):
+        return {"_time"}
+
+    def to_string(self):
+        return f"_time:{self.repr_str}" if self.repr_str else \
+            f"_time:[{self.min_ts},{self.max_ts}]"
+
+
+@dataclass(repr=False)
+class FilterDayRange(Filter):
+    start_offset_ns: int   # offset into the day, inclusive
+    end_offset_ns: int     # inclusive
+    tz_offset_ns: int = 0
+    repr_str: str = ""
+
+    def apply_to_block(self, bs, bm):
+        ts = bs.timestamps() + self.tz_offset_ns
+        day_off = ts % (86400 * 1_000_000_000)
+        bm &= (day_off >= self.start_offset_ns) & \
+              (day_off <= self.end_offset_ns)
+
+    def apply_to_values(self, get_values, nrows):
+        from ..engine.block_result import parse_rfc3339
+        vals = get_values("_time")
+        out = np.zeros(nrows, dtype=bool)
+        for i, v in enumerate(vals):
+            t = parse_rfc3339(v)
+            if t is None:
+                continue
+            off = (t + self.tz_offset_ns) % (86400 * 1_000_000_000)
+            out[i] = self.start_offset_ns <= off <= self.end_offset_ns
+        return out
+
+    def needed_fields(self):
+        return {"_time"}
+
+    def to_string(self):
+        return f"_time:day_range{self.repr_str}"
+
+
+@dataclass(repr=False)
+class FilterWeekRange(Filter):
+    start_day: int   # 0=Sunday .. 6=Saturday, inclusive
+    end_day: int
+    tz_offset_ns: int = 0
+    repr_str: str = ""
+
+    def apply_to_block(self, bs, bm):
+        ts = bs.timestamps() + self.tz_offset_ns
+        # 1970-01-01 was a Thursday (weekday 4 with Sunday=0)
+        days = ts // (86400 * 1_000_000_000)
+        wd = (days + 4) % 7
+        bm &= (wd >= self.start_day) & (wd <= self.end_day)
+
+    def apply_to_values(self, get_values, nrows):
+        from ..engine.block_result import parse_rfc3339
+        vals = get_values("_time")
+        out = np.zeros(nrows, dtype=bool)
+        for i, v in enumerate(vals):
+            t = parse_rfc3339(v)
+            if t is None:
+                continue
+            wd = ((t + self.tz_offset_ns) // (86400 * 1_000_000_000) + 4) % 7
+            out[i] = self.start_day <= wd <= self.end_day
+        return out
+
+    def needed_fields(self):
+        return {"_time"}
+
+    def to_string(self):
+        return f"_time:week_range{self.repr_str}"
+
+
+@dataclass(repr=False)
+class FilterStream(Filter):
+    """`{label="value", ...}` — resolved against the partition stream index."""
+
+    stream_filter: object  # storage.stream_filter.StreamFilter
+
+    def __post_init__(self):
+        # per-partition resolution cache: id(partition) -> set[StreamID]
+        self._resolved: dict = {}
+
+    def resolve(self, partition, tenants) -> set:
+        key = (id(partition), tuple(tenants))
+        got = self._resolved.get(key)
+        if got is None:
+            got = set(partition.idb.search_stream_ids(list(tenants),
+                                                      self.stream_filter))
+            if len(self._resolved) > 64:
+                self._resolved.clear()
+            self._resolved[key] = got
+        return got
+
+    def apply_to_block(self, bs, bm):
+        ctx = getattr(bs, "ctx", None)
+        if ctx is None:
+            return
+        sids = self.resolve(ctx.partition, ctx.tenants)
+        if bs.stream_id not in sids:
+            bm[:] = False
+
+    def apply_to_values(self, get_values, nrows):
+        from ..storage.stream_filter import parse_stream_tags
+        vals = get_values("_stream")
+        out = np.zeros(nrows, dtype=bool)
+        for i, v in enumerate(vals):
+            out[i] = self.stream_filter.matches(parse_stream_tags(v))
+        return out
+
+    def needed_fields(self):
+        return {"_stream"}
+
+    def to_string(self):
+        return self.stream_filter.to_string()
+
+
+@dataclass(repr=False)
+class FilterStreamID(Filter):
+    stream_ids: list  # hex strings
+
+    def __post_init__(self):
+        self._set = set(self.stream_ids)
+
+    def apply_to_block(self, bs, bm):
+        if bs.stream_id.as_string() not in self._set:
+            bm[:] = False
+
+    def apply_to_values(self, get_values, nrows):
+        vals = get_values("_stream_id")
+        return np.fromiter((v in self._set for v in vals), dtype=bool,
+                           count=nrows)
+
+    def needed_fields(self):
+        return {"_stream_id"}
+
+    def to_string(self):
+        if len(self.stream_ids) == 1:
+            return f"_stream_id:{self.stream_ids[0]}"
+        return "_stream_id:in(" + ",".join(self.stream_ids) + ")"

@@ -1,0 +1,165 @@
+"""Scalar value-match semantics for LogsQL filters.
+
+These definitions are the *correctness oracle* shared by the CPU executor and
+the TPU kernels: every kernel must produce bit-identical results to these
+functions.  Semantics mirror the reference:
+
+- match_phrase: substring occurrence with word-boundary checks on both sides
+  (filter_phrase.go:211-268)
+- match_prefix: occurrence with a word boundary before it only
+  (filter_prefix.go:318-352); empty prefix matches any non-empty string
+- match_exact_prefix: plain startswith (filter_exact_prefix.go:275)
+- match_sequence: ordered non-overlapping phrase occurrences
+  (filter_sequence.go:260)
+- word-char definition: ASCII [A-Za-z0-9_] plus all non-ASCII characters
+  (departure: the reference uses unicode letter/digit classes —
+  tokenizer.go:142-148; treating all non-ASCII as word chars keeps the byte-
+  level arena tokenizer, this module and the device kernels exactly agreed)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+
+def is_word_char(c: str) -> bool:
+    return c.isascii() and (c.isalnum() or c == "_") or not c.isascii()
+
+
+def match_phrase(s: str, phrase: str) -> bool:
+    if not phrase:
+        return not s
+    starts_tok = is_word_char(phrase[0])
+    ends_tok = is_word_char(phrase[-1])
+    pos = 0
+    while True:
+        n = s.find(phrase, pos)
+        if n < 0:
+            return False
+        if starts_tok and n > 0 and is_word_char(s[n - 1]):
+            pos = n + 1
+            continue
+        end = n + len(phrase)
+        if ends_tok and end < len(s) and is_word_char(s[end]):
+            pos = n + 1
+            continue
+        return True
+
+
+def match_prefix(s: str, prefix: str) -> bool:
+    if not prefix:
+        return len(s) > 0
+    starts_tok = is_word_char(prefix[0])
+    pos = 0
+    while True:
+        n = s.find(prefix, pos)
+        if n < 0:
+            return False
+        if starts_tok and n > 0 and is_word_char(s[n - 1]):
+            pos = n + 1
+            continue
+        return True
+
+
+def match_exact_prefix(s: str, prefix: str) -> bool:
+    return s.startswith(prefix)
+
+
+def match_any_case_phrase(s: str, phrase_lower: str) -> bool:
+    return match_phrase(s.lower(), phrase_lower)
+
+
+def match_any_case_prefix(s: str, prefix_lower: str) -> bool:
+    return match_prefix(s.lower(), prefix_lower)
+
+
+def match_sequence(s: str, phrases: list[str]) -> bool:
+    for p in phrases:
+        n = s.find(p)
+        if n < 0:
+            return False
+        s = s[n + len(p):]
+    return True
+
+
+def match_string_range(s: str, min_value: str, max_value: str) -> bool:
+    return min_value <= s < max_value
+
+
+def match_len_range(s: str, min_len: int, max_len: int) -> bool:
+    # length is measured in unicode code points (reference measures runes —
+    # filter_len_range.go uses utf8.RuneCountInString)
+    return min_len <= len(s) <= max_len
+
+
+_FLOAT_RE = re.compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?$")
+_SUFFIXES = {
+    "k": 1e3, "m": 1e6, "g": 1e9, "t": 1e12,
+    "ki": 1024.0, "mi": 1024.0 ** 2, "gi": 1024.0 ** 3, "ti": 1024.0 ** 4,
+    "kb": 1e3, "mb": 1e6, "gb": 1e9, "tb": 1e12,
+    "kib": 1024.0, "mib": 1024.0 ** 2, "gib": 1024.0 ** 3, "tib": 1024.0 ** 4,
+    "b": 1.0,
+}
+
+
+def parse_number(s: str) -> float:
+    """Parse a LogsQL number, with size suffixes (10KB, 5MiB) and inf/nan."""
+    if not s:
+        return math.nan
+    t = s.strip().lower().replace("_", "")
+    if t in ("inf", "+inf"):
+        return math.inf
+    if t == "-inf":
+        return -math.inf
+    if t == "nan":
+        return math.nan
+    mult = 1.0
+    for suf in ("kib", "mib", "gib", "tib", "kb", "mb", "gb", "tb",
+                "ki", "mi", "gi", "ti", "k", "m", "g", "t", "b"):
+        if t.endswith(suf):
+            base = t[: -len(suf)]
+            if base and _FLOAT_RE.match(base):
+                t = base
+                mult = _SUFFIXES[suf]
+            break
+    try:
+        return float(t) * mult
+    except ValueError:
+        return math.nan
+
+
+def match_range(s: str, min_value: float, max_value: float) -> bool:
+    v = parse_number(s)
+    if math.isnan(v):
+        return False
+    return min_value <= v <= max_value
+
+
+def parse_ipv4(s: str) -> int | None:
+    parts = s.split(".")
+    if len(parts) != 4:
+        return None
+    v = 0
+    for p in parts:
+        if not p.isdigit() or len(p) > 3:
+            return None
+        n = int(p)
+        if n > 255:
+            return None
+        v = (v << 8) | n
+    return v
+
+
+def match_ipv4_range(s: str, min_value: int, max_value: int) -> bool:
+    v = parse_ipv4(s)
+    return v is not None and min_value <= v <= max_value
+
+
+_VALUE_TYPE_RES = {
+    # maps value_type() names to a string-level check for re-filter use
+}
+
+
+def match_value_type(type_name: str, want: str) -> bool:
+    return type_name == want

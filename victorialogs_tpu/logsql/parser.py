@@ -1,0 +1,864 @@
+"""LogsQL parser: query -> (options, filter tree, pipes).
+
+Grammar and semantics mirror the reference hand-written parser
+(lib/logstorage/parser.go): implicit AND between adjacent filters,
+`or`/`and`/`not`(`!`/`-`) operators, parenthesized groups, `field:filter`
+scoping, compound phrases glued from adjacent unspaced tokens, `{...}` stream
+filters, `_time:` filters, and the trailing `| pipe | pipe ...` chain.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+
+from ..storage.stream_filter import StreamFilter, TagFilter
+from .duration import NS, parse_duration, ts_bounds
+from .filters import (Filter, FilterAnd, FilterAnyCasePhrase,
+                      FilterAnyCasePrefix, FilterContainsAll,
+                      FilterContainsAny, FilterDayRange, FilterEqField,
+                      FilterExact, FilterExactPrefix, FilterIn,
+                      FilterIPv4Range, FilterLeField, FilterLenRange,
+                      FilterNoop, FilterNot, FilterOr, FilterPhrase,
+                      FilterPrefix, FilterRange, FilterRegexp, FilterSequence,
+                      FilterStream, FilterStreamID, FilterStringRange,
+                      FilterTime, FilterValueType, FilterWeekRange)
+from .lexer import Lexer, quote_token_if_needed
+from .matchers import parse_ipv4, parse_number
+
+MAX_TS = (1 << 63) - 1
+MIN_TS = -(1 << 63)
+
+
+class ParseError(ValueError):
+    pass
+
+
+@dataclass
+class QueryOptions:
+    concurrency: int = 0
+    ignore_global_time_filter: bool = False
+
+
+@dataclass
+class Query:
+    filter: Filter
+    pipes: list = dc_field(default_factory=list)
+    opts: QueryOptions = dc_field(default_factory=QueryOptions)
+    timestamp: int | None = None
+
+    def to_string(self) -> str:
+        s = self.filter.to_string()
+        for p in self.pipes:
+            s += f" | {p.to_string()}"
+        return s
+
+    def get_time_range(self) -> tuple[int, int]:
+        """Overall [min_ts, max_ts] from top-level AND-ed time filters."""
+        return _filter_time_range(self.filter)
+
+    def add_time_filter(self, start_ns: int, end_ns: int) -> None:
+        tf = FilterTime(min_ts=start_ns, max_ts=end_ns)
+        f = self.filter
+        if isinstance(f, FilterAnd):
+            f.filters.insert(0, tf)
+        else:
+            self.filter = FilterAnd([tf, f])
+
+    def add_pipe_limit(self, n: int) -> None:
+        from .pipes import PipeLimit
+        self.pipes.append(PipeLimit(n))
+
+    def get_concurrency(self) -> int:
+        if self.opts.concurrency > 0:
+            return self.opts.concurrency
+        import os
+        return min(os.cpu_count() or 1, 16)
+
+    def clone(self, timestamp: int | None = None) -> "Query":
+        q = parse_query(self.to_string(),
+                        timestamp if timestamp is not None
+                        else self.timestamp)
+        return q
+
+    def can_return_last_n_results(self) -> bool:
+        """True when `| sort by (_time) desc | limit N` tail-opt applies."""
+        from .pipes import (PipeFields, PipeLimit, PipeOffset, PipeSort)
+        for p in self.pipes:
+            if not isinstance(p, (PipeSort, PipeLimit, PipeOffset,
+                                  PipeFields)):
+                return False
+        return True
+
+    def can_live_tail(self) -> bool:
+        for p in self.pipes:
+            if not p.can_live_tail():
+                return False
+        return True
+
+    def has_stats_pipe(self) -> bool:
+        from .pipes import PipeStats
+        return any(isinstance(p, PipeStats) for p in self.pipes)
+
+
+def _filter_time_range(f: Filter) -> tuple[int, int]:
+    if isinstance(f, FilterTime):
+        return f.min_ts, f.max_ts
+    if isinstance(f, FilterAnd):
+        lo, hi = MIN_TS, MAX_TS
+        for sub in f.filters:
+            slo, shi = _filter_time_range(sub)
+            lo = max(lo, slo)
+            hi = min(hi, shi)
+        return lo, hi
+    if isinstance(f, FilterOr):
+        lo, hi = MAX_TS, MIN_TS
+        for sub in f.filters:
+            slo, shi = _filter_time_range(sub)
+            lo = min(lo, slo)
+            hi = max(hi, shi)
+        if lo > hi:
+            return MIN_TS, MAX_TS
+        return lo, hi
+    return MIN_TS, MAX_TS
+
+
+def parse_query(s: str, timestamp: int | None = None) -> Query:
+    lex = Lexer(s, timestamp=timestamp)
+    q = _parse_query_internal(lex)
+    if not lex.is_end():
+        raise ParseError(f"unexpected trailing token {lex.token!r} "
+                         f"near ...{lex.context()}")
+    return q
+
+
+def parse_filter_string(s: str) -> Filter:
+    """Parse a standalone filter expression (extra_filters etc.)."""
+    lex = Lexer(s)
+    f = parse_filter_or(lex, "")
+    if not lex.is_end():
+        raise ParseError(f"unexpected trailing token {lex.token!r}")
+    return f
+
+
+def _parse_query_internal(lex: Lexer) -> Query:
+    opts = QueryOptions()
+    if lex.is_keyword("options"):
+        opts = _parse_options(lex)
+    f = parse_filter_or(lex, "")
+    pipes = []
+    from .pipes import parse_pipes
+    if lex.is_keyword("|"):
+        lex.next_token()
+        pipes = parse_pipes(lex)
+    return Query(filter=f, pipes=pipes, opts=opts, timestamp=lex.timestamp)
+
+
+def _parse_options(lex: Lexer) -> QueryOptions:
+    opts = QueryOptions()
+    lex.next_token()
+    if not lex.is_keyword("("):
+        raise ParseError("missing '(' after options")
+    lex.next_token()
+    while not lex.is_keyword(")"):
+        name = lex.token
+        lex.next_token()
+        if not lex.is_keyword("="):
+            raise ParseError(f"missing '=' after option {name!r}")
+        lex.next_token()
+        value = lex.token
+        lex.next_token()
+        if name == "concurrency":
+            opts.concurrency = int(value)
+        elif name == "ignore_global_time_filter":
+            opts.ignore_global_time_filter = value.lower() == "true"
+        else:
+            raise ParseError(f"unknown query option {name!r}")
+        if lex.is_keyword(","):
+            lex.next_token()
+    lex.next_token()
+    return opts
+
+
+# ---------------- filter grammar ----------------
+
+def parse_filter_or(lex: Lexer, field_name: str) -> Filter:
+    filters = [parse_filter_and(lex, field_name)]
+    while True:
+        if lex.is_keyword("or"):
+            lex.next_token()
+            filters.append(parse_filter_and(lex, field_name))
+        else:
+            break
+    if len(filters) == 1:
+        return filters[0]
+    return FilterOr(filters)
+
+
+def parse_filter_and(lex: Lexer, field_name: str) -> Filter:
+    filters = [parse_generic_filter(lex, field_name)]
+    while True:
+        if lex.is_end() or lex.is_keyword("or", "|", ")", "]", ","):
+            break
+        if lex.is_keyword("and"):
+            lex.next_token()
+        filters.append(parse_generic_filter(lex, field_name))
+    if len(filters) == 1:
+        return filters[0]
+    return FilterAnd(filters)
+
+
+def parse_generic_filter(lex: Lexer, field_name: str) -> Filter:
+    if lex.is_keyword("{"):
+        if field_name not in ("", "_stream"):
+            raise ParseError("stream filter can only apply to _stream")
+        return _parse_filter_stream(lex)
+    if lex.is_keyword(":"):
+        lex.next_token()
+        return parse_generic_filter(lex, field_name)
+    if lex.is_keyword("*"):
+        lex.next_token()
+        return FilterPrefix(field_name, "") if field_name else FilterNoop()
+    if lex.is_keyword("("):
+        return _parse_parens(lex, field_name)
+    if lex.is_keyword(">"):
+        return _parse_gt(lex, field_name)
+    if lex.is_keyword("<"):
+        return _parse_lt(lex, field_name)
+    if lex.is_keyword("="):
+        return _parse_eq(lex, field_name)
+    if lex.is_keyword("!="):
+        lex.next_token()
+        return FilterNot(_parse_eq_tail(lex, field_name))
+    if lex.is_keyword("~"):
+        lex.next_token()
+        return _parse_regexp_tail(lex, field_name)
+    if lex.is_keyword("!~"):
+        lex.next_token()
+        return FilterNot(_parse_regexp_tail(lex, field_name))
+    if lex.is_keyword("not", "!", "-"):
+        lex.next_token()
+        return FilterNot(parse_generic_filter(lex, field_name))
+    for kw, fn in _FUNC_FILTERS.items():
+        if lex.is_keyword(kw) and (
+                _peek_is_lparen(lex)
+                or (kw == "range" and lex.pos < len(lex.s)
+                    and lex.s[lex.pos] == "[")):
+            return fn(lex, field_name)
+    if lex.is_keyword(",", ")", "[", "]", "|") or lex.is_end():
+        raise ParseError(f"unexpected token {lex.token!r} "
+                         f"near ...{lex.context()}")
+    if lex.is_keyword("and", "or"):
+        # reserved keywords can't start a phrase (reference reservedKeywords
+        # — parser.go:3101-3115); quote them to search literally
+        raise ParseError(f"reserved keyword {lex.token!r} cannot be used "
+                         f"as a search phrase; quote it to search literally")
+    phrase = _get_compound_phrase(lex, allow_colon=bool(field_name))
+    return _parse_filter_for_phrase(lex, phrase, field_name)
+
+
+def _peek_is_lparen(lex: Lexer) -> bool:
+    # function-style keywords must be followed immediately by '('
+    return lex.pos < len(lex.s) and lex.s[lex.pos] == "("
+
+
+_STOP_TOKENS = ("*", ",", "(", ")", "[", "]", "|", "")
+
+
+def _get_compound_phrase(lex: Lexer, allow_colon: bool) -> str:
+    stop = _STOP_TOKENS if allow_colon else _STOP_TOKENS + (":",)
+    if lex.is_keyword(*stop):
+        raise ParseError(f"compound phrase cannot start with {lex.token!r}")
+    phrase = lex.token
+    raw = lex.raw_token
+    was_quoted = lex.is_quoted
+    lex.next_token()
+    suffix = ""
+    while not lex.is_skipped_space and not lex.is_keyword(*stop) \
+            and not lex.is_end():
+        suffix += lex.raw_token if not lex.is_quoted else lex.token
+        lex.next_token()
+    if not suffix:
+        return phrase
+    if was_quoted:
+        return phrase + suffix
+    return raw + suffix
+
+
+def _get_compound_token(lex: Lexer,
+                        stop=(",", "(", ")", "[", "]", "|", "")) -> str:
+    if lex.is_keyword(*stop):
+        raise ParseError(f"compound token cannot start with {lex.token!r}")
+    s = lex.token
+    raw = lex.raw_token
+    was_quoted = lex.is_quoted
+    lex.next_token()
+    suffix = ""
+    while not lex.is_skipped_space and not lex.is_keyword(*stop) \
+            and not lex.is_end():
+        suffix += lex.raw_token if not lex.is_quoted else lex.token
+        lex.next_token()
+    if not suffix:
+        return s
+    return (s if was_quoted else raw) + suffix
+
+
+def _parse_filter_for_phrase(lex: Lexer, phrase: str,
+                             field_name: str) -> Filter:
+    if field_name or not lex.is_keyword(":"):
+        if lex.is_keyword("*") and not lex.is_skipped_space:
+            lex.next_token()
+            return FilterPrefix(field_name, phrase)
+        return FilterPhrase(field_name, phrase)
+    # phrase is actually a field name
+    field_name = phrase
+    lex.next_token()
+    if field_name == "_time":
+        return _parse_filter_time_generic(lex)
+    if field_name == "_stream_id":
+        return _parse_filter_stream_id(lex)
+    if field_name == "_stream":
+        return parse_generic_filter(lex, field_name)
+    return parse_generic_filter(lex, field_name)
+
+
+def _parse_parens(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    f = parse_filter_or(lex, field_name)
+    if not lex.is_keyword(")"):
+        raise ParseError(f"missing ')' ; got {lex.token!r}")
+    lex.next_token()
+    return f
+
+
+# ---- function-style filters ----
+
+def _parse_func_args(lex: Lexer) -> list[str]:
+    """Parse `(arg, arg, ...)`; each arg is a compound token or quoted str."""
+    if not lex.is_keyword("("):
+        raise ParseError(f"missing '(' ; got {lex.token!r}")
+    lex.next_token()
+    args: list[str] = []
+    while not lex.is_keyword(")"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        if lex.is_keyword("*") :
+            args.append("*")
+            lex.next_token()
+            continue
+        args.append(_get_compound_token(lex))
+    lex.next_token()
+    return args
+
+
+def _try_parse_subquery(lex: Lexer):
+    """Detect `(subquery...)` for in()/contains_*: returns Query or None."""
+    # a subquery starts with '(' and contains a full query; we detect it by
+    # attempting a parse and falling back to plain args on failure
+    save = (lex.pos, lex.token, lex.raw_token, lex.prev_token,
+            lex.is_quoted, lex.is_skipped_space)
+    try:
+        if not lex.is_keyword("("):
+            return None
+        lex.next_token()
+        q = _parse_query_internal(lex)
+        if not lex.is_keyword(")"):
+            raise ParseError("not a subquery")
+        # heuristic: a subquery must contain a pipe with explicit fields
+        # or a star filter is not enough to distinguish: require pipes
+        if not q.pipes:
+            raise ParseError("not a subquery")
+        lex.next_token()
+        return q
+    except (ParseError, ValueError):
+        (lex.pos, lex.token, lex.raw_token, lex.prev_token,
+         lex.is_quoted, lex.is_skipped_space) = save
+        return None
+
+
+def _parse_in(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    sub = _try_parse_subquery(lex)
+    if sub is not None:
+        return FilterIn(field_name, [], subquery=sub)
+    args = _parse_func_args(lex)
+    if args == ["*"]:
+        return FilterNoop()
+    return FilterIn(field_name, args)
+
+
+def _parse_contains_all(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    sub = _try_parse_subquery(lex)
+    if sub is not None:
+        return FilterContainsAll(field_name, [], subquery=sub)
+    return FilterContainsAll(field_name, _parse_func_args(lex))
+
+
+def _parse_contains_any(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    sub = _try_parse_subquery(lex)
+    if sub is not None:
+        return FilterContainsAny(field_name, [], subquery=sub)
+    return FilterContainsAny(field_name, _parse_func_args(lex))
+
+
+def _parse_exact(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args_raw_star(lex)
+    if len(args) == 1 and args[0][1]:
+        return FilterExactPrefix(field_name, args[0][0])
+    if len(args) != 1:
+        raise ParseError("exact() expects one arg")
+    return FilterExact(field_name, args[0][0])
+
+
+def _parse_func_args_raw_star(lex: Lexer) -> list[tuple[str, bool]]:
+    """Args where a trailing `*` marks a prefix: exact(foo*)."""
+    if not lex.is_keyword("("):
+        raise ParseError("missing '('")
+    lex.next_token()
+    args: list[tuple[str, bool]] = []
+    while not lex.is_keyword(")"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        tok = _get_compound_token(lex, stop=("*", ",", "(", ")", "|", ""))
+        star = False
+        if lex.is_keyword("*") and not lex.is_skipped_space:
+            star = True
+            lex.next_token()
+        args.append((tok, star))
+    lex.next_token()
+    return args
+
+
+def _parse_i(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args_raw_star(lex)
+    if len(args) != 1:
+        raise ParseError("i() expects one arg")
+    phrase, star = args[0]
+    if star:
+        return FilterAnyCasePrefix(field_name, phrase)
+    return FilterAnyCasePhrase(field_name, phrase)
+
+
+def _parse_regexp_func(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) != 1:
+        raise ParseError("re() expects one arg")
+    return FilterRegexp(field_name, args[0])
+
+
+def _parse_regexp_tail(lex: Lexer, field_name: str) -> Filter:
+    if lex.is_quoted:
+        pat = lex.token
+        lex.next_token()
+    else:
+        pat = _get_compound_token(lex)
+    return FilterRegexp(field_name, pat)
+
+
+def _parse_eq(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    return _parse_eq_tail(lex, field_name)
+
+
+def _parse_eq_tail(lex: Lexer, field_name: str) -> Filter:
+    if lex.is_keyword("*") :
+        lex.next_token()
+        return FilterExactPrefix(field_name, "")
+    value = _get_compound_token(lex, stop=("*", ",", "(", ")", "[", "]",
+                                           "|", ""))
+    if lex.is_keyword("*") and not lex.is_skipped_space:
+        lex.next_token()
+        return FilterExactPrefix(field_name, value)
+    return FilterExact(field_name, value)
+
+
+def _parse_gt(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    eq = False
+    if lex.is_keyword("=") and not lex.is_skipped_space:
+        eq = True
+        lex.next_token()
+    v = _get_compound_token(lex)
+    fv = parse_number(v)
+    if math.isnan(fv):
+        raise ParseError(f"cannot parse number {v!r} after '>'")
+    op = ">=" if eq else ">"
+    minv = fv if eq else math.nextafter(fv, math.inf)
+    return FilterRange(field_name, minv, math.inf, repr_str=f"{op}{v}")
+
+
+def _parse_lt(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    eq = False
+    if lex.is_keyword("=") and not lex.is_skipped_space:
+        eq = True
+        lex.next_token()
+    v = _get_compound_token(lex)
+    fv = parse_number(v)
+    if math.isnan(fv):
+        raise ParseError(f"cannot parse number {v!r} after '<'")
+    op = "<=" if eq else "<"
+    maxv = fv if eq else math.nextafter(fv, -math.inf)
+    return FilterRange(field_name, -math.inf, maxv, repr_str=f"{op}{v}")
+
+
+def _parse_range(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    if not lex.is_keyword("(", "["):
+        raise ParseError("range must be followed by '(' or '['")
+    inc_lo = lex.is_keyword("[")
+    lex.next_token()
+    lo_s = _get_compound_token(lex)
+    if not lex.is_keyword(","):
+        raise ParseError("missing ',' in range()")
+    lex.next_token()
+    hi_s = _get_compound_token(lex)
+    if not lex.is_keyword(")", "]"):
+        raise ParseError("missing ')' or ']' in range()")
+    inc_hi = lex.is_keyword("]")
+    lex.next_token()
+    lo = parse_number(lo_s)
+    hi = parse_number(hi_s)
+    if math.isnan(lo) or math.isnan(hi):
+        raise ParseError(f"cannot parse range bounds ({lo_s},{hi_s})")
+    rs = f"range{'[' if inc_lo else '('}{lo_s},{hi_s}{']' if inc_hi else ')'}"
+    if not inc_lo:
+        lo = math.nextafter(lo, math.inf)
+    if not inc_hi:
+        hi = math.nextafter(hi, -math.inf)
+    return FilterRange(field_name, lo, hi, repr_str=rs)
+
+
+def _parse_ipv4_range(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) == 1:
+        # CIDR form
+        s = args[0]
+        if "/" in s:
+            base, bits = s.rsplit("/", 1)
+            ip = parse_ipv4(base)
+            if ip is None or not bits.isdigit() or int(bits) > 32:
+                raise ParseError(f"invalid CIDR {s!r}")
+            shift = 32 - int(bits)
+            lo = (ip >> shift) << shift
+            hi = lo | ((1 << shift) - 1)
+        else:
+            ip = parse_ipv4(s)
+            if ip is None:
+                raise ParseError(f"invalid IP {s!r}")
+            lo = hi = ip
+        return FilterIPv4Range(field_name, lo, hi)
+    if len(args) != 2:
+        raise ParseError("ipv4_range() expects 1 or 2 args")
+    lo = parse_ipv4(args[0])
+    hi = parse_ipv4(args[1])
+    if lo is None or hi is None:
+        raise ParseError(f"invalid IPs in ipv4_range{args}")
+    return FilterIPv4Range(field_name, lo, hi)
+
+
+def _parse_len_range(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) != 2:
+        raise ParseError("len_range() expects 2 args")
+
+    def _bound(s, dflt):
+        if s.lower() == "inf":
+            return dflt
+        v = parse_number(s)
+        if math.isnan(v):
+            raise ParseError(f"bad len_range bound {s!r}")
+        return int(v)
+    return FilterLenRange(field_name, _bound(args[0], 0),
+                          _bound(args[1], 1 << 62))
+
+
+def _parse_string_range(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) != 2:
+        raise ParseError("string_range() expects 2 args")
+    return FilterStringRange(field_name, args[0], args[1])
+
+
+def _parse_value_type(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) != 1:
+        raise ParseError("value_type() expects 1 arg")
+    return FilterValueType(field_name, args[0])
+
+
+def _parse_eq_field(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    if len(args) != 1:
+        raise ParseError("eq_field() expects 1 arg")
+    return FilterEqField(field_name, args[0])
+
+
+def _parse_le_field(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    return FilterLeField(field_name, args[0], strict=False)
+
+
+def _parse_lt_field(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    args = _parse_func_args(lex)
+    return FilterLeField(field_name, args[0], strict=True)
+
+
+def _parse_seq(lex: Lexer, field_name: str) -> Filter:
+    lex.next_token()
+    return FilterSequence(field_name, _parse_func_args(lex))
+
+
+_FUNC_FILTERS = {
+    "contains_all": _parse_contains_all,
+    "contains_any": _parse_contains_any,
+    "eq_field": _parse_eq_field,
+    "exact": _parse_exact,
+    "i": _parse_i,
+    "in": _parse_in,
+    "ipv4_range": _parse_ipv4_range,
+    "le_field": _parse_le_field,
+    "len_range": _parse_len_range,
+    "lt_field": _parse_lt_field,
+    "range": _parse_range,
+    "re": _parse_regexp_func,
+    "seq": _parse_seq,
+    "string_range": _parse_string_range,
+    "value_type": _parse_value_type,
+}
+
+
+# ---- _time filters ----
+
+def _now_ns(lex: Lexer) -> int:
+    if lex.timestamp is not None:
+        return lex.timestamp
+    import time
+    return time.time_ns()
+
+
+def _parse_offset_modifier(lex: Lexer) -> int:
+    if lex.is_keyword("offset"):
+        lex.next_token()
+        tok = _get_compound_token(lex)
+        d = parse_duration(tok)
+        if d is None:
+            raise ParseError(f"invalid offset duration {tok!r}")
+        return d
+    return 0
+
+
+def _parse_filter_time_generic(lex: Lexer) -> Filter:
+    if lex.is_keyword("day_range"):
+        return _parse_day_range(lex)
+    if lex.is_keyword("week_range"):
+        return _parse_week_range(lex)
+    f = _parse_filter_time(lex)
+    if lex.is_keyword("offset"):
+        lex.next_token()
+        tok = _get_compound_token(lex)
+        off = parse_duration(tok)
+        if off is None:
+            raise ParseError(f"invalid offset duration {tok!r}")
+        f = FilterTime(f.min_ts - off, f.max_ts - off,
+                       repr_str=f"{f.repr_str} offset {tok}".strip())
+    return f
+
+
+def _parse_filter_time(lex: Lexer) -> FilterTime:
+    if lex.is_keyword("[", "("):
+        inc_lo = lex.is_keyword("[")
+        lex.next_token()
+        lo_s = _get_compound_token(lex)
+        if not lex.is_keyword(","):
+            raise ParseError("missing ',' in _time range")
+        lex.next_token()
+        hi_s = _get_compound_token(lex)
+        if not lex.is_keyword("]", ")"):
+            raise ParseError("missing ']' or ')' in _time range")
+        inc_hi = lex.is_keyword("]")
+        lex.next_token()
+        lo = _time_bound(lex, lo_s, end=False)
+        hi = _time_bound(lex, hi_s, end=True)
+        if not inc_lo:
+            lo += 1
+        if not inc_hi:
+            # exclusive end at the *start* of the named instant
+            hi = _time_bound(lex, hi_s, end=False) - 1
+        rs = f"{'[' if inc_lo else '('}{lo_s},{hi_s}{']' if inc_hi else ')'}"
+        return FilterTime(lo, hi, repr_str=rs)
+    if lex.is_keyword(">"):
+        lex.next_token()
+        eq = False
+        if lex.is_keyword("=") and not lex.is_skipped_space:
+            eq = True
+            lex.next_token()
+        tok = _get_compound_token(lex)
+        t = _time_bound(lex, tok, end=True)
+        if eq:
+            t = _time_bound(lex, tok, end=False)
+        return FilterTime(t if eq else t + 1, MAX_TS, repr_str=f">{tok}")
+    if lex.is_keyword("<"):
+        lex.next_token()
+        eq = False
+        if lex.is_keyword("=") and not lex.is_skipped_space:
+            eq = True
+            lex.next_token()
+        tok = _get_compound_token(lex)
+        t = _time_bound(lex, tok, end=eq)
+        if not eq:
+            t = _time_bound(lex, tok, end=False) - 1
+        return FilterTime(MIN_TS, t, repr_str=f"<{tok}")
+    if lex.is_keyword("="):
+        lex.next_token()
+    tok = _get_compound_token(lex)
+    d = parse_duration(tok)
+    if d is not None:
+        now = _now_ns(lex)
+        return FilterTime(now - abs(d), now, repr_str=tok)
+    tb = ts_bounds(tok)
+    if tb is not None:
+        return FilterTime(tb[0], tb[1], repr_str=tok)
+    raise ParseError(f"cannot parse _time filter value {tok!r}")
+
+
+def _time_bound(lex: Lexer, s: str, end: bool) -> int:
+    if s == "now":
+        return _now_ns(lex)
+    d = parse_duration(s)
+    if d is not None:
+        return _now_ns(lex) + d if d < 0 else _now_ns(lex) - d
+    tb = ts_bounds(s)
+    if tb is None:
+        raise ParseError(f"cannot parse time bound {s!r}")
+    return tb[1] if end else tb[0]
+
+
+def _parse_day_range(lex: Lexer) -> Filter:
+    lex.next_token()
+    if not lex.is_keyword("[", "("):
+        raise ParseError("day_range must be followed by '[' or '('")
+    inc_lo = lex.is_keyword("[")
+    lex.next_token()
+    lo_s = _get_compound_token(lex)
+    if not lex.is_keyword(","):
+        raise ParseError("missing ',' in day_range")
+    lex.next_token()
+    hi_s = _get_compound_token(lex)
+    if not lex.is_keyword("]", ")"):
+        raise ParseError("missing ']' or ')' in day_range")
+    inc_hi = lex.is_keyword("]")
+    lex.next_token()
+    off = _parse_offset_modifier(lex)
+
+    def _day_off(s):
+        parts = s.split(":")
+        if len(parts) != 2 or not parts[0].isdigit() or not parts[1].isdigit():
+            raise ParseError(f"invalid day_range bound {s!r}; want hh:mm")
+        return (int(parts[0]) * 3600 + int(parts[1]) * 60) * NS
+    lo = _day_off(lo_s)
+    hi = _day_off(hi_s)
+    if not inc_lo:
+        lo += 60 * NS
+    if not inc_hi:
+        hi -= 1
+    rs = f"{'[' if inc_lo else '('}{lo_s},{hi_s}{']' if inc_hi else ')'}"
+    return FilterDayRange(lo, hi, tz_offset_ns=-off, repr_str=rs)
+
+
+_WEEKDAYS = {
+    "sun": 0, "sunday": 0, "mon": 1, "monday": 1, "tue": 2, "tuesday": 2,
+    "wed": 3, "wednesday": 3, "thu": 4, "thursday": 4, "fri": 5,
+    "friday": 5, "sat": 6, "saturday": 6,
+}
+
+
+def _parse_week_range(lex: Lexer) -> Filter:
+    lex.next_token()
+    if not lex.is_keyword("[", "("):
+        raise ParseError("week_range must be followed by '[' or '('")
+    inc_lo = lex.is_keyword("[")
+    lex.next_token()
+    lo_s = _get_compound_token(lex)
+    if not lex.is_keyword(","):
+        raise ParseError("missing ',' in week_range")
+    lex.next_token()
+    hi_s = _get_compound_token(lex)
+    if not lex.is_keyword("]", ")"):
+        raise ParseError("missing ']' or ')' in week_range")
+    inc_hi = lex.is_keyword("]")
+    lex.next_token()
+    off = _parse_offset_modifier(lex)
+    try:
+        lo = _WEEKDAYS[lo_s.lower()]
+        hi = _WEEKDAYS[hi_s.lower()]
+    except KeyError:
+        raise ParseError(f"invalid week_range bounds [{lo_s},{hi_s}]")
+    if not inc_lo:
+        lo += 1
+    if not inc_hi:
+        hi -= 1
+    rs = f"{'[' if inc_lo else '('}{lo_s},{hi_s}{']' if inc_hi else ')'}"
+    return FilterWeekRange(lo, hi, tz_offset_ns=-off, repr_str=rs)
+
+
+# ---- _stream / _stream_id ----
+
+def _parse_filter_stream(lex: Lexer) -> Filter:
+    """Parse `{tag op "value" [,...] [or ...]}`."""
+    lex.next_token()
+    or_groups: list[tuple[TagFilter, ...]] = []
+    cur: list[TagFilter] = []
+    while not lex.is_keyword("}"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        if lex.is_keyword("or"):
+            if cur:
+                or_groups.append(tuple(cur))
+                cur = []
+            lex.next_token()
+            continue
+        label = _get_compound_token(lex, stop=("=", "!=", "=~", "!~", "{",
+                                               "}", ",", "(", ")", "|", ""))
+        if lex.is_keyword("=", "!=", "=~", "!~"):
+            op = lex.token
+            lex.next_token()
+        else:
+            raise ParseError(f"missing stream filter op after {label!r}")
+        if lex.is_keyword("in") and not lex.is_quoted:
+            # label in (v1, v2) — only with '=' / '!='
+            raise ParseError("label in(...) inside stream filter "
+                             "not supported yet")
+        value = lex.token
+        lex.next_token()
+        cur.append(TagFilter(label, op, value))
+    lex.next_token()
+    if cur:
+        or_groups.append(tuple(cur))
+    if not or_groups:
+        return FilterNoop()
+    return FilterStream(StreamFilter(tuple(or_groups)))
+
+
+def _parse_filter_stream_id(lex: Lexer) -> Filter:
+    if lex.is_keyword("in") and _peek_is_lparen(lex):
+        lex.next_token()
+        args = _parse_func_args(lex)
+        return FilterStreamID(args)
+    tok = _get_compound_token(lex)
+    return FilterStreamID([tok])

@@ -1,0 +1,890 @@
+"""LogsQL pipes: AST + streaming processors.
+
+Reference contract (lib/logstorage/pipe.go:11-82): each pipe parses itself,
+reports needed/updated fields, and spawns a pipeProcessor that receives
+column-oriented blocks and flushes accumulated state downstream.  Stateless
+pipes stream block-by-block; stateful ones (sort/stats/uniq/top) accumulate
+and emit at flush.  `limit` cancels the upstream scan once satisfied
+(reference runPipes per-pipe cancellation — storage_search.go:147-185).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from functools import cmp_to_key
+
+import numpy as np
+
+from ..engine.block_result import BlockResult
+from .duration import parse_duration
+from .lexer import Lexer, quote_token_if_needed
+from .matchers import parse_number
+from . import stats_funcs as sf
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ---------------- processor plumbing ----------------
+
+class Processor:
+    def __init__(self, next_p):
+        self.next_p = next_p
+
+    def write_block(self, br: BlockResult) -> None:
+        self.next_p.write_block(br)
+
+    def flush(self) -> None:
+        self.next_p.flush()
+
+    def is_done(self) -> bool:
+        return self.next_p.is_done() if self.next_p else False
+
+
+class SinkProcessor(Processor):
+    """Terminal processor: hands blocks to a callback."""
+
+    def __init__(self, write_fn):
+        super().__init__(None)
+        self.write_fn = write_fn
+        self._done = False
+
+    def write_block(self, br):
+        if self.write_fn(br) is False:
+            self._done = True
+
+    def flush(self):
+        pass
+
+    def is_done(self):
+        return self._done
+
+
+class Pipe:
+    name = "?"
+
+    def to_string(self) -> str:
+        raise NotImplementedError
+
+    def can_live_tail(self) -> bool:
+        return False
+
+    def needed_fields(self) -> set:
+        return set()
+
+    def make_processor(self, next_p: Processor) -> Processor:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<pipe {self.to_string()}>"
+
+    def split_to_remote_and_local(self):
+        """(remote_pipe|None, local_pipes) for cluster pushdown
+        (reference pipe.splitToRemoteAndLocal — pipe.go:15-22)."""
+        return None, [self]
+
+
+# ---------------- fields / delete / copy / rename ----------------
+
+@dataclass(repr=False)
+class PipeFields(Pipe):
+    fields: list
+
+    name = "fields"
+
+    def to_string(self):
+        return "fields " + ", ".join(quote_token_if_needed(f)
+                                     for f in self.fields)
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        return set(self.fields)
+
+    def make_processor(self, next_p):
+        fields = self.fields
+
+        class P(Processor):
+            def write_block(self, br):
+                self.next_p.write_block(br.materialize(fields))
+        return P(next_p)
+
+    def split_to_remote_and_local(self):
+        return self, [self]
+
+
+@dataclass(repr=False)
+class PipeDelete(Pipe):
+    fields: list
+
+    name = "delete"
+
+    def to_string(self):
+        return "delete " + ", ".join(quote_token_if_needed(f)
+                                     for f in self.fields)
+
+    def can_live_tail(self):
+        return True
+
+    def make_processor(self, next_p):
+        drop = set(self.fields)
+
+        class P(Processor):
+            def write_block(self, br):
+                names = [n for n in br.column_names() if n not in drop]
+                self.next_p.write_block(br.materialize(names))
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeCopy(Pipe):
+    pairs: list  # [(src, dst)]
+
+    name = "copy"
+
+    def to_string(self):
+        return "copy " + ", ".join(f"{s} as {d}" for s, d in self.pairs)
+
+    def can_live_tail(self):
+        return True
+
+    def make_processor(self, next_p):
+        pairs = self.pairs
+
+        class P(Processor):
+            def write_block(self, br):
+                out = br.materialize()
+                for s, d in pairs:
+                    out._cols[d] = list(br.column(s))
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeRename(Pipe):
+    pairs: list
+
+    name = "rename"
+
+    def to_string(self):
+        return "rename " + ", ".join(f"{s} as {d}" for s, d in self.pairs)
+
+    def can_live_tail(self):
+        return True
+
+    def make_processor(self, next_p):
+        pairs = self.pairs
+
+        class P(Processor):
+            def write_block(self, br):
+                out = br.materialize()
+                for s, d in pairs:
+                    vals = out._cols.pop(s, None)
+                    if vals is None:
+                        vals = br.column(s)
+                    out._cols[d] = vals
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+# ---------------- limit / offset ----------------
+
+@dataclass(repr=False)
+class PipeLimit(Pipe):
+    n: int
+
+    name = "limit"
+
+    def to_string(self):
+        return f"limit {self.n}"
+
+    def make_processor(self, next_p):
+        limit = self.n
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.seen = 0
+
+            def write_block(self, br):
+                if self.seen >= limit:
+                    return
+                take = min(br.nrows, limit - self.seen)
+                self.seen += take
+                if take < br.nrows:
+                    mask = np.zeros(br.nrows, dtype=bool)
+                    mask[:take] = True
+                    br = br.filter_rows(mask)
+                self.next_p.write_block(br)
+
+            def is_done(self):
+                return self.seen >= limit or super().is_done()
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeOffset(Pipe):
+    n: int
+
+    name = "offset"
+
+    def to_string(self):
+        return f"offset {self.n}"
+
+    def make_processor(self, next_p):
+        offset = self.n
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.skipped = 0
+
+            def write_block(self, br):
+                if self.skipped >= offset:
+                    self.next_p.write_block(br)
+                    return
+                skip = min(br.nrows, offset - self.skipped)
+                self.skipped += skip
+                if skip < br.nrows:
+                    mask = np.zeros(br.nrows, dtype=bool)
+                    mask[skip:] = True
+                    self.next_p.write_block(br.filter_rows(mask))
+        return P(next_p)
+
+
+# ---------------- where / filter ----------------
+
+@dataclass(repr=False)
+class PipeWhere(Pipe):
+    filter: object  # logsql.filters.Filter
+
+    name = "filter"
+
+    def to_string(self):
+        return f"filter {self.filter.to_string()}"
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        return self.filter.needed_fields()
+
+    def make_processor(self, next_p):
+        flt = self.filter
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = flt.apply_to_values(br.column, br.nrows)
+                if mask.all():
+                    self.next_p.write_block(br)
+                elif mask.any():
+                    self.next_p.write_block(br.filter_rows(mask))
+        return P(next_p)
+
+
+# ---------------- sort ----------------
+
+def _cmp_values(a: str, b: str) -> int:
+    fa, fb = parse_number(a), parse_number(b)
+    na, nb = not math.isnan(fa), not math.isnan(fb)
+    if na and nb:
+        if fa < fb:
+            return -1
+        if fa > fb:
+            return 1
+        return -1 if a < b else (1 if a > b else 0)
+    if na:
+        return -1
+    if nb:
+        return 1
+    return -1 if a < b else (1 if a > b else 0)
+
+
+@dataclass(repr=False)
+class PipeSort(Pipe):
+    by: list            # [(field, desc)]
+    desc: bool = False  # global desc
+    limit: int = 0
+    offset: int = 0
+    rank_field: str = ""
+
+    name = "sort"
+
+    def to_string(self):
+        s = "sort"
+        if self.by:
+            s += " by (" + ", ".join(
+                f + (" desc" if d else "") for f, d in self.by) + ")"
+        if self.desc:
+            s += " desc"
+        if self.offset:
+            s += f" offset {self.offset}"
+        if self.limit:
+            s += f" limit {self.limit}"
+        if self.rank_field:
+            s += f" rank as {self.rank_field}"
+        return s
+
+    def needed_fields(self):
+        return {f for f, _ in self.by}
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.blocks: list[BlockResult] = []
+
+            def write_block(self, br):
+                self.blocks.append(br.materialize())
+
+            def flush(self):
+                rows = []  # (sort_key_values, block_idx, row_idx)
+                for bi, br in enumerate(self.blocks):
+                    cols = [br.column(f) for f, _ in pipe.by]
+                    for ri in range(br.nrows):
+                        rows.append(([c[ri] for c in cols], bi, ri))
+
+                def cmp(x, y):
+                    # global desc reverses the whole ordering, including
+                    # per-field desc flags (effective desc = field XOR global)
+                    for k, (_f, d) in enumerate(pipe.by):
+                        c = _cmp_values(x[0][k], y[0][k])
+                        if c:
+                            return -c if (d != pipe.desc) else c
+                    return 0
+                rows.sort(key=cmp_to_key(cmp))
+                if pipe.offset:
+                    rows = rows[pipe.offset:]
+                if pipe.limit:
+                    rows = rows[:pipe.limit]
+                # emit in sorted order, with optional rank column
+                rank0 = pipe.offset + 1
+                out_cols: dict[str, list[str]] = {}
+                names: dict[str, None] = {}
+                for br in self.blocks:
+                    for n in br.column_names():
+                        names.setdefault(n, None)
+                for n in names:
+                    col = []
+                    for _k, bi, ri in rows:
+                        col.append(self.blocks[bi].column(n)[ri])
+                    out_cols[n] = col
+                if pipe.rank_field:
+                    out_cols[pipe.rank_field] = [
+                        str(rank0 + i) for i in range(len(rows))]
+                if rows or not self.blocks:
+                    self.next_p.write_block(
+                        BlockResult.from_columns(out_cols)
+                        if out_cols else BlockResult(0))
+                self.blocks = []
+                self.next_p.flush()
+        return P(next_p)
+
+
+# ---------------- uniq ----------------
+
+@dataclass(repr=False)
+class PipeUniq(Pipe):
+    by: list
+    limit: int = 0
+    with_hits: bool = False
+
+    name = "uniq"
+
+    def to_string(self):
+        s = "uniq"
+        if self.by:
+            s += " by (" + ", ".join(self.by) + ")"
+        if self.with_hits:
+            s += " with hits"
+        if self.limit:
+            s += f" limit {self.limit}"
+        return s
+
+    def needed_fields(self):
+        return set(self.by)
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                # keys are (field, value) pair tuples (empty values dropped)
+                # so blocks with different column sets mix safely
+                self.seen: dict[tuple, int] = {}
+
+            def write_block(self, br):
+                fields = pipe.by or br.column_names()
+                cols = [(f, br.column(f)) for f in fields]
+                for ri in range(br.nrows):
+                    key = tuple((f, c[ri]) for f, c in cols if c[ri] != "")
+                    self.seen[key] = self.seen.get(key, 0) + 1
+
+            def flush(self):
+                keys = sorted(self.seen)
+                if pipe.limit:
+                    keys = keys[:pipe.limit]
+                names: dict[str, None] = {f: None for f in pipe.by}
+                for k in keys:
+                    for f, _v in k:
+                        names.setdefault(f, None)
+                cols = {f: [dict(k).get(f, "") for k in keys]
+                        for f in names}
+                if pipe.with_hits:
+                    cols["hits"] = [str(self.seen[k]) for k in keys]
+                self.next_p.write_block(BlockResult.from_columns(cols)
+                                        if keys else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+# ---------------- stats ----------------
+
+@dataclass(repr=False)
+class ByField:
+    name: str
+    bucket: str = ""     # e.g. "5m" or "10" for numeric buckets
+    bucket_offset: str = ""
+
+    def to_string(self):
+        s = self.name
+        if self.bucket:
+            s += f":{self.bucket}"
+        return s
+
+
+@dataclass(repr=False)
+class PipeStats(Pipe):
+    by: list            # list[ByField]
+    funcs: list         # list[StatsFunc]
+
+    name = "stats"
+
+    def to_string(self):
+        s = "stats"
+        if self.by:
+            s += " by (" + ", ".join(b.to_string() for b in self.by) + ")"
+        s += " " + ", ".join(f.to_string() for f in self.funcs)
+        return s
+
+    def needed_fields(self):
+        out = {b.name for b in self.by}
+        for f in self.funcs:
+            out |= f.needed_fields()
+        return out
+
+    def _bucket_value(self, b: ByField, v: str, ts: int | None) -> str:
+        if not b.bucket:
+            return v
+        if b.name == "_time":
+            step = parse_duration(b.bucket)
+            if step and ts is not None:
+                from ..engine.block_result import format_rfc3339
+                return format_rfc3339((ts // step) * step)
+            return v
+        step = parse_number(b.bucket)
+        if not math.isnan(step) and step > 0:
+            f = parse_number(v)
+            if not math.isnan(f):
+                return sf.format_number(math.floor(f / step) * step)
+        return v
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                # group key -> list[state per func]
+                self.groups: dict[tuple, list] = {}
+
+            def write_block(self, br):
+                n = br.nrows
+                if n == 0:
+                    return
+                ts = br.timestamps
+                # group keys per row
+                if pipe.by:
+                    key_cols = []
+                    for b in pipe.by:
+                        vals = br.column(b.name)
+                        if b.bucket:
+                            vals = [pipe._bucket_value(
+                                b, vals[i],
+                                ts[i] if (ts is not None
+                                          and b.name == "_time") else None)
+                                for i in range(n)]
+                        key_cols.append(vals)
+                    rows_by_key: dict[tuple, list] = {}
+                    for i in range(n):
+                        rows_by_key.setdefault(
+                            tuple(c[i] for c in key_cols), []).append(i)
+                else:
+                    rows_by_key = {(): list(range(n))}
+                func_cols = [[br.column(f) for f in fn.fields]
+                             for fn in pipe.funcs]
+                for key, idxs in rows_by_key.items():
+                    states = self.groups.get(key)
+                    if states is None:
+                        states = [fn.new_state() for fn in pipe.funcs]
+                        self.groups[key] = states
+                    for k, fn in enumerate(pipe.funcs):
+                        states[k] = fn.update(states[k], func_cols[k], idxs)
+
+            def flush(self):
+                by_names = [b.name for b in pipe.by]
+                keys = sorted(self.groups)
+                cols: dict[str, list[str]] = {n: [] for n in by_names}
+                for fn in pipe.funcs:
+                    cols[fn.out_name] = []
+                for key in keys:
+                    for n, kv in zip(by_names, key):
+                        cols[n].append(kv)
+                    states = self.groups[key]
+                    for fn, st in zip(pipe.funcs, states):
+                        cols[fn.out_name].append(fn.finalize(st))
+                if not keys and not pipe.by:
+                    # zero rows still yields one all-groups row
+                    for fn in pipe.funcs:
+                        cols[fn.out_name].append(fn.finalize(fn.new_state()))
+                self.next_p.write_block(BlockResult.from_columns(cols)
+                                        if any(cols.values())
+                                        else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+# ---------------- parsing ----------------
+
+def parse_pipes(lex: Lexer) -> list:
+    pipes = []
+    while True:
+        pipes.append(parse_pipe(lex))
+        if lex.is_keyword("|"):
+            lex.next_token()
+            continue
+        break
+    return pipes
+
+
+def parse_pipe(lex: Lexer):
+    name = lex.token.lower()
+    fn = _PIPE_PARSERS.get(name)
+    if fn is None:
+        raise ParseError(f"unknown pipe {lex.token!r}")
+    lex.next_token()
+    return fn(lex)
+
+
+def _parse_field_name(lex: Lexer) -> str:
+    from .parser import _get_compound_token
+    tok = _get_compound_token(lex, stop=(",", "(", ")", "[", "]", "|", "*",
+                                         ""))
+    return tok
+
+
+def _parse_field_list(lex: Lexer) -> list:
+    fields = []
+    while True:
+        fields.append(_parse_field_name(lex))
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        break
+    return fields
+
+
+def _parse_fields(lex: Lexer):
+    return PipeFields(_parse_field_list(lex))
+
+
+def _parse_delete(lex: Lexer):
+    return PipeDelete(_parse_field_list(lex))
+
+
+def _parse_as_pairs(lex: Lexer) -> list:
+    pairs = []
+    while True:
+        src = _parse_field_name(lex)
+        if lex.is_keyword("as"):
+            lex.next_token()
+        dst = _parse_field_name(lex)
+        pairs.append((src, dst))
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        break
+    return pairs
+
+
+def _parse_copy(lex: Lexer):
+    return PipeCopy(_parse_as_pairs(lex))
+
+
+def _parse_rename(lex: Lexer):
+    return PipeRename(_parse_as_pairs(lex))
+
+
+def _parse_uint(lex: Lexer, what: str) -> int:
+    v = parse_number(lex.token)
+    if math.isnan(v) or v < 0 or v != int(v):
+        raise ParseError(f"invalid {what} {lex.token!r}")
+    lex.next_token()
+    return int(v)
+
+
+def _parse_limit(lex: Lexer):
+    return PipeLimit(_parse_uint(lex, "limit"))
+
+
+def _parse_offset(lex: Lexer):
+    return PipeOffset(_parse_uint(lex, "offset"))
+
+
+def _parse_where(lex: Lexer):
+    from .parser import parse_filter_or
+    return PipeWhere(parse_filter_or(lex, ""))
+
+
+def _parse_by_fields(lex: Lexer) -> list:
+    """Parse `by (f1, f2:bucket, ...)` — 'by' already consumed or implied."""
+    out = []
+    if not lex.is_keyword("("):
+        raise ParseError("missing '(' after by")
+    lex.next_token()
+    while not lex.is_keyword(")"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        name = lex.token
+        lex.next_token()
+        bf = ByField(name)
+        if lex.is_keyword(":"):
+            lex.next_token()
+            bf.bucket = lex.token
+            lex.next_token()
+        out.append(bf)
+    lex.next_token()
+    return out
+
+
+def _parse_sort(lex: Lexer):
+    by = []
+    if lex.is_keyword("by"):
+        lex.next_token()
+        if not lex.is_keyword("("):
+            raise ParseError("missing '(' after sort by")
+        lex.next_token()
+        while not lex.is_keyword(")"):
+            if lex.is_keyword(","):
+                lex.next_token()
+                continue
+            f = _parse_field_name(lex)
+            desc = False
+            if lex.is_keyword("desc"):
+                desc = True
+                lex.next_token()
+            elif lex.is_keyword("asc"):
+                lex.next_token()
+            by.append((f, desc))
+        lex.next_token()
+    p = PipeSort(by)
+    while True:
+        if lex.is_keyword("desc"):
+            p.desc = True
+            lex.next_token()
+        elif lex.is_keyword("asc"):
+            lex.next_token()
+        elif lex.is_keyword("limit"):
+            lex.next_token()
+            p.limit = _parse_uint(lex, "limit")
+        elif lex.is_keyword("offset"):
+            lex.next_token()
+            p.offset = _parse_uint(lex, "offset")
+        elif lex.is_keyword("rank"):
+            lex.next_token()
+            if lex.is_keyword("as"):
+                lex.next_token()
+            p.rank_field = _parse_field_name(lex)
+        else:
+            break
+    return p
+
+
+def _parse_uniq(lex: Lexer):
+    by = []
+    if lex.is_keyword("by"):
+        lex.next_token()
+        bfs = _parse_by_fields(lex)
+        by = [b.name for b in bfs]
+    p = PipeUniq(by)
+    while True:
+        if lex.is_keyword("with"):
+            lex.next_token()
+            if lex.is_keyword("hits"):
+                p.with_hits = True
+                lex.next_token()
+        elif lex.is_keyword("limit"):
+            lex.next_token()
+            p.limit = _parse_uint(lex, "limit")
+        else:
+            break
+    return p
+
+
+def _parse_first_last(lex: Lexer, desc: bool):
+    # `first N by (field)` == sort by (field) limit N
+    n = 1
+    if not lex.is_keyword("by") and not lex.is_end() and \
+            not lex.is_keyword("|"):
+        n = _parse_uint(lex, "first/last count")
+    by = []
+    if lex.is_keyword("by"):
+        lex.next_token()
+        if not lex.is_keyword("("):
+            raise ParseError("missing '(' after by")
+        lex.next_token()
+        while not lex.is_keyword(")"):
+            if lex.is_keyword(","):
+                lex.next_token()
+                continue
+            f = _parse_field_name(lex)
+            d = False
+            if lex.is_keyword("desc"):
+                d = True
+                lex.next_token()
+            by.append((f, d))
+        lex.next_token()
+    return PipeSort(by or [("_time", False)], desc=desc, limit=n)
+
+
+def parse_stats_func(lex: Lexer):
+    name = lex.token.lower()
+    ctor = _STATS_FUNCS.get(name)
+    if ctor is None:
+        raise ParseError(f"unknown stats function {lex.token!r}")
+    lex.next_token()
+    if not lex.is_keyword("("):
+        raise ParseError(f"missing '(' after {name}")
+    lex.next_token()
+    args = []
+    while not lex.is_keyword(")"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        if lex.is_keyword("*"):
+            lex.next_token()
+            continue
+        args.append(_parse_field_name(lex))
+    lex.next_token()
+    fn = ctor(args)
+    # optional limit N (count_uniq/uniq_values/values)
+    if lex.is_keyword("limit") and hasattr(fn, "limit"):
+        lex.next_token()
+        fn.limit = _parse_uint(lex, "limit")
+    if lex.is_keyword("as"):
+        lex.next_token()
+        fn.out_name = _parse_field_name(lex)
+    elif not lex.is_end() and not lex.is_keyword(",", "|", ")") \
+            and not lex.is_keyword("by"):
+        fn.out_name = _parse_field_name(lex)
+    return fn
+
+
+def _quantile_ctor(args):
+    if len(args) < 2:
+        raise ParseError("quantile(phi, field) expects 2+ args")
+    phi = parse_number(args[0])
+    if math.isnan(phi) or not 0 <= phi <= 1:
+        raise ParseError(f"invalid quantile phi {args[0]!r}")
+    return sf.StatsQuantile(phi, args[1:])
+
+
+_STATS_FUNCS = {
+    "count": sf.StatsCount,
+    "count_empty": sf.StatsCountEmpty,
+    "count_uniq": sf.StatsCountUniq,
+    "count_uniq_hash": sf.StatsCountUniqHash,
+    "sum": sf.StatsSum,
+    "sum_len": sf.StatsSumLen,
+    "min": sf.StatsMin,
+    "max": sf.StatsMax,
+    "avg": sf.StatsAvg,
+    "uniq_values": sf.StatsUniqValues,
+    "values": sf.StatsValues,
+    "median": sf.StatsMedian,
+    "quantile": _quantile_ctor,
+    "row_any": sf.StatsRowAny,
+}
+
+
+def _parse_stats(lex: Lexer):
+    by = []
+    if lex.is_keyword("by"):
+        lex.next_token()
+        by = _parse_by_fields(lex)
+    funcs = []
+    while True:
+        funcs.append(parse_stats_func(lex))
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        break
+    # alt form: `stats count() by (f)`
+    if lex.is_keyword("by") and not by:
+        lex.next_token()
+        by = _parse_by_fields(lex)
+    if not funcs:
+        raise ParseError("stats needs at least one function")
+    return PipeStats(by, funcs)
+
+
+def _parse_count_shorthand(lex: Lexer):
+    """Top-level `| count()` == `| stats count()`."""
+    if lex.is_keyword("("):
+        lex.next_token()
+        if not lex.is_keyword(")"):
+            raise ParseError("count() takes no args")
+        lex.next_token()
+    fn = sf.StatsCount([])
+    if lex.is_keyword("as"):
+        lex.next_token()
+        fn.out_name = _parse_field_name(lex)
+    return PipeStats([], [fn])
+
+
+_PIPE_PARSERS = {
+    "fields": _parse_fields,
+    "keep": _parse_fields,
+    "delete": _parse_delete,
+    "del": _parse_delete,
+    "rm": _parse_delete,
+    "drop": _parse_delete,
+    "copy": _parse_copy,
+    "cp": _parse_copy,
+    "rename": _parse_rename,
+    "mv": _parse_rename,
+    "limit": _parse_limit,
+    "head": _parse_limit,
+    "offset": _parse_offset,
+    "skip": _parse_offset,
+    "where": _parse_where,
+    "filter": _parse_where,
+    "sort": _parse_sort,
+    "order": _parse_sort,
+    "uniq": _parse_uniq,
+    "stats": _parse_stats,
+    "count": _parse_count_shorthand,
+    "first": lambda lex: _parse_first_last(lex, desc=False),
+    "last": lambda lex: _parse_first_last(lex, desc=True),
+}
+
+
+def register_pipe(name: str, parse_fn) -> None:
+    _PIPE_PARSERS[name] = parse_fn

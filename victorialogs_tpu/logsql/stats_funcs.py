@@ -1,0 +1,447 @@
+"""LogsQL stats functions.
+
+Mirrors the reference statsFunc/statsProcessor contract (lib/logstorage/
+pipe_stats.go:73-125): per-group mutable state with update / merge /
+export_state / import_state / finalize.  merge and export/import exist for
+the cluster + multi-chip paths: device partials and remote-node partials are
+merged into one state before finalize (the reference ships exported states
+over HTTP; we additionally reduce numeric partials over ICI psum).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+
+from .matchers import parse_number
+
+
+def format_number(v: float) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "inf" if v > 0 else "-inf"
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    if float(v) == int(v) and abs(v) < 2**63:
+        return str(int(v))
+    return repr(float(v))
+
+
+class StatsFunc:
+    """Base: parsed stats function with its input fields and result name."""
+
+    name = "?"
+
+    def __init__(self, fields: list[str], out_name: str = ""):
+        self.fields = fields
+        self.out_name = out_name or self.default_name()
+
+    def default_name(self) -> str:
+        args = ", ".join(self.fields)
+        return f"{self.name}({args})"
+
+    def to_string(self) -> str:
+        s = f"{self.name}({', '.join(self.fields)})"
+        if self.out_name != self.default_name():
+            s += f" as {self.out_name}"
+        return s
+
+    def needed_fields(self) -> set:
+        return set(self.fields)
+
+    # state protocol
+    def new_state(self):
+        raise NotImplementedError
+
+    def update(self, state, cols: list[list[str]], idxs) -> None:
+        """cols: one list[str] per self.fields (or all columns for star)."""
+        raise NotImplementedError
+
+    def merge(self, a, b):
+        raise NotImplementedError
+
+    def finalize(self, state) -> str:
+        raise NotImplementedError
+
+    def export_state(self, state):
+        return state
+
+    def import_state(self, data):
+        return data
+
+
+class StatsCount(StatsFunc):
+    name = "count"
+
+    def default_name(self):
+        return "count(*)" if not self.fields else super().default_name()
+
+    def new_state(self):
+        return 0
+
+    def update(self, state, cols, idxs):
+        if not self.fields:
+            return state + len(idxs)
+        n = state
+        for i in idxs:
+            if any(c[i] != "" for c in cols):
+                n += 1
+        return n
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return str(state)
+
+
+class StatsCountEmpty(StatsFunc):
+    name = "count_empty"
+
+    def new_state(self):
+        return 0
+
+    def update(self, state, cols, idxs):
+        n = state
+        for i in idxs:
+            if all(c[i] == "" for c in cols):
+                n += 1
+        return n
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return str(state)
+
+
+class StatsSum(StatsFunc):
+    name = "sum"
+
+    def new_state(self):
+        return math.nan
+
+    def update(self, state, cols, idxs):
+        s = state
+        for c in cols:
+            for i in idxs:
+                v = parse_number(c[i]) if c[i] else math.nan
+                if not math.isnan(v):
+                    s = v if math.isnan(s) else s + v
+        return s
+
+    def merge(self, a, b):
+        if math.isnan(a):
+            return b
+        if math.isnan(b):
+            return a
+        return a + b
+
+    def finalize(self, state):
+        return format_number(state) if not math.isnan(state) else "NaN"
+
+
+class StatsSumLen(StatsFunc):
+    name = "sum_len"
+
+    def new_state(self):
+        return 0
+
+    def update(self, state, cols, idxs):
+        s = state
+        for c in cols:
+            for i in idxs:
+                s += len(c[i])
+        return s
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return str(state)
+
+
+def _num_or_str_less(a: str, b: str) -> bool:
+    """Reference lessString semantics: numeric compare when both parse."""
+    fa, fb = parse_number(a), parse_number(b)
+    if not math.isnan(fa) and not math.isnan(fb):
+        if fa != fb:
+            return fa < fb
+        return a < b
+    if not math.isnan(fa):
+        return True   # numbers sort before strings
+    if not math.isnan(fb):
+        return False
+    return a < b
+
+
+class StatsMin(StatsFunc):
+    name = "min"
+
+    def new_state(self):
+        return None
+
+    def update(self, state, cols, idxs):
+        best = state
+        for c in cols:
+            for i in idxs:
+                v = c[i]
+                if v == "":
+                    continue
+                if best is None or _num_or_str_less(v, best):
+                    best = v
+        return best
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a if _num_or_str_less(a, b) else b
+
+    def finalize(self, state):
+        return state if state is not None else ""
+
+
+class StatsMax(StatsMin):
+    name = "max"
+
+    def update(self, state, cols, idxs):
+        best = state
+        for c in cols:
+            for i in idxs:
+                v = c[i]
+                if v == "":
+                    continue
+                if best is None or _num_or_str_less(best, v):
+                    best = v
+        return best
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return b if _num_or_str_less(a, b) else a
+
+
+class StatsAvg(StatsFunc):
+    name = "avg"
+
+    def new_state(self):
+        return (0.0, 0)  # (sum, count)
+
+    def update(self, state, cols, idxs):
+        s, n = state
+        for c in cols:
+            for i in idxs:
+                v = parse_number(c[i]) if c[i] else math.nan
+                if not math.isnan(v):
+                    s += v
+                    n += 1
+        return (s, n)
+
+    def merge(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def finalize(self, state):
+        s, n = state
+        return format_number(s / n) if n else "NaN"
+
+
+class StatsCountUniq(StatsFunc):
+    name = "count_uniq"
+
+    def __init__(self, fields, out_name="", limit: int = 0):
+        super().__init__(fields, out_name)
+        self.limit = limit
+
+    def new_state(self):
+        return set()
+
+    def update(self, state, cols, idxs):
+        if self.limit and len(state) >= self.limit:
+            return state
+        for i in idxs:
+            key = tuple(c[i] for c in cols)
+            if any(k != "" for k in key):
+                state.add(key)
+        return state
+
+    def merge(self, a, b):
+        a |= b
+        return a
+
+    def finalize(self, state):
+        n = len(state)
+        if self.limit and n > self.limit:
+            n = self.limit
+        return str(n)
+
+    def export_state(self, state):
+        return sorted(state)
+
+    def import_state(self, data):
+        return set(tuple(x) for x in data)
+
+
+class StatsCountUniqHash(StatsCountUniq):
+    """Approximate-by-hash count of unique values (reference
+    stats_count_uniq_hash.go): stores 64-bit hashes instead of values."""
+
+    name = "count_uniq_hash"
+
+    def update(self, state, cols, idxs):
+        from ..utils.hashing import xxh64
+        if self.limit and len(state) >= self.limit:
+            return state
+        for i in idxs:
+            key = tuple(c[i] for c in cols)
+            if any(k != "" for k in key):
+                state.add(xxh64("\x00".join(key).encode("utf-8")))
+        return state
+
+    def import_state(self, data):
+        return set(data)
+
+
+class StatsUniqValues(StatsFunc):
+    name = "uniq_values"
+
+    def __init__(self, fields, out_name="", limit: int = 0):
+        super().__init__(fields, out_name)
+        self.limit = limit
+
+    def new_state(self):
+        return set()
+
+    def update(self, state, cols, idxs):
+        for c in cols:
+            for i in idxs:
+                if c[i] != "":
+                    state.add(c[i])
+        return state
+
+    def merge(self, a, b):
+        a |= b
+        return a
+
+    def finalize(self, state):
+        import json
+        vals = sorted(state, key=lambda v: ((0, parse_number(v))
+                                            if not math.isnan(parse_number(v))
+                                            else (1, 0), v))
+        if self.limit and len(vals) > self.limit:
+            vals = vals[:self.limit]
+        return json.dumps(vals, separators=(",", ":")) if vals else ""
+
+    def export_state(self, state):
+        return sorted(state)
+
+    def import_state(self, data):
+        return set(data)
+
+
+class StatsValues(StatsFunc):
+    name = "values"
+
+    def __init__(self, fields, out_name="", limit: int = 0):
+        super().__init__(fields, out_name)
+        self.limit = limit
+
+    def new_state(self):
+        return []
+
+    def update(self, state, cols, idxs):
+        for c in cols:
+            for i in idxs:
+                state.append(c[i])
+        return state
+
+    def merge(self, a, b):
+        a.extend(b)
+        return a
+
+    def finalize(self, state):
+        import json
+        vals = state
+        if self.limit and len(vals) > self.limit:
+            vals = vals[:self.limit]
+        return json.dumps(vals, separators=(",", ":")) if vals else ""
+
+
+class StatsQuantile(StatsFunc):
+    name = "quantile"
+
+    def __init__(self, phi: float, fields, out_name=""):
+        self.phi = phi
+        super().__init__(fields, out_name)
+
+    def default_name(self):
+        return f"quantile({format_number(self.phi)}, {', '.join(self.fields)})"
+
+    def to_string(self):
+        s = f"quantile({format_number(self.phi)}, {', '.join(self.fields)})"
+        if self.out_name != self.default_name():
+            s += f" as {self.out_name}"
+        return s
+
+    def new_state(self):
+        return []
+
+    def update(self, state, cols, idxs):
+        for c in cols:
+            for i in idxs:
+                v = parse_number(c[i]) if c[i] else math.nan
+                if not math.isnan(v):
+                    state.append(v)
+        return state
+
+    def merge(self, a, b):
+        a.extend(b)
+        return a
+
+    def finalize(self, state):
+        if not state:
+            return "NaN"
+        vs = sorted(state)
+        idx = int(self.phi * len(vs))
+        if idx >= len(vs):
+            idx = len(vs) - 1
+        return format_number(vs[idx])
+
+
+class StatsMedian(StatsQuantile):
+    name = "median"
+
+    def __init__(self, fields, out_name=""):
+        super().__init__(0.5, fields, out_name)
+
+    def default_name(self):
+        return f"median({', '.join(self.fields)})"
+
+    def to_string(self):
+        s = f"median({', '.join(self.fields)})"
+        if self.out_name != self.default_name():
+            s += f" as {self.out_name}"
+        return s
+
+
+class StatsRowAny(StatsFunc):
+    name = "row_any"
+
+    def new_state(self):
+        return None
+
+    def update(self, state, cols, idxs):
+        if state is not None or not idxs:
+            return state
+        i = idxs[0]
+        return {f: c[i] for f, c in zip(self.fields, cols)} \
+            if self.fields else None
+
+    def merge(self, a, b):
+        return a if a is not None else b
+
+    def finalize(self, state):
+        import json
+        return json.dumps(state, separators=(",", ":")) if state else ""
