@@ -343,3 +343,50 @@ def test_time_filter_roundtrip():
 def test_subquery_requires_single_column(storage):
     with pytest.raises(ValueError):
         q(storage, "level:in(level:error | fields level, app)")
+
+
+def test_time_cmp_roundtrip():
+    from victorialogs_tpu.logsql.parser import parse_query
+    for qs in ["_time:>=2025-07-01", "_time:<=2025-07-01",
+               "_time:>2025-07-01", "_time:<2025-07-01"]:
+        q1 = parse_query(qs, timestamp=T0)
+        q2 = parse_query(q1.to_string(), timestamp=T0)
+        assert (q1.filter.min_ts, q1.filter.max_ts) == \
+               (q2.filter.min_ts, q2.filter.max_ts), qs
+
+
+def test_sequence_word_boundaries(storage):
+    # seq phrases must match at word boundaries: "err" is not a word in
+    # "error" (the reference getPhrasePos semantics)
+    from victorialogs_tpu.logsql.matchers import match_sequence
+    assert not match_sequence("errors happen", ["err"])
+    assert match_sequence("err happens", ["err"])
+    assert match_sequence("a GET then /api path", ["GET", "path"])
+
+
+def test_day_range_exclusive_bounds():
+    from victorialogs_tpu.logsql.parser import parse_query
+    NS_ = 1_000_000_000
+    qf = parse_query("_time:day_range(08:00, 18:00]", timestamp=T0).filter
+    assert qf.start_offset_ns == 8 * 3600 * NS_ + 1
+    assert qf.end_offset_ns == 18 * 3600 * NS_
+
+
+def test_row_any_star(storage):
+    rows = q(storage, "level:error | stats row_any() as r")
+    import json
+    row = json.loads(rows[0]["r"])
+    assert row["level"] == "error" and "_msg" in row
+
+
+def test_bare_eq_field_targets_msg(tmp_path):
+    s = Storage(str(tmp_path / "eqf"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows()
+    lr.add(TEN, T0, [("_msg", "same"), ("other", "same")])
+    lr.add(TEN, T0 + 1, [("_msg", "x"), ("other", "y")])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    rows = run_query_collect(s, [TEN], "eq_field(other) | count()")
+    assert rows == [{"count(*)": "1"}]
+    s.close()

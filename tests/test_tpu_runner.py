@@ -1,0 +1,168 @@
+"""TPU block runner parity tests: device bitmaps must equal CPU bitmaps.
+
+Runs on the virtual CPU backend (conftest.py) — same XLA kernels, no TPU
+needed.  This is the bit-exact diff harness from SURVEY.md §4: every kernel
+vs the scalar oracle in logsql.matchers.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.runner import BlockRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+WORDS = ["alpha", "beta", "gamma", "delta", "error", "GET", "POST",
+         "timeout", "x", "_under", "123", "a1b2"]
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    random.seed(42)
+    path = str(tmp_path_factory.mktemp("tpustore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(3000):
+        nwords = random.randint(0, 8)
+        msg = " ".join(random.choice(WORDS) for _ in range(nwords))
+        sep = random.choice([" ", "/", "=", ":", "-", ""])
+        msg = msg + sep + random.choice(WORDS)
+        if i % 97 == 0:
+            msg = ""  # empty messages
+        if i % 31 == 0:
+            msg = "日本語ログ " + msg  # unicode rows
+        lr.add(TEN, T0 + i * NS, [
+            ("app", f"app{i % 2}"),
+            ("_msg", msg),
+            ("path", f"/api/v{i % 3}/items/{i}"),
+        ])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+QUERIES = [
+    "error",
+    "GET",
+    "x",                      # single-char word
+    "_under",
+    "123",
+    '"error GET"',            # two-word phrase
+    '"gamma/delta"',          # phrase across separator
+    "err*",
+    "a1b*",
+    "_msg:=error",
+    '_msg:="error GET"*',
+    "path:v1",
+    "path:\"/api/v2\"*",
+    '_msg:seq("error", "GET")',
+    "_msg:contains_all(error, GET)",
+    "_msg:contains_any(error, timeout)",
+    '_msg:~"err.r"',
+    '_msg:~"(GET|POST) "',
+    '_msg:~"items/2\\\\d"',
+    "error or timeout",
+    "error timeout",
+    "!error",
+    "error !timeout",
+    "(error or GET) !POST",
+    "日本語ログ",              # unicode -> CPU fallback path
+]
+
+
+def test_bitmap_parity(storage):
+    runner = BlockRunner()
+    for qs in QUERIES:
+        cpu = run_query_collect(storage, [TEN], f"{qs} | fields _time",
+                                timestamp=T0)
+        tpu = run_query_collect(storage, [TEN], f"{qs} | fields _time",
+                                timestamp=T0, runner=runner)
+        assert [r.get("_time") for r in cpu] == \
+               [r.get("_time") for r in tpu], qs
+    assert runner.device_calls > 0
+
+
+def test_parity_exhaustive_phrases(storage):
+    """Every word/pair phrase must agree bit-exactly."""
+    runner = BlockRunner()
+    for w in WORDS:
+        for qs in (w, f'"{w} {w}"', f"{w}*", f"_msg:={w}"):
+            cpu = run_query_collect(storage, [TEN],
+                                    f"{qs} | stats count() n", timestamp=T0)
+            tpu = run_query_collect(storage, [TEN],
+                                    f"{qs} | stats count() n", timestamp=T0,
+                                    runner=runner)
+            assert cpu == tpu, qs
+
+
+def test_runner_cache_hits(storage):
+    runner = BlockRunner()
+    run_query_collect(storage, [TEN], "error | fields _time", timestamp=T0,
+                      runner=runner)
+    misses0 = runner.cache.misses
+    run_query_collect(storage, [TEN], "timeout | fields _time",
+                      timestamp=T0, runner=runner)
+    # second query over the same blocks: staging cache must hit
+    assert runner.cache.hits > 0
+    assert runner.cache.misses == misses0
+
+
+def test_scan_kernel_direct():
+    """Kernel-level oracle diff on adversarial arenas."""
+    from victorialogs_tpu.logsql.matchers import (is_word_char, match_phrase,
+                                                  match_prefix)
+    from victorialogs_tpu.tpu import kernels as K
+    from victorialogs_tpu.tpu.layout import stage_string_column
+
+    random.seed(7)
+    alphabet = "ab_ /"
+    vals = ["".join(random.choice(alphabet) for _ in range(random.randint(0, 12)))
+            for _ in range(500)]
+    vals += ["", "a", "ab", "ab ab", " ab", "ab ", "a_b", "abab", "ab/ab"]
+    bs_ = [v.encode() for v in vals]
+    lengths = np.array([len(b) for b in bs_], dtype=np.int64)
+    offsets = np.zeros(len(bs_), dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    arena = np.frombuffer(b"".join(bs_), dtype=np.uint8)
+    staged = stage_string_column(arena, offsets, lengths)
+
+    for pat in ["ab", "a", "ab ab", "b_a", "/", "ab/"]:
+        got = np.asarray(K.match_scan(
+            staged.rows, staged.lengths,
+            np.frombuffer(pat.encode(), dtype=np.uint8),
+            len(pat), K.MODE_PHRASE,
+            is_word_char(pat[0]), is_word_char(pat[-1])))[:len(vals)]
+        want = np.array([match_phrase(v, pat) for v in vals])
+        assert np.array_equal(got, want), f"phrase {pat!r}"
+
+        got = np.asarray(K.match_scan(
+            staged.rows, staged.lengths,
+            np.frombuffer(pat.encode(), dtype=np.uint8),
+            len(pat), K.MODE_PREFIX,
+            is_word_char(pat[0]), False))[:len(vals)]
+        want = np.array([match_prefix(v, pat) for v in vals])
+        assert np.array_equal(got, want), f"prefix {pat!r}"
+
+        got = np.asarray(K.match_scan(
+            staged.rows, staged.lengths,
+            np.frombuffer(pat.encode(), dtype=np.uint8),
+            len(pat), K.MODE_EXACT, False,
+            False))[:len(vals)]
+        want = np.array([v == pat for v in vals])
+        assert np.array_equal(got, want), f"exact {pat!r}"
+
+        got = np.asarray(K.match_scan(
+            staged.rows, staged.lengths,
+            np.frombuffer(pat.encode(), dtype=np.uint8),
+            len(pat), K.MODE_EXACT_PREFIX, False,
+            False))[:len(vals)]
+        want = np.array([v.startswith(pat) for v in vals])
+        assert np.array_equal(got, want), f"exact_prefix {pat!r}"

@@ -281,15 +281,15 @@ class FilterExact(_ValuePredFilter):
         return tokenize_string(self.value)
 
     def apply_to_block(self, bs, bm):
-        # numeric fast path: exact match on typed columns via vectorized ==
+        # numeric-column prune: a typed numeric column only decodes to
+        # numeric strings, so a non-numeric or out-of-range exact value
+        # can't match any row
         meta = bs.column_meta(canonical_field(self.field))
         if meta is not None and meta["t"] in _NUMERIC_VTS:
             v = parse_number(self.value)
             if math.isnan(v) or not (meta["min"] <= v <= meta["max"]):
-                # value can't be present (non-numeric or out of range)
-                if not math.isnan(v):
-                    bm[:] = False
-                    return
+                bm[:] = False
+                return
         super().apply_to_block(bs, bm)
 
     def to_string(self):
@@ -642,7 +642,7 @@ class FilterValueType(Filter):
     type_name: str
 
     def apply_to_block(self, bs, bm):
-        if bs.value_type_name(self.field) != self.type_name:
+        if bs.value_type_name(canonical_field(self.field)) != self.type_name:
             bm[:] = False
 
     def apply_to_values(self, get_values, nrows):
@@ -652,7 +652,7 @@ class FilterValueType(Filter):
         return np.full(nrows, keep, dtype=bool)
 
     def needed_fields(self):
-        return {self.field}
+        return {canonical_field(self.field)}
 
     def to_string(self):
         return f"{_q(self.field)}value_type({self.type_name})"
@@ -666,7 +666,7 @@ class FilterEqField(Filter):
     other: str
 
     def apply_to_block(self, bs, bm):
-        a = bs.values(self.field)
+        a = bs.values(canonical_field(self.field))
         b = bs.values(self.other)
         for i in np.nonzero(bm)[0]:
             if a[i] != b[i]:
@@ -679,7 +679,7 @@ class FilterEqField(Filter):
                            count=nrows)
 
     def needed_fields(self):
-        return {self.field, self.other}
+        return {canonical_field(self.field), self.other}
 
     def to_string(self):
         return f"{_q(self.field)}eq_field({self.other})"
@@ -698,7 +698,7 @@ class FilterLeField(Filter):
         return x < y if self.strict else x <= y
 
     def apply_to_block(self, bs, bm):
-        a = bs.values(self.field)
+        a = bs.values(canonical_field(self.field))
         b = bs.values(self.other)
         for i in np.nonzero(bm)[0]:
             if not self._cmp(a[i], b[i]):
@@ -711,7 +711,7 @@ class FilterLeField(Filter):
                            dtype=bool, count=nrows)
 
     def needed_fields(self):
-        return {self.field, self.other}
+        return {canonical_field(self.field), self.other}
 
     def to_string(self):
         fn = "lt_field" if self.strict else "le_field"

@@ -74,9 +74,33 @@ def match_any_case_prefix(s: str, prefix_lower: str) -> bool:
     return match_prefix(s.lower(), prefix_lower)
 
 
+def phrase_pos(s: str, phrase: str) -> int:
+    """First word-boundary occurrence of phrase in s; -1 if none
+    (reference getPhrasePos — filter_phrase.go:219-268)."""
+    if not phrase:
+        return 0
+    starts_tok = is_word_char(phrase[0])
+    ends_tok = is_word_char(phrase[-1])
+    pos = 0
+    while True:
+        n = s.find(phrase, pos)
+        if n < 0:
+            return -1
+        if starts_tok and n > 0 and is_word_char(s[n - 1]):
+            pos = n + 1
+            continue
+        end = n + len(phrase)
+        if ends_tok and end < len(s) and is_word_char(s[end]):
+            pos = n + 1
+            continue
+        return n
+
+
 def match_sequence(s: str, phrases: list[str]) -> bool:
+    """Ordered phrase occurrences, each at word boundaries
+    (reference matchSequence — filter_sequence.go:260)."""
     for p in phrases:
-        n = s.find(p)
+        n = phrase_pos(s, p)
         if n < 0:
             return False
         s = s[n + len(p):]

@@ -711,7 +711,8 @@ def _parse_filter_time(lex: Lexer) -> FilterTime:
         t = _time_bound(lex, tok, end=True)
         if eq:
             t = _time_bound(lex, tok, end=False)
-        return FilterTime(t if eq else t + 1, MAX_TS, repr_str=f">{tok}")
+        op = ">=" if eq else ">"
+        return FilterTime(t if eq else t + 1, MAX_TS, repr_str=f"{op}{tok}")
     if lex.is_keyword("<"):
         lex.next_token()
         eq = False
@@ -722,7 +723,8 @@ def _parse_filter_time(lex: Lexer) -> FilterTime:
         t = _time_bound(lex, tok, end=eq)
         if not eq:
             t = _time_bound(lex, tok, end=False) - 1
-        return FilterTime(MIN_TS, t, repr_str=f"<{tok}")
+        op = "<=" if eq else "<"
+        return FilterTime(MIN_TS, t, repr_str=f"{op}{tok}")
     if lex.is_keyword("="):
         lex.next_token()
     tok = _get_compound_token(lex)
@@ -773,7 +775,7 @@ def _parse_day_range(lex: Lexer) -> Filter:
     lo = _day_off(lo_s)
     hi = _day_off(hi_s)
     if not inc_lo:
-        lo += 60 * NS
+        lo += 1
     if not inc_hi:
         hi -= 1
     rs = f"{'[' if inc_lo else '('}{lo_s},{hi_s}{']' if inc_hi else ')'}"

@@ -527,8 +527,7 @@ class PipeStats(Pipe):
                             tuple(c[i] for c in key_cols), []).append(i)
                 else:
                     rows_by_key = {(): list(range(n))}
-                func_cols = [[br.column(f) for f in fn.fields]
-                             for fn in pipe.funcs]
+                func_cols = [fn.block_cols(br) for fn in pipe.funcs]
                 for key, idxs in rows_by_key.items():
                     states = self.groups.get(key)
                     if states is None:

@@ -52,8 +52,13 @@ class StatsFunc:
     def new_state(self):
         raise NotImplementedError
 
+    def block_cols(self, br):
+        """Column lists this function consumes from a block (cached by the
+        stats processor once per block)."""
+        return [br.column(f) for f in self.fields]
+
     def update(self, state, cols: list[list[str]], idxs) -> None:
-        """cols: one list[str] per self.fields (or all columns for star)."""
+        """cols: whatever block_cols() returned for the current block."""
         raise NotImplementedError
 
     def merge(self, a, b):
@@ -429,19 +434,28 @@ class StatsMedian(StatsQuantile):
 class StatsRowAny(StatsFunc):
     name = "row_any"
 
+    def default_name(self):
+        return "row_any(*)" if not self.fields else super().default_name()
+
     def new_state(self):
         return None
+
+    def block_cols(self, br):
+        # with no named fields, capture the whole row (reference row_any)
+        if self.fields:
+            return [(f, br.column(f)) for f in self.fields]
+        return [(n, br.column(n)) for n in br.column_names()]
 
     def update(self, state, cols, idxs):
         if state is not None or not idxs:
             return state
         i = idxs[0]
-        return {f: c[i] for f, c in zip(self.fields, cols)} \
-            if self.fields else None
+        return {f: c[i] for f, c in cols if c[i] != ""}
 
     def merge(self, a, b):
         return a if a is not None else b
 
     def finalize(self, state):
         import json
-        return json.dumps(state, separators=(",", ":")) if state else ""
+        return json.dumps(state, separators=(",", ":")) \
+            if state is not None else ""

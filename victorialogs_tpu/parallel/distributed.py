@@ -1,0 +1,106 @@
+"""Multi-chip query execution: blocks sharded over a device mesh, stats
+partials reduced over ICI.
+
+This maps the reference's two parallelism mechanisms (SURVEY.md §2.6) onto a
+TPU mesh:
+
+- intra-query data parallelism (N workers over a block channel —
+  storage_search.go:1035-1067) -> a `blocks` mesh axis: each device scans its
+  shard of the staged block batch;
+- the stats remote/local pushdown split (pipe_stats.go:55-60, mergeState over
+  exported states) -> `jax.lax.psum` over ICI: per-device partial aggregates
+  are reduced in-network, the host only finalizes.
+
+The step below is the distributed analogue of a training step: jit once over
+the mesh, run per staged batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tpu import kernels as K
+
+BLOCK_AXIS = "blocks"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (BLOCK_AXIS,))
+
+
+@partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",
+                                   "ends_tok", "num_buckets", "mesh"))
+def distributed_scan_count(mesh, rows, lengths,
+                           bucket_ids, pattern, pat_len: int, mode: int,
+                           starts_tok: bool, ends_tok: bool,
+                           num_buckets: int):
+    """One distributed query step.
+
+    rows: uint8[B, R, W] — B fixed-width blocks sharded across the mesh's
+    block axis; lengths: int32[B, R];
+    bucket_ids: int32[B] — per-BLOCK stats group (e.g. the block's time
+    bucket; blocks are the stats unit here since rows within a block share
+    a stream and close timestamps);
+    returns (match bitmaps bool[B, R], total count, per-bucket counts) with
+    the two aggregates psum-reduced across devices.
+    """
+
+    def per_block(rw, lens):
+        bm = K.match_scan(rw, lens, pattern, pat_len, mode, starts_tok,
+                          ends_tok)
+        return bm, jnp.sum(bm.astype(jnp.int32))
+
+    def shard_fn(rows, lengths, bucket_ids):
+        bms, cnts = jax.vmap(per_block)(rows, lengths)
+        # stats partials merge over ICI — the psum analogue of mergeState
+        total = jax.lax.psum(jnp.sum(cnts), BLOCK_AXIS)
+        # per-bucket counts: one-hot matmul instead of segment ops (scatter
+        # serializes on TPU; a (B, num_buckets) one-hot contraction rides
+        # the MXU instead)
+        onehot = jax.nn.one_hot(bucket_ids, num_buckets, dtype=jnp.float32)
+        hist = jax.lax.psum(
+            jnp.einsum("b,bk->k", cnts.astype(jnp.float32), onehot),
+            BLOCK_AXIS)
+        return bms, total, hist.astype(jnp.int32)
+
+    spec = P(BLOCK_AXIS)
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, P(), P()))(rows, lengths, bucket_ids)
+
+
+def stage_block_batch(blocks: list[tuple[np.ndarray, np.ndarray, np.ndarray]],
+                      n_devices: int):
+    """Pad a list of (arena, offsets, lengths) into fixed-width batch
+    tensors whose block count divides the mesh size.  Returns
+    (rows uint8[B, R, W], lengths int32[B, R], rows_bucket)."""
+    from ..tpu.kernels import pad_bucket
+    from ..tpu.layout import to_fixed_width, row_width_bucket
+    rb = pad_bucket(max(max((o.shape[0] for _a, o, _l in blocks),
+                            default=1), 1), minimum=1024)
+    w = max(row_width_bucket(int(l.max()) if l.size else 0)
+            for _a, _o, l in blocks)
+    b = len(blocks)
+    bpad = ((b + n_devices - 1) // n_devices) * n_devices
+    rows = np.full((bpad, rb, w), 0xFF, dtype=np.uint8)
+    lengths = np.zeros((bpad, rb), dtype=np.int32)
+    for i, (a, o, l) in enumerate(blocks):
+        mat, _wi, _overflow = to_fixed_width(a, o, l, rb, width=w)
+        rows[i] = mat
+        lengths[i, :l.shape[0]] = np.minimum(l, w - 1).astype(np.int32)
+    return rows, lengths, rb
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Device-put batch tensors with the block axis sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(BLOCK_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in arrays)

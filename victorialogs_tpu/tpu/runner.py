@@ -1,0 +1,271 @@
+"""TPU block runner: executes the filter tree over staged blocks.
+
+This is the pluggable `blockSearch` replacement from the north star: the
+searcher hands it (filter, BlockSearch) and gets back a bitmap identical to
+the CPU path's.  Device-capable leaves (phrase/prefix/exact/exact-prefix
+matches, sequences, contains_*, regex literal prefilters on string-arena
+columns) run as arena-scan kernels; everything else (numeric compares, dict
+columns, time filters, cross-field compares) stays on the host where numpy is
+already bandwidth-bound.  Bitmaps combine host-side; bloom probes stay on the
+host kill-path so most blocks never touch HBM.
+
+Regex: device runs the mandatory-literal substring prefilter, then the host
+re.search verifies only surviving rows (mirrors the reference's bloom+scan
+split — filter_regexp.go:44-51); a pure-literal pattern skips verification.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.block_search import BlockSearch, new_bitmap
+from ..logsql import filters as F
+from ..storage.values_encoder import VT_STRING
+from . import kernels as K
+from .layout import StagingCache, stage_string_column
+
+
+class BlockRunner:
+    def __init__(self, max_cache_bytes: int = 4 << 30):
+        self.cache = StagingCache(max_cache_bytes)
+        self.device_calls = 0
+        self.cpu_fallbacks = 0
+
+    # ---- staging ----
+    def stage(self, bs: BlockSearch, field: str):
+        key = (id(bs.part), bs.block_idx, field)
+        got = self.cache.get(key)
+        if got is not None:
+            return got
+        col = bs.column(field)
+        if col is None or col.vtype != VT_STRING:
+            return None
+        staged = stage_string_column(col.arena, col.offsets, col.lengths)
+        self.cache.put(key, staged)
+        return staged
+
+    # ---- filter evaluation ----
+    def apply_filter(self, f, bs: BlockSearch) -> np.ndarray:
+        bm = new_bitmap(bs.nrows)
+        self._apply(f, bs, bm)
+        return bm
+
+    def _apply(self, f, bs: BlockSearch, bm: np.ndarray) -> None:
+        if isinstance(f, F.FilterAnd):
+            for sub in f.filters:
+                if not bm.any():
+                    return
+                self._apply(sub, bs, bm)
+            return
+        if isinstance(f, F.FilterOr):
+            acc = np.zeros(bs.nrows, dtype=bool)
+            for sub in f.filters:
+                tmp = bm.copy()
+                self._apply(sub, bs, tmp)
+                acc |= tmp
+                if acc.all():
+                    break
+            bm &= acc
+            return
+        if isinstance(f, F.FilterNot):
+            tmp = new_bitmap(bs.nrows)
+            self._apply(f.inner, bs, tmp)
+            bm &= ~tmp
+            return
+        leaf = self._apply_leaf_device(f, bs)
+        if leaf is None:
+            self.cpu_fallbacks += 1
+            f.apply_to_block(bs, bm)
+        else:
+            bm &= leaf
+
+    def _scan(self, staged, pattern: bytes, mode: int, starts_tok: bool,
+              ends_tok: bool, bs=None, fld=None, pred=None) -> np.ndarray:
+        import jax.numpy as jnp
+        self.device_calls += 1
+        pat = jnp.asarray(np.frombuffer(pattern, dtype=np.uint8))
+        out = K.match_scan(staged.rows, staged.lengths, pat,
+                           len(pattern), mode, starts_tok, ends_tok)
+        bm = np.array(out[:staged.nrows])  # writable host copy
+        if staged.overflow.size and bs is not None and pred is not None:
+            # rows longer than the staging width were truncated on device;
+            # re-evaluate them on the host with the scalar oracle
+            vals = bs.values(fld)
+            for i in staged.overflow:
+                bm[i] = pred(vals[i])
+        return bm
+
+    def _apply_leaf_device(self, f, bs: BlockSearch) -> np.ndarray | None:
+        """Evaluate one leaf on device; None => caller falls back to CPU."""
+        from ..logsql.filters import canonical_field, _bloom_prunes
+        from ..logsql.matchers import is_word_char
+
+        if isinstance(f, F.FilterPhrase):
+            if not f.phrase or not f.phrase.isascii() or \
+                    len(f.phrase) > K.MAX_PATTERN_LEN:
+                return None
+            fld = canonical_field(f.field)
+            if _bloom_prunes(bs, fld, f._tokens()):
+                return np.zeros(bs.nrows, dtype=bool)
+            staged = self.stage(bs, fld)
+            if staged is None:
+                return None
+            pat = f.phrase.encode("utf-8")
+            return self._scan(staged, pat, K.MODE_PHRASE,
+                              is_word_char(f.phrase[0]),
+                              is_word_char(f.phrase[-1]),
+                              bs=bs, fld=fld, pred=f._pred)
+
+        if isinstance(f, F.FilterPrefix):
+            if not f.prefix.isascii() or len(f.prefix) > K.MAX_PATTERN_LEN:
+                return None
+            fld = canonical_field(f.field)
+            if _bloom_prunes(bs, fld, f._tokens()):
+                return np.zeros(bs.nrows, dtype=bool)
+            staged = self.stage(bs, fld)
+            if staged is None:
+                return None
+            if not f.prefix:
+                bm = np.asarray(staged.lengths)[:staged.nrows] > 0
+                for i in staged.overflow:
+                    bm[i] = True  # overflow rows are non-empty
+                return bm
+            return self._scan(staged, f.prefix.encode("utf-8"),
+                              K.MODE_PREFIX, is_word_char(f.prefix[0]),
+                              False, bs=bs, fld=fld, pred=f._pred)
+
+        if isinstance(f, F.FilterExact):
+            if not f.value or not f.value.isascii() or \
+                    len(f.value) > K.MAX_PATTERN_LEN:
+                return None
+            fld = canonical_field(f.field)
+            staged = self.stage(bs, fld)
+            if staged is None:
+                return None
+            return self._scan(staged, f.value.encode("utf-8"),
+                              K.MODE_EXACT, False, False,
+                              bs=bs, fld=fld, pred=f._pred)
+
+        if isinstance(f, F.FilterExactPrefix):
+            if not f.prefix or not f.prefix.isascii() or \
+                    len(f.prefix) > K.MAX_PATTERN_LEN:
+                return None
+            fld = canonical_field(f.field)
+            staged = self.stage(bs, fld)
+            if staged is None:
+                return None
+            return self._scan(staged, f.prefix.encode("utf-8"),
+                              K.MODE_EXACT_PREFIX, False, False,
+                              bs=bs, fld=fld, pred=f._pred)
+
+        if isinstance(f, F.FilterSequence):
+            # all phrases must occur; ordering verified on survivors (host)
+            if not f.phrases:
+                return None
+            fld = canonical_field(f.field)
+            if any(not p or not p.isascii() or len(p) > K.MAX_PATTERN_LEN
+                   for p in f.phrases):
+                return None
+            if _bloom_prunes(bs, fld, f._tokens()):
+                return np.zeros(bs.nrows, dtype=bool)
+            staged = self.stage(bs, fld)
+            if staged is None:
+                return None
+            cand = np.ones(staged.nrows, dtype=bool)
+            for p in f.phrases:
+                cand &= self._scan(staged, p.encode("utf-8"),
+                                   K.MODE_SUBSTRING, False, False,
+                                   bs=bs, fld=fld,
+                                   pred=lambda v, p=p: p in v)
+                if not cand.any():
+                    return cand[:bs.nrows]
+            if len(f.phrases) == 1:
+                return cand[:bs.nrows]
+            return self._verify_rows(bs, fld, cand, f._pred)
+
+        if isinstance(f, F.FilterContainsAll):
+            if f.subquery is not None and not f.values:
+                return None
+            return self._contains(bs, f, require_all=True)
+
+        if isinstance(f, F.FilterContainsAny):
+            if f.subquery is not None and not f.values:
+                return None
+            return self._contains(bs, f, require_all=False)
+
+        if isinstance(f, F.FilterRegexp):
+            return self._regexp(bs, f)
+
+        return None
+
+    def _contains(self, bs, f, require_all: bool) -> np.ndarray | None:
+        from ..logsql.filters import canonical_field
+        from ..logsql.matchers import is_word_char, match_phrase
+        fld = canonical_field(f.field)
+        phrases = f.values
+        if not phrases:
+            return None
+        if any(not p.isascii() or len(p) > K.MAX_PATTERN_LEN
+               for p in phrases):
+            return None
+        staged = self.stage(bs, fld)
+        if staged is None:
+            return None
+        if require_all:
+            out = np.ones(staged.nrows, dtype=bool)
+        else:
+            out = np.zeros(staged.nrows, dtype=bool)
+        for p in phrases:
+            if not p:
+                # empty phrase matches only the empty string
+                hit = np.asarray(staged.lengths)[:staged.nrows] == 0
+            else:
+                hit = self._scan(staged, p.encode("utf-8"), K.MODE_PHRASE,
+                                 is_word_char(p[0]), is_word_char(p[-1]),
+                                 bs=bs, fld=fld,
+                                 pred=lambda v, p=p: match_phrase(v, p))
+            if require_all:
+                out &= hit
+                if not out.any():
+                    break
+            else:
+                out |= hit
+                if out.all():
+                    break
+        return out[:bs.nrows]
+
+    def _regexp(self, bs, f) -> np.ndarray | None:
+        from ..logsql.filters import canonical_field
+        fld = canonical_field(f.field)
+        staged = self.stage(bs, fld)
+        if staged is None:
+            return None
+        # literal prefilter on device
+        cand = np.ones(staged.nrows, dtype=bool)
+        literals = [t for t in getattr(f, "_bloom_tokens", [])
+                    if t.isascii() and 0 < len(t) <= K.MAX_PATTERN_LEN]
+        for lit in literals:
+            cand &= self._scan(staged, lit.encode("utf-8"),
+                               K.MODE_SUBSTRING, False, False,
+                               bs=bs, fld=fld,
+                               pred=lambda v, lit=lit: lit in v)
+            if not cand.any():
+                return cand[:bs.nrows]
+        # pure-literal regex needs no verification
+        import re
+        if re.escape(f.pattern) == f.pattern and len(literals) == 1 and \
+                literals[0] == f.pattern:
+            return cand[:bs.nrows]
+        return self._verify_rows(bs, fld, cand, f._pred)
+
+    def _verify_rows(self, bs, fld: str, cand: np.ndarray, pred
+                     ) -> np.ndarray:
+        """Host verification of device-surviving rows only."""
+        out = cand[:bs.nrows].copy()
+        if not out.any():
+            return out
+        vals = bs.values(fld)
+        for i in np.nonzero(out)[0]:
+            if not pred(vals[i]):
+                out[i] = False
+        return out
