@@ -1,0 +1,365 @@
+"""HTTP API integration tests: real server over real sockets.
+
+Modeled on the reference's apptest harness (SURVEY.md §4 tier 3): start the
+server, speak the actual ingestion protocols over HTTP, then query back.
+"""
+
+import gzip
+import json
+import http.client
+import struct
+import time
+
+import pytest
+
+from victorialogs_tpu.server.app import VLServer
+from victorialogs_tpu.storage.storage import Storage
+
+T0 = time.time_ns() - 60 * 1_000_000_000
+
+
+@pytest.fixture()
+def server(tmp_path):
+    storage = Storage(str(tmp_path / "data"), retention_days=100,
+                      flush_interval=3600)
+    srv = VLServer(storage, listen_addr="127.0.0.1", port=0)
+    yield srv
+    srv.close()
+    storage.close()
+
+
+def _req(srv, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _flush(srv):
+    _req(srv, "GET", "/internal/force_flush")
+
+
+def _query(srv, q, extra=""):
+    status, data = _req(srv, "GET",
+                        f"/select/logsql/query?query={_esc(q)}{extra}")
+    assert status == 200, data
+    return [json.loads(line) for line in data.decode().splitlines() if line]
+
+
+def _esc(s):
+    import urllib.parse
+    return urllib.parse.quote(s)
+
+
+def test_health_and_root(server):
+    assert _req(server, "GET", "/health")[0] == 200
+    status, data = _req(server, "GET", "/")
+    assert status == 200 and b"victorialogs" in data
+
+
+def test_jsonline_roundtrip(server):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i * 1_000_000_000,
+        "_msg": f"hello {i}",
+        "level": "info" if i % 2 else "error",
+        "app": "web",
+    }) for i in range(10))
+    status, data = _req(server, "POST",
+                        "/insert/jsonline?_stream_fields=app",
+                        body=body.encode())
+    assert status == 200, data
+    assert json.loads(data)["ingested"] == 10
+    _flush(server)
+    rows = _query(server, "hello")
+    assert len(rows) == 10
+    assert all("_stream" in r for r in rows)
+    rows = _query(server, "level:error | stats count() n")
+    assert rows == [{"n": "5"}]
+
+
+def test_jsonline_gzip(server):
+    body = json.dumps({"_time": T0, "_msg": "gzipped row"}).encode()
+    status, _ = _req(server, "POST", "/insert/jsonline",
+                     body=gzip.compress(body),
+                     headers={"Content-Encoding": "gzip"})
+    assert status == 200
+    _flush(server)
+    assert len(_query(server, "gzipped")) == 1
+
+
+def test_elasticsearch_bulk(server):
+    lines = []
+    for i in range(4):
+        lines.append(json.dumps({"create": {}}))
+        lines.append(json.dumps({
+            "@timestamp": "2026-07-28T10:00:00Z",
+            "message": f"es doc {i}", "k": "v"}))
+    status, data = _req(server, "POST", "/insert/elasticsearch/_bulk",
+                        body="\n".join(lines).encode())
+    assert status == 200
+    resp = json.loads(data)
+    assert resp["errors"] is False and len(resp["items"]) == 4
+    _flush(server)
+    rows = _query(server, '"es doc"')
+    assert len(rows) == 4
+    assert rows[0]["_msg"].startswith("es doc")
+
+
+def test_loki_json(server):
+    body = json.dumps({"streams": [{
+        "stream": {"app": "loki-app", "env": "prod"},
+        "values": [[str(T0), "loki line one"],
+                   [str(T0 + 1), "loki line two", {"trace_id": "abc"}]],
+    }]})
+    status, _ = _req(server, "POST", "/insert/loki/api/v1/push",
+                     body=body.encode(),
+                     headers={"Content-Type": "application/json"})
+    assert status == 204
+    _flush(server)
+    rows = _query(server, "loki")
+    assert len(rows) == 2
+    assert any(r.get("trace_id") == "abc" for r in rows)
+    rows = _query(server, '{app="loki-app"} | stats count() n')
+    assert rows == [{"n": "2"}]
+
+
+def _pb_field(fnum, wt, payload):
+    key = (fnum << 3) | wt
+    out = bytes([key])
+    if wt == 2:
+        out += _varint(len(payload)) + payload
+    elif wt == 0:
+        out += _varint(payload)
+    return out
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def test_loki_protobuf_snappy(server):
+    # hand-build PushRequest{streams=[{labels, entries=[{ts, line}]}]}
+    ts = _pb_field(1, 0, T0 // 1_000_000_000) + _pb_field(2, 0, 0)
+    entry = _pb_field(1, 2, ts) + _pb_field(2, 2, b"loki pb line")
+    stream = _pb_field(1, 2, b'{job="pbjob"}') + _pb_field(2, 2, entry)
+    push = _pb_field(1, 2, stream)
+    # snappy block-compress: emit as a single literal
+    raw = push
+    lit_len = len(raw) - 1
+    if lit_len < 60:
+        snappy = _varint(len(raw)) + bytes([lit_len << 2]) + raw
+    else:
+        snappy = _varint(len(raw)) + bytes([(60 << 2) | 0, lit_len & 0xFF]) \
+            + raw
+    status, data = _req(server, "POST", "/insert/loki/api/v1/push",
+                        body=snappy,
+                        headers={"Content-Type": "application/x-protobuf"})
+    assert status == 204, data
+    _flush(server)
+    rows = _query(server, '{job="pbjob"}')
+    assert len(rows) == 1
+    assert rows[0]["_msg"] == "loki pb line"
+
+
+def test_otlp_json(server):
+    body = json.dumps({"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "otlp-svc"}}]},
+        "scopeLogs": [{"logRecords": [
+            {"timeUnixNano": str(T0), "severityText": "WARN",
+             "body": {"stringValue": "otlp warning body"},
+             "attributes": [{"key": "code",
+                             "value": {"intValue": "42"}}]}]}],
+    }]})
+    status, _ = _req(server, "POST", "/insert/opentelemetry/v1/logs",
+                     body=body.encode(),
+                     headers={"Content-Type": "application/json"})
+    assert status == 200
+    _flush(server)
+    rows = _query(server, "otlp")
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["severity"] == "WARN" and r["code"] == "42"
+    assert r["service.name"] == "otlp-svc"
+
+
+def test_otlp_protobuf(server):
+    body_v = _pb_field(1, 2, b"otlp pb body")
+    rec = (_pb_field(1, 1, 0) or b"")
+    # fixed64 time field
+    rec = bytes([(1 << 3) | 1]) + struct.pack("<Q", T0)
+    rec += _pb_field(2, 0, 9)  # severity INFO
+    rec += _pb_field(5, 2, body_v)
+    scope_logs = _pb_field(2, 2, rec)
+    resource_logs = _pb_field(2, 2, scope_logs)
+    payload = _pb_field(1, 2, resource_logs)
+    status, _ = _req(server, "POST", "/insert/opentelemetry/v1/logs",
+                     body=payload,
+                     headers={"Content-Type": "application/x-protobuf"})
+    assert status == 200
+    _flush(server)
+    rows = _query(server, '"otlp pb body"')
+    assert len(rows) == 1
+    assert rows[0]["severity"] == "INFO"
+
+
+def test_datadog(server):
+    body = json.dumps([{"message": "dd log line", "ddsource": "nginx",
+                        "service": "payments",
+                        "ddtags": "env:prod,version:1.2"}])
+    status, _ = _req(server, "POST", "/insert/datadog/api/v2/logs",
+                     body=body.encode())
+    assert status == 200
+    _flush(server)
+    rows = _query(server, "dd")
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["service"] == "payments" and r["env"] == "prod"
+
+
+def test_journald(server):
+    entry = (b"MESSAGE=journald says hi\nPRIORITY=6\n"
+             b"_SYSTEMD_UNIT=web.service\n"
+             b"__REALTIME_TIMESTAMP=" +
+             str(T0 // 1000).encode() + b"\n\n")
+    status, _ = _req(server, "POST", "/insert/journald/upload", body=entry)
+    assert status == 200
+    _flush(server)
+    rows = _query(server, "journald")
+    assert len(rows) == 1
+    assert rows[0]["_SYSTEMD_UNIT"] == "web.service"
+
+
+def test_hits_endpoint(server):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i * 1_000_000_000, "_msg": f"hit {i}",
+        "level": "error" if i < 3 else "info"})
+        for i in range(10))
+    _req(server, "POST", "/insert/jsonline", body=body.encode())
+    _flush(server)
+    status, data = _req(server, "GET",
+                        "/select/logsql/hits?query=" + _esc("hit") +
+                        "&step=1h&field=level")
+    assert status == 200
+    obj = json.loads(data)
+    totals = {h["fields"]["level"]: h["total"] for h in obj["hits"]}
+    assert totals == {"error": 3, "info": 7}
+
+
+def test_field_endpoints(server):
+    body = json.dumps({"_time": T0, "_msg": "ff", "color": "red"})
+    _req(server, "POST", "/insert/jsonline", body=body.encode())
+    _flush(server)
+    status, data = _req(server, "GET",
+                        "/select/logsql/field_names?query=*")
+    names = {v["value"] for v in json.loads(data)["values"]}
+    assert "color" in names
+    status, data = _req(server, "GET",
+                        "/select/logsql/field_values?query=*&field=color")
+    assert json.loads(data)["values"][0]["value"] == "red"
+
+
+def test_streams_endpoints(server):
+    body = json.dumps({"_time": T0, "_msg": "s", "app": "str-app"})
+    _req(server, "POST", "/insert/jsonline?_stream_fields=app",
+         body=body.encode())
+    _flush(server)
+    status, data = _req(server, "GET", "/select/logsql/streams?query=*")
+    vals = [v["value"] for v in json.loads(data)["values"]]
+    assert '{app="str-app"}' in vals
+    status, data = _req(server, "GET",
+                        "/select/logsql/stream_field_names?query=*")
+    assert any(v["value"] == "app" for v in json.loads(data)["values"])
+    status, data = _req(server, "GET",
+                        "/select/logsql/stream_field_values?query=*"
+                        "&field=app")
+    assert json.loads(data)["values"][0]["value"] == "str-app"
+
+
+def test_stats_query(server):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i, "_msg": f"sq {i}", "lvl": "a" if i < 2 else "b"})
+        for i in range(5))
+    _req(server, "POST", "/insert/jsonline", body=body.encode())
+    _flush(server)
+    q = "sq | stats by (lvl) count() as cnt"
+    status, data = _req(server, "GET",
+                        "/select/logsql/stats_query?query=" + _esc(q))
+    assert status == 200
+    obj = json.loads(data)
+    assert obj["status"] == "success"
+    res = {r["metric"]["lvl"]: r["value"][1] for r in
+           obj["data"]["result"]}
+    assert res == {"a": "2", "b": "3"}
+    # query without stats pipe must 400
+    status, _ = _req(server, "GET",
+                     "/select/logsql/stats_query?query=" + _esc("sq"))
+    assert status == 400
+
+
+def test_facets(server):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i, "_msg": f"fc {i}",
+        "kind": "x" if i % 3 else "y"}) for i in range(9))
+    _req(server, "POST", "/insert/jsonline", body=body.encode())
+    _flush(server)
+    status, data = _req(server, "GET",
+                        "/select/logsql/facets?query=" + _esc("fc"))
+    obj = json.loads(data)
+    kinds = {f["field_name"]: f["values"] for f in obj["facets"]}
+    assert "kind" in kinds
+    assert {v["field_value"]: v["hits"] for v in kinds["kind"]} == \
+        {"x": 6, "y": 3}
+
+
+def test_metrics_endpoint(server):
+    _req(server, "POST", "/insert/jsonline",
+         body=json.dumps({"_time": T0, "_msg": "m"}).encode())
+    _flush(server)
+    status, data = _req(server, "GET", "/metrics")
+    assert status == 200
+    text = data.decode()
+    assert "vl_storage_rows" in text
+    assert 'vl_rows_ingested_total{type="jsonline"} 1' in text
+
+
+def test_tenant_isolation_http(server):
+    _req(server, "POST", "/insert/jsonline",
+         body=json.dumps({"_time": T0, "_msg": "tenant42"}).encode(),
+         headers={"AccountID": "42"})
+    _flush(server)
+    assert _query(server, "tenant42") == []
+    status, data = _req(
+        server, "GET", "/select/logsql/query?query=tenant42",
+        headers={"AccountID": "42"})
+    rows = [json.loads(x) for x in data.decode().splitlines() if x]
+    assert len(rows) == 1
+
+
+def test_bad_query_400(server):
+    status, _ = _req(server, "GET", "/select/logsql/query?query=" +
+                     _esc("foo | nosuchpipe"))
+    assert status == 400
+    status, _ = _req(server, "GET", "/select/logsql/query")
+    assert status == 400
+
+
+def test_force_merge(server):
+    for k in range(3):
+        _req(server, "POST", "/insert/jsonline",
+             body=json.dumps({"_time": T0 + k, "_msg": f"fm {k}"}).encode())
+        _flush(server)
+    status, _ = _req(server, "GET", "/internal/force_merge")
+    assert status == 200
+    rows = _query(server, "fm | stats count() n")
+    assert rows == [{"n": "3"}]

@@ -1,0 +1,74 @@
+"""Syslog parser + listener tests."""
+
+import socket
+import time
+
+from victorialogs_tpu.server.syslog import (SyslogServer,
+                                            parse_syslog_message)
+from victorialogs_tpu.server.insertutil import LogRowsStorage
+
+
+def test_parse_rfc3164():
+    f = dict(parse_syslog_message(
+        "<34>Oct 11 22:14:15 mymachine su[123]: 'su root' failed"))
+    assert f["priority"] == "34"
+    assert f["facility"] == "4" and f["severity"] == "2"
+    assert f["level"] == "crit"
+    assert f["hostname"] == "mymachine"
+    assert f["app_name"] == "su" and f["proc_id"] == "123"
+    assert f["_msg"] == "'su root' failed"
+    assert f["format"] == "rfc3164"
+
+
+def test_parse_rfc5424():
+    line = ('<165>1 2026-07-28T22:14:15.003Z host01 evntslog 1370 ID47 '
+            '[exampleSDID@32473 iut="3" eventSource="Application"] '
+            'An application event')
+    f = dict(parse_syslog_message(line))
+    assert f["format"] == "rfc5424"
+    assert f["hostname"] == "host01"
+    assert f["app_name"] == "evntslog"
+    assert f["proc_id"] == "1370" and f["msg_id"] == "ID47"
+    assert f["exampleSDID@32473.iut"] == "3"
+    assert f["_msg"] == "An application event"
+    assert f["timestamp"] == "2026-07-28T22:14:15.003Z"
+
+
+def test_parse_plain_line():
+    f = dict(parse_syslog_message("just some text"))
+    assert f["_msg"] == "just some text"
+    assert f["format"] == "unknown"
+
+
+class _CaptureSink(LogRowsStorage):
+    def __init__(self):
+        self.rows = []
+
+    def must_add_rows(self, lr):
+        for i in range(len(lr)):
+            self.rows.append(dict(lr.rows[i]))
+
+
+def test_syslog_tcp_udp_listeners():
+    sink = _CaptureSink()
+    srv = SyslogServer(sink, tcp_port=0, udp_port=0)
+    try:
+        with socket.create_connection(("127.0.0.1", srv.tcp_port),
+                                      timeout=5) as s:
+            s.sendall(b"<13>Jul 28 10:00:00 h1 app1: tcp says hi\n")
+        u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        u.sendto(b"<13>Jul 28 10:00:01 h2 app2: udp says hi",
+                 ("127.0.0.1", srv.udp_port))
+        u.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            srv.flush()
+            if len(sink.rows) >= 2:
+                break
+            time.sleep(0.05)
+        msgs = {r["_msg"] for r in sink.rows}
+        assert "tcp says hi" in msgs and "udp says hi" in msgs
+        hosts = {r.get("hostname") for r in sink.rows}
+        assert {"h1", "h2"} <= hosts
+    finally:
+        srv.close()

@@ -1,0 +1,141 @@
+"""Interactive LogsQL REPL (reference: app/vlogscli).
+
+Talks to /select/logsql/query; output modes json / logfmt / compact;
+`\\tail <query>` live-tails; readline history in ~/.vlogscli-history.
+
+Usage:
+  python -m victorialogs_tpu.cli.vlogscli -datasource.url \
+      http://127.0.0.1:9428 [-accountID N] [-projectID N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+HELP = """\
+Commands:
+  <LogsQL query>        run a query (default limit 10)
+  \\m json|logfmt|compact  set output mode
+  \\limit N              set the default limit
+  \\tail <query>         live-tail a query (Ctrl-C to stop)
+  \\h                    this help
+  \\q                    quit
+"""
+
+
+class Client:
+    def __init__(self, base_url: str, account_id: int = 0,
+                 project_id: int = 0, timeout: float = 60.0):
+        self.base = base_url.rstrip("/")
+        self.headers = {"AccountID": str(account_id),
+                        "ProjectID": str(project_id)}
+        self.timeout = timeout
+
+    def query(self, q: str, limit: int = 10):
+        url = (f"{self.base}/select/logsql/query?"
+               f"query={urllib.parse.quote(q)}&limit={limit}")
+        req = urllib.request.Request(url, headers=self.headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+    def tail(self, q: str):
+        url = (f"{self.base}/select/logsql/tail?"
+               f"query={urllib.parse.quote(q)}")
+        req = urllib.request.Request(url, headers=self.headers)
+        with urllib.request.urlopen(req, timeout=3600) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+
+def render(row: dict, mode: str) -> str:
+    if mode == "json":
+        return json.dumps(row, ensure_ascii=False)
+    if mode == "logfmt":
+        return " ".join(f"{k}={json.dumps(v, ensure_ascii=False)}"
+                        for k, v in row.items())
+    # compact: _time + _msg
+    return f"{row.get('_time', '')} {row.get('_msg', '')}".strip()
+
+
+def repl(client: Client) -> int:
+    try:
+        import readline  # noqa: F401 - side effect: line editing
+        import os
+        hist = os.path.expanduser("~/.vlogscli-history")
+        try:
+            readline.read_history_file(hist)
+        except OSError:
+            pass
+        import atexit
+        atexit.register(lambda: readline.write_history_file(hist))
+    except ImportError:
+        pass
+    mode = "json"
+    limit = 10
+    print("victorialogs-tpu interactive shell; \\h for help")
+    while True:
+        try:
+            line = input(";> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in ("\\q", "q", "quit", "exit"):
+            return 0
+        if line == "\\h":
+            print(HELP)
+            continue
+        if line.startswith("\\m "):
+            m = line[3:].strip()
+            if m in ("json", "logfmt", "compact"):
+                mode = m
+            else:
+                print("unknown mode; want json|logfmt|compact")
+            continue
+        if line.startswith("\\limit "):
+            try:
+                limit = int(line[7:])
+            except ValueError:
+                print("invalid limit")
+            continue
+        if line.startswith("\\tail "):
+            try:
+                for row in client.tail(line[6:]):
+                    print(render(row, mode))
+            except KeyboardInterrupt:
+                print()
+            except Exception as e:
+                print(f"error: {e}")
+            continue
+        try:
+            n = 0
+            for row in client.query(line, limit=limit):
+                print(render(row, mode))
+                n += 1
+            print(f"-- {n} rows")
+        except Exception as e:
+            print(f"error: {e}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="vlogscli", prefix_chars="-")
+    p.add_argument("-datasource.url", dest="url",
+                   default="http://127.0.0.1:9428")
+    p.add_argument("-accountID", type=int, default=0)
+    p.add_argument("-projectID", type=int, default=0)
+    args = p.parse_args(argv)
+    return repl(Client(args.url, args.accountID, args.projectID))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Benchmark load generator (reference: app/vlogsgenerator).
+
+Emits synthetic log streams with a configurable mix of typed fields
+(const/var/dict/uint/float/ip/timestamp/json — main.go:24-60) to stdout or
+an ingest URL, reporting the achieved rate.
+
+Usage:
+  python -m victorialogs_tpu.cli.vlogsgenerator -logsPerStream 1000 \
+      -streams 8 -addr http://127.0.0.1:9428 [-start ...] [-end ...]
+  python -m victorialogs_tpu.cli.vlogsgenerator -out - > logs.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+import urllib.request
+
+
+WORDS = ["error", "warn", "info", "request", "response", "timeout",
+         "connected", "closed", "retry", "flush", "compact", "merge",
+         "alloc", "free", "login", "logout", "GET", "POST", "PUT"]
+
+
+def gen_row(args, stream_id: int, seq: int, ts_ns: int) -> dict:
+    rnd = random.Random((stream_id << 32) | seq)
+    row = {
+        "_time": ts_ns,
+        "_msg": " ".join(rnd.choice(WORDS)
+                         for _ in range(args.wordsPerMsg)),
+        "stream_id": f"stream_{stream_id}",
+        "host": f"host-{stream_id % args.hosts}",
+    }
+    for i in range(args.constFieldsPerLog):
+        row[f"const_{i}"] = f"const_value_{i}"
+    for i in range(args.varFieldsPerLog):
+        row[f"var_{i}"] = str(rnd.randrange(1 << 30))
+    for i in range(args.dictFieldsPerLog):
+        row[f"dict_{i}"] = rnd.choice(("red", "green", "blue", "yellow"))
+    for i in range(args.u8FieldsPerLog):
+        row[f"u8_{i}"] = rnd.randrange(256)
+    for i in range(args.floatFieldsPerLog):
+        row[f"float_{i}"] = round(rnd.random() * 100, 3)
+    for i in range(args.ipFieldsPerLog):
+        row[f"ip_{i}"] = f"10.{rnd.randrange(256)}.{rnd.randrange(256)}." \
+                         f"{rnd.randrange(256)}"
+    for i in range(args.timestampFieldsPerLog):
+        row[f"timestamp_{i}"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts_ns / 1e9))
+    for i in range(args.jsonFieldsPerLog):
+        row[f"json_{i}"] = {"k": rnd.choice(WORDS),
+                            "n": rnd.randrange(100)}
+    return row
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="vlogsgenerator", prefix_chars="-")
+    p.add_argument("-addr", default="",
+                   help="ingest URL base (http://host:port); '-' or empty "
+                        "writes ndjson to stdout")
+    p.add_argument("-streams", type=int, default=8)
+    p.add_argument("-logsPerStream", type=int, default=1000)
+    p.add_argument("-wordsPerMsg", type=int, default=8)
+    p.add_argument("-hosts", type=int, default=4)
+    p.add_argument("-constFieldsPerLog", type=int, default=1)
+    p.add_argument("-varFieldsPerLog", type=int, default=1)
+    p.add_argument("-dictFieldsPerLog", type=int, default=1)
+    p.add_argument("-u8FieldsPerLog", type=int, default=1)
+    p.add_argument("-floatFieldsPerLog", type=int, default=1)
+    p.add_argument("-ipFieldsPerLog", type=int, default=1)
+    p.add_argument("-timestampFieldsPerLog", type=int, default=0)
+    p.add_argument("-jsonFieldsPerLog", type=int, default=0)
+    p.add_argument("-start", default="", help="start ts (ns or RFC3339)")
+    p.add_argument("-end", default="", help="end ts (ns or RFC3339)")
+    p.add_argument("-batchSize", type=int, default=10_000)
+    args = p.parse_args(argv)
+
+    from ..engine.block_result import parse_rfc3339
+    end_ns = parse_rfc3339(args.end) if args.end else time.time_ns()
+    start_ns = parse_rfc3339(args.start) if args.start else \
+        end_ns - 3600 * 1_000_000_000
+    total = args.streams * args.logsPerStream
+    span = max(end_ns - start_ns, 1)
+
+    t0 = time.time()
+    emitted = 0
+    batch: list[str] = []
+
+    def flush_batch():
+        nonlocal batch
+        if not batch:
+            return
+        data = ("\n".join(batch)).encode()
+        if args.addr and args.addr != "-":
+            req = urllib.request.Request(
+                args.addr.rstrip("/") +
+                "/insert/jsonline?_stream_fields=stream_id",
+                data=data, method="POST")
+            urllib.request.urlopen(req, timeout=60).read()
+        else:
+            sys.stdout.write("\n".join(batch) + "\n")
+        batch = []
+
+    for seq in range(args.logsPerStream):
+        for sid in range(args.streams):
+            ts = start_ns + span * (seq * args.streams + sid) // total
+            batch.append(json.dumps(gen_row(args, sid, seq, ts),
+                                    separators=(",", ":")))
+            emitted += 1
+            if len(batch) >= args.batchSize:
+                flush_batch()
+    flush_batch()
+    dt = time.time() - t0
+    print(f"emitted {emitted} rows in {dt:.2f}s "
+          f"({emitted / max(dt, 1e-9):.0f} rows/s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

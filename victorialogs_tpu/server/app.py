@@ -1,0 +1,342 @@
+"""The victoria-logs single binary: HTTP server wiring insert + select +
+storage.
+
+Reference: app/victoria-logs/main.go (request routing insert->select->storage
+— main.go:79-103), app/vlinsert/main.go:61-89 (ingest routes),
+app/vlselect/main.go:212-274 (query routes), app/vlstorage/main.go:208-255
+(/internal/force_merge, /internal/force_flush) and the /metrics surface
+(main.go:354-410).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import zstandard
+
+from ..storage.storage import Storage
+from .insertutil import (CommonParams, LocalLogRowsStorage,
+                         LogMessageProcessor)
+from . import vlinsert
+from .vlselect import (HTTPError, handle_facets, handle_field_names,
+                       handle_field_values, handle_hits, handle_query,
+                       handle_stats_query, handle_stats_query_range,
+                       handle_stream_field_names, handle_stream_field_values,
+                       handle_stream_ids, handle_streams, handle_tail)
+
+
+class Metrics:
+    """Tiny Prometheus-text metrics registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def render(self, storage: Storage) -> str:
+        out = []
+        with self._lock:
+            for name in sorted(self.counters):
+                out.append(f"{name} {self.counters[name]}")
+        s = storage.update_stats()
+        gauges = {
+            "vl_partitions": s["partitions"],
+            "vl_streams_created_total": s["streams"],
+            "vl_storage_rows{type=\"inmemory\"}": s["inmemory_rows"],
+            "vl_storage_rows{type=\"file\"}": s["file_rows"],
+            "vl_storage_parts{type=\"inmemory\"}": s["inmemory_parts"],
+            "vl_storage_parts{type=\"small\"}": s["small_parts"],
+            "vl_storage_parts{type=\"big\"}": s["big_parts"],
+            "vl_data_size_bytes": s["compressed_size"],
+            "vl_uncompressed_data_size_bytes": s["uncompressed_size"],
+            "vl_rows_dropped_total{reason=\"too_old\"}":
+                s["rows_dropped_too_old"],
+            "vl_rows_dropped_total{reason=\"too_new\"}":
+                s["rows_dropped_too_new"],
+            "vl_storage_is_read_only": int(s["is_read_only"]),
+        }
+        for name, v in gauges.items():
+            out.append(f"{name} {v}")
+        return "\n".join(out) + "\n"
+
+
+class VLServer:
+    """Single-binary server instance (storage + HTTP)."""
+
+    def __init__(self, storage: Storage, listen_addr: str = "127.0.0.1",
+                 port: int = 0, runner=None, max_concurrent: int = 8):
+        self.storage = storage
+        self.sink = LocalLogRowsStorage(storage)
+        self.metrics = Metrics()
+        self.runner = runner
+        self.start_time = time.time()
+        self._sem = threading.Semaphore(max_concurrent)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args_):
+                pass
+
+            def do_GET(self):
+                outer.dispatch(self, b"")
+
+            def do_HEAD(self):
+                outer.dispatch(self, b"")
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(ln) if ln else b""
+                enc = (self.headers.get("Content-Encoding") or "").lower()
+                try:
+                    if enc == "gzip":
+                        body = gzip.decompress(body)
+                    elif enc == "zstd":
+                        body = zstandard.ZstdDecompressor().decompress(
+                            body, max_output_size=1 << 30)
+                    elif enc == "deflate":
+                        import zlib
+                        body = zlib.decompress(body)
+                    elif enc == "snappy":
+                        pass  # loki protobuf handles snappy itself
+                except Exception:
+                    outer.respond(self, 400, "text/plain",
+                                  b"cannot decompress request body")
+                    return
+                outer.dispatch(self, body)
+
+            do_PUT = do_POST
+            do_DELETE = do_GET
+
+        self.httpd = ThreadingHTTPServer((listen_addr, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # ---- helpers ----
+    def respond(self, h, status: int, ctype: str, body: bytes) -> None:
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            if h.command != "HEAD":
+                h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def respond_json(self, h, obj, status: int = 200) -> None:
+        self.respond(h, status, "application/json",
+                     json.dumps(obj, ensure_ascii=False).encode("utf-8"))
+
+    def respond_stream(self, h, gen, ctype="application/x-ndjson") -> None:
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            for chunk in gen:
+                if not chunk:
+                    continue
+                data = chunk.encode("utf-8") if isinstance(chunk, str) \
+                    else chunk
+                h.wfile.write(f"{len(data):x}\r\n".encode())
+                h.wfile.write(data)
+                h.wfile.write(b"\r\n")
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ---- routing ----
+    def dispatch(self, h, body: bytes) -> None:
+        parsed = urllib.parse.urlparse(h.path)
+        path = parsed.path
+        args = {k: v[0] for k, v in
+                urllib.parse.parse_qs(parsed.query).items()}
+        ctype = (h.headers.get("Content-Type") or "").split(";")[0].strip()
+        if h.command == "POST" and ctype in (
+                "application/x-www-form-urlencoded",):
+            for k, v in urllib.parse.parse_qs(
+                    body.decode("utf-8", "replace")).items():
+                args.setdefault(k, v[0])
+        try:
+            self.route(h, path, args, body, ctype)
+        except HTTPError as e:
+            self.metrics.inc("vl_http_errors_total")
+            self.respond(h, e.status, "text/plain",
+                         e.message.encode("utf-8"))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # pragma: no cover
+            import traceback
+            traceback.print_exc()
+            self.metrics.inc("vl_http_errors_total")
+            self.respond(h, 500, "text/plain", str(e).encode("utf-8"))
+
+    def route(self, h, path, args, body, ctype) -> None:
+        m = self.metrics
+        headers = h.headers
+        # ---- health / misc ----
+        if path in ("/health", "/-/healthy", "/ping", "/insert/ready"):
+            self.respond(h, 200, "text/plain", b"OK")
+            return
+        if path == "/metrics":
+            self.respond(h, 200, "text/plain",
+                         m.render(self.storage).encode())
+            return
+        if path == "/":
+            self.respond_json(h, {
+                "app": "victorialogs-tpu",
+                "uptime_seconds": round(time.time() - self.start_time, 1)})
+            return
+
+        # ---- ingestion ----
+        if path.startswith("/insert/"):
+            self.handle_insert(h, path, args, body, ctype)
+            return
+
+        # ---- queries (concurrency-gated; reference main.go:34-46) ----
+        if path.startswith("/select/"):
+            if not self._sem.acquire(timeout=30):
+                raise HTTPError(429, "too many concurrent queries")
+            try:
+                self.handle_select(h, path, args, headers)
+            finally:
+                self._sem.release()
+            return
+
+        # ---- storage maintenance ----
+        if path == "/internal/force_merge":
+            self.storage.must_force_merge(args.get("partition_prefix", ""))
+            self.respond(h, 200, "text/plain", b"OK")
+            return
+        if path == "/internal/force_flush":
+            self.storage.debug_flush()
+            self.respond(h, 200, "text/plain", b"OK")
+            return
+
+        self.respond(h, 404, "text/plain",
+                     f"unknown path {path}".encode())
+
+    def handle_insert(self, h, path, args, body, ctype) -> None:
+        m = self.metrics
+        cp = CommonParams.from_request(h.headers, args)
+        lmp = LogMessageProcessor(cp, self.sink)
+        try:
+            if path == "/insert/jsonline":
+                n = vlinsert.handle_jsonline(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"jsonline\"}", n)
+            elif path.endswith("/_bulk"):
+                n, resp = vlinsert.handle_elasticsearch_bulk(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"elasticsearch\"}", n)
+                lmp.flush()
+                self.respond_json(h, resp)
+                return
+            elif path == "/insert/loki/api/v1/push":
+                if ctype == "application/x-protobuf" or \
+                        (body[:1] != b"{" and ctype != "application/json"):
+                    n = vlinsert.handle_loki_protobuf(cp, body, lmp)
+                else:
+                    n = vlinsert.handle_loki_json(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"loki\"}", n)
+                lmp.flush()
+                self.respond(h, 204, "text/plain", b"")
+                return
+            elif path == "/insert/opentelemetry/v1/logs":
+                if ctype == "application/json":
+                    n = vlinsert.handle_otlp_json(cp, body, lmp)
+                else:
+                    n = vlinsert.handle_otlp_protobuf(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"opentelemetry\"}", n)
+                lmp.flush()
+                self.respond_json(h, {"partialSuccess": {}})
+                return
+            elif path in ("/insert/datadog/api/v2/logs",
+                          "/insert/datadog/api/v1/input"):
+                obj = json.loads(body) if body[:1] not in (b"[", b"{") \
+                    else None
+                n = vlinsert.handle_datadog(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"datadog\"}", n)
+                lmp.flush()
+                self.respond_json(h, {})
+                return
+            elif path == "/insert/journald/upload":
+                n = vlinsert.handle_journald(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"journald\"}", n)
+            elif path.startswith("/insert/elasticsearch"):
+                # ES-compat discovery endpoints
+                self.respond_json(h, {"version": {"number": "8.9.0"}})
+                return
+            else:
+                raise HTTPError(404, f"unknown insert path {path}")
+        except vlinsert.IngestError as e:
+            raise HTTPError(400, str(e))
+        lmp.flush()
+        self.respond_json(h, {"status": "ok", "ingested": n})
+
+    def handle_select(self, h, path, args, headers) -> None:
+        s = self.storage
+        m = self.metrics
+        m.inc("vl_http_requests_total{path=\"" + path + "\"}")
+        t0 = time.time()
+        if path == "/select/logsql/query":
+            gen = handle_query(s, args, headers, runner=self.runner)
+            self.respond_stream(h, gen)
+        elif path == "/select/logsql/hits":
+            self.respond_json(h, handle_hits(s, args, headers,
+                                             runner=self.runner))
+        elif path == "/select/logsql/facets":
+            self.respond_json(h, handle_facets(s, args, headers,
+                                               runner=self.runner))
+        elif path == "/select/logsql/field_names":
+            self.respond_json(h, handle_field_names(s, args, headers))
+        elif path == "/select/logsql/field_values":
+            self.respond_json(h, handle_field_values(s, args, headers))
+        elif path == "/select/logsql/streams":
+            self.respond_json(h, handle_streams(s, args, headers))
+        elif path == "/select/logsql/stream_ids":
+            self.respond_json(h, handle_stream_ids(s, args, headers))
+        elif path == "/select/logsql/stream_field_names":
+            self.respond_json(h, handle_stream_field_names(s, args,
+                                                           headers))
+        elif path == "/select/logsql/stream_field_values":
+            self.respond_json(h, handle_stream_field_values(s, args,
+                                                            headers))
+        elif path == "/select/logsql/stats_query":
+            self.respond_json(h, handle_stats_query(s, args, headers,
+                                                    runner=self.runner))
+        elif path == "/select/logsql/stats_query_range":
+            self.respond_json(h, handle_stats_query_range(
+                s, args, headers, runner=self.runner))
+        elif path == "/select/logsql/tail":
+            stop = {"flag": False}
+
+            def stop_check():
+                return stop["flag"]
+            gen = handle_tail(s, args, headers, stop_check=stop_check,
+                              runner=self.runner)
+            try:
+                self.respond_stream(h, gen)
+            finally:
+                stop["flag"] = True
+        else:
+            raise HTTPError(404, f"unknown select path {path}")
+        m.inc("vl_http_request_duration_ms_total{path=\"" + path + "\"}",
+              int((time.time() - t0) * 1000))
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

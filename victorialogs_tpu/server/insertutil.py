@@ -1,0 +1,223 @@
+"""Shared ingestion plumbing: per-request params, row batching, timestamps.
+
+Reference: app/vlinsert/insertutil — CommonParams extracted from headers/query
+args (_time_field, _msg_field, _stream_fields, ignore_fields, extra_fields,
+debug — common_params.go:30-100), tenant from AccountID/ProjectID headers
+(tenant_id parsing — common_params.go:48), and LogMessageProcessor batching
+rows with a 1s periodic flush + size-triggered flush (common_params.go:199-255).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+
+from ..engine.block_result import parse_rfc3339
+from ..storage.log_rows import LogRows, TenantID
+
+MAX_BATCH_ROWS = 100_000
+MAX_BATCH_BYTES = 50 << 20
+FLUSH_INTERVAL = 1.0
+
+
+def get_tenant_id(headers, args) -> TenantID:
+    """Tenant from AccountID/ProjectID headers or query args."""
+    acc = headers.get("AccountID") or args.get("AccountID") or "0"
+    proj = headers.get("ProjectID") or args.get("ProjectID") or "0"
+    try:
+        return TenantID(int(acc), int(proj))
+    except ValueError:
+        return TenantID()
+
+
+def _csv(s: str | None) -> list[str]:
+    if not s:
+        return []
+    return [x.strip() for x in s.split(",") if x.strip()]
+
+
+@dataclass
+class CommonParams:
+    tenant: TenantID = dc_field(default_factory=TenantID)
+    time_field: str = "_time"
+    msg_fields: list = dc_field(default_factory=lambda: ["_msg"])
+    stream_fields: list = dc_field(default_factory=list)
+    ignore_fields: list = dc_field(default_factory=list)
+    decolorize_fields: list = dc_field(default_factory=list)
+    extra_fields: list = dc_field(default_factory=list)
+    default_msg_value: str = ""
+    debug: bool = False
+
+    @staticmethod
+    def from_request(headers, args) -> "CommonParams":
+        def hv(name, hdr):
+            return args.get(name) or headers.get(hdr) or ""
+        cp = CommonParams()
+        cp.tenant = get_tenant_id(headers, args)
+        cp.time_field = hv("_time_field", "VL-Time-Field") or "_time"
+        msg = _csv(hv("_msg_field", "VL-Msg-Field"))
+        if msg:
+            cp.msg_fields = msg
+        cp.stream_fields = _csv(hv("_stream_fields", "VL-Stream-Fields"))
+        cp.ignore_fields = _csv(hv("ignore_fields", "VL-Ignore-Fields"))
+        cp.decolorize_fields = _csv(hv("decolorize_fields",
+                                       "VL-Decolorize-Fields"))
+        extra = _csv(hv("extra_fields", "VL-Extra-Fields"))
+        cp.extra_fields = []
+        for ef in extra:
+            if "=" in ef:
+                k, v = ef.split("=", 1)
+                cp.extra_fields.append((k, v))
+        cp.default_msg_value = args.get("default_msg_value") or ""
+        cp.debug = (hv("debug", "VL-Debug").lower() in ("1", "true", "y"))
+        return cp
+
+
+def parse_timestamp(v, default_ns: int | None = None) -> int | None:
+    """Parse a log timestamp: RFC3339 string, unix secs/millis/micros/nanos.
+
+    Follows the reference's unit inference by magnitude
+    (app/vlinsert/insertutil/timestamp.go).
+    """
+    if v is None or v == "" or v == 0:
+        return default_ns if default_ns is not None else time.time_ns()
+    if isinstance(v, str):
+        ts = parse_rfc3339(v)
+        if ts is not None:
+            return ts
+        try:
+            v = float(v) if ("." in v or "e" in v or "E" in v) else int(v)
+        except ValueError:
+            return None
+    if isinstance(v, float):
+        # floats are unix seconds with fraction
+        return int(v * 1e9)
+    if isinstance(v, int):
+        if v < (1 << 32):           # seconds until year 2106
+            return v * 1_000_000_000
+        if v < (1 << 32) * 1_000:   # millis
+            return v * 1_000_000
+        if v < (1 << 32) * 1_000_000:
+            return v * 1_000
+        return v
+    return None
+
+
+_ANSI_CSI = "\x1b["
+
+
+def decolorize(s: str) -> str:
+    """Strip ANSI color/escape sequences (reference decolorize rules)."""
+    if _ANSI_CSI not in s:
+        return s
+    out = []
+    i, n = 0, len(s)
+    while i < n:
+        if s[i] == "\x1b" and i + 1 < n and s[i + 1] == "[":
+            i += 2
+            while i < n and not ("@" <= s[i] <= "~"):
+                i += 1
+            i += 1  # final byte
+            continue
+        out.append(s[i])
+        i += 1
+    return "".join(out)
+
+
+class LogRowsStorage:
+    """Destination indirection so vlinsert can feed either the local
+    Storage or a remote forwarder (reference insertutil.LogRowsStorage —
+    common_params.go:150-170)."""
+
+    def must_add_rows(self, lr: LogRows) -> None:
+        raise NotImplementedError
+
+
+class LocalLogRowsStorage(LogRowsStorage):
+    def __init__(self, storage):
+        self.storage = storage
+
+    def must_add_rows(self, lr: LogRows) -> None:
+        self.storage.must_add_rows(lr)
+
+
+class LogMessageProcessor:
+    """Accumulates rows, flushing on size or (for long-lived processors
+    like the syslog listeners) a periodic 1s timer — reference
+    common_params.go:199-223."""
+
+    def __init__(self, cp: CommonParams, sink: LogRowsStorage,
+                 periodic_flush: bool = False):
+        self.cp = cp
+        self.sink = sink
+        self.lr = LogRows(stream_fields=list(cp.stream_fields),
+                          ignore_fields=list(cp.ignore_fields),
+                          extra_fields=list(cp.extra_fields),
+                          default_msg_value=cp.default_msg_value)
+        self.bytes = 0
+        self.rows_total = 0
+        self._lock = threading.Lock()
+        self._stop = None
+        if periodic_flush:
+            self._stop = threading.Event()
+            t = threading.Thread(target=self._flush_loop, daemon=True)
+            t.start()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(FLUSH_INTERVAL):
+            try:
+                self.flush()
+            except Exception:  # pragma: no cover - keep the flusher alive
+                pass
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        self.flush()
+
+    def add_row(self, ts_ns: int | None, fields: list[tuple[str, str]],
+                stream_fields: list[tuple[str, str]] | None = None) -> None:
+        if ts_ns is None:
+            ts_ns = time.time_ns()
+        if self.cp.decolorize_fields:
+            fields = [(k, decolorize(v))
+                      if _match_any(k, self.cp.decolorize_fields) else (k, v)
+                      for k, v in fields]
+        if stream_fields is not None:
+            # protocol-level stream labels (loki/datadog): prepend them and
+            # scope the batch's stream fields accordingly
+            names = [k for k, _ in stream_fields]
+            self.lr.stream_fields = names
+            fields = list(stream_fields) + \
+                [f for f in fields if f[0] not in names]
+        with self._lock:
+            self.lr.add(self.cp.tenant, ts_ns, fields)
+            self.rows_total += 1
+            self.bytes += sum(len(k) + len(v) for k, v in fields)
+            if len(self.lr) >= MAX_BATCH_ROWS or \
+                    self.bytes >= MAX_BATCH_BYTES:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if len(self.lr):
+            self.sink.must_add_rows(self.lr)
+            self.lr = LogRows(stream_fields=list(self.lr.stream_fields),
+                              ignore_fields=list(self.cp.ignore_fields),
+                              extra_fields=list(self.cp.extra_fields),
+                              default_msg_value=self.cp.default_msg_value)
+            self.bytes = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+
+def _match_any(name: str, patterns: list[str]) -> bool:
+    for p in patterns:
+        if p.endswith("*"):
+            if name.startswith(p[:-1]):
+                return True
+        elif name == p:
+            return True
+    return False

@@ -1,0 +1,222 @@
+"""Syslog ingestion: RFC3164 / RFC5424 parsing + TCP/UDP listeners.
+
+Reference: app/vlinsert/syslog (listeners with TLS/timezone/year-inference
+flags — syslog.go:94-160) and lib/logstorage/syslog_parser.go for field
+extraction: priority/facility/severity, timestamp, hostname, app_name,
+proc_id, msg_id, structured data, message.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+import socket
+import socketserver
+import threading
+import time
+
+from ..engine.block_result import parse_rfc3339
+from .insertutil import CommonParams, LogMessageProcessor
+
+_RFC3164_RE = re.compile(
+    r"^(?P<mon>[A-Z][a-z]{2}) +(?P<day>\d{1,2}) "
+    r"(?P<time>\d{2}:\d{2}:\d{2}) (?P<host>\S+) (?P<rest>.*)$", re.DOTALL)
+_TAG_RE = re.compile(r"^(?P<tag>[^\s:\[\]]+)(?:\[(?P<pid>\d+)\])?: ?")
+
+_MONTHS = {m: i + 1 for i, m in enumerate(
+    ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug", "Sep", "Oct",
+     "Nov", "Dec"])}
+
+_SEVERITIES = ["emerg", "alert", "crit", "err", "warning", "notice", "info",
+               "debug"]
+
+
+def parse_syslog_message(line: str, current_year: int | None = None,
+                         tz_offset_ns: int = 0) -> list[tuple[str, str]]:
+    """Parse one syslog line into log fields (format auto-detected)."""
+    fields: list[tuple[str, str]] = []
+    pri = None
+    if line.startswith("<"):
+        end = line.find(">")
+        if 0 < end <= 4 and line[1:end].isdigit():
+            pri = int(line[1:end])
+            line = line[end + 1:]
+    if pri is not None:
+        fields.append(("priority", str(pri)))
+        fields.append(("facility", str(pri // 8)))
+        sev = pri % 8
+        fields.append(("severity", str(sev)))
+        fields.append(("level", _SEVERITIES[sev]))
+
+    if line.startswith("1 "):
+        fields.extend(_parse_rfc5424(line[2:]))
+        fields.append(("format", "rfc5424"))
+        return fields
+
+    m = _RFC3164_RE.match(line)
+    if m is not None:
+        mon = _MONTHS.get(m.group("mon"))
+        if mon is not None:
+            year = current_year or time.gmtime().tm_year
+            hh, mm, ss = m.group("time").split(":")
+            try:
+                dt = datetime.datetime(year, mon, int(m.group("day")),
+                                       int(hh), int(mm), int(ss),
+                                       tzinfo=datetime.timezone.utc)
+                ts = int(dt.timestamp()) * 1_000_000_000 - tz_offset_ns
+                # year inference: timestamps far in the future belong to
+                # the previous year (reference year-inference logic)
+                if ts > time.time_ns() + 2 * 86400 * 1_000_000_000:
+                    dt = dt.replace(year=year - 1)
+                    ts = int(dt.timestamp()) * 1_000_000_000 - tz_offset_ns
+                fields.append(("timestamp",
+                               dt.strftime("%Y-%m-%dT%H:%M:%SZ")))
+            except ValueError:
+                pass
+            fields.append(("hostname", m.group("host")))
+            rest = m.group("rest")
+            tm = _TAG_RE.match(rest)
+            if tm is not None:
+                fields.append(("app_name", tm.group("tag")))
+                if tm.group("pid"):
+                    fields.append(("proc_id", tm.group("pid")))
+                rest = rest[tm.end():]
+            fields.append(("_msg", rest))
+            fields.append(("format", "rfc3164"))
+            return fields
+
+    fields.append(("_msg", line))
+    fields.append(("format", "unknown"))
+    return fields
+
+
+def _parse_rfc5424(rest: str) -> list[tuple[str, str]]:
+    fields: list[tuple[str, str]] = []
+    parts = rest.split(" ", 5)
+    if len(parts) < 6:
+        parts += ["-"] * (6 - len(parts))
+    ts_s, host, app, procid, msgid, tail = parts
+    if ts_s != "-":
+        fields.append(("timestamp", ts_s))
+    if host != "-":
+        fields.append(("hostname", host))
+    if app != "-":
+        fields.append(("app_name", app))
+    if procid != "-":
+        fields.append(("proc_id", procid))
+    if msgid != "-":
+        fields.append(("msg_id", msgid))
+    # structured data
+    tail = tail.lstrip()
+    if tail.startswith("["):
+        i = 0
+        while i < len(tail) and tail[i] == "[":
+            end = _sd_end(tail, i)
+            if end < 0:
+                break
+            sd = tail[i + 1:end]
+            fields.extend(_parse_sd_element(sd))
+            i = end + 1
+            while i < len(tail) and tail[i] == " ":
+                i += 1
+                break
+        tail = tail[i:].lstrip()
+    elif tail.startswith("- "):
+        tail = tail[2:]
+    elif tail == "-":
+        tail = ""
+    fields.append(("_msg", tail))
+    return fields
+
+
+def _sd_end(s: str, start: int) -> int:
+    i = start + 1
+    in_quote = False
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and in_quote:
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+        elif c == "]" and not in_quote:
+            return i
+        i += 1
+    return -1
+
+
+def _parse_sd_element(sd: str) -> list[tuple[str, str]]:
+    out = []
+    parts = sd.split(" ", 1)
+    sd_id = parts[0]
+    rest = parts[1] if len(parts) > 1 else ""
+    for m in re.finditer(r'(\S+?)="((?:[^"\\]|\\.)*)"', rest):
+        out.append((f"{sd_id}.{m.group(1)}",
+                    m.group(2).replace('\\"', '"').replace("\\\\", "\\")))
+    return out
+
+
+def _ts_of(fields: list[tuple[str, str]]):
+    for k, v in fields:
+        if k == "timestamp":
+            return parse_rfc3339(v)
+    return None
+
+
+class SyslogServer:
+    """TCP + UDP syslog listeners feeding a LogMessageProcessor."""
+
+    def __init__(self, sink, tenant=None, listen_addr: str = "127.0.0.1",
+                 tcp_port: int = 0, udp_port: int = 0):
+        from ..storage.log_rows import TenantID
+        cp = CommonParams(tenant=tenant or TenantID(),
+                          stream_fields=["hostname", "app_name"])
+        self.lmp = LogMessageProcessor(cp, sink, periodic_flush=True)
+        self.tcp_port = self.udp_port = 0
+        self._tcp = self._udp = None
+        outer = self
+
+        if tcp_port >= 0:
+            class Handler(socketserver.StreamRequestHandler):
+                def handle(self):
+                    for raw in self.rfile:
+                        line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                        if line:
+                            outer.ingest_line(line)
+            self._tcp = socketserver.ThreadingTCPServer(
+                (listen_addr, tcp_port), Handler, bind_and_activate=True)
+            self._tcp.daemon_threads = True
+            self.tcp_port = self._tcp.server_address[1]
+            threading.Thread(target=self._tcp.serve_forever,
+                             daemon=True).start()
+
+        if udp_port >= 0:
+            class UHandler(socketserver.DatagramRequestHandler):
+                def handle(self):
+                    data = self.rfile.read()
+                    for raw in data.split(b"\n"):
+                        line = raw.decode("utf-8", "replace").strip()
+                        if line:
+                            outer.ingest_line(line)
+            self._udp = socketserver.ThreadingUDPServer(
+                (listen_addr, udp_port), UHandler)
+            self._udp.daemon_threads = True
+            self.udp_port = self._udp.server_address[1]
+            threading.Thread(target=self._udp.serve_forever,
+                             daemon=True).start()
+
+    def ingest_line(self, line: str) -> None:
+        fields = parse_syslog_message(line)
+        self.lmp.add_row(_ts_of(fields), fields)
+
+    def flush(self) -> None:
+        self.lmp.flush()
+
+    def close(self) -> None:
+        if self._tcp:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+        if self._udp:
+            self._udp.shutdown()
+            self._udp.server_close()
+        self.lmp.stop()

@@ -1,0 +1,452 @@
+"""Ingestion protocol parsers: jsonline, Elasticsearch bulk, Loki, OTLP,
+Datadog, journald.
+
+Reference: app/vlinsert/* — each protocol is a parser feeding rows into a
+LogMessageProcessor (SURVEY.md §2.4).  Syslog lives in syslog.py (it owns
+TCP/UDP listeners).  All parsers return the number of ingested rows.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..utils import protobuf as pb
+from ..utils.snappy import SnappyError, decompress as snappy_decompress
+from .insertutil import CommonParams, LogMessageProcessor, parse_timestamp
+
+
+class IngestError(ValueError):
+    pass
+
+
+def _fields_from_json_obj(obj: dict, prefix: str = "") -> list:
+    """Flatten a JSON object into (name, value) string fields the way the
+    reference does (nested objects dot-joined, arrays/bools/numbers
+    stringified — lib/logstorage/json_parser.go)."""
+    out = []
+    for k, v in obj.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, str):
+            out.append((name, v))
+        elif isinstance(v, bool):
+            out.append((name, "true" if v else "false"))
+        elif isinstance(v, (int, float)):
+            out.append((name, json.dumps(v)))
+        elif v is None:
+            continue
+        elif isinstance(v, dict):
+            out.extend(_fields_from_json_obj(v, prefix=f"{name}."))
+        else:  # arrays stay JSON-encoded
+            out.append((name, json.dumps(v, separators=(",", ":"))))
+    return out
+
+
+def _pop_time(cp: CommonParams, fields: list) -> tuple[int | None, list]:
+    ts = None
+    rest = []
+    for k, v in fields:
+        if k == cp.time_field and ts is None:
+            ts = parse_timestamp(v)
+        else:
+            rest.append((k, v))
+    return ts, rest
+
+
+def _rename_msg(cp: CommonParams, fields: list) -> list:
+    """First matching msg field becomes _msg."""
+    for mf in cp.msg_fields:
+        if mf == "_msg":
+            return fields
+        for i, (k, v) in enumerate(fields):
+            if k == mf:
+                out = [f for j, f in enumerate(fields) if j != i
+                       and f[0] != "_msg"]
+                out.append(("_msg", v))
+                return out
+    return fields
+
+
+# ---------------- jsonline ----------------
+
+def handle_jsonline(cp: CommonParams, body: bytes,
+                    lmp: LogMessageProcessor) -> int:
+    n = 0
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise IngestError(f"cannot parse JSON line: {e}") from None
+        if not isinstance(obj, dict):
+            raise IngestError("JSON line must be an object")
+        fields = _fields_from_json_obj(obj)
+        ts, fields = _pop_time(cp, fields)
+        fields = _rename_msg(cp, fields)
+        lmp.add_row(ts, fields)
+        n += 1
+    return n
+
+
+# ---------------- elasticsearch bulk ----------------
+
+def handle_elasticsearch_bulk(cp: CommonParams, body: bytes,
+                              lmp: LogMessageProcessor) -> tuple[int, dict]:
+    lines = body.split(b"\n")
+    n = 0
+    i = 0
+    while i < len(lines):
+        action_line = lines[i].strip()
+        i += 1
+        if not action_line:
+            continue
+        try:
+            action = json.loads(action_line)
+        except json.JSONDecodeError:
+            raise IngestError("invalid bulk action line") from None
+        op = next(iter(action), "")
+        if op not in ("create", "index"):
+            continue  # delete/update are ignored for logs
+        if i >= len(lines):
+            break
+        doc_line = lines[i].strip()
+        i += 1
+        if not doc_line:
+            continue
+        try:
+            obj = json.loads(doc_line)
+        except json.JSONDecodeError:
+            raise IngestError("invalid bulk document line") from None
+        fields = _fields_from_json_obj(obj)
+        # ES convention: @timestamp, message
+        ts = None
+        rest = []
+        for k, v in fields:
+            if ts is None and k in ("@timestamp", "timestamp",
+                                    cp.time_field):
+                ts = parse_timestamp(v)
+            else:
+                rest.append((k, v))
+        out = []
+        for k, v in rest:
+            out.append(("_msg", v) if k in ("message", "msg") and
+                       not any(x[0] == "_msg" for x in rest) else (k, v))
+        out = _rename_msg(cp, out)
+        lmp.add_row(ts, out)
+        n += 1
+    resp = {"took": 0, "errors": False,
+            "items": [{"create": {"status": 201}}] * n}
+    return n, resp
+
+
+# ---------------- loki ----------------
+
+def handle_loki_json(cp: CommonParams, body: bytes,
+                     lmp: LogMessageProcessor) -> int:
+    try:
+        obj = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"cannot parse Loki JSON: {e}") from None
+    n = 0
+    for stream in obj.get("streams", []):
+        labels = stream.get("stream", {})
+        stream_fields = [(str(k), str(v)) for k, v in labels.items()]
+        for entry in stream.get("values", []):
+            ts = parse_timestamp(int(entry[0])) if str(entry[0]).isdigit() \
+                else parse_timestamp(entry[0])
+            fields = [("_msg", entry[1])]
+            if len(entry) > 2 and isinstance(entry[2], dict):
+                fields.extend((str(k), str(v))
+                              for k, v in entry[2].items())
+            lmp.add_row(ts, fields, stream_fields=stream_fields)
+            n += 1
+    return n
+
+
+def _parse_loki_labels(s: str) -> list:
+    """Parse Loki's `{a="b", c="d"}` label string."""
+    from ..storage.stream_filter import parse_stream_tags
+    return sorted(parse_stream_tags(s).items())
+
+
+def handle_loki_protobuf(cp: CommonParams, body: bytes,
+                         lmp: LogMessageProcessor) -> int:
+    try:
+        raw = snappy_decompress(body)
+    except SnappyError as e:
+        raise IngestError(f"cannot snappy-decompress Loki push: {e}") \
+            from None
+    n = 0
+    for fnum, _wt, val in pb.iter_fields(raw):
+        if fnum != 1:
+            continue
+        labels = []
+        entries = []
+        for f2, _w2, v2 in pb.iter_fields(val):
+            if f2 == 1:
+                labels = _parse_loki_labels(v2.decode("utf-8", "replace"))
+            elif f2 == 2:
+                entries.append(v2)
+        for ent in entries:
+            ts_ns = None
+            line = ""
+            attrs = []
+            for f3, _w3, v3 in pb.iter_fields(ent):
+                if f3 == 1:  # Timestamp{seconds=1, nanos=2}
+                    secs = nanos = 0
+                    for f4, _w4, v4 in pb.iter_fields(v3):
+                        if f4 == 1:
+                            secs = v4
+                        elif f4 == 2:
+                            nanos = v4
+                    ts_ns = secs * 1_000_000_000 + nanos
+                elif f3 == 2:
+                    line = v3.decode("utf-8", "replace")
+                elif f3 == 3:  # structured metadata LabelPairAdapter
+                    k = v = ""
+                    for f4, _w4, v4 in pb.iter_fields(v3):
+                        if f4 == 1:
+                            k = v4.decode("utf-8", "replace")
+                        elif f4 == 2:
+                            v = v4.decode("utf-8", "replace")
+                    if k:
+                        attrs.append((k, v))
+            lmp.add_row(ts_ns, [("_msg", line)] + attrs,
+                        stream_fields=labels)
+            n += 1
+    return n
+
+
+# ---------------- OTLP logs ----------------
+
+def _otlp_any_value(buf: bytes) -> str:
+    for fnum, wt, val in pb.iter_fields(buf):
+        if fnum == 1:
+            return val.decode("utf-8", "replace")
+        if fnum == 2:
+            return "true" if val else "false"
+        if fnum == 3:  # int64 varint (two's complement for negatives)
+            return str(val - (1 << 64) if val >= (1 << 63) else val)
+        if fnum == 4:
+            return repr(pb.fixed64_f(val))
+        if fnum == 5:  # array
+            vals = [_otlp_any_value(v) for f, _w, v in pb.iter_fields(val)
+                    if f == 1]
+            return json.dumps(vals, separators=(",", ":"))
+        if fnum == 6:  # kvlist
+            obj = {}
+            for f, _w, v in pb.iter_fields(val):
+                if f == 1:
+                    k, vv = _otlp_kv(v)
+                    obj[k] = vv
+            return json.dumps(obj, separators=(",", ":"))
+        if fnum == 7:
+            return val.hex()
+    return ""
+
+
+def _otlp_kv(buf: bytes) -> tuple[str, str]:
+    k = v = ""
+    for fnum, _wt, val in pb.iter_fields(buf):
+        if fnum == 1:
+            k = val.decode("utf-8", "replace")
+        elif fnum == 2:
+            v = _otlp_any_value(val)
+    return k, v
+
+
+_OTLP_SEVERITIES = {
+    1: "TRACE", 5: "DEBUG", 9: "INFO", 13: "WARN", 17: "ERROR", 21: "FATAL",
+}
+
+
+def _otlp_severity(num: int) -> str:
+    base = ((num - 1) // 4) * 4 + 1 if num >= 1 else 0
+    name = _OTLP_SEVERITIES.get(base, "")
+    if not name:
+        return str(num)
+    off = num - base
+    return name + (str(off + 1) if off else "")
+
+
+def handle_otlp_protobuf(cp: CommonParams, body: bytes,
+                         lmp: LogMessageProcessor) -> int:
+    n = 0
+    for f1, _w, rl in pb.iter_fields(body):
+        if f1 != 1:  # resource_logs
+            continue
+        resource_attrs = []
+        scope_bufs = []
+        for f2, _w2, v2 in pb.iter_fields(rl):
+            if f2 == 1:  # Resource{attributes=1}
+                for f3, _w3, v3 in pb.iter_fields(v2):
+                    if f3 == 1:
+                        resource_attrs.append(_otlp_kv(v3))
+            elif f2 == 2:
+                scope_bufs.append(v2)
+        for sl in scope_bufs:
+            for f3, _w3, lr_buf in pb.iter_fields(sl):
+                if f3 != 2:  # log_records
+                    continue
+                ts = None
+                sev_text = ""
+                sev_num = 0
+                body_s = ""
+                attrs = []
+                for f4, w4, v4 in pb.iter_fields(lr_buf):
+                    if f4 == 1:
+                        ts = pb.fixed64_u(v4)
+                    elif f4 == 2:
+                        sev_num = v4
+                    elif f4 == 3:
+                        sev_text = v4.decode("utf-8", "replace")
+                    elif f4 == 5:
+                        body_s = _otlp_any_value(v4)
+                    elif f4 == 6:
+                        attrs.append(_otlp_kv(v4))
+                    elif f4 == 11 and ts is None:
+                        ts = pb.fixed64_u(v4)
+                fields = [("_msg", body_s)]
+                sev = sev_text or (_otlp_severity(sev_num) if sev_num else "")
+                if sev:
+                    fields.append(("severity", sev))
+                fields.extend(attrs)
+                fields.extend(resource_attrs)
+                lmp.add_row(ts, fields)
+                n += 1
+    return n
+
+
+def handle_otlp_json(cp: CommonParams, body: bytes,
+                     lmp: LogMessageProcessor) -> int:
+    try:
+        obj = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"cannot parse OTLP JSON: {e}") from None
+    n = 0
+    for rl in obj.get("resourceLogs", []):
+        resource_attrs = [(a.get("key", ""), _otlp_json_value(a.get("value")))
+                          for a in rl.get("resource", {})
+                          .get("attributes", [])]
+        for sl in rl.get("scopeLogs", []):
+            for rec in sl.get("logRecords", []):
+                ts = parse_timestamp(int(rec["timeUnixNano"])) \
+                    if rec.get("timeUnixNano") else None
+                fields = [("_msg", _otlp_json_value(rec.get("body")))]
+                sev = rec.get("severityText") or ""
+                if sev:
+                    fields.append(("severity", sev))
+                fields.extend((a.get("key", ""),
+                               _otlp_json_value(a.get("value")))
+                              for a in rec.get("attributes", []))
+                fields.extend(resource_attrs)
+                lmp.add_row(ts, fields)
+                n += 1
+    return n
+
+
+def _otlp_json_value(v) -> str:
+    if v is None:
+        return ""
+    if "stringValue" in v:
+        return v["stringValue"]
+    if "intValue" in v:
+        return str(v["intValue"])
+    if "doubleValue" in v:
+        return repr(float(v["doubleValue"]))
+    if "boolValue" in v:
+        return "true" if v["boolValue"] else "false"
+    return json.dumps(v, separators=(",", ":"))
+
+
+# ---------------- datadog ----------------
+
+def handle_datadog(cp: CommonParams, body: bytes,
+                   lmp: LogMessageProcessor) -> int:
+    try:
+        obj = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"cannot parse Datadog JSON: {e}") from None
+    if isinstance(obj, dict):
+        obj = [obj]
+    n = 0
+    for item in obj:
+        if not isinstance(item, dict):
+            continue
+        fields = []
+        msg = item.get("message", "")
+        fields.append(("_msg", msg))
+        for k in ("ddsource", "service", "hostname", "status"):
+            if item.get(k):
+                fields.append((k, str(item[k])))
+        tags = item.get("ddtags", "")
+        for tag in str(tags).split(","):
+            if ":" in tag:
+                k, v = tag.split(":", 1)
+                fields.append((k, v))
+            elif tag:
+                fields.append((tag, "no_label_value"))
+        ts = parse_timestamp(item.get("timestamp") or item.get("date"))
+        lmp.add_row(ts, fields)
+        n += 1
+    return n
+
+
+# ---------------- journald export format ----------------
+
+def handle_journald(cp: CommonParams, body: bytes,
+                    lmp: LogMessageProcessor) -> int:
+    n = 0
+    i = 0
+    size = len(body)
+    fields: list = []
+    while i < size:
+        nl = body.find(b"\n", i)
+        if nl < 0:
+            nl = size
+        line = body[i:nl]
+        if not line:  # blank line: end of entry
+            if fields:
+                n += _emit_journald(cp, fields, lmp)
+                fields = []
+            i = nl + 1
+            continue
+        eq = line.find(b"=")
+        if eq >= 0:  # FIELD=value
+            fields.append((line[:eq].decode("utf-8", "replace"),
+                           line[eq + 1:].decode("utf-8", "replace")))
+            i = nl + 1
+        else:        # binary field: FIELD\n<8-byte LE size><data>\n
+            name = line.decode("utf-8", "replace")
+            j = nl + 1
+            if j + 8 > size:
+                break
+            ln = int.from_bytes(body[j:j + 8], "little")
+            data = body[j + 8:j + 8 + ln]
+            fields.append((name, data.decode("utf-8", "replace")))
+            i = j + 8 + ln + 1  # trailing newline
+    if fields:
+        n += _emit_journald(cp, fields, lmp)
+    return n
+
+
+def _emit_journald(cp: CommonParams, raw: list,
+                   lmp: LogMessageProcessor) -> int:
+    ts = None
+    fields = []
+    for k, v in raw:
+        if k == "__REALTIME_TIMESTAMP":  # microseconds
+            try:
+                ts = int(v) * 1000
+            except ValueError:
+                pass
+            continue
+        if k.startswith("__"):
+            continue
+        if k == "MESSAGE":
+            fields.append(("_msg", v))
+        else:
+            fields.append((k, v))
+    lmp.add_row(ts, fields)
+    return 1

@@ -1,0 +1,353 @@
+"""Query HTTP API handlers: /select/logsql/*.
+
+Reference: app/vlselect (endpoints main.go:212-274, handlers in
+app/vlselect/logsql): streamed NDJSON query results, hits histograms via an
+injected `stats by (_time:step) count()` pipe (logsql.go:113-170), facets,
+field/stream introspection, Prometheus-style stats_query[_range], live tail.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+from ..engine.block_result import format_rfc3339
+from ..engine.searcher import (get_field_names, get_field_values, run_query,
+                               run_query_collect)
+from ..logsql.duration import parse_duration, ts_bounds
+from ..logsql.parser import (MAX_TS, MIN_TS, ParseError, Query, parse_query,
+                             parse_filter_string)
+from ..logsql.filters import FilterAnd, FilterIn
+from ..logsql.pipes import PipeStats, ByField, PipeLimit, PipeOffset
+from ..logsql import stats_funcs as sf
+from .insertutil import get_tenant_id
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _parse_time_arg(v: str, default: int, end: bool = False) -> int:
+    if not v:
+        return default
+    if v == "now":
+        return time.time_ns()
+    d = parse_duration(v)
+    if d is not None:
+        return time.time_ns() - abs(d)
+    tb = ts_bounds(v)
+    if tb is not None:
+        return tb[1] if end else tb[0]
+    try:  # unix seconds / millis / nanos
+        iv = float(v)
+        from .insertutil import parse_timestamp
+        ts = parse_timestamp(int(iv) if iv.is_integer() else iv)
+        if ts is not None:
+            return ts
+    except ValueError:
+        pass
+    raise HTTPError(400, f"cannot parse time arg {v!r}")
+
+
+def parse_common_args(storage, args, headers) -> tuple[Query, list]:
+    qs = args.get("query", "")
+    if not qs:
+        raise HTTPError(400, "missing query arg")
+    now = time.time_ns()
+    ts = _parse_time_arg(args.get("time", ""), now, end=True)
+    try:
+        q = parse_query(qs, timestamp=ts)
+    except (ParseError, ValueError) as e:
+        raise HTTPError(400, f"cannot parse query: {e}")
+    start = _parse_time_arg(args.get("start", ""), MIN_TS)
+    end = _parse_time_arg(args.get("end", ""), MAX_TS, end=True)
+    if start != MIN_TS or end != MAX_TS:
+        q.add_time_filter(start, end)
+    for extra_arg in ("extra_filters", "extra_stream_filters"):
+        ef = args.get(extra_arg, "")
+        if ef:
+            _apply_extra_filters(q, ef)
+    tenant = get_tenant_id(headers, args)
+    return q, [tenant]
+
+
+def _apply_extra_filters(q: Query, ef: str) -> None:
+    try:
+        obj = json.loads(ef)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        fs = []
+        for k, vals in obj.items():
+            if isinstance(vals, str):
+                vals = [vals]
+            fs.append(FilterIn(k, [str(v) for v in vals]))
+        extra = FilterAnd(fs) if len(fs) > 1 else fs[0]
+    else:
+        try:
+            extra = parse_filter_string(ef)
+        except (ParseError, ValueError) as e:
+            raise HTTPError(400, f"cannot parse extra_filters: {e}")
+    f = q.filter
+    if isinstance(f, FilterAnd):
+        f.filters.insert(0, extra)
+    else:
+        q.filter = FilterAnd([extra, f])
+
+
+def _int_arg(args, name, default=0) -> int:
+    v = args.get(name, "")
+    if not v:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise HTTPError(400, f"invalid {name} arg {v!r}")
+
+
+# ---------------- /select/logsql/query ----------------
+
+def handle_query(storage, args, headers, runner=None):
+    """Returns an iterator of NDJSON chunks."""
+    q, tenants = parse_common_args(storage, args, headers)
+    limit = _int_arg(args, "limit", 1000)
+    offset = _int_arg(args, "offset", 0)
+    if offset:
+        q.pipes.append(PipeOffset(offset))
+    if limit > 0:
+        q.pipes.append(PipeLimit(limit))
+
+    def gen():
+        chunks = []
+
+        def sink(br):
+            out = []
+            for row in br.rows():
+                out.append(json.dumps(row, ensure_ascii=False,
+                                      separators=(",", ":")))
+            if out:
+                chunks.append("\n".join(out) + "\n")
+        run_query(storage, tenants, q, write_block=sink, runner=runner)
+        yield from chunks
+    return gen()
+
+
+# ---------------- /select/logsql/hits ----------------
+
+def handle_hits(storage, args, headers, runner=None) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    step = args.get("step", "1d")
+    if parse_duration(step) is None:
+        raise HTTPError(400, f"invalid step {step!r}")
+    offset_s = args.get("offset", "0s")
+    fields = [f.strip() for f in args.get("field", "").split(",")
+              if f.strip()] + \
+             [f.strip() for f in args.get("fields", "").split(",")
+              if f.strip()]
+    by = [ByField("_time", bucket=step)] + [ByField(f) for f in fields]
+    fn = sf.StatsCount([])
+    fn.out_name = "hits"
+    q.pipes.append(PipeStats(by, [fn]))
+    rows = run_query_collect(storage, tenants, q, runner=runner)
+    groups: dict = {}
+    for r in rows:
+        key = tuple((f, r.get(f, "")) for f in fields)
+        g = groups.setdefault(key, {"fields": dict(key), "timestamps": [],
+                                    "values": [], "total": 0})
+        g["timestamps"].append(r.get("_time", ""))
+        hits = int(r.get("hits", "0"))
+        g["values"].append(hits)
+        g["total"] += hits
+    return {"hits": sorted(groups.values(),
+                           key=lambda g: -g["total"])}
+
+
+# ---------------- /select/logsql/facets ----------------
+
+def handle_facets(storage, args, headers, runner=None) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    limit = _int_arg(args, "limit", 10)
+    max_values = _int_arg(args, "max_values_per_field", 1000)
+    max_len = _int_arg(args, "max_value_len", 1000)
+    counts: dict[str, dict[str, int]] = {}
+
+    def sink(br):
+        names = [n for n in br.column_names()
+                 if n not in ("_time", "_stream_id", "_stream")]
+        for n in names:
+            per = counts.setdefault(n, {})
+            for v in br.column(n):
+                if v == "" or len(v) > max_len:
+                    continue
+                if len(per) >= max_values and v not in per:
+                    per["__truncated__"] = 1
+                    continue
+                per[v] = per.get(v, 0) + 1
+    run_query(storage, tenants, q, write_block=sink, runner=runner)
+    out = []
+    for field in sorted(counts):
+        per = counts[field]
+        if "__truncated__" in per:
+            continue  # too many distinct values: not a useful facet
+        vals = sorted(per.items(), key=lambda kv: (-kv[1], kv[0]))[:limit]
+        out.append({"field_name": field,
+                    "values": [{"field_value": v, "hits": h}
+                               for v, h in vals]})
+    return {"facets": out}
+
+
+# ---------------- field/stream introspection ----------------
+
+def handle_field_names(storage, args, headers) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    return {"values": get_field_names(storage, tenants, q)}
+
+
+def handle_field_values(storage, args, headers) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    field = args.get("field", "")
+    if not field:
+        raise HTTPError(400, "missing field arg")
+    limit = _int_arg(args, "limit", 0)
+    return {"values": get_field_values(storage, tenants, q, field, limit)}
+
+
+def handle_streams(storage, args, headers) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    limit = _int_arg(args, "limit", 0)
+    return {"values": get_field_values(storage, tenants, q, "_stream",
+                                       limit)}
+
+
+def handle_stream_ids(storage, args, headers) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    limit = _int_arg(args, "limit", 0)
+    return {"values": get_field_values(storage, tenants, q, "_stream_id",
+                                       limit)}
+
+
+def handle_stream_field_names(storage, args, headers) -> dict:
+    from ..storage.stream_filter import parse_stream_tags
+    q, tenants = parse_common_args(storage, args, headers)
+    hits: dict[str, int] = {}
+
+    def sink(br):
+        for v in br.column("_stream"):
+            for name in parse_stream_tags(v):
+                hits[name] = hits.get(name, 0) + 1
+    run_query(storage, tenants, q, write_block=sink)
+    return {"values": [{"value": k, "hits": str(hits[k])}
+                       for k in sorted(hits)]}
+
+
+def handle_stream_field_values(storage, args, headers) -> dict:
+    from ..storage.stream_filter import parse_stream_tags
+    q, tenants = parse_common_args(storage, args, headers)
+    field = args.get("field", "")
+    if not field:
+        raise HTTPError(400, "missing field arg")
+    limit = _int_arg(args, "limit", 0)
+    hits: dict[str, int] = {}
+
+    def sink(br):
+        for v in br.column("_stream"):
+            tags = parse_stream_tags(v)
+            if field in tags:
+                hits[tags[field]] = hits.get(tags[field], 0) + 1
+    run_query(storage, tenants, q, write_block=sink)
+    out = [{"value": k, "hits": str(v)}
+           for k, v in sorted(hits.items(), key=lambda kv: (-kv[1], kv[0]))]
+    if limit:
+        out = out[:limit]
+    return {"values": out}
+
+
+# ---------------- stats_query / stats_query_range ----------------
+
+def _require_stats_query(q: Query) -> PipeStats:
+    for p in reversed(q.pipes):
+        if isinstance(p, PipeStats):
+            return p
+    raise HTTPError(400, "query must end with a `stats` pipe")
+
+
+def handle_stats_query(storage, args, headers, runner=None) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    sp = _require_stats_query(q)
+    ts = _parse_time_arg(args.get("time", ""), time.time_ns(), end=True)
+    rows = run_query_collect(storage, tenants, q, runner=runner)
+    result = []
+    by_names = [b.name for b in sp.by]
+    for r in rows:
+        for fn in sp.funcs:
+            metric = {"__name__": fn.out_name}
+            for n in by_names:
+                if n in r:
+                    metric[n] = r[n]
+            result.append({"metric": metric,
+                           "value": [ts / 1e9, r.get(fn.out_name, "")]})
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": result}}
+
+
+def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    sp = _require_stats_query(q)
+    step = args.get("step", "1d")
+    if parse_duration(step) is None:
+        raise HTTPError(400, f"invalid step {step!r}")
+    if not any(b.name == "_time" for b in sp.by):
+        sp.by.insert(0, ByField("_time", bucket=step))
+    rows = run_query_collect(storage, tenants, q, runner=runner)
+    series: dict = {}
+    by_names = [b.name for b in sp.by if b.name != "_time"]
+    from ..engine.block_result import parse_rfc3339
+    for r in rows:
+        t = parse_rfc3339(r.get("_time", "")) or 0
+        for fn in sp.funcs:
+            key = (fn.out_name,) + tuple((n, r.get(n, ""))
+                                         for n in by_names)
+            s = series.setdefault(key, {"metric": dict(
+                [("__name__", fn.out_name)] + [(n, r.get(n, ""))
+                                               for n in by_names if n in r]),
+                "values": []})
+            s["values"].append([t / 1e9, r.get(fn.out_name, "")])
+    for s in series.values():
+        s["values"].sort()
+    return {"status": "success",
+            "data": {"resultType": "matrix",
+                     "result": list(series.values())}}
+
+
+# ---------------- live tail ----------------
+
+def handle_tail(storage, args, headers, stop_check=None, runner=None):
+    """Generator yielding NDJSON chunks for new rows (poll loop, ~1s period
+    with a lag offset — reference logsql.go:497-580)."""
+    q, tenants = parse_common_args(storage, args, headers)
+    if not q.can_live_tail():
+        raise HTTPError(400, "query contains pipes that cannot live-tail")
+    lag_ns = 2_500_000_000
+    last_ts = time.time_ns() - lag_ns
+    while True:
+        if stop_check is not None and stop_check():
+            return
+        now_end = time.time_ns() - lag_ns
+        qq = q.clone()
+        qq.add_time_filter(last_ts + 1, now_end)
+        rows = run_query_collect(storage, tenants, qq, runner=runner)
+        rows.sort(key=lambda r: r.get("_time", ""))
+        out = []
+        for r in rows:
+            out.append(json.dumps(r, ensure_ascii=False,
+                                  separators=(",", ":")))
+        if out:
+            yield "\n".join(out) + "\n"
+        else:
+            yield ""  # keep-alive chunk
+        last_ts = now_end
+        time.sleep(1.0)

@@ -1,0 +1,77 @@
+"""Minimal protobuf wire-format reader (decode only).
+
+Enough to parse OTLP logs and Loki push payloads without a generated-code
+dependency (the reference similarly hand-rolls its Loki decoder —
+app/vlinsert/loki/pb.go).
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class PBError(ValueError):
+    pass
+
+
+def read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        if i >= len(buf):
+            raise PBError("truncated varint")
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+        if shift > 70:
+            raise PBError("varint too long")
+
+
+def iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's fields.
+
+    wire types: 0 varint (value int), 1 fixed64 (bytes), 2 length-delimited
+    (bytes), 5 fixed32 (bytes).
+    """
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = read_varint(buf, i)
+        fnum = key >> 3
+        wt = key & 7
+        if wt == 0:
+            v, i = read_varint(buf, i)
+            yield fnum, wt, v
+        elif wt == 1:
+            if i + 8 > n:
+                raise PBError("truncated fixed64")
+            yield fnum, wt, buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = read_varint(buf, i)
+            if i + ln > n:
+                raise PBError("truncated bytes field")
+            yield fnum, wt, buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            if i + 4 > n:
+                raise PBError("truncated fixed32")
+            yield fnum, wt, buf[i:i + 4]
+            i += 4
+        else:
+            raise PBError(f"unsupported wire type {wt}")
+
+
+def fixed64_u(b: bytes) -> int:
+    return struct.unpack("<Q", b)[0]
+
+
+def fixed64_f(b: bytes) -> float:
+    return struct.unpack("<d", b)[0]
+
+
+def zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)

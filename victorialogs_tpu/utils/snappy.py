@@ -1,0 +1,67 @@
+"""Pure-Python snappy block-format decompressor.
+
+Needed for Loki protobuf push payloads (snappy-framed by Promtail/Grafana
+Agent as raw block format).  Decode-only; compression is not needed server
+side.
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def decompress(data: bytes) -> bytes:
+    i = 0
+    n = len(data)
+    # uncompressed length varint
+    ulen = 0
+    shift = 0
+    while True:
+        if i >= n:
+            raise SnappyError("truncated length")
+        b = data[i]
+        i += 1
+        ulen |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while i < n:
+        tag = data[i]
+        i += 1
+        elem_type = tag & 3
+        if elem_type == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if i + extra > n:
+                    raise SnappyError("truncated literal length")
+                ln = int.from_bytes(data[i:i + extra], "little")
+                i += extra
+            ln += 1
+            if i + ln > n:
+                raise SnappyError("truncated literal")
+            out += data[i:i + ln]
+            i += ln
+            continue
+        if elem_type == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 7) + 4
+            off = ((tag >> 5) << 8) | data[i]
+            i += 1
+        elif elem_type == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[i:i + 2], "little")
+            i += 2
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[i:i + 4], "little")
+            i += 4
+        if off == 0 or off > len(out):
+            raise SnappyError("bad copy offset")
+        for _ in range(ln):  # overlapping copies must go byte by byte
+            out.append(out[-off])
+    if len(out) != ulen:
+        raise SnappyError(f"length mismatch: {len(out)} != {ulen}")
+    return bytes(out)
