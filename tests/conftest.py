@@ -1,6 +1,14 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import, so it lives at conftest import time.
+Two things must happen before the first backend init:
+
+1. provision 8 virtual CPU devices (XLA_FLAGS), and
+2. neutralize the axon TPU plugin that this image's sitecustomize registers
+   in EVERY interpreter: its PJRT init dials the tunnel and can block
+   indefinitely when the relay is wedged, and it force-sets the
+   jax_platforms config so the JAX_PLATFORMS=cpu env var alone is not
+   honored.  Tests must never depend on tunnel health, so we drop the
+   backend factory and pin the config to cpu.
 """
 
 import os
@@ -10,3 +18,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _k in [k for k in list(_xb._backend_factories) if k != "cpu"]:
+        _xb._backend_factories.pop(_k, None)
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - plain environments need no surgery
+    pass

@@ -1,0 +1,150 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md r1)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.logsql.filters import regex_literal_tokens
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+def _mk_storage(tmp_path, msgs, flush=True):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i, m in enumerate(msgs):
+        lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", m)])
+    s.must_add_rows(lr)
+    if flush:
+        s.debug_flush()
+    return s
+
+
+def test_regex_inline_flags_no_literal_tokens():
+    # (?i) flips case semantics: extracting 'foo' would wrongly bloom-prune
+    assert regex_literal_tokens("(?i)error: foo bar") == []
+    assert regex_literal_tokens("(?s)foo.bar") == []
+    # plain patterns still extract mandatory inner tokens
+    assert "foo" in regex_literal_tokens("error: foo bar")
+
+
+def test_regex_inline_case_insensitive_matches(tmp_path):
+    msgs = [f"ERROR: FOO BAR {i}" for i in range(50)] + ["other row"]
+    s = _mk_storage(tmp_path, msgs)
+    try:
+        for runner in (None, BatchRunner()):
+            rows = run_query_collect(
+                s, [TEN], '_msg:~"(?i)error: foo bar" | stats count() n',
+                timestamp=T0, runner=runner)
+            assert rows == [{"n": "50"}], f"runner={runner}"
+    finally:
+        s.close()
+
+
+def test_long_pattern_vs_short_rows_device_path(tmp_path):
+    # 40-byte phrase vs short values: staged width bucket is 32; round-1
+    # crashed with a negative broadcast dim inside match_scan
+    long_phrase = "this phrase is way longer than the rows"
+    msgs = ["short", "tiny", "x"] * 20
+    s = _mk_storage(tmp_path, msgs)
+    try:
+        rows = run_query_collect(
+            s, [TEN], f'_msg:"{long_phrase}" | stats count() n',
+            timestamp=T0, runner=BatchRunner())
+        assert rows == [{"n": "0"}]
+    finally:
+        s.close()
+
+
+def test_long_pattern_overflow_rows_still_match(tmp_path):
+    # one row longer than the width bucket actually contains the phrase
+    long_phrase = "this phrase is way longer than the rows"
+    msgs = ["short"] * 30 + [f"prefix {long_phrase} suffix" + "x" * 4000]
+    s = _mk_storage(tmp_path, msgs)
+    try:
+        for runner in (None, BatchRunner()):
+            rows = run_query_collect(
+                s, [TEN], f'_msg:"{long_phrase}" | stats count() n',
+                timestamp=T0, runner=runner)
+            assert rows == [{"n": "1"}], f"runner={runner}"
+    finally:
+        s.close()
+
+
+def test_flushing_parts_stay_visible(tmp_path):
+    """Rows must remain query-visible during the inmemory->file flush window
+    (advisor: round-1 dropped them from snapshot_parts mid-flush)."""
+    from victorialogs_tpu.storage import datadb as ddb_mod
+
+    s = _mk_storage(tmp_path / "s", ["hello world"] * 10, flush=False)
+    try:
+        pt = s.select_partitions(T0, T0 + 100 * NS)[0]
+        ddb = pt.ddb
+        assert sum(p.num_rows for p in ddb.snapshot_parts()) == 10
+
+        in_flush = threading.Event()
+        release = threading.Event()
+        real_write_part = ddb_mod.write_part
+
+        def slow_write_part(*a, **kw):
+            in_flush.set()
+            assert release.wait(10)
+            return real_write_part(*a, **kw)
+
+        ddb_mod.write_part = slow_write_part
+        try:
+            t = threading.Thread(target=ddb.flush_inmemory_parts)
+            t.start()
+            assert in_flush.wait(10)
+            # mid-flush: rows must still be visible exactly once
+            visible = sum(p.num_rows for p in ddb.snapshot_parts())
+            assert visible == 10
+            release.set()
+            t.join(10)
+        finally:
+            ddb_mod.write_part = real_write_part
+        assert sum(p.num_rows for p in ddb.snapshot_parts()) == 10
+        assert not ddb.flushing_parts
+    finally:
+        s.close()
+
+
+def test_part_uids_are_unique_across_merge(tmp_path):
+    """Staging-cache keys use part uids, which must never be reused (round-1
+    keyed on id(part), which CPython recycles)."""
+    s = Storage(str(tmp_path / "u"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        seen = set()
+        for batch in range(3):
+            lr = LogRows(stream_fields=["app"])
+            for i in range(5):
+                lr.add(TEN, T0 + i * NS, [("app", "a"),
+                                          ("_msg", f"m{batch}-{i}")])
+            s.must_add_rows(lr)
+            s.debug_flush()
+            for pt in s.select_partitions(T0, T0 + 100 * NS):
+                for p in pt.ddb.snapshot_parts():
+                    seen.add(p.uid)
+        pt = s.select_partitions(T0, T0 + 100 * NS)[0]
+        pt.ddb.force_merge()
+        post = {p.uid for p in pt.ddb.snapshot_parts()}
+        # the merged part gets a fresh uid, never one of the retired ones
+        assert post
+        assert not (post & seen)
+    finally:
+        s.close()
+
+
+def test_dead_kernels_removed():
+    from victorialogs_tpu.tpu import kernels as K
+    assert not hasattr(K, "match_positions_any")
+    assert not hasattr(K, "nonempty_rows")
+    assert "kernels_pallas" not in (K.__doc__ or "")

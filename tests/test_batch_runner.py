@@ -1,0 +1,133 @@
+"""Batched (part-at-a-time) TPU runner parity: must match the CPU path and
+the per-block BlockRunner bit-exactly, with ONE dispatch per device leaf."""
+
+import random
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+WORDS = ["alpha", "beta", "gamma", "delta", "error", "GET", "POST",
+         "timeout", "x", "_under", "123", "a1b2"]
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    random.seed(43)
+    path = str(tmp_path_factory.mktemp("batchstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(3000):
+        nwords = random.randint(0, 8)
+        msg = " ".join(random.choice(WORDS) for _ in range(nwords))
+        sep = random.choice([" ", "/", "=", ":", "-", ""])
+        msg = msg + sep + random.choice(WORDS)
+        if i % 97 == 0:
+            msg = ""
+        if i % 31 == 0:
+            msg = "日本語ログ " + msg
+        if i % 501 == 0:
+            msg = "needle " + "pad " * 700  # overflow rows (>2KB staging)
+        lr.add(TEN, T0 + i * NS, [
+            ("app", f"app{i % 3}"),
+            ("_msg", msg),
+            ("path", f"/api/v{i % 3}/items/{i}"),
+        ])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+QUERIES = [
+    "error",
+    "GET",
+    "x",
+    '"error GET"',
+    "err*",
+    '""*',                      # empty prefix: any non-empty _msg
+    "_msg:=error",
+    '_msg:="error GET"*',
+    "path:v1",
+    'path:"/api/v2"*',
+    '_msg:seq("error", "GET")',
+    "_msg:contains_all(error, GET)",
+    "_msg:contains_any(error, timeout)",
+    '_msg:~"err.r"',
+    '_msg:~"(GET|POST) "',
+    '_msg:~"(?i)ERROR"',        # inline-flag regex: no literal prefilter
+    "error or timeout",
+    "error timeout",
+    "!error",
+    "error !timeout",
+    "(error or GET) !POST",
+    "needle",                   # matches only overflow rows
+    '{app="app1"} error',
+    "_time:[2025-07-28T00:00:00Z, 2025-07-28T00:20:00Z] error",
+    "日本語ログ",
+    "alpha and beta or gamma !delta",
+]
+
+
+def test_batch_parity_vs_cpu(storage):
+    runner = BatchRunner()
+    for qs in QUERIES:
+        cpu = run_query_collect(storage, [TEN], f"{qs} | fields _time",
+                                timestamp=T0)
+        dev = run_query_collect(storage, [TEN], f"{qs} | fields _time",
+                                timestamp=T0, runner=runner)
+        assert [r.get("_time") for r in cpu] == \
+               [r.get("_time") for r in dev], qs
+    assert runner.device_calls > 0
+
+
+def test_batch_dispatch_count(storage):
+    """One device dispatch per leaf per part — not per block."""
+    runner = BatchRunner()
+    run_query_collect(storage, [TEN], "error | stats count() n",
+                      timestamp=T0, runner=runner)
+    parts = sum(len([p for p in pt.ddb.snapshot_parts() if p.num_rows])
+                for pt in storage.select_partitions(T0, T0 + 3000 * NS))
+    assert runner.device_calls <= parts  # single leaf => <=1 dispatch/part
+
+
+def test_batch_staging_cache_hot(storage):
+    runner = BatchRunner()
+    run_query_collect(storage, [TEN], "error | fields _time", timestamp=T0,
+                      runner=runner)
+    misses0 = runner.cache.misses
+    run_query_collect(storage, [TEN], "timeout | fields _time",
+                      timestamp=T0, runner=runner)
+    assert runner.cache.hits > 0
+    assert runner.cache.misses == misses0
+
+
+def test_batch_parity_exhaustive(storage):
+    runner = BatchRunner()
+    for w in WORDS:
+        for qs in (w, f'"{w} {w}"', f"{w}*", f"_msg:={w}"):
+            cpu = run_query_collect(storage, [TEN],
+                                    f"{qs} | stats count() n", timestamp=T0)
+            dev = run_query_collect(storage, [TEN],
+                                    f"{qs} | stats count() n", timestamp=T0,
+                                    runner=runner)
+            assert cpu == dev, qs
+
+
+def test_batch_stats_pipeline(storage):
+    runner = BatchRunner()
+    for qs in ["* | stats count() c",
+               "* | stats by (app) count() c, count_uniq(path) u",
+               "error | stats by (app) count() c"]:
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        assert cpu == dev, qs

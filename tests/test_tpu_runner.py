@@ -13,7 +13,7 @@ import pytest
 from victorialogs_tpu.engine.searcher import run_query_collect
 from victorialogs_tpu.storage.log_rows import LogRows, TenantID
 from victorialogs_tpu.storage.storage import Storage
-from victorialogs_tpu.tpu.runner import BlockRunner
+from victorialogs_tpu.tpu.batch import BatchRunner
 
 NS = 1_000_000_000
 T0 = 1_753_660_800_000_000_000
@@ -79,7 +79,7 @@ QUERIES = [
 
 
 def test_bitmap_parity(storage):
-    runner = BlockRunner()
+    runner = BatchRunner()
     for qs in QUERIES:
         cpu = run_query_collect(storage, [TEN], f"{qs} | fields _time",
                                 timestamp=T0)
@@ -92,7 +92,7 @@ def test_bitmap_parity(storage):
 
 def test_parity_exhaustive_phrases(storage):
     """Every word/pair phrase must agree bit-exactly."""
-    runner = BlockRunner()
+    runner = BatchRunner()
     for w in WORDS:
         for qs in (w, f'"{w} {w}"', f"{w}*", f"_msg:={w}"):
             cpu = run_query_collect(storage, [TEN],
@@ -104,7 +104,7 @@ def test_parity_exhaustive_phrases(storage):
 
 
 def test_runner_cache_hits(storage):
-    runner = BlockRunner()
+    runner = BatchRunner()
     run_query_collect(storage, [TEN], "error | fields _time", timestamp=T0,
                       runner=runner)
     misses0 = runner.cache.misses

@@ -7,7 +7,7 @@ through the filter tree, and feeds resulting batches through the pipe
 processor chain with per-pipe cancellation (storage_search.go:102-185,
 1035-1121).
 
-The per-block scan dispatches to the TPU runner when enabled (tpu/runner.py);
+The per-block scan dispatches to the TPU runner when enabled (tpu/batch.py);
 this module stays the correctness oracle and the fallback path.
 """
 
@@ -110,8 +110,9 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
               timestamp: int | None = None, runner=None) -> None:
     """Execute a LogsQL query; write_block(BlockResult) receives results.
 
-    runner: optional TPU block runner (tpu/runner.py BlockRunner) — when
-    given, block filtering dispatches to the device.
+    runner: optional TPU runner (tpu/batch.py BatchRunner) — when given,
+    block filtering dispatches to the device, one dispatch per leaf per
+    part.
     """
     if isinstance(q, str):
         q = parse_query(q, timestamp)
@@ -137,11 +138,13 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                 if not allowed_sids:
                     continue
             tenant_set = set(tenants)
+            batch = runner is not None and hasattr(runner, "run_part")
             for part in pt.ddb.snapshot_parts():
                 if part.num_rows == 0:
                     continue
                 if part.min_ts > max_ts or part.max_ts < min_ts:
                     continue
+                cand: dict[int, BlockSearch] = {}
                 for bi in range(part.num_blocks):
                     if head.is_done():
                         raise QueryCancelled()
@@ -155,6 +158,9 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                         continue
                     bs = BlockSearch(part, bi)
                     bs.ctx = ctx
+                    if batch:
+                        cand[bi] = bs
+                        continue
                     if runner is not None:
                         bm = runner.apply_filter(q.filter, bs)
                     else:
@@ -163,6 +169,20 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                     if not bm.any():
                         continue
                     head.write_block(BlockResult.from_block_search(bs, bm))
+                if batch and cand:
+                    if head.is_done():
+                        raise QueryCancelled()
+                    # batched device path: one dispatch per filter leaf over
+                    # the whole part (tpu/batch.py)
+                    bms = runner.run_part(q.filter, part, cand)
+                    for bi, bs in cand.items():
+                        if head.is_done():
+                            raise QueryCancelled()
+                        bm = bms[bi]
+                        if not bm.any():
+                            continue
+                        head.write_block(
+                            BlockResult.from_block_search(bs, bm))
     except QueryCancelled:
         pass
     head.flush()

@@ -370,9 +370,12 @@ def regex_literal_tokens(pattern: str) -> list[str]:
     literal runs outside any metacharacter scope, then drop first/last token
     of each run boundary the same way.
     """
-    # bail out on constructs that make literal extraction unsound
-    if re.search(r"\\[wWdDsSbB]|\(\?", pattern):
-        pass  # classes don't invalidate top-level literal concatenation
+    # Inline flags/groups like (?i) change matching semantics for the whole
+    # pattern (case folding etc.), so any literal we extract could wrongly
+    # prune via blooms — bail to "no mandatory tokens" (the reference parses
+    # the regex tree and folds case; we stay conservative).
+    if "(?" in pattern:
+        return []
     literals = []
     cur = []
     i, n = 0, len(pattern)

@@ -29,9 +29,22 @@ from ..tpu import kernels as K
 BLOCK_AXIS = "blocks"
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
-    devs = jax.devices()
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Build the block-parallel mesh.
+
+    devices: explicit device list (e.g. a virtual CPU world); defaults to
+    jax.devices().  Raises when fewer than n_devices are attached instead of
+    silently building a smaller mesh — callers that want a virtual mesh must
+    provision one (see __graft_entry__.dryrun_multichip).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)}; provision a "
+                f"virtual CPU world with JAX_PLATFORMS=cpu "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n_devices}")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (BLOCK_AXIS,))
 

@@ -73,8 +73,8 @@ def main(argv=None) -> int:
 
     runner = None
     if args.tpu:
-        from ..tpu.runner import BlockRunner
-        runner = BlockRunner()
+        from ..tpu.batch import BatchRunner
+        runner = BatchRunner()
 
     host, _, port_s = args.httpListenAddr.rpartition(":")
     server = VLServer(storage, listen_addr=host or "0.0.0.0",

@@ -38,6 +38,8 @@ class InmemoryPart:
     """A flushed-but-not-yet-durable part: blocks held decoded in memory."""
 
     def __init__(self, blocks: list[BlockData]):
+        from .part import next_part_uid
+        self.uid = next_part_uid()
         self.blocks = blocks
         self.num_blocks = len(blocks)
         self.num_rows = sum(b.num_rows for b in blocks)
@@ -144,6 +146,10 @@ class DataDB:
         # worker channels — datadb.go:209-262)
         self._merge_lock = threading.Lock()
         self.inmemory_parts: list[InmemoryPart] = []
+        # parts mid-flush: removed from inmemory_parts but not yet replaced
+        # by their file part — must stay query-visible (the reference swaps
+        # partWrappers atomically; see ADVICE r1)
+        self.flushing_parts: list[InmemoryPart] = []
         self.small_parts: list[Part] = []
         self.big_parts: list[Part] = []
         self._next_part_id = 0
@@ -226,18 +232,33 @@ class DataDB:
             if not imps:
                 return
             self.inmemory_parts = []
-        if len(imps) == 1:
-            merged = imps[0].blocks
-        else:
-            merged = merge_blocks([im.blocks for im in imps])
-        with self._lock:
-            name = self._new_part_name_locked()
-        write_part(os.path.join(self.path, name), merged)
-        p = Part(os.path.join(self.path, name))
-        p.name = name
-        with self._lock:
-            self.small_parts.append(p)
-            self._write_manifest_locked()
+            # keep the flushing parts query-visible until the file part is
+            # registered, then drop both in one locked swap
+            self.flushing_parts.extend(imps)
+        try:
+            if len(imps) == 1:
+                merged = imps[0].blocks
+            else:
+                merged = merge_blocks([im.blocks for im in imps])
+            with self._lock:
+                name = self._new_part_name_locked()
+            write_part(os.path.join(self.path, name), merged)
+            p = Part(os.path.join(self.path, name))
+            p.name = name
+            with self._lock:
+                gone = set(id(x) for x in imps)
+                self.flushing_parts = [x for x in self.flushing_parts
+                                       if id(x) not in gone]
+                self.small_parts.append(p)
+                self._write_manifest_locked()
+        except BaseException:
+            # put the in-memory parts back so their rows stay visible
+            with self._lock:
+                gone = set(id(x) for x in imps)
+                self.flushing_parts = [x for x in self.flushing_parts
+                                       if id(x) not in gone]
+                self.inmemory_parts.extend(imps)
+            raise
         self._maybe_merge()
 
     def _maybe_merge(self) -> None:
@@ -290,17 +311,19 @@ class DataDB:
     def snapshot_parts(self) -> list:
         """Stable part list for one query (parts are immutable once listed)."""
         with self._lock:
-            return list(self.inmemory_parts) + list(self.small_parts) + \
-                   list(self.big_parts)
+            return list(self.inmemory_parts) + list(self.flushing_parts) + \
+                   list(self.small_parts) + list(self.big_parts)
 
     # ---- stats / lifecycle ----
     def stats(self) -> dict:
         with self._lock:
             return {
-                "inmemory_parts": len(self.inmemory_parts),
+                "inmemory_parts": len(self.inmemory_parts)
+                + len(self.flushing_parts),
                 "small_parts": len(self.small_parts),
                 "big_parts": len(self.big_parts),
-                "inmemory_rows": sum(p.num_rows for p in self.inmemory_parts),
+                "inmemory_rows": sum(p.num_rows for p in self.inmemory_parts
+                                     + self.flushing_parts),
                 "file_rows": sum(p.num_rows
                                  for p in self.small_parts + self.big_parts),
                 "compressed_size": sum(p.meta["compressed_size"]

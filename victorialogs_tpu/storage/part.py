@@ -18,6 +18,7 @@ a decompress step — the reader memory-maps them.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 from dataclasses import dataclass
@@ -32,6 +33,15 @@ from .values_encoder import (EncodedColumn, VT_DICT, VT_FLOAT64, VT_INT64,
                              VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64)
 
 FORMAT_VERSION = 1
+
+# Process-unique part identity for caches keyed across part lifetimes:
+# id(part) is unsafe (CPython reuses freed addresses — ADVICE r1), so every
+# Part/InmemoryPart draws a monotonic uid instead.
+_part_uid_counter = itertools.count(1)
+
+
+def next_part_uid() -> int:
+    return next(_part_uid_counter)
 METADATA_FILENAME = "metadata.json"
 INDEX_FILENAME = "index.bin"
 TIMESTAMPS_FILENAME = "timestamps.bin"
@@ -191,6 +201,7 @@ class Part:
 
     def __init__(self, path: str):
         self.path = path
+        self.uid = next_part_uid()
         with open(os.path.join(path, METADATA_FILENAME)) as f:
             self.meta = json.load(f)
         with open(os.path.join(path, INDEX_FILENAME), "rb") as f:

@@ -1,0 +1,396 @@
+"""Batched TPU execution: ONE device dispatch per filter leaf per part.
+
+Round-1's BlockRunner dispatched one kernel per block per leaf with a
+synchronous download each time (~65ms round trip under the axon tunnel once
+sync mode engages), so an 8M-row query cost seconds on the device path.  This
+module is the production path instead: a part's string column is staged into
+HBM ONCE as a single fixed-width (rows, W) uint8 matrix covering every block
+(parts are immutable, so the staging is cached across queries), and each
+device-capable filter leaf becomes one `match_scan` dispatch over the whole
+matrix, downloaded as one bool vector and sliced per block on the host.
+
+This mirrors the reference's batched scanning (64-block batches per worker —
+lib/logstorage/block_search.go:16, storage_search.go:1035-1121) reshaped for
+a dispatch-latency-bound accelerator: fewer, bigger kernels win.
+
+Filter-tree semantics are identical to the CPU path (the parity tests in
+tests/test_tpu_runner.py and tests/test_batch_runner.py diff them bit-exactly):
+- AND children evaluate left-to-right with block-level early exit;
+- bloom pruning stays on the host kill-path (filter_phrase.go:302 analogue);
+- rows longer than the staging width are truncated on device and re-checked
+  on the host with the filter's full predicate;
+- regex runs its mandatory-literal substring prefilter on device and
+  re.search on the survivors only (filter_regexp.go:44-51 analogue).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..engine.block_search import BlockSearch
+from ..logsql import filters as F
+from ..storage.bloom import bloom_contains_all
+from ..storage.values_encoder import VT_STRING
+from ..utils.hashing import hash_tokens
+from . import kernels as K
+from .layout import StagingCache, row_width_bucket
+from .kernels import pad_bucket
+
+
+# ---------------- leaf planning ----------------
+
+@dataclass
+class ScanOp:
+    pattern: bytes
+    mode: int
+    starts_tok: bool = False
+    ends_tok: bool = False
+    # specials that need no device scan:
+    match_nonempty: bool = False   # prefix "": any non-empty value
+    match_empty: bool = False      # contains "": only the empty value
+
+
+@dataclass
+class LeafPlan:
+    filter: object                 # the original Filter (host fallback + pred)
+    field: str
+    ops: list
+    combine: str                   # 'and' | 'or'
+    bloom_tokens: list
+    verify: bool = False           # re-check survivors with filter._pred
+
+
+def device_plan(f) -> LeafPlan | None:
+    """Compile one filter leaf into device scan ops; None => host-only leaf."""
+    from ..logsql.filters import canonical_field
+    from ..logsql.matchers import is_word_char
+
+    def ok(s: str) -> bool:
+        return s.isascii() and 0 < len(s) <= K.MAX_PATTERN_LEN
+
+    if isinstance(f, F.FilterPhrase):
+        if not ok(f.phrase):
+            return None
+        return LeafPlan(f, canonical_field(f.field),
+                        [ScanOp(f.phrase.encode(), K.MODE_PHRASE,
+                                is_word_char(f.phrase[0]),
+                                is_word_char(f.phrase[-1]))],
+                        "and", f._tokens())
+
+    if isinstance(f, F.FilterPrefix):
+        fld = canonical_field(f.field)
+        if not f.prefix:
+            return LeafPlan(f, fld, [ScanOp(b"", 0, match_nonempty=True)],
+                            "and", [])
+        if not ok(f.prefix):
+            return None
+        return LeafPlan(f, fld,
+                        [ScanOp(f.prefix.encode(), K.MODE_PREFIX,
+                                is_word_char(f.prefix[0]), False)],
+                        "and", f._tokens())
+
+    if isinstance(f, F.FilterExact):
+        if not ok(f.value):
+            return None
+        return LeafPlan(f, canonical_field(f.field),
+                        [ScanOp(f.value.encode(), K.MODE_EXACT)], "and", [])
+
+    if isinstance(f, F.FilterExactPrefix):
+        if not ok(f.prefix):
+            return None
+        return LeafPlan(f, canonical_field(f.field),
+                        [ScanOp(f.prefix.encode(), K.MODE_EXACT_PREFIX)],
+                        "and", [])
+
+    if isinstance(f, F.FilterSequence):
+        if not f.phrases or any(not ok(p) for p in f.phrases):
+            return None
+        ops = [ScanOp(p.encode(), K.MODE_SUBSTRING) for p in f.phrases]
+        return LeafPlan(f, canonical_field(f.field), ops, "and",
+                        f._tokens(), verify=len(f.phrases) > 1)
+
+    if isinstance(f, F.FilterContainsAll):
+        if f.subquery is not None and not f.values:
+            return None
+        return _contains_plan(f, require_all=True)
+
+    if isinstance(f, F.FilterContainsAny):
+        if f.subquery is not None and not f.values:
+            return None
+        return _contains_plan(f, require_all=False)
+
+    if isinstance(f, F.FilterRegexp):
+        from ..logsql.filters import canonical_field as cf
+        literals = [t for t in getattr(f, "_bloom_tokens", []) if ok(t)]
+        ops = [ScanOp(t.encode(), K.MODE_SUBSTRING) for t in literals]
+        import re
+        pure = (re.escape(f.pattern) == f.pattern and len(literals) == 1
+                and literals[0] == f.pattern)
+        return LeafPlan(f, cf(f.field), ops, "and", f._tokens(),
+                        verify=not pure)
+
+    return None
+
+
+def _contains_plan(f, require_all: bool) -> LeafPlan | None:
+    from ..logsql.filters import canonical_field
+    from ..logsql.matchers import is_word_char
+    if not f.values:
+        return None
+    ops = []
+    for p in f.values:
+        if not p:
+            ops.append(ScanOp(b"", 0, match_empty=True))
+            continue
+        if not p.isascii() or len(p) > K.MAX_PATTERN_LEN:
+            return None
+        ops.append(ScanOp(p.encode(), K.MODE_PHRASE, is_word_char(p[0]),
+                          is_word_char(p[-1])))
+    tokens = f._tokens() if require_all else []
+    return LeafPlan(f, canonical_field(f.field), ops,
+                    "and" if require_all else "or", tokens)
+
+
+# ---------------- part-level staging ----------------
+
+@dataclass
+class StagedPart:
+    rows: object                   # jax uint8[Rb, W]
+    lengths: object                # jax int32[Rb]
+    lengths_np: np.ndarray         # host copy (truncated at W-1)
+    nrows: int                     # real staged rows
+    width: int
+    block_rows: dict               # block_idx -> (start, nrows)
+    overflow: dict                 # block_idx -> np.ndarray of row idxs
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+_UNSTAGEABLE = object()  # cache marker: part+field can't be staged
+
+
+def stage_part_column(part, field: str,
+                      max_bytes: int = 4 << 30) -> StagedPart | None:
+    """Stage every string-typed block of `field` in one (Rb, W) matrix.
+
+    Blocks whose column is missing/const/dict/numeric are left out (the
+    evaluator runs those on the host).  Returns None when nothing is
+    stageable or the staged matrix would exceed max_bytes."""
+    import jax.numpy as jnp
+
+    cols = {}
+    total = 0
+    max_len = 0
+    for bi in range(part.num_blocks):
+        col = part.block_column(bi, field)
+        if col is None or col.vtype != VT_STRING:
+            continue
+        cols[bi] = col
+        total += part.block_rows(bi)
+        if col.lengths.size:
+            max_len = max(max_len, int(col.lengths.max()))
+    if not cols:
+        return None
+    w = row_width_bucket(max_len)
+    rb = pad_bucket(max(total, 1), minimum=1024)
+    if rb * (w + 4) > max_bytes:
+        return None
+    mat = np.full((rb, w), 0xFF, dtype=np.uint8)
+    lens = np.zeros(rb, dtype=np.int32)
+    block_rows = {}
+    overflow = {}
+    start = 0
+    from .layout import to_fixed_width
+    for bi, col in cols.items():
+        r = int(col.offsets.shape[0])
+        sub, _w, ov = to_fixed_width(col.arena, col.offsets, col.lengths,
+                                     r, width=w)
+        mat[start:start + r] = sub
+        lens[start:start + r] = np.minimum(col.lengths, w - 1).astype(np.int32)
+        block_rows[bi] = (start, r)
+        if ov.size:
+            overflow[bi] = ov
+        start += r
+    return StagedPart(rows=jnp.asarray(mat), lengths=jnp.asarray(lens),
+                      lengths_np=lens, nrows=start, width=w,
+                      block_rows=block_rows, overflow=overflow,
+                      nbytes=rb * (w + 4))
+
+
+# ---------------- the batch runner ----------------
+
+class BatchRunner:
+    """Part-at-a-time filter evaluation with one dispatch per device leaf.
+
+    Exposes run_part() (used by engine.searcher.run_query when present) and
+    a per-block apply_filter() shim for callers holding one BlockSearch."""
+
+    def __init__(self, max_cache_bytes: int = 8 << 30,
+                 max_part_bytes: int = 4 << 30):
+        self.cache = StagingCache(max_cache_bytes)
+        self.max_part_bytes = max_part_bytes
+        self.device_calls = 0
+        self.cpu_fallbacks = 0
+
+    # ---- staging (cached across queries; parts are immutable) ----
+    def stage_part(self, part, field: str) -> StagedPart | None:
+        key = (part.uid, field)
+        got = self.cache.get(key)
+        if got is _UNSTAGEABLE:
+            return None
+        if got is not None:
+            return got
+        spc = stage_part_column(part, field, self.max_part_bytes)
+        if spc is None:
+            self.cache.put_small(key, _UNSTAGEABLE)
+            return None
+        self.cache.put(key, spc)
+        return spc
+
+    # ---- per-block compatibility shim ----
+    def apply_filter(self, f, bs: BlockSearch) -> np.ndarray:
+        out = self.run_part(f, bs.part, {bs.block_idx: bs})
+        return out[bs.block_idx]
+
+    # ---- part-level evaluation ----
+    def run_part(self, f, part, bss: dict) -> dict:
+        """Evaluate the filter tree over candidate blocks of one part.
+
+        bss: block_idx -> BlockSearch (with .ctx set for stream filters).
+        Returns block_idx -> bool bitmap, bit-identical to the CPU path."""
+        return self._eval(f, part, bss, list(bss))
+
+    def _eval(self, f, part, bss, alive) -> dict:
+        if isinstance(f, F.FilterAnd):
+            acc = {bi: np.ones(bss[bi].nrows, dtype=bool) for bi in alive}
+            cur = list(alive)
+            for sub in f.filters:
+                if not cur:
+                    break
+                sub_bms = self._eval(sub, part, bss, cur)
+                nxt = []
+                for bi in cur:
+                    acc[bi] &= sub_bms[bi]
+                    if acc[bi].any():
+                        nxt.append(bi)
+                cur = nxt
+            return acc
+        if isinstance(f, F.FilterOr):
+            acc = {bi: np.zeros(bss[bi].nrows, dtype=bool) for bi in alive}
+            cur = list(alive)
+            for sub in f.filters:
+                if not cur:
+                    break
+                sub_bms = self._eval(sub, part, bss, cur)
+                nxt = []
+                for bi in cur:
+                    acc[bi] |= sub_bms[bi]
+                    if not acc[bi].all():
+                        nxt.append(bi)
+                cur = nxt
+            return acc
+        if isinstance(f, F.FilterNot):
+            inner = self._eval(f.inner, part, bss, alive)
+            return {bi: ~inner[bi] for bi in alive}
+        plan = device_plan(f)
+        if plan is None:
+            self.cpu_fallbacks += 1
+            out = {}
+            for bi in alive:
+                bm = np.ones(bss[bi].nrows, dtype=bool)
+                f.apply_to_block(bss[bi], bm)
+                out[bi] = bm
+            return out
+        return self._eval_leaf(plan, part, bss, alive)
+
+    def _eval_leaf(self, plan: LeafPlan, part, bss, alive) -> dict:
+        out = {}
+        # host bloom kill-path FIRST (cheap, mmap'd words): when a rare
+        # token prunes every candidate block, the part is never staged
+        survivors = list(alive)
+        if plan.bloom_tokens:
+            hashes = hash_tokens(plan.bloom_tokens)
+            survivors = []
+            for bi in alive:
+                words = bss[bi].bloom(plan.field)
+                if words is not None and words.shape[0] and \
+                        not bloom_contains_all(words, hashes):
+                    out[bi] = np.zeros(bss[bi].nrows, dtype=bool)
+                else:
+                    survivors.append(bi)
+            if not survivors:
+                return out
+
+        spc = self.stage_part(part, plan.field)
+        if spc is None:
+            dev_bis = []
+            host_bis = survivors
+        else:
+            dev_bis = [bi for bi in survivors if bi in spc.block_rows]
+            host_bis = [bi for bi in survivors if bi not in spc.block_rows]
+        for bi in host_bis:
+            bm = np.ones(bss[bi].nrows, dtype=bool)
+            plan.filter.apply_to_block(bss[bi], bm)
+            out[bi] = bm
+        if not dev_bis:
+            return out
+
+        combined = self._run_ops(spc, plan)
+        for bi in dev_bis:
+            start, n = spc.block_rows[bi]
+            bm = combined[start:start + n].copy() if combined is not None \
+                else np.ones(n, dtype=bool)
+            ov = spc.overflow.get(bi)
+            vals = None
+            if ov is not None and ov.size:
+                # truncated rows: ask the filter's full predicate
+                vals = bss[bi].values(plan.field)
+                for i in ov:
+                    bm[i] = plan.filter._pred(vals[i])
+            if plan.verify and bm.any():
+                if vals is None:
+                    vals = bss[bi].values(plan.field)
+                for i in np.nonzero(bm)[0]:
+                    if not plan.filter._pred(vals[i]):
+                        bm[i] = False
+            out[bi] = bm
+        return out
+
+    def _run_ops(self, spc: StagedPart, plan: LeafPlan) -> np.ndarray | None:
+        """AND/OR the leaf's scan ops over the whole staged part.
+
+        Returns bool[spc.nrows], or None for an op-less leaf (regex with no
+        safe literals => everything survives to verification)."""
+        combined = None
+        for op in plan.ops:
+            m = self._scan(spc, op)
+            if combined is None:
+                combined = m
+            elif plan.combine == "and":
+                combined &= m
+            else:
+                combined |= m
+            if plan.combine == "and" and combined is not None and \
+                    not combined.any():
+                break
+        return combined
+
+    def _scan(self, spc: StagedPart, op: ScanOp) -> np.ndarray:
+        import jax.numpy as jnp
+        if op.match_nonempty:
+            return spc.lengths_np[:spc.nrows] > 0
+        if op.match_empty:
+            return spc.lengths_np[:spc.nrows] == 0
+        if len(op.pattern) >= spc.width:
+            # no staged (truncated) value can contain it; overflow rows are
+            # re-checked from the full values by the caller
+            return np.zeros(spc.nrows, dtype=bool)
+        self.device_calls += 1
+        pat = jnp.asarray(np.frombuffer(op.pattern, dtype=np.uint8))
+        res = K.match_scan(spc.rows, spc.lengths, pat, len(op.pattern),
+                           op.mode, op.starts_tok, op.ends_tok)
+        return np.array(res[:spc.nrows])  # writable host copy

@@ -1,5 +1,4 @@
-"""Device kernels for the block runner (jnp/XLA; Pallas variants in
-kernels_pallas.py).
+"""Device kernels for the block runner (jnp/XLA).
 
 The flagship kernel is the byte-arena phrase/substring scan: a column block's
 string values are staged as one padded uint8 arena plus row offsets, and the
@@ -28,7 +27,6 @@ import jax.numpy as jnp
 import numpy as np
 
 MAX_PATTERN_LEN = 64
-ARENA_PAD = MAX_PATTERN_LEN  # extra 0xFF tail so static window slices fit
 
 MODE_PHRASE = 0        # substring with word boundaries on both sides
 MODE_PREFIX = 1        # substring with word boundary before only
@@ -96,22 +94,6 @@ def match_scan(rows: jnp.ndarray, lengths: jnp.ndarray,
         acc = acc & end_ok
 
     return jnp.any(acc, axis=1) & (lengths >= pat_len)
-
-
-@partial(jax.jit, static_argnames=("nrows",))
-def nonempty_rows(lengths: jnp.ndarray, nrows: int) -> jnp.ndarray:
-    return lengths > 0
-
-
-@partial(jax.jit, static_argnames=("nrows", "pat_len"))
-def match_positions_any(arena: jnp.ndarray, offsets: jnp.ndarray,
-                        arena_len: jnp.ndarray, pattern: jnp.ndarray,
-                        nrows: int, pat_len: int) -> jnp.ndarray:
-    """Plain substring containment per row (no boundaries) — the regex
-    literal prefilter."""
-    return match_scan(arena, offsets,
-                      jnp.zeros_like(offsets), arena_len, pattern,
-                      nrows, pat_len, MODE_SUBSTRING, False, False)
 
 
 @partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",

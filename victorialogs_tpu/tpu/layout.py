@@ -113,14 +113,25 @@ class StagingCache:
             self.misses += 1
         return got
 
-    def put(self, key: tuple, col: StagedStringColumn) -> None:
+    @staticmethod
+    def _cost(col) -> int:
+        # markers without device buffers still occupy a nominal slot so the
+        # LRU eventually evicts them (long-running servers mint a fresh part
+        # uid every flush/merge)
+        return col.device_bytes() if hasattr(col, "device_bytes") else 4096
+
+    def put(self, key: tuple, col) -> None:
         if key in self._lru:
             return
         self._lru[key] = col
-        self._bytes += col.device_bytes()
+        self._bytes += self._cost(col)
         while self._bytes > self.max_bytes and self._lru:
             _, old = self._lru.popitem(last=False)
-            self._bytes -= old.device_bytes()
+            self._bytes -= self._cost(old)
+
+    def put_small(self, key: tuple, marker) -> None:
+        """Cache a marker (e.g. 'this column is unstageable')."""
+        self.put(key, marker)
 
     def clear(self) -> None:
         self._lru.clear()
