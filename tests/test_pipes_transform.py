@@ -1,0 +1,323 @@
+"""Table tests for the transform pipes (extract/format/math/unpack/...).
+
+Shape mirrors the reference's table-driven pipe tests
+(lib/logstorage/pipe_extract_test.go etc.): run a query over in-memory rows
+and compare the full result rows."""
+
+import math
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.logsql.parser import parse_query
+from victorialogs_tpu.logsql.pipes_transform import (Pattern, parse_logfmt,
+                                                     unpack_json_array)
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    yield s
+    s.close()
+
+
+def _ingest(s, rows):
+    lr = LogRows(stream_fields=["app"])
+    for i, fields in enumerate(rows):
+        lr.add(TEN, T0 + i * NS, [("app", "a")] + list(fields.items()))
+    s.must_add_rows(lr)
+    s.debug_flush()
+
+
+def q(s, query):
+    return run_query_collect(s, [TEN], query, timestamp=T0)
+
+
+# ---------------- pattern engine unit tests ----------------
+
+def test_pattern_basic():
+    p = Pattern("ip=<ip> port=<port>")
+    assert p.apply("ip=1.2.3.4 port=80") == {"ip": "1.2.3.4", "port": "80"}
+    assert p.apply("nope") == {"ip": "", "port": ""}
+    # leading junk before the first prefix is skipped
+    assert p.apply("xx ip=9.9.9.9 port=1")["ip"] == "9.9.9.9"
+
+
+def test_pattern_last_field_takes_rest():
+    p = Pattern("user=<user>")
+    assert p.apply("user=alice bob") == {"user": "alice bob"}
+
+
+def test_pattern_quoted():
+    p = Pattern("msg=<msg> code=<code>")
+    assert p.apply('msg="hello world" code=3') == \
+        {"msg": "hello world", "code": "3"}
+    # plain: option disables unquoting
+    p2 = Pattern("msg=<plain:msg> code=<code>")
+    assert p2.apply('msg="a b" code=3') == {"msg": '"a b"', "code": "3"}
+
+
+def test_pattern_html_escaped_prefix():
+    p = Pattern("&lt;<tag>&gt;")
+    assert p.apply("<div>") == {"tag": "div"}
+
+
+def test_logfmt_parser():
+    assert parse_logfmt('a=1 b="x y" c=') == \
+        [("a", "1"), ("b", "x y"), ("c", "")]
+
+
+def test_unpack_json_array():
+    assert unpack_json_array('[1,"a",true,null]') == ["1", "a", "true", ""]
+    assert unpack_json_array('"scalar"') == []
+    assert unpack_json_array("notjson") == []
+
+
+# ---------------- extract ----------------
+
+def test_extract_pipe(store):
+    _ingest(store, [{"_msg": "ip=1.2.3.4 port=80 ok"},
+                    {"_msg": "ip=5.6.7.8 port=443 ok"},
+                    {"_msg": "garbage"}])
+    rows = q(store, '* | extract "ip=<ip> port=<port> " | fields ip, port')
+    assert rows == [{"ip": "1.2.3.4", "port": "80"},
+                    {"ip": "5.6.7.8", "port": "443"},
+                    {}]
+
+
+def test_extract_if_and_keep_original(store):
+    _ingest(store, [{"_msg": "x=new", "x": "old"},
+                    {"_msg": "x=other", "x": ""}])
+    rows = q(store, '* | extract if (x:"") "x=<x>" | fields x')
+    assert rows == [{"x": "old"}, {"x": "other"}]
+    rows = q(store, '* | extract "x=<x>" keep_original_fields | fields x')
+    assert rows == [{"x": "old"}, {"x": "other"}]
+
+
+def test_extract_regexp(store):
+    _ingest(store, [{"_msg": "took 25ms"}, {"_msg": "took 1300ms"},
+                    {"_msg": "no-match"}])
+    rows = q(store, r'* | extract_regexp `took (?P<ms>\d+)ms` | fields ms')
+    assert rows == [{"ms": "25"}, {"ms": "1300"}, {}]
+
+
+# ---------------- format ----------------
+
+def test_format_pipe(store):
+    _ingest(store, [{"a": "1", "b": "x"}, {"a": "2", "b": "y"}])
+    rows = q(store, '* | format "a=<a>, b=<b>" as out | fields out')
+    assert rows == [{"out": "a=1, b=x"}, {"out": "a=2, b=y"}]
+
+
+def test_format_options(store):
+    _ingest(store, [{"v": "abC", "n": "3000000000", "ip": "16909060"}])
+    rows = q(store, '* | format "<uc:v>|<lc:v>|<q:v>" as out | fields out')
+    assert rows == [{"out": 'ABC|abc|"abC"'}]
+    rows = q(store, '* | format "<duration:n>" as out | fields out')
+    assert rows == [{"out": "3s"}]
+    rows = q(store, '* | format "<ipv4:ip>" as out | fields out')
+    assert rows == [{"out": "1.2.3.4"}]
+    rows = q(store, '* | format "<base64encode:v>" as out | fields out')
+    assert rows == [{"out": "YWJD"}]
+
+
+# ---------------- math ----------------
+
+def test_math_pipe(store):
+    _ingest(store, [{"a": "10", "b": "3"}, {"a": "7", "b": "0"}])
+    rows = q(store, "* | math a + b as s, a % b as m, a / b as d, "
+                    "a ^ 2 as p | fields s, m, d, p")
+    assert rows[0] == {"s": "13", "m": "1", "d": "3.3333333333333335",
+                      "p": "100"}
+    assert rows[1]["s"] == "7"
+    assert rows[1]["m"] == "NaN"
+    assert rows[1]["d"] == "NaN"
+
+
+def test_math_precedence_and_funcs(store):
+    _ingest(store, [{"a": "2", "b": "8"}])
+    rows = q(store, "* | math a + b * 2 as x, (a + b) * 2 as y, "
+                    "max(a, b, 5) as mx, min(a, b) as mn, "
+                    "round(7.6) as r, floor(7.6) as fl, ceil(7.2) as ce, "
+                    "abs(-3) as ab, b default 9 as df, "
+                    "unknown_field default 9 as df2 "
+                    "| fields x, y, mx, mn, r, fl, ce, ab, df, df2")
+    assert rows == [{"x": "18", "y": "20", "mx": "8", "mn": "2", "r": "8",
+                     "fl": "7", "ce": "8", "ab": "3", "df": "8",
+                     "df2": "9"}]
+
+
+def test_math_bitwise(store):
+    _ingest(store, [{"a": "12", "b": "10"}])
+    rows = q(store, "* | math a & b as x, a or b as o, a xor b as xo "
+                    "| fields x, o, xo")
+    assert rows == [{"x": "8", "o": "14", "xo": "6"}]
+
+
+def test_math_durations(store):
+    _ingest(store, [{"d": "2m30s"}])
+    rows = q(store, "* | math d / 1e9 as secs | fields secs")
+    assert rows == [{"secs": "150"}]
+
+
+# ---------------- unpack ----------------
+
+def test_unpack_json(store):
+    _ingest(store, [{"_msg": '{"level":"info","nested":{"x":"1"},'
+                             '"num":42}'},
+                    {"_msg": "not json"}])
+    rows = q(store, "* | unpack_json | fields level, nested.x, num")
+    assert rows == [{"level": "info", "nested.x": "1", "num": "42"}, {}]
+
+
+def test_unpack_json_opts(store):
+    _ingest(store, [{"_msg": '{"a":"1","b":"2"}'}])
+    rows = q(store, "* | unpack_json fields (a) result_prefix p_ "
+                    "| fields p_a, p_b")
+    assert rows == [{"p_a": "1"}]
+
+
+def test_unpack_logfmt(store):
+    _ingest(store, [{"_msg": 'level=warn msg="disk full" free=5GB'}])
+    rows = q(store, "* | unpack_logfmt | fields level, msg, free")
+    assert rows == [{"level": "warn", "msg": "disk full", "free": "5GB"}]
+
+
+def test_unpack_syslog(store):
+    _ingest(store, [{"_msg": "<165>1 2024-06-01T12:00:00Z host app 123 - "
+                             "- boom happened"}])
+    rows = q(store, "* | unpack_syslog | fields hostname, app_name, "
+                    "severity")
+    assert rows == [{"hostname": "host", "app_name": "app",
+                     "severity": "5"}]
+
+
+def test_unpack_words(store):
+    _ingest(store, [{"_msg": "foo bar foo"}])
+    rows = q(store, "* | unpack_words as w | fields w")
+    assert rows == [{"w": '["foo","bar","foo"]'}]
+    rows = q(store, "* | unpack_words as w drop_duplicates | fields w")
+    assert rows == [{"w": '["foo","bar"]'}]
+
+
+# ---------------- replace ----------------
+
+def test_replace(store):
+    _ingest(store, [{"_msg": "a-b-c-d"}])
+    rows = q(store, '* | replace ("-", "_") | fields _msg')
+    assert rows == [{"_msg": "a_b_c_d"}]
+    rows = q(store, '* | replace ("-", "_") limit 2 | fields _msg')
+    assert rows == [{"_msg": "a_b_c-d"}]
+
+
+def test_replace_regexp(store):
+    _ingest(store, [{"_msg": "id=12345 user=9"}])
+    rows = q(store, r'* | replace_regexp (`\d+`, "N") | fields _msg')
+    assert rows == [{"_msg": "id=N user=N"}]
+
+
+def test_replace_at_field_with_if(store):
+    _ingest(store, [{"u": "secret", "keep": "y"}, {"u": "secret"}])
+    rows = q(store, '* | replace if (keep:"") ("secret", "xxx") at u '
+                    '| fields u')
+    assert rows == [{"u": "secret"}, {"u": "xxx"}]
+
+
+# ---------------- top / len / pack / sample / unroll / misc ----------------
+
+def test_top_pipe(store):
+    _ingest(store, [{"k": "a"}] * 5 + [{"k": "b"}] * 3 + [{"k": "c"}])
+    rows = q(store, "* | top 2 by (k)")
+    assert rows == [{"k": "a", "hits": "5"}, {"k": "b", "hits": "3"}]
+    rows = q(store, "* | top 2 by (k) rank as r")
+    assert rows == [{"k": "a", "hits": "5", "r": "1"},
+                    {"k": "b", "hits": "3", "r": "2"}]
+
+
+def test_len_pipe(store):
+    _ingest(store, [{"_msg": "hello"}, {"_msg": "日本"}])
+    rows = q(store, "* | len(_msg) as l | fields l")
+    assert rows == [{"l": "5"}, {"l": "6"}]  # utf-8 byte length
+
+
+def test_pack_json(store):
+    _ingest(store, [{"a": "1", "b": "x"}])
+    rows = q(store, "* | pack_json fields (a, b) as out | fields out")
+    assert rows == [{"out": '{"a":"1","b":"x"}'}]
+
+
+def test_pack_logfmt(store):
+    _ingest(store, [{"a": "1", "b": "x y"}])
+    rows = q(store, "* | pack_logfmt fields (a, b) as out | fields out")
+    assert rows == [{"out": 'a=1 b="x y"'}]
+
+
+def test_sample_pipe(store):
+    _ingest(store, [{"_msg": f"m{i}"} for i in range(300)])
+    rows = q(store, "* | sample 1")
+    assert len(rows) == 300
+    rows = q(store, "* | sample 3 | stats count() n")
+    n = int(rows[0]["n"])
+    assert 30 <= n <= 250  # ~100 expected
+
+
+def test_unroll_pipe(store):
+    _ingest(store, [{"_msg": "r1", "tags": '["a","b"]'},
+                    {"_msg": "r2", "tags": "notarray"}])
+    rows = q(store, "* | unroll by (tags) | fields _msg, tags")
+    assert rows == [{"_msg": "r1", "tags": "a"}, {"_msg": "r1", "tags": "b"},
+                    {"_msg": "r2"}]
+
+
+def test_drop_empty_fields(store):
+    _ingest(store, [{"a": "1", "b": ""}, {"a": "", "b": ""}])
+    rows = q(store, "* | fields a, b | drop_empty_fields")
+    assert rows == [{"a": "1"}]
+
+
+def test_field_names_values_pipes(store):
+    _ingest(store, [{"x": "v1"}, {"x": "v2"}, {"x": "v1"}])
+    rows = q(store, "* | field_values x")
+    assert rows == [{"x": "v1", "hits": "2"}, {"x": "v2", "hits": "1"}]
+    rows = q(store, "* | field_names")
+    names = {r["name"] for r in rows}
+    assert "x" in names and "_time" in names
+
+
+def test_blocks_count(store):
+    _ingest(store, [{"_msg": "a"}] * 10)
+    rows = q(store, "* | blocks_count as bc")
+    assert int(rows[0]["bc"]) >= 1
+
+
+def test_pipe_roundtrip_to_string():
+    for qs in [
+        '* | extract "ip=<ip> port=<port>"',
+        '* | extract if (x:y) "a=<a>" from f keep_original_fields',
+        '* | format "a=<a>" as out',
+        "* | math (a + b) * 2 as x",
+        "* | unpack_json from f fields (a, b) result_prefix p_",
+        "* | unpack_logfmt",
+        "* | unpack_syslog",
+        '* | replace ("a", "b") at f limit 3',
+        '* | replace_regexp ("a.", "b") at f',
+        "* | top 5 by (k) rank as r",
+        "* | len(x) as l",
+        "* | pack_json fields (a, b) as out",
+        "* | sample 10",
+        "* | unroll by (tags)",
+        "* | field_names",
+        "* | field_values x limit 5",
+        "* | blocks_count",
+        "* | drop_empty_fields",
+        "* | unpack_words from f as w drop_duplicates",
+    ]:
+        parsed = parse_query(qs)
+        again = parse_query(parsed.to_string())
+        assert parsed.to_string() == again.to_string(), qs

@@ -1,0 +1,144 @@
+"""Tests for the round-2 stats functions (histogram, rate, rate_sum,
+row_min, row_max, json_values), per-func if-guards, and memory budgets."""
+
+import json
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.utils.memory import QueryMemoryError
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    yield s
+    s.close()
+
+
+def _ingest(store, rows):
+    lr = LogRows(stream_fields=["app"])
+    for i, fields in enumerate(rows):
+        lr.add(TEN, T0 + i * NS, [("app", "a")] + list(fields.items()))
+    store.must_add_rows(lr)
+    store.debug_flush()
+
+
+def q(s, query):
+    return run_query_collect(s, [TEN], query, timestamp=T0)
+
+
+def test_histogram(store):
+    _ingest(store, [{"v": "1"}, {"v": "1"}, {"v": "100"}, {"v": "bad"}])
+    rows = q(store, "* | stats histogram(v) as h")
+    buckets = json.loads(rows[0]["h"])
+    assert sum(b["hits"] for b in buckets) == 3
+    # the two v=1 rows share a bucket below the v=100 bucket
+    assert buckets[0]["hits"] == 2
+    lo0 = float(buckets[0]["vmrange"].split("...")[0])
+    lo1 = float(buckets[-1]["vmrange"].split("...")[0])
+    assert lo0 <= 1 <= lo0 * 10**(1 / 9)
+    assert lo1 <= 100 and lo0 < lo1
+
+
+def test_rate(store):
+    _ingest(store, [{"v": "1"}] * 20)
+    # 10 rows land in the 10s range => rate = 10/10 = 1
+    rng = "[2025-07-28T00:00:00Z, 2025-07-28T00:00:10Z)"
+    rows = q(store, f"_time:{rng} | stats rate() r")
+    assert rows == [{"r": "1"}]
+    rows = q(store, f"_time:{rng} | stats rate_sum(v) rs")
+    assert rows == [{"rs": "1"}]
+
+
+def test_rate_without_time_filter_is_plain_count(store):
+    _ingest(store, [{"v": "1"}] * 5)
+    rows = q(store, "* | stats rate() r")
+    assert rows == [{"r": "5"}]
+
+
+def test_row_min_row_max(store):
+    _ingest(store, [{"lat": "30", "path": "/a"},
+                    {"lat": "5", "path": "/b"},
+                    {"lat": "900", "path": "/c"}])
+    rows = q(store, "* | stats row_min(lat, lat, path) rm")
+    got = json.loads(rows[0]["rm"])
+    assert got == {"lat": "5", "path": "/b"}
+    rows = q(store, "* | stats row_max(lat, lat, path) rm")
+    assert json.loads(rows[0]["rm"]) == {"lat": "900", "path": "/c"}
+
+
+def test_json_values(store):
+    _ingest(store, [{"a": "1"}, {"a": "2"}])
+    rows = q(store, "* | stats json_values(a) jv")
+    assert json.loads(rows[0]["jv"]) == [{"a": "1"}, {"a": "2"}]
+    rows = q(store, "* | stats json_values(a) limit 1 jv")
+    assert json.loads(rows[0]["jv"]) == [{"a": "1"}]
+
+
+def test_stats_if_guard(store):
+    _ingest(store, [{"_msg": "error x"}, {"_msg": "ok"}, {"_msg": "error"}])
+    rows = q(store, '* | stats count() if (error) e, count() total')
+    assert rows == [{"e": "2", "total": "3"}]
+
+
+def test_stats_roundtrip_strings():
+    from victorialogs_tpu.logsql.parser import parse_query
+    for qs in ["* | stats histogram(v) as h",
+               "* | stats rate() as r, rate_sum(x) as rs",
+               "* | stats row_min(a, b, c) as m, row_max(a) as M",
+               "* | stats json_values(a, b) limit 3 as jv",
+               '* | stats count() if (error) as e']:
+        p = parse_query(qs)
+        assert parse_query(p.to_string()).to_string() == p.to_string()
+
+
+# ---------------- memory budgets ----------------
+
+def _budget(monkeypatch, nbytes):
+    monkeypatch.setenv("VL_MEMORY_ALLOWED_BYTES", str(nbytes))
+
+
+def test_sort_memory_budget(store, monkeypatch):
+    _ingest(store, [{"v": f"value-{i}" * 10} for i in range(500)])
+    _budget(monkeypatch, 10_000)
+    with pytest.raises(QueryMemoryError, match="sort"):
+        q(store, "* | sort by (v)")
+    monkeypatch.delenv("VL_MEMORY_ALLOWED_BYTES")
+    assert len(q(store, "* | sort by (v) | limit 3")) == 3
+
+
+def test_uniq_memory_budget(store, monkeypatch):
+    _ingest(store, [{"v": f"u{i}"} for i in range(2000)])
+    _budget(monkeypatch, 10_000)
+    with pytest.raises(QueryMemoryError, match="uniq"):
+        q(store, "* | uniq by (v)")
+
+
+def test_stats_memory_budget(store, monkeypatch):
+    _ingest(store, [{"v": f"u{i}"} for i in range(3000)])
+    _budget(monkeypatch, 20_000)
+    with pytest.raises(QueryMemoryError, match="stats"):
+        q(store, "* | stats count_uniq(v) u")
+    with pytest.raises(QueryMemoryError, match="stats"):
+        q(store, "* | stats by (v) count() c")
+
+
+def test_top_memory_budget(store, monkeypatch):
+    _ingest(store, [{"v": f"u{i}"} for i in range(3000)])
+    _budget(monkeypatch, 10_000)
+    with pytest.raises(QueryMemoryError, match="top"):
+        q(store, "* | top 5 by (v)")
+
+
+def test_small_queries_fit_budget(store, monkeypatch):
+    _ingest(store, [{"v": f"u{i % 5}"} for i in range(100)])
+    _budget(monkeypatch, 1_000_000)
+    assert q(store, "* | stats count_uniq(v) u") == [{"u": "5"}]
+    assert len(q(store, "* | uniq by (v)")) == 5

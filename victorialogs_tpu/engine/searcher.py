@@ -123,6 +123,17 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
     init_subqueries(storage, tenants, q, runner=runner)
     min_ts, max_ts = q.get_time_range()
 
+    # rate()/rate_sum() divide by the time-filter range (reference
+    # Query.initStatsRateFuncsFromTimeFilter — parser.go:1218-1224)
+    if min_ts != MIN_TS and max_ts != MAX_TS:
+        from ..logsql.pipes import PipeStats
+        step_seconds = (max_ts - min_ts + 1) / 1e9
+        for p in q.pipes:
+            if isinstance(p, PipeStats):
+                for fn in p.funcs:
+                    if hasattr(fn, "step_seconds"):
+                        fn.step_seconds = step_seconds
+
     head = build_processor_chain(q.pipes, write_block or (lambda br: None))
 
     sfs: list[FilterStream] = []

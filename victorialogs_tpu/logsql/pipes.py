@@ -337,10 +337,16 @@ class PipeSort(Pipe):
         class P(Processor):
             def __init__(self, np_):
                 super().__init__(np_)
+                from ..utils.memory import MemoryBudget
                 self.blocks: list[BlockResult] = []
+                self.budget = MemoryBudget(0.2, "sort")
 
             def write_block(self, br):
-                self.blocks.append(br.materialize())
+                br = br.materialize()
+                self.budget.add(sum(
+                    sum(len(v) + 8 for v in vals)
+                    for vals in br._cols.values()) + 64)
+                self.blocks.append(br)
 
             def flush(self):
                 rows = []  # (sort_key_values, block_idx, row_idx)
@@ -415,16 +421,23 @@ class PipeUniq(Pipe):
         class P(Processor):
             def __init__(self, np_):
                 super().__init__(np_)
+                from ..utils.memory import MemoryBudget
                 # keys are (field, value) pair tuples (empty values dropped)
                 # so blocks with different column sets mix safely
                 self.seen: dict[tuple, int] = {}
+                self.budget = MemoryBudget(0.4, "uniq")
 
             def write_block(self, br):
                 fields = pipe.by or br.column_names()
                 cols = [(f, br.column(f)) for f in fields]
                 for ri in range(br.nrows):
                     key = tuple((f, c[ri]) for f, c in cols if c[ri] != "")
-                    self.seen[key] = self.seen.get(key, 0) + 1
+                    if key not in self.seen:
+                        self.seen[key] = 1
+                        self.budget.add(sum(
+                            len(f) + len(v) for f, v in key) + 80)
+                    else:
+                        self.seen[key] += 1
 
             def flush(self):
                 keys = sorted(self.seen)
@@ -501,8 +514,12 @@ class PipeStats(Pipe):
         class P(Processor):
             def __init__(self, np_):
                 super().__init__(np_)
+                from ..utils.memory import MemoryBudget
                 # group key -> list[state per func]
                 self.groups: dict[tuple, list] = {}
+                self.budget = MemoryBudget(0.3, "stats")
+                for fn in pipe.funcs:
+                    fn.budget = self.budget
 
             def write_block(self, br):
                 n = br.nrows
@@ -528,13 +545,21 @@ class PipeStats(Pipe):
                 else:
                     rows_by_key = {(): list(range(n))}
                 func_cols = [fn.block_cols(br) for fn in pipe.funcs]
+                # per-func `if (...)` row guards
+                iff_masks = [None if fn.iff is None
+                             else fn.iff.apply_to_values(br.column, n)
+                             for fn in pipe.funcs]
                 for key, idxs in rows_by_key.items():
                     states = self.groups.get(key)
                     if states is None:
                         states = [fn.new_state() for fn in pipe.funcs]
                         self.groups[key] = states
+                        self.budget.add(
+                            sum(len(k) for k in key) + 80)
                     for k, fn in enumerate(pipe.funcs):
-                        states[k] = fn.update(states[k], func_cols[k], idxs)
+                        use = idxs if iff_masks[k] is None else \
+                            [i for i in idxs if iff_masks[k][i]]
+                        states[k] = fn.update(states[k], func_cols[k], use)
 
             def flush(self):
                 by_names = [b.name for b in pipe.by]
@@ -787,6 +812,11 @@ def parse_stats_func(lex: Lexer):
     if lex.is_keyword("limit") and hasattr(fn, "limit"):
         lex.next_token()
         fn.limit = _parse_uint(lex, "limit")
+    # optional per-func row guard: `count() if (error)` (reference
+    # pipe_stats.go statsFuncs iff)
+    if lex.is_keyword("if"):
+        from .pipes_transform import parse_if_filter
+        fn.iff = parse_if_filter(lex)
     if lex.is_keyword("as"):
         lex.next_token()
         fn.out_name = _parse_field_name(lex)
@@ -820,6 +850,12 @@ _STATS_FUNCS = {
     "median": sf.StatsMedian,
     "quantile": _quantile_ctor,
     "row_any": sf.StatsRowAny,
+    "histogram": sf.StatsHistogram,
+    "rate": sf.StatsRate,
+    "rate_sum": sf.StatsRateSum,
+    "row_min": sf.StatsRowMin,
+    "row_max": sf.StatsRowMax,
+    "json_values": sf.StatsJSONValues,
 }
 
 
@@ -887,3 +923,8 @@ _PIPE_PARSERS = {
 
 def register_pipe(name: str, parse_fn) -> None:
     _PIPE_PARSERS[name] = parse_fn
+
+
+# transform pipes (extract/format/math/unpack/replace/top/...) register
+# themselves on import; must come after the registry exists
+from . import pipes_transform  # noqa: E402,F401  (registration side effect)

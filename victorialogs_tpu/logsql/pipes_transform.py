@@ -1,0 +1,1724 @@
+"""LogsQL transform pipes: extract/format/math/unpack/replace/top/... .
+
+The second half of the reference pipe registry (lib/logstorage/pipe.go:
+119-386) — row-transforming pipes built on the same streaming Processor
+contract as pipes.py.  All of them are stateless per-block transforms except
+`top`, `field_names` and `field_values`, which accumulate and emit at flush.
+
+Each pipe supports the reference's optional `if (filter)` guard where the
+reference does (pipe_extract.go:135-143 pattern: rows failing the guard pass
+through unchanged)."""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import math
+import random
+import re
+import time as _time
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..engine.block_result import BlockResult
+from .duration import parse_duration
+from .lexer import Lexer, quote_token_if_needed
+from .matchers import parse_number
+from .pipes import (ParseError, Pipe, Processor, _parse_field_name,
+                    _parse_uint, register_pipe)
+
+
+# ---------------- shared helpers ----------------
+
+def parse_if_filter(lex: Lexer):
+    """Parse `if (filter)` — 'if' already current token."""
+    lex.next_token()
+    if not lex.is_keyword("("):
+        raise ParseError("missing '(' after if")
+    lex.next_token()
+    from .parser import parse_filter_or
+    f = parse_filter_or(lex, "")
+    if not lex.is_keyword(")"):
+        raise ParseError("missing ')' after if filter")
+    lex.next_token()
+    return f
+
+
+def _maybe_if(lex: Lexer):
+    if lex.is_keyword("if"):
+        return parse_if_filter(lex)
+    return None
+
+
+def _if_str(iff) -> str:
+    return f" if ({iff.to_string()})" if iff is not None else ""
+
+
+def _if_mask(iff, br: BlockResult):
+    if iff is None:
+        return None
+    return iff.apply_to_values(br.column, br.nrows)
+
+
+def _parse_compound_arg(lex: Lexer) -> str:
+    from .parser import _get_compound_token
+    return _get_compound_token(lex, stop=(",", "(", ")", "|", ""))
+
+
+# ---------------- pattern engine (reference pattern.go) ----------------
+
+@dataclass
+class PatternStep:
+    prefix: str
+    field: str = ""
+    opt: str = ""
+
+
+_HTML_UNESCAPES = {"&lt;": "<", "&gt;": ">", "&amp;": "&",
+                   "&quot;": '"', "&apos;": "'"}
+
+
+def _html_unescape(s: str) -> str:
+    for k, v in _HTML_UNESCAPES.items():
+        s = s.replace(k, v)
+    return s
+
+
+class Pattern:
+    """'text<field>text...' extraction pattern (reference pattern.go:1-251).
+
+    Greedy-less matching: each unquoted field matches up to the next literal
+    prefix; `<q:field>` tries Go-unquoting first; prefixes between fields
+    must be non-empty; prefixes support &lt;/&gt; escapes."""
+
+    def __init__(self, pattern_str: str):
+        self.pattern_str = pattern_str
+        self.steps = self._parse_steps(pattern_str)
+        if not any(st.field for st in self.steps):
+            raise ParseError(
+                f"pattern {pattern_str!r} needs at least one <field>")
+        for i in range(1, len(self.steps)):
+            if not self.steps[i].prefix:
+                raise ParseError(
+                    f"missing delimiter between <{self.steps[i-1].field}> "
+                    f"and <{self.steps[i].field}>")
+        self.fields = [st.field for st in self.steps if st.field]
+
+    @staticmethod
+    def _parse_steps(s: str) -> list:
+        steps = []
+        i, n = 0, len(s)
+        prefix = []
+        while i < n:
+            c = s[i]
+            if c != "<":
+                prefix.append(c)
+                i += 1
+                continue
+            j = s.find(">", i + 1)
+            if j < 0:
+                prefix.append(c)
+                i += 1
+                continue
+            name = s[i + 1:j]
+            opt = ""
+            if ":" in name:
+                opt, name = name.split(":", 1)
+                opt = opt.strip()
+            steps.append(PatternStep(_html_unescape("".join(prefix)),
+                                     name.strip(), opt))
+            prefix = []
+            i = j + 1
+        if prefix:
+            steps.append(PatternStep(_html_unescape("".join(prefix))))
+        if steps and not steps[0].prefix and not steps[0].field and \
+                len(steps) > 1:
+            steps = steps[1:]
+        return steps
+
+    def apply(self, s: str) -> dict:
+        """Extract fields from s; mismatch => all fields empty."""
+        out = {f: "" for f in self.fields}
+        steps = self.steps
+        idx = s.find(steps[0].prefix) if steps[0].prefix else 0
+        if idx < 0:
+            return out
+        s = s[idx + len(steps[0].prefix):]
+        for i, st in enumerate(steps):
+            nxt = steps[i + 1].prefix if i + 1 < len(steps) else ""
+            if st.opt != "plain":
+                us, off = _try_unquote_prefix(s)
+                if off >= 0:
+                    if st.field:
+                        out[st.field] = us
+                    s = s[off:]
+                    if not s.startswith(nxt):
+                        return {f: "" for f in self.fields}
+                    s = s[len(nxt):]
+                    continue
+            if not nxt:
+                if st.field:
+                    out[st.field] = s
+                return out
+            pos = s.find(nxt)
+            if pos < 0:
+                return {f: "" for f in self.fields}
+            if st.field:
+                out[st.field] = s[:pos]
+            s = s[pos + len(nxt):]
+        return out
+
+
+def _try_unquote_prefix(s: str):
+    """Go strconv.QuotedPrefix + Unquote; returns (value, consumed|-1)."""
+    if not s or s[0] not in "\"`":
+        return "", -1
+    q = s[0]
+    if q == "`":
+        j = s.find("`", 1)
+        if j < 0:
+            return "", -1
+        return s[1:j], j + 1
+    i, n = 1, len(s)
+    out = []
+    while i < n:
+        c = s[i]
+        if c == '"':
+            return "".join(out), i + 1
+        if c == "\\" and i + 1 < n:
+            e = s[i + 1]
+            mapping = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                       '"': '"', "'": "'", "a": "\a", "b": "\b",
+                       "f": "\f", "v": "\v", "/": "/"}
+            if e in mapping:
+                out.append(mapping[e])
+                i += 2
+                continue
+            if e == "x" and i + 3 < n:
+                try:
+                    out.append(chr(int(s[i + 2:i + 4], 16)))
+                    i += 4
+                    continue
+                except ValueError:
+                    return "", -1
+            if e == "u" and i + 5 < n:
+                try:
+                    out.append(chr(int(s[i + 2:i + 6], 16)))
+                    i += 6
+                    continue
+                except ValueError:
+                    return "", -1
+            return "", -1
+        out.append(c)
+        i += 1
+    return "", -1
+
+
+# ---------------- extract / extract_regexp ----------------
+
+def _merge_extracted(br, out_cols, names, mask, keep_original, skip_empty):
+    """Apply keep_original_fields / skip_empty_results / if-mask merging."""
+    for name in names:
+        newv = out_cols[name]
+        if keep_original or skip_empty or mask is not None:
+            orig = br.column(name) if br.has_column(name) else [""] * br.nrows
+            for i in range(br.nrows):
+                if mask is not None and not mask[i]:
+                    newv[i] = orig[i]
+                elif keep_original and orig[i] != "":
+                    newv[i] = orig[i]
+                elif skip_empty and newv[i] == "" and orig[i] != "":
+                    newv[i] = orig[i]
+
+
+@dataclass(repr=False)
+class PipeExtract(Pipe):
+    pattern_str: str
+    from_field: str = "_msg"
+    keep_original_fields: bool = False
+    skip_empty_results: bool = False
+    iff: object = None
+
+    name = "extract"
+
+    def __post_init__(self):
+        self.ptn = Pattern(self.pattern_str)
+
+    def to_string(self):
+        s = "extract" + _if_str(self.iff) + " " + \
+            quote_token_if_needed(self.pattern_str)
+        if self.from_field != "_msg":
+            s += " from " + quote_token_if_needed(self.from_field)
+        if self.keep_original_fields:
+            s += " keep_original_fields"
+        if self.skip_empty_results:
+            s += " skip_empty_results"
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = {self.from_field}
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def output_fields(self):
+        return list(self.ptn.fields)
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                vals = br.column(pipe.from_field)
+                out_cols = {f: [""] * br.nrows for f in pipe.ptn.fields}
+                prev_v, prev = None, None
+                for i in range(br.nrows):
+                    if mask is not None and not mask[i]:
+                        continue
+                    v = vals[i]
+                    if v != prev_v:
+                        prev_v, prev = v, pipe.ptn.apply(v)
+                    for f in pipe.ptn.fields:
+                        out_cols[f][i] = prev[f]
+                _merge_extracted(br, out_cols, pipe.ptn.fields, mask,
+                                 pipe.keep_original_fields,
+                                 pipe.skip_empty_results)
+                out = br.materialize()
+                for f in pipe.ptn.fields:
+                    out._cols[f] = out_cols[f]
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeExtractRegexp(Pipe):
+    pattern_str: str
+    from_field: str = "_msg"
+    keep_original_fields: bool = False
+    skip_empty_results: bool = False
+    iff: object = None
+
+    name = "extract_regexp"
+
+    def __post_init__(self):
+        self.re = re.compile(self.pattern_str)
+        self.fields = [g for g in self.re.groupindex]
+        if not self.fields:
+            raise ParseError(
+                "extract_regexp needs at least one named group "
+                "(?P<name>...)")
+
+    def to_string(self):
+        s = "extract_regexp" + _if_str(self.iff) + " " + \
+            quote_token_if_needed(self.pattern_str)
+        if self.from_field != "_msg":
+            s += " from " + quote_token_if_needed(self.from_field)
+        if self.keep_original_fields:
+            s += " keep_original_fields"
+        if self.skip_empty_results:
+            s += " skip_empty_results"
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = {self.from_field}
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                vals = br.column(pipe.from_field)
+                out_cols = {f: [""] * br.nrows for f in pipe.fields}
+                for i in range(br.nrows):
+                    if mask is not None and not mask[i]:
+                        continue
+                    m = pipe.re.search(vals[i])
+                    if m is None:
+                        continue
+                    for f in pipe.fields:
+                        out_cols[f][i] = m.group(f) or ""
+                _merge_extracted(br, out_cols, pipe.fields, mask,
+                                 pipe.keep_original_fields,
+                                 pipe.skip_empty_results)
+                out = br.materialize()
+                for f in pipe.fields:
+                    out._cols[f] = out_cols[f]
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+# ---------------- format ----------------
+
+def _format_duration(ns: float) -> str:
+    if math.isnan(ns):
+        return ""
+    ns = int(ns)
+    if ns == 0:
+        return "0"
+    sign = "-" if ns < 0 else ""
+    ns = abs(ns)
+    parts = []
+    for unit, width in (("w", 7 * 86400 * 10**9), ("d", 86400 * 10**9),
+                        ("h", 3600 * 10**9), ("m", 60 * 10**9),
+                        ("s", 10**9), ("ms", 10**6), ("µs", 10**3),
+                        ("ns", 1)):
+        if ns >= width:
+            parts.append(f"{ns // width}{unit}")
+            ns %= width
+        if len(parts) >= 3:
+            break
+    return sign + "".join(parts)
+
+
+def _format_value(v: str, opt: str) -> str:
+    """Apply a format option (reference pipe_format.go:180-250)."""
+    if opt in ("", "plain"):
+        return v
+    if opt == "q":
+        return json.dumps(v, ensure_ascii=False)
+    if opt == "uc":
+        return v.upper()
+    if opt == "lc":
+        return v.lower()
+    if opt == "hexencode":
+        return v.encode("utf-8").hex()
+    if opt == "hexdecode":
+        try:
+            return bytes.fromhex(v).decode("utf-8", "replace")
+        except ValueError:
+            return v
+    if opt == "base64encode":
+        return base64.b64encode(v.encode("utf-8")).decode()
+    if opt == "base64decode":
+        try:
+            return base64.b64decode(v, validate=True).decode("utf-8",
+                                                             "replace")
+        except (ValueError, binascii.Error):
+            return v
+    if opt == "urlencode":
+        from urllib.parse import quote
+        return quote(v, safe="")
+    if opt == "urldecode":
+        from urllib.parse import unquote
+        return unquote(v)
+    if opt == "duration":
+        n = parse_number(v)
+        return _format_duration(n) if not math.isnan(n) else v
+    if opt == "duration_seconds":
+        d = parse_duration(v)
+        return str(d // 10**9) if d is not None else v
+    if opt == "ipv4":
+        n = parse_number(v)
+        if math.isnan(n) or not 0 <= n <= 2**32 - 1:
+            return v
+        n = int(n)
+        return f"{(n >> 24) & 255}.{(n >> 16) & 255}." \
+               f"{(n >> 8) & 255}.{n & 255}"
+    if opt == "time":
+        n = parse_number(v)
+        if math.isnan(n):
+            return v
+        from ..engine.block_result import format_rfc3339
+        n = int(n)
+        # heuristically scale unix seconds/millis/micros to nanos
+        if abs(n) < 10**11:
+            n *= 10**9
+        elif abs(n) < 10**14:
+            n *= 10**6
+        elif abs(n) < 10**17:
+            n *= 10**3
+        return format_rfc3339(n)
+    return v
+
+
+@dataclass(repr=False)
+class PipeFormat(Pipe):
+    format_str: str
+    result_field: str = "_msg"
+    keep_original_fields: bool = False
+    skip_empty_results: bool = False
+    iff: object = None
+
+    name = "format"
+
+    def __post_init__(self):
+        self.steps = Pattern._parse_steps(self.format_str)
+
+    def to_string(self):
+        s = "format" + _if_str(self.iff) + " " + \
+            quote_token_if_needed(self.format_str)
+        if self.result_field != "_msg":
+            s += " as " + quote_token_if_needed(self.result_field)
+        if self.keep_original_fields:
+            s += " keep_original_fields"
+        if self.skip_empty_results:
+            s += " skip_empty_results"
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = {st.field for st in self.steps if st.field}
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                cols = {st.field: br.column(st.field)
+                        for st in pipe.steps if st.field}
+                orig = br.column(pipe.result_field) \
+                    if br.has_column(pipe.result_field) else [""] * br.nrows
+                out_vals = []
+                for i in range(br.nrows):
+                    if mask is not None and not mask[i]:
+                        out_vals.append(orig[i])
+                        continue
+                    buf = []
+                    for st in pipe.steps:
+                        buf.append(st.prefix)
+                        if st.field:
+                            buf.append(_format_value(cols[st.field][i],
+                                                     st.opt))
+                    v = "".join(buf)
+                    if (pipe.keep_original_fields or
+                            (pipe.skip_empty_results and v == "")) and \
+                            orig[i] != "":
+                        v = orig[i]
+                    out_vals.append(v)
+                out = br.materialize()
+                out._cols[pipe.result_field] = out_vals
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+# ---------------- math ----------------
+
+def _math_num(s: str) -> float:
+    v = parse_number(s)
+    if not math.isnan(v):
+        return v
+    d = parse_duration(s)
+    if d is not None:
+        return float(d)
+    from ..engine.block_result import parse_rfc3339
+    t = parse_rfc3339(s)
+    if t is not None:
+        return float(t)
+    return math.nan
+
+
+def _to_u64(v: float) -> int:
+    if math.isnan(v) or math.isinf(v):
+        return 0
+    return int(v) & (2**64 - 1)
+
+
+_MATH_BINOPS = {
+    "^": (1, lambda a, b: math.nan if (math.isnan(a) or math.isnan(b))
+          else _safe_pow(a, b)),
+    "*": (2, lambda a, b: a * b),
+    "/": (2, lambda a, b: a / b if b else math.nan),
+    "%": (2, lambda a, b: math.fmod(a, b) if b else math.nan),
+    "+": (3, lambda a, b: a + b),
+    "-": (3, lambda a, b: a - b),
+    "&": (4, lambda a, b: float(_to_u64(a) & _to_u64(b))),
+    "xor": (5, lambda a, b: float(_to_u64(a) ^ _to_u64(b))),
+    "or": (6, lambda a, b: float(_to_u64(a) | _to_u64(b))),
+    "default": (10, lambda a, b: b if math.isnan(a) else a),
+}
+
+
+def _safe_pow(a, b):
+    try:
+        r = a ** b
+        return r if isinstance(r, (int, float)) else math.nan
+    except (OverflowError, ValueError, ZeroDivisionError):
+        return math.nan
+
+
+def _m_round(args):
+    if len(args) == 1:
+        v = args[0]
+        return float(round(v)) if not math.isnan(v) else v
+    v, nearest = args[0], args[1]
+    if math.isnan(v) or math.isnan(nearest) or nearest == 0:
+        return math.nan
+    return round(v / nearest) * nearest
+
+
+_MATH_FUNCS = {
+    "abs": (1, lambda a: abs(a[0])),
+    "exp": (1, lambda a: _safe_pow(math.e, a[0])),
+    "ln": (1, lambda a: math.log(a[0]) if a[0] > 0 else math.nan),
+    "max": (-1, lambda a: max(a) if a else math.nan),
+    "min": (-1, lambda a: min(a) if a else math.nan),
+    "round": (-2, _m_round),
+    "ceil": (1, lambda a: float(math.ceil(a[0]))
+             if not (math.isnan(a[0]) or math.isinf(a[0])) else a[0]),
+    "floor": (1, lambda a: float(math.floor(a[0]))
+              if not (math.isnan(a[0]) or math.isinf(a[0])) else a[0]),
+    "now": (0, lambda a: float(_time.time_ns())),
+    "rand": (0, lambda a: random.random()),
+}
+
+
+class MathExpr:
+    def __init__(self, kind, value=None, args=None, op=None):
+        self.kind = kind          # const | field | func | binop
+        self.value = value
+        self.args = args or []
+        self.op = op
+
+    def needed_fields(self) -> set:
+        if self.kind == "field":
+            return {self.value}
+        out = set()
+        for a in self.args:
+            out |= a.needed_fields()
+        return out
+
+    def eval_row(self, get, i) -> float:
+        k = self.kind
+        if k == "const":
+            return self.value
+        if k == "field":
+            return _math_num(get(self.value)[i])
+        vals = [a.eval_row(get, i) for a in self.args]
+        if k == "func":
+            try:
+                return _MATH_FUNCS[self.op][1](vals)
+            except (ValueError, OverflowError):
+                return math.nan
+        fn = _MATH_BINOPS[self.op][1]
+        try:
+            return fn(vals[0], vals[1])
+        except (ValueError, OverflowError, ZeroDivisionError):
+            return math.nan
+
+    def to_string(self) -> str:
+        if self.kind == "const":
+            from .stats_funcs import format_number
+            return format_number(self.value)
+        if self.kind == "field":
+            return quote_token_if_needed(self.value)
+        if self.kind == "func":
+            return f"{self.op}({', '.join(a.to_string() for a in self.args)})"
+        return f"({self.args[0].to_string()} {self.op} " \
+               f"{self.args[1].to_string()})"
+
+
+def parse_math_expr(lex: Lexer) -> MathExpr:
+    left = _parse_math_operand(lex)
+    return _parse_math_binop(lex, left, 20)
+
+
+def _parse_math_binop(lex: Lexer, left: MathExpr, max_prio: int) -> MathExpr:
+    while True:
+        op = lex.token.lower()
+        if op not in _MATH_BINOPS:
+            return left
+        prio = _MATH_BINOPS[op][0]
+        if prio > max_prio:
+            return left
+        lex.next_token()
+        right = _parse_math_operand(lex)
+        # bind tighter ops on the right first
+        while True:
+            nop = lex.token.lower()
+            if nop in _MATH_BINOPS and _MATH_BINOPS[nop][0] < prio:
+                right = _parse_math_binop(lex, right,
+                                          _MATH_BINOPS[nop][0])
+                continue
+            break
+        left = MathExpr("binop", args=[left, right], op=op)
+
+
+def _parse_math_operand(lex: Lexer) -> MathExpr:
+    tok = lex.token
+    low = tok.lower()
+    if lex.is_keyword("("):
+        lex.next_token()
+        e = parse_math_expr(lex)
+        if not lex.is_keyword(")"):
+            raise ParseError("missing ')' in math expr")
+        lex.next_token()
+        return e
+    if low in _MATH_FUNCS:
+        lex.next_token()
+        if not lex.is_keyword("("):
+            # field named like a function
+            return MathExpr("field", value=tok)
+        lex.next_token()
+        args = []
+        while not lex.is_keyword(")"):
+            if lex.is_keyword(","):
+                lex.next_token()
+                continue
+            args.append(parse_math_expr(lex))
+        lex.next_token()
+        arity = _MATH_FUNCS[low][0]
+        if arity >= 0 and len(args) != arity:
+            raise ParseError(f"{low}() expects {arity} args")
+        if arity == -2 and not 1 <= len(args) <= 2:
+            raise ParseError(f"{low}() expects 1 or 2 args")
+        if arity == -1 and not args:
+            raise ParseError(f"{low}() expects at least one arg")
+        return MathExpr("func", args=args, op=low)
+    if lex.is_keyword("-"):
+        lex.next_token()
+        inner = _parse_math_operand(lex)
+        if inner.kind == "const":
+            return MathExpr("const", value=-inner.value)
+        return MathExpr("binop", args=[MathExpr("const", value=0.0), inner],
+                        op="-")
+    if lex.is_keyword("+"):
+        lex.next_token()
+        return _parse_math_operand(lex)
+    v = _math_num(tok)
+    if tok and not math.isnan(v) and (tok[0].isdigit() or
+                                      tok[0] in ".-+" or
+                                      low in ("inf", "nan")):
+        lex.next_token()
+        return MathExpr("const", value=v)
+    name = _parse_field_name(lex)
+    if not name:
+        raise ParseError(f"bad math operand near {tok!r}")
+    return MathExpr("field", value=name)
+
+
+@dataclass(repr=False)
+class PipeMath(Pipe):
+    entries: list  # [(MathExpr, result_field)]
+
+    name = "math"
+
+    def to_string(self):
+        return "math " + ", ".join(
+            f"{e.to_string()} as {quote_token_if_needed(r)}"
+            for e, r in self.entries)
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = set()
+        for e, _r in self.entries:
+            out |= e.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                from .stats_funcs import format_number
+                out = br.materialize()
+
+                def get(name):
+                    return out.column(name) if out.has_column(name) \
+                        else [""] * out.nrows
+                for expr, res in pipe.entries:
+                    vals = []
+                    for i in range(br.nrows):
+                        v = expr.eval_row(get, i)
+                        vals.append("NaN" if math.isnan(v)
+                                    else format_number(v))
+                    out._cols[res] = vals
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+# ---------------- unpack_json / unpack_logfmt / unpack_syslog ----------------
+
+def _flatten_json(obj, prefix="") -> list:
+    """Flatten a JSON object into (path, scalar-string) pairs the way the
+    reference unpacks (nested keys joined with '.')."""
+    out = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                out.extend(_flatten_json(v, key))
+            elif isinstance(v, list):
+                out.append((key, json.dumps(v, separators=(",", ":"),
+                                            ensure_ascii=False)))
+            elif isinstance(v, bool):
+                out.append((key, "true" if v else "false"))
+            elif v is None:
+                out.append((key, ""))
+            elif isinstance(v, str):
+                out.append((key, v))
+            else:
+                from .stats_funcs import format_number
+                out.append((key, format_number(v)
+                            if isinstance(v, float) else str(v)))
+    return out
+
+
+def parse_logfmt(s: str) -> list:
+    """k=v pairs with Go-quoted values (reference logfmt_parser.go)."""
+    out = []
+    i, n = 0, len(s)
+    while i < n:
+        while i < n and s[i] == " ":
+            i += 1
+        if i >= n:
+            break
+        eq = s.find("=", i)
+        if eq < 0:
+            break
+        key = s[i:eq].strip()
+        i = eq + 1
+        if i < n and s[i] in "\"`":
+            v, off = _try_unquote_prefix(s[i:])
+            if off >= 0:
+                out.append((key, v))
+                i += off
+                continue
+        sp = s.find(" ", i)
+        if sp < 0:
+            sp = n
+        out.append((key, s[i:sp]))
+        i = sp
+    return out
+
+
+class _UnpackBase(Pipe):
+    """Shared unpack scaffolding: from-field, field filter, result_prefix,
+    keep_original_fields/skip_empty_results, if-guard."""
+
+    def __init__(self, from_field="_msg", fields=None, result_prefix="",
+                 keep_original_fields=False, skip_empty_results=False,
+                 iff=None):
+        self.from_field = from_field
+        self.fields = fields or []
+        self.result_prefix = result_prefix
+        self.keep_original_fields = keep_original_fields
+        self.skip_empty_results = skip_empty_results
+        self.iff = iff
+
+    def _unpack_value(self, v: str) -> list:
+        raise NotImplementedError
+
+    def to_string(self):
+        s = self.name + _if_str(self.iff)
+        if self.from_field != "_msg":
+            s += " from " + quote_token_if_needed(self.from_field)
+        if self.fields:
+            s += " fields (" + ", ".join(self.fields) + ")"
+        if self.result_prefix:
+            s += " result_prefix " + quote_token_if_needed(self.result_prefix)
+        if self.keep_original_fields:
+            s += " keep_original_fields"
+        if self.skip_empty_results:
+            s += " skip_empty_results"
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = {self.from_field}
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+        allow = set(pipe.fields) or None
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                vals = br.column(pipe.from_field)
+                out_cols: dict[str, list] = {}
+                prev_v, prev = None, None
+                for i in range(br.nrows):
+                    if mask is not None and not mask[i]:
+                        continue
+                    v = vals[i]
+                    if v != prev_v:
+                        prev_v, prev = v, pipe._unpack_value(v)
+                    for k, val in prev:
+                        if allow is not None and k not in allow:
+                            continue
+                        key = pipe.result_prefix + k
+                        col = out_cols.get(key)
+                        if col is None:
+                            col = out_cols[key] = [""] * br.nrows
+                        col[i] = val
+                names = list(out_cols)
+                _merge_extracted(br, out_cols, names, mask,
+                                 pipe.keep_original_fields,
+                                 pipe.skip_empty_results)
+                out = br.materialize()
+                for k in names:
+                    out._cols[k] = out_cols[k]
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+class PipeUnpackJson(_UnpackBase):
+    name = "unpack_json"
+
+    def _unpack_value(self, v):
+        try:
+            obj = json.loads(v)
+        except (ValueError, RecursionError):
+            return []
+        return _flatten_json(obj) if isinstance(obj, dict) else []
+
+
+class PipeUnpackLogfmt(_UnpackBase):
+    name = "unpack_logfmt"
+
+    def _unpack_value(self, v):
+        return parse_logfmt(v)
+
+
+class PipeUnpackSyslog(_UnpackBase):
+    name = "unpack_syslog"
+
+    def __init__(self, *args, offset_ns=0, **kw):
+        super().__init__(*args, **kw)
+        self.offset_ns = offset_ns
+
+    def to_string(self):
+        s = super().to_string()
+        if self.offset_ns:
+            # render offset right after the from clause like the reference
+            s += f" offset {self.offset_ns // 3600_000_000_000}h"
+        return s
+
+    def _unpack_value(self, v):
+        from ..server.syslog import parse_syslog_message
+        fields = parse_syslog_message(v)
+        return [(k, val) for k, val in fields if k != "_msg"] + \
+            [(k, val) for k, val in fields if k == "_msg" and val != v]
+
+
+class PipeUnpackWords(_UnpackBase):
+    """unpack_words: tokenize the field into a JSON array of words
+    (reference pipe_unpack_words.go)."""
+
+    name = "unpack_words"
+
+    def __init__(self, from_field="_msg", dst_field="words",
+                 drop_duplicates=False, iff=None):
+        super().__init__(from_field=from_field, iff=iff)
+        self.dst_field = dst_field
+        self.drop_duplicates = drop_duplicates
+
+    def to_string(self):
+        s = "unpack_words"
+        if self.from_field != "_msg":
+            s += " from " + quote_token_if_needed(self.from_field)
+        if self.dst_field != "words":
+            s += " as " + quote_token_if_needed(self.dst_field)
+        if self.drop_duplicates:
+            s += " drop_duplicates"
+        return s
+
+    def _unpack_value(self, v):
+        from ..utils.tokenizer import tokenize_string
+        toks = tokenize_string(v)
+        if self.drop_duplicates:
+            toks = list(dict.fromkeys(toks))
+        return [(self.dst_field,
+                 json.dumps(toks, separators=(",", ":"),
+                            ensure_ascii=False))]
+
+
+# ---------------- replace / replace_regexp ----------------
+
+@dataclass(repr=False)
+class PipeReplace(Pipe):
+    old: str
+    new: str
+    field: str = "_msg"
+    limit: int = 0
+    iff: object = None
+    regexp: bool = False
+
+    name = "replace"
+
+    def __post_init__(self):
+        if self.regexp:
+            self._re = re.compile(self.old)
+
+    def to_string(self):
+        nm = "replace_regexp" if self.regexp else "replace"
+        s = nm + _if_str(self.iff) + \
+            f" ({quote_token_if_needed(self.old)}, " \
+            f"{quote_token_if_needed(self.new)})"
+        if self.field != "_msg":
+            s += " at " + quote_token_if_needed(self.field)
+        if self.limit:
+            s += f" limit {self.limit}"
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        out = {self.field}
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                vals = br.column(pipe.field)
+                limit = pipe.limit if pipe.limit > 0 else 0
+                out_vals = []
+                for i, v in enumerate(vals):
+                    if mask is not None and not mask[i]:
+                        out_vals.append(v)
+                        continue
+                    if pipe.regexp:
+                        out_vals.append(pipe._re.sub(pipe.new, v,
+                                                     count=limit))
+                    else:
+                        out_vals.append(v.replace(pipe.old, pipe.new,
+                                                  limit or -1))
+                out = br.materialize()
+                out._cols[pipe.field] = out_vals
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+# ---------------- top ----------------
+
+@dataclass(repr=False)
+class PipeTop(Pipe):
+    by: list
+    limit: int = 10
+    hits_field: str = "hits"
+    rank_field: str = ""
+
+    name = "top"
+
+    def to_string(self):
+        s = "top"
+        if self.limit != 10:
+            s += f" {self.limit}"
+        if self.by:
+            s += " by (" + ", ".join(self.by) + ")"
+        if self.hits_field != "hits":
+            s += " hits as " + quote_token_if_needed(self.hits_field)
+        if self.rank_field:
+            s += " rank as " + quote_token_if_needed(self.rank_field)
+        return s
+
+    def needed_fields(self):
+        return set(self.by)
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                from ..utils.memory import MemoryBudget
+                self.counts: dict[tuple, int] = {}
+                self.budget = MemoryBudget(0.4, "top")
+
+            def write_block(self, br):
+                fields = pipe.by or br.column_names()
+                cols = [br.column(f) for f in fields]
+                self._fields = fields
+                for i in range(br.nrows):
+                    key = tuple(c[i] for c in cols)
+                    if key not in self.counts:
+                        self.counts[key] = 1
+                        self.budget.add(sum(len(k) for k in key) + 80)
+                    else:
+                        self.counts[key] += 1
+
+            def flush(self):
+                fields = getattr(self, "_fields", pipe.by)
+                # hits desc, then key asc (reference pipe_top ordering)
+                items = sorted(self.counts.items(),
+                               key=lambda kv: (-kv[1], kv[0]))
+                items = items[:pipe.limit]
+                cols = {f: [k[j] for k, _ in items]
+                        for j, f in enumerate(fields)}
+                cols[pipe.hits_field] = [str(h) for _, h in items]
+                if pipe.rank_field:
+                    cols[pipe.rank_field] = [str(i + 1)
+                                             for i in range(len(items))]
+                self.next_p.write_block(BlockResult.from_columns(cols)
+                                        if items else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+# ---------------- drop_empty_fields / len / pack / sample / unroll ----------
+
+@dataclass(repr=False)
+class PipeDropEmptyFields(Pipe):
+    name = "drop_empty_fields"
+
+    def to_string(self):
+        return "drop_empty_fields"
+
+    def can_live_tail(self):
+        return True
+
+    def make_processor(self, next_p):
+        class P(Processor):
+            def write_block(self, br):
+                out = br.materialize()
+                # drop all-empty columns; drop rows with no non-empty field
+                keep_cols = {n: v for n, v in out._cols.items()
+                             if any(x != "" for x in v)}
+                if len(keep_cols) != len(out._cols):
+                    out._cols = keep_cols
+                if keep_cols:
+                    rows_mask = np.zeros(out.nrows, dtype=bool)
+                    for v in keep_cols.values():
+                        for i, x in enumerate(v):
+                            if x != "":
+                                rows_mask[i] = True
+                    if not rows_mask.all():
+                        out = out.filter_rows(rows_mask)
+                elif out.nrows:
+                    out = BlockResult(0)
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeLen(Pipe):
+    field: str
+    result_field: str = "_msg"
+
+    name = "len"
+
+    def to_string(self):
+        s = f"len({quote_token_if_needed(self.field)})"
+        if self.result_field != "_msg":
+            s += " as " + quote_token_if_needed(self.result_field)
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        return {self.field}
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                vals = br.column(pipe.field)
+                out = br.materialize()
+                out._cols[pipe.result_field] = [
+                    str(len(v.encode("utf-8"))) for v in vals]
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipePackJson(Pipe):
+    result_field: str = "_msg"
+    fields: list = dc_field(default_factory=list)
+    logfmt: bool = False
+
+    name = "pack_json"
+
+    def to_string(self):
+        s = "pack_logfmt" if self.logfmt else "pack_json"
+        if self.fields:
+            s += " fields (" + ", ".join(self.fields) + ")"
+        if self.result_field != "_msg":
+            s += " as " + quote_token_if_needed(self.result_field)
+        return s
+
+    def can_live_tail(self):
+        return True
+
+    def needed_fields(self):
+        return set(self.fields)
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                names = pipe.fields or br.column_names()
+                cols = [(n, br.column(n)) for n in names]
+                out_vals = []
+                for i in range(br.nrows):
+                    if pipe.logfmt:
+                        parts = []
+                        for n, c in cols:
+                            v = c[i]
+                            if re.search(r'[\s"=]', v) or v == "":
+                                v = json.dumps(v, ensure_ascii=False)
+                            parts.append(f"{n}={v}")
+                        out_vals.append(" ".join(parts))
+                    else:
+                        out_vals.append(json.dumps(
+                            {n: c[i] for n, c in cols},
+                            separators=(",", ":"), ensure_ascii=False))
+                out = br.materialize()
+                out._cols[pipe.result_field] = out_vals
+                self.next_p.write_block(out)
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeSample(Pipe):
+    n: int
+
+    name = "sample"
+
+    def to_string(self):
+        return f"sample {self.n}"
+
+    def can_live_tail(self):
+        return True
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.rng = random.Random()
+                self.next_row = self._step() - 1
+                self.seen = 0
+
+            def _step(self):
+                # expected-gap sampling: mean gap == n (pipe_sample.go)
+                if pipe.n <= 1:
+                    return 1
+                return 1 + int(self.rng.uniform(0, 2 * (pipe.n - 1)))
+
+            def write_block(self, br):
+                if pipe.n <= 1:
+                    self.next_p.write_block(br)
+                    return
+                keep = []
+                lo = self.seen
+                hi = self.seen + br.nrows
+                while self.next_row < hi:
+                    keep.append(self.next_row - lo)
+                    self.next_row += self._step()
+                self.seen = hi
+                if keep:
+                    mask = np.zeros(br.nrows, dtype=bool)
+                    mask[keep] = True
+                    self.next_p.write_block(br.filter_rows(mask))
+        return P(next_p)
+
+
+def unpack_json_array(v: str) -> list:
+    try:
+        arr = json.loads(v)
+    except (ValueError, RecursionError):
+        return []
+    if not isinstance(arr, list):
+        return []
+    out = []
+    for x in arr:
+        if isinstance(x, str):
+            out.append(x)
+        elif isinstance(x, bool):
+            out.append("true" if x else "false")
+        elif x is None:
+            out.append("")
+        elif isinstance(x, (dict, list)):
+            out.append(json.dumps(x, separators=(",", ":"),
+                                  ensure_ascii=False))
+        else:
+            from .stats_funcs import format_number
+            out.append(format_number(x) if isinstance(x, float) else str(x))
+    return out
+
+
+@dataclass(repr=False)
+class PipeUnroll(Pipe):
+    fields: list
+    iff: object = None
+
+    name = "unroll"
+
+    def to_string(self):
+        return "unroll" + _if_str(self.iff) + \
+            " by (" + ", ".join(self.fields) + ")"
+
+    def needed_fields(self):
+        out = set(self.fields)
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def write_block(self, br):
+                mask = _if_mask(pipe.iff, br)
+                names = br.column_names()
+                cols = {n: br.column(n) for n in names}
+                out_cols: dict[str, list] = {n: [] for n in names}
+                for n in pipe.fields:
+                    out_cols.setdefault(n, [])
+                for i in range(br.nrows):
+                    if mask is not None and not mask[i]:
+                        unrolled = {f: [cols.get(f, [""] * br.nrows)[i]]
+                                    for f in pipe.fields}
+                        count = 1
+                    else:
+                        unrolled = {
+                            f: unpack_json_array(
+                                cols.get(f, [""] * br.nrows)[i])
+                            for f in pipe.fields}
+                        count = max((len(v) for v in unrolled.values()),
+                                    default=0) or 1
+                    for k in range(count):
+                        for n in out_cols:
+                            if n in unrolled:
+                                vs = unrolled[n]
+                                out_cols[n].append(vs[k] if k < len(vs)
+                                                   else "")
+                            else:
+                                out_cols[n].append(cols[n][i])
+                self.next_p.write_block(
+                    BlockResult.from_columns(out_cols)
+                    if out_cols and any(out_cols.values())
+                    else BlockResult(0))
+        return P(next_p)
+
+
+# ---------------- field_names / field_values / blocks_count ----------------
+
+@dataclass(repr=False)
+class PipeFieldNames(Pipe):
+    result_name: str = "name"
+
+    name = "field_names"
+
+    def to_string(self):
+        s = "field_names"
+        if self.result_name != "name":
+            s += " as " + quote_token_if_needed(self.result_name)
+        return s
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.hits: dict[str, int] = {}
+
+            def write_block(self, br):
+                for n in br.column_names():
+                    cnt = sum(1 for v in br.column(n) if v != "")
+                    if n in ("_time", "_stream", "_stream_id"):
+                        cnt = br.nrows
+                    if cnt:
+                        self.hits[n] = self.hits.get(n, 0) + cnt
+
+            def flush(self):
+                keys = sorted(self.hits)
+                cols = {pipe.result_name: list(keys),
+                        "hits": [str(self.hits[k]) for k in keys]}
+                self.next_p.write_block(BlockResult.from_columns(cols)
+                                        if keys else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeFieldValues(Pipe):
+    field: str
+    limit: int = 0
+
+    name = "field_values"
+
+    def to_string(self):
+        s = "field_values " + quote_token_if_needed(self.field)
+        if self.limit:
+            s += f" limit {self.limit}"
+        return s
+
+    def needed_fields(self):
+        return {self.field}
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.hits: dict[str, int] = {}
+
+            def write_block(self, br):
+                for v in br.column(pipe.field):
+                    if v != "":
+                        self.hits[v] = self.hits.get(v, 0) + 1
+
+            def flush(self):
+                keys = sorted(self.hits)
+                if pipe.limit and len(keys) > pipe.limit:
+                    keys = keys[:pipe.limit]
+                cols = {pipe.field: list(keys),
+                        "hits": [str(self.hits[k]) for k in keys]}
+                self.next_p.write_block(BlockResult.from_columns(cols)
+                                        if keys else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+@dataclass(repr=False)
+class PipeBlocksCount(Pipe):
+    result_name: str = "blocks_count"
+
+    name = "blocks_count"
+
+    def to_string(self):
+        s = "blocks_count"
+        if self.result_name != "blocks_count":
+            s += " as " + quote_token_if_needed(self.result_name)
+        return s
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.blocks = 0
+
+            def write_block(self, br):
+                if br.nrows:
+                    self.blocks += 1
+
+            def flush(self):
+                self.next_p.write_block(BlockResult.from_columns(
+                    {pipe.result_name: [str(self.blocks)]}))
+                self.next_p.flush()
+        return P(next_p)
+
+
+# ---------------- parsers + registration ----------------
+
+def _parse_quoted_arg(lex: Lexer) -> str:
+    from .parser import _get_compound_token
+    return _get_compound_token(lex, stop=(",", ")", "|", ""))
+
+
+def _parse_from_clause(lex: Lexer) -> str:
+    if lex.is_keyword("from"):
+        lex.next_token()
+        return _parse_field_name(lex)
+    return "_msg"
+
+
+def _parse_unpack_opts(lex: Lexer, pipe) -> None:
+    while True:
+        if lex.is_keyword("result_prefix"):
+            lex.next_token()
+            pipe.result_prefix = _parse_field_name(lex)
+        elif lex.is_keyword("keep_original_fields"):
+            pipe.keep_original_fields = True
+            lex.next_token()
+        elif lex.is_keyword("skip_empty_results"):
+            pipe.skip_empty_results = True
+            lex.next_token()
+        elif lex.is_keyword("fields"):
+            lex.next_token()
+            pipe.fields = _parse_paren_fields(lex)
+        else:
+            return
+
+
+def _parse_paren_fields(lex: Lexer) -> list:
+    if not lex.is_keyword("("):
+        raise ParseError("missing '('")
+    lex.next_token()
+    out = []
+    while not lex.is_keyword(")"):
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        out.append(_parse_field_name(lex))
+    lex.next_token()
+    return out
+
+
+def _parse_extract(lex: Lexer):
+    iff = _maybe_if(lex)
+    pattern = _parse_quoted_arg(lex)
+    p = PipeExtract(pattern, iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    _parse_unpack_opts(lex, p)
+    return p
+
+
+def _parse_extract_regexp(lex: Lexer):
+    iff = _maybe_if(lex)
+    pattern = _parse_quoted_arg(lex)
+    p = PipeExtractRegexp(pattern, iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    _parse_unpack_opts(lex, p)
+    return p
+
+
+def _parse_format(lex: Lexer):
+    iff = _maybe_if(lex)
+    fmt = _parse_quoted_arg(lex)
+    p = PipeFormat(fmt, iff=iff)
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.result_field = _parse_field_name(lex)
+    while True:
+        if lex.is_keyword("keep_original_fields"):
+            p.keep_original_fields = True
+            lex.next_token()
+        elif lex.is_keyword("skip_empty_results"):
+            p.skip_empty_results = True
+            lex.next_token()
+        else:
+            break
+    return p
+
+
+def _parse_math(lex: Lexer):
+    entries = []
+    while True:
+        expr = parse_math_expr(lex)
+        if lex.is_keyword("as"):
+            lex.next_token()
+        res = _parse_field_name(lex)
+        if not res:
+            raise ParseError("math: missing result field after expression")
+        entries.append((expr, res))
+        if lex.is_keyword(","):
+            lex.next_token()
+            continue
+        break
+    return PipeMath(entries)
+
+
+def _parse_unpack_json(lex: Lexer):
+    iff = _maybe_if(lex)
+    p = PipeUnpackJson(iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    _parse_unpack_opts(lex, p)
+    return p
+
+
+def _parse_unpack_logfmt(lex: Lexer):
+    iff = _maybe_if(lex)
+    p = PipeUnpackLogfmt(iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    _parse_unpack_opts(lex, p)
+    return p
+
+
+def _parse_unpack_syslog(lex: Lexer):
+    iff = _maybe_if(lex)
+    p = PipeUnpackSyslog(iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    if lex.is_keyword("offset"):
+        lex.next_token()
+        d = parse_duration(lex.token)
+        if d is None:
+            raise ParseError(f"bad unpack_syslog offset {lex.token!r}")
+        p.offset_ns = d
+        lex.next_token()
+    _parse_unpack_opts(lex, p)
+    return p
+
+
+def _parse_unpack_words(lex: Lexer):
+    iff = _maybe_if(lex)
+    p = PipeUnpackWords(iff=iff)
+    p.from_field = _parse_from_clause(lex)
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.dst_field = _parse_field_name(lex)
+    if lex.is_keyword("drop_duplicates"):
+        p.drop_duplicates = True
+        lex.next_token()
+    return p
+
+
+def _parse_replace(lex: Lexer, regexp: bool):
+    iff = _maybe_if(lex)
+    if not lex.is_keyword("("):
+        raise ParseError("missing '(' after replace")
+    lex.next_token()
+    old = _parse_quoted_arg(lex)
+    if not lex.is_keyword(","):
+        raise ParseError("replace needs (old, new)")
+    lex.next_token()
+    new = _parse_quoted_arg(lex)
+    if not lex.is_keyword(")"):
+        raise ParseError("missing ')' after replace args")
+    lex.next_token()
+    p = PipeReplace(old, new, iff=iff, regexp=regexp)
+    if lex.is_keyword("at"):
+        lex.next_token()
+        p.field = _parse_field_name(lex)
+    if lex.is_keyword("limit"):
+        lex.next_token()
+        p.limit = _parse_uint(lex, "limit")
+    if regexp:
+        p.__post_init__()
+    return p
+
+
+def _parse_top(lex: Lexer):
+    limit = 10
+    if not lex.is_keyword("by", "(") and not lex.is_end() and \
+            not lex.is_keyword("|"):
+        limit = _parse_uint(lex, "top limit")
+    by = []
+    if lex.is_keyword("by"):
+        lex.next_token()
+    if lex.is_keyword("("):
+        by = _parse_paren_fields(lex)
+    p = PipeTop(by, limit=limit)
+    while True:
+        if lex.is_keyword("hits"):
+            lex.next_token()
+            if lex.is_keyword("as"):
+                lex.next_token()
+            p.hits_field = _parse_field_name(lex)
+        elif lex.is_keyword("rank"):
+            lex.next_token()
+            if lex.is_keyword("as"):
+                lex.next_token()
+            p.rank_field = _parse_field_name(lex)
+        else:
+            break
+    return p
+
+
+def _parse_len(lex: Lexer):
+    if not lex.is_keyword("("):
+        raise ParseError("missing '(' after len")
+    lex.next_token()
+    fld = _parse_field_name(lex)
+    if not lex.is_keyword(")"):
+        raise ParseError("missing ')' after len field")
+    lex.next_token()
+    p = PipeLen(fld)
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.result_field = _parse_field_name(lex)
+    elif not lex.is_end() and not lex.is_keyword("|"):
+        p.result_field = _parse_field_name(lex)
+    return p
+
+
+def _parse_pack(lex: Lexer, logfmt: bool):
+    p = PipePackJson(logfmt=logfmt)
+    if lex.is_keyword("fields"):
+        lex.next_token()
+        p.fields = _parse_paren_fields(lex)
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.result_field = _parse_field_name(lex)
+    elif not lex.is_end() and not lex.is_keyword("|"):
+        p.result_field = _parse_field_name(lex)
+    return p
+
+
+def _parse_sample(lex: Lexer):
+    n = _parse_uint(lex, "sample")
+    if n < 1:
+        raise ParseError("sample must be >= 1")
+    return PipeSample(n)
+
+
+def _parse_unroll(lex: Lexer):
+    iff = _maybe_if(lex)
+    if lex.is_keyword("by"):
+        lex.next_token()
+    fields = _parse_paren_fields(lex)
+    if not fields:
+        raise ParseError("unroll needs at least one field")
+    return PipeUnroll(fields, iff=iff)
+
+
+def _parse_field_names(lex: Lexer):
+    p = PipeFieldNames()
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.result_name = _parse_field_name(lex)
+    return p
+
+
+def _parse_field_values(lex: Lexer):
+    fld = _parse_field_name(lex)
+    p = PipeFieldValues(fld)
+    if lex.is_keyword("limit"):
+        lex.next_token()
+        p.limit = _parse_uint(lex, "limit")
+    return p
+
+
+def _parse_blocks_count(lex: Lexer):
+    p = PipeBlocksCount()
+    if lex.is_keyword("as"):
+        lex.next_token()
+        p.result_name = _parse_field_name(lex)
+    return p
+
+
+def _parse_drop_empty_fields(lex: Lexer):
+    return PipeDropEmptyFields()
+
+
+register_pipe("extract", _parse_extract)
+register_pipe("extract_regexp", _parse_extract_regexp)
+register_pipe("format", _parse_format)
+register_pipe("math", _parse_math)
+register_pipe("eval", _parse_math)
+register_pipe("unpack_json", _parse_unpack_json)
+register_pipe("unpack_logfmt", _parse_unpack_logfmt)
+register_pipe("unpack_syslog", _parse_unpack_syslog)
+register_pipe("unpack_words", _parse_unpack_words)
+register_pipe("replace", lambda lex: _parse_replace(lex, regexp=False))
+register_pipe("replace_regexp", lambda lex: _parse_replace(lex, regexp=True))
+register_pipe("top", _parse_top)
+register_pipe("len", _parse_len)
+register_pipe("pack_json", lambda lex: _parse_pack(lex, logfmt=False))
+register_pipe("pack_logfmt", lambda lex: _parse_pack(lex, logfmt=True))
+register_pipe("sample", _parse_sample)
+register_pipe("unroll", _parse_unroll)
+register_pipe("field_names", _parse_field_names)
+register_pipe("field_values", _parse_field_values)
+register_pipe("blocks_count", _parse_blocks_count)
+register_pipe("drop_empty_fields", _parse_drop_empty_fields)

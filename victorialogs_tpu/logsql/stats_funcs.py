@@ -30,6 +30,7 @@ class StatsFunc:
     """Base: parsed stats function with its input fields and result name."""
 
     name = "?"
+    iff = None  # optional per-func row guard: `count() if (filter)`
 
     def __init__(self, fields: list[str], out_name: str = ""):
         self.fields = fields
@@ -41,12 +42,17 @@ class StatsFunc:
 
     def to_string(self) -> str:
         s = f"{self.name}({', '.join(self.fields)})"
+        if self.iff is not None:
+            s += f" if ({self.iff.to_string()})"
         if self.out_name != self.default_name():
             s += f" as {self.out_name}"
         return s
 
     def needed_fields(self) -> set:
-        return set(self.fields)
+        out = set(self.fields)
+        if self.iff is not None:
+            out |= self.iff.needed_fields()
+        return out
 
     # state protocol
     def new_state(self):
@@ -60,6 +66,15 @@ class StatsFunc:
     def update(self, state, cols: list[list[str]], idxs) -> None:
         """cols: whatever block_cols() returned for the current block."""
         raise NotImplementedError
+
+    # memory accounting: the stats processor installs a shared budget and
+    # accumulating funcs charge it on ACTUAL state growth (reference fails
+    # queries at a fraction of memory.Allowed() — pipe_stats.go:314-348)
+    budget = None
+
+    def _charge(self, nbytes: int) -> None:
+        if self.budget is not None and nbytes:
+            self.budget.add(nbytes)
 
     def merge(self, a, b):
         raise NotImplementedError
@@ -266,10 +281,13 @@ class StatsCountUniq(StatsFunc):
     def update(self, state, cols, idxs):
         if self.limit and len(state) >= self.limit:
             return state
+        grown = 0
         for i in idxs:
             key = tuple(c[i] for c in cols)
-            if any(k != "" for k in key):
+            if any(k != "" for k in key) and key not in state:
                 state.add(key)
+                grown += sum(len(k) for k in key) + 64
+        self._charge(grown)
         return state
 
     def merge(self, a, b):
@@ -320,10 +338,14 @@ class StatsUniqValues(StatsFunc):
         return set()
 
     def update(self, state, cols, idxs):
+        grown = 0
         for c in cols:
             for i in idxs:
-                if c[i] != "":
-                    state.add(c[i])
+                v = c[i]
+                if v != "" and v not in state:
+                    state.add(v)
+                    grown += len(v) + 56
+        self._charge(grown)
         return state
 
     def merge(self, a, b):
@@ -357,9 +379,12 @@ class StatsValues(StatsFunc):
         return []
 
     def update(self, state, cols, idxs):
+        grown = 0
         for c in cols:
             for i in idxs:
                 state.append(c[i])
+                grown += len(c[i]) + 48
+        self._charge(grown)
         return state
 
     def merge(self, a, b):
@@ -394,11 +419,14 @@ class StatsQuantile(StatsFunc):
         return []
 
     def update(self, state, cols, idxs):
+        grown = 0
         for c in cols:
             for i in idxs:
                 v = parse_number(c[i]) if c[i] else math.nan
                 if not math.isnan(v):
                     state.append(v)
+                    grown += 32
+        self._charge(grown)
         return state
 
     def merge(self, a, b):
@@ -429,6 +457,209 @@ class StatsMedian(StatsQuantile):
         if self.out_name != self.default_name():
             s += f" as {self.out_name}"
         return s
+
+
+# ---------------- histogram (VictoriaMetrics-style vmrange buckets) -------
+
+_HIST_BUCKETS_PER_DECIMAL = 18
+_HIST_LOWER = 1e-9
+_HIST_UPPER = 1e18
+
+
+def _vmrange(v: float) -> str:
+    """Log-scale bucket label for v (18 buckets per decade, the
+    VictoriaMetrics histogram layout — reference stats_histogram.go)."""
+    if v < _HIST_LOWER:
+        return f"0...{_HIST_LOWER:.3e}"
+    if v > _HIST_UPPER:
+        return f"{_HIST_UPPER:.3e}...+Inf"
+    idx = math.floor(math.log10(v) * _HIST_BUCKETS_PER_DECIMAL + 1e-9)
+    lo = 10 ** (idx / _HIST_BUCKETS_PER_DECIMAL)
+    hi = 10 ** ((idx + 1) / _HIST_BUCKETS_PER_DECIMAL)
+    if v > hi:  # float rounding at bucket edges
+        idx += 1
+        lo, hi = hi, 10 ** ((idx + 1) / _HIST_BUCKETS_PER_DECIMAL)
+    return f"{lo:.3e}...{hi:.3e}"
+
+
+def _vmrange_sort_key(r: str):
+    try:
+        return float(r.split("...", 1)[0])
+    except ValueError:
+        return math.inf
+
+
+class StatsHistogram(StatsFunc):
+    name = "histogram"
+
+    def new_state(self):
+        return {}
+
+    def update(self, state, cols, idxs):
+        for c in cols:
+            for i in idxs:
+                v = parse_number(c[i]) if c[i] else math.nan
+                if math.isnan(v) or v < 0:
+                    continue
+                r = _vmrange(v)
+                state[r] = state.get(r, 0) + 1
+        return state
+
+    def merge(self, a, b):
+        for k, v in b.items():
+            a[k] = a.get(k, 0) + v
+        return a
+
+    def finalize(self, state):
+        import json
+        out = [{"vmrange": r, "hits": state[r]}
+               for r in sorted(state, key=_vmrange_sort_key)]
+        return json.dumps(out, separators=(",", ":"))
+
+
+# ---------------- rate / rate_sum ----------------
+
+class StatsRate(StatsCount):
+    """count() divided by the query's time-filter range in seconds
+    (reference stats_rate.go; step set via Query time filter —
+    parser.go:1218-1224)."""
+
+    name = "rate"
+    step_seconds: float = 0.0
+
+    def finalize(self, state):
+        v = float(state)
+        if self.step_seconds > 0:
+            v /= self.step_seconds
+        return format_number(v)
+
+
+class StatsRateSum(StatsSum):
+    name = "rate_sum"
+    step_seconds: float = 0.0
+
+    def finalize(self, state):
+        if math.isnan(state):
+            return "NaN"
+        v = state
+        if self.step_seconds > 0:
+            v /= self.step_seconds
+        return format_number(v)
+
+
+# ---------------- row_min / row_max / json_values ----------------
+
+class StatsRowMin(StatsFunc):
+    """Captures the whole row (or named fields) where src_field is minimal
+    (reference stats_row_min.go)."""
+
+    name = "row_min"
+    _want_max = False
+
+    def __init__(self, fields, out_name=""):
+        if not fields:
+            raise ValueError(f"{self.name} needs a source field")
+        self.src_field = fields[0]
+        self.row_fields = fields[1:]
+        super().__init__(fields, out_name)
+
+    def needed_fields(self):
+        if self.row_fields:
+            return {self.src_field, *self.row_fields}
+        return {"*"}
+
+    def block_cols(self, br):
+        src = br.column(self.src_field)
+        names = self.row_fields or br.column_names()
+        return [src, [(n, br.column(n)) for n in names]]
+
+    def new_state(self):
+        return None  # (src_value, row_dict)
+
+    def _better(self, a: str, b: str) -> bool:
+        return _num_or_str_less(b, a) if self._want_max \
+            else _num_or_str_less(a, b)
+
+    def update(self, state, cols, idxs):
+        src, row_cols = cols
+        best = state
+        for i in idxs:
+            v = src[i]
+            if v == "":
+                continue
+            if best is None or self._better(v, best[0]):
+                best = (v, {n: c[i] for n, c in row_cols if c[i] != ""})
+        return best
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a if self._better(a[0], b[0]) else b
+
+    def finalize(self, state):
+        import json
+        return json.dumps(state[1], separators=(",", ":")) \
+            if state is not None else ""
+
+    def export_state(self, state):
+        return state
+
+    def import_state(self, data):
+        return tuple(data) if data is not None else None
+
+
+class StatsRowMax(StatsRowMin):
+    name = "row_max"
+    _want_max = True
+
+
+class StatsJSONValues(StatsFunc):
+    """Per-row JSON objects collected into one JSON array (reference
+    stats_json_values.go)."""
+
+    name = "json_values"
+
+    def __init__(self, fields, out_name="", limit: int = 0):
+        super().__init__(fields, out_name)
+        self.limit = limit
+
+    def needed_fields(self):
+        return set(self.fields) if self.fields else {"*"}
+
+    def block_cols(self, br):
+        names = self.fields or br.column_names()
+        return [[(n, br.column(n)) for n in names]]
+
+    def new_state(self):
+        return []
+
+    def update(self, state, cols, idxs):
+        import json
+        if self.limit and len(state) >= self.limit:
+            return state
+        row_cols = cols[0]
+        grown = 0
+        for i in idxs:
+            item = json.dumps({n: c[i] for n, c in row_cols},
+                              separators=(",", ":"), ensure_ascii=False)
+            state.append(item)
+            grown += len(item) + 48
+            if self.limit and len(state) >= self.limit:
+                break
+        self._charge(grown)
+        return state
+
+    def merge(self, a, b):
+        a.extend(b)
+        return a
+
+    def finalize(self, state):
+        items = state
+        if self.limit and len(items) > self.limit:
+            items = items[:self.limit]
+        return "[" + ",".join(items) + "]"
 
 
 class StatsRowAny(StatsFunc):

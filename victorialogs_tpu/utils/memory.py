@@ -1,0 +1,56 @@
+"""Memory budget for stateful query pipes.
+
+The reference fails memory-hungry pipes (sort/stats/uniq/top) once their
+state passes a fraction of `memory.Allowed()` (pipe_sort.go:144,
+pipe_stats.go:314-348) instead of OOMing the process.  allowed() here reads
+total RAM once and takes 60% of it, overridable with
+VL_MEMORY_ALLOWED_BYTES for tests."""
+
+from __future__ import annotations
+
+import os
+
+_cached: int | None = None
+
+
+def allowed() -> int:
+    global _cached
+    env = os.environ.get("VL_MEMORY_ALLOWED_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if _cached is None:
+        total = 1 << 32
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        _cached = int(total * 0.6)
+    return _cached
+
+
+class MemoryBudget:
+    """Tracks approximate state bytes for one pipe processor."""
+
+    def __init__(self, fraction: float, what: str):
+        self.limit = int(allowed() * fraction)
+        self.used = 0
+        self.what = what
+
+    def add(self, nbytes: int) -> None:
+        self.used += nbytes
+        if self.used > self.limit:
+            raise QueryMemoryError(
+                f"memory limit exceeded for {self.what}: state needs more "
+                f"than {self.limit} bytes; reduce the query's row/group "
+                f"count (e.g. add filters or limits)")
+
+
+class QueryMemoryError(Exception):
+    """Raised when a stateful pipe exceeds its memory budget."""
