@@ -36,6 +36,8 @@ def storage(tmp_path_factory):
             msg = "日本語ログ " + msg
         if i % 501 == 0:
             msg = "needle " + "pad " * 700  # overflow rows (>2KB staging)
+        if i % 73 == 0:
+            msg = f"alpha {i}\nbeta line2"  # newline rows for A.*B parity
         lr.add(TEN, T0 + i * NS, [
             ("app", f"app{i % 3}"),
             ("_msg", msg),
@@ -64,6 +66,10 @@ QUERIES = [
     '_msg:~"err.r"',
     '_msg:~"(GET|POST) "',
     '_msg:~"(?i)ERROR"',        # inline-flag regex: no literal prefilter
+    '_msg:~"alpha.*beta"',      # A.*B device path; \n rows host-verified
+    '_msg:~"beta.*alpha"',      # ordering matters
+    '_msg:~"error.*GET"',
+    '_msg:~"GET.*error"',
     "error or timeout",
     "error timeout",
     "!error",

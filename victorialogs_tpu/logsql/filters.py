@@ -349,6 +349,7 @@ class FilterRegexp(_ValuePredFilter):
 
     def __post_init__(self):
         self._re = re.compile(self.pattern)
+        self._substr_literals = regex_literal_runs(self.pattern)
         self._bloom_tokens = regex_literal_tokens(self.pattern)
 
     def _pred(self, v):
@@ -368,8 +369,36 @@ def regex_literal_tokens(pattern: str) -> list[str]:
     (regexutil GetLiterals — filter_regexp.go:44-51) and skips the first/last
     token (they may be partial words).  We conservatively extract maximal
     literal runs outside any metacharacter scope, then drop first/last token
-    of each run boundary the same way.
+    of each run boundary the same way.  These are sound for BLOOM probes
+    (which index whole words); for plain substring prefilters use
+    regex_literal_runs, which keeps the full runs.
     """
+    out = []
+    for lit, drop_last, is_final in _regex_literal_parts(pattern):
+        toks = tokenize_string(lit)
+        if not toks:
+            continue
+        start = 1 if (lit and (lit[0].isalnum() or lit[0] == "_")) else 0
+        end = len(toks)
+        if drop_last or not is_final:
+            end -= 1
+        else:
+            if lit and (lit[-1].isalnum() or lit[-1] == "_"):
+                end -= 1
+        out.extend(toks[start:end])
+    return out
+
+
+def regex_literal_runs(pattern: str) -> list[str]:
+    """Maximal literal substrings every match must contain, UNtokenized.
+
+    Unlike the bloom tokens above, partial words are fine here: a device
+    substring scan for "dead" soundly prefilters `~"dead.*exceeded"`."""
+    return [lit for lit, _d, _f in _regex_literal_parts(pattern) if lit]
+
+
+def _regex_literal_parts(pattern: str) -> list[tuple[str, bool, bool]]:
+    """Shared scanner: (literal_run, last_char_dropped, is_final) parts."""
     # Inline flags/groups like (?i) change matching semantics for the whole
     # pattern (case folding etc.), so any literal we extract could wrongly
     # prune via blooms — bail to "no mandatory tokens" (the reference parses
@@ -404,7 +433,13 @@ def regex_literal_tokens(pattern: str) -> list[str]:
         if c in "|([{" :
             # alternation/group/class: everything inside is not mandatory
             if c == "|":
-                return []  # top-level alternation: no mandatory literal
+                if depth_unsafe == 0:
+                    return []  # top-level alternation: nothing is mandatory
+                i += 1
+                continue
+            if c == "{" and cur and depth_unsafe == 0:
+                # quantifier may be {0,n}: the preceding char is optional
+                cur.pop()
             cur = _flush_literal(cur, literals, drop_last=True)
             depth_unsafe += 1
             i += 1
@@ -429,22 +464,7 @@ def regex_literal_tokens(pattern: str) -> list[str]:
             cur.append(c)
         i += 1
     _flush_literal(cur, literals, drop_last=False, final=True)
-    # each literal run: its inner tokens are mandatory; first/last may be
-    # partial words (reference skipFirstLastToken)
-    out = []
-    for lit, drop_last, is_final in literals:
-        toks = tokenize_string(lit)
-        if not toks:
-            continue
-        start = 1 if (lit and (lit[0].isalnum() or lit[0] == "_")) else 0
-        end = len(toks)
-        if drop_last or not is_final:
-            end -= 1
-        else:
-            if lit and (lit[-1].isalnum() or lit[-1] == "_"):
-                end -= 1
-        out.extend(toks[start:end])
-    return out
+    return literals
 
 
 def _flush_literal(cur, literals, drop_last, final=False):

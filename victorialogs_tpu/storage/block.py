@@ -157,9 +157,24 @@ def _build_one_block(
             for k, v in r:
                 col_values[k][ri] = v
 
+    return build_block_from_columns(stream_id, timestamps, col_values,
+                                    stream_tags_str)
+
+
+def build_block_from_columns(
+    stream_id: StreamID,
+    timestamps: np.ndarray,
+    col_values: dict[str, list[str]],
+    stream_tags_str: str = "",
+) -> BlockData:
+    """Encode one block from column-oriented values (the columnar fast path
+    used by the streaming merger — no per-row tuples anywhere)."""
+    ts = np.asarray(timestamps, dtype=np.int64)
+    nrows = int(ts.shape[0])
     columns: list[EncodedColumn] = []
     const_columns: list[tuple[str, str]] = []
     for name, values in col_values.items():
+        assert len(values) == nrows
         col = encode_values(name, values)
         if col.vtype == VT_CONST:
             const_columns.append((name, col.const_value))
@@ -169,8 +184,6 @@ def _build_one_block(
 
     # timestamps must be sorted within a block (reference asserts this:
     # block.go:177-195)
-    ts = np.asarray(timestamps, dtype=np.int64)
-    assert nrows == ts.shape[0]
     return BlockData(stream_id=stream_id, timestamps=ts, columns=columns,
                      const_columns=const_columns,
                      stream_tags_str=stream_tags_str)

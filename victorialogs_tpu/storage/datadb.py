@@ -101,8 +101,9 @@ class InmemoryPart:
         pass
 
 
-def _part_rows(blocks: list[BlockData]):
-    """Decode part blocks back into per-stream row iterables for merging."""
+def _block_rows(blocks: list[BlockData]):
+    """Decode blocks into per-row tuples (only used for the rare
+    overlapping-range case in the streaming merger)."""
     for b in blocks:
         nrows = b.num_rows
         col_strs = [(c.name, c.to_strings(nrows)) for c in b.columns]
@@ -114,25 +115,136 @@ def _part_rows(blocks: list[BlockData]):
             yield (b.stream_id, ts[ri], fields, b.stream_tags_str)
 
 
+def _row_merge_blocks(blocks: list[BlockData]) -> list[BlockData]:
+    """Row-level merge for same-stream blocks with overlapping time ranges."""
+    rows = sorted(_block_rows(blocks), key=lambda r: (r[0], r[1]))
+    sid = rows[0][0]
+    ts = np.fromiter((r[1] for r in rows), dtype=np.int64, count=len(rows))
+    return build_blocks(sid, ts, [r[2] for r in rows],
+                        stream_tags_str=rows[0][3])
+
+
+MERGE_TARGET_ROWS = 128 * 1024   # coalesce small same-stream blocks up to
+COALESCE_MIN_ROWS = 64 * 1024    # blocks >= this pass through unchanged
+
+
+def _block_columns(b: BlockData) -> dict[str, list[str]]:
+    n = b.num_rows
+    out = {c.name: c.to_strings(n) for c in b.columns}
+    for k, v in b.const_columns:
+        out[k] = [v] * n
+    return out
+
+
+def _coalesce_same_stream(blocks: list[BlockData]) -> list[BlockData]:
+    """Columnar concat + re-encode of small same-stream adjacent blocks.
+
+    No per-row tuples and no sort: ranges are already ordered, so columns
+    concatenate directly (the streaming redesign of the reference's
+    mustMergeBlockStreams — block_stream_merger.go)."""
+    from .block import build_block_from_columns
+    if len(blocks) == 1:
+        return blocks
+    names: dict[str, None] = {}
+    for b in blocks:
+        for c in b.columns:
+            names.setdefault(c.name, None)
+        for k, _v in b.const_columns:
+            names.setdefault(k, None)
+    cols: dict[str, list[str]] = {n: [] for n in names}
+    for b in blocks:
+        bc = _block_columns(b)
+        n = b.num_rows
+        for name in names:
+            vals = bc.get(name)
+            cols[name].extend(vals if vals is not None else [""] * n)
+    ts = np.concatenate([b.timestamps for b in blocks])
+    total = int(ts.shape[0])
+    out = []
+    for i in range(0, total, MERGE_TARGET_ROWS):
+        j = min(i + MERGE_TARGET_ROWS, total)
+        chunk = {n: v[i:j] for n, v in cols.items()}
+        out.append(build_block_from_columns(
+            blocks[0].stream_id, ts[i:j], chunk,
+            stream_tags_str=blocks[0].stream_tags_str))
+    return out
+
+
+def merge_block_streams(parts_blocks):
+    """Streaming k-way merge of per-part block iterators.
+
+    Each input yields BlockData sorted by (stream_id, min_ts).  Blocks whose
+    (stream, time) range doesn't overlap any other part's stream straight
+    through — big blocks are emitted as-is, runs of small same-stream blocks
+    are coalesced column-wise.  Only genuinely overlapping ranges pay a
+    row-level merge.  Memory stays bounded by a handful of blocks
+    (the reference streams via blockStreamReaders — datadb.go:466-602)."""
+    import heapq
+
+    iters = [iter(pb) for pb in parts_blocks]
+    heap = []
+    seq = 0
+    for it in iters:
+        b = next(it, None)
+        if b is not None:
+            heapq.heappush(heap, (b.stream_id, b.min_ts, seq, b, it))
+            seq += 1
+
+    pending: list[BlockData] = []   # small same-stream blocks to coalesce
+    pending_rows = 0
+
+    def flush_pending():
+        nonlocal pending, pending_rows
+        if not pending:
+            return []
+        out = _coalesce_same_stream(pending) if len(pending) > 1 \
+            else [pending[0]]
+        pending = []
+        pending_rows = 0
+        return out
+
+    while heap:
+        sid, _mt, _s, b, it = heapq.heappop(heap)
+        nb = next(it, None)
+        if nb is not None:
+            heapq.heappush(heap, (nb.stream_id, nb.min_ts, seq, nb, it))
+            seq += 1
+        # gather overlapping same-stream blocks from other parts
+        group = [b]
+        gmax = b.max_ts
+        while heap:
+            sid2, mt2, _s2, b2, it2 = heap[0]
+            if sid2 != sid or mt2 > gmax:
+                break
+            heapq.heappop(heap)
+            group.append(b2)
+            gmax = max(gmax, b2.max_ts)
+            nb2 = next(it2, None)
+            if nb2 is not None:
+                heapq.heappush(
+                    heap, (nb2.stream_id, nb2.min_ts, seq, nb2, it2))
+                seq += 1
+        if len(group) > 1:
+            merged = _row_merge_blocks(group)
+        else:
+            merged = group
+        for mb in merged:
+            if pending and pending[0].stream_id != mb.stream_id:
+                yield from flush_pending()
+            if mb.num_rows >= COALESCE_MIN_ROWS:
+                yield from flush_pending()
+                yield mb
+                continue
+            if pending_rows + mb.num_rows > MERGE_TARGET_ROWS:
+                yield from flush_pending()
+            pending.append(mb)
+            pending_rows += mb.num_rows
+    yield from flush_pending()
+
+
 def merge_blocks(parts_blocks: list[list[BlockData]]) -> list[BlockData]:
     """Merge blocks from several parts into a fresh sorted block list."""
-    rows = []
-    for blocks in parts_blocks:
-        rows.extend(_part_rows(blocks))
-    rows.sort(key=lambda r: (r[0], r[1]))
-    out: list[BlockData] = []
-    i, n = 0, len(rows)
-    while i < n:
-        sid = rows[i][0]
-        j = i
-        while j < n and rows[j][0] == sid:
-            j += 1
-        ts = np.fromiter((rows[k][1] for k in range(i, j)), dtype=np.int64,
-                         count=j - i)
-        out.extend(build_blocks(sid, ts, [rows[k][2] for k in range(i, j)],
-                                stream_tags_str=rows[i][3]))
-        i = j
-    return out
+    return list(merge_block_streams(parts_blocks))
 
 
 class DataDB:
@@ -239,7 +351,7 @@ class DataDB:
             if len(imps) == 1:
                 merged = imps[0].blocks
             else:
-                merged = merge_blocks([im.blocks for im in imps])
+                merged = merge_block_streams([im.blocks for im in imps])
             with self._lock:
                 name = self._new_part_name_locked()
             write_part(os.path.join(self.path, name), merged)
@@ -280,8 +392,12 @@ class DataDB:
                 self._merge_parts(to_merge, big=True)
 
     def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
-        merged = merge_blocks([[p.read_block(i) for i in range(p.num_blocks)]
-                               for p in to_merge])
+        # streaming k-way merge: blocks are read lazily per part and flow
+        # straight into the part writer — bounded memory, no row decode for
+        # non-overlapping ranges
+        def part_iter(p):
+            return (p.read_block(i) for i in range(p.num_blocks))
+        merged = merge_block_streams([part_iter(p) for p in to_merge])
         with self._lock:
             name = self._new_part_name_locked()
         write_part(os.path.join(self.path, name), merged, big=big)

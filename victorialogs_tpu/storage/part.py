@@ -67,8 +67,11 @@ def _decompress(data: bytes) -> bytes:
     return _zd.decompress(data)
 
 
-def write_part(path: str, blocks: list[BlockData], big: bool = False) -> None:
-    """Write blocks (already sorted by (stream_id, ts)) as a part directory."""
+def write_part(path: str, blocks, big: bool = False) -> None:
+    """Write blocks (already sorted by (stream_id, ts)) as a part directory.
+
+    blocks may be any iterable of BlockData (e.g. the streaming merger) —
+    it is consumed exactly once."""
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     headers = []
@@ -142,7 +145,7 @@ def write_part(path: str, blocks: list[BlockData], big: bool = False) -> None:
     meta = {
         "format_version": FORMAT_VERSION,
         "rows": total_rows,
-        "blocks": len(blocks),
+        "blocks": len(headers),
         "min_ts": min_ts or 0,
         "max_ts": max_ts or 0,
         "compressed_size": comp_size + len(index_z),

@@ -60,6 +60,7 @@ class LeafPlan:
     combine: str                   # 'and' | 'or'
     bloom_tokens: list
     verify: bool = False           # re-check survivors with filter._pred
+    pair: tuple | None = None      # (A, B) for the device `A.*B` fast path
 
 
 def device_plan(f) -> LeafPlan | None:
@@ -123,9 +124,19 @@ def device_plan(f) -> LeafPlan | None:
 
     if isinstance(f, F.FilterRegexp):
         from ..logsql.filters import canonical_field as cf
-        literals = [t for t in getattr(f, "_bloom_tokens", []) if ok(t)]
-        ops = [ScanOp(t.encode(), K.MODE_SUBSTRING) for t in literals]
         import re
+        # `A.*B` with literal A and B: decided fully on device (positions +
+        # newline guard — kernels.match_ordered_pair); only rows containing
+        # a newline fall back to host re.search
+        parts = f.pattern.split(".*")
+        if len(parts) == 2 and all(p and ok(p) and re.escape(p) == p
+                                   for p in parts):
+            return LeafPlan(f, cf(f.field), [], "and", f._tokens(),
+                            pair=(parts[0].encode(), parts[1].encode()))
+        # full literal RUNS (partial words included) are sound for plain
+        # substring prefilters; word tokens stay for the bloom kill-path
+        literals = [t for t in getattr(f, "_substr_literals", []) if ok(t)]
+        ops = [ScanOp(t.encode(), K.MODE_SUBSTRING) for t in literals]
         pure = (re.escape(f.pattern) == f.pattern and len(literals) == 1
                 and literals[0] == f.pattern)
         return LeafPlan(f, cf(f.field), ops, "and", f._tokens(),
@@ -339,7 +350,13 @@ class BatchRunner:
         if not dev_bis:
             return out
 
-        combined = self._run_ops(spc, plan)
+        verify_mask = None     # None => verify ALL survivors when plan.verify
+        need_verify = plan.verify
+        if plan.pair is not None:
+            combined, verify_mask = self._scan_pair(spc, plan.pair)
+            need_verify = True
+        else:
+            combined = self._run_ops(spc, plan)
         for bi in dev_bis:
             start, n = spc.block_rows[bi]
             bm = combined[start:start + n].copy() if combined is not None \
@@ -351,14 +368,33 @@ class BatchRunner:
                 vals = bss[bi].values(plan.field)
                 for i in ov:
                     bm[i] = plan.filter._pred(vals[i])
-            if plan.verify and bm.any():
-                if vals is None:
-                    vals = bss[bi].values(plan.field)
-                for i in np.nonzero(bm)[0]:
-                    if not plan.filter._pred(vals[i]):
-                        bm[i] = False
+            if need_verify and bm.any():
+                check = np.nonzero(
+                    bm & verify_mask[start:start + n]
+                    if verify_mask is not None else bm)[0]
+                if check.size:
+                    if vals is None:
+                        vals = bss[bi].values(plan.field)
+                    for i in check:
+                        if not plan.filter._pred(vals[i]):
+                            bm[i] = False
             out[bi] = bm
         return out
+
+    def _scan_pair(self, spc: StagedPart, pair: tuple):
+        """Device `A.*B` evaluation; returns (survivors, host_verify_mask)."""
+        import jax.numpy as jnp
+        a, b = pair
+        if max(len(a), len(b)) >= spc.width:
+            return np.zeros(spc.nrows, dtype=bool), None
+        self.device_calls += 1
+        definite, needs_verify = K.match_ordered_pair(
+            spc.rows, spc.lengths,
+            jnp.asarray(np.frombuffer(a, dtype=np.uint8)), len(a),
+            jnp.asarray(np.frombuffer(b, dtype=np.uint8)), len(b))
+        definite = np.array(definite[:spc.nrows])
+        needs_verify = np.array(needs_verify[:spc.nrows])
+        return definite | needs_verify, needs_verify
 
     def _run_ops(self, spc: StagedPart, plan: LeafPlan) -> np.ndarray | None:
         """AND/OR the leaf's scan ops over the whole staged part.

@@ -118,6 +118,44 @@ def match_scan_batch(rows: jnp.ndarray, lengths: jnp.ndarray,
     return bms, jnp.sum(bms.astype(jnp.int32), axis=1)
 
 
+def _window_eq(rows: jnp.ndarray, pattern: jnp.ndarray, pat_len: int
+               ) -> jnp.ndarray:
+    """acc[:, i] = rows[:, i:i+pat_len] == pattern (bool[R, W-pat_len+1])."""
+    r, w = rows.shape
+    nwc = w - pat_len + 1
+    acc = jnp.ones((r, nwc), dtype=bool)
+    for j in range(pat_len):
+        acc = acc & (jax.lax.slice(rows, (0, j), (r, j + nwc))
+                     == pattern[j])
+    return acc
+
+
+@partial(jax.jit, static_argnames=("len_a", "len_b"))
+def match_ordered_pair(rows: jnp.ndarray, lengths: jnp.ndarray,
+                       pat_a: jnp.ndarray, len_a: int,
+                       pat_b: jnp.ndarray, len_b: int):
+    """Device decomposition of the `A.*B` regex family.
+
+    A row matches /A.*B/ iff substring A ends at or before the LAST
+    occurrence of B — computed from first-match(A) and last-match(B)
+    positions, both pure argmax reductions over the window-equality matrix
+    (no gather/scatter).  '.' does not cross newlines, so rows that contain
+    a 0x0A byte are flagged for host re-verification instead of being
+    decided on device.
+
+    Returns (definite_match bool[R], needs_host_verify bool[R]).
+    """
+    acc_a = _window_eq(rows, pat_a, len_a)
+    acc_b = _window_eq(rows, pat_b, len_b)
+    any_a = jnp.any(acc_a, axis=1) & (lengths >= len_a)
+    any_b = jnp.any(acc_b, axis=1) & (lengths >= len_b)
+    first_a = jnp.argmax(acc_a, axis=1)
+    last_b = (acc_b.shape[1] - 1) - jnp.argmax(acc_b[:, ::-1], axis=1)
+    ordered = any_a & any_b & (first_a + len_a <= last_b)
+    has_nl = jnp.any(rows == 0x0A, axis=1)
+    return ordered & ~has_nl, ordered & has_nl
+
+
 # ---------------- bitmap combine (trivial but device-resident) ----------------
 
 @jax.jit
