@@ -120,6 +120,13 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         tenants = [tenants]
     tenants = tuple(tenants)
 
+    if hasattr(storage, "net_run_query"):
+        # cluster mode: storage is a NetSelectStorage — scatter-gather the
+        # query over the storage nodes (server/cluster.py)
+        storage.net_run_query(list(tenants), q, write_block=write_block,
+                              timestamp=timestamp)
+        return
+
     init_subqueries(storage, tenants, q, runner=runner)
     min_ts, max_ts = q.get_time_range()
 

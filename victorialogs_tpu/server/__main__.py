@@ -53,6 +53,12 @@ def main(argv=None) -> int:
                    dest="max_concurrent", default=8)
     p.add_argument("-tpu", action="store_true",
                    help="enable the TPU block runner for queries")
+    p.add_argument("-storageNode", action="append", dest="storage_nodes",
+                   default=None,
+                   help="cluster mode: storage node base URL (repeatable); "
+                        "this instance then shards ingest and "
+                        "scatter-gathers queries over the nodes "
+                        "(reference -storageNode)")
     args = p.parse_args(argv)
 
     retention_ns = parse_duration(args.retentionPeriod)
@@ -79,7 +85,8 @@ def main(argv=None) -> int:
     host, _, port_s = args.httpListenAddr.rpartition(":")
     server = VLServer(storage, listen_addr=host or "0.0.0.0",
                       port=int(port_s or 9428), runner=runner,
-                      max_concurrent=args.max_concurrent)
+                      max_concurrent=args.max_concurrent,
+                      storage_nodes=args.storage_nodes)
     print(f"started victoria-logs server at "
           f"http://{host or '0.0.0.0'}:{server.port}/", flush=True)
 

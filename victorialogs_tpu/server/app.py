@@ -73,13 +73,23 @@ class VLServer:
     """Single-binary server instance (storage + HTTP)."""
 
     def __init__(self, storage: Storage, listen_addr: str = "127.0.0.1",
-                 port: int = 0, runner=None, max_concurrent: int = 8):
+                 port: int = 0, runner=None, max_concurrent: int = 8,
+                 storage_nodes: list | None = None):
         self.storage = storage
-        self.sink = LocalLogRowsStorage(storage)
         self.metrics = Metrics()
         self.runner = runner
         self.start_time = time.time()
         self._sem = threading.Semaphore(max_concurrent)
+        if storage_nodes:
+            # cluster mode: ingest shards to the nodes, queries
+            # scatter-gather over them (reference -storageNode switch —
+            # app/vlstorage/main.go:87-93)
+            from .cluster import NetInsertStorage, NetSelectStorage
+            self.sink = NetInsertStorage(storage_nodes)
+            self.query_storage = NetSelectStorage(storage_nodes)
+        else:
+            self.sink = LocalLogRowsStorage(storage)
+            self.query_storage = storage
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -218,6 +228,26 @@ class VLServer:
                 self._sem.release()
             return
 
+        # ---- cluster-internal endpoints ----
+        if path == "/internal/insert":
+            from . import cluster
+            try:
+                n = cluster.handle_internal_insert(self.storage, args, body)
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            m.inc("vl_rows_ingested_total{type=\"internal\"}", n)
+            self.respond_json(h, {"status": "ok", "ingested": n})
+            return
+        if path == "/internal/select/query":
+            from . import cluster
+            try:
+                gen = cluster.handle_internal_select(self.storage, args,
+                                                     runner=self.runner)
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            self.respond_stream(h, gen, ctype="application/octet-stream")
+            return
+
         # ---- storage maintenance ----
         if path == "/internal/force_merge":
             self.storage.must_force_merge(args.get("partition_prefix", ""))
@@ -288,7 +318,7 @@ class VLServer:
         self.respond_json(h, {"status": "ok", "ingested": n})
 
     def handle_select(self, h, path, args, headers) -> None:
-        s = self.storage
+        s = self.query_storage
         m = self.metrics
         m.inc("vl_http_requests_total{path=\"" + path + "\"}")
         t0 = time.time()

@@ -1,0 +1,388 @@
+"""Cluster layer (L5): stream-hash sharded ingest + scatter-gather queries.
+
+The TPU-native redesign of the reference's netinsert/netselect/
+internalinsert/internalselect stack:
+
+- ingest: rows shard to storage nodes by stream hash for locality
+  (app/vlstorage/netinsert/netinsert.go:368-409), with a 10s circuit
+  breaker per node and re-routing to healthy nodes
+  (netinsert.go:283-289, 199-215);
+- query: the pipe chain splits into a remote part (filters + streaming
+  row-local pipes + per-node stats PARTIALS) and a local part (stats merge
+  via the stats funcs' export/import contract + remaining pipes) —
+  lib/logstorage/net_query_runner.go:67-96, pipe_stats.go:111-119; results
+  stream back as length-prefixed zstd frames
+  (app/vlselect/internalselect/internalselect.go:55-100);
+- failure semantics: any node error fails the whole query (the reference's
+  explicit no-partial-results design).
+
+Wire formats are this repo's own (JSON + zstd frames): versioned via the
+`version` arg like the reference's per-endpoint protocol versions
+(netselect.go:28-63).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import urllib.request
+
+import zstandard
+
+from ..engine.block_result import BlockResult
+from ..logsql.parser import MAX_TS, MIN_TS, parse_query
+from ..logsql.pipes import PipeLimit, PipeStats, Processor
+from ..storage.log_rows import LogRows, StreamID, TenantID
+from ..utils.hashing import stream_id_hash
+
+PROTOCOL_VERSION = "v1"
+CIRCUIT_BREAK_SECONDS = 10.0
+
+_zc = zstandard.ZstdCompressor(level=1)
+
+
+def _zd() -> zstandard.ZstdDecompressor:
+    return zstandard.ZstdDecompressor()
+
+
+# ---------------- stats split pipes ----------------
+
+class PipeStatsExport(PipeStats):
+    """Remote half of a stats split: emits per-group EXPORTED states
+    instead of finalized values (reference `stats_remote` mode —
+    pipe_stats.go:55-60)."""
+
+    name = "stats_export"
+
+    def __init__(self, ps: PipeStats):
+        super().__init__(ps.by, ps.funcs)
+
+    def to_string(self):
+        return "stats_export:" + super().to_string()[len("stats "):]
+
+    def make_processor(self, next_p):
+        pipe = self
+        inner = super().make_processor(None)
+
+        class P(type(inner)):
+            def flush(self):
+                by_names = [b.name for b in pipe.by]
+                cols: dict[str, list[str]] = {n: [] for n in by_names}
+                for k in range(len(pipe.funcs)):
+                    cols[f"__state_{k}"] = []
+                for key, states in self.groups.items():
+                    for n, kv in zip(by_names, key):
+                        cols[n].append(kv)
+                    for k, (fn, st) in enumerate(zip(pipe.funcs, states)):
+                        cols[f"__state_{k}"].append(
+                            json.dumps(fn.export_state(st)))
+                self.next_p.write_block(
+                    BlockResult.from_columns(cols)
+                    if any(cols.values()) else BlockResult(0))
+                self.next_p.flush()
+        p = P(next_p)
+        return p
+
+
+class PipeStatsImport(PipeStats):
+    """Local half: imports remote per-group states and merges them
+    (reference `stats_local` — importState merging)."""
+
+    name = "stats_import"
+
+    def __init__(self, ps: PipeStats):
+        super().__init__(ps.by, ps.funcs)
+
+    def to_string(self):
+        return "stats_import:" + super().to_string()[len("stats "):]
+
+    def make_processor(self, next_p):
+        pipe = self
+        inner = super().make_processor(None)
+
+        class P(type(inner)):
+            def write_block(self, br):
+                by_names = [b.name for b in pipe.by]
+                key_cols = [br.column(n) for n in by_names]
+                state_cols = [br.column(f"__state_{k}")
+                              for k in range(len(pipe.funcs))]
+                for i in range(br.nrows):
+                    key = tuple(c[i] for c in key_cols)
+                    states = self.groups.get(key)
+                    incoming = [
+                        fn.import_state(json.loads(state_cols[k][i]))
+                        for k, fn in enumerate(pipe.funcs)]
+                    if states is None:
+                        self.groups[key] = incoming
+                        self.budget.add(sum(len(k) for k in key) + 80)
+                    else:
+                        for k, fn in enumerate(pipe.funcs):
+                            states[k] = fn.merge(states[k], incoming[k])
+        return P(next_p)
+
+
+def split_query(q):
+    """(mode, split_at, local_pipes): remote part = pipes[:split_at]
+    (+ stats export when mode == 'stats'); per-pipe pushdown follows the
+    reference's splitToRemoteAndLocal contract (pipe.go:15-22) with
+    can_live_tail() marking streaming row-local pipes."""
+    for k, p in enumerate(q.pipes):
+        if isinstance(p, PipeStats) and \
+                all(pp.can_live_tail() for pp in q.pipes[:k]):
+            return "stats", k, [PipeStatsImport(p)] + list(q.pipes[k + 1:])
+    k = 0
+    while k < len(q.pipes) and q.pipes[k].can_live_tail():
+        k += 1
+    local = list(q.pipes[k:])
+    return "rows", k, local
+
+
+# ---------------- framing ----------------
+
+def write_frame(obj) -> bytes:
+    payload = _zc.compress(json.dumps(obj, ensure_ascii=False,
+                                      separators=(",", ":")).encode("utf-8"))
+    return struct.pack(">I", len(payload)) + payload
+
+
+END_FRAME = struct.pack(">I", 0)
+
+
+def read_frames(fp):
+    """Yield decoded frame objects from a stream until the end frame."""
+    while True:
+        hdr = fp.read(4)
+        if len(hdr) < 4:
+            raise IOError("truncated frame header")
+        n = struct.unpack(">I", hdr)[0]
+        if n == 0:
+            return
+        payload = b""
+        while len(payload) < n:
+            chunk = fp.read(n - len(payload))
+            if not chunk:
+                raise IOError("truncated frame payload")
+            payload += chunk
+        yield json.loads(_zd().decompress(payload, max_output_size=1 << 30))
+
+
+# ---------------- server side: /internal/select/query ----------------
+
+def handle_internal_select(storage, args, runner=None):
+    """Generator of wire frames for one remote sub-query."""
+    from ..engine.searcher import run_query
+    if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
+        raise ValueError(f"unsupported protocol version "
+                         f"{args.get('version')!r}")
+    qs = args["query"]
+    ts = int(args.get("ts") or time.time_ns())
+    mode = args.get("mode", "rows")
+    split_at = int(args.get("split_at") or 0)
+    limit = int(args.get("limit") or 0)
+    tenants = [TenantID.parse(args.get("tenant", "0:0"))]
+    q = parse_query(qs, timestamp=ts)
+    all_pipes = q.pipes
+    q.pipes = all_pipes[:split_at]
+    if mode == "stats":
+        ps = all_pipes[split_at]
+        assert isinstance(ps, PipeStats), "split_at must point at stats"
+        q.pipes = q.pipes + [PipeStatsExport(ps)]
+    elif limit > 0:
+        # pushed-down limit: each node returns at most N rows
+        q.pipes.append(PipeLimit(limit))
+
+    frames: list[bytes] = []
+
+    def sink(br):
+        cols = {n: br.column(n) for n in br.column_names()}
+        ts_list = br.timestamps
+        frames.append(write_frame({"cols": cols, "ts": ts_list}))
+
+    run_query(storage, tenants, q, write_block=sink, runner=runner)
+    yield from frames
+    yield END_FRAME
+
+
+# ---------------- server side: /internal/insert ----------------
+
+def handle_internal_insert(storage, args, body: bytes) -> int:
+    if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
+        raise ValueError(f"unsupported protocol version "
+                         f"{args.get('version')!r}")
+    data = _zd().decompress(body, max_output_size=1 << 30)
+    lr = LogRows()
+    n = 0
+    for line in data.splitlines():
+        if not line:
+            continue
+        row = json.loads(line)
+        tenant = TenantID(int(row.get("a", 0)), int(row.get("p", 0)))
+        tags_str = row.get("s", "")
+        hi, lo = stream_id_hash(tags_str.encode("utf-8"))
+        lr.timestamps.append(int(row["t"]))
+        lr.rows.append([(k, v) for k, v in row["f"]])
+        lr.stream_ids.append(StreamID(tenant, hi, lo))
+        lr.stream_tags_str.append(tags_str)
+        lr.tenants.append(tenant)
+        n += 1
+    if n:
+        storage.must_add_rows(lr)
+    return n
+
+
+# ---------------- client side: sharded ingest ----------------
+
+class NetInsertStorage:
+    """LogRowsStorage that ships rows to storage nodes by stream hash.
+
+    Implements the reference's placement + failure policy: stream-hash
+    routing for locality, a 10s circuit breaker on a failed node, and
+    re-routing to the next healthy node (netinsert.go:368-409, 283-289)."""
+
+    def __init__(self, node_urls: list, timeout: float = 30.0):
+        if not node_urls:
+            raise ValueError("no storage nodes configured")
+        self.urls = [u.rstrip("/") for u in node_urls]
+        self.timeout = timeout
+        self._disabled_until = [0.0] * len(self.urls)
+        self._lock = threading.Lock()
+
+    def _healthy(self, idx: int) -> bool:
+        return time.monotonic() >= self._disabled_until[idx]
+
+    def _mark_broken(self, idx: int) -> None:
+        with self._lock:
+            self._disabled_until[idx] = \
+                time.monotonic() + CIRCUIT_BREAK_SECONDS
+
+    def must_add_rows(self, lr: LogRows) -> None:
+        n_nodes = len(self.urls)
+        batches: dict[int, list] = {}
+        for i in range(len(lr)):
+            sid = lr.stream_ids[i]
+            node = (sid.hi ^ sid.lo) % n_nodes
+            ten = lr.tenants[i]
+            batches.setdefault(node, []).append(json.dumps({
+                "t": lr.timestamps[i], "a": ten.account_id,
+                "p": ten.project_id, "s": lr.stream_tags_str[i],
+                "f": lr.rows[i]}, ensure_ascii=False,
+                separators=(",", ":")))
+        errors = []
+        for node, lines in batches.items():
+            body = _zc.compress(("\n".join(lines)).encode("utf-8"))
+            if not self._send(node, body):
+                # re-route to any healthy node (data locality is a
+                # preference, not a correctness requirement)
+                sent = False
+                for alt in range(n_nodes):
+                    if alt != node and self._healthy(alt) and \
+                            self._send(alt, body):
+                        sent = True
+                        break
+                if not sent:
+                    errors.append(f"all nodes down for shard {node}")
+        if errors:
+            raise IOError("; ".join(errors))
+
+    def _send(self, idx: int, body: bytes) -> bool:
+        if not self._healthy(idx):
+            return False
+        url = (f"{self.urls[idx]}/internal/insert?"
+               f"version={PROTOCOL_VERSION}")
+        req = urllib.request.Request(url, data=body, method="POST")
+        req.add_header("Content-Type", "application/octet-stream")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return 200 <= resp.status < 300
+        except Exception:
+            self._mark_broken(idx)
+            return False
+
+
+# ---------------- client side: scatter-gather select ----------------
+
+class NetSelectStorage:
+    """Query layer over N storage nodes: remote/local pipe split, parallel
+    fan-out, first-error cancellation (netselect.go:324-369)."""
+
+    def __init__(self, node_urls: list, timeout: float = 120.0):
+        if not node_urls:
+            raise ValueError("no storage nodes configured")
+        self.urls = [u.rstrip("/") for u in node_urls]
+        self.timeout = timeout
+
+    def net_run_query(self, tenants, q, write_block=None,
+                      timestamp: int | None = None) -> None:
+        from ..engine.searcher import build_processor_chain
+        if isinstance(q, str):
+            q = parse_query(q, timestamp)
+        ts = q.timestamp if getattr(q, "timestamp", None) else \
+            (timestamp or time.time_ns())
+        mode, split_at, local_pipes = split_query(q)
+
+        # rate()/rate_sum() step for locally-finalized stats
+        min_ts, max_ts = q.get_time_range()
+        if min_ts != MIN_TS and max_ts != MAX_TS:
+            step_seconds = (max_ts - min_ts + 1) / 1e9
+            for p in local_pipes:
+                if isinstance(p, PipeStats):
+                    for fn in p.funcs:
+                        if hasattr(fn, "step_seconds"):
+                            fn.step_seconds = step_seconds
+
+        push_limit = 0
+        if mode == "rows" and local_pipes and \
+                isinstance(local_pipes[0], PipeLimit):
+            push_limit = local_pipes[0].n
+
+        head = build_processor_chain(local_pipes,
+                                     write_block or (lambda br: None))
+        lock = threading.Lock()
+        stop = threading.Event()
+        errors: list = []
+        tenant = tenants[0] if tenants else TenantID(0, 0)
+
+        def fetch(url: str):
+            from urllib.parse import urlencode
+            qs = urlencode({
+                "version": PROTOCOL_VERSION,
+                "query": q.to_string(),
+                "ts": str(ts),
+                "mode": mode,
+                "split_at": str(split_at),
+                "limit": str(push_limit),
+                "tenant": f"{tenant.account_id}:{tenant.project_id}",
+            })
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/internal/select/query?{qs}",
+                        timeout=self.timeout) as resp:
+                    if resp.status != 200:
+                        raise IOError(f"{url}: HTTP {resp.status}")
+                    for frame in read_frames(resp):
+                        if stop.is_set():
+                            return
+                        br = BlockResult.from_columns(
+                            frame.get("cols") or {},
+                            timestamps=frame.get("ts"))
+                        with lock:
+                            head.write_block(br)
+                            if head.is_done():
+                                stop.set()
+                                return
+            except Exception as e:
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=fetch, args=(u,), daemon=True)
+                   for u in self.urls]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            # no partial results: any storage-node failure fails the query
+            raise IOError(f"cluster query failed: {errors[0]}")
+        head.flush()
