@@ -1,0 +1,122 @@
+"""Inverted stream-index tests: posting-list resolution must agree with the
+brute-force tag matcher at every operator, and be O(matching streams) at
+high cardinality (reference indexdb.go:20-31, 182-307)."""
+
+import random
+import time
+
+import pytest
+
+from victorialogs_tpu.logsql.parser import parse_query
+from victorialogs_tpu.storage.indexdb import IndexDB
+from victorialogs_tpu.storage.log_rows import StreamID, TenantID
+from victorialogs_tpu.storage.stream_filter import (StreamFilter, TagFilter,
+                                                    parse_stream_tags)
+from victorialogs_tpu.utils.hashing import stream_id_hash
+
+TEN = TenantID(0, 0)
+TEN2 = TenantID(7, 0)
+
+
+def _sid(tenant, tags_str):
+    hi, lo = stream_id_hash(tags_str.encode())
+    return StreamID(tenant, hi, lo)
+
+
+def _register(idb, tenant, tags_str):
+    idb.must_register_streams([(_sid(tenant, tags_str), tags_str)])
+
+
+def _sf(*groups):
+    return StreamFilter(tuple(tuple(g) for g in groups))
+
+
+@pytest.fixture()
+def idb(tmp_path):
+    db = IndexDB(str(tmp_path / "idx"))
+    yield db
+    db.close()
+
+
+def _brute(idb, tenants, sf):
+    out = []
+    for t in tenants:
+        for sid in idb._by_tenant.get(t, ()):
+            if sf.matches(parse_stream_tags(idb._streams[sid])):
+                out.append(sid)
+    return sorted(out)
+
+
+def test_postings_agree_with_brute_force(idb):
+    random.seed(5)
+    apps = [f"app{i}" for i in range(10)]
+    envs = ["prod", "dev", ""]
+    for i in range(300):
+        app = random.choice(apps)
+        env = random.choice(envs)
+        tags = f'{{app="{app}"' + (f',env="{env}"' if env else "") + "}"
+        _register(idb, TEN if i % 5 else TEN2, tags)
+
+    filters = [
+        _sf([TagFilter("app", "=", "app3")]),
+        _sf([TagFilter("app", "!=", "app3")]),
+        _sf([TagFilter("app", "=~", "app[1-3]")]),
+        _sf([TagFilter("app", "!~", "app[1-3]")]),
+        _sf([TagFilter("env", "=", "prod")]),
+        _sf([TagFilter("env", "=", "")]),          # label absent
+        _sf([TagFilter("env", "!=", "")]),         # label present
+        _sf([TagFilter("env", "=~", ".*")]),       # matches absent too
+        _sf([TagFilter("env", "!~", "pro.*")]),
+        _sf([TagFilter("app", "=", "app1"), TagFilter("env", "=", "prod")]),
+        _sf([TagFilter("app", "=", "app1")], [TagFilter("app", "=", "app2")]),
+        _sf([TagFilter("missing", "=", "x")]),
+        _sf([TagFilter("missing", "!=", "x")]),
+    ]
+    for sf in filters:
+        for tenants in ([TEN], [TEN2], [TEN, TEN2]):
+            got = idb.search_stream_ids(tenants, sf)
+            want = _brute(idb, tenants, sf)
+            assert got == want, (sf.to_string(), tenants)
+
+
+def test_cache_invalidated_on_register(idb):
+    _register(idb, TEN, '{app="a"}')
+    sf = _sf([TagFilter("app", "=", "a")])
+    assert len(idb.search_stream_ids([TEN], sf)) == 1
+    _register(idb, TEN, '{app="a",host="h2"}')
+    assert len(idb.search_stream_ids([TEN], sf)) == 2
+
+
+def test_high_cardinality_exact_is_fast(tmp_path):
+    """50K streams: '=' resolution must not re-parse every stream's tags."""
+    db = IndexDB(str(tmp_path / "big"))
+    try:
+        batch = [( _sid(TEN, f'{{app="a{i}",host="h{i % 97}"}}'),
+                   f'{{app="a{i}",host="h{i % 97}"}}')
+                 for i in range(50_000)]
+        db.must_register_streams(batch)
+        sf = _sf([TagFilter("app", "=", "a123")])
+        t0 = time.time()
+        for _ in range(100):
+            db._filter_cache.clear()
+            got = db.search_stream_ids([TEN], sf)
+        elapsed = (time.time() - t0) / 100
+        assert len(got) == 1
+        # posting-list lookup: well under a millisecond-ish per query even
+        # on this 1-CPU host; the old linear parse took ~100ms at 50K
+        assert elapsed < 0.02, f"{elapsed * 1e3:.1f}ms per resolution"
+    finally:
+        db.close()
+
+
+def test_reopen_rebuilds_postings(tmp_path):
+    db = IndexDB(str(tmp_path / "re"))
+    _register(db, TEN, '{app="x"}')
+    _register(db, TEN, '{app="y"}')
+    db.close()
+    db2 = IndexDB(str(tmp_path / "re"))
+    try:
+        got = db2.search_stream_ids([TEN], _sf([TagFilter("app", "=", "x")]))
+        assert len(got) == 1
+    finally:
+        db2.close()

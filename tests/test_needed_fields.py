@@ -1,0 +1,97 @@
+"""Needed-columns propagation: unreferenced columns must never decode
+(reference lib/prefixfilter + per-pipe updateNeededFields)."""
+
+import pytest
+
+from victorialogs_tpu.engine import block_search as bsearch
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.logsql.parser import parse_query
+from victorialogs_tpu.logsql.pipes import compute_needed_fields
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(200):
+        lr.add(TEN, T0 + i * NS, [
+            ("app", f"app{i % 2}"), ("_msg", f"error row {i}"),
+            ("payload", f"wide-column-{i}" * 5),
+            ("code", str(200 + i % 3))])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+def _needed(qs):
+    return compute_needed_fields(parse_query(qs).pipes)
+
+
+def test_compute_needed_fields():
+    assert _needed("*") == {"*"}
+    assert _needed("* | fields a, b") == {"a", "b"}
+    assert _needed("* | stats count() c") == set()
+    assert _needed("* | stats by (app) count() c") == {"app"}
+    assert _needed("* | stats sum(code) s") == {"code"}
+    assert _needed("* | sort by (code) | fields a") == {"a", "code"}
+    assert _needed("* | where code:200 | fields a") == {"a", "code"}
+    assert _needed("* | top 3 by (k)") == {"k"}
+    assert _needed("* | field_values app") == {"app"}
+    assert _needed("* | blocks_count") == set()
+    assert _needed("* | uniq by (app)") == {"app"}
+    assert "*" in _needed("* | limit 5")
+    # delete narrows from the output side
+    got = _needed("* | fields a, b, c | delete c")
+    assert got == {"a", "b", "c"}  # delete happens after fields
+
+
+def _track_decodes(monkeypatch):
+    decoded = []
+    orig = bsearch.BlockSearch.values
+
+    def spy(self, name):
+        decoded.append(name)
+        return orig(self, name)
+    monkeypatch.setattr(bsearch.BlockSearch, "values", spy)
+    return decoded
+
+
+def test_stats_count_decodes_no_columns(store, monkeypatch):
+    decoded = _track_decodes(monkeypatch)
+    rows = run_query_collect(store, [TEN], "* | stats count() c",
+                             timestamp=T0)
+    assert rows == [{"c": "200"}]
+    assert decoded == []
+
+
+def test_stats_by_decodes_only_group_column(store, monkeypatch):
+    decoded = _track_decodes(monkeypatch)
+    rows = run_query_collect(store, [TEN],
+                             "* | stats by (app) count() c", timestamp=T0)
+    assert len(rows) == 2
+    assert set(decoded) == {"app"}
+
+
+def test_sort_fields_decodes_only_referenced(store, monkeypatch):
+    decoded = _track_decodes(monkeypatch)
+    rows = run_query_collect(
+        store, [TEN], "error | sort by (code) | fields code | limit 3",
+        timestamp=T0)
+    assert len(rows) == 3
+    # the filter reads _msg via the dict/encoded fast path, not values();
+    # the pipeline itself must only decode the sort/output column
+    assert set(decoded) <= {"code", "_msg"}
+    assert "payload" not in set(decoded)
+
+
+def test_full_output_still_complete(store):
+    rows = run_query_collect(store, [TEN], "* | limit 1", timestamp=T0)
+    assert set(rows[0]) >= {"_time", "_stream", "app", "_msg", "payload",
+                            "code"}

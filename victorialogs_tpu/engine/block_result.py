@@ -75,15 +75,23 @@ class BlockResult:
         self._cols: dict[str, list[str]] = {}
         self._bs: BlockSearch | None = None
         self._sel: np.ndarray | None = None   # selected row indices into bs
+        self._needed: set | None = None       # needed-columns restriction
         self.timestamps: list[int] | None = None
 
     # ---- constructors ----
     @staticmethod
-    def from_block_search(bs: BlockSearch, bm: np.ndarray) -> "BlockResult":
+    def from_block_search(bs: BlockSearch, bm: np.ndarray,
+                          needed: set | None = None) -> "BlockResult":
+        """needed: optional needed-columns set from the pipe chain — when
+        given (and not {"*"}), column_names()/rows() only enumerate those,
+        so unreferenced columns are never decoded."""
         sel = np.nonzero(bm)[0]
         br = BlockResult(int(sel.shape[0]))
         br._bs = bs
         br._sel = sel
+        if needed is not None and "*" in needed:
+            needed = None
+        br._needed = needed
         br.timestamps = bs.timestamps()[sel].tolist()
         return br
 
@@ -119,11 +127,19 @@ class BlockResult:
     def column_names(self) -> list[str]:
         names: dict[str, None] = {}
         if self._bs is not None:
-            names["_time"] = None
-            names["_stream"] = None
-            names["_stream_id"] = None
-            for n in self._bs.column_names():
-                names[n] = None
+            if self._needed is None:
+                names["_time"] = None
+                names["_stream"] = None
+                names["_stream_id"] = None
+                for n in self._bs.column_names():
+                    names[n] = None
+            else:
+                for n in ("_time", "_stream", "_stream_id"):
+                    if n in self._needed:
+                        names[n] = None
+                for n in self._bs.column_names():
+                    if n in self._needed:
+                        names[n] = None
         for n in self._cols:
             names[n] = None
         return list(names)
@@ -137,6 +153,7 @@ class BlockResult:
     def filter_rows(self, mask: np.ndarray) -> "BlockResult":
         keep = np.nonzero(mask)[0]
         br = BlockResult(int(keep.shape[0]))
+        br._needed = self._needed
         if self._bs is not None and not self._cols:
             br._bs = self._bs
             br._sel = self._sel[keep]

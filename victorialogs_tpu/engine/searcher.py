@@ -142,6 +142,8 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                         fn.step_seconds = step_seconds
 
     head = build_processor_chain(q.pipes, write_block or (lambda br: None))
+    from ..logsql.pipes import compute_needed_fields
+    needed = compute_needed_fields(q.pipes)
 
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
@@ -186,7 +188,8 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                         q.filter.apply_to_block(bs, bm)
                     if not bm.any():
                         continue
-                    head.write_block(BlockResult.from_block_search(bs, bm))
+                    head.write_block(
+                        BlockResult.from_block_search(bs, bm, needed))
                 if batch and cand:
                     if head.is_done():
                         raise QueryCancelled()
@@ -200,7 +203,7 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                         if not bm.any():
                             continue
                         head.write_block(
-                            BlockResult.from_block_search(bs, bm))
+                            BlockResult.from_block_search(bs, bm, needed))
     except QueryCancelled:
         pass
     head.flush()

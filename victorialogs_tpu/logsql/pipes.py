@@ -74,6 +74,14 @@ class Pipe:
     def needed_fields(self) -> set:
         return set()
 
+    def input_fields(self, out_needed: set) -> set:
+        """Fields this pipe needs from its INPUT given the fields needed
+        from its output — the back-to-front needed-columns propagation
+        (reference per-pipe updateNeededFields + lib/prefixfilter).  The
+        default (pass-through + own inputs) is always safe; reducing pipes
+        override it to reset the set."""
+        return out_needed | self.needed_fields()
+
     def make_processor(self, next_p: Processor) -> Processor:
         raise NotImplementedError
 
@@ -104,6 +112,9 @@ class PipeFields(Pipe):
     def needed_fields(self):
         return set(self.fields)
 
+    def input_fields(self, out_needed):
+        return set(self.fields)
+
     def make_processor(self, next_p):
         fields = self.fields
 
@@ -125,6 +136,11 @@ class PipeDelete(Pipe):
     def to_string(self):
         return "delete " + ", ".join(quote_token_if_needed(f)
                                      for f in self.fields)
+
+    def input_fields(self, out_needed):
+        if "*" in out_needed:
+            return out_needed
+        return out_needed - set(self.fields)
 
     def can_live_tail(self):
         return True
@@ -415,6 +431,9 @@ class PipeUniq(Pipe):
     def needed_fields(self):
         return set(self.by)
 
+    def input_fields(self, out_needed):
+        return set(self.by) if self.by else {"*"}
+
     def make_processor(self, next_p):
         pipe = self
 
@@ -491,6 +510,10 @@ class PipeStats(Pipe):
         for f in self.funcs:
             out |= f.needed_fields()
         return out
+
+    def input_fields(self, out_needed):
+        # stats replaces the row set: only grouped/aggregated inputs matter
+        return self.needed_fields()
 
     def _bucket_value(self, b: ByField, v: str, ts: int | None) -> str:
         if not b.bucket:
@@ -923,6 +946,18 @@ _PIPE_PARSERS = {
 
 def register_pipe(name: str, parse_fn) -> None:
     _PIPE_PARSERS[name] = parse_fn
+
+
+def compute_needed_fields(pipes: list) -> set:
+    """Back-to-front needed-columns set for the storage scan: which columns
+    the pipe chain can ever read from a raw block.  {"*"} means all
+    (reference getNeededColumns -> prefixfilter — storage_search.go:123)."""
+    needed = {"*"}
+    for p in reversed(pipes):
+        needed = p.input_fields(needed)
+        if "*" in needed:
+            needed = {"*"} | needed
+    return needed
 
 
 # transform pipes (extract/format/math/unpack/replace/top/...) register

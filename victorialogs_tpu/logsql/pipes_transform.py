@@ -1021,6 +1021,9 @@ class PipeTop(Pipe):
 
     name = "top"
 
+    def input_fields(self, out_needed):
+        return set(self.by) if self.by else {"*"}
+
     def to_string(self):
         s = "top"
         if self.limit != 10:
@@ -1364,6 +1367,9 @@ class PipeFieldValues(Pipe):
 
     name = "field_values"
 
+    def input_fields(self, out_needed):
+        return {self.field}
+
     def to_string(self):
         s = "field_values " + quote_token_if_needed(self.field)
         if self.limit:
@@ -1403,6 +1409,9 @@ class PipeBlocksCount(Pipe):
     result_name: str = "blocks_count"
 
     name = "blocks_count"
+
+    def input_fields(self, out_needed):
+        return set()
 
     def to_string(self):
         s = "blocks_count"

@@ -668,6 +668,10 @@ class StatsRowAny(StatsFunc):
     def default_name(self):
         return "row_any(*)" if not self.fields else super().default_name()
 
+    def needed_fields(self):
+        out = super().needed_fields()
+        return out if self.fields else out | {"*"}
+
     def new_state(self):
         return None
 

@@ -5,13 +5,14 @@ mergeset-backed indexdb (lib/logstorage/indexdb.go:20-31): it answers
 "which streamIDs in this partition match `{label=...}`" and "what are the tags
 of streamID X".
 
-The reference stores three key namespaces in an LSM mergeset table.  Our v1
-representation is an append-only registration log (`streams.jsonl.zst` frames)
-hydrated into an in-memory table at open — same query semantics, with the
-stream-filter result cache keyed by filter string (indexdb.go:55-57).  Stream
-cardinality per day-partition is low relative to row count, so the in-memory
-table is the right trade-off; a mergeset-equivalent SSTable backend can slot in
-behind the same API.
+The reference stores three key namespaces in an LSM mergeset table —
+streamID registry, streamID->tags, and (tag,value)->streamIDs posting lists
+(indexdb.go:20-31, 182-307).  Our representation keeps all three: an
+append-only registration log (`streams.jsonl`) hydrated at open into the
+registry plus in-memory inverted postings, so `{app="x"}` resolves in
+O(matching streams) via set intersection instead of re-parsing every
+stream's tags.  Results are memoized in the filter cache (indexdb.go:55-57),
+invalidated on registrations.
 """
 
 from __future__ import annotations
@@ -21,7 +22,7 @@ import os
 import threading
 
 from .log_rows import StreamID, TenantID
-from .stream_filter import StreamFilter, parse_stream_tags
+from .stream_filter import StreamFilter, _compiled, parse_stream_tags
 
 STREAMS_FILENAME = "streams.jsonl"
 
@@ -35,6 +36,11 @@ class IndexDB:
         self._streams: dict[StreamID, str] = {}
         # tenant -> list[StreamID] for tenant-scoped scans
         self._by_tenant: dict[TenantID, list[StreamID]] = {}
+        # inverted postings: tenant -> label -> value -> set[StreamID]
+        # (the (tag,value)->streamIDs namespace — indexdb.go:20-31)
+        self._postings: dict[TenantID, dict[str, dict[str, set]]] = {}
+        # tenant -> label -> set[StreamID] having the label at all
+        self._label_any: dict[TenantID, dict[str, set]] = {}
         self._filter_cache: dict[tuple, list[StreamID]] = {}
         self._file_path = os.path.join(path, STREAMS_FILENAME)
         if os.path.exists(self._file_path):
@@ -60,6 +66,11 @@ class IndexDB:
             return
         self._streams[sid] = tags_str
         self._by_tenant.setdefault(sid.tenant, []).append(sid)
+        postings = self._postings.setdefault(sid.tenant, {})
+        label_any = self._label_any.setdefault(sid.tenant, {})
+        for label, value in parse_stream_tags(tags_str).items():
+            postings.setdefault(label, {}).setdefault(value, set()).add(sid)
+            label_any.setdefault(label, set()).add(sid)
 
     def close(self) -> None:
         with self._lock:
@@ -106,6 +117,33 @@ class IndexDB:
         with self._lock:
             return self._streams.get(sid)
 
+    def _match_tag_filter(self, tenant: TenantID, tf, all_sids: set) -> set:
+        """Exact stream set for ONE tag filter via the inverted postings.
+
+        Semantics match TagFilter.matches over tags.get(label, ""): absent
+        labels read as the empty string, so negations and empty-matching
+        regexes include label-less streams."""
+        postings = self._postings.get(tenant, {}).get(tf.label, {})
+        label_any = self._label_any.get(tenant, {}).get(tf.label, set())
+        if tf.op == "=":
+            if tf.value == "":
+                return all_sids - label_any
+            return set(postings.get(tf.value, ()))
+        if tf.op == "!=":
+            if tf.value == "":
+                return set(label_any)
+            return all_sids - postings.get(tf.value, set())
+        rx = _compiled(tf.value)
+        hit: set = set()
+        for value, sids in postings.items():
+            if rx.fullmatch(value) is not None:
+                hit |= sids
+        if rx.fullmatch("") is not None:
+            hit |= all_sids - label_any
+        if tf.op == "=~":
+            return hit
+        return all_sids - hit                      # '!~'
+
     def search_stream_ids(self, tenants: list[TenantID],
                           sf: StreamFilter) -> list[StreamID]:
         key = (tuple(tenants), sf)
@@ -113,13 +151,24 @@ class IndexDB:
             cached = self._filter_cache.get(key)
             if cached is not None:
                 return cached
-            out: list[StreamID] = []
+            result: set[StreamID] = set()
             for t in tenants:
-                for sid in self._by_tenant.get(t, ()):  # insertion order
-                    tags = parse_stream_tags(self._streams[sid])
-                    if sf.matches(tags):
-                        out.append(sid)
-            out.sort()
+                all_sids = set(self._by_tenant.get(t, ()))
+                if not all_sids:
+                    continue
+                for grp in sf.or_groups:
+                    # '=' filters first: cheapest and most selective
+                    ordered = sorted(
+                        grp, key=lambda tf: 0 if tf.op == "=" else
+                        1 if tf.op == "=~" else 2)
+                    cand: set | None = None
+                    for tf in ordered:
+                        s = self._match_tag_filter(t, tf, all_sids)
+                        cand = s if cand is None else cand & s
+                        if not cand:
+                            break
+                    result |= cand if cand is not None else all_sids
+            out = sorted(result)
             if len(self._filter_cache) > 512:
                 self._filter_cache.clear()
             self._filter_cache[key] = out
