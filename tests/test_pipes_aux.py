@@ -1,0 +1,160 @@
+"""Tests for join/union/stream_context/collapse_nums/decolorize/hash/
+json_array_len/block_stats pipes."""
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.logsql.parser import parse_query
+from victorialogs_tpu.logsql.pipes_aux import (collapse_nums,
+                                               prettify_collapsed)
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    yield s
+    s.close()
+
+
+def _ingest(s, rows):
+    lr = LogRows(stream_fields=["app"])
+    for i, fields in enumerate(rows):
+        lr.add(TEN, T0 + i * NS, [("app", fields.pop("app", "a"))]
+               + list(fields.items()))
+    s.must_add_rows(lr)
+    s.debug_flush()
+
+
+def q(s, query):
+    return run_query_collect(s, [TEN], query, timestamp=T0)
+
+
+# ---------------- collapse_nums unit ----------------
+
+def test_collapse_nums_basic():
+    assert collapse_nums("took 25ms for id 12345") == \
+        "took <N>ms for id <N>"
+    # short hex words stay text
+    assert collapse_nums("be bad abc") == "be bad abc"
+    # long even hex runs collapse
+    assert collapse_nums("trace deadbeef done") == "trace <N> done"
+    # digits glued to letters stay (part of a token)
+    assert collapse_nums("user42x") == "user42x"
+
+
+def test_collapse_nums_prettify():
+    c = collapse_nums("ip 10.2.3.4 at 2024-01-02T10:11:12.345Z ok")
+    assert prettify_collapsed(c) == "ip <IP4> at <DATETIME> ok"
+    c = collapse_nums("id 123e4567-e89b-12d3-a456-426614174000")
+    assert prettify_collapsed(c) == "id <UUID>"
+
+
+# ---------------- pipes over storage ----------------
+
+def test_collapse_nums_pipe(store):
+    _ingest(store, [{"_msg": "req 123 took 45ms"}])
+    rows = q(store, "* | collapse_nums | fields _msg")
+    assert rows == [{"_msg": "req <N> took <N>ms"}]
+
+
+def test_decolorize_pipe(store):
+    _ingest(store, [{"_msg": "\x1b[31mred error\x1b[0m done"}])
+    rows = q(store, "* | decolorize | fields _msg")
+    assert rows == [{"_msg": "red error done"}]
+
+
+def test_hash_pipe(store):
+    _ingest(store, [{"v": "abc"}, {"v": "abc"}, {"v": "xyz"}])
+    rows = q(store, "* | hash(v) as h | fields h")
+    assert rows[0]["h"] == rows[1]["h"] != rows[2]["h"]
+    assert rows[0]["h"].isdigit()
+
+
+def test_json_array_len_pipe(store):
+    _ingest(store, [{"v": '[1,2,3]'}, {"v": "nope"}])
+    rows = q(store, "* | json_array_len(v) as n | fields n")
+    assert rows == [{"n": "3"}, {"n": "0"}]
+
+
+def test_block_stats_pipe(store):
+    _ingest(store, [{"_msg": f"m{i}", "code": str(i % 3)}
+                    for i in range(50)])
+    rows = q(store, "* | block_stats")
+    fields = {r["field"] for r in rows}
+    assert {"_msg", "code"} <= fields
+    assert all(r["rows"] == "50" for r in rows)
+
+
+def test_join_pipe(store):
+    _ingest(store, [{"_msg": "m", "user": "u1"},
+                    {"_msg": "m", "user": "u2"},
+                    {"_msg": "names", "user": "u1", "full_name": "Alice"},
+                    {"_msg": "names", "user": "u2", "full_name": "Bob"},
+                    {"_msg": "m", "user": "u3"}])
+    rows = q(store, '_msg:=m | join by (user) '
+                    '(_msg:=names | fields user, full_name) '
+                    '| sort by (user) | fields user, full_name')
+    assert rows == [{"user": "u1", "full_name": "Alice"},
+                    {"user": "u2", "full_name": "Bob"},
+                    {"user": "u3"}]
+    rows = q(store, '_msg:=m | join by (user) '
+                    '(_msg:=names | fields user, full_name) inner '
+                    '| sort by (user) | fields user, full_name')
+    assert len(rows) == 2
+
+
+def test_join_prefix(store):
+    _ingest(store, [{"_msg": "m", "user": "u1"},
+                    {"_msg": "names", "user": "u1", "full_name": "Alice"}])
+    rows = q(store, '_msg:=m | join by (user) '
+                    '(_msg:=names | fields user, full_name) prefix j_ '
+                    '| fields user, j_full_name')
+    assert rows == [{"user": "u1", "j_full_name": "Alice"}]
+
+
+def test_union_pipe(store):
+    _ingest(store, [{"_msg": "alpha one"}, {"_msg": "beta two"}])
+    rows = q(store, 'alpha | fields _msg | union (beta | fields _msg)')
+    assert [r["_msg"] for r in rows] == ["alpha one", "beta two"]
+
+
+def test_stream_context_pipe(store):
+    _ingest(store, [{"_msg": f"line {i}" + (" panic" if i == 5 else "")}
+                    for i in range(10)])
+    rows = q(store, "panic | stream_context before 2 after 1 "
+                    "| fields _msg")
+    msgs = [r["_msg"] for r in rows]
+    assert msgs == ["line 3", "line 4", "line 5 panic", "line 6"]
+
+
+def test_stream_context_multiple_streams(store):
+    _ingest(store, [{"app": f"app{i % 2}",
+                     "_msg": f"s{i % 2} line {i}"
+                     + (" boom" if i in (6, 7) else "")}
+                    for i in range(12)])
+    rows = q(store, "boom | stream_context before 1 | fields _msg")
+    msgs = sorted(r["_msg"] for r in rows)
+    # each stream returns its own predecessor + the matched line
+    assert msgs == ["s0 line 4", "s0 line 6 boom",
+                    "s1 line 5", "s1 line 7 boom"]
+
+
+def test_aux_roundtrip_strings():
+    for qs in [
+        "* | collapse_nums at f prettify",
+        "* | decolorize at f",
+        "* | hash(x) as h",
+        "* | json_array_len(x) as n",
+        "* | block_stats",
+        "* | stream_context before 2 after 3",
+        "* | union (err | fields a)",
+        "* | join by (u) (x | fields u, b) inner prefix p_",
+    ]:
+        p = parse_query(qs)
+        assert parse_query(p.to_string()).to_string() == p.to_string(), qs

@@ -128,6 +128,10 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         return
 
     init_subqueries(storage, tenants, q, runner=runner)
+    # storage-backed pipes (join/union/stream_context) get their query hook
+    for p in q.pipes:
+        if hasattr(p, "init_with_storage"):
+            p.init_with_storage(storage, tenants, runner)
     min_ts, max_ts = q.get_time_range()
 
     # rate()/rate_sum() divide by the time-filter range (reference

@@ -352,6 +352,18 @@ def _parse_func_args(lex: Lexer) -> list[str]:
     return args
 
 
+def parse_query_in_parens(lex: Lexer) -> Query:
+    """Parse `(full query)` — used by the join/union pipes."""
+    if not lex.is_keyword("("):
+        raise ParseError("missing '('")
+    lex.next_token()
+    q = _parse_query_internal(lex)
+    if not lex.is_keyword(")"):
+        raise ParseError("missing ')' after query")
+    lex.next_token()
+    return q
+
+
 def _try_parse_subquery(lex: Lexer):
     """Detect `(subquery...)` for in()/contains_*: returns Query or None."""
     # a subquery starts with '(' and contains a full query; we detect it by

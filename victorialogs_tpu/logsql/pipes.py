@@ -960,6 +960,8 @@ def compute_needed_fields(pipes: list) -> set:
     return needed
 
 
-# transform pipes (extract/format/math/unpack/replace/top/...) register
-# themselves on import; must come after the registry exists
+# transform pipes (extract/format/math/unpack/replace/top/...) and aux
+# pipes (join/union/stream_context/...) register themselves on import;
+# must come after the registry exists
 from . import pipes_transform  # noqa: E402,F401  (registration side effect)
+from . import pipes_aux        # noqa: E402,F401  (registration side effect)
