@@ -1,0 +1,86 @@
+"""Query deadline + sort top-k tests."""
+
+import time
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import (QueryTimeoutError,
+                                              run_query_collect)
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(5000):
+        lr.add(TEN, T0 + i * NS, [("app", f"app{i % 3}"),
+                                  ("_msg", f"row {i}"),
+                                  ("v", str((i * 37) % 1000))])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+def test_deadline_exceeded(store):
+    with pytest.raises(QueryTimeoutError):
+        run_query_collect(store, [TEN], "* | stats count() c",
+                          timestamp=T0, deadline=time.monotonic() - 1)
+
+
+def test_deadline_not_exceeded(store):
+    rows = run_query_collect(store, [TEN], "* | stats count() c",
+                             timestamp=T0,
+                             deadline=time.monotonic() + 30)
+    assert rows == [{"c": "5000"}]
+
+
+def test_sort_topk_matches_full_sort(store):
+    full = run_query_collect(
+        store, [TEN], "* | sort by (v, _msg) | fields v, _msg",
+        timestamp=T0)
+    topk = run_query_collect(
+        store, [TEN], "* | sort by (v, _msg) limit 25 | fields v, _msg",
+        timestamp=T0)
+    assert topk == full[:25]
+    topk_off = run_query_collect(
+        store, [TEN],
+        "* | sort by (v, _msg) offset 10 limit 25 | fields v, _msg",
+        timestamp=T0)
+    assert topk_off == full[10:35]
+
+
+def test_sort_topk_desc_and_rank(store):
+    full = run_query_collect(
+        store, [TEN], "* | sort by (v desc, _msg) | fields v", timestamp=T0)
+    topk = run_query_collect(
+        store, [TEN], "* | sort by (v desc, _msg) limit 5 rank as r",
+        timestamp=T0)
+    assert [r["v"] for r in topk] == [r["v"] for r in full[:5]]
+    assert [r["r"] for r in topk] == ["1", "2", "3", "4", "5"]
+
+
+def test_sort_topk_under_tiny_memory_budget(store, monkeypatch):
+    """limit queries stay under budgets that fail a full sort."""
+    monkeypatch.setenv("VL_MEMORY_ALLOWED_BYTES", "100000")
+    from victorialogs_tpu.utils.memory import QueryMemoryError
+    with pytest.raises(QueryMemoryError):
+        run_query_collect(store, [TEN], "* | sort by (v)", timestamp=T0)
+    rows = run_query_collect(store, [TEN], "* | sort by (v) limit 3",
+                             timestamp=T0)
+    assert len(rows) == 3
+
+
+def test_first_last_use_topk(store):
+    rows = run_query_collect(store, [TEN], "* | first 3 by (_time)",
+                             timestamp=T0)
+    assert [r["_msg"] for r in rows] == ["row 0", "row 1", "row 2"]
+    rows = run_query_collect(store, [TEN], "* | last 2 by (_time)",
+                             timestamp=T0)
+    assert [r["_msg"] for r in rows] == ["row 4999", "row 4998"]

@@ -13,6 +13,7 @@ this module stays the correctness oracle and the fallback path.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -35,6 +36,11 @@ class SearchContext:
 
 class QueryCancelled(Exception):
     pass
+
+
+class QueryTimeoutError(Exception):
+    """Raised when a query exceeds its deadline (reference
+    -search.maxQueryDuration — app/vlselect/main.go:133-150)."""
 
 
 def build_processor_chain(pipes: list, write_fn) -> Processor:
@@ -107,12 +113,15 @@ def _collect_stream_filters(f: Filter, out: list) -> None:
 
 
 def run_query(storage, tenants, q: Query | str, write_block=None,
-              timestamp: int | None = None, runner=None) -> None:
+              timestamp: int | None = None, runner=None,
+              deadline: float | None = None) -> None:
     """Execute a LogsQL query; write_block(BlockResult) receives results.
 
     runner: optional TPU runner (tpu/batch.py BatchRunner) — when given,
     block filtering dispatches to the device, one dispatch per leaf per
     part.
+    deadline: monotonic-clock limit; past it the query fails with
+    QueryTimeoutError (reference -search.maxQueryDuration).
     """
     if isinstance(q, str):
         q = parse_query(q, timestamp)
@@ -168,6 +177,10 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                     continue
                 if part.min_ts > max_ts or part.max_ts < min_ts:
                     continue
+                if deadline is not None and \
+                        time.monotonic() > deadline:
+                    raise QueryTimeoutError(
+                        "query exceeded -search.maxQueryDuration")
                 cand: dict[int, BlockSearch] = {}
                 for bi in range(part.num_blocks):
                     if head.is_done():
@@ -214,14 +227,15 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
 
 
 def run_query_collect(storage, tenants, q: Query | str,
-                      timestamp: int | None = None, runner=None) -> list[dict]:
+                      timestamp: int | None = None, runner=None,
+                      deadline: float | None = None) -> list[dict]:
     """Execute and collect result rows as dicts (test/API convenience)."""
     rows: list[dict] = []
 
     def sink(br: BlockResult):
         rows.extend(br.rows())
     run_query(storage, tenants, q, write_block=sink, timestamp=timestamp,
-              runner=runner)
+              runner=runner, deadline=deadline)
     return rows
 
 

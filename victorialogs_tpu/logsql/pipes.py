@@ -348,6 +348,70 @@ class PipeSort(Pipe):
         return {f for f, _ in self.by}
 
     def make_processor(self, next_p):
+        if self.limit > 0:
+            return self._make_topk_processor(next_p)
+        return self._make_full_processor(next_p)
+
+    def _sort_cmp(self):
+        pipe = self
+
+        def cmp(x, y):
+            # global desc reverses the whole ordering, including
+            # per-field desc flags (effective desc = field XOR global)
+            for k, (_f, d) in enumerate(pipe.by):
+                c = _cmp_values(x[0][k], y[0][k])
+                if c:
+                    return -c if (d != pipe.desc) else c
+            return 0
+        return cmp
+
+    def _make_topk_processor(self, next_p):
+        """Bounded top-k sort: `sort ... limit N` keeps only offset+N rows
+        (reference pipe_sort_topk.go) instead of materializing everything."""
+        import heapq
+        pipe = self
+        k = self.limit + self.offset
+        keyfn = cmp_to_key(self._sort_cmp())
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.top: list = []   # (key_values, seq, row_dict)
+                self.seq = 0
+
+            def write_block(self, br):
+                cols = [br.column(f) for f, _ in pipe.by]
+                names = br.column_names()
+                all_cols = [(n, br.column(n)) for n in names]
+                rows = []
+                for ri in range(br.nrows):
+                    rows.append(([c[ri] for c in cols], self.seq,
+                                 {n: v[ri] for n, v in all_cols}))
+                    self.seq += 1
+                self.top = heapq.nsmallest(
+                    k, self.top + rows,
+                    key=lambda r: (keyfn(r), r[1]))
+
+            def flush(self):
+                rows = self.top[pipe.offset:]
+                rank0 = pipe.offset + 1
+                names: dict[str, None] = {}
+                for _kv, _s, rd in rows:
+                    for n in rd:
+                        names.setdefault(n, None)
+                out_cols = {n: [rd.get(n, "") for _kv, _s, rd in rows]
+                            for n in names}
+                if pipe.rank_field:
+                    out_cols[pipe.rank_field] = [
+                        str(rank0 + i) for i in range(len(rows))]
+                self.next_p.write_block(
+                    BlockResult.from_columns(out_cols)
+                    if out_cols else BlockResult(0))
+                self.top = []
+                self.next_p.flush()
+        return P(next_p)
+
+    def _make_full_processor(self, next_p):
         pipe = self
 
         class P(Processor):
@@ -371,15 +435,7 @@ class PipeSort(Pipe):
                     for ri in range(br.nrows):
                         rows.append(([c[ri] for c in cols], bi, ri))
 
-                def cmp(x, y):
-                    # global desc reverses the whole ordering, including
-                    # per-field desc flags (effective desc = field XOR global)
-                    for k, (_f, d) in enumerate(pipe.by):
-                        c = _cmp_values(x[0][k], y[0][k])
-                        if c:
-                            return -c if (d != pipe.desc) else c
-                    return 0
-                rows.sort(key=cmp_to_key(cmp))
+                rows.sort(key=cmp_to_key(pipe._sort_cmp()))
                 if pipe.offset:
                     rows = rows[pipe.offset:]
                 if pipe.limit:

@@ -20,7 +20,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import zstandard
 
+from ..engine.searcher import QueryTimeoutError
 from ..storage.storage import Storage
+from ..utils.memory import QueryMemoryError
 from .insertutil import (CommonParams, LocalLogRowsStorage,
                          LogMessageProcessor)
 from . import vlinsert
@@ -188,6 +190,12 @@ class VLServer:
             self.metrics.inc("vl_http_errors_total")
             self.respond(h, e.status, "text/plain",
                          e.message.encode("utf-8"))
+        except QueryTimeoutError as e:
+            self.metrics.inc("vl_http_errors_total")
+            self.respond(h, 503, "text/plain", str(e).encode("utf-8"))
+        except QueryMemoryError as e:
+            self.metrics.inc("vl_http_errors_total")
+            self.respond(h, 422, "text/plain", str(e).encode("utf-8"))
         except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:  # pragma: no cover

@@ -99,6 +99,22 @@ def _apply_extra_filters(q: Query, ef: str) -> None:
         q.filter = FilterAnd([extra, f])
 
 
+DEFAULT_MAX_QUERY_DURATION_S = 30.0
+
+
+def query_deadline(args) -> float:
+    """Monotonic deadline for one query: per-request `timeout` arg capped
+    by the -search.maxQueryDuration default (reference
+    app/vlselect/main.go:133-150, 277-287)."""
+    t = args.get("timeout", "")
+    secs = DEFAULT_MAX_QUERY_DURATION_S
+    if t:
+        d = parse_duration(t)
+        if d is not None and d > 0:
+            secs = min(d / 1e9, DEFAULT_MAX_QUERY_DURATION_S * 10)
+    return time.monotonic() + secs
+
+
 def _int_arg(args, name, default=0) -> int:
     v = args.get(name, "")
     if not v:
@@ -131,7 +147,8 @@ def handle_query(storage, args, headers, runner=None):
                                       separators=(",", ":")))
             if out:
                 chunks.append("\n".join(out) + "\n")
-        run_query(storage, tenants, q, write_block=sink, runner=runner)
+        run_query(storage, tenants, q, write_block=sink, runner=runner,
+                  deadline=query_deadline(args))
         yield from chunks
     return gen()
 
@@ -152,7 +169,8 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
     fn = sf.StatsCount([])
     fn.out_name = "hits"
     q.pipes.append(PipeStats(by, [fn]))
-    rows = run_query_collect(storage, tenants, q, runner=runner)
+    rows = run_query_collect(storage, tenants, q, runner=runner,
+                             deadline=query_deadline(args))
     groups: dict = {}
     for r in rows:
         key = tuple((f, r.get(f, "")) for f in fields)
@@ -187,7 +205,8 @@ def handle_facets(storage, args, headers, runner=None) -> dict:
                     per["__truncated__"] = 1
                     continue
                 per[v] = per.get(v, 0) + 1
-    run_query(storage, tenants, q, write_block=sink, runner=runner)
+    run_query(storage, tenants, q, write_block=sink, runner=runner,
+                  deadline=query_deadline(args))
     out = []
     for field in sorted(counts):
         per = counts[field]
@@ -279,7 +298,8 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     sp = _require_stats_query(q)
     ts = _parse_time_arg(args.get("time", ""), time.time_ns(), end=True)
-    rows = run_query_collect(storage, tenants, q, runner=runner)
+    rows = run_query_collect(storage, tenants, q, runner=runner,
+                             deadline=query_deadline(args))
     result = []
     by_names = [b.name for b in sp.by]
     for r in rows:
@@ -302,7 +322,8 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
         raise HTTPError(400, f"invalid step {step!r}")
     if not any(b.name == "_time" for b in sp.by):
         sp.by.insert(0, ByField("_time", bucket=step))
-    rows = run_query_collect(storage, tenants, q, runner=runner)
+    rows = run_query_collect(storage, tenants, q, runner=runner,
+                             deadline=query_deadline(args))
     series: dict = {}
     by_names = [b.name for b in sp.by if b.name != "_time"]
     from ..engine.block_result import parse_rfc3339
