@@ -1,0 +1,50 @@
+"""vlagent HTTP frontend: every ingest protocol, no storage, no queries.
+
+Reuses the single binary's insert routing (BaseHTTPApp.handle_insert —
+reference vlagent serves the same vlinsert protocol surface,
+app/vlagent/main.go)."""
+
+from __future__ import annotations
+
+import time
+
+from .app import BaseHTTPApp, Metrics
+from .vlselect import HTTPError
+
+
+class AgentServer(BaseHTTPApp):
+    def __init__(self, agent, listen_addr: str = "127.0.0.1",
+                 port: int = 0):
+        self.agent = agent
+        self.sink = agent
+        self.metrics = Metrics()
+        self.start_time = time.time()
+        self._start_http(listen_addr, port)
+
+    def route(self, h, path, args, body, ctype) -> None:
+        if path in ("/health", "/-/healthy", "/ping", "/insert/ready"):
+            self.respond(h, 200, "text/plain", b"OK")
+            return
+        if path == "/metrics":
+            out = []
+            for name in sorted(self.metrics.counters):
+                out.append(f"{name} {self.metrics.counters[name]}")
+            out.append(f"vlagent_pending_bytes "
+                       f"{self.agent.pending_bytes()}")
+            for c in self.agent.clients:
+                lbl = f'{{url="{c.url}"}}'
+                out.append(f"vlagent_delivered_blocks_total{lbl} "
+                           f"{c.delivered_blocks}")
+                out.append(f"vlagent_delivery_errors_total{lbl} {c.errors}")
+            self.respond(h, 200, "text/plain",
+                         ("\n".join(out) + "\n").encode())
+            return
+        if path == "/":
+            self.respond_json(h, {
+                "app": "vlagent",
+                "uptime_seconds": round(time.time() - self.start_time, 1)})
+            return
+        if path.startswith("/insert/"):
+            self.handle_insert(h, path, args, body, ctype)
+            return
+        raise HTTPError(404, f"unknown path {path}")

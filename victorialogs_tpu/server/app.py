@@ -71,27 +71,11 @@ class Metrics:
         return "\n".join(out) + "\n"
 
 
-class VLServer:
-    """Single-binary server instance (storage + HTTP)."""
+class BaseHTTPApp:
+    """HTTP scaffolding shared by the single binary and vlagent: request
+    decompression, routing dispatch, response helpers."""
 
-    def __init__(self, storage: Storage, listen_addr: str = "127.0.0.1",
-                 port: int = 0, runner=None, max_concurrent: int = 8,
-                 storage_nodes: list | None = None):
-        self.storage = storage
-        self.metrics = Metrics()
-        self.runner = runner
-        self.start_time = time.time()
-        self._sem = threading.Semaphore(max_concurrent)
-        if storage_nodes:
-            # cluster mode: ingest shards to the nodes, queries
-            # scatter-gather over them (reference -storageNode switch —
-            # app/vlstorage/main.go:87-93)
-            from .cluster import NetInsertStorage, NetSelectStorage
-            self.sink = NetInsertStorage(storage_nodes)
-            self.query_storage = NetSelectStorage(storage_nodes)
-        else:
-            self.sink = LocalLogRowsStorage(storage)
-            self.query_storage = storage
+    def _start_http(self, listen_addr: str, port: int) -> None:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -204,6 +188,90 @@ class VLServer:
             self.metrics.inc("vl_http_errors_total")
             self.respond(h, 500, "text/plain", str(e).encode("utf-8"))
 
+    def handle_insert(self, h, path, args, body, ctype) -> None:
+        m = self.metrics
+        cp = CommonParams.from_request(h.headers, args)
+        lmp = LogMessageProcessor(cp, self.sink)
+        try:
+            if path == "/insert/jsonline":
+                n = vlinsert.handle_jsonline(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"jsonline\"}", n)
+            elif path.endswith("/_bulk"):
+                n, resp = vlinsert.handle_elasticsearch_bulk(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"elasticsearch\"}", n)
+                lmp.flush()
+                self.respond_json(h, resp)
+                return
+            elif path == "/insert/loki/api/v1/push":
+                if ctype == "application/x-protobuf" or \
+                        (body[:1] != b"{" and ctype != "application/json"):
+                    n = vlinsert.handle_loki_protobuf(cp, body, lmp)
+                else:
+                    n = vlinsert.handle_loki_json(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"loki\"}", n)
+                lmp.flush()
+                self.respond(h, 204, "text/plain", b"")
+                return
+            elif path == "/insert/opentelemetry/v1/logs":
+                if ctype == "application/json":
+                    n = vlinsert.handle_otlp_json(cp, body, lmp)
+                else:
+                    n = vlinsert.handle_otlp_protobuf(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"opentelemetry\"}", n)
+                lmp.flush()
+                self.respond_json(h, {"partialSuccess": {}})
+                return
+            elif path in ("/insert/datadog/api/v2/logs",
+                          "/insert/datadog/api/v1/input"):
+                obj = json.loads(body) if body[:1] not in (b"[", b"{") \
+                    else None
+                n = vlinsert.handle_datadog(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"datadog\"}", n)
+                lmp.flush()
+                self.respond_json(h, {})
+                return
+            elif path == "/insert/journald/upload":
+                n = vlinsert.handle_journald(cp, body, lmp)
+                m.inc("vl_rows_ingested_total{type=\"journald\"}", n)
+            elif path.startswith("/insert/elasticsearch"):
+                # ES-compat discovery endpoints
+                self.respond_json(h, {"version": {"number": "8.9.0"}})
+                return
+            else:
+                raise HTTPError(404, f"unknown insert path {path}")
+        except vlinsert.IngestError as e:
+            raise HTTPError(400, str(e))
+        lmp.flush()
+        self.respond_json(h, {"status": "ok", "ingested": n})
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class VLServer(BaseHTTPApp):
+    """Single-binary server instance (storage + HTTP)."""
+
+    def __init__(self, storage: Storage, listen_addr: str = "127.0.0.1",
+                 port: int = 0, runner=None, max_concurrent: int = 8,
+                 storage_nodes: list | None = None):
+        self.storage = storage
+        self.metrics = Metrics()
+        self.runner = runner
+        self.start_time = time.time()
+        self._sem = threading.Semaphore(max_concurrent)
+        if storage_nodes:
+            # cluster mode: ingest shards to the nodes, queries
+            # scatter-gather over them (reference -storageNode switch —
+            # app/vlstorage/main.go:87-93)
+            from .cluster import NetInsertStorage, NetSelectStorage
+            self.sink = NetInsertStorage(storage_nodes)
+            self.query_storage = NetSelectStorage(storage_nodes)
+        else:
+            self.sink = LocalLogRowsStorage(storage)
+            self.query_storage = storage
+        self._start_http(listen_addr, port)
+
     def route(self, h, path, args, body, ctype) -> None:
         m = self.metrics
         headers = h.headers
@@ -269,62 +337,6 @@ class VLServer:
         self.respond(h, 404, "text/plain",
                      f"unknown path {path}".encode())
 
-    def handle_insert(self, h, path, args, body, ctype) -> None:
-        m = self.metrics
-        cp = CommonParams.from_request(h.headers, args)
-        lmp = LogMessageProcessor(cp, self.sink)
-        try:
-            if path == "/insert/jsonline":
-                n = vlinsert.handle_jsonline(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"jsonline\"}", n)
-            elif path.endswith("/_bulk"):
-                n, resp = vlinsert.handle_elasticsearch_bulk(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"elasticsearch\"}", n)
-                lmp.flush()
-                self.respond_json(h, resp)
-                return
-            elif path == "/insert/loki/api/v1/push":
-                if ctype == "application/x-protobuf" or \
-                        (body[:1] != b"{" and ctype != "application/json"):
-                    n = vlinsert.handle_loki_protobuf(cp, body, lmp)
-                else:
-                    n = vlinsert.handle_loki_json(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"loki\"}", n)
-                lmp.flush()
-                self.respond(h, 204, "text/plain", b"")
-                return
-            elif path == "/insert/opentelemetry/v1/logs":
-                if ctype == "application/json":
-                    n = vlinsert.handle_otlp_json(cp, body, lmp)
-                else:
-                    n = vlinsert.handle_otlp_protobuf(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"opentelemetry\"}", n)
-                lmp.flush()
-                self.respond_json(h, {"partialSuccess": {}})
-                return
-            elif path in ("/insert/datadog/api/v2/logs",
-                          "/insert/datadog/api/v1/input"):
-                obj = json.loads(body) if body[:1] not in (b"[", b"{") \
-                    else None
-                n = vlinsert.handle_datadog(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"datadog\"}", n)
-                lmp.flush()
-                self.respond_json(h, {})
-                return
-            elif path == "/insert/journald/upload":
-                n = vlinsert.handle_journald(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"journald\"}", n)
-            elif path.startswith("/insert/elasticsearch"):
-                # ES-compat discovery endpoints
-                self.respond_json(h, {"version": {"number": "8.9.0"}})
-                return
-            else:
-                raise HTTPError(404, f"unknown insert path {path}")
-        except vlinsert.IngestError as e:
-            raise HTTPError(400, str(e))
-        lmp.flush()
-        self.respond_json(h, {"status": "ok", "ingested": n})
-
     def handle_select(self, h, path, args, headers) -> None:
         s = self.query_storage
         m = self.metrics
@@ -374,7 +386,3 @@ class VLServer:
             raise HTTPError(404, f"unknown select path {path}")
         m.inc("vl_http_request_duration_ms_total{path=\"" + path + "\"}",
               int((time.time() - t0) * 1000))
-
-    def close(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()

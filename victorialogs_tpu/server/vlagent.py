@@ -1,0 +1,174 @@
+"""vlagent: lightweight log forwarder with disk-backed delivery queues.
+
+Redesign of the reference app/vlagent: accepts every vlinsert protocol,
+serializes rows to the native cluster wire format, appends them to a
+persistent queue PER remote (replication: every -remoteWrite.url gets every
+row — remotewrite.go:165-184), and background clients deliver each queue
+with retries/backoff.  Rows survive agent restarts and remote outages
+(remotewrite.go:188-214).
+
+Run: python -m victorialogs_tpu.server.vlagent \
+        -remoteWrite.url http://host:9428 -httpListenAddr :9429
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.request
+
+import zstandard
+
+from ..storage.log_rows import LogRows
+from ..utils.persistentqueue import PersistentQueue
+from .cluster import PROTOCOL_VERSION
+from .insertutil import LogRowsStorage
+
+_zc = zstandard.ZstdCompressor(level=1)
+
+
+def encode_rows(lr: LogRows) -> bytes:
+    """Native wire block (same format /internal/insert consumes)."""
+    lines = []
+    for i in range(len(lr)):
+        ten = lr.tenants[i]
+        lines.append(json.dumps({
+            "t": lr.timestamps[i], "a": ten.account_id,
+            "p": ten.project_id, "s": lr.stream_tags_str[i],
+            "f": lr.rows[i]}, ensure_ascii=False, separators=(",", ":")))
+    return _zc.compress(("\n".join(lines)).encode("utf-8"))
+
+
+class RemoteWriteClient:
+    """Delivers one persistent queue to one remote URL with backoff."""
+
+    def __init__(self, url: str, queue: PersistentQueue,
+                 timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.queue = queue
+        self.timeout = timeout
+        self.delivered_blocks = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        backoff = 0.5
+        while not self._stop.is_set():
+            data = self.queue.read(timeout=0.5)
+            if data is None:
+                continue
+            if self._send(data):
+                self.queue.ack(len(data))
+                self.delivered_blocks += 1
+                backoff = 0.5
+            else:
+                self.errors += 1
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    def _send(self, body: bytes) -> bool:
+        req = urllib.request.Request(
+            f"{self.url}/internal/insert?version={PROTOCOL_VERSION}",
+            data=body, method="POST")
+        req.add_header("Content-Type", "application/octet-stream")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return 200 <= resp.status < 300
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class VLAgent(LogRowsStorage):
+    """LogRowsStorage fan-out: every batch goes to every remote's queue."""
+
+    def __init__(self, remote_urls: list, queues_dir: str,
+                 max_pending_bytes: int = 1 << 30):
+        if not remote_urls:
+            raise ValueError("vlagent needs at least one -remoteWrite.url")
+        self.clients = []
+        for url in remote_urls:
+            qdir = os.path.join(
+                queues_dir,
+                hashlib.sha256(url.encode()).hexdigest()[:16])
+            q = PersistentQueue(qdir, max_pending_bytes=max_pending_bytes)
+            self.clients.append(RemoteWriteClient(url, q))
+
+    def must_add_rows(self, lr: LogRows) -> None:
+        if not len(lr):
+            return
+        block = encode_rows(lr)
+        for c in self.clients:
+            c.queue.append(block)
+
+    def pending_bytes(self) -> int:
+        return sum(c.queue.pending_bytes() for c in self.clients)
+
+    def wait_drained(self, timeout: float = 30.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if self.pending_bytes() == 0:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+            c.queue.close()
+
+
+def main(argv=None) -> int:
+    from .agent_http import AgentServer
+
+    p = argparse.ArgumentParser(prog="vlagent", description=__doc__)
+    p.add_argument("-remoteWrite.url", action="append", dest="remotes",
+                   default=None, required=False)
+    p.add_argument("-remoteWrite.tmpDataPath", dest="queues_dir",
+                   default="vlagent-queues")
+    p.add_argument("-httpListenAddr", default=":9429")
+    p.add_argument("-remoteWrite.maxPendingBytes", type=int,
+                   dest="max_pending", default=1 << 30)
+    args = p.parse_args(argv)
+    if not args.remotes:
+        print("missing -remoteWrite.url", file=sys.stderr)
+        return 2
+
+    agent = VLAgent(args.remotes, args.queues_dir,
+                    max_pending_bytes=args.max_pending)
+    host, _, port_s = args.httpListenAddr.rpartition(":")
+    server = AgentServer(agent, listen_addr=host or "0.0.0.0",
+                         port=int(port_s or 9429))
+    print(f"started vlagent at http://{host or '0.0.0.0'}:{server.port}/",
+          flush=True)
+
+    stop = []
+
+    def on_signal(_sig, _frm):
+        stop.append(1)
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    agent.close()
+    print("vlagent shut down", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Disk-backed FIFO queue of byte blocks (the vlagent delivery buffer).
+
+Redesign of the reference's lib/persistentqueue FastQueue
+(app/vlagent/remotewrite/remotewrite.go:188-214): writers append
+length-prefixed records to rolling segment files; the reader's position is
+persisted on ack, so undelivered data survives restarts.  A crash between
+write and ack re-delivers (at-least-once), matching the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+
+SEGMENT_MAX_BYTES = 64 << 20
+READER_STATE = "reader.json"
+
+
+class PersistentQueue:
+    def __init__(self, path: str, max_pending_bytes: int = 1 << 30):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_pending_bytes = max_pending_bytes
+        self._lock = threading.Lock()
+        self._data_ready = threading.Condition(self._lock)
+        # reader state
+        rs_path = os.path.join(path, READER_STATE)
+        self._read_seg = 0
+        self._read_off = 0
+        if os.path.exists(rs_path):
+            try:
+                with open(rs_path) as f:
+                    st = json.load(f)
+                self._read_seg = int(st["seg"])
+                self._read_off = int(st["off"])
+            except (ValueError, KeyError, OSError):
+                pass
+        # discover existing segments
+        segs = sorted(int(n.split("_")[1].split(".")[0])
+                      for n in os.listdir(path)
+                      if n.startswith("seg_") and n.endswith(".bin"))
+        self._write_seg = segs[-1] if segs else self._read_seg
+        if self._write_seg < self._read_seg:
+            self._write_seg = self._read_seg
+        self._writer = open(self._seg_path(self._write_seg), "ab")
+        # drop fully-consumed older segments
+        for s in segs:
+            if s < self._read_seg:
+                try:
+                    os.unlink(self._seg_path(s))
+                except OSError:
+                    pass
+
+    def _seg_path(self, n: int) -> str:
+        return os.path.join(self.path, f"seg_{n:08d}.bin")
+
+    # ---- writer ----
+    def append(self, data: bytes) -> None:
+        """Durably append one block (fsynced before returning)."""
+        rec = struct.pack(">I", len(data)) + data
+        with self._lock:
+            if self.pending_bytes_locked() + len(rec) > \
+                    self.max_pending_bytes:
+                raise IOError("persistent queue overflow")
+            if self._writer.tell() >= SEGMENT_MAX_BYTES:
+                self._writer.flush()
+                os.fsync(self._writer.fileno())
+                self._writer.close()
+                self._write_seg += 1
+                self._writer = open(self._seg_path(self._write_seg), "ab")
+            self._writer.write(rec)
+            self._writer.flush()
+            os.fsync(self._writer.fileno())
+            self._data_ready.notify_all()
+
+    def pending_bytes_locked(self) -> int:
+        total = 0
+        for s in range(self._read_seg, self._write_seg + 1):
+            try:
+                sz = os.path.getsize(self._seg_path(s))
+            except OSError:
+                continue
+            total += sz - (self._read_off if s == self._read_seg else 0)
+        return total
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return self.pending_bytes_locked()
+
+    # ---- reader ----
+    def read(self, timeout: float | None = None) -> bytes | None:
+        """Peek the next block (does NOT advance); None on timeout.
+
+        Call ack() after successful delivery to advance durably."""
+        with self._lock:
+            while True:
+                rec = self._read_locked()
+                if rec is not None:
+                    return rec
+                if timeout is not None:
+                    if not self._data_ready.wait(timeout):
+                        return None
+                    continue
+                return None
+
+    def _read_locked(self) -> bytes | None:
+        while True:
+            seg_path = self._seg_path(self._read_seg)
+            try:
+                size = os.path.getsize(seg_path)
+            except OSError:
+                size = 0
+            if self._read_off + 4 <= size:
+                with open(seg_path, "rb") as f:
+                    f.seek(self._read_off)
+                    hdr = f.read(4)
+                    n = struct.unpack(">I", hdr)[0]
+                    data = f.read(n)
+                if len(data) < n:
+                    return None  # torn tail: wait for the writer
+                return data
+            if self._read_seg < self._write_seg:
+                # segment exhausted: move on, clean up
+                try:
+                    os.unlink(seg_path)
+                except OSError:
+                    pass
+                self._read_seg += 1
+                self._read_off = 0
+                continue
+            return None
+
+    def ack(self, data_len: int) -> None:
+        """Advance past the block returned by read() (durable)."""
+        with self._lock:
+            self._read_off += 4 + data_len
+            tmp = os.path.join(self.path, READER_STATE + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({"seg": self._read_seg, "off": self._read_off}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.path, READER_STATE))
+
+    def close(self) -> None:
+        with self._lock:
+            self._writer.flush()
+            os.fsync(self._writer.fileno())
+            self._writer.close()
