@@ -1,0 +1,24 @@
+# victorialogs_tpu build/test entry points.
+#
+# The native host core (victorialogs_tpu/native/libvlnative.so) also builds
+# itself on first import; this target is for explicit/offline builds.
+
+NATIVE_DIR := victorialogs_tpu/native
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(NATIVE_DIR)/libvlnative.so
+
+$(NATIVE_DIR)/libvlnative.so: $(NATIVE_DIR)/vlnative.cpp
+	g++ -O3 -std=c++17 -shared -fPIC -o $@ $<
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -f $(NATIVE_DIR)/libvlnative.so

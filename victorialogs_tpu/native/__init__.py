@@ -1,0 +1,153 @@
+"""Native host core loader: builds/loads libvlnative.so via ctypes.
+
+The shared library is compiled on first use with g++ (no pip deps, no
+pybind11 — plain C ABI).  Every consumer has a pure-numpy fallback, so a
+missing toolchain degrades performance, never correctness.  Set
+VL_NO_NATIVE=1 to force the fallbacks (used in tests to diff outputs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "vlnative.cpp")
+_SO = os.path.join(_HERE, "libvlnative.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", _SO + ".tmp", _SRC]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        return False
+    os.replace(_SO + ".tmp", _SO)
+    return True
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("VL_NO_NATIVE"):
+            return None
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        p_u8 = ctypes.POINTER(ctypes.c_uint8)
+        p_i64 = ctypes.POINTER(ctypes.c_int64)
+        p_u64 = ctypes.POINTER(ctypes.c_uint64)
+        lib.vl_to_fixed_width.argtypes = [p_u8, p_i64, p_i64, i64, p_u8,
+                                          i64, i64]
+        lib.vl_to_fixed_width.restype = None
+        lib.vl_tokenize_arena.argtypes = [p_u8, p_i64, p_i64, i64, p_i64,
+                                          p_i64, p_i64, i64]
+        lib.vl_tokenize_arena.restype = i64
+        lib.vl_unique_token_hashes.argtypes = [p_u8, p_i64, p_i64, i64,
+                                               p_u64, i64]
+        lib.vl_unique_token_hashes.restype = i64
+        lib.vl_xxh64.argtypes = [p_u8, i64, u64]
+        lib.vl_xxh64.restype = u64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def to_fixed_width_native(arena: np.ndarray, offsets: np.ndarray,
+                          lengths: np.ndarray, rb: int, w: int
+                          ) -> np.ndarray | None:
+    """C++ staging transpose; None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arena = np.ascontiguousarray(arena, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    out = np.empty((rb, w), dtype=np.uint8)
+    lib.vl_to_fixed_width(
+        _ptr(arena, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64), len(offsets),
+        _ptr(out, ctypes.c_uint8), rb, w)
+    return out
+
+
+def unique_token_hashes_native(arena: np.ndarray, offsets: np.ndarray,
+                               lengths: np.ndarray) -> np.ndarray | None:
+    """Tokenize+hash+dedupe in one native pass; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arena = np.ascontiguousarray(arena, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    cap = max(64, int(arena.shape[0]) // 2 + len(offsets) + 1)
+    out = np.empty(cap, dtype=np.uint64)
+    n = lib.vl_unique_token_hashes(
+        _ptr(arena, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64), len(offsets),
+        _ptr(out, ctypes.c_uint64), cap)
+    if n < 0:
+        return None
+    return out[:n].copy()
+
+
+def tokenize_arena_native(arena: np.ndarray, offsets: np.ndarray,
+                          lengths: np.ndarray):
+    """Native tokenizer; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arena = np.ascontiguousarray(arena, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    cap = max(64, int(arena.shape[0]) + 1)
+    ts = np.empty(cap, dtype=np.int64)
+    te = np.empty(cap, dtype=np.int64)
+    tr = np.empty(cap, dtype=np.int64)
+    n = lib.vl_tokenize_arena(
+        _ptr(arena, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64), len(offsets),
+        _ptr(ts, ctypes.c_int64), _ptr(te, ctypes.c_int64),
+        _ptr(tr, ctypes.c_int64), cap)
+    if n < 0:
+        return None
+    return ts[:n].copy(), te[:n].copy(), tr[:n].copy()
+
+
+def xxh64_native(data: bytes, seed: int = 0) -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)
+        return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), 0, seed))
+    return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), buf.size, seed))

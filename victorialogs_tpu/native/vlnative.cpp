@@ -1,0 +1,188 @@
+// Native host core for victorialogs_tpu (C ABI, loaded via ctypes).
+//
+// The reference is an AOT-compiled native binary; these are our equivalents
+// of its hottest host paths (the device plane stays JAX/XLA):
+//
+//   vl_to_fixed_width      — staging transpose: packed string column ->
+//                            (rows, W) 0xFF-padded matrix (the HBM layout;
+//                            tpu/layout.py fallback is numpy fancy indexing)
+//   vl_tokenize_arena      — word tokenizer over a packed column
+//                            (lib/logstorage/tokenizer.go:34-148 semantics:
+//                            ASCII alnum + '_' + any >=0x80 byte)
+//   vl_unique_token_hashes — tokenize + xxh64 + dedupe in ONE pass, feeding
+//                            bloom construction without materializing any
+//                            Python token objects
+//                            (bloomfilter.go:126-170 consumes hashes only)
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py, Makefile).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+
+constexpr uint64_t P1 = 11400714785074694791ULL;
+constexpr uint64_t P2 = 14029467366897019727ULL;
+constexpr uint64_t P3 = 1609587929392839161ULL;
+constexpr uint64_t P4 = 9650029242287828579ULL;
+constexpr uint64_t P5 = 2870177450012600261ULL;
+
+inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t rd64(const uint8_t* p) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    return v;
+}
+
+inline uint32_t rd32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+// Canonical XXH64 (public spec); bit-identical to the python `xxhash`
+// package used by utils/hashing.py.
+uint64_t xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+    const uint8_t* end = p + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+                 v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = rotl64(v1 + rd64(p) * P2, 31) * P1; p += 8;
+            v2 = rotl64(v2 + rd64(p) * P2, 31) * P1; p += 8;
+            v3 = rotl64(v3 + rd64(p) * P2, 31) * P1; p += 8;
+            v4 = rotl64(v4 + rd64(p) * P2, 31) * P1; p += 8;
+        } while (p <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) +
+            rotl64(v4, 18);
+        v1 *= P2; v1 = rotl64(v1, 31); v1 *= P1; h ^= v1; h = h * P1 + P4;
+        v2 *= P2; v2 = rotl64(v2, 31); v2 *= P1; h ^= v2; h = h * P1 + P4;
+        v3 *= P2; v3 = rotl64(v3, 31); v3 *= P1; h ^= v3; h = h * P1 + P4;
+        v4 *= P2; v4 = rotl64(v4, 31); v4 *= P1; h ^= v4; h = h * P1 + P4;
+    } else {
+        h = seed + P5;
+    }
+    h += (uint64_t)len;
+    while (p + 8 <= end) {
+        uint64_t k = rd64(p);
+        k *= P2; k = rotl64(k, 31); k *= P1;
+        h ^= k;
+        h = rotl64(h, 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= (uint64_t)rd32(p) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (*p) * P5;
+        h = rotl64(h, 11) * P1;
+        p++;
+    }
+    h ^= h >> 33; h *= P2;
+    h ^= h >> 29; h *= P3;
+    h ^= h >> 32;
+    return h;
+}
+
+inline bool word_char(uint8_t b) {
+    return (b >= 'a' && b <= 'z') || (b >= 'A' && b <= 'Z') ||
+           (b >= '0' && b <= '9') || b == '_' || b >= 0x80;
+}
+
+}  // namespace
+
+extern "C" {
+
+void vl_to_fixed_width(const uint8_t* arena, const int64_t* offsets,
+                       const int64_t* lengths, int64_t nrows,
+                       uint8_t* out, int64_t rb, int64_t w) {
+    std::memset(out, 0xFF, (size_t)(rb * w));
+    for (int64_t i = 0; i < nrows; i++) {
+        int64_t len = lengths[i];
+        if (len > w - 1) len = w - 1;
+        if (len > 0) {
+            std::memcpy(out + i * w, arena + offsets[i], (size_t)len);
+        }
+    }
+}
+
+int64_t vl_tokenize_arena(const uint8_t* arena, const int64_t* offsets,
+                          const int64_t* lengths, int64_t nrows,
+                          int64_t* tok_start, int64_t* tok_end,
+                          int64_t* tok_row, int64_t cap) {
+    int64_t nt = 0;
+    for (int64_t r = 0; r < nrows; r++) {
+        const int64_t off = offsets[r], len = lengths[r];
+        int64_t i = 0;
+        while (i < len) {
+            while (i < len && !word_char(arena[off + i])) i++;
+            if (i >= len) break;
+            int64_t s = i;
+            while (i < len && word_char(arena[off + i])) i++;
+            if (nt >= cap) return -1;
+            tok_start[nt] = off + s;
+            tok_end[nt] = off + i;
+            tok_row[nt] = r;
+            nt++;
+        }
+    }
+    return nt;
+}
+
+// Tokenize + hash + dedupe in one pass.  Dedup keys on the xxh64 hash:
+// for bloom construction this is exactly equivalent to deduping on token
+// bytes (identical hashes set identical bloom bits).  Returns the number
+// of unique hashes written to out (first-seen order), or -1 if out_cap
+// would overflow.
+int64_t vl_unique_token_hashes(const uint8_t* arena, const int64_t* offsets,
+                               const int64_t* lengths, int64_t nrows,
+                               uint64_t* out, int64_t out_cap) {
+    // open-addressing set sized to the next power of two >= 2*out_cap
+    size_t table_size = 64;
+    while ((int64_t)table_size < out_cap * 2) table_size <<= 1;
+    uint64_t* table = (uint64_t*)std::calloc(table_size, sizeof(uint64_t));
+    if (table == nullptr) return -1;
+    const size_t mask = table_size - 1;
+    int64_t n_out = 0;
+    for (int64_t r = 0; r < nrows; r++) {
+        const int64_t off = offsets[r], len = lengths[r];
+        int64_t i = 0;
+        while (i < len) {
+            while (i < len && !word_char(arena[off + i])) i++;
+            if (i >= len) break;
+            int64_t s = i;
+            while (i < len && word_char(arena[off + i])) i++;
+            uint64_t h = xxh64(arena + off + s, (size_t)(i - s), 0);
+            // 0 is the empty slot marker; remap the (essentially
+            // impossible) zero hash onto a fixed sentinel
+            if (h == 0) h = 0x9E3779B97F4A7C15ULL;
+            size_t slot = (size_t)h & mask;
+            bool found = false;
+            while (table[slot] != 0) {
+                if (table[slot] == h) { found = true; break; }
+                slot = (slot + 1) & mask;
+            }
+            if (!found) {
+                if (n_out >= out_cap) { std::free(table); return -1; }
+                table[slot] = h;
+                out[n_out++] = h;
+            }
+        }
+    }
+    std::free(table);
+    return n_out;
+}
+
+uint64_t vl_xxh64(const uint8_t* data, int64_t len, uint64_t seed) {
+    return xxh64(data, (size_t)len, seed);
+}
+
+}  // extern "C"

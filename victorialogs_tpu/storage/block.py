@@ -84,6 +84,13 @@ def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
     if col.vtype in (VT_CONST, VT_DICT):
         return
     if col.vtype == VT_STRING:
+        # native fast path: tokenize+hash+dedupe in one C++ pass
+        from .. import native
+        hashes = native.unique_token_hashes_native(
+            col.arena, col.offsets, col.lengths)
+        if hashes is not None:
+            col.bloom = bloom_build(hashes)
+            return
         ts_, te_, _ = tokenize_arena(col.arena, col.offsets, col.lengths)
         tokens = unique_tokens_bytes(col.arena, ts_, te_)
     else:

@@ -53,10 +53,18 @@ def to_fixed_width(arena_np: np.ndarray, offsets_np: np.ndarray,
 
     Returns (matrix, W, overflow_row_indices).  Overflow rows (longer than
     W-1) are truncated in the matrix; the runner re-checks them on host.
+    Uses the C++ host core when available (native/vlnative.cpp); numpy
+    fancy-indexing fallback otherwise.
     """
     r = int(offsets_np.shape[0])
     max_len = int(lengths_np.max()) if r else 0
     w = width if width is not None else row_width_bucket(max_len)
+    from .. import native
+    nat = native.to_fixed_width_native(arena_np, offsets_np, lengths_np,
+                                       rb, w)
+    if nat is not None:
+        overflow = np.nonzero(lengths_np > w - 1)[0]
+        return nat, w, overflow
     out = np.full((rb, w), 0xFF, dtype=np.uint8)
     if r:
         copy_lens = np.minimum(lengths_np, w - 1)
