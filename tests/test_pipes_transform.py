@@ -321,3 +321,18 @@ def test_pipe_roundtrip_to_string():
         parsed = parse_query(qs)
         again = parse_query(parsed.to_string())
         assert parsed.to_string() == again.to_string(), qs
+
+
+def test_facets_pipe(store):
+    _ingest(store, [{"k": "a", "lvl": "info"}] * 6
+            + [{"k": "b", "lvl": "warn"}] * 3 + [{"k": "c", "lvl": "warn"}])
+    rows = q(store, "* | facets 2")
+    got = {(r["field_name"], r["field_value"]): int(r["hits"])
+           for r in rows}
+    assert got[("k", "a")] == 6 and got[("k", "b")] == 3
+    assert got[("lvl", "info")] == 6 and got[("lvl", "warn")] == 4
+    assert ("k", "c") not in got  # limit 2
+    # const fields (app=a on every row) are dropped unless requested
+    assert not any(f == "app" for f, _ in got)
+    rows = q(store, "* | facets 2 keep_const_fields")
+    assert any(r["field_name"] == "app" for r in rows)

@@ -1405,6 +1405,82 @@ class PipeFieldValues(Pipe):
 
 
 @dataclass(repr=False)
+class PipeFacets(Pipe):
+    """Per-field top values with hit counts (reference pipe_facets.go:
+    output columns field_name/field_value/hits)."""
+
+    limit: int = 10
+    max_values_per_field: int = 1000
+    max_value_len: int = 1000
+    keep_const_fields: bool = False
+
+    name = "facets"
+
+    def to_string(self):
+        s = "facets"
+        if self.limit != 10:
+            s += f" {self.limit}"
+        if self.max_values_per_field != 1000:
+            s += f" max_values_per_field {self.max_values_per_field}"
+        if self.max_value_len != 1000:
+            s += f" max_value_len {self.max_value_len}"
+        if self.keep_const_fields:
+            s += " keep_const_fields"
+        return s
+
+    def input_fields(self, out_needed):
+        return {"*"}
+
+    def make_processor(self, next_p):
+        pipe = self
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                self.counts: dict[str, dict[str, int]] = {}
+                self.rows_total = 0
+
+            def write_block(self, br):
+                self.rows_total += br.nrows
+                names = [n for n in br.column_names()
+                         if n not in ("_time", "_stream_id", "_stream")]
+                for n in names:
+                    per = self.counts.setdefault(n, {})
+                    if per is None:
+                        continue
+                    for v in br.column(n):
+                        if v == "" or len(v) > pipe.max_value_len:
+                            continue
+                        if len(per) >= pipe.max_values_per_field and \
+                                v not in per:
+                            # too many distinct values: not a facet
+                            self.counts[n] = None
+                            break
+                        per[v] = per.get(v, 0) + 1
+
+            def flush(self):
+                out = {"field_name": [], "field_value": [], "hits": []}
+                for field in sorted(self.counts):
+                    per = self.counts[field]
+                    if per is None:
+                        continue
+                    if not pipe.keep_const_fields and len(per) == 1 and \
+                            next(iter(per.values())) == self.rows_total:
+                        continue  # constant field: not a useful facet
+                    items = sorted(per.items(),
+                                   key=lambda kv: (-kv[1], kv[0]))
+                    for v, hits in items[:pipe.limit]:
+                        out["field_name"].append(field)
+                        out["field_value"].append(v)
+                        out["hits"].append(str(hits))
+                self.next_p.write_block(
+                    BlockResult.from_columns(out)
+                    if out["field_name"] else BlockResult(0))
+                self.next_p.flush()
+        return P(next_p)
+
+
+@dataclass(repr=False)
 class PipeBlocksCount(Pipe):
     result_name: str = "blocks_count"
 
@@ -1698,6 +1774,27 @@ def _parse_field_values(lex: Lexer):
     return p
 
 
+def _parse_facets(lex: Lexer):
+    p = PipeFacets()
+    if not lex.is_end() and not lex.is_keyword("|") and \
+            lex.token.isdigit():
+        p.limit = _parse_uint(lex, "facets limit")
+    while True:
+        if lex.is_keyword("max_values_per_field"):
+            lex.next_token()
+            p.max_values_per_field = _parse_uint(lex,
+                                                 "max_values_per_field")
+        elif lex.is_keyword("max_value_len"):
+            lex.next_token()
+            p.max_value_len = _parse_uint(lex, "max_value_len")
+        elif lex.is_keyword("keep_const_fields"):
+            p.keep_const_fields = True
+            lex.next_token()
+        else:
+            break
+    return p
+
+
 def _parse_blocks_count(lex: Lexer):
     p = PipeBlocksCount()
     if lex.is_keyword("as"):
@@ -1727,6 +1824,7 @@ register_pipe("pack_json", lambda lex: _parse_pack(lex, logfmt=False))
 register_pipe("pack_logfmt", lambda lex: _parse_pack(lex, logfmt=True))
 register_pipe("sample", _parse_sample)
 register_pipe("unroll", _parse_unroll)
+register_pipe("facets", _parse_facets)
 register_pipe("field_names", _parse_field_names)
 register_pipe("field_values", _parse_field_values)
 register_pipe("blocks_count", _parse_blocks_count)

@@ -289,6 +289,13 @@ class VLServer(BaseHTTPApp):
                 "uptime_seconds": round(time.time() - self.start_time, 1)})
             return
 
+        # ---- embedded web UI (reference vmui — vlselect/main.go:71-74) ----
+        if path in ("/select/vmui", "/select/vmui/", "/vmui", "/vmui/"):
+            from .vmui import VMUI_HTML
+            self.respond(h, 200, "text/html; charset=utf-8",
+                         VMUI_HTML.encode("utf-8"))
+            return
+
         # ---- ingestion ----
         if path.startswith("/insert/"):
             self.handle_insert(h, path, args, body, ctype)

@@ -1,0 +1,106 @@
+"""Minimal embedded web UI (the vmui analogue, served at /select/vmui/).
+
+The reference embeds a prebuilt React SPA (app/vlselect/main.go:71-74);
+this is a self-contained single-file UI over the same HTTP API: LogsQL
+query box, time range, hits histogram, streaming results table."""
+
+VMUI_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>VictoriaLogs TPU</title>
+<style>
+  body { font-family: -apple-system, system-ui, sans-serif; margin: 0;
+         background: #f7f7f9; color: #222; }
+  header { background: #1a1a2e; color: #eee; padding: 10px 16px;
+           display: flex; gap: 12px; align-items: center; }
+  header h1 { font-size: 16px; margin: 0; font-weight: 600; }
+  #bar { display: flex; gap: 8px; padding: 12px 16px; }
+  #query { flex: 1; font: 14px monospace; padding: 8px; }
+  select, button, input { font-size: 14px; padding: 8px; }
+  button { background: #4361ee; color: white; border: 0;
+           border-radius: 4px; cursor: pointer; }
+  #hits { display: flex; align-items: flex-end; gap: 1px; height: 64px;
+          padding: 0 16px; }
+  #hits div { background: #4361ee; flex: 1; min-width: 2px; }
+  #meta { padding: 4px 16px; color: #666; font-size: 12px; }
+  table { border-collapse: collapse; margin: 8px 16px; font-size: 13px;
+          width: calc(100% - 32px); }
+  th, td { border: 1px solid #ddd; padding: 4px 8px; text-align: left;
+           font-family: monospace; vertical-align: top;
+           word-break: break-all; }
+  th { background: #eaeaef; position: sticky; top: 0; }
+  #err { color: #b00020; padding: 0 16px; white-space: pre-wrap; }
+</style>
+</head>
+<body>
+<header><h1>VictoriaLogs <small>tpu-native</small></h1></header>
+<div id="bar">
+  <input id="query" value="*" placeholder="LogsQL query, e.g. error | stats count()">
+  <select id="range">
+    <option value="5m">last 5m</option>
+    <option value="1h">last 1h</option>
+    <option value="24h" selected>last 24h</option>
+    <option value="7d">last 7d</option>
+    <option value="">all time</option>
+  </select>
+  <input id="limit" type="number" value="100" style="width:70px">
+  <button onclick="run()">Run</button>
+</div>
+<div id="hits"></div>
+<div id="meta"></div>
+<div id="err"></div>
+<table id="out"></table>
+<script>
+async function run() {
+  const q = document.getElementById('query').value;
+  const range = document.getElementById('range').value;
+  const limit = document.getElementById('limit').value || 100;
+  const errEl = document.getElementById('err');
+  errEl.textContent = '';
+  let params = new URLSearchParams({query: q, limit: limit});
+  if (range) params.set('start', new Date(Date.now() -
+      {m: 6e4, h: 36e5, d: 864e5}[range.slice(-1)] *
+      parseInt(range)).toISOString());
+  try {
+    const hp = new URLSearchParams({query: q, step: '1h'});
+    if (range) hp.set('start', params.get('start'));
+    fetch('/select/logsql/hits?' + hp).then(r => r.json()).then(h => {
+      const el = document.getElementById('hits');
+      el.innerHTML = '';
+      const vals = (h.hits || []).flatMap(g => g.values);
+      const mx = Math.max(1, ...vals);
+      vals.forEach(v => {
+        const d = document.createElement('div');
+        d.style.height = (v / mx * 100) + '%';
+        d.title = v;
+        el.appendChild(d);
+      });
+    }).catch(() => {});
+    const t0 = performance.now();
+    const resp = await fetch('/select/logsql/query?' + params);
+    const text = await resp.text();
+    if (!resp.ok) { errEl.textContent = text; return; }
+    const rows = text.trim() ? text.trim().split('\\n').map(JSON.parse)
+        : [];
+    const cols = [];
+    rows.forEach(r => Object.keys(r).forEach(k => {
+      if (!cols.includes(k)) cols.push(k); }));
+    const tbl = document.getElementById('out');
+    tbl.innerHTML = '';
+    const hr = tbl.insertRow();
+    cols.forEach(c => { const th = document.createElement('th');
+                        th.textContent = c; hr.appendChild(th); });
+    rows.forEach(r => { const tr = tbl.insertRow();
+      cols.forEach(c => { tr.insertCell().textContent = r[c] ?? ''; }); });
+    document.getElementById('meta').textContent =
+      rows.length + ' rows in ' +
+      Math.round(performance.now() - t0) + 'ms';
+  } catch (e) { errEl.textContent = String(e); }
+}
+document.getElementById('query').addEventListener('keydown',
+  e => { if (e.key === 'Enter') run(); });
+run();
+</script>
+</body>
+</html>"""
