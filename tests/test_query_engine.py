@@ -390,3 +390,14 @@ def test_bare_eq_field_targets_msg(tmp_path):
     rows = run_query_collect(s, [TEN], "eq_field(other) | count()")
     assert rows == [{"count(*)": "1"}]
     s.close()
+
+
+def test_query_concurrency_option(storage):
+    """options(concurrency=N) spins a worker pool; results stay identical
+    and deterministic (reference storage_search.go:1035-1067)."""
+    seq = q(storage, "error | fields _time")
+    par = q(storage, "options(concurrency=4) error | fields _time")
+    assert seq == par
+    seq = q(storage, "* | stats by (level) count() c")
+    par = q(storage, "options(concurrency=4) * | stats by (level) count() c")
+    assert seq == par

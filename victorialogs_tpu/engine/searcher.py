@@ -172,58 +172,91 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                     continue
             tenant_set = set(tenants)
             batch = runner is not None and hasattr(runner, "run_part")
-            for part in pt.ddb.snapshot_parts():
-                if part.num_rows == 0:
-                    continue
-                if part.min_ts > max_ts or part.max_ts < min_ts:
-                    continue
-                if deadline is not None and \
-                        time.monotonic() > deadline:
-                    raise QueryTimeoutError(
-                        "query exceeded -search.maxQueryDuration")
-                cand: dict[int, BlockSearch] = {}
-                for bi in range(part.num_blocks):
-                    if head.is_done():
-                        raise QueryCancelled()
-                    if part.block_min_ts(bi) > max_ts or \
-                       part.block_max_ts(bi) < min_ts:
-                        continue
-                    sid = part.block_stream_id(bi)
-                    if sid.tenant not in tenant_set:
-                        continue
-                    if allowed_sids is not None and sid not in allowed_sids:
-                        continue
-                    bs = BlockSearch(part, bi)
-                    bs.ctx = ctx
-                    if batch:
-                        cand[bi] = bs
-                        continue
-                    if runner is not None:
-                        bm = runner.apply_filter(q.filter, bs)
-                    else:
-                        bm = new_bitmap(bs.nrows)
-                        q.filter.apply_to_block(bs, bm)
-                    if not bm.any():
-                        continue
-                    head.write_block(
-                        BlockResult.from_block_search(bs, bm, needed))
-                if batch and cand:
-                    if head.is_done():
-                        raise QueryCancelled()
-                    # batched device path: one dispatch per filter leaf over
-                    # the whole part (tpu/batch.py)
-                    bms = runner.run_part(q.filter, part, cand)
-                    for bi, bs in cand.items():
-                        if head.is_done():
-                            raise QueryCancelled()
-                        bm = bms[bi]
-                        if not bm.any():
-                            continue
-                        head.write_block(
-                            BlockResult.from_block_search(bs, bm, needed))
+            # CPU-path block workers (reference spawns GetConcurrency()
+            # workers over a 64-block channel — storage_search.go:1035-1067;
+            # numpy/zstd release the GIL, so threads overlap real work)
+            nworkers = 1 if batch else q.get_concurrency()
+            pool = None
+            if nworkers > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                pool = ThreadPoolExecutor(max_workers=nworkers)
+            try:
+                _scan_parts(pt, q, head, runner, batch, tenant_set,
+                            allowed_sids, min_ts, max_ts, ctx, needed,
+                            deadline, pool)
+            finally:
+                if pool is not None:
+                    pool.shutdown(wait=True)
     except QueryCancelled:
         pass
     head.flush()
+
+
+def _eval_block_cpu(q, bs):
+    bm = new_bitmap(bs.nrows)
+    q.filter.apply_to_block(bs, bm)
+    return bm
+
+
+def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
+                min_ts, max_ts, ctx, needed, deadline, pool) -> None:
+    for part in pt.ddb.snapshot_parts():
+        if part.num_rows == 0:
+            continue
+        if part.min_ts > max_ts or part.max_ts < min_ts:
+            continue
+        if deadline is not None and time.monotonic() > deadline:
+            raise QueryTimeoutError(
+                "query exceeded -search.maxQueryDuration")
+        cand: dict[int, BlockSearch] = {}
+        for bi in range(part.num_blocks):
+            if head.is_done():
+                raise QueryCancelled()
+            if part.block_min_ts(bi) > max_ts or \
+               part.block_max_ts(bi) < min_ts:
+                continue
+            sid = part.block_stream_id(bi)
+            if sid.tenant not in tenant_set:
+                continue
+            if allowed_sids is not None and sid not in allowed_sids:
+                continue
+            bs = BlockSearch(part, bi)
+            bs.ctx = ctx
+            if batch or pool is not None:
+                cand[bi] = bs
+                continue
+            if runner is not None:
+                bm = runner.apply_filter(q.filter, bs)
+            else:
+                bm = new_bitmap(bs.nrows)
+                q.filter.apply_to_block(bs, bm)
+            if not bm.any():
+                continue
+            head.write_block(
+                BlockResult.from_block_search(bs, bm, needed))
+        if not cand:
+            continue
+        if head.is_done():
+            raise QueryCancelled()
+        if batch:
+            # batched device path: one dispatch per filter leaf over
+            # the whole part (tpu/batch.py)
+            bms = runner.run_part(q.filter, part, cand)
+        else:
+            # CPU worker pool: filters evaluate in parallel, results
+            # are written downstream in deterministic block order
+            order = list(cand)
+            results = pool.map(lambda bi: _eval_block_cpu(q, cand[bi]),
+                               order)
+            bms = dict(zip(order, results))
+        for bi, bs in cand.items():
+            if head.is_done():
+                raise QueryCancelled()
+            bm = bms[bi]
+            if not bm.any():
+                continue
+            head.write_block(
+                BlockResult.from_block_search(bs, bm, needed))
 
 
 def run_query_collect(storage, tenants, q: Query | str,
