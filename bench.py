@@ -214,6 +214,25 @@ def main():
             "identical": same,
         }
 
+    # pallas scan micro-bench in a crash-safe subprocess (the kernel is
+    # hardware-unproven: the axon tunnel was down for all of round 2)
+    pallas_info = None
+    if tpu_ok:
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "bench_pallas.py")],
+                capture_output=True, timeout=300)
+            if res.returncode == 0 and res.stdout.strip():
+                pallas_info = json.loads(
+                    res.stdout.decode().splitlines()[-1])
+            else:
+                pallas_info = {"error":
+                               res.stderr.decode()[-300:] or "failed"}
+        except subprocess.TimeoutExpired:
+            pallas_info = {"error": "timeout"}
+
     headline = results["regex_full"]
     out = {
         "metric": "logsql_e2e_regex_scan_rows_per_sec_per_chip",
@@ -225,6 +244,7 @@ def main():
         "backend": backend,
         "n_rows": N_ROWS,
         "configs": results,
+        "pallas": pallas_info,
     }
     print(json.dumps(out))
     print(f"# end-to-end via run_query+BatchRunner; gen={gen_s:.1f}s "

@@ -1,0 +1,82 @@
+"""Pallas-vs-XLA scan kernel micro-benchmark (invoked by bench.py in a
+subprocess so an unproven hardware lowering can never take down the main
+benchmark run).  Prints one JSON line."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_097_152
+    n_rows = max(512, (n_rows // 512) * 512)   # pallas tile alignment
+    width = 128
+    import jax
+    import jax.numpy as jnp
+
+    from victorialogs_tpu.tpu import kernels as K
+    from victorialogs_tpu.tpu.kernels_pallas import (PALLAS_AVAILABLE,
+                                                     match_scan_pallas,
+                                                     pallas_ok)
+    if not PALLAS_AVAILABLE:
+        print(json.dumps({"pallas": "import unavailable"}))
+        return 0
+
+    rng = np.random.default_rng(7)
+    mat = np.full((n_rows, width), 0xFF, dtype=np.uint8)
+    base = np.frombuffer(
+        (b"GET /api/items status=200 deadline exceeded retry ok " * 3),
+        dtype=np.uint8)
+    lens = rng.integers(20, width - 1, n_rows).astype(np.int32)
+    take = min(base.shape[0], width - 1)
+    mat[:, :take] = base[:take]
+    assert pallas_ok(n_rows, width)
+
+    rows_d = jax.device_put(jnp.asarray(mat))
+    lens_d = jax.device_put(jnp.asarray(lens))
+    pat = jnp.asarray(np.frombuffer(b"deadline", dtype=np.uint8))
+    # CPU backends only run pallas in interpret mode (slow but validates
+    # the plumbing); real hardware uses the Mosaic lowering
+    interp = jax.default_backend() not in ("tpu",)
+
+    # force sync completion mode before timing (axon: timings are fake
+    # until the first device->host download)
+    float(jnp.sum(jnp.ones(8)))
+
+    def timed(fn, reps=5):
+        out = fn()          # warmup/compile
+        np.asarray(out)
+        t0 = time.time()
+        for _ in range(reps):
+            np.asarray(fn())
+        return (time.time() - t0) / reps
+
+    xla_s = timed(lambda: K.match_scan(rows_d, lens_d, pat, 8,
+                                       K.MODE_PHRASE, True, True))
+    pl_s = timed(lambda: match_scan_pallas(rows_d, lens_d, pat, 8,
+                                           K.MODE_PHRASE, True, True,
+                                           interpret=interp))
+    same = bool(np.array_equal(
+        np.asarray(K.match_scan(rows_d, lens_d, pat, 8, K.MODE_PHRASE,
+                                True, True)),
+        np.asarray(match_scan_pallas(rows_d, lens_d, pat, 8,
+                                     K.MODE_PHRASE, True, True,
+                                     interpret=interp))))
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "interpret_mode": interp,
+        "n_rows": n_rows,
+        "xla_rows_per_sec": round(n_rows / xla_s),
+        "pallas_rows_per_sec": round(n_rows / pl_s),
+        "pallas_speedup_vs_xla": round(xla_s / pl_s, 2),
+        "identical": same,
+    }))
+    return 0 if same else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Standalone pallas parity check (run by tests/test_pallas.py in a clean
+subprocess: the axon sitecustomize breaks pallas imports in-process)."""
+
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from victorialogs_tpu.tpu import kernels as K  # noqa: E402
+from victorialogs_tpu.tpu.kernels_pallas import (PALLAS_AVAILABLE,  # noqa
+                                                 TILE_ROWS,
+                                                 match_scan_pallas,
+                                                 pad_for_pallas, pallas_ok)
+
+assert PALLAS_AVAILABLE, "pallas unavailable in clean env"
+
+
+def stage(vals, width=128):
+    bs = [v.encode() for v in vals]
+    r = len(bs)
+    mat = np.full((r, width), 0xFF, dtype=np.uint8)
+    lens = np.zeros(r, dtype=np.int32)
+    for i, b in enumerate(bs):
+        take = min(len(b), width - 1)
+        mat[i, :take] = np.frombuffer(b[:take], dtype=np.uint8)
+        lens[i] = take
+    return pad_for_pallas(mat, lens)
+
+
+WORDS = ["err", "error", "GET", "a_b", "x", "", "deadline exceeded",
+         "tok123", "ab/cd"]
+random.seed(17)
+vals = []
+for _ in range(900):
+    vals.append(" ".join(random.choice(WORDS)
+                         for _ in range(random.randint(0, 6))))
+vals += ["error", " error", "error ", "xerror", "errorx", "err or"]
+mat, lens = stage(vals)
+assert pallas_ok(*mat.shape)
+
+PATTERNS = [
+    ("error", K.MODE_PHRASE, True, True),
+    ("err", K.MODE_PHRASE, True, True),
+    ("err", K.MODE_PREFIX, True, False),
+    ("error", K.MODE_SUBSTRING, False, False),
+    ("GET", K.MODE_EXACT, False, False),
+    ("err", K.MODE_EXACT_PREFIX, False, False),
+    ("deadline exceeded", K.MODE_PHRASE, True, True),
+    ("a_b", K.MODE_PHRASE, True, True),
+    ("/", K.MODE_SUBSTRING, False, False),
+]
+
+for pat_s, mode, st, et in PATTERNS:
+    pat = np.frombuffer(pat_s.encode(), dtype=np.uint8)
+    want = np.asarray(K.match_scan(mat, lens.astype(np.int32), pat,
+                                   len(pat_s), mode, st, et))
+    got = np.asarray(match_scan_pallas(mat, lens, pat, len(pat_s), mode,
+                                       st, et, interpret=True))
+    assert np.array_equal(got, want), pat_s
+
+# multi-tile grid
+mat3 = np.concatenate([mat, mat, mat])
+lens3 = np.concatenate([lens, lens, lens])
+pat = np.frombuffer(b"error", dtype=np.uint8)
+want = np.asarray(K.match_scan(mat3, lens3.astype(np.int32), pat, 5,
+                               K.MODE_PHRASE, True, True))
+got = np.asarray(match_scan_pallas(mat3, lens3, pat, 5, K.MODE_PHRASE,
+                                   True, True, interpret=True))
+assert np.array_equal(got, want)
+
+print(f"PALLAS_PARITY_OK patterns={len(PATTERNS)} rows={mat3.shape[0]}")
