@@ -600,23 +600,101 @@ class PipeStats(Pipe):
                 for fn in pipe.funcs:
                     fn.budget = self.budget
 
+            def _key_columns(self, br):
+                """Per-row group-key value lists (bucketing applied).
+
+                _time:step buckets vectorize over the int64 timestamps —
+                only distinct buckets pay string formatting (the per-row
+                Python path was the hits-endpoint hot loop)."""
+                n = br.nrows
+                ts = br.timestamps
+                key_cols = []
+                for b in pipe.by:
+                    if b.bucket and b.name == "_time" and ts is not None:
+                        step = parse_duration(b.bucket)
+                        if step:
+                            arr = np.asarray(ts, dtype=np.int64)
+                            bucketed = (arr // step) * step
+                            uniq, inv = np.unique(bucketed,
+                                                  return_inverse=True)
+                            from ..engine.block_result import format_rfc3339
+                            strs = [format_rfc3339(int(t)) for t in uniq]
+                            key_cols.append([strs[j] for j in inv])
+                            continue
+                    vals = br.column(b.name)
+                    if b.bucket:
+                        vals = [pipe._bucket_value(
+                            b, vals[i],
+                            ts[i] if (ts is not None
+                                      and b.name == "_time") else None)
+                            for i in range(n)]
+                    key_cols.append(vals)
+                return key_cols
+
+            def _try_fast_count(self, br) -> bool:
+                """Vectorized `count() by (...)`: bincount over factorized
+                group ids — the device-partials analogue on the host side
+                (block bitmaps come from the TPU; per-group counting needs
+                no per-row Python)."""
+                if any(fn.iff is not None or fn.fields or
+                       not isinstance(fn, sf.StatsCount)
+                       for fn in pipe.funcs):
+                    return False
+                n = br.nrows
+                if not pipe.by:
+                    key = ()
+                    states = self.groups.get(key)
+                    if states is None:
+                        states = [fn.new_state() for fn in pipe.funcs]
+                        self.groups[key] = states
+                        self.budget.add(80)
+                    for k in range(len(pipe.funcs)):
+                        states[k] += n
+                    return True
+                key_cols = self._key_columns(br)
+                # factorize each key column
+                codes = np.zeros(n, dtype=np.int64)
+                uniques_per_col = []
+                stride = 1
+                for vals in key_cols:
+                    mapping: dict = {}
+                    col_codes = np.empty(n, dtype=np.int64)
+                    for i, v in enumerate(vals):
+                        c = mapping.get(v)
+                        if c is None:
+                            c = mapping[v] = len(mapping)
+                        col_codes[i] = c
+                    codes = codes * len(mapping) + col_codes
+                    uniques_per_col.append(
+                        {c: v for v, c in mapping.items()})
+                    stride *= len(mapping)
+                counts = np.bincount(codes, minlength=0)
+                for code in np.nonzero(counts)[0]:
+                    cnt = int(counts[code])
+                    parts = []
+                    rem = int(code)
+                    for uniq in reversed(uniques_per_col):
+                        parts.append(uniq[rem % len(uniq)])
+                        rem //= len(uniq)
+                    key = tuple(reversed(parts))
+                    states = self.groups.get(key)
+                    if states is None:
+                        states = [fn.new_state() for fn in pipe.funcs]
+                        self.groups[key] = states
+                        self.budget.add(sum(len(k) for k in key) + 80)
+                    for k in range(len(pipe.funcs)):
+                        states[k] += cnt
+                return True
+
             def write_block(self, br):
                 n = br.nrows
                 if n == 0:
                     return
-                ts = br.timestamps
+                if self._try_fast_count(br):
+                    return
                 # group keys per row
                 if pipe.by:
-                    key_cols = []
-                    for b in pipe.by:
-                        vals = br.column(b.name)
-                        if b.bucket:
-                            vals = [pipe._bucket_value(
-                                b, vals[i],
-                                ts[i] if (ts is not None
-                                          and b.name == "_time") else None)
-                                for i in range(n)]
-                        key_cols.append(vals)
+                    key_cols = self._key_columns(br)
                     rows_by_key: dict[tuple, list] = {}
                     for i in range(n):
                         rows_by_key.setdefault(

@@ -281,6 +281,19 @@ class StatsCountUniq(StatsFunc):
     def update(self, state, cols, idxs):
         if self.limit and len(state) >= self.limit:
             return state
+        if len(cols) == 1:
+            # single-field fast path: set ops run at C speed (the common
+            # `count_uniq(field)` shape; dominated the stats bench config)
+            vals = cols[0]
+            if len(idxs) == len(vals):
+                cand = {(v,) for v in vals if v != ""}
+            else:
+                cand = {(vals[i],) for i in idxs if vals[i] != ""}
+            new = cand - state
+            if new:
+                self._charge(sum(len(k[0]) for k in new) + 64 * len(new))
+                state |= new
+            return state
         grown = 0
         for i in idxs:
             key = tuple(c[i] for c in cols)
