@@ -331,6 +331,35 @@ class VLServer(BaseHTTPApp):
             self.respond_stream(h, gen, ctype="application/octet-stream")
             return
 
+        # ---- profiling (reference exposes net/http/pprof; we expose the
+        # Python-native equivalents — SURVEY §5 tracing/profiling) ----
+        if path == "/debug/pprof/threads":
+            import sys
+            import traceback
+            names = {t.ident: t.name for t in threading.enumerate()}
+            out = []
+            for tid, frame in sys._current_frames().items():
+                out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+                out.extend(s.rstrip()
+                           for s in traceback.format_stack(frame))
+            self.respond(h, 200, "text/plain",
+                         ("\n".join(out) + "\n").encode())
+            return
+        if path == "/debug/pprof/profile":
+            import cProfile
+            import pstats
+            import io as _io
+            seconds = min(float(args.get("seconds", "5")), 30.0)
+            prof = cProfile.Profile()
+            prof.enable()
+            time.sleep(seconds)
+            prof.disable()
+            buf = _io.StringIO()
+            pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+                .print_stats(60)
+            self.respond(h, 200, "text/plain", buf.getvalue().encode())
+            return
+
         # ---- storage maintenance ----
         if path == "/internal/force_merge":
             self.storage.must_force_merge(args.get("partition_prefix", ""))

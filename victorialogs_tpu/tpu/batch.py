@@ -27,6 +27,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field as dc_field
 
+import os
+
 import numpy as np
 
 from ..engine.block_search import BlockSearch
@@ -273,6 +275,13 @@ class BatchRunner:
 
         bss: block_idx -> BlockSearch (with .ctx set for stream filters).
         Returns block_idx -> bool bitmap, bit-identical to the CPU path."""
+        trace_dir = os.environ.get("VL_XLA_TRACE_DIR")
+        if trace_dir:
+            # XLA profiler hook at the block-runner seam (SURVEY §5);
+            # inspect with tensorboard or xprof
+            import jax
+            with jax.profiler.trace(trace_dir):
+                return self._eval(f, part, bss, list(bss))
         return self._eval(f, part, bss, list(bss))
 
     def _eval(self, f, part, bss, alive) -> dict:
