@@ -1,0 +1,84 @@
+"""Pattern extraction table tests ported from the reference's
+pattern_test.go cases (same inputs, same expected captures), plus the
+two-generation cache unit tests."""
+
+import time
+
+import pytest
+
+from victorialogs_tpu.logsql.pipes import ParseError
+from victorialogs_tpu.logsql.pipes_transform import Pattern
+from victorialogs_tpu.utils.cache import TwoGenCache
+
+
+CASES = [
+    # (pattern, input, {field: expected})
+    ("<foo>", "", {"foo": ""}),
+    ("<foo>", "abc", {"foo": "abc"}),
+    ("<foo>bar", "", {"foo": ""}),
+    ("<foo>bar", "bar", {"foo": ""}),
+    ("<foo>bar", "bazbar", {"foo": "baz"}),
+    ("<foo>bar", "a bazbar xdsf", {"foo": "a baz"}),
+    ("<foo>bar<>", "a bazbar xdsf", {"foo": "a baz"}),
+    ("foo<bar>", "", {"bar": ""}),
+    ("foo<bar>", "foo", {"bar": ""}),
+    ("foo<bar>", "a foo xdf sdf", {"bar": " xdf sdf"}),
+    ("foo<bar>", "a foo foobar", {"bar": " foobar"}),
+    ("foo<bar>baz", "a foo foobar", {"bar": ""}),
+    ("foo<bar>baz", "a foobaz bar", {"bar": ""}),
+    ("foo<bar>baz", "a foo foobar baz", {"bar": " foobar "}),
+    ("foo<bar>baz", "a foo foobar bazabc", {"bar": " foobar "}),
+    ("ip=<ip> <> path=<path> ",
+     "x=a, ip=1.2.3.4 method=GET host='abc' path=/foo/bar some tail here",
+     {"ip": "1.2.3.4", "path": "/foo/bar"}),
+    ("ip=&lt;<ip>&gt;", "foo ip=<1.2.3.4> bar", {"ip": "1.2.3.4"}),
+    ('"msg":<msg>,', '{"foo":"bar","msg":"foo,b\\"ar\\n\\t","baz":"x"}',
+     {"msg": 'foo,b"ar\n\t'}),
+    ("foo=<bar>", "foo=`bar baz,abc` def", {"bar": "bar baz,abc"}),
+    ("<foo>", '"foo,\\"bar"', {"foo": 'foo,"bar'}),
+    ("[<plain:foo>]", '["foo","bar"]', {"foo": '"foo","bar"'}),
+]
+
+
+@pytest.mark.parametrize("pattern,inp,want", CASES,
+                         ids=[c[0] + "|" + c[1][:20] for c in CASES])
+def test_pattern_table(pattern, inp, want):
+    got = Pattern(pattern).apply(inp)
+    for k, v in want.items():
+        assert got.get(k, "") == v, (pattern, inp, got)
+
+
+@pytest.mark.parametrize("pattern", [
+    "", "foobar", "<>", "<>foo<>bar",        # no named fields
+    "<foo><bar>", "abc<foo><bar>def",        # missing delimiter between
+])
+def test_pattern_parse_failures(pattern):
+    with pytest.raises(ParseError):
+        Pattern(pattern)
+
+
+# ---------------- two-generation cache ----------------
+
+def test_twogen_cache_promote_and_rotate():
+    c = TwoGenCache(rotate_seconds=0.05)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1
+    time.sleep(0.08)
+    # rotation moved entries to prev; a hit promotes into curr
+    assert c.get("a") == 1
+    time.sleep(0.08)
+    # 'a' was promoted so it survives another rotation; 'b' was not
+    assert c.get("a") == 1
+    time.sleep(0.16)
+    # two rotations with no hits: everything ages out
+    assert c.get("a") is None
+    assert c.get("b") is None
+
+
+def test_twogen_cache_clear():
+    c = TwoGenCache()
+    c.put("x", 5)
+    c.clear()
+    assert c.get("x") is None
+    assert len(c) == 0

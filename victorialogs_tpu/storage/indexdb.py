@@ -41,7 +41,10 @@ class IndexDB:
         self._postings: dict[TenantID, dict[str, dict[str, set]]] = {}
         # tenant -> label -> set[StreamID] having the label at all
         self._label_any: dict[TenantID, dict[str, set]] = {}
-        self._filter_cache: dict[tuple, list[StreamID]] = {}
+        # two-generation rotating result cache (reference cache.go:13-58,
+        # filterStreamCache — indexdb.go:55-57)
+        from ..utils.cache import TwoGenCache
+        self._filter_cache = TwoGenCache()
         self._file_path = os.path.join(path, STREAMS_FILENAME)
         if os.path.exists(self._file_path):
             self._load()
@@ -169,9 +172,7 @@ class IndexDB:
                             break
                     result |= cand if cand is not None else all_sids
             out = sorted(result)
-            if len(self._filter_cache) > 512:
-                self._filter_cache.clear()
-            self._filter_cache[key] = out
+            self._filter_cache.put(key, out)
             return out
 
     def all_stream_ids(self, tenants: list[TenantID]) -> list[StreamID]:
