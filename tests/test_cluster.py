@@ -216,3 +216,21 @@ def test_cluster_node_down_fails_query(ingested):
     finally:
         front2.terminate()
         front2.wait(10)
+
+
+def test_cluster_subquery_resolves_globally(ingested):
+    # in(<subquery>) must materialize across ALL shards at the front, not
+    # per-shard (values for app live on both nodes)
+    rows = _query(ingested["front"],
+                  'app:in(error | uniq by (app) | fields app) '
+                  '| stats count() n')
+    # every app stream has error rows => all rows match
+    assert rows == [{"n": str(N_ROWS)}]
+
+
+def test_cluster_join_pipe(ingested):
+    rows = _query(ingested["front"],
+                  'error | join by (app) (* | stats by (app) count() as '
+                  'app_total) | limit 3 | fields app, app_total')
+    assert len(rows) == 3
+    assert all(r["app_total"] == str(N_ROWS // N_STREAMS) for r in rows)

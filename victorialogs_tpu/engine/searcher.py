@@ -90,9 +90,15 @@ def _run_single_column_subquery(storage, tenants, sub, runner=None
     return values
 
 
-def init_subqueries(storage, tenants, q: Query, runner=None) -> None:
+def init_subqueries(storage, tenants, q: Query, runner=None,
+                    detach: bool = False) -> None:
     """Materialize in(<subquery>)-style filters (reference
-    storage_search.go:530-553)."""
+    storage_search.go:530-553).
+
+    detach=True drops the subquery after materialization so to_string()
+    renders the literal value list — the cluster front uses this to
+    resolve subqueries over the WHOLE cluster once and ship plain in(...)
+    filters to the storage nodes (reference initFilterInValues)."""
     from ..logsql.pipes import PipeWhere
     subfilters = list(_iter_subquery_filters(q.filter))
     for p in q.pipes:
@@ -101,6 +107,8 @@ def init_subqueries(storage, tenants, q: Query, runner=None) -> None:
     for f in subfilters:
         f.set_values(_run_single_column_subquery(storage, tenants,
                                                  f.subquery, runner=runner))
+        if detach:
+            f.subquery = None
 
 
 def _collect_stream_filters(f: Filter, out: list) -> None:

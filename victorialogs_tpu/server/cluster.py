@@ -315,11 +315,19 @@ class NetSelectStorage:
 
     def net_run_query(self, tenants, q, write_block=None,
                       timestamp: int | None = None) -> None:
-        from ..engine.searcher import build_processor_chain
+        from ..engine.searcher import build_processor_chain, init_subqueries
         if isinstance(q, str):
             q = parse_query(q, timestamp)
         ts = q.timestamp if getattr(q, "timestamp", None) else \
             (timestamp or time.time_ns())
+        # subqueries resolve against the WHOLE cluster here, then ship as
+        # literal value lists (per-shard resolution would be wrong)
+        init_subqueries(self, tenants, q, detach=True)
+        # storage-backed pipes (join/union/stream_context) also query the
+        # cluster through this front
+        for p in q.pipes:
+            if hasattr(p, "init_with_storage"):
+                p.init_with_storage(self, tenants, None)
         mode, split_at, local_pipes = split_query(q)
 
         # rate()/rate_sum() step for locally-finalized stats
@@ -346,7 +354,9 @@ class NetSelectStorage:
 
         def fetch(url: str):
             from urllib.parse import urlencode
-            qs = urlencode({
+            # POST the query as a form body: materialized in(...) value
+            # lists can exceed sane URL lengths
+            body = urlencode({
                 "version": PROTOCOL_VERSION,
                 "query": q.to_string(),
                 "ts": str(ts),
@@ -354,11 +364,14 @@ class NetSelectStorage:
                 "split_at": str(split_at),
                 "limit": str(push_limit),
                 "tenant": f"{tenant.account_id}:{tenant.project_id}",
-            })
+            }).encode("utf-8")
+            req = urllib.request.Request(
+                f"{url}/internal/select/query", data=body, method="POST")
+            req.add_header("Content-Type",
+                           "application/x-www-form-urlencoded")
             try:
                 with urllib.request.urlopen(
-                        f"{url}/internal/select/query?{qs}",
-                        timeout=self.timeout) as resp:
+                        req, timeout=self.timeout) as resp:
                     if resp.status != 200:
                         raise IOError(f"{url}: HTTP {resp.status}")
                     for frame in read_frames(resp):
