@@ -392,6 +392,16 @@ class DataDB:
                 self._merge_parts(to_merge, big=True)
 
     def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
+        # disk-space reservation: skip the merge when the output could not
+        # fit (reference reserves before merging — datadb.go:478-493)
+        need = int(sum(p.meta.get("compressed_size", 0)
+                       for p in to_merge) * 1.2) + (64 << 20)
+        try:
+            free = shutil.disk_usage(self.path).free
+        except OSError:
+            free = None
+        if free is not None and free < need:
+            return  # not enough space: keep the source parts
         # streaming k-way merge: blocks are read lazily per part and flow
         # straight into the part writer — bounded memory, no row decode for
         # non-overlapping ranges
