@@ -124,6 +124,26 @@ class BlockResult:
             return True
         return self._bs is not None and self._bs.has_column(name)
 
+    def numeric_column(self, name: str):
+        """float64 view of a storage-typed numeric column (uint/int/float),
+        or None — lets stats skip per-row string parsing (the reference
+        keeps blockResult columns type-encoded for the same reason —
+        block_result.go:26-63)."""
+        if self._bs is None or name in self._cols:
+            return None
+        from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
+                                              VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64)
+        if name in self._bs.consts() or name in ("_time", "_stream",
+                                                 "_stream_id"):
+            return None
+        col = self._bs.column(name)
+        if col is None or col.vtype not in (VT_UINT8, VT_UINT16, VT_UINT32,
+                                            VT_UINT64, VT_INT64,
+                                            VT_FLOAT64):
+            return None
+        return col.nums[self._sel].astype(np.float64)
+
     def column_names(self) -> list[str]:
         names: dict[str, None] = {}
         if self._bs is not None:

@@ -140,9 +140,25 @@ class StatsSum(StatsFunc):
     def new_state(self):
         return math.nan
 
+    def block_cols(self, br):
+        # typed numeric columns skip per-row parsing entirely
+        out = []
+        for f in self.fields:
+            num = br.numeric_column(f) \
+                if hasattr(br, "numeric_column") else None
+            out.append(num if num is not None else br.column(f))
+        return out
+
     def update(self, state, cols, idxs):
+        import numpy as np
         s = state
         for c in cols:
+            if isinstance(c, np.ndarray):
+                sub = c if len(idxs) == c.shape[0] else c[idxs]
+                if sub.size:
+                    add = float(np.sum(sub))
+                    s = add if math.isnan(s) else s + add
+                continue
             for i in idxs:
                 v = parse_number(c[i]) if c[i] else math.nan
                 if not math.isnan(v):
@@ -250,9 +266,17 @@ class StatsAvg(StatsFunc):
     def new_state(self):
         return (0.0, 0)  # (sum, count)
 
+    block_cols = StatsSum.block_cols
+
     def update(self, state, cols, idxs):
+        import numpy as np
         s, n = state
         for c in cols:
+            if isinstance(c, np.ndarray):
+                sub = c if len(idxs) == c.shape[0] else c[idxs]
+                s += float(np.sum(sub))
+                n += int(sub.size)
+                continue
             for i in idxs:
                 v = parse_number(c[i]) if c[i] else math.nan
                 if not math.isnan(v):
