@@ -1,0 +1,71 @@
+"""collapse_nums value-level table tests ported from the reference's
+pipe_collapse_nums_test.go — the collapse and prettify rules must agree
+exactly on the reference's own cases."""
+
+import pytest
+
+from victorialogs_tpu.logsql.pipes_aux import (collapse_nums,
+                                               prettify_collapsed)
+
+COLLAPSE_CASES = [
+    ("", ""),
+    ("foo", "foo"),
+    ("ad", "ad"),
+    ("abc", "abc"),
+    ("deadbeef", "<N>"),
+    ("a b c d e f ad be:eac,dead beef ab",
+     "a b c d e f ad be:eac,<N> <N> ab"),
+    ("ыва", "ыва"),
+    ("0", "<N>"),
+    ("1234567890", "<N>"),
+    ("1foo", "1foo"),
+    ("1 foo", "<N> foo"),
+    ("a1foo2bar34", "a1foo2bar34"),
+    ("a.1Zfoo.2Tbar:34", "a.<N>Zfoo.<N>Tbar:<N>"),
+    ("ЫВА123bar45.78", "ЫВА123bar45.<N>"),
+    ("ЫВА.123.bar.45.78", "ЫВА.<N>.bar.<N>.<N>"),
+    ("1.23.45.67", "<N>.<N>.<N>.<N>"),
+    ("2024-12-25T10:20:30Z foo", "<N>-<N>-<N>T<N>:<N>:<N>Z foo"),
+    ("2024-12-25T10:20:30.123324+05:00 foo",
+     "<N>-<N>-<N>T<N>:<N>:<N>.<N>+<N>:<N> foo"),
+    ("release v1.2.3", "release v<N>.<N>.<N>"),
+    ("2004-10-12T43:23:12Z abc:345", "<N>-<N>-<N>T<N>:<N>:<N>Z abc:<N>"),
+    ("123.43s", "<N>.<N>s"),
+    ("123ms 2us 3h5m6s43ms43μs324ns",
+     "<N>ms <N>us <N>h<N>m<N>s<N>ms<N>μs<N>ns"),
+    ("0x1234 0XFEAD12", "0x<N> 0X<N>"),
+]
+
+
+@pytest.mark.parametrize("inp,want", COLLAPSE_CASES,
+                         ids=[c[0][:25] or "empty" for c in COLLAPSE_CASES])
+def test_collapse_nums_reference_cases(inp, want):
+    assert collapse_nums(inp) == want
+
+
+PRETTIFY_CASES = [
+    ("", ""),
+    ("foo", "foo"),
+    ("35.191.193.225:51648 - 2edfed59-3e98-4073-bbb2-28d321ca71a7 - - "
+     "[2024/12/08 15:21:02] 10.71.20.32 GET /foo 200",
+     "<IP4>:<N> - <UUID> - - [<DATETIME>] <IP4> GET /foo <N>"),
+    ("E1208 15:21:02.748877 62 metric_reporter.go:182",
+     "E1208 <TIME> <N> metric_reporter.go:<N>"),
+    ("2024-12-08T15:22:32.342Z error exporterhelper/queued_retry.go:101",
+     "<DATETIME> error exporterhelper/queued_retry.go:<N>"),
+    ("2024-12-08 15:22:32Z error exporterhelper/queued_retry.go:101",
+     "<DATETIME> error exporterhelper/queued_retry.go:<N>"),
+    ("2024-12-08 15:22:32,123 error exporterhelper/queued_retry.go:101",
+     "<DATETIME> error exporterhelper/queued_retry.go:<N>"),
+    ("2024-12-08 15:22:32.123+10:30 error "
+     "exporterhelper/queued_retry.go:101",
+     "<DATETIME> error exporterhelper/queued_retry.go:<N>"),
+    ("2024/12/08T15:22:32-10:30 error exporterhelper/queued_retry.go:101",
+     "<DATETIME> error exporterhelper/queued_retry.go:<N>"),
+]
+
+
+@pytest.mark.parametrize("inp,want", PRETTIFY_CASES,
+                         ids=[c[0][:25] or "empty" for c in PRETTIFY_CASES])
+def test_prettify_reference_cases(inp, want):
+    assert prettify_collapsed(collapse_nums(inp)) == want
