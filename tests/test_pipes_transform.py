@@ -336,3 +336,36 @@ def test_facets_pipe(store):
     assert not any(f == "app" for f, _ in got)
     rows = q(store, "* | facets 2 keep_const_fields")
     assert any(r["field_name"] == "app" for r in rows)
+
+
+def test_math_reference_eval_chain(store):
+    # ported from pipe_math_test.go: results feed later expressions
+    _ingest(store, [{"a": "v1", "b": "2", "c": "3"}])
+    rows = q(store, "* | eval b+1 as a, a*2 as b, b-10.5+c as c "
+                    "| fields a, b, c")
+    assert rows == [{"a": "3", "b": "6", "c": "-1.5"}]
+
+
+def test_math_reference_default_chain(store):
+    _ingest(store, [{"a": "v1", "b": "2", "c": "3"},
+                    {"a": "0", "b": "0", "c": "3"},
+                    {"a": "3", "b": "2"},
+                    {"a": "3", "b": "foo"}])
+    rows = q(store, "* | math a / b default c as r | fields r")
+    assert rows == [{"r": "3"}, {"r": "3"}, {"r": "1.5"}, {"r": "NaN"}]
+
+
+def test_math_const_kinds(store):
+    _ingest(store, [{"x": "1"}])
+    rows = q(store, "* | math '123.45.67.89' + 1000 as ip, "
+                    "10m5s + 10e9 as dur, 0xff & 0x0f as h, "
+                    "'2024-05-30T01:02:03Z' ^ 1 as t "
+                    "| fields ip, dur, h, t")
+    assert rows == [{"ip": "2066564929", "dur": "615000000000",
+                     "h": "15", "t": "1717030923000000000"}]
+
+
+def test_math_optional_result_name(store):
+    _ingest(store, [{"a": "6", "b": "2"}])
+    rows = q(store, "* | math a / b")
+    assert any(v == "3" for v in rows[0].values())

@@ -513,12 +513,23 @@ class PipeFormat(Pipe):
 # ---------------- math ----------------
 
 def _math_num(s: str) -> float:
+    """Reference parseMathNumber order: number (incl. 0x hex), duration,
+    IPv4, RFC3339 timestamp (pipe_math.go:1066)."""
+    if s[:2].lower() == "0x":
+        try:
+            return float(int(s, 16))
+        except ValueError:
+            pass
     v = parse_number(s)
     if not math.isnan(v):
         return v
     d = parse_duration(s)
     if d is not None:
         return float(d)
+    from .matchers import parse_ipv4
+    ip = parse_ipv4(s)
+    if ip is not None:
+        return float(ip)
     from ..engine.block_result import parse_rfc3339
     t = parse_rfc3339(s)
     if t is not None:
@@ -693,16 +704,21 @@ def _parse_math_operand(lex: Lexer) -> MathExpr:
     if lex.is_keyword("+"):
         lex.next_token()
         return _parse_math_operand(lex)
-    v = _math_num(tok)
-    if tok and not math.isnan(v) and (tok[0].isdigit() or
+    quoted = getattr(lex, "is_quoted", False)
+    v = _math_num(tok) if tok else math.nan
+    if tok and not math.isnan(v) and (quoted or tok[0].isdigit() or
                                       tok[0] in ".-+" or
                                       low in ("inf", "nan")):
+        # consts: numbers (incl. 0x/size suffixes), durations, and quoted
+        # IPv4/timestamp values like '2024-05-30T01:02:03Z'
         lex.next_token()
         return MathExpr("const", value=v)
-    name = _parse_field_name(lex)
-    if not name:
+    # field operand: a SINGLE token — compound gluing would swallow
+    # operators like the '+' in `b+1`
+    if not tok or tok in (",", ")", "|", "(", "as"):
         raise ParseError(f"bad math operand near {tok!r}")
-    return MathExpr("field", value=name)
+    lex.next_token()
+    return MathExpr("field", value=tok)
 
 
 @dataclass(repr=False)
@@ -1603,9 +1619,18 @@ def _parse_math(lex: Lexer):
         expr = parse_math_expr(lex)
         if lex.is_keyword("as"):
             lex.next_token()
-        res = _parse_field_name(lex)
-        if not res:
-            raise ParseError("math: missing result field after expression")
+            res = _parse_field_name(lex)
+            if not res:
+                raise ParseError("math: missing result field after 'as'")
+        elif lex.is_keyword(",", "|", ")") or lex.is_end():
+            # optional result name: default to the expression rendering
+            # (reference allows `math a / b default c`)
+            res = expr.to_string()
+        else:
+            res = _parse_field_name(lex)
+            if not res:
+                raise ParseError(
+                    "math: missing result field after expression")
         entries.append((expr, res))
         if lex.is_keyword(","):
             lex.next_token()
