@@ -142,3 +142,16 @@ def test_small_queries_fit_budget(store, monkeypatch):
     _budget(monkeypatch, 1_000_000)
     assert q(store, "* | stats count_uniq(v) u") == [{"u": "5"}]
     assert len(q(store, "* | uniq by (v)")) == 5
+
+
+def test_time_bucket_offset(store):
+    _ingest(store, [{"v": "1"}] * 120)  # rows at T0 + i seconds
+    rows = q(store, "* | stats by (_time:1m) count() c")
+    assert [r["c"] for r in rows] == ["60", "60"]
+    rows = q(store, "* | stats by (_time:1m offset 30s) count() c")
+    # buckets shifted by 30s: 30 / 60 / 30 split
+    assert [r["c"] for r in rows] == ["30", "60", "30"]
+    # rendering round-trips
+    from victorialogs_tpu.logsql.parser import parse_query
+    p = parse_query("* | stats by (_time:1m offset 30s) count() c")
+    assert parse_query(p.to_string()).to_string() == p.to_string()

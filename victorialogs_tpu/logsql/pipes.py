@@ -544,7 +544,15 @@ class ByField:
         s = self.name
         if self.bucket:
             s += f":{self.bucket}"
+            if self.bucket_offset:
+                s += f" offset {self.bucket_offset}"
         return s
+
+    def offset_ns(self) -> int:
+        if not self.bucket_offset:
+            return 0
+        d = parse_duration(self.bucket_offset)
+        return d if d is not None else 0
 
 
 @dataclass(repr=False)
@@ -578,7 +586,8 @@ class PipeStats(Pipe):
             step = parse_duration(b.bucket)
             if step and ts is not None:
                 from ..engine.block_result import format_rfc3339
-                return format_rfc3339((ts // step) * step)
+                off = b.offset_ns()
+                return format_rfc3339(((ts - off) // step) * step + off)
             return v
         step = parse_number(b.bucket)
         if not math.isnan(step) and step > 0:
@@ -614,7 +623,8 @@ class PipeStats(Pipe):
                         step = parse_duration(b.bucket)
                         if step:
                             arr = np.asarray(ts, dtype=np.int64)
-                            bucketed = (arr // step) * step
+                            off = b.offset_ns()
+                            bucketed = ((arr - off) // step) * step + off
                             uniq, inv = np.unique(bucketed,
                                                   return_inverse=True)
                             from ..engine.block_result import format_rfc3339
@@ -850,6 +860,10 @@ def _parse_by_fields(lex: Lexer) -> list:
             lex.next_token()
             bf.bucket = lex.token
             lex.next_token()
+            if lex.is_keyword("offset"):
+                lex.next_token()
+                bf.bucket_offset = lex.token
+                lex.next_token()
         out.append(bf)
     lex.next_token()
     return out

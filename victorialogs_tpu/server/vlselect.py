@@ -165,7 +165,8 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
               if f.strip()] + \
              [f.strip() for f in args.get("fields", "").split(",")
               if f.strip()]
-    by = [ByField("_time", bucket=step)] + [ByField(f) for f in fields]
+    by = [ByField("_time", bucket=step, bucket_offset=offset_s)] + \
+        [ByField(f) for f in fields]
     fn = sf.StatsCount([])
     fn.out_name = "hits"
     q.pipes.append(PipeStats(by, [fn]))
