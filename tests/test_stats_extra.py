@@ -155,3 +155,30 @@ def test_time_bucket_offset(store):
     from victorialogs_tpu.logsql.parser import parse_query
     p = parse_query("* | stats by (_time:1m offset 30s) count() c")
     assert parse_query(p.to_string()).to_string() == p.to_string()
+
+
+def test_time_bucket_calendar(store):
+    lr = LogRows(stream_fields=["app"])
+    times = ["2025-07-27T23:00:00", "2025-07-28T01:00:00",  # Sun/Mon
+             "2025-08-02T00:00:00", "2025-12-31T10:00:00",
+             "2026-01-01T00:00:01"]
+    from victorialogs_tpu.engine.block_result import parse_rfc3339
+    for i, t in enumerate(times):
+        lr.add(TEN, parse_rfc3339(t + "Z"), [("app", "a"),
+                                             ("_msg", f"m{i}")])
+    store.must_add_rows(lr)
+    store.debug_flush()
+    rows = q(store, "* | stats by (_time:week) count() c | sort by (_time)")
+    # Mon 07-21 week: the Sunday row; Mon 07-28 week: Mon + Sat rows;
+    # Mon 12-29 week: Dec 31 + Jan 1 rows
+    assert [r["c"] for r in rows] == ["1", "2", "2"]
+    assert rows[1]["_time"].startswith("2025-07-28")
+    assert rows[2]["_time"].startswith("2025-12-29")
+    rows = q(store, "* | stats by (_time:month) count() c "
+                    "| sort by (_time)")
+    assert [(r["_time"][:7], r["c"]) for r in rows] == [
+        ("2025-07", "2"), ("2025-08", "1"), ("2025-12", "1"),
+        ("2026-01", "1")]
+    rows = q(store, "* | stats by (_time:year) count() c | sort by (_time)")
+    assert [(r["_time"][:4], r["c"]) for r in rows] == [("2025", "4"),
+                                                        ("2026", "1")]

@@ -534,6 +534,36 @@ class PipeUniq(Pipe):
 
 # ---------------- stats ----------------
 
+_NS_DAY = 86400 * 1_000_000_000
+
+
+def truncate_timestamp(ts: int, b: "ByField") -> int | None:
+    """Reference truncateTimestamp (block_result.go:818): fixed-size
+    buckets plus calendar week (Monday-start), month and year."""
+    name = b.bucket.lower()
+    off = b.offset_ns()
+    if name == "week":
+        # adjust so weeks start on Monday (epoch day 0 was a Thursday)
+        off += 4 * _NS_DAY
+        step = 7 * _NS_DAY
+        return ((ts - off) // step) * step + off
+    if name in ("month", "year"):
+        import datetime
+        t = ts - off
+        dt = datetime.datetime.fromtimestamp(t / 1e9,
+                                             tz=datetime.timezone.utc)
+        if name == "month":
+            start = datetime.datetime(dt.year, dt.month, 1,
+                                      tzinfo=datetime.timezone.utc)
+        else:
+            start = datetime.datetime(dt.year, 1, 1,
+                                      tzinfo=datetime.timezone.utc)
+        return int(start.timestamp()) * 1_000_000_000 + off
+    step = parse_duration(b.bucket)
+    if not step:
+        return None
+    return ((ts - off) // step) * step + off
+
 @dataclass(repr=False)
 class ByField:
     name: str
@@ -583,11 +613,11 @@ class PipeStats(Pipe):
         if not b.bucket:
             return v
         if b.name == "_time":
-            step = parse_duration(b.bucket)
-            if step and ts is not None:
-                from ..engine.block_result import format_rfc3339
-                off = b.offset_ns()
-                return format_rfc3339(((ts - off) // step) * step + off)
+            if ts is not None:
+                t = truncate_timestamp(ts, b)
+                if t is not None:
+                    from ..engine.block_result import format_rfc3339
+                    return format_rfc3339(t)
             return v
         step = parse_number(b.bucket)
         if not math.isnan(step) and step > 0:
@@ -619,7 +649,9 @@ class PipeStats(Pipe):
                 ts = br.timestamps
                 key_cols = []
                 for b in pipe.by:
-                    if b.bucket and b.name == "_time" and ts is not None:
+                    if b.bucket and b.name == "_time" and ts is not None \
+                            and b.bucket.lower() not in ("week", "month",
+                                                         "year"):
                         step = parse_duration(b.bucket)
                         if step:
                             arr = np.asarray(ts, dtype=np.int64)
