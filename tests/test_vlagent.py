@@ -186,3 +186,24 @@ def test_agent_forwards_and_resumes(tmp_path):
                 p.wait(10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_queue_truncates_torn_tail(tmp_path):
+    import struct
+    q = PersistentQueue(str(tmp_path / "torn"))
+    q.append(b"good-one")
+    q.close()
+    # simulate a crash mid-append: length prefix says 5000, payload torn
+    seg = [n for n in os.listdir(tmp_path / "torn")
+           if n.startswith("seg_")][0]
+    with open(tmp_path / "torn" / seg, "ab") as f:
+        f.write(struct.pack(">I", 5000) + b"only 100 bytes" * 7)
+    q2 = PersistentQueue(str(tmp_path / "torn"))
+    q2.append(b"after-crash")
+    assert q2.read() == b"good-one"
+    q2.ack(8)
+    # the torn record is gone; framing stays intact
+    assert q2.read() == b"after-crash"
+    q2.ack(11)
+    assert q2.read(timeout=0.05) is None
+    q2.close()

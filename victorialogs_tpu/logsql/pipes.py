@@ -694,7 +694,9 @@ class PipeStats(Pipe):
                         states[k] += n
                     return True
                 key_cols = self._key_columns(br)
-                # factorize each key column
+                # factorize each key column; bail to the generic path when
+                # the dense code space would blow up (multiple
+                # high-cardinality by-fields)
                 codes = np.zeros(n, dtype=np.int64)
                 uniques_per_col = []
                 stride = 1
@@ -706,10 +708,12 @@ class PipeStats(Pipe):
                         if c is None:
                             c = mapping[v] = len(mapping)
                         col_codes[i] = c
+                    stride *= max(len(mapping), 1)
+                    if stride > max(4 * n, 1 << 16):
+                        return False
                     codes = codes * len(mapping) + col_codes
                     uniques_per_col.append(
                         {c: v for v, c in mapping.items()})
-                    stride *= len(mapping)
                 counts = np.bincount(codes, minlength=0)
                 for code in np.nonzero(counts)[0]:
                     cnt = int(counts[code])

@@ -211,14 +211,17 @@ class PipeStreamContext(_StorageBackedPipe):
                     keep_idx: set[int] = set()
                     row_ts = [parse_rfc3339(r.get("_time", "")) or 0
                               for r in rows]
+                    import bisect
                     for t in times:
-                        # locate the matched row and take the surrounding
-                        # window (reference pipe_stream_context.go)
-                        for i, rt in enumerate(row_ts):
-                            if rt == t:
-                                a = max(0, i - pipe.before)
-                                b = min(len(rows), i + pipe.after + 1)
-                                keep_idx.update(range(a, b))
+                        # locate matched rows by bisect (row_ts is sorted)
+                        # and take the surrounding window
+                        # (reference pipe_stream_context.go)
+                        i = bisect.bisect_left(row_ts, t)
+                        while i < len(row_ts) and row_ts[i] == t:
+                            a = max(0, i - pipe.before)
+                            b = min(len(rows), i + pipe.after + 1)
+                            keep_idx.update(range(a, b))
+                            i += 1
                     keep = sorted(keep_idx)
                     if not keep:
                         continue

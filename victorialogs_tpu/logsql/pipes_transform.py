@@ -927,7 +927,7 @@ class PipeUnpackSyslog(_UnpackBase):
 
     def _unpack_value(self, v):
         from ..server.syslog import parse_syslog_message
-        fields = parse_syslog_message(v)
+        fields = parse_syslog_message(v, tz_offset_ns=self.offset_ns)
         return [(k, val) for k, val in fields if k != "_msg"] + \
             [(k, val) for k, val in fields if k == "_msg" and val != v]
 
@@ -1066,25 +1066,39 @@ class PipeTop(Pipe):
                 self.budget = MemoryBudget(0.4, "top")
 
             def write_block(self, br):
-                fields = pipe.by or br.column_names()
-                cols = [br.column(f) for f in fields]
-                self._fields = fields
-                for i in range(br.nrows):
-                    key = tuple(c[i] for c in cols)
+                if pipe.by:
+                    cols = [br.column(f) for f in pipe.by]
+                    keys = (tuple(c[i] for c in cols)
+                            for i in range(br.nrows))
+                else:
+                    # keys carry (field, value) pairs so blocks with
+                    # different column sets mix safely
+                    names = br.column_names()
+                    cols = [(f, br.column(f)) for f in names]
+                    keys = (tuple((f, c[i]) for f, c in cols if c[i] != "")
+                            for i in range(br.nrows))
+                for key in keys:
                     if key not in self.counts:
                         self.counts[key] = 1
-                        self.budget.add(sum(len(k) for k in key) + 80)
+                        self.budget.add(sum(len(str(k)) for k in key) + 80)
                     else:
                         self.counts[key] += 1
 
             def flush(self):
-                fields = getattr(self, "_fields", pipe.by)
                 # hits desc, then key asc (reference pipe_top ordering)
                 items = sorted(self.counts.items(),
                                key=lambda kv: (-kv[1], kv[0]))
                 items = items[:pipe.limit]
-                cols = {f: [k[j] for k, _ in items]
-                        for j, f in enumerate(fields)}
+                if pipe.by:
+                    cols = {f: [k[j] for k, _ in items]
+                            for j, f in enumerate(pipe.by)}
+                else:
+                    names: dict[str, None] = {}
+                    for k, _h in items:
+                        for f, _v in k:
+                            names.setdefault(f, None)
+                    cols = {f: [dict(k).get(f, "") for k, _ in items]
+                            for f in names}
                 cols[pipe.hits_field] = [str(h) for _, h in items]
                 if pipe.rank_field:
                     cols[pipe.rank_field] = [str(i + 1)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import gzip
 import io
 import json
+import os
 import threading
 import time
 import urllib.parse
@@ -346,18 +347,33 @@ class VLServer(BaseHTTPApp):
                          ("\n".join(out) + "\n").encode())
             return
         if path == "/debug/pprof/profile":
-            import cProfile
-            import pstats
-            import io as _io
+            # statistical sampler over every thread's stack (cProfile only
+            # instruments its own thread, which here would just sleep)
+            import sys
+            import traceback
             seconds = min(float(args.get("seconds", "5")), 30.0)
-            prof = cProfile.Profile()
-            prof.enable()
-            time.sleep(seconds)
-            prof.disable()
-            buf = _io.StringIO()
-            pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
-                .print_stats(60)
-            self.respond(h, 200, "text/plain", buf.getvalue().encode())
+            me = threading.get_ident()
+            samples: dict[str, int] = {}
+            n_samples = 0
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = traceback.extract_stack(frame)[-6:]
+                    key = " <- ".join(
+                        f"{f.name}({os.path.basename(f.filename)}:"
+                        f"{f.lineno})" for f in reversed(stack))
+                    samples[key] = samples.get(key, 0) + 1
+                n_samples += 1
+                time.sleep(0.01)
+            out = [f"# {n_samples} samples over {seconds}s "
+                   f"(count stack)"]
+            for key, cnt in sorted(samples.items(),
+                                   key=lambda kv: -kv[1])[:60]:
+                out.append(f"{cnt}\t{key}")
+            self.respond(h, 200, "text/plain",
+                         ("\n".join(out) + "\n").encode())
             return
 
         # ---- storage maintenance ----

@@ -181,7 +181,8 @@ def handle_internal_select(storage, args, runner=None):
     mode = args.get("mode", "rows")
     split_at = int(args.get("split_at") or 0)
     limit = int(args.get("limit") or 0)
-    tenants = [TenantID.parse(args.get("tenant", "0:0"))]
+    tenants = [TenantID.parse(t)
+               for t in (args.get("tenant", "0:0")).split(",") if t]
     q = parse_query(qs, timestamp=ts)
     all_pipes = q.pipes
     q.pipes = all_pipes[:split_at]
@@ -193,15 +194,33 @@ def handle_internal_select(storage, args, runner=None):
         # pushed-down limit: each node returns at most N rows
         q.pipes.append(PipeLimit(limit))
 
-    frames: list[bytes] = []
+    # stream frames as blocks arrive: a worker runs the query and a
+    # bounded queue hands frames to the HTTP response (storage-node memory
+    # stays bounded; time-to-first-byte is first-block time)
+    import queue as _queue
+    frames: _queue.Queue = _queue.Queue(maxsize=64)
+    DONE = object()
 
     def sink(br):
         cols = {n: br.column(n) for n in br.column_names()}
-        ts_list = br.timestamps
-        frames.append(write_frame({"cols": cols, "ts": ts_list}))
+        frames.put(write_frame({"cols": cols, "ts": br.timestamps}))
 
-    run_query(storage, tenants, q, write_block=sink, runner=runner)
-    yield from frames
+    def work():
+        try:
+            run_query(storage, tenants, q, write_block=sink, runner=runner)
+            frames.put(DONE)
+        except Exception as e:  # propagate to the response loop
+            frames.put(e)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    while True:
+        item = frames.get()
+        if item is DONE:
+            break
+        if isinstance(item, Exception):
+            raise item
+        yield item
     yield END_FRAME
 
 
@@ -350,7 +369,9 @@ class NetSelectStorage:
         lock = threading.Lock()
         stop = threading.Event()
         errors: list = []
-        tenant = tenants[0] if tenants else TenantID(0, 0)
+        tenants = list(tenants) or [TenantID(0, 0)]
+        tenant_arg = ",".join(f"{t.account_id}:{t.project_id}"
+                              for t in tenants)
 
         def fetch(url: str):
             from urllib.parse import urlencode
@@ -363,7 +384,7 @@ class NetSelectStorage:
                 "mode": mode,
                 "split_at": str(split_at),
                 "limit": str(push_limit),
-                "tenant": f"{tenant.account_id}:{tenant.project_id}",
+                "tenant": tenant_arg,
             }).encode("utf-8")
             req = urllib.request.Request(
                 f"{url}/internal/select/query", data=body, method="POST")

@@ -45,6 +45,9 @@ class PersistentQueue:
         self._write_seg = segs[-1] if segs else self._read_seg
         if self._write_seg < self._read_seg:
             self._write_seg = self._read_seg
+        # crash recovery: truncate a torn record at the tail of the write
+        # segment, or appended records would be permanently misframed
+        self._truncate_torn_tail(self._seg_path(self._write_seg))
         self._writer = open(self._seg_path(self._write_seg), "ab")
         # drop fully-consumed older segments
         for s in segs:
@@ -53,6 +56,24 @@ class PersistentQueue:
                     os.unlink(self._seg_path(s))
                 except OSError:
                     pass
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        good = 0
+        with open(path, "rb") as f:
+            while good + 4 <= size:
+                f.seek(good)
+                n = struct.unpack(">I", f.read(4))[0]
+                if good + 4 + n > size:
+                    break  # torn payload
+                good += 4 + n
+        if good != size:
+            with open(path, "r+b") as f:
+                f.truncate(good)
 
     def _seg_path(self, n: int) -> str:
         return os.path.join(self.path, f"seg_{n:08d}.bin")
