@@ -215,9 +215,11 @@ def main():
         }
 
     # pallas scan micro-bench in a crash-safe subprocess (the kernel is
-    # hardware-unproven: the axon tunnel was down for all of round 2)
+    # hardware-unproven: the axon tunnel was down for all of round 2);
+    # CPU backends only run pallas in interpret mode — far too slow to
+    # time, so only attempt it on real hardware
     pallas_info = None
-    if tpu_ok:
+    if tpu_ok and backend == "tpu":
         try:
             res = subprocess.run(
                 [sys.executable, os.path.join(

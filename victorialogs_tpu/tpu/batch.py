@@ -345,7 +345,15 @@ class BatchRunner:
             if not survivors:
                 return out
 
-        spc = self.stage_part(part, plan.field)
+        # when the candidate blocks are a small fraction of the part (e.g.
+        # a narrow stream filter) and the part isn't staged yet, the host
+        # path over just those blocks beats staging + scanning everything
+        cand_rows = sum(bss[bi].nrows for bi in survivors)
+        already_staged = (part.uid, plan.field) in self.cache._lru
+        if not already_staged and cand_rows * 8 < part.num_rows:
+            spc = None
+        else:
+            spc = self.stage_part(part, plan.field)
         if spc is None:
             dev_bis = []
             host_bis = survivors
