@@ -369,3 +369,11 @@ def test_math_optional_result_name(store):
     _ingest(store, [{"a": "6", "b": "2"}])
     rows = q(store, "* | math a / b")
     assert any(v == "3" for v in rows[0].values())
+
+
+def test_format_hexnum_options(store):
+    _ingest(store, [{"n": "123456789", "h": "75BCD15", "s": "AB",
+                     "hx": "41"}])
+    rows = q(store, '* | format "<hexnumencode:n>|<hexnumdecode:h>|'
+                    '<hexencode:s>|<hexdecode:hx>" as out | fields out')
+    assert rows == [{"out": "00000000075BCD15|123456789|4142|A"}]

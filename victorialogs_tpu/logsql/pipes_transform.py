@@ -394,12 +394,27 @@ def _format_value(v: str, opt: str) -> str:
     if opt == "lc":
         return v.lower()
     if opt == "hexencode":
-        return v.encode("utf-8").hex()
+        return v.encode("utf-8").hex().upper()
     if opt == "hexdecode":
         try:
             return bytes.fromhex(v).decode("utf-8", "replace")
         except ValueError:
             return v
+    if opt == "hexnumencode":
+        try:
+            n = int(v)
+            if not 0 <= n < 2**64:
+                return v
+        except ValueError:
+            return v
+        return f"{n:016X}"
+    if opt == "hexnumdecode":
+        if 0 < len(v) <= 16:
+            try:
+                return str(int(v, 16))
+            except ValueError:
+                return v
+        return v
     if opt == "base64encode":
         return base64.b64encode(v.encode("utf-8")).decode()
     if opt == "base64decode":
