@@ -377,3 +377,22 @@ def test_format_hexnum_options(store):
     rows = q(store, '* | format "<hexnumencode:n>|<hexnumdecode:h>|'
                     '<hexencode:s>|<hexdecode:hx>" as out | fields out')
     assert rows == [{"out": "00000000075BCD15|123456789|4142|A"}]
+
+
+def test_logfmt_reference_table():
+    # ported from logfmt_parser_test.go
+    cases = [
+        ("", []),
+        ("foo=bar", [("foo", "bar")]),
+        ('foo="bar=baz x=y"', [("foo", "bar=baz x=y")]),
+        ("foo=", [("foo", "")]),
+        ("foo", [("foo", "")]),
+        ("foo bar", [("foo", ""), ("bar", "")]),
+        ("foo bar=baz", [("foo", ""), ("bar", "baz")]),
+        ('foo=bar baz="x y" a=b',
+         [("foo", "bar"), ("baz", "x y"), ("a", "b")]),
+        ("  foo=bar  baz=x =z qwe",
+         [("foo", "bar"), ("baz", "x"), ("_msg", "z"), ("qwe", "")]),
+    ]
+    for inp, want in cases:
+        assert parse_logfmt(inp) == want, inp

@@ -806,7 +806,10 @@ def _flatten_json(obj, prefix="") -> list:
 
 
 def parse_logfmt(s: str) -> list:
-    """k=v pairs with Go-quoted values (reference logfmt_parser.go)."""
+    """k=v pairs with Go-quoted values (reference logfmt_parser.go).
+
+    Reference edge semantics (logfmt_parser_test.go): a bare word becomes
+    a key with an empty value; a bare `=value` goes to `_msg`."""
     out = []
     i, n = 0, len(s)
     while i < n:
@@ -814,11 +817,17 @@ def parse_logfmt(s: str) -> list:
             i += 1
         if i >= n:
             break
-        eq = s.find("=", i)
-        if eq < 0:
-            break
-        key = s[i:eq].strip()
-        i = eq + 1
+        j = i
+        while j < n and s[j] not in " =":
+            j += 1
+        key = s[i:j]
+        if j >= n or s[j] == " ":
+            out.append((key, ""))       # bare word: empty value
+            i = j
+            continue
+        if not key:
+            key = "_msg"                # `=value` with no key
+        i = j + 1
         if i < n and s[i] in "\"`":
             v, off = _try_unquote_prefix(s[i:])
             if off >= 0:
