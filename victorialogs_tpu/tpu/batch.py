@@ -186,6 +186,27 @@ class StagedPart:
 _UNSTAGEABLE = object()  # cache marker: part+field can't be staged
 
 
+def _row_accessor(bs: BlockSearch, field: str):
+    """Per-row string access without materializing the whole column.
+
+    Host verification touches only surviving rows; decoding the full
+    block's value list (bs.values) wasted most of the device path's win
+    on verify-heavy regex queries."""
+    if field not in ("_time", "_stream", "_stream_id") and \
+            field not in bs.consts():
+        col = bs.column(field)
+        if col is not None and col.vtype == VT_STRING:
+            arena, offs, lens = col.arena, col.offsets, col.lengths
+
+            def at(i: int) -> str:
+                o = int(offs[i])
+                return arena[o:o + int(lens[i])].tobytes().decode(
+                    "utf-8", "replace")
+            return at
+    vals = bs.values(field)
+    return vals.__getitem__
+
+
 def stage_part_column(part, field: str,
                       max_bytes: int = 4 << 30) -> StagedPart | None:
     """Stage every string-typed block of `field` in one (Rb, W) matrix.
@@ -379,21 +400,21 @@ class BatchRunner:
             bm = combined[start:start + n].copy() if combined is not None \
                 else np.ones(n, dtype=bool)
             ov = spc.overflow.get(bi)
-            vals = None
+            value_at = None
             if ov is not None and ov.size:
                 # truncated rows: ask the filter's full predicate
-                vals = bss[bi].values(plan.field)
+                value_at = _row_accessor(bss[bi], plan.field)
                 for i in ov:
-                    bm[i] = plan.filter._pred(vals[i])
+                    bm[i] = plan.filter._pred(value_at(i))
             if need_verify and bm.any():
                 check = np.nonzero(
                     bm & verify_mask[start:start + n]
                     if verify_mask is not None else bm)[0]
                 if check.size:
-                    if vals is None:
-                        vals = bss[bi].values(plan.field)
+                    if value_at is None:
+                        value_at = _row_accessor(bss[bi], plan.field)
                     for i in check:
-                        if not plan.filter._pred(vals[i]):
+                        if not plan.filter._pred(value_at(i)):
                             bm[i] = False
             out[bi] = bm
         return out
