@@ -72,3 +72,46 @@ def test_syslog_tcp_udp_listeners():
         assert {"h1", "h2"} <= hosts
     finally:
         srv.close()
+
+
+def test_syslog_tls_listener(tmp_path):
+    import socket
+    import ssl
+    import subprocess
+    import time as _time
+
+    from victorialogs_tpu.server.syslog import SyslogServer
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True, timeout=60)
+
+    got = []
+
+    class Sink:
+        def must_add_rows(self, lr):
+            got.extend(lr.rows)
+
+    srv = SyslogServer(Sink(), tcp_port=0, udp_port=-1,
+                       tls_cert_file=str(cert), tls_key_file=str(key))
+    try:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with socket.create_connection(("127.0.0.1", srv.tcp_port),
+                                      10) as raw:
+            with ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+                tls.sendall(b"<165>1 2024-06-01T12:00:00Z host app 1 - - "
+                            b"tls hello\n")
+        for _ in range(100):
+            srv.flush()
+            if got:
+                break
+            _time.sleep(0.05)
+        assert any(("_msg", "tls hello") in row for row in got), got
+    finally:
+        srv.close()

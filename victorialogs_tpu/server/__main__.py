@@ -49,6 +49,10 @@ def main(argv=None) -> int:
                        "retention.maxDiskSpaceUsageBytes", 0)))
     p.add_argument("-syslog.listenAddr.tcp", dest="syslog_tcp", default="")
     p.add_argument("-syslog.listenAddr.udp", dest="syslog_udp", default="")
+    p.add_argument("-syslog.tls.certFile", dest="syslog_tls_cert",
+                   default="")
+    p.add_argument("-syslog.tls.keyFile", dest="syslog_tls_key",
+                   default="")
     p.add_argument("-search.maxConcurrentRequests", type=int,
                    dest="max_concurrent", default=8)
     p.add_argument("-tpu", action="store_true",
@@ -99,7 +103,9 @@ def main(argv=None) -> int:
         syslog_server = SyslogServer(
             server.sink,
             tcp_port=addr_port(args.syslog_tcp),
-            udp_port=addr_port(args.syslog_udp))
+            udp_port=addr_port(args.syslog_udp),
+            tls_cert_file=args.syslog_tls_cert,
+            tls_key_file=args.syslog_tls_key)
         print(f"syslog listeners: tcp={syslog_server.tcp_port} "
               f"udp={syslog_server.udp_port}", flush=True)
 

@@ -167,7 +167,8 @@ class SyslogServer:
     """TCP + UDP syslog listeners feeding a LogMessageProcessor."""
 
     def __init__(self, sink, tenant=None, listen_addr: str = "127.0.0.1",
-                 tcp_port: int = 0, udp_port: int = 0):
+                 tcp_port: int = 0, udp_port: int = 0,
+                 tls_cert_file: str = "", tls_key_file: str = ""):
         from ..storage.log_rows import TenantID
         cp = CommonParams(tenant=tenant or TenantID(),
                           stream_fields=["hostname", "app_name"])
@@ -176,6 +177,14 @@ class SyslogServer:
         self._tcp = self._udp = None
         outer = self
 
+        ssl_ctx = None
+        if tls_cert_file and tls_key_file:
+            # TLS syslog (reference -syslog.tls* flags —
+            # app/vlinsert/syslog/syslog.go:94-160)
+            import ssl
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(tls_cert_file, tls_key_file)
+
         if tcp_port >= 0:
             class Handler(socketserver.StreamRequestHandler):
                 def handle(self):
@@ -183,8 +192,15 @@ class SyslogServer:
                         line = raw.decode("utf-8", "replace").rstrip("\r\n")
                         if line:
                             outer.ingest_line(line)
-            self._tcp = socketserver.ThreadingTCPServer(
-                (listen_addr, tcp_port), Handler, bind_and_activate=True)
+
+            class TCPServer(socketserver.ThreadingTCPServer):
+                def get_request(self):
+                    sock, addr = super().get_request()
+                    if ssl_ctx is not None:
+                        sock = ssl_ctx.wrap_socket(sock, server_side=True)
+                    return sock, addr
+            self._tcp = TCPServer((listen_addr, tcp_port), Handler,
+                                  bind_and_activate=True)
             self._tcp.daemon_threads = True
             self.tcp_port = self._tcp.server_address[1]
             threading.Thread(target=self._tcp.serve_forever,
