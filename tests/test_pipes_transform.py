@@ -396,3 +396,19 @@ def test_logfmt_reference_table():
     ]
     for inp, want in cases:
         assert parse_logfmt(inp) == want, inp
+
+
+def test_wildcard_field_selections(store):
+    _ingest(store, [{"req_path": "/x", "req_method": "GET",
+                     "resp_code": "200"}])
+    rows = q(store, "* | fields req_*")
+    assert rows == [{"req_path": "/x", "req_method": "GET"}]
+    rows = q(store, "* | fields req_*, resp_code")
+    assert rows == [{"req_path": "/x", "req_method": "GET",
+                     "resp_code": "200"}]
+    rows = q(store, '* | unpack_json from j fields (a*)',)
+    # wildcard unpack: only a-prefixed keys surface
+    _ingest(store, [{"j": '{"aa":"1","ab":"2","zz":"3"}'}])
+    rows = q(store, '_msg:"" j:* | unpack_json from j fields (a*) '
+                    '| fields aa, ab, zz')
+    assert rows and rows[-1] == {"aa": "1", "ab": "2"}

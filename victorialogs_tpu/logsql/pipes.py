@@ -96,6 +96,21 @@ class Pipe:
 
 # ---------------- fields / delete / copy / rename ----------------
 
+def expand_field_patterns(patterns: list, names: list) -> list:
+    """Expand trailing-`*` wildcards against available column names
+    (reference lib/prefixfilter wildcard selections)."""
+    out: dict[str, None] = {}
+    for p in patterns:
+        if p.endswith("*"):
+            prefix = p[:-1]
+            for n in names:
+                if n.startswith(prefix):
+                    out.setdefault(n, None)
+        else:
+            out.setdefault(p, None)
+    return list(out)
+
+
 @dataclass(repr=False)
 class PipeFields(Pipe):
     fields: list
@@ -113,14 +128,19 @@ class PipeFields(Pipe):
         return set(self.fields)
 
     def input_fields(self, out_needed):
+        if any(f.endswith("*") for f in self.fields):
+            return {"*"}
         return set(self.fields)
 
     def make_processor(self, next_p):
         fields = self.fields
+        has_wildcard = any(f.endswith("*") for f in fields)
 
         class P(Processor):
             def write_block(self, br):
-                self.next_p.write_block(br.materialize(fields))
+                use = expand_field_patterns(fields, br.column_names()) \
+                    if has_wildcard else fields
+                self.next_p.write_block(br.materialize(use))
         return P(next_p)
 
     def split_to_remote_and_local(self):
@@ -819,7 +839,11 @@ def _parse_field_name(lex: Lexer) -> str:
 def _parse_field_list(lex: Lexer) -> list:
     fields = []
     while True:
-        fields.append(_parse_field_name(lex))
+        name = _parse_field_name(lex)
+        if lex.is_keyword("*") and not lex.is_skipped_space:
+            name += "*"          # wildcard selection: `fields req_*`
+            lex.next_token()
+        fields.append(name)
         if lex.is_keyword(","):
             lex.next_token()
             continue

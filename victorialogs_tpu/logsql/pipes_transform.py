@@ -885,6 +885,8 @@ class _UnpackBase(Pipe):
     def make_processor(self, next_p):
         pipe = self
         allow = set(pipe.fields) or None
+        allow_prefixes = tuple(f[:-1] for f in pipe.fields
+                               if f.endswith("*")) if allow else ()
 
         class P(Processor):
             def write_block(self, br):
@@ -899,7 +901,9 @@ class _UnpackBase(Pipe):
                     if v != prev_v:
                         prev_v, prev = v, pipe._unpack_value(v)
                     for k, val in prev:
-                        if allow is not None and k not in allow:
+                        if allow is not None and k not in allow and \
+                                not (allow_prefixes and
+                                     k.startswith(allow_prefixes)):
                             continue
                         key = pipe.result_prefix + k
                         col = out_cols.get(key)
@@ -1609,7 +1613,11 @@ def _parse_paren_fields(lex: Lexer) -> list:
         if lex.is_keyword(","):
             lex.next_token()
             continue
-        out.append(_parse_field_name(lex))
+        name = _parse_field_name(lex)
+        if lex.is_keyword("*") and not lex.is_skipped_space:
+            name += "*"          # wildcard: `fields (req_*)`
+            lex.next_token()
+        out.append(name)
     lex.next_token()
     return out
 
