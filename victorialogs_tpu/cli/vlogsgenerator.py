@@ -42,6 +42,14 @@ def gen_row(args, stream_id: int, seq: int, ts_ns: int) -> dict:
         row[f"dict_{i}"] = rnd.choice(("red", "green", "blue", "yellow"))
     for i in range(args.u8FieldsPerLog):
         row[f"u8_{i}"] = rnd.randrange(256)
+    for i in range(args.u16FieldsPerLog):
+        row[f"u16_{i}"] = rnd.randrange(1 << 16)
+    for i in range(args.u32FieldsPerLog):
+        row[f"u32_{i}"] = rnd.randrange(1 << 32)
+    for i in range(args.u64FieldsPerLog):
+        row[f"u64_{i}"] = rnd.randrange(1 << 64)
+    for i in range(args.i64FieldsPerLog):
+        row[f"i64_{i}"] = rnd.randrange(-(1 << 63), 1 << 63)
     for i in range(args.floatFieldsPerLog):
         row[f"float_{i}"] = round(rnd.random() * 100, 3)
     for i in range(args.ipFieldsPerLog):
@@ -69,6 +77,10 @@ def main(argv=None) -> int:
     p.add_argument("-varFieldsPerLog", type=int, default=1)
     p.add_argument("-dictFieldsPerLog", type=int, default=1)
     p.add_argument("-u8FieldsPerLog", type=int, default=1)
+    p.add_argument("-u16FieldsPerLog", type=int, default=0)
+    p.add_argument("-u32FieldsPerLog", type=int, default=0)
+    p.add_argument("-u64FieldsPerLog", type=int, default=0)
+    p.add_argument("-i64FieldsPerLog", type=int, default=0)
     p.add_argument("-floatFieldsPerLog", type=int, default=1)
     p.add_argument("-ipFieldsPerLog", type=int, default=1)
     p.add_argument("-timestampFieldsPerLog", type=int, default=0)

@@ -127,8 +127,11 @@ class Pattern:
             if ":" in name:
                 opt, name = name.split(":", 1)
                 opt = opt.strip()
+            name = name.strip()
+            if name == "_":
+                name = ""        # <_> is an anonymous skip like <>
             steps.append(PatternStep(_html_unescape("".join(prefix)),
-                                     name.strip(), opt))
+                                     name, opt))
             prefix = []
             i = j + 1
         if prefix:
