@@ -138,18 +138,53 @@ def handle_query(storage, args, headers, runner=None):
         q.pipes.append(PipeLimit(limit))
 
     def gen():
-        chunks = []
+        # stream results as blocks arrive (bounded queue: memory stays
+        # bounded and time-to-first-byte is first-block time); a client
+        # disconnect sets `stop`, which aborts the worker's query
+        import queue as _queue
+        import threading
+        chunks: _queue.Queue = _queue.Queue(maxsize=64)
+        stop = threading.Event()
+        DONE = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    chunks.put(item, timeout=0.5)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def sink(br):
             out = []
             for row in br.rows():
                 out.append(json.dumps(row, ensure_ascii=False,
                                       separators=(",", ":")))
-            if out:
-                chunks.append("\n".join(out) + "\n")
-        run_query(storage, tenants, q, write_block=sink, runner=runner,
-                  deadline=query_deadline(args))
-        yield from chunks
+            if out and not put("\n".join(out) + "\n"):
+                raise ConnectionAbortedError("client went away")
+
+        def work():
+            try:
+                run_query(storage, tenants, q, write_block=sink,
+                          runner=runner, deadline=query_deadline(args))
+                put(DONE)
+            except ConnectionAbortedError:
+                pass
+            except Exception as e:
+                put(e)
+
+        threading.Thread(target=work, daemon=True).start()
+        try:
+            while True:
+                item = chunks.get()
+                if item is DONE:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
     return gen()
 
 
