@@ -363,3 +363,51 @@ def test_force_merge(server):
     assert status == 200
     rows = _query(server, "fm | stats count() n")
     assert rows == [{"n": "3"}]
+
+
+def test_live_tail_http(server):
+    """Live tail: rows ingested after the tail starts must stream out
+    (reference logsql.go:497-580 poll loop)."""
+    import threading
+    import urllib.parse
+    import urllib.request
+
+    srv = server
+    port = srv.port
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/select/logsql/tail?"
+                 + urllib.parse.urlencode({"query": "tailtoken"}))
+    resp = conn.getresponse()
+    assert resp.status == 200
+
+    got = []
+    done = threading.Event()
+
+    def reader():
+        buf = b""
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            chunk = resp.read1(65536)
+            if chunk:
+                buf += chunk
+                if b"tailtoken" in buf:
+                    got.append(buf)
+                    break
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    # ingest AFTER the tail started; rows are timestamped 'now' so the
+    # lagged poll window picks them up within a few seconds
+    time.sleep(0.3)
+    now = time.time_ns()
+    body = "\n".join(json.dumps(
+        {"_time": now + i, "_msg": f"tailtoken row {i}", "app": "t"})
+        for i in range(5)).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/insert/jsonline?_stream_fields=app",
+        data=body)
+    urllib.request.urlopen(req, timeout=30)
+    assert done.wait(30), "tail never delivered the ingested rows"
+    assert got and b"tailtoken" in got[0]
+    conn.close()
