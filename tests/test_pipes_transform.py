@@ -412,3 +412,26 @@ def test_wildcard_field_selections(store):
     rows = q(store, '_msg:"" j:* | unpack_json from j fields (a*) '
                     '| fields aa, ab, zz')
     assert rows and rows[-1] == {"aa": "1", "ab": "2"}
+
+
+def test_extract_reference_value_cases(store):
+    # ported from pipe_extract_test.go (quoted-value unquoting + option
+    # interactions); the skip_empty case's message has NO `a=...`, so the
+    # empty <aa> extraction keeps the original value
+    _ingest(store, [{"_msg": 'foo=bar baz="x y=z" ',
+                     "aa": "foobar", "abc": "ippl"}])
+    rows = q(store, '* | extract "baz=<abc> a=<aa>" skip_empty_results '
+                    '| fields aa, abc')
+    assert rows == [{"aa": "foobar", "abc": "x y=z"}]
+    rows = q(store, '* | extract "baz=<abc> a=<aa>" | fields aa, abc')
+    assert rows == [{"abc": "x y=z"}]  # aa extracted empty (omitted)
+
+
+def test_extract_reference_quoted_value(store):
+    _ingest(store, [{"_msg": 'foo=bar baz="x y=z" a=b',
+                     "aa": "foobar", "abc": ""}])
+    rows = q(store, '* | extract "baz=<abc> a=<aa>" | fields abc, aa')
+    assert rows == [{"abc": "x y=z", "aa": "b"}]
+    rows = q(store, '* | extract "baz=<abc> a=<aa>" keep_original_fields '
+                    '| fields abc, aa')
+    assert rows == [{"abc": "x y=z", "aa": "foobar"}]

@@ -158,7 +158,9 @@ class Pattern:
                         out[st.field] = us
                     s = s[off:]
                     if not s.startswith(nxt):
-                        return {f: "" for f in self.fields}
+                        # mid-pattern mismatch keeps fields extracted so
+                        # far (reference pattern.apply — pattern.go:125)
+                        return out
                     s = s[len(nxt):]
                     continue
             if not nxt:
@@ -167,7 +169,7 @@ class Pattern:
                 return out
             pos = s.find(nxt)
             if pos < 0:
-                return {f: "" for f in self.fields}
+                return out
             if st.field:
                 out[st.field] = s[:pos]
             s = s[pos + len(nxt):]
