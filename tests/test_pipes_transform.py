@@ -435,3 +435,26 @@ def test_extract_reference_quoted_value(store):
     rows = q(store, '* | extract "baz=<abc> a=<aa>" keep_original_fields '
                     '| fields abc, aa')
     assert rows == [{"abc": "x y=z", "aa": "foobar"}]
+
+
+def test_format_time_duration_reference_case(store):
+    # ported from pipe_format_test.go
+    _ingest(store, [{"foo": "1717328141123456789", "bar": "210123456789",
+                     "baz": "1234567890", "d": "1h5m35s"}])
+    rows = q(store, "* | format 'time=<time:foo>, "
+                    "duration=<duration:bar>, "
+                    "duration_secs=<duration_seconds:d> ip=<ipv4:baz>' "
+                    "as x | fields x")
+    assert rows == [{"x": "time=2024-06-02T11:35:41.123456789Z, "
+                          "duration=3m30.123456789s, duration_secs=3935 "
+                          "ip=73.150.2.210"}]
+
+
+def test_format_time_decimal_unix(store):
+    _ingest(store, [{"foo": "1717328141.123456789",
+                     "bar": "1717328141.123456", "neg": "-1717328141"}])
+    rows = q(store, "* | format 'a=<time:foo>, b=<time:bar>, "
+                    "c=<time:neg>' as x | fields x")
+    assert rows == [{"x": "a=2024-06-02T11:35:41.123456789Z, "
+                          "b=2024-06-02T11:35:41.123456Z, "
+                          "c=1915-08-01T12:24:19Z"}]

@@ -17,9 +17,16 @@ NS = 1_000_000_000
 
 
 def format_rfc3339(ts_ns: int) -> str:
-    """Render int64 nanos as RFC3339 with nanosecond precision (UTC)."""
+    """Render int64 nanos as RFC3339Nano (UTC): trailing fraction zeros
+    trimmed, whole seconds carry no fraction (reference
+    marshalTimestampRFC3339NanoString)."""
     from ..storage.values_encoder import format_iso8601
-    return format_iso8601(ts_ns, 9)
+    s = format_iso8601(ts_ns, 9)
+    if "." in s:
+        head, _, frac = s[:-1].partition(".")
+        frac = frac.rstrip("0")
+        s = (head + "." + frac if frac else head) + "Z"
+    return s
 
 
 _RFC3339_CACHE: dict[str, int | None] = {}

@@ -336,6 +336,14 @@ def _cmp_values(a: str, b: str) -> int:
         return -1
     if nb:
         return 1
+    if a and b and a[0].isdigit() and b[0].isdigit():
+        # RFC3339Nano trims fractions, so "..00Z" vs "..00.5Z" mis-sorts
+        # lexicographically ('.' < 'Z'); compare as timestamps when both
+        # parse
+        from ..engine.block_result import parse_rfc3339
+        ta, tb = parse_rfc3339(a), parse_rfc3339(b)
+        if ta is not None and tb is not None and ta != tb:
+            return -1 if ta < tb else 1
     return -1 if a < b else (1 if a > b else 0)
 
 

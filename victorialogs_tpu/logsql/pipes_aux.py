@@ -20,7 +20,23 @@ from .duration import parse_duration
 from .lexer import Lexer, quote_token_if_needed
 from .pipes import (ParseError, Pipe, Processor, _parse_field_name,
                     _parse_uint, register_pipe)
-from .pipes_transform import _if_mask, _if_str, _maybe_if, _parse_paren_fields
+from . import pipes_transform as _pt
+
+
+def _if_mask(iff, br):
+    return _pt._if_mask(iff, br)
+
+
+def _if_str(iff):
+    return _pt._if_str(iff)
+
+
+def _maybe_if(lex):
+    return _pt._maybe_if(lex)
+
+
+def _parse_paren_fields(lex):
+    return _pt._parse_paren_fields(lex)
 
 NS = 1_000_000_000
 

@@ -368,24 +368,36 @@ class PipeExtractRegexp(Pipe):
 # ---------------- format ----------------
 
 def _format_duration(ns: float) -> str:
+    """Go time.Duration.String() rendering (the reference formats
+    durations with Go's stdlib — e.g. 210123456789ns -> '3m30.123456789s',
+    1500µs -> '1.5ms')."""
     if math.isnan(ns):
         return ""
-    ns = int(ns)
-    if ns == 0:
-        return "0"
-    sign = "-" if ns < 0 else ""
-    ns = abs(ns)
-    parts = []
-    for unit, width in (("w", 7 * 86400 * 10**9), ("d", 86400 * 10**9),
-                        ("h", 3600 * 10**9), ("m", 60 * 10**9),
-                        ("s", 10**9), ("ms", 10**6), ("µs", 10**3),
-                        ("ns", 1)):
-        if ns >= width:
-            parts.append(f"{ns // width}{unit}")
-            ns %= width
-        if len(parts) >= 3:
-            break
-    return sign + "".join(parts)
+    n = int(ns)
+    if n == 0:
+        return "0s"
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+
+    def frac(val: int, digits: int) -> str:
+        s = f"{val:0{digits}d}".rstrip("0")
+        return "." + s if s else ""
+
+    if n < 1000:
+        return f"{sign}{n}ns"
+    if n < 10**6:
+        return f"{sign}{n // 1000}{frac(n % 1000, 3)}µs"
+    if n < 10**9:
+        return f"{sign}{n // 10**6}{frac(n % 10**6, 6)}ms"
+    secs, sub = divmod(n, 10**9)
+    out = f"{secs % 60}{frac(sub, 9)}s"
+    mins = secs // 60
+    if mins:
+        out = f"{mins % 60}m" + out
+        hours = mins // 60
+        if hours:
+            out = f"{hours}h" + out
+    return sign + out
 
 
 def _format_value(v: str, opt: str) -> str:
@@ -448,20 +460,39 @@ def _format_value(v: str, opt: str) -> str:
         return f"{(n >> 24) & 255}.{(n >> 16) & 255}." \
                f"{(n >> 8) & 255}.{n & 255}"
     if opt == "time":
-        n = parse_number(v)
-        if math.isnan(n):
+        ns = _parse_unix_timestamp_ns(v)
+        if ns is None:
             return v
         from ..engine.block_result import format_rfc3339
-        n = int(n)
-        # heuristically scale unix seconds/millis/micros to nanos
-        if abs(n) < 10**11:
-            n *= 10**9
-        elif abs(n) < 10**14:
-            n *= 10**6
-        elif abs(n) < 10**17:
-            n *= 10**3
-        return format_rfc3339(n)
+        return format_rfc3339(ns)
     return v
+
+
+def _parse_unix_timestamp_ns(v: str) -> int | None:
+    """Unix timestamp (secs/millis/micros/nanos, optional decimal
+    fraction) -> int64 ns without float precision loss (reference
+    timeutil.TryParseUnixTimestamp)."""
+    s = v.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    whole, _, fraction = s.partition(".")
+    if not whole.isdigit() or (fraction and not fraction.isdigit()):
+        return None
+    n = int(whole)
+    if fraction:                      # decimal seconds
+        scale = 9
+    elif n < 10**11:
+        scale = 9                     # seconds
+    elif n < 10**14:
+        scale = 6                     # millis
+    elif n < 10**17:
+        scale = 3                     # micros
+    else:
+        scale = 0                     # nanos
+    frac_ns = int((fraction + "0" * scale)[:scale] or "0")
+    ns = n * 10**scale + frac_ns
+    return -ns if neg else ns
 
 
 @dataclass(repr=False)
