@@ -182,3 +182,12 @@ def test_time_bucket_calendar(store):
     rows = q(store, "* | stats by (_time:year) count() c | sort by (_time)")
     assert [(r["_time"][:4], r["c"]) for r in rows] == [("2025", "4"),
                                                         ("2026", "1")]
+
+
+def test_numeric_bucket_offset(store):
+    _ingest(store, [{"v": str(i)} for i in range(20)])
+    rows = q(store, "* | stats by (v:10) count() c | sort by (v)")
+    assert [(r["v"], r["c"]) for r in rows] == [("0", "10"), ("10", "10")]
+    rows = q(store, "* | stats by (v:10 offset 5) count() c | sort by (v)")
+    assert [(r["v"], r["c"]) for r in rows] == \
+        [("-5", "5"), ("5", "10"), ("15", "5")]

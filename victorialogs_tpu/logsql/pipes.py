@@ -651,7 +651,12 @@ class PipeStats(Pipe):
         if not math.isnan(step) and step > 0:
             f = parse_number(v)
             if not math.isnan(f):
-                return sf.format_number(math.floor(f / step) * step)
+                off = parse_number(b.bucket_offset) \
+                    if b.bucket_offset else 0.0
+                if math.isnan(off):
+                    off = 0.0
+                return sf.format_number(
+                    math.floor((f - off) / step) * step + off)
         return v
 
     def make_processor(self, next_p):
