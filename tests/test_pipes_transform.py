@@ -458,3 +458,18 @@ def test_format_time_decimal_unix(store):
     assert rows == [{"x": "a=2024-06-02T11:35:41.123456789Z, "
                           "b=2024-06-02T11:35:41.123456Z, "
                           "c=1915-08-01T12:24:19Z"}]
+
+
+def test_unpack_json_reference_cases(store):
+    # ported from pipe_unpack_json_test.go (option interactions with
+    # pre-existing fields)
+    _ingest(store, [{"_msg": '{"foo":"bar","z":"q","a":""}',
+                     "foo": "x", "a": "foobar"}])
+    rows = q(store, "* | unpack_json skip_empty_results "
+                    "| fields foo, z, a")
+    assert rows == [{"foo": "bar", "z": "q", "a": "foobar"}]
+    rows = q(store, "* | unpack_json | fields foo, z, a")
+    assert rows == [{"foo": "bar", "z": "q"}]  # a unpacked empty
+    rows = q(store, "* | unpack_json keep_original_fields "
+                    "| fields foo, z, a")
+    assert rows == [{"foo": "x", "z": "q", "a": "foobar"}]
