@@ -84,3 +84,28 @@ def test_first_last_use_topk(store):
     rows = run_query_collect(store, [TEN], "* | last 2 by (_time)",
                              timestamp=T0)
     assert [r["_msg"] for r in rows] == ["row 4999", "row 4998"]
+
+
+def test_sort_partition_by(store):
+    """limit applies per partition group (reference pipe_sort.go
+    partitionByFields)."""
+    rows = run_query_collect(
+        store, [TEN],
+        "* | sort by (v desc) partition by (app) limit 2 "
+        "| sort by (app, v desc) | fields app, v",
+        timestamp=T0)
+    assert len(rows) == 6  # 3 apps x top 2
+    by_app: dict = {}
+    for r in rows:
+        by_app.setdefault(r["app"], []).append(int(r["v"]))
+    assert set(by_app) == {"app0", "app1", "app2"}
+    full = run_query_collect(store, [TEN], "* | fields app, v",
+                             timestamp=T0)
+    for app, got in by_app.items():
+        want = sorted((int(r["v"]) for r in full if r["app"] == app),
+                      reverse=True)[:2]
+        assert got == want, app
+    # round-trip rendering
+    from victorialogs_tpu.logsql.parser import parse_query
+    p = parse_query("* | sort by (x desc) partition by (a, b) limit 3")
+    assert parse_query(p.to_string()).to_string() == p.to_string()

@@ -354,6 +354,7 @@ class PipeSort(Pipe):
     limit: int = 0
     offset: int = 0
     rank_field: str = ""
+    partition_by: list = dc_field(default_factory=list)
 
     name = "sort"
 
@@ -364,6 +365,8 @@ class PipeSort(Pipe):
                 f + (" desc" if d else "") for f, d in self.by) + ")"
         if self.desc:
             s += " desc"
+        if self.partition_by:
+            s += " partition by (" + ", ".join(self.partition_by) + ")"
         if self.offset:
             s += f" offset {self.offset}"
         if self.limit:
@@ -373,12 +376,73 @@ class PipeSort(Pipe):
         return s
 
     def needed_fields(self):
-        return {f for f, _ in self.by}
+        return {f for f, _ in self.by} | set(self.partition_by)
 
     def make_processor(self, next_p):
+        if self.partition_by:
+            return self._make_partitioned_processor(next_p)
         if self.limit > 0:
             return self._make_topk_processor(next_p)
         return self._make_full_processor(next_p)
+
+    def _make_partitioned_processor(self, next_p):
+        """offset/limit apply PER partition-key group (reference
+        pipe_sort.go partitionByFields — e.g. per-field top values in the
+        facets split)."""
+        pipe = self
+        keyfn = cmp_to_key(self._sort_cmp())
+
+        class P(Processor):
+            def __init__(self, np_):
+                super().__init__(np_)
+                from ..utils.memory import MemoryBudget
+                self.budget = MemoryBudget(0.2, "sort")
+                # partition key -> list of (sort_keys, seq, row_dict)
+                self.groups: dict[tuple, list] = {}
+                self.seq = 0
+
+            def write_block(self, br):
+                cols = [br.column(f) for f, _ in pipe.by]
+                pcols = [br.column(f) for f in pipe.partition_by]
+                names = br.column_names()
+                all_cols = [(n, br.column(n)) for n in names]
+                self.budget.add(sum(
+                    sum(len(v) + 8 for v in vals)
+                    for _n, vals in all_cols) + 64)
+                for ri in range(br.nrows):
+                    pkey = tuple(c[ri] for c in pcols)
+                    self.groups.setdefault(pkey, []).append(
+                        ([c[ri] for c in cols], self.seq,
+                         {n: v[ri] for n, v in all_cols}))
+                    self.seq += 1
+
+            def flush(self):
+                out_rows: list[dict] = []
+                for pkey in sorted(self.groups):
+                    rows = sorted(self.groups[pkey],
+                                  key=lambda r: (keyfn(r), r[1]))
+                    if pipe.offset:
+                        rows = rows[pipe.offset:]
+                    if pipe.limit:
+                        rows = rows[:pipe.limit]
+                    for i, (_k, _s, rd) in enumerate(rows):
+                        if pipe.rank_field:
+                            rd = {**rd,
+                                  pipe.rank_field: str(pipe.offset + 1 + i)}
+                        out_rows.append(rd)
+                if out_rows:
+                    names: dict[str, None] = {}
+                    for rd in out_rows:
+                        for n in rd:
+                            names.setdefault(n, None)
+                    cols = {n: [rd.get(n, "") for rd in out_rows]
+                            for n in names}
+                    self.next_p.write_block(BlockResult.from_columns(cols))
+                else:
+                    self.next_p.write_block(BlockResult(0))
+                self.groups = {}
+                self.next_p.flush()
+        return P(next_p)
 
     def _sort_cmp(self):
         pipe = self
@@ -980,6 +1044,19 @@ def _parse_sort(lex: Lexer):
             if lex.is_keyword("as"):
                 lex.next_token()
             p.rank_field = _parse_field_name(lex)
+        elif lex.is_keyword("partition"):
+            lex.next_token()
+            if lex.is_keyword("by"):
+                lex.next_token()
+            if not lex.is_keyword("("):
+                raise ParseError("missing '(' after partition by")
+            lex.next_token()
+            while not lex.is_keyword(")"):
+                if lex.is_keyword(","):
+                    lex.next_token()
+                    continue
+                p.partition_by.append(_parse_field_name(lex))
+            lex.next_token()
         else:
             break
     return p
