@@ -158,3 +158,19 @@ def test_aux_roundtrip_strings():
     ]:
         p = parse_query(qs)
         assert parse_query(p.to_string()).to_string() == p.to_string(), qs
+
+
+def test_top_reference_cases(store):
+    # ported from pipe_top_test.go
+    _ingest(store, [{"a": "2", "b": "3"}, {"a": "2", "b": "3"},
+                    {"a": "2", "b": "54", "c": "d"}])
+    rows = q(store, "* | top by (a)")
+    assert rows == [{"a": "2", "hits": "3"}]
+    rows = q(store, "* | top b hits abc")
+    assert rows == [{"b": "3", "abc": "2"}, {"b": "54", "abc": "1"}]
+    rows = q(store, "* | top by (b) rank as x")
+    assert rows == [{"b": "3", "hits": "2", "x": "1"},
+                    {"b": "54", "hits": "1", "x": "2"}]
+    rows = q(store, "* | top by (b) rank")
+    assert rows == [{"b": "3", "hits": "2", "rank": "1"},
+                    {"b": "54", "hits": "1", "rank": "2"}]

@@ -1793,13 +1793,21 @@ def _parse_replace(lex: Lexer, regexp: bool):
 def _parse_top(lex: Lexer):
     limit = 10
     if not lex.is_keyword("by", "(") and not lex.is_end() and \
-            not lex.is_keyword("|"):
+            not lex.is_keyword("|") and lex.token.isdigit():
         limit = _parse_uint(lex, "top limit")
     by = []
     if lex.is_keyword("by"):
         lex.next_token()
     if lex.is_keyword("("):
         by = _parse_paren_fields(lex)
+    elif not lex.is_end() and not lex.is_keyword("|", "hits", "rank"):
+        # bare field list: `top b hits abc` (reference parsePipeTop)
+        while True:
+            by.append(_parse_field_name(lex))
+            if lex.is_keyword(","):
+                lex.next_token()
+                continue
+            break
     p = PipeTop(by, limit=limit)
     while True:
         if lex.is_keyword("hits"):
@@ -1811,7 +1819,10 @@ def _parse_top(lex: Lexer):
             lex.next_token()
             if lex.is_keyword("as"):
                 lex.next_token()
-            p.rank_field = _parse_field_name(lex)
+            if lex.is_end() or lex.is_keyword("|"):
+                p.rank_field = "rank"     # bare `rank`
+            else:
+                p.rank_field = _parse_field_name(lex)
         else:
             break
     return p
