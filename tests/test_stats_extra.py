@@ -191,3 +191,14 @@ def test_numeric_bucket_offset(store):
     rows = q(store, "* | stats by (v:10 offset 5) count() c | sort by (v)")
     assert [(r["v"], r["c"]) for r in rows] == \
         [("-5", "5"), ("5", "10"), ("15", "5")]
+
+
+def test_uniq_limit_zeroes_hits_when_exceeded(store):
+    _ingest(store, [{"v": f"u{i % 50}"} for i in range(200)])
+    rows = q(store, "* | uniq by (v) with hits limit 10")
+    assert len(rows) == 10
+    # counting stopped at the limit: hits are zeroed, not misreported
+    assert all(r["hits"] == "0" for r in rows)
+    rows = q(store, "* | uniq by (v) with hits limit 100")
+    assert len(rows) == 50
+    assert all(r["hits"] == "4" for r in rows)

@@ -595,6 +595,8 @@ class PipeUniq(Pipe):
                 self.budget = MemoryBudget(0.4, "uniq")
 
             def write_block(self, br):
+                if pipe.limit and len(self.seen) > pipe.limit:
+                    return  # limit exceeded: stop accumulating
                 fields = pipe.by or br.column_names()
                 cols = [(f, br.column(f)) for f in fields]
                 for ri in range(br.nrows):
@@ -606,7 +608,13 @@ class PipeUniq(Pipe):
                     else:
                         self.seen[key] += 1
 
+            def is_done(self):
+                if pipe.limit and len(self.seen) > pipe.limit:
+                    return True  # cancels the upstream scan
+                return super().is_done()
+
             def flush(self):
+                exceeded = pipe.limit and len(self.seen) > pipe.limit
                 keys = sorted(self.seen)
                 if pipe.limit:
                     keys = keys[:pipe.limit]
@@ -617,7 +625,10 @@ class PipeUniq(Pipe):
                 cols = {f: [dict(k).get(f, "") for k in keys]
                         for f in names}
                 if pipe.with_hits:
-                    cols["hits"] = [str(self.seen[k]) for k in keys]
+                    # past the limit the counts are incomplete: the
+                    # reference zeroes them rather than lying
+                    cols["hits"] = ["0" if exceeded else str(self.seen[k])
+                                    for k in keys]
                 self.next_p.write_block(BlockResult.from_columns(cols)
                                         if keys else BlockResult(0))
                 self.next_p.flush()
