@@ -234,3 +234,52 @@ def test_cluster_join_pipe(ingested):
                   'app_total) | limit 3 | fields app, app_total')
     assert len(rows) == 3
     assert all(r["app_total"] == str(N_ROWS // N_STREAMS) for r in rows)
+
+
+def test_cluster_matches_single_node(ingested, tmp_path_factory):
+    """Differential: the sharded cluster must answer exactly like a single
+    node holding the same rows (sort-normalized where order is unspecified)."""
+    import subprocess
+
+    tmp = tempfile.mkdtemp(prefix="vlsingle")
+    port = _free_port()
+    single = _start(["-storageDataPath", tmp,
+                     "-httpListenAddr", f"127.0.0.1:{port}"])
+    try:
+        assert _wait_http(port)
+        rows = []
+        for i in range(N_ROWS):
+            rows.append({
+                "_time": f"2026-07-28T10:{(i // 60) % 60:02d}:"
+                         f"{i % 60:02d}Z",
+                "_msg": f"{'error' if i % 3 == 0 else 'ok'} request {i}",
+                "app": f"app{i % N_STREAMS}",
+                "code": str(200 + (i % 5)),
+            })
+        _insert(port, rows)
+        _flush(port)
+
+        queries = [
+            "* | stats count() n",
+            "error | stats by (app) count() n | sort by (app)",
+            "* | stats count_uniq(app) u, max(code) mx, min(code) mn, "
+            "sum(code) s, avg(code) a",
+            "* | stats by (code) count() c | sort by (code)",
+            'code:204 | sort by (_time) | fields _msg | limit 7',
+            "* | uniq by (code) | sort by (code)",
+            "* | top 3 by (app)",
+            'error | extract "request <id>" | stats count_uniq(id) u',
+            "* | math code + 1 as c1 | stats sum(c1) s",
+            '{app=~"app[0-3]"} | stats count() n',
+            "* | stats by (_time:10m) count() c | sort by (_time)",
+            "* | facets 3",
+        ]
+        for qs in queries:
+            single_rows = _query(port, qs)
+            cluster_rows = _query(ingested["front"], qs)
+            norm = lambda rs: sorted(  # noqa: E731
+                (tuple(sorted(r.items())) for r in rs))
+            assert norm(single_rows) == norm(cluster_rows), qs
+    finally:
+        single.terminate()
+        single.wait(10)
