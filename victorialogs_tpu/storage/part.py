@@ -249,12 +249,15 @@ class Part:
         self._col_f.close()
 
     # ---- lazy block access ----
+    # reads use os.pread: Part objects are shared between query threads,
+    # the worker pool and background mergers, and a shared seek+read pair
+    # races (observed as sporadic zstd errors under concurrent
+    # flush+query load)
     def read_timestamps(self, block_idx: int) -> np.ndarray:
         h = self.headers[block_idx]
         off, ln = h.ts_region
-        self._ts_f.seek(off)
-        deltas = np.frombuffer(_decompress(self._ts_f.read(ln)),
-                               dtype=np.int64)
+        raw = os.pread(self._ts_f.fileno(), ln, off)
+        deltas = np.frombuffer(_decompress(raw), dtype=np.int64)
         return np.cumsum(deltas)
 
     def read_bloom(self, ch: dict) -> np.ndarray | None:
@@ -268,8 +271,7 @@ class Part:
     def read_column(self, block_idx: int, ch: dict) -> EncodedColumn:
         h = self.headers[block_idx]
         off, ln = ch["r"]
-        self._col_f.seek(off)
-        payload = _decompress(self._col_f.read(ln))
+        payload = _decompress(os.pread(self._col_f.fileno(), ln, off))
         vt = ch["t"]
         col = EncodedColumn(name=ch["n"], vtype=vt)
         nrows = h.rows
