@@ -224,35 +224,20 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
 
 def handle_facets(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
-    limit = _int_arg(args, "limit", 10)
-    max_values = _int_arg(args, "max_values_per_field", 1000)
-    max_len = _int_arg(args, "max_value_len", 1000)
-    counts: dict[str, dict[str, int]] = {}
-
-    def sink(br):
-        names = [n for n in br.column_names()
-                 if n not in ("_time", "_stream_id", "_stream")]
-        for n in names:
-            per = counts.setdefault(n, {})
-            for v in br.column(n):
-                if v == "" or len(v) > max_len:
-                    continue
-                if len(per) >= max_values and v not in per:
-                    per["__truncated__"] = 1
-                    continue
-                per[v] = per.get(v, 0) + 1
-    run_query(storage, tenants, q, write_block=sink, runner=runner,
-                  deadline=query_deadline(args))
-    out = []
-    for field in sorted(counts):
-        per = counts[field]
-        if "__truncated__" in per:
-            continue  # too many distinct values: not a useful facet
-        vals = sorted(per.items(), key=lambda kv: (-kv[1], kv[0]))[:limit]
-        out.append({"field_name": field,
-                    "values": [{"field_value": v, "hits": h}
-                               for v, h in vals]})
-    return {"facets": out}
+    from ..logsql.pipes_transform import PipeFacets
+    q.pipes.append(PipeFacets(
+        limit=_int_arg(args, "limit", 10),
+        max_values_per_field=_int_arg(args, "max_values_per_field", 1000),
+        max_value_len=_int_arg(args, "max_value_len", 1000),
+        keep_const_fields=bool(args.get("keep_const_fields", ""))))
+    rows = run_query_collect(storage, tenants, q, runner=runner,
+                             deadline=query_deadline(args))
+    out: dict[str, list] = {}
+    for r in rows:
+        out.setdefault(r["field_name"], []).append(
+            {"field_value": r["field_value"], "hits": int(r["hits"])})
+    return {"facets": [{"field_name": f, "values": v}
+                       for f, v in sorted(out.items())]}
 
 
 # ---------------- field/stream introspection ----------------
