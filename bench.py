@@ -45,9 +45,13 @@ WORDS = ["ok", "cache miss", "retry", "connection reset by peer",
 VERBS = ["GET", "POST", "PUT", "DELETE"]
 
 
-def tpu_probe(timeout_s: int = 180) -> bool:
+def tpu_probe(timeout_s: int | None = None) -> bool:
     """Check device availability in a subprocess so a wedged tunnel can't
     hang the bench process itself."""
+    if timeout_s is None:
+        # the axon claim loop can wait minutes for the chip to free up;
+        # the retry loop (tools/bench_loop.sh) grants a long window
+        timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     code = ("import jax, jax.numpy as jnp; "
             "print(float(jnp.sum(jnp.ones(8))), jax.default_backend())")
     try:

@@ -148,3 +148,140 @@ def test_dead_kernels_removed():
     assert not hasattr(K, "match_positions_any")
     assert not hasattr(K, "nonempty_rows")
     assert "kernels_pallas" not in (K.__doc__ or "")
+
+
+def test_internal_select_abandoned_stream_stops_worker(tmp_path):
+    """Closing the frame generator mid-stream (client disconnect / cluster
+    first-error cancel) must stop the query worker instead of leaving it
+    blocked on a full frame queue forever (ADVICE r2, cluster.py:205)."""
+    import time as _time
+
+    from victorialogs_tpu.server import cluster
+
+    s = Storage(str(tmp_path / "ab"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        lr = LogRows(stream_fields=["app"])
+        for i in range(5000):
+            lr.add(TEN, T0 + i * 1000, [("app", "a"), ("_msg", f"m{i}")])
+        s.must_add_rows(lr)
+        s.debug_flush()
+
+        before = threading.active_count()
+        gen = cluster.handle_internal_select(
+            s, {"query": "*", "ts": str(T0 + 10 * NS)})
+        next(gen)  # first frame arrives; worker keeps producing
+        gen.close()  # abandon the stream
+        deadline = _time.monotonic() + 10
+        while threading.active_count() > before and \
+                _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "internal-select worker thread leaked after stream abandon"
+    finally:
+        s.close()
+
+
+def test_persistentqueue_pending_bytes_incremental(tmp_path):
+    """pending_bytes is tracked incrementally and survives reopen."""
+    from victorialogs_tpu.utils.persistentqueue import PersistentQueue
+
+    q = PersistentQueue(str(tmp_path / "pq"))
+    q.append(b"x" * 100)
+    q.append(b"y" * 50)
+    assert q.pending_bytes() == 104 + 54
+    data = q.read()
+    q.ack(len(data))
+    assert q.pending_bytes() == 54
+    q.close()
+    q2 = PersistentQueue(str(tmp_path / "pq"))
+    assert q2.pending_bytes() == 54
+    q2.close()
+
+
+def test_cluster_error_types_preserved(tmp_path):
+    """Typed local errors (deadline) surface unwrapped from cluster
+    queries so the HTTP layer maps them to the same status codes as
+    single-node mode (ADVICE r2, cluster.py:416)."""
+    from victorialogs_tpu.engine.searcher import QueryTimeoutError
+    from victorialogs_tpu.server.app import VLServer
+    from victorialogs_tpu.server.cluster import NetSelectStorage
+
+    s = Storage(str(tmp_path / "n1"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(1000):
+        lr.add(TEN, T0 + i * 1000, [("app", "a"), ("_msg", f"m{i}")])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    node = VLServer(s, port=0)
+    try:
+        front = NetSelectStorage([f"http://127.0.0.1:{node.port}"])
+
+        class SlowSink:
+            def __init__(self):
+                self.err = None
+
+            def __call__(self, br):
+                raise QueryTimeoutError("deadline exceeded (test)")
+
+        with pytest.raises(QueryTimeoutError):
+            front.net_run_query([TEN], "*", write_block=SlowSink(),
+                                timestamp=T0 + 10 * NS)
+    finally:
+        node.close()
+        s.close()
+
+
+def test_select_queue_shedding_429(tmp_path):
+    """-search.maxQueueDuration: a query that cannot get a concurrency
+    slot in time is shed with 429 instead of waiting forever
+    (reference app/vlselect/main.go:34-46)."""
+    import urllib.error
+    import urllib.request
+
+    from victorialogs_tpu.server.app import VLServer
+
+    s = Storage(str(tmp_path / "shed"), retention_days=100000,
+                flush_interval=3600)
+    node = VLServer(s, port=0, max_concurrent=1, max_queue_duration=0.2)
+    node._sem.acquire()  # exhaust the only slot
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{node.port}/select/logsql/query?query=x",
+                timeout=10)
+        assert ei.value.code == 429
+    finally:
+        node._sem.release()
+        node.close()
+        s.close()
+
+
+def test_internal_select_bad_request_is_400(tmp_path):
+    """Validation must run before the 200 chunked stream starts: a bad
+    protocol version or unparsable query yields a clean HTTP 400."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from victorialogs_tpu.server.app import VLServer
+
+    s = Storage(str(tmp_path / "v400"), retention_days=100000,
+                flush_interval=3600)
+    node = VLServer(s, port=0)
+    try:
+        for form in ({"version": "v999", "query": "*"},
+                     {"version": "v1", "query": "| | |"}):
+            body = urllib.parse.urlencode(form).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{node.port}/internal/select/query",
+                data=body, method="POST")
+            req.add_header("Content-Type",
+                           "application/x-www-form-urlencoded")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, form
+    finally:
+        node.close()
+        s.close()

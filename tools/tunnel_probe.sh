@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the axon TPU tunnel in a loop. Each attempt runs jax.devices() in a
+# subprocess under `timeout` (the tunnel hangs forever when down — see
+# axon claim-loop behavior). Logs one line per attempt to .tunnel_probe.log.
+# Exits 0 the first time the device answers, so callers can `wait` on it.
+LOG=/root/repo/.tunnel_probe.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 120 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" 2>&1 | tail -1)
+  rc=$?
+  echo "$ts rc=$rc $out" >> "$LOG"
+  if [ $rc -eq 0 ] && echo "$out" | grep -qv cpu; then
+    echo "$ts TUNNEL UP" >> "$LOG"
+    exit 0
+  fi
+  sleep 540
+done

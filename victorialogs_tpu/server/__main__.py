@@ -55,6 +55,11 @@ def main(argv=None) -> int:
                    default="")
     p.add_argument("-search.maxConcurrentRequests", type=int,
                    dest="max_concurrent", default=8)
+    p.add_argument("-search.maxQueueDuration", dest="max_queue_duration",
+                   default="30s",
+                   help="how long a query may wait for a free concurrency "
+                        "slot before shedding with 429 (reference "
+                        "app/vlselect/main.go:34-46)")
     p.add_argument("-tpu", action="store_true",
                    help="enable the TPU block runner for queries")
     p.add_argument("-storageNode", action="append", dest="storage_nodes",
@@ -69,6 +74,13 @@ def main(argv=None) -> int:
     if retention_ns is None:
         print(f"invalid -retentionPeriod {args.retentionPeriod!r}",
               file=sys.stderr)
+        return 2
+    # explicit 0 means shed immediately; only a missing/invalid value errors
+    max_queue_ns = 0 if args.max_queue_duration.strip() == "0" \
+        else parse_duration(args.max_queue_duration)
+    if max_queue_ns is None:
+        print(f"invalid -search.maxQueueDuration "
+              f"{args.max_queue_duration!r}", file=sys.stderr)
         return 2
     flush_ns = parse_duration(args.inmemoryDataFlushInterval) or 5e9
     future_ns = parse_duration(args.futureRetention) or 2 * 86400e9
@@ -90,6 +102,7 @@ def main(argv=None) -> int:
     server = VLServer(storage, listen_addr=host or "0.0.0.0",
                       port=int(port_s or 9428), runner=runner,
                       max_concurrent=args.max_concurrent,
+                      max_queue_duration=max_queue_ns / 1e9,
                       storage_nodes=args.storage_nodes)
     print(f"started victoria-logs server at "
           f"http://{host or '0.0.0.0'}:{server.port}/", flush=True)

@@ -255,12 +255,18 @@ class VLServer(BaseHTTPApp):
 
     def __init__(self, storage: Storage, listen_addr: str = "127.0.0.1",
                  port: int = 0, runner=None, max_concurrent: int = 8,
+                 max_queue_duration: float = 30.0,
                  storage_nodes: list | None = None):
         self.storage = storage
         self.metrics = Metrics()
         self.runner = runner
         self.start_time = time.time()
         self._sem = threading.Semaphore(max_concurrent)
+        # internal (cluster) sub-queries get their own gate: a node acting
+        # as both frontend and storage node must not have frontend queries
+        # starve the sub-queries they themselves fan out
+        self._internal_sem = threading.Semaphore(max_concurrent)
+        self.max_queue_duration = max_queue_duration
         if storage_nodes:
             # cluster mode: ingest shards to the nodes, queries
             # scatter-gather over them (reference -storageNode switch —
@@ -302,10 +308,15 @@ class VLServer(BaseHTTPApp):
             self.handle_insert(h, path, args, body, ctype)
             return
 
-        # ---- queries (concurrency-gated; reference main.go:34-46) ----
+        # ---- queries (concurrency-gated with queue-timeout shedding;
+        # reference -search.maxQueueDuration — main.go:34-46) ----
         if path.startswith("/select/"):
-            if not self._sem.acquire(timeout=30):
-                raise HTTPError(429, "too many concurrent queries")
+            if not self._sem.acquire(timeout=self.max_queue_duration):
+                self.metrics.inc("vl_http_request_queue_timeouts_total")
+                raise HTTPError(
+                    429, f"query queued longer than "
+                    f"-search.maxQueueDuration={self.max_queue_duration}s; "
+                    f"too many concurrent queries")
             try:
                 self.handle_select(h, path, args, headers)
             finally:
@@ -323,13 +334,23 @@ class VLServer(BaseHTTPApp):
             self.respond_json(h, {"status": "ok", "ingested": n})
             return
         if path == "/internal/select/query":
+            # same concurrency gate + shedding as /select/ — a storage node
+            # hammered by N frontends must shed, not pile up threads
             from . import cluster
+            if not self._internal_sem.acquire(
+                    timeout=self.max_queue_duration):
+                self.metrics.inc("vl_http_request_queue_timeouts_total")
+                raise HTTPError(429, "too many concurrent queries")
             try:
-                gen = cluster.handle_internal_select(self.storage, args,
-                                                     runner=self.runner)
-            except ValueError as e:
-                raise HTTPError(400, str(e))
-            self.respond_stream(h, gen, ctype="application/octet-stream")
+                try:
+                    gen = cluster.handle_internal_select(
+                        self.storage, args, runner=self.runner)
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                self.respond_stream(h, gen,
+                                    ctype="application/octet-stream")
+            finally:
+                self._internal_sem.release()
             return
 
         # ---- profiling (reference exposes net/http/pprof; we expose the

@@ -171,8 +171,18 @@ def read_frames(fp):
 # ---------------- server side: /internal/select/query ----------------
 
 def handle_internal_select(storage, args, runner=None):
-    """Generator of wire frames for one remote sub-query."""
+    """Frames generator for one remote sub-query; validates EAGERLY.
+
+    Validation and query parsing run before the generator is returned so
+    bad requests surface as ValueError -> HTTP 400 instead of corrupting
+    an already-started 200 chunked stream.  The worker thread never
+    outlives the response: closing the generator (client disconnect, or
+    the frontend's first-error/early-done cancel stopping mid-stream)
+    aborts the query at the sink and unblocks any pending put (see
+    streamwork).  The query runs under the same server-side deadline as
+    single-node /select queries."""
     from ..engine.searcher import run_query
+    from .vlselect import query_deadline
     if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
         raise ValueError(f"unsupported protocol version "
                          f"{args.get('version')!r}")
@@ -194,34 +204,23 @@ def handle_internal_select(storage, args, runner=None):
         # pushed-down limit: each node returns at most N rows
         q.pipes.append(PipeLimit(limit))
 
-    # stream frames as blocks arrive: a worker runs the query and a
-    # bounded queue hands frames to the HTTP response (storage-node memory
-    # stays bounded; time-to-first-byte is first-block time)
-    import queue as _queue
-    frames: _queue.Queue = _queue.Queue(maxsize=64)
-    DONE = object()
+    # stream frames as blocks arrive; the shared worker protocol
+    # (bounded queue + abandon-stream cancellation) lives in streamwork
+    from .streamwork import stream_blocks
 
-    def sink(br):
+    def encode(br):
         cols = {n: br.column(n) for n in br.column_names()}
-        frames.put(write_frame({"cols": cols, "ts": br.timestamps}))
+        return write_frame({"cols": cols, "ts": br.timestamps})
 
-    def work():
-        try:
-            run_query(storage, tenants, q, write_block=sink, runner=runner)
-            frames.put(DONE)
-        except Exception as e:  # propagate to the response loop
-            frames.put(e)
+    deadline = query_deadline(args)
 
-    t = threading.Thread(target=work, daemon=True)
-    t.start()
-    while True:
-        item = frames.get()
-        if item is DONE:
-            break
-        if isinstance(item, Exception):
-            raise item
-        yield item
-    yield END_FRAME
+    def gen():
+        yield from stream_blocks(
+            lambda sink: run_query(storage, tenants, q, write_block=sink,
+                                   runner=runner, deadline=deadline),
+            encode)
+        yield END_FRAME
+    return gen()
 
 
 # ---------------- server side: /internal/insert ----------------
@@ -333,7 +332,8 @@ class NetSelectStorage:
         self.timeout = timeout
 
     def net_run_query(self, tenants, q, write_block=None,
-                      timestamp: int | None = None) -> None:
+                      timestamp: int | None = None,
+                      deadline: float | None = None) -> None:
         from ..engine.searcher import build_processor_chain, init_subqueries
         if isinstance(q, str):
             q = parse_query(q, timestamp)
@@ -373,11 +373,18 @@ class NetSelectStorage:
         tenant_arg = ",".join(f"{t.account_id}:{t.project_id}"
                               for t in tenants)
 
+        # forward the caller's remaining deadline so storage nodes enforce
+        # the same budget the single-node path would (they re-derive it via
+        # query_deadline(args) from this `timeout` arg)
+        remaining_s = None
+        if deadline is not None:
+            remaining_s = max(deadline - time.monotonic(), 0.001)
+
         def fetch(url: str):
             from urllib.parse import urlencode
             # POST the query as a form body: materialized in(...) value
             # lists can exceed sane URL lengths
-            body = urlencode({
+            form = {
                 "version": PROTOCOL_VERSION,
                 "query": q.to_string(),
                 "ts": str(ts),
@@ -385,14 +392,19 @@ class NetSelectStorage:
                 "split_at": str(split_at),
                 "limit": str(push_limit),
                 "tenant": tenant_arg,
-            }).encode("utf-8")
+            }
+            if remaining_s is not None:
+                form["timeout"] = f"{remaining_s:.3f}s"
+            body = urlencode(form).encode("utf-8")
             req = urllib.request.Request(
                 f"{url}/internal/select/query", data=body, method="POST")
             req.add_header("Content-Type",
                            "application/x-www-form-urlencoded")
+            http_timeout = self.timeout if remaining_s is None else \
+                min(self.timeout, remaining_s + 5.0)
             try:
                 with urllib.request.urlopen(
-                        req, timeout=self.timeout) as resp:
+                        req, timeout=http_timeout) as resp:
                     if resp.status != 200:
                         raise IOError(f"{url}: HTTP {resp.status}")
                     for frame in read_frames(resp):
@@ -417,6 +429,13 @@ class NetSelectStorage:
         for t in threads:
             t.join()
         if errors:
-            # no partial results: any storage-node failure fails the query
-            raise IOError(f"cluster query failed: {errors[0]}")
+            # no partial results: any storage-node failure fails the query.
+            # Local typed errors (memory budget, deadline) raised by
+            # head.write_block re-raise unwrapped so the HTTP layer maps
+            # them to 422/503 exactly as in single-node mode; only genuine
+            # transport failures become IOError.
+            err = errors[0]
+            if isinstance(err, (IOError, OSError)):
+                raise IOError(f"cluster query failed: {err}")
+            raise err
         head.flush()

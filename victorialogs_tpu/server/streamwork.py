@@ -1,0 +1,68 @@
+"""Shared streaming-query worker: run a query in a thread, hand encoded
+blocks to an HTTP response generator through a bounded queue.
+
+Used by both /select/logsql/query (vlselect) and /internal/select/query
+(cluster) so the abandon-stream protocol lives in exactly one place:
+- the bounded queue keeps server memory flat and time-to-first-byte at
+  first-block time;
+- closing the generator (client disconnect, or the cluster frontend's
+  first-error/early-done cancel) sets `stop`, which unblocks any pending
+  put() and aborts the query at its next sink() call, so the worker
+  thread and the query's part snapshot never outlive the response.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class StreamAbandoned(Exception):
+    """Raised into the running query when the response stream went away."""
+
+
+def stream_blocks(run, encode):
+    """Generator of encoded items from a threaded query.
+
+    run: callable(sink) that executes the query, calling sink(block) per
+         result block and returning when done;
+    encode: block -> item to yield, or None to skip the block.
+    Exceptions from `run` re-raise in the consuming generator."""
+    chunks: queue.Queue = queue.Queue(maxsize=64)
+    stop = threading.Event()
+    DONE = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                chunks.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def sink(block):
+        item = encode(block)
+        if item is not None and not put(item):
+            raise StreamAbandoned("response stream abandoned")
+
+    def work():
+        try:
+            run(sink)
+            put(DONE)
+        except StreamAbandoned:
+            pass
+        except Exception as e:  # propagate to the response loop
+            put(e)
+
+    threading.Thread(target=work, daemon=True).start()
+    try:
+        while True:
+            item = chunks.get()
+            if item is DONE:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        stop.set()

@@ -153,6 +153,10 @@ def main(argv=None) -> int:
     print(f"started vlagent at http://{host or '0.0.0.0'}:{server.port}/",
           flush=True)
 
+    # the handler only flips a plain flag (no locks: Event.set() from a
+    # signal handler can self-deadlock on the condition lock); the wait
+    # loop re-checks after every sleep, so a signal landing anywhere costs
+    # at most one poll interval instead of hanging until a second signal
     stop = []
 
     def on_signal(_sig, _frm):
@@ -161,7 +165,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
     try:
         while not stop:
-            signal.pause()
+            time.sleep(0.5)
     except KeyboardInterrupt:
         pass
     server.close()

@@ -137,55 +137,22 @@ def handle_query(storage, args, headers, runner=None):
     if limit > 0:
         q.pipes.append(PipeLimit(limit))
 
-    def gen():
-        # stream results as blocks arrive (bounded queue: memory stays
-        # bounded and time-to-first-byte is first-block time); a client
-        # disconnect sets `stop`, which aborts the worker's query
-        import queue as _queue
-        import threading
-        chunks: _queue.Queue = _queue.Queue(maxsize=64)
-        stop = threading.Event()
-        DONE = object()
+    # stream results as blocks arrive; the shared worker protocol
+    # (bounded queue + abandon-stream cancellation) lives in streamwork
+    from .streamwork import stream_blocks
 
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    chunks.put(item, timeout=0.5)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
+    def encode(br):
+        out = []
+        for row in br.rows():
+            out.append(json.dumps(row, ensure_ascii=False,
+                                  separators=(",", ":")))
+        return "\n".join(out) + "\n" if out else None
 
-        def sink(br):
-            out = []
-            for row in br.rows():
-                out.append(json.dumps(row, ensure_ascii=False,
-                                      separators=(",", ":")))
-            if out and not put("\n".join(out) + "\n"):
-                raise ConnectionAbortedError("client went away")
-
-        def work():
-            try:
-                run_query(storage, tenants, q, write_block=sink,
-                          runner=runner, deadline=query_deadline(args))
-                put(DONE)
-            except ConnectionAbortedError:
-                pass
-            except Exception as e:
-                put(e)
-
-        threading.Thread(target=work, daemon=True).start()
-        try:
-            while True:
-                item = chunks.get()
-                if item is DONE:
-                    return
-                if isinstance(item, Exception):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-    return gen()
+    return stream_blocks(
+        lambda sink: run_query(storage, tenants, q, write_block=sink,
+                               runner=runner,
+                               deadline=query_deadline(args)),
+        encode)
 
 
 # ---------------- /select/logsql/hits ----------------

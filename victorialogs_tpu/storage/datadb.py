@@ -6,11 +6,12 @@ merging, a `parts.json` manifest atomically rewritten on every part-set change
 (datadb.go:909-916), unreferenced part dirs removed at open (datadb.go:158-159)
 and periodic in-memory flush (datadb.go:272-300).
 
-Departures: merging rebuilds blocks via decode+re-encode of the overlapping
-streams instead of a streaming k-way block merge (correct, simpler; a
-streaming merger is a later optimization), and concurrency is one lock plus a
-flusher thread — on TPU hosts the query path gets its parallelism from the
-device, not from goroutine-per-CPU merges.
+Merging is a streaming k-way block merge (`merge_block_streams` below):
+parts iterate block-at-a-time in (stream_id, min_ts) order and same-stream
+runs coalesce column-wise without decoding to rows, the same shape as the
+reference's blockStreamMerger (block_stream_merger.go).  Concurrency is one
+lock plus a flusher thread — on TPU hosts the query path gets its
+parallelism from the device, not from goroutine-per-CPU merges.
 """
 
 from __future__ import annotations

@@ -56,6 +56,10 @@ class PersistentQueue:
                     os.unlink(self._seg_path(s))
                 except OSError:
                     pass
+        # pending bytes are tracked incrementally from here on (one stat
+        # sweep at open, then +rec on append / -rec on ack) — stat-ing
+        # every live segment per append made ingest cost grow with backlog
+        self._pending = self._scan_pending_bytes()
 
     @staticmethod
     def _truncate_torn_tail(path: str) -> None:
@@ -83,8 +87,7 @@ class PersistentQueue:
         """Durably append one block (fsynced before returning)."""
         rec = struct.pack(">I", len(data)) + data
         with self._lock:
-            if self.pending_bytes_locked() + len(rec) > \
-                    self.max_pending_bytes:
+            if self._pending + len(rec) > self.max_pending_bytes:
                 raise IOError("persistent queue overflow")
             if self._writer.tell() >= SEGMENT_MAX_BYTES:
                 self._writer.flush()
@@ -95,9 +98,10 @@ class PersistentQueue:
             self._writer.write(rec)
             self._writer.flush()
             os.fsync(self._writer.fileno())
+            self._pending += len(rec)
             self._data_ready.notify_all()
 
-    def pending_bytes_locked(self) -> int:
+    def _scan_pending_bytes(self) -> int:
         total = 0
         for s in range(self._read_seg, self._write_seg + 1):
             try:
@@ -109,7 +113,7 @@ class PersistentQueue:
 
     def pending_bytes(self) -> int:
         with self._lock:
-            return self.pending_bytes_locked()
+            return self._pending
 
     # ---- reader ----
     def read(self, timeout: float | None = None) -> bytes | None:
@@ -158,6 +162,7 @@ class PersistentQueue:
         """Advance past the block returned by read() (durable)."""
         with self._lock:
             self._read_off += 4 + data_len
+            self._pending = max(0, self._pending - (4 + data_len))
             tmp = os.path.join(self.path, READER_STATE + ".tmp")
             with open(tmp, "w") as f:
                 json.dump({"seg": self._read_seg, "off": self._read_off}, f)
