@@ -141,7 +141,7 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         # cluster mode: storage is a NetSelectStorage — scatter-gather the
         # query over the storage nodes (server/cluster.py)
         storage.net_run_query(list(tenants), q, write_block=write_block,
-                              timestamp=timestamp)
+                              timestamp=timestamp, deadline=deadline)
         return
 
     init_subqueries(storage, tenants, q, runner=runner)
@@ -165,6 +165,15 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
     head = build_processor_chain(q.pipes, write_block or (lambda br: None))
     from ..logsql.pipes import compute_needed_fields
     needed = compute_needed_fields(q.pipes)
+
+    # device stats partials: `<filter> | stats [by (_time:step)] ...` runs
+    # as one fused dispatch per part after the filter bitmap, merging
+    # per-bucket partials straight into the stats processor
+    # (tpu/stats_device.py; reference pipe_stats.go:354-377)
+    stats_spec = None
+    if runner is not None and hasattr(runner, "run_part_stats"):
+        from ..tpu.stats_device import device_stats_spec
+        stats_spec = device_stats_spec(q)
 
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
@@ -191,7 +200,7 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
             try:
                 _scan_parts(pt, q, head, runner, batch, tenant_set,
                             allowed_sids, min_ts, max_ts, ctx, needed,
-                            deadline, pool)
+                            deadline, pool, stats_spec)
             finally:
                 if pool is not None:
                     pool.shutdown(wait=True)
@@ -206,8 +215,21 @@ def _eval_block_cpu(q, bs):
     return bm
 
 
+def _absorb_stats_partials(head, q, spec, partials) -> None:
+    """Fold device per-bucket partials into the stats processor."""
+    from ..tpu.stats_device import build_partial_states
+    from .block_result import format_rfc3339
+    ps = q.pipes[0]
+    for bucket_value, cnt, field_stats in partials:
+        key = (format_rfc3339(bucket_value),) if spec.by_time else ()
+        states = build_partial_states(spec, ps.funcs, key, cnt,
+                                      field_stats)
+        head.absorb_partials(key, states)
+
+
 def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
-                min_ts, max_ts, ctx, needed, deadline, pool) -> None:
+                min_ts, max_ts, ctx, needed, deadline, pool,
+                stats_spec=None) -> None:
     for part in pt.ddb.snapshot_parts():
         if part.num_rows == 0:
             continue
@@ -249,7 +271,15 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
         if batch:
             # batched device path: one dispatch per filter leaf over
             # the whole part (tpu/batch.py)
-            bms = runner.run_part(q.filter, part, cand)
+            if stats_spec is not None:
+                bms, handled, partials = runner.run_part_stats(
+                    q.filter, part, cand, stats_spec)
+                if partials:
+                    _absorb_stats_partials(head, q, stats_spec, partials)
+                for bi in handled:
+                    del cand[bi]
+            else:
+                bms = runner.run_part(q.filter, part, cand)
         else:
             # CPU worker pool: filters evaluate in parallel, results
             # are written downstream in deterministic block order

@@ -872,6 +872,18 @@ class PipeStats(Pipe):
                             [i for i in idxs if iff_masks[k][i]]
                         states[k] = fn.update(states[k], func_cols[k], use)
 
+            def absorb_partials(self, key: tuple, states: list) -> None:
+                """Merge device-computed partial states for one group
+                (tpu/stats_device.py) — the in-process analogue of the
+                cluster importState merge (pipe_stats.go:93-125)."""
+                cur = self.groups.get(key)
+                if cur is None:
+                    self.groups[key] = states
+                    self.budget.add(sum(len(k) for k in key) + 80)
+                else:
+                    for k, fn in enumerate(pipe.funcs):
+                        cur[k] = fn.merge(cur[k], states[k])
+
             def flush(self):
                 by_names = [b.name for b in pipe.by]
                 keys = sorted(self.groups)

@@ -255,6 +255,135 @@ def stage_part_column(part, field: str,
                       nbytes=rb * (w + 4))
 
 
+# ---------------- stats staging (device partials) ----------------
+
+_INT_VTYPES = None
+
+
+def _int_vtypes():
+    global _INT_VTYPES
+    if _INT_VTYPES is None:
+        from ..storage.values_encoder import (VT_INT64, VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64)
+        _INT_VTYPES = (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64, VT_INT64)
+    return _INT_VTYPES
+
+
+@dataclass
+class StatsLayout:
+    """Canonical whole-part row layout for stats dispatches: every block in
+    index order (unlike string staging, which skips non-string blocks)."""
+    starts: dict                   # block_idx -> row start
+    nrows: int                     # real rows
+    nrows_padded: int              # STATS_CHUNK multiple
+
+    def device_bytes(self) -> int:
+        return 64 * len(self.starts)
+
+
+@dataclass
+class StagedNumeric:
+    """One value column staged for exact device stats.
+
+    values: uint32 offsets from vmin over eligible (int-typed) blocks;
+    other blocks hold 0 and must be masked off by the caller."""
+    values: object                 # jax uint32[Rp]
+    vmin: int
+    eligible: frozenset            # block idxs with int-typed columns
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+@dataclass
+class StagedBuckets:
+    ids: object                    # jax int32[Rp]
+    base: int                      # bucketed-ns value of bucket 0
+    num_buckets: int
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def part_stats_layout(part) -> StatsLayout:
+    from .kernels import stats_pad_rows
+    starts = {}
+    pos = 0
+    for bi in range(part.num_blocks):
+        starts[bi] = pos
+        pos += part.block_rows(bi)
+    return StatsLayout(starts=starts, nrows=pos,
+                       nrows_padded=stats_pad_rows(pos))
+
+
+def stage_numeric(part, field: str, layout: StatsLayout,
+                  max_abs_times_rows: int) -> StagedNumeric | None:
+    """Stage one uint/int column as exact uint32 offsets from its minimum.
+
+    Returns None when no block is int-typed, the value range exceeds
+    uint32, or magnitudes could break float64 exactness on the host side
+    (stats_device.py exactness contract)."""
+    import jax.numpy as jnp
+
+    cols = {}
+    vmin = None
+    vmax = None
+    for bi in range(part.num_blocks):
+        col = part.block_column(bi, field)
+        if col is None or col.vtype not in _int_vtypes():
+            continue
+        cols[bi] = col
+        lo, hi = int(col.nums.min()), int(col.nums.max())
+        vmin = lo if vmin is None else min(vmin, lo)
+        vmax = hi if vmax is None else max(vmax, hi)
+    if not cols:
+        return None
+    if vmax - vmin >= 1 << 32:
+        return None
+    if max(abs(vmin), abs(vmax)) * max(layout.nrows, 1) >= \
+            max_abs_times_rows:
+        return None
+    vals = np.zeros(layout.nrows_padded, dtype=np.uint32)
+    for bi, col in cols.items():
+        start = layout.starts[bi]
+        vals[start:start + col.nums.shape[0]] = \
+            (col.nums.astype(np.int64) - vmin).astype(np.uint32)
+    return StagedNumeric(values=jnp.asarray(vals), vmin=vmin,
+                         eligible=frozenset(cols),
+                         nbytes=layout.nrows_padded * 4)
+
+
+def stage_time_buckets(part, layout: StatsLayout, step: int, offset: int,
+                       max_buckets: int) -> StagedBuckets | None:
+    """Bucket ids per row from block timestamps, matching the host's
+    `((ts - off) // step) * step + off` bucketing bit-for-bit."""
+    import jax.numpy as jnp
+
+    ids = np.zeros(layout.nrows_padded, dtype=np.int64)
+    base = None
+    hi = None
+    for bi in range(part.num_blocks):
+        ts = part.block_timestamps(bi)
+        vb = ((ts.astype(np.int64) - offset) // step) * step + offset
+        start = layout.starts[bi]
+        ids[start:start + vb.shape[0]] = vb
+        lo_b, hi_b = int(vb.min()), int(vb.max())
+        base = lo_b if base is None else min(base, lo_b)
+        hi = hi_b if hi is None else max(hi, hi_b)
+    if base is None:
+        return None
+    nb = (hi - base) // step + 1
+    if nb > max_buckets:
+        return None
+    ids[:layout.nrows] = (ids[:layout.nrows] - base) // step
+    ids[layout.nrows:] = 0
+    return StagedBuckets(ids=jnp.asarray(ids.astype(np.int32)), base=base,
+                         num_buckets=int(nb),
+                         nbytes=layout.nrows_padded * 4)
+
+
 # ---------------- the batch runner ----------------
 
 class BatchRunner:
@@ -269,6 +398,7 @@ class BatchRunner:
         self.max_part_bytes = max_part_bytes
         self.device_calls = 0
         self.cpu_fallbacks = 0
+        self.stats_dispatches = 0
 
     # ---- staging (cached across queries; parts are immutable) ----
     def stage_part(self, part, field: str) -> StagedPart | None:
@@ -370,7 +500,7 @@ class BatchRunner:
         # a narrow stream filter) and the part isn't staged yet, the host
         # path over just those blocks beats staging + scanning everything
         cand_rows = sum(bss[bi].nrows for bi in survivors)
-        already_staged = (part.uid, plan.field) in self.cache._lru
+        already_staged = self.cache.contains((part.uid, plan.field))
         if not already_staged and cand_rows * 8 < part.num_rows:
             spc = None
         else:
@@ -418,6 +548,144 @@ class BatchRunner:
                             bm[i] = False
             out[bi] = bm
         return out
+
+    # ---- device stats partials (filter bitmap -> per-bucket aggregates) ----
+
+    def _stats_layout(self, part) -> StatsLayout:
+        key = (part.uid, "#layout")
+        got = self.cache.get(key)
+        if got is None:
+            got = part_stats_layout(part)
+            self.cache.put_small(key, got)
+        return got
+
+    def _stage_numeric(self, part, field: str, layout: StatsLayout,
+                       max_abs_times_rows: int):
+        key = (part.uid, "#num", field)
+        got = self.cache.get(key)
+        if got is _UNSTAGEABLE:
+            return None
+        if got is None:
+            got = stage_numeric(part, field, layout, max_abs_times_rows)
+            if got is None:
+                self.cache.put_small(key, _UNSTAGEABLE)
+            else:
+                self.cache.put(key, got)
+        return got
+
+    def _stage_buckets(self, part, layout: StatsLayout, step: int,
+                       offset: int, max_buckets: int):
+        key = (part.uid, "#tb", step, offset)
+        got = self.cache.get(key)
+        if got is _UNSTAGEABLE:
+            return None
+        if got is None:
+            got = stage_time_buckets(part, layout, step, offset,
+                                     max_buckets)
+            if got is None:
+                self.cache.put_small(key, _UNSTAGEABLE)
+            else:
+                self.cache.put(key, got)
+        return got
+
+    def run_part_stats(self, f, part, bss: dict, spec):
+        """Filter + stats partials for one part.
+
+        Runs the ordinary filter evaluation (run_part), then computes
+        per-bucket count/sum/min/max partials ON DEVICE for every
+        candidate block whose value columns are int-typed — one stats
+        dispatch per value field (or one count dispatch), with the row
+        bitmap uploaded once and only (buckets,)-sized results downloaded.
+        This is the fused analogue of the reference's per-worker stats
+        shards merged at flush (pipe_stats.go:354-377).
+
+        Returns (bms, handled, partials):
+        - bms: block_idx -> bitmap (same as run_part);
+        - handled: block idxs fully accounted for by the partials (the
+          caller must NOT feed them through the row path);
+        - partials: list of (bucket_value:int, count:int,
+          field_stats: dict field -> (sum:int, vmin:int, vmax:int));
+          bucket_value is `base + idx*step` ns for by-time specs, 0 else.
+        """
+        from .stats_device import MAX_ABS_TIMES_ROWS, MAX_BUCKETS, \
+            MAX_STAT_ROWS
+        import jax.numpy as jnp
+
+        bms = self.run_part(f, part, bss)
+        layout = self._stats_layout(part)
+        if layout.nrows > MAX_STAT_ROWS:
+            return bms, set(), []
+        numerics = {}
+        for fld in spec.value_fields:
+            sn = self._stage_numeric(part, fld, layout, MAX_ABS_TIMES_ROWS)
+            if sn is None:
+                return bms, set(), []
+            numerics[fld] = sn
+        if spec.by_time:
+            sb = self._stage_buckets(part, layout, spec.step, spec.offset,
+                                     MAX_BUCKETS)
+            if sb is None:
+                return bms, set(), []
+            ids, base, nb = sb.ids, sb.base, sb.num_buckets
+        else:
+            key = (part.uid, "#tb0")
+            sb0 = self.cache.get(key)
+            if sb0 is None:
+                sb0 = StagedBuckets(
+                    ids=jnp.zeros(layout.nrows_padded, jnp.int32),
+                    base=0, num_buckets=1,
+                    nbytes=layout.nrows_padded * 4)
+                self.cache.put(key, sb0)
+            ids, base, nb = sb0.ids, 0, 1
+
+        handled = {bi for bi in bss
+                   if all(bi in numerics[fld].eligible
+                          for fld in spec.value_fields)}
+        if not handled:
+            return bms, set(), []
+        mask = np.zeros(layout.nrows_padded, dtype=bool)
+        any_rows = False
+        for bi in handled:
+            bm = bms[bi]
+            if bm.any():
+                start = layout.starts[bi]
+                mask[start:start + bm.shape[0]] = bm
+                any_rows = True
+        if not any_rows:
+            return bms, handled, []
+        mask_j = jnp.asarray(mask)
+
+        if spec.value_fields:
+            counts = None
+            stats_np = {}
+            for fld in spec.value_fields:
+                self.device_calls += 1
+                self.stats_dispatches += 1
+                packed = np.array(K.stats_bucket_values(
+                    numerics[fld].values, ids, mask_j, nb))
+                counts = packed[0]
+                stats_np[fld] = packed
+            partials = []
+            for idx in np.nonzero(counts)[0]:
+                cnt = int(counts[idx])
+                fs = {}
+                for fld, packed in stats_np.items():
+                    vmin0 = numerics[fld].vmin
+                    from .stats_device import combine_plane_sums
+                    s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
+                    fs[fld] = (s, int(packed[5, idx]) + vmin0,
+                               int(packed[6, idx]) + vmin0)
+                partials.append((base + int(idx) * spec.step
+                                 if spec.by_time else 0, cnt, fs))
+            return bms, handled, partials
+
+        self.device_calls += 1
+        self.stats_dispatches += 1
+        counts = np.array(K.stats_bucket_count(ids, mask_j, nb))
+        partials = [(base + int(idx) * spec.step if spec.by_time else 0,
+                     int(counts[idx]), {})
+                    for idx in np.nonzero(counts)[0]]
+        return bms, handled, partials
 
     def _scan_pair(self, spc: StagedPart, pair: tuple):
         """Device `A.*B` evaluation; returns (survivors, host_verify_mask)."""

@@ -178,32 +178,87 @@ def bitmap_count(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(a.astype(jnp.int32))
 
 
-# ---------------- segmented stats partials ----------------
+# ---------------- bucketed stats partials ----------------
+#
+# One-hot compare-and-reduce instead of segment_sum/min/max: scatter and
+# segment ops serialize on this TPU (~80ms per 8MB block, measured round 1),
+# while a (chunk, num_buckets) comparison matrix reduced along the row axis
+# is pure VPU/MXU work.  The reduction runs as a lax.scan over fixed-size
+# row chunks so peak memory stays bounded at any bucket count.  Sums are
+# EXACT: the kernel reduces four uint8 byte-planes of the uint32 values
+# (per-chunk plane sums < 2**24 stay exact in the f32 matmul; accumulation
+# is uint32), and the host recombines planes with Python integers
+# (tpu/stats_device.py).  This is the device half of the reference's stats
+# partials contract (pipe_stats.go:354-377).
+
+STATS_CHUNK = 8192  # rows per scan step; (chunk, buckets) tiles stay in VMEM
+
+
+def stats_pad_rows(n: int) -> int:
+    """Rows are staged padded to a STATS_CHUNK multiple (scan-friendly)."""
+    return ((max(n, 1) + STATS_CHUNK - 1) // STATS_CHUNK) * STATS_CHUNK
+
 
 @partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
-                 num_buckets: int) -> jnp.ndarray:
-    """count() by bucket — e.g. `_time:step` histograms (hits endpoint)."""
-    return jax.ops.segment_sum(mask.astype(jnp.int32), bucket_ids,
-                               num_segments=num_buckets)
+def stats_bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
+                       num_buckets: int) -> jnp.ndarray:
+    """Masked row count per bucket.
+
+    bucket_ids: int32[R] in [0, num_buckets); mask: bool[R]; R must be a
+    STATS_CHUNK multiple (pad rows masked off).  Returns uint32[B]."""
+    b = bucket_ids.reshape(-1, STATS_CHUNK)
+    m = mask.reshape(-1, STATS_CHUNK)
+    buckets = jnp.arange(num_buckets, dtype=jnp.int32)
+
+    def body(acc, xs):
+        bi, mi = xs
+        onehot = (bi[:, None] == buckets[None, :]) & mi[:, None]
+        return acc + jnp.sum(onehot.astype(jnp.uint32), axis=0), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((num_buckets,), jnp.uint32),
+                          (b, m))
+    return acc
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_sum_f32(values: jnp.ndarray, bucket_ids: jnp.ndarray,
-                   mask: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
-    vals = jnp.where(mask, values, 0.0).astype(jnp.float32)
-    return jax.ops.segment_sum(vals, bucket_ids, num_segments=num_buckets)
+def stats_bucket_values(values: jnp.ndarray, bucket_ids: jnp.ndarray,
+                        mask: jnp.ndarray, num_buckets: int):
+    """count/sum/min/max partials per bucket for one uint32 value column.
 
+    values: uint32[R] (offsets from the part minimum — see stage_numeric);
+    returns uint32[7, B] packed as [count, plane_sums[0..3], vmin, vmax].
+    Buckets with count 0 carry vmin=UINT32_MAX, vmax=0."""
+    v = values.reshape(-1, STATS_CHUNK)
+    b = bucket_ids.reshape(-1, STATS_CHUNK)
+    m = mask.reshape(-1, STATS_CHUNK)
+    buckets = jnp.arange(num_buckets, dtype=jnp.int32)
+    u32max = jnp.uint32(0xFFFFFFFF)
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_min_max_f32(values: jnp.ndarray, bucket_ids: jnp.ndarray,
-                       mask: jnp.ndarray, num_buckets: int):
-    big = jnp.float32(jnp.inf)
-    lo = jax.ops.segment_min(jnp.where(mask, values, big), bucket_ids,
-                             num_segments=num_buckets)
-    hi = jax.ops.segment_max(jnp.where(mask, values, -big), bucket_ids,
-                             num_segments=num_buckets)
-    return lo, hi
+    def body(carry, xs):
+        cnt, sums, lo, hi = carry
+        vi, bi, mi = xs
+        onehot = (bi[:, None] == buckets[None, :]) & mi[:, None]
+        cnt = cnt + jnp.sum(onehot.astype(jnp.uint32), axis=0)
+        planes = jnp.stack(
+            [((vi >> (8 * p)) & 0xFF).astype(jnp.float32)
+             for p in range(4)], axis=1)                       # (C, 4)
+        ps = jnp.einsum("cb,cp->pb", onehot.astype(jnp.float32),
+                        planes)                                # exact < 2**24
+        sums = sums + ps.astype(jnp.uint32)
+        lo = jnp.minimum(lo, jnp.min(
+            jnp.where(onehot, vi[:, None], u32max), axis=0))
+        hi = jnp.maximum(hi, jnp.max(
+            jnp.where(onehot, vi[:, None], jnp.uint32(0)), axis=0))
+        return (cnt, sums, lo, hi), None
+
+    init = (jnp.zeros((num_buckets,), jnp.uint32),
+            jnp.zeros((4, num_buckets), jnp.uint32),
+            jnp.full((num_buckets,), u32max),
+            jnp.zeros((num_buckets,), jnp.uint32))
+    (cnt, sums, lo, hi), _ = jax.lax.scan(body, init, (v, b, m))
+    # one packed (7, B) result => ONE device->host download per dispatch
+    # (each download is a full ~65ms round trip under the axon tunnel)
+    return jnp.concatenate([cnt[None], sums, lo[None], hi[None]], axis=0)
 
 
 def pad_bucket(n: int, minimum: int = 8192) -> int:

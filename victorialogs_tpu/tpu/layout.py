@@ -141,6 +141,10 @@ class StagingCache:
         """Cache a marker (e.g. 'this column is unstageable')."""
         self.put(key, marker)
 
+    def contains(self, key: tuple) -> bool:
+        """Membership probe without touching LRU order or hit counters."""
+        return key in self._lru
+
     def clear(self) -> None:
         self._lru.clear()
         self._bytes = 0

@@ -1,0 +1,164 @@
+"""Device-side stats partials: query analysis + partial-state assembly.
+
+The reference's stats engine computes per-shard partial states and merges
+them at flush (lib/logstorage/pipe_stats.go:354-377); its cluster mode ships
+mergeable states between nodes (pipe_stats.go:93-125).  The TPU-shaped
+analogue: when a query is `<filter> | stats [by (_time:step)] <funcs...>`,
+the per-bucket partials (count / sum / min / max) are computed ON DEVICE in
+one dispatch fused after the filter bitmap — the per-row bitmap and the
+column values never leave HBM; the host downloads a few (num_buckets,)
+vectors and merges them into the ordinary PipeStats group map, so the rest
+of the pipe chain (and the cluster export/import contract) is unchanged.
+
+Exactness contract (why this path is bit-equal to the CPU executor):
+- eligible value columns are storage-typed uint/int (VT_UINT8..64,
+  VT_INT64), whose encodings are round-trip exact — every stored string is
+  the canonical decimal of its value, so min/max chosen numerically on
+  device map back to the same strings the host would pick, and there are
+  no numeric ties between distinct strings;
+- sums are computed exactly: values are staged as uint32 offsets from the
+  part minimum and the kernel accumulates four uint8 byte-planes (each
+  plane sum bounded by 255 * R < 2**32), which the host recombines with
+  Python integers — no float rounding anywhere on the device path;
+- a part is only eligible while max|value| * num_rows < 2**53, keeping the
+  HOST executor's float64 accumulation exact too (otherwise the exact
+  device sum could disagree with a rounded host sum).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..logsql import stats_funcs as sf
+from ..logsql.duration import parse_duration
+
+MAX_BUCKETS = 8192
+MAX_STAT_ROWS = 16 << 20          # plane-sum bound: 255 * R < 2**32
+MAX_ABS_TIMES_ROWS = 1 << 53      # keep the host float64 path exact as well
+
+
+@dataclass
+class FuncSpec:
+    kind: str                     # count | count_field | sum | avg | min | max
+    field: str | None             # value field (None for plain count)
+
+
+@dataclass
+class StatsSpec:
+    by_time: bool                 # False => single global group
+    step: int                     # ns (when by_time)
+    offset: int                   # ns (when by_time)
+    funcs: list                   # list[FuncSpec], parallel to pipe.funcs
+    value_fields: list            # distinct non-None fields, staging order
+
+
+def _func_spec(fn) -> FuncSpec | None:
+    """Map one parsed stats function to its device partial kind.
+
+    Exact type() checks: subclasses may change update/finalize semantics
+    (StatsRate and StatsRateSum are explicitly allowed — they reuse the
+    count/sum STATE and only change finalize, which stays on the host)."""
+    t = type(fn)
+    if t in (sf.StatsCount, sf.StatsRate):
+        if not fn.fields:
+            return FuncSpec("count", None)
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            # int-typed blocks have a value in every row, so count(field)
+            # over an eligible block is just the masked row count
+            return FuncSpec("count_field", fn.fields[0])
+        return None
+    if t in (sf.StatsSum, sf.StatsRateSum):
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            return FuncSpec("sum", fn.fields[0])
+        return None
+    if t is sf.StatsAvg:
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            return FuncSpec("avg", fn.fields[0])
+        return None
+    if t is sf.StatsMin:
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            return FuncSpec("min", fn.fields[0])
+        return None
+    if t is sf.StatsMax:
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            return FuncSpec("max", fn.fields[0])
+        return None
+    return None
+
+
+def device_stats_spec(q) -> StatsSpec | None:
+    """Static per-query analysis: can pipes[0] run as device partials?
+
+    Eligible shape: first pipe is a plain `stats` (or the cluster's
+    stats_export wrapper — same grouping semantics), grouped by nothing or
+    by a single `_time:<duration>` bucket, with every function mapping to a
+    device partial and no per-function `if (...)` guards."""
+    if not q.pipes:
+        return None
+    ps = q.pipes[0]
+    from ..logsql.pipes import PipeStats
+    if not isinstance(ps, PipeStats) or \
+            getattr(ps, "name", "") not in ("stats", "stats_export"):
+        return None
+    by_time, step, offset = False, 0, 0
+    if ps.by:
+        if len(ps.by) != 1:
+            return None
+        b = ps.by[0]
+        if b.name != "_time" or not b.bucket or \
+                b.bucket.lower() in ("week", "month", "year"):
+            return None
+        d = parse_duration(b.bucket)
+        if not d or d <= 0:
+            return None
+        by_time, step, offset = True, int(d), b.offset_ns()
+    funcs = []
+    for fn in ps.funcs:
+        if fn.iff is not None:
+            return None
+        spec = _func_spec(fn)
+        if spec is None:
+            return None
+        funcs.append(spec)
+    fields: list[str] = []
+    for f in funcs:
+        if f.field is not None and f.field not in fields:
+            fields.append(f.field)
+    return StatsSpec(by_time=by_time, step=step, offset=offset,
+                     funcs=funcs, value_fields=fields)
+
+
+def combine_plane_sums(planes) -> int:
+    """Exact uint sum from the kernel's four uint8-plane partials."""
+    total = 0
+    for p, s in enumerate(planes):
+        total += int(s) << (8 * p)
+    return total
+
+
+def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
+                         count: int, field_stats: dict) -> list:
+    """Per-bucket states list (parallel to pipe_funcs) from kernel outputs.
+
+    field_stats: field -> (sum:int, vmin:int, vmax:int) exact integers.
+    The states are merged into the stats processor with the funcs' own
+    merge(), so downstream behavior (finalize, export/import for cluster
+    pushdown) is identical to the host path."""
+    states = []
+    for fs, fn in zip(spec.funcs, pipe_funcs):
+        if fs.kind in ("count", "count_field"):
+            states.append(count)
+        elif fs.kind == "sum":
+            s = field_stats[fs.field][0]
+            states.append(float(s) if count else math.nan)
+        elif fs.kind == "avg":
+            s = field_stats[fs.field][0]
+            states.append((float(s), count))
+        elif fs.kind == "min":
+            states.append(str(field_stats[fs.field][1]) if count else None)
+        elif fs.kind == "max":
+            states.append(str(field_stats[fs.field][2]) if count else None)
+        else:  # pragma: no cover - _func_spec gates kinds
+            raise AssertionError(fs.kind)
+    return states
