@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tpu import kernels as K
+from ..tpu.batch import BatchRunner
 
 BLOCK_AXIS = "blocks"
 
@@ -117,3 +118,78 @@ def shard_batch(mesh: Mesh, *arrays):
     """Device-put batch tensors with the block axis sharded over the mesh."""
     sharding = NamedSharding(mesh, P(BLOCK_AXIS))
     return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+# ---------------- the multi-chip product runner ----------------
+
+@partial(jax.jit, static_argnames=("num_buckets", "mesh"))
+def _stats_values_mesh(mesh, values, bucket_ids, mask, num_buckets):
+    """Sharded stats partials: each device reduces its row shard with the
+    same chunked kernel body, then count/sums ride psum and min/max ride
+    pmin/pmax over ICI — the mesh analogue of the reference's mergeState
+    (pipe_stats.go:354-377)."""
+    def shard_fn(v, b, m):
+        cnt, sums, lo, hi = K.stats_values_local(v, b, m, num_buckets,
+                                                 vary_axes=(BLOCK_AXIS,))
+        cnt = jax.lax.psum(cnt, BLOCK_AXIS)
+        sums = jax.lax.psum(sums, BLOCK_AXIS)
+        lo = jax.lax.pmin(lo, BLOCK_AXIS)
+        hi = jax.lax.pmax(hi, BLOCK_AXIS)
+        return K.pack_stats(cnt, sums, lo, hi)
+
+    spec = P(BLOCK_AXIS)
+    return jax.shard_map(shard_fn, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=P())(values, bucket_ids, mask)
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "mesh"))
+def _stats_count_mesh(mesh, bucket_ids, mask, num_buckets):
+    def shard_fn(b, m):
+        cnt = K.stats_count_local(b, m, num_buckets,
+                                  vary_axes=(BLOCK_AXIS,))
+        return jax.lax.psum(cnt, BLOCK_AXIS)
+
+    spec = P(BLOCK_AXIS)
+    return jax.shard_map(shard_fn, mesh=mesh,
+                         in_specs=(spec, spec),
+                         out_specs=P())(bucket_ids, mask)
+
+
+class MeshBatchRunner(BatchRunner):
+    """BatchRunner over a device mesh: the PRODUCT multi-chip query path.
+
+    Staged arrays (string matrices, numeric columns, bucket ids, masks)
+    are device_put with their row axis sharded over the mesh, so:
+    - filter scans (match_scan & friends) compile SPMD under jit — each
+      device scans its row stripe, no collectives needed (the bitmap
+      gathers on download);
+    - stats partials run under shard_map with psum/pmin/pmax over ICI and
+      only the (7, buckets) reduced result reaches the host.
+
+    Single-device behavior is identical to BatchRunner (the sharding
+    degenerates); engine.searcher drives both through the same interface.
+    """
+
+    def __init__(self, mesh: Mesh | None = None, **kw):
+        super().__init__(**kw)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.ndev = int(self.mesh.devices.size)
+        self.stats_shards = self.ndev
+        self._row_sharding = NamedSharding(self.mesh, P(BLOCK_AXIS))
+        self._replicated = NamedSharding(self.mesh, P())
+
+    def _put(self, arr):
+        # shard axis 0 when it divides evenly (stats layouts always do;
+        # string-staging row buckets do for power-of-two mesh sizes),
+        # else replicate — correctness never depends on the placement
+        if arr.shape[0] % self.ndev == 0:
+            return jax.device_put(arr, self._row_sharding)
+        return jax.device_put(arr, self._replicated)
+
+    def _dispatch_stats_count(self, ids, mask, nb):
+        return np.array(_stats_count_mesh(self.mesh, ids, mask, nb))
+
+    def _dispatch_stats_values(self, values, ids, mask, nb):
+        return np.array(_stats_values_mesh(self.mesh, values, ids, mask,
+                                           nb))

@@ -95,8 +95,14 @@ def main(argv=None) -> int:
 
     runner = None
     if args.tpu:
-        from ..tpu.batch import BatchRunner
-        runner = BatchRunner()
+        import jax
+        if len(jax.devices()) > 1:
+            # multi-chip: shard staged rows over the mesh, psum stats
+            from ..parallel.distributed import MeshBatchRunner
+            runner = MeshBatchRunner()
+        else:
+            from ..tpu.batch import BatchRunner
+            runner = BatchRunner()
 
     host, _, port_s = args.httpListenAddr.rpartition(":")
     server = VLServer(storage, listen_addr=host or "0.0.0.0",

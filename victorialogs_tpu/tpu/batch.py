@@ -208,13 +208,18 @@ def _row_accessor(bs: BlockSearch, field: str):
 
 
 def stage_part_column(part, field: str,
-                      max_bytes: int = 4 << 30) -> StagedPart | None:
+                      max_bytes: int = 4 << 30,
+                      put=None) -> StagedPart | None:
     """Stage every string-typed block of `field` in one (Rb, W) matrix.
 
     Blocks whose column is missing/const/dict/numeric are left out (the
     evaluator runs those on the host).  Returns None when nothing is
-    stageable or the staged matrix would exceed max_bytes."""
+    stageable or the staged matrix would exceed max_bytes.
+    put: host->device transfer (default jnp.asarray); a mesh runner passes
+    a sharding device_put so the rows axis spreads over its devices."""
     import jax.numpy as jnp
+    if put is None:
+        put = jnp.asarray
 
     cols = {}
     total = 0
@@ -249,7 +254,7 @@ def stage_part_column(part, field: str,
         if ov.size:
             overflow[bi] = ov
         start += r
-    return StagedPart(rows=jnp.asarray(mat), lengths=jnp.asarray(lens),
+    return StagedPart(rows=put(mat), lengths=put(lens),
                       lengths_np=lens, nrows=start, width=w,
                       block_rows=block_rows, overflow=overflow,
                       nbytes=rb * (w + 4))
@@ -307,25 +312,31 @@ class StagedBuckets:
         return self.nbytes
 
 
-def part_stats_layout(part) -> StatsLayout:
-    from .kernels import stats_pad_rows
+def part_stats_layout(part, shards: int = 1) -> StatsLayout:
+    """shards: pad rows to a (STATS_CHUNK * shards) multiple so a mesh
+    runner can split the row axis evenly with whole chunks per device."""
+    from .kernels import stats_pad_rows, STATS_CHUNK
     starts = {}
     pos = 0
     for bi in range(part.num_blocks):
         starts[bi] = pos
         pos += part.block_rows(bi)
-    return StatsLayout(starts=starts, nrows=pos,
-                       nrows_padded=stats_pad_rows(pos))
+    padded = stats_pad_rows(pos)
+    mult = STATS_CHUNK * max(shards, 1)
+    padded = ((padded + mult - 1) // mult) * mult
+    return StatsLayout(starts=starts, nrows=pos, nrows_padded=padded)
 
 
 def stage_numeric(part, field: str, layout: StatsLayout,
-                  max_abs_times_rows: int) -> StagedNumeric | None:
+                  max_abs_times_rows: int, put=None) -> StagedNumeric | None:
     """Stage one uint/int column as exact uint32 offsets from its minimum.
 
     Returns None when no block is int-typed, the value range exceeds
     uint32, or magnitudes could break float64 exactness on the host side
     (stats_device.py exactness contract)."""
     import jax.numpy as jnp
+    if put is None:
+        put = jnp.asarray
 
     cols = {}
     vmin = None
@@ -350,16 +361,18 @@ def stage_numeric(part, field: str, layout: StatsLayout,
         start = layout.starts[bi]
         vals[start:start + col.nums.shape[0]] = \
             (col.nums.astype(np.int64) - vmin).astype(np.uint32)
-    return StagedNumeric(values=jnp.asarray(vals), vmin=vmin,
+    return StagedNumeric(values=put(vals), vmin=vmin,
                          eligible=frozenset(cols),
                          nbytes=layout.nrows_padded * 4)
 
 
 def stage_time_buckets(part, layout: StatsLayout, step: int, offset: int,
-                       max_buckets: int) -> StagedBuckets | None:
+                       max_buckets: int, put=None) -> StagedBuckets | None:
     """Bucket ids per row from block timestamps, matching the host's
     `((ts - off) // step) * step + off` bucketing bit-for-bit."""
     import jax.numpy as jnp
+    if put is None:
+        put = jnp.asarray
 
     ids = np.zeros(layout.nrows_padded, dtype=np.int64)
     base = None
@@ -379,7 +392,7 @@ def stage_time_buckets(part, layout: StatsLayout, step: int, offset: int,
         return None
     ids[:layout.nrows] = (ids[:layout.nrows] - base) // step
     ids[layout.nrows:] = 0
-    return StagedBuckets(ids=jnp.asarray(ids.astype(np.int32)), base=base,
+    return StagedBuckets(ids=put(ids.astype(np.int32)), base=base,
                          num_buckets=int(nb),
                          nbytes=layout.nrows_padded * 4)
 
@@ -399,6 +412,19 @@ class BatchRunner:
         self.device_calls = 0
         self.cpu_fallbacks = 0
         self.stats_dispatches = 0
+        self.stats_shards = 1          # mesh runners stripe rows over >1
+
+    # ---- device placement hook (MeshBatchRunner shards the row axis) ----
+    def _put(self, arr):
+        import jax.numpy as jnp
+        return jnp.asarray(arr)
+
+    # ---- stats dispatch hooks (MeshBatchRunner shard_maps + psum-reduces)
+    def _dispatch_stats_count(self, ids, mask, nb):
+        return np.array(K.stats_bucket_count(ids, mask, nb))
+
+    def _dispatch_stats_values(self, values, ids, mask, nb):
+        return np.array(K.stats_bucket_values(values, ids, mask, nb))
 
     # ---- staging (cached across queries; parts are immutable) ----
     def stage_part(self, part, field: str) -> StagedPart | None:
@@ -408,7 +434,8 @@ class BatchRunner:
             return None
         if got is not None:
             return got
-        spc = stage_part_column(part, field, self.max_part_bytes)
+        spc = stage_part_column(part, field, self.max_part_bytes,
+                                put=self._put)
         if spc is None:
             self.cache.put_small(key, _UNSTAGEABLE)
             return None
@@ -555,7 +582,7 @@ class BatchRunner:
         key = (part.uid, "#layout")
         got = self.cache.get(key)
         if got is None:
-            got = part_stats_layout(part)
+            got = part_stats_layout(part, shards=self.stats_shards)
             self.cache.put_small(key, got)
         return got
 
@@ -566,7 +593,8 @@ class BatchRunner:
         if got is _UNSTAGEABLE:
             return None
         if got is None:
-            got = stage_numeric(part, field, layout, max_abs_times_rows)
+            got = stage_numeric(part, field, layout, max_abs_times_rows,
+                                put=self._put)
             if got is None:
                 self.cache.put_small(key, _UNSTAGEABLE)
             else:
@@ -581,7 +609,7 @@ class BatchRunner:
             return None
         if got is None:
             got = stage_time_buckets(part, layout, step, offset,
-                                     max_buckets)
+                                     max_buckets, put=self._put)
             if got is None:
                 self.cache.put_small(key, _UNSTAGEABLE)
             else:
@@ -609,7 +637,6 @@ class BatchRunner:
         """
         from .stats_device import MAX_ABS_TIMES_ROWS, MAX_BUCKETS, \
             MAX_STAT_ROWS
-        import jax.numpy as jnp
 
         bms = self.run_part(f, part, bss)
         layout = self._stats_layout(part)
@@ -632,7 +659,8 @@ class BatchRunner:
             sb0 = self.cache.get(key)
             if sb0 is None:
                 sb0 = StagedBuckets(
-                    ids=jnp.zeros(layout.nrows_padded, jnp.int32),
+                    ids=self._put(np.zeros(layout.nrows_padded,
+                                           np.int32)),
                     base=0, num_buckets=1,
                     nbytes=layout.nrows_padded * 4)
                 self.cache.put(key, sb0)
@@ -653,7 +681,7 @@ class BatchRunner:
                 any_rows = True
         if not any_rows:
             return bms, handled, []
-        mask_j = jnp.asarray(mask)
+        mask_j = self._put(mask)
 
         if spec.value_fields:
             counts = None
@@ -661,8 +689,8 @@ class BatchRunner:
             for fld in spec.value_fields:
                 self.device_calls += 1
                 self.stats_dispatches += 1
-                packed = np.array(K.stats_bucket_values(
-                    numerics[fld].values, ids, mask_j, nb))
+                packed = self._dispatch_stats_values(
+                    numerics[fld].values, ids, mask_j, nb)
                 counts = packed[0]
                 stats_np[fld] = packed
             partials = []
@@ -681,7 +709,7 @@ class BatchRunner:
 
         self.device_calls += 1
         self.stats_dispatches += 1
-        counts = np.array(K.stats_bucket_count(ids, mask_j, nb))
+        counts = self._dispatch_stats_count(ids, mask_j, nb)
         partials = [(base + int(idx) * spec.step if spec.by_time else 0,
                      int(counts[idx]), {})
                     for idx in np.nonzero(counts)[0]]

@@ -199,13 +199,20 @@ def stats_pad_rows(n: int) -> int:
     return ((max(n, 1) + STATS_CHUNK - 1) // STATS_CHUNK) * STATS_CHUNK
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def stats_bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
-                       num_buckets: int) -> jnp.ndarray:
-    """Masked row count per bucket.
+def _vary(x, axes):
+    """Mark a scan-carry constant as varying over shard_map manual axes
+    (required so carry input/output types agree inside shard_map)."""
+    if not axes:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)  # older jax
 
-    bucket_ids: int32[R] in [0, num_buckets); mask: bool[R]; R must be a
-    STATS_CHUNK multiple (pad rows masked off).  Returns uint32[B]."""
+
+def stats_count_local(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
+                      num_buckets: int, vary_axes=()) -> jnp.ndarray:
+    """Chunked masked-count body (also the per-shard body under
+    shard_map — parallel/distributed.py reduces it with psum)."""
     b = bucket_ids.reshape(-1, STATS_CHUNK)
     m = mask.reshape(-1, STATS_CHUNK)
     buckets = jnp.arange(num_buckets, dtype=jnp.int32)
@@ -215,19 +222,15 @@ def stats_bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
         onehot = (bi[:, None] == buckets[None, :]) & mi[:, None]
         return acc + jnp.sum(onehot.astype(jnp.uint32), axis=0), None
 
-    acc, _ = jax.lax.scan(body, jnp.zeros((num_buckets,), jnp.uint32),
-                          (b, m))
+    acc, _ = jax.lax.scan(
+        body, _vary(jnp.zeros((num_buckets,), jnp.uint32), vary_axes),
+        (b, m))
     return acc
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def stats_bucket_values(values: jnp.ndarray, bucket_ids: jnp.ndarray,
-                        mask: jnp.ndarray, num_buckets: int):
-    """count/sum/min/max partials per bucket for one uint32 value column.
-
-    values: uint32[R] (offsets from the part minimum — see stage_numeric);
-    returns uint32[7, B] packed as [count, plane_sums[0..3], vmin, vmax].
-    Buckets with count 0 carry vmin=UINT32_MAX, vmax=0."""
+def stats_values_local(values: jnp.ndarray, bucket_ids: jnp.ndarray,
+                       mask: jnp.ndarray, num_buckets: int, vary_axes=()):
+    """Chunked count/sum/min/max body; returns (cnt, sums[4,B], lo, hi)."""
     v = values.reshape(-1, STATS_CHUNK)
     b = bucket_ids.reshape(-1, STATS_CHUNK)
     m = mask.reshape(-1, STATS_CHUNK)
@@ -251,14 +254,42 @@ def stats_bucket_values(values: jnp.ndarray, bucket_ids: jnp.ndarray,
             jnp.where(onehot, vi[:, None], jnp.uint32(0)), axis=0))
         return (cnt, sums, lo, hi), None
 
-    init = (jnp.zeros((num_buckets,), jnp.uint32),
-            jnp.zeros((4, num_buckets), jnp.uint32),
-            jnp.full((num_buckets,), u32max),
-            jnp.zeros((num_buckets,), jnp.uint32))
+    init = tuple(
+        _vary(a, vary_axes)
+        for a in (jnp.zeros((num_buckets,), jnp.uint32),
+                  jnp.zeros((4, num_buckets), jnp.uint32),
+                  jnp.full((num_buckets,), u32max),
+                  jnp.zeros((num_buckets,), jnp.uint32)))
     (cnt, sums, lo, hi), _ = jax.lax.scan(body, init, (v, b, m))
-    # one packed (7, B) result => ONE device->host download per dispatch
-    # (each download is a full ~65ms round trip under the axon tunnel)
+    return cnt, sums, lo, hi
+
+
+def pack_stats(cnt, sums, lo, hi) -> jnp.ndarray:
+    """One packed (7, B) result => ONE device->host download per dispatch
+    (each download is a full ~65ms round trip under the axon tunnel)."""
     return jnp.concatenate([cnt[None], sums, lo[None], hi[None]], axis=0)
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def stats_bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
+                       num_buckets: int) -> jnp.ndarray:
+    """Masked row count per bucket.
+
+    bucket_ids: int32[R] in [0, num_buckets); mask: bool[R]; R must be a
+    STATS_CHUNK multiple (pad rows masked off).  Returns uint32[B]."""
+    return stats_count_local(bucket_ids, mask, num_buckets)
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def stats_bucket_values(values: jnp.ndarray, bucket_ids: jnp.ndarray,
+                        mask: jnp.ndarray, num_buckets: int):
+    """count/sum/min/max partials per bucket for one uint32 value column.
+
+    values: uint32[R] (offsets from the part minimum — see stage_numeric);
+    returns uint32[7, B] packed as [count, plane_sums[0..3], vmin, vmax].
+    Buckets with count 0 carry vmin=UINT32_MAX, vmax=0."""
+    return pack_stats(*stats_values_local(values, bucket_ids, mask,
+                                          num_buckets))
 
 
 def pad_bucket(n: int, minimum: int = 8192) -> int:
