@@ -178,35 +178,100 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
 
+    tenant_set = set(tenants)
+    batch = runner is not None and hasattr(runner, "run_part")
+    # CPU-path block workers (reference spawns GetConcurrency() workers
+    # over a 64-block channel — storage_search.go:1035-1067; numpy/zstd
+    # release the GIL, so threads overlap real work).  One pool is SHARED
+    # across partitions so total workers stay bounded.
+    nworkers = 1 if batch else q.get_concurrency()
+    pool = None
+    if nworkers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(max_workers=nworkers)
+
+    def scan_partition(pt, sink_head):
+        ctx = SearchContext(partition=pt, tenants=tenants)
+        allowed_sids = None
+        if sfs:
+            allowed_sids = set.intersection(
+                *(f.resolve(pt, tenants) for f in sfs))
+            if not allowed_sids:
+                return
+        _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
+                    allowed_sids, min_ts, max_ts, ctx, needed,
+                    deadline, pool, stats_spec)
+
     try:
-        for pt in storage.select_partitions(min_ts, max_ts):
-            ctx = SearchContext(partition=pt, tenants=tenants)
-            allowed_sids = None
-            if sfs:
-                allowed_sids = set.intersection(
-                    *(f.resolve(pt, tenants) for f in sfs))
-                if not allowed_sids:
-                    continue
-            tenant_set = set(tenants)
-            batch = runner is not None and hasattr(runner, "run_part")
-            # CPU-path block workers (reference spawns GetConcurrency()
-            # workers over a 64-block channel — storage_search.go:1035-1067;
-            # numpy/zstd release the GIL, so threads overlap real work)
-            nworkers = 1 if batch else q.get_concurrency()
-            pool = None
-            if nworkers > 1:
-                from concurrent.futures import ThreadPoolExecutor
-                pool = ThreadPoolExecutor(max_workers=nworkers)
-            try:
-                _scan_parts(pt, q, head, runner, batch, tenant_set,
-                            allowed_sids, min_ts, max_ts, ctx, needed,
-                            deadline, pool, stats_spec)
-            finally:
-                if pool is not None:
-                    pool.shutdown(wait=True)
+        pts = storage.select_partitions(min_ts, max_ts)
+        # per-day partitions search CONCURRENTLY under a worker cap
+        # (reference storage_search.go:1095-1126): a 30-day query is no
+        # longer 30x the single-day latency.  The processor chain is not
+        # thread-safe, so partition workers funnel through a locked head;
+        # within one partition, block order stays deterministic.
+        npw = min(len(pts), q.get_concurrency())
+        if npw <= 1:
+            for pt in pts:
+                scan_partition(pt, head)
+        else:
+            _scan_partitions_parallel(pts, scan_partition, head, npw)
     except QueryCancelled:
         pass
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
     head.flush()
+
+
+class _SyncHead:
+    """Thread-safe facade over the processor chain head for concurrent
+    partition workers; also turns the cross-worker stop flag into
+    is_done() so sibling scans exit at their next check."""
+
+    def __init__(self, head, lock, stop):
+        self._head = head
+        self._lock = lock
+        self._stop = stop
+
+    def write_block(self, br) -> None:
+        with self._lock:
+            self._head.write_block(br)
+
+    def absorb_partials(self, key, states) -> None:
+        with self._lock:
+            self._head.absorb_partials(key, states)
+
+    def is_done(self) -> bool:
+        if self._stop.is_set():
+            return True
+        with self._lock:
+            return self._head.is_done()
+
+
+def _scan_partitions_parallel(pts, scan_partition, head, npw) -> None:
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    lock = _threading.Lock()
+    stop = _threading.Event()
+    sync_head = _SyncHead(head, lock, stop)
+    errors: list = []
+
+    def run_one(pt):
+        if stop.is_set():
+            return
+        try:
+            scan_partition(pt, sync_head)
+        except QueryCancelled:
+            stop.set()
+        except Exception as e:
+            errors.append(e)
+            stop.set()
+
+    with ThreadPoolExecutor(max_workers=npw) as ex:
+        list(ex.map(run_one, pts))
+    if errors:
+        raise errors[0]
 
 
 def _eval_block_cpu(q, bs):
@@ -230,18 +295,13 @@ def _absorb_stats_partials(head, q, spec, partials) -> None:
 def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 min_ts, max_ts, ctx, needed, deadline, pool,
                 stats_spec=None) -> None:
-    for part in pt.ddb.snapshot_parts():
-        if part.num_rows == 0:
-            continue
-        if part.min_ts > max_ts or part.max_ts < min_ts:
-            continue
-        if deadline is not None and time.monotonic() > deadline:
-            raise QueryTimeoutError(
-                "query exceeded -search.maxQueryDuration")
-        cand: dict[int, BlockSearch] = {}
+    parts = [p for p in pt.ddb.snapshot_parts()
+             if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
+
+    def cand_block_idxs(part) -> list:
+        """Header-only candidate selection (shared with the prefetcher)."""
+        out = []
         for bi in range(part.num_blocks):
-            if head.is_done():
-                raise QueryCancelled()
             if part.block_min_ts(bi) > max_ts or \
                part.block_max_ts(bi) < min_ts:
                 continue
@@ -250,6 +310,25 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 continue
             if allowed_sids is not None and sid not in allowed_sids:
                 continue
+            out.append(bi)
+        return out
+
+    for pi, part in enumerate(parts):
+        if deadline is not None and time.monotonic() > deadline:
+            raise QueryTimeoutError(
+                "query exceeded -search.maxQueryDuration")
+        if batch and pi + 1 < len(parts):
+            # double-buffer: stage part N+1 (host decode + upload) while
+            # the device scans part N (SURVEY §7 hard-part 3); the
+            # prefetcher applies the evaluator's own bloom/narrowness
+            # gates over the same candidate set
+            nxt = parts[pi + 1]
+            runner.submit_prefetch(nxt, q.filter, stats_spec,
+                                   cand_bis=cand_block_idxs(nxt))
+        cand: dict[int, BlockSearch] = {}
+        for bi in cand_block_idxs(part):
+            if head.is_done():
+                raise QueryCancelled()
             bs = BlockSearch(part, bi)
             bs.ctx = ctx
             if batch or pool is not None:

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field as dc_field
 
 import os
+import threading
 
 import numpy as np
 
@@ -145,6 +146,25 @@ def device_plan(f) -> LeafPlan | None:
                         verify=not pure)
 
     return None
+
+
+def device_plans(f) -> list:
+    """All device-scannable leaf plans of a filter tree (prefetch uses the
+    same bloom tokens / fields the evaluator will)."""
+    out: list = []
+
+    def walk(g):
+        if isinstance(g, (F.FilterAnd, F.FilterOr)):
+            for sub in g.filters:
+                walk(sub)
+        elif isinstance(g, F.FilterNot):
+            walk(g.inner)
+        else:
+            plan = device_plan(g)
+            if plan is not None and (plan.ops or plan.pair):
+                out.append(plan)
+    walk(f)
+    return out
 
 
 def _contains_plan(f, require_all: bool) -> LeafPlan | None:
@@ -413,6 +433,75 @@ class BatchRunner:
         self.cpu_fallbacks = 0
         self.stats_dispatches = 0
         self.stats_shards = 1          # mesh runners stripe rows over >1
+        self._counter_mu = threading.Lock()
+        # striped staging locks: the prefetcher, concurrent partition
+        # workers and the scan thread may race to stage the same
+        # (part, field); the loser waits and takes the cache hit instead
+        # of duplicating a multi-100MB upload.  A fixed stripe pool keeps
+        # lock memory bounded across part churn (merges mint fresh uids).
+        self._stage_locks = [threading.Lock() for _ in range(64)]
+        from concurrent.futures import ThreadPoolExecutor
+        self._prefetch_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="vl-prefetch")
+
+    def _bump(self, attr: str, n: int = 1) -> None:
+        with self._counter_mu:
+            setattr(self, attr, getattr(self, attr) + n)
+
+    def _key_lock(self, key) -> threading.Lock:
+        return self._stage_locks[hash(key) % len(self._stage_locks)]
+
+    # ---- prefetch (stage part N+1 while part N scans) ----
+    def submit_prefetch(self, part, f, stats_spec=None,
+                        cand_bis=None) -> None:
+        """Queue background staging of what the query will need from
+        `part`, so the host decode/upload of the NEXT part overlaps the
+        device scans of the current one (SURVEY §7 hard-part 3).
+
+        Applies the SAME gates as _eval_leaf so prefetch never stages a
+        column the evaluator would skip: the bloom kill-path over the
+        candidate blocks, and the narrow-candidate heuristic (a small
+        candidate fraction takes the host path instead of staging).
+        cand_bis: candidate block idxs (after tenant/stream/time
+        pruning); None means every block is a candidate."""
+
+        def work():
+            try:
+                bis = list(cand_bis) if cand_bis is not None else \
+                    list(range(part.num_blocks))
+                for plan in device_plans(f):
+                    surv = bis
+                    if plan.bloom_tokens:
+                        hashes = hash_tokens(plan.bloom_tokens)
+                        surv = []
+                        for bi in bis:
+                            words = part.block_column_bloom(bi, plan.field)
+                            if words is not None and words.shape[0] and \
+                                    not bloom_contains_all(words, hashes):
+                                continue
+                            surv.append(bi)
+                    if not surv:
+                        continue
+                    cand_rows = sum(part.block_rows(bi) for bi in surv)
+                    if not self.cache.contains((part.uid, plan.field)) \
+                            and cand_rows * 8 < part.num_rows:
+                        continue  # evaluator will take the host path
+                    self.stage_part(part, plan.field)
+                if stats_spec is not None:
+                    from .stats_device import MAX_ABS_TIMES_ROWS, \
+                        MAX_BUCKETS, MAX_STAT_ROWS
+                    layout = self._stats_layout(part)
+                    if layout.nrows > MAX_STAT_ROWS:
+                        return
+                    for fld in stats_spec.value_fields:
+                        self._stage_numeric(part, fld, layout,
+                                            MAX_ABS_TIMES_ROWS)
+                    if stats_spec.by_time:
+                        self._stage_buckets(part, layout, stats_spec.step,
+                                            stats_spec.offset, MAX_BUCKETS)
+            except Exception:
+                pass  # prefetch is best-effort; the scan path re-stages
+        self._prefetch_pool.submit(work)
 
     # ---- device placement hook (MeshBatchRunner shards the row axis) ----
     def _put(self, arr):
@@ -429,18 +518,19 @@ class BatchRunner:
     # ---- staging (cached across queries; parts are immutable) ----
     def stage_part(self, part, field: str) -> StagedPart | None:
         key = (part.uid, field)
-        got = self.cache.get(key)
-        if got is _UNSTAGEABLE:
-            return None
-        if got is not None:
-            return got
-        spc = stage_part_column(part, field, self.max_part_bytes,
-                                put=self._put)
-        if spc is None:
-            self.cache.put_small(key, _UNSTAGEABLE)
-            return None
-        self.cache.put(key, spc)
-        return spc
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
+            if got is not None:
+                return got
+            spc = stage_part_column(part, field, self.max_part_bytes,
+                                    put=self._put)
+            if spc is None:
+                self.cache.put_small(key, _UNSTAGEABLE)
+                return None
+            self.cache.put(key, spc)
+            return spc
 
     # ---- per-block compatibility shim ----
     def apply_filter(self, f, bs: BlockSearch) -> np.ndarray:
@@ -496,7 +586,7 @@ class BatchRunner:
             return {bi: ~inner[bi] for bi in alive}
         plan = device_plan(f)
         if plan is None:
-            self.cpu_fallbacks += 1
+            self._bump("cpu_fallbacks")
             out = {}
             for bi in alive:
                 bm = np.ones(bss[bi].nrows, dtype=bool)
@@ -580,41 +670,44 @@ class BatchRunner:
 
     def _stats_layout(self, part) -> StatsLayout:
         key = (part.uid, "#layout")
-        got = self.cache.get(key)
-        if got is None:
-            got = part_stats_layout(part, shards=self.stats_shards)
-            self.cache.put_small(key, got)
-        return got
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                got = part_stats_layout(part, shards=self.stats_shards)
+                self.cache.put_small(key, got)
+            return got
 
     def _stage_numeric(self, part, field: str, layout: StatsLayout,
                        max_abs_times_rows: int):
         key = (part.uid, "#num", field)
-        got = self.cache.get(key)
-        if got is _UNSTAGEABLE:
-            return None
-        if got is None:
-            got = stage_numeric(part, field, layout, max_abs_times_rows,
-                                put=self._put)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
             if got is None:
-                self.cache.put_small(key, _UNSTAGEABLE)
-            else:
-                self.cache.put(key, got)
-        return got
+                got = stage_numeric(part, field, layout,
+                                    max_abs_times_rows, put=self._put)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
 
     def _stage_buckets(self, part, layout: StatsLayout, step: int,
                        offset: int, max_buckets: int):
         key = (part.uid, "#tb", step, offset)
-        got = self.cache.get(key)
-        if got is _UNSTAGEABLE:
-            return None
-        if got is None:
-            got = stage_time_buckets(part, layout, step, offset,
-                                     max_buckets, put=self._put)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
             if got is None:
-                self.cache.put_small(key, _UNSTAGEABLE)
-            else:
-                self.cache.put(key, got)
-        return got
+                got = stage_time_buckets(part, layout, step, offset,
+                                         max_buckets, put=self._put)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
 
     def run_part_stats(self, f, part, bss: dict, spec):
         """Filter + stats partials for one part.
@@ -687,8 +780,8 @@ class BatchRunner:
             counts = None
             stats_np = {}
             for fld in spec.value_fields:
-                self.device_calls += 1
-                self.stats_dispatches += 1
+                self._bump("device_calls")
+                self._bump("stats_dispatches")
                 packed = self._dispatch_stats_values(
                     numerics[fld].values, ids, mask_j, nb)
                 counts = packed[0]
@@ -707,8 +800,8 @@ class BatchRunner:
                                  if spec.by_time else 0, cnt, fs))
             return bms, handled, partials
 
-        self.device_calls += 1
-        self.stats_dispatches += 1
+        self._bump("device_calls")
+        self._bump("stats_dispatches")
         counts = self._dispatch_stats_count(ids, mask_j, nb)
         partials = [(base + int(idx) * spec.step if spec.by_time else 0,
                      int(counts[idx]), {})
@@ -721,7 +814,7 @@ class BatchRunner:
         a, b = pair
         if max(len(a), len(b)) >= spc.width:
             return np.zeros(spc.nrows, dtype=bool), None
-        self.device_calls += 1
+        self._bump("device_calls")
         definite, needs_verify = K.match_ordered_pair(
             spc.rows, spc.lengths,
             jnp.asarray(np.frombuffer(a, dtype=np.uint8)), len(a),
@@ -759,7 +852,7 @@ class BatchRunner:
             # no staged (truncated) value can contain it; overflow rows are
             # re-checked from the full values by the caller
             return np.zeros(spc.nrows, dtype=bool)
-        self.device_calls += 1
+        self._bump("device_calls")
         pat = jnp.asarray(np.frombuffer(op.pattern, dtype=np.uint8))
         res = K.match_scan(spc.rows, spc.lengths, pat, len(op.pattern),
                            op.mode, op.starts_tok, op.ends_tok)

@@ -103,23 +103,29 @@ def stage_string_column(arena_np: np.ndarray, offsets_np: np.ndarray,
 
 
 class StagingCache:
-    """LRU over staged columns, bounded by device bytes."""
+    """LRU over staged columns, bounded by device bytes.
+
+    Thread-safe: the prefetcher, concurrent partition scans and the query
+    thread all touch it (batch.py)."""
 
     def __init__(self, max_bytes: int = 4 << 30):
+        import threading
         self.max_bytes = max_bytes
         self._lru: OrderedDict[tuple, StagedStringColumn] = OrderedDict()
         self._bytes = 0
+        self._mu = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key: tuple):
-        got = self._lru.get(key)
-        if got is not None:
-            self._lru.move_to_end(key)
-            self.hits += 1
-        else:
-            self.misses += 1
-        return got
+        with self._mu:
+            got = self._lru.get(key)
+            if got is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return got
 
     @staticmethod
     def _cost(col) -> int:
@@ -129,13 +135,14 @@ class StagingCache:
         return col.device_bytes() if hasattr(col, "device_bytes") else 4096
 
     def put(self, key: tuple, col) -> None:
-        if key in self._lru:
-            return
-        self._lru[key] = col
-        self._bytes += self._cost(col)
-        while self._bytes > self.max_bytes and self._lru:
-            _, old = self._lru.popitem(last=False)
-            self._bytes -= self._cost(old)
+        with self._mu:
+            if key in self._lru:
+                return
+            self._lru[key] = col
+            self._bytes += self._cost(col)
+            while self._bytes > self.max_bytes and self._lru:
+                _, old = self._lru.popitem(last=False)
+                self._bytes -= self._cost(old)
 
     def put_small(self, key: tuple, marker) -> None:
         """Cache a marker (e.g. 'this column is unstageable')."""
@@ -143,8 +150,10 @@ class StagingCache:
 
     def contains(self, key: tuple) -> bool:
         """Membership probe without touching LRU order or hit counters."""
-        return key in self._lru
+        with self._mu:
+            return key in self._lru
 
     def clear(self) -> None:
-        self._lru.clear()
-        self._bytes = 0
+        with self._mu:
+            self._lru.clear()
+            self._bytes = 0
