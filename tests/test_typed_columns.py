@@ -1,0 +1,174 @@
+"""Typed lazy columns: numeric/dict columns flow type-encoded through
+stats with per-column header min/max short-circuits; strings materialize
+only at output (reference block_result.go:26-63,2149-2199)."""
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine import block_result as br_mod
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("typedstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(4000):
+        lr.add(TEN, T0 + i * NS, [
+            ("app", "web"),
+            ("_msg", f"m{i}"),
+            ("dur", str(i % 907)),            # uint column
+            ("ratio", f"{(i % 23) / 8}"),     # float column (23 distinct)
+            ("lvl", ["info", "warn", "error"][i % 3]),  # dict column
+        ])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+def test_sum_never_materializes_strings(storage, monkeypatch):
+    """`stats sum(dur)` must not build a Python string list for dur."""
+    calls = []
+    orig = br_mod.BlockResult.column
+
+    def spy(self, name):
+        if self._bs is not None:   # block-backed only; output rows don't count
+            calls.append(name)
+        return orig(self, name)
+    monkeypatch.setattr(br_mod.BlockResult, "column", spy)
+    rows = run_query_collect(storage, [TEN], "* | stats sum(dur) s",
+                             timestamp=T0)
+    assert rows[0]["s"] == str(sum(i % 907 for i in range(4000)))
+    assert "dur" not in calls
+
+
+def test_min_max_never_materialize_strings(storage, monkeypatch):
+    calls = []
+    orig = br_mod.BlockResult.column
+
+    def spy(self, name):
+        if self._bs is not None:   # block-backed only; output rows don't count
+            calls.append(name)
+        return orig(self, name)
+    monkeypatch.setattr(br_mod.BlockResult, "column", spy)
+    rows = run_query_collect(
+        storage, [TEN],
+        "* | stats min(dur) mn, max(dur) mx, min(ratio) rn, max(ratio) rx,"
+        " min(lvl) ln, max(lvl) lx",
+        timestamp=T0)
+    assert rows[0]["mn"] == "0"
+    assert rows[0]["mx"] == "906"
+    assert rows[0]["rn"] == "0.0"
+    assert rows[0]["rx"] == "2.75"
+    assert rows[0]["ln"] == "error"
+    assert rows[0]["lx"] == "warn"
+    assert "dur" not in calls
+    assert "ratio" not in calls
+    assert "lvl" not in calls  # dict min/max reduces over the code table
+
+
+def test_min_max_header_short_circuit_skips_decode(tmp_path, monkeypatch):
+    """Once the running min is strictly below a block's header min, that
+    block's column payload is never read (per-column min/max skip)."""
+    from victorialogs_tpu.storage import part as part_mod
+
+    # mint the two stream ids first: blocks sort by stream id, so give
+    # the FIRST block the global minimum to make the skip deterministic
+    probe = LogRows(stream_fields=["app"])
+    probe.add(TEN, T0, [("app", "aa"), ("_msg", "x")])
+    probe.add(TEN, T0, [("app", "bb"), ("_msg", "x")])
+    sid = {"aa": probe.stream_ids[0], "bb": probe.stream_ids[1]}
+    first, second = sorted(sid, key=lambda a: (sid[a].hi, sid[a].lo))
+
+    s = Storage(str(tmp_path / "skip"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        lr = LogRows(stream_fields=["app"])
+        for i in range(200):
+            lr.add(TEN, T0 + i * NS,
+                   [("app", first), ("_msg", "x"), ("dur", str(i))])
+        for i in range(200):
+            lr.add(TEN, T0 + i * NS,
+                   [("app", second), ("_msg", "x"),
+                    ("dur", str(500 + i))])
+        s.must_add_rows(lr)
+        s.debug_flush()
+
+        reads = []
+        orig = part_mod.Part.read_column
+
+        def spy(self, block_idx, ch):
+            reads.append(ch["n"])
+            return orig(self, block_idx, ch)
+        monkeypatch.setattr(part_mod.Part, "read_column", spy)
+        rows = run_query_collect(s, [TEN], "* | stats min(dur) mn",
+                                 timestamp=T0)
+        assert rows[0]["mn"] == "0"
+        # state after block 1 is 0 < 500 (block 2's header min): the
+        # second block's dur payload is never read
+        assert reads.count("dur") == 1
+    finally:
+        s.close()
+
+
+def test_dict_group_by_uses_codes(storage, monkeypatch):
+    """`count() by (lvl)` factorizes through stored dict codes without
+    materializing the lvl string column."""
+    calls = []
+    orig = br_mod.BlockResult.column
+
+    def spy(self, name):
+        if self._bs is not None:   # block-backed only; output rows don't count
+            calls.append(name)
+        return orig(self, name)
+    monkeypatch.setattr(br_mod.BlockResult, "column", spy)
+    rows = run_query_collect(storage, [TEN],
+                             "* | stats by (lvl) count() c", timestamp=T0)
+    got = {r["lvl"]: r["c"] for r in rows}
+    assert got == {"info": "1334", "warn": "1333", "error": "1333"}
+    assert "lvl" not in calls
+
+
+def test_typed_paths_match_string_paths(storage):
+    """Mixed-encoding differential: forcing the string path (via a
+    transform that materializes) gives identical results."""
+    for qs, qs2 in [
+        ("* | stats min(dur) a, max(dur) b",
+         "* | copy dur durx | stats min(durx) a, max(durx) b"),
+        ("* | stats by (lvl) count() c",
+         "* | copy lvl lvlx | stats by (lvlx) count() c"),
+    ]:
+        r1 = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        r2 = run_query_collect(storage, [TEN], qs2, timestamp=T0)
+        v1 = sorted(tuple(sorted(r.values())) for r in r1)
+        v2 = sorted(tuple(sorted(r.values())) for r in r2)
+        assert v1 == v2, qs
+
+
+def test_uint64_min_max_no_wrap(tmp_path):
+    """uint64 values >= 2**63 must not wrap through the typed path."""
+    s = Storage(str(tmp_path / "u64"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        lr = LogRows(stream_fields=["app"])
+        big = 18446744073709551615  # 2**64 - 1
+        for i in range(100):
+            lr.add(TEN, T0 + i * NS,
+                   [("app", "a"), ("_msg", "x"),
+                    ("big", str(big - (i % 7)))])
+        s.must_add_rows(lr)
+        s.debug_flush()
+        rows = run_query_collect(
+            s, [TEN], "* | stats min(big) mn, max(big) mx", timestamp=T0)
+        assert rows[0]["mx"] == str(big)
+        assert rows[0]["mn"] == str(big - 6)
+    finally:
+        s.close()

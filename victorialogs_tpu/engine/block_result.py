@@ -151,6 +151,66 @@ class BlockResult:
             return None
         return col.nums[self._sel].astype(np.float64)
 
+    def typed_numeric(self, name: str):
+        """(selected values array, is_int) for a uint/int/float column, or
+        None.  Unlike numeric_column, int columns keep their native
+        integer dtype so consumers can regenerate the exact canonical
+        stored strings (round-trip encodings — values_encoder.py) without
+        ever materializing a Python string list
+        (block_result.go:2149-2199)."""
+        if self._bs is None or name in self._cols:
+            return None
+        from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
+                                              VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64)
+        if name in self._bs.consts() or name in ("_time", "_stream",
+                                                 "_stream_id"):
+            return None
+        col = self._bs.column(name)
+        if col is None:
+            return None
+        if col.vtype in (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64,
+                         VT_INT64):
+            # native dtype: an int64 cast would wrap uint64 values >= 2**63
+            return col.nums[self._sel], True
+        if col.vtype == VT_FLOAT64:
+            return col.nums[self._sel], False
+        return None
+
+    def dict_column(self, name: str):
+        """(selected dict ids uint8, dict value strings) for a
+        dict-encoded column, or None — lets group-by factorize through
+        the stored codes without materializing a per-row string list."""
+        if self._bs is None or name in self._cols:
+            return None
+        from ..storage.values_encoder import VT_DICT
+        if name in self._bs.consts() or name in ("_time", "_stream",
+                                                 "_stream_id"):
+            return None
+        col = self._bs.column(name)
+        if col is None or col.vtype != VT_DICT:
+            return None
+        return col.ids[self._sel], col.dict_values
+
+    def header_min_max(self, name: str):
+        """(min, max) of a numeric column from the BLOCK HEADER — no
+        column payload read/decode (reference per-column min/max skips,
+        block_result.go:26-63).  None for non-numeric/absent columns."""
+        if self._bs is None or name in self._cols:
+            return None
+        from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
+                                              VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64)
+        meta = self._bs.column_meta(name)
+        if meta is None or meta.get("t") not in (
+                VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64, VT_INT64,
+                VT_FLOAT64):
+            return None
+        mn, mx = meta.get("min"), meta.get("max")
+        if mn is None or mx is None:
+            return None
+        return float(mn), float(mx)
+
     def column_names(self) -> list[str]:
         names: dict[str, None] = {}
         if self._bs is not None:
@@ -175,7 +235,11 @@ class BlockResult:
         """Detach from the underlying block (copy out the needed columns)."""
         names = fields if fields is not None else self.column_names()
         cols = {n: self.column(n) for n in names}
-        return BlockResult.from_columns(cols, self.timestamps)
+        out = BlockResult.from_columns(cols, self.timestamps)
+        # a needed-columns restriction can leave zero columns while rows
+        # still exist (e.g. copy/rename rebuilding them); keep the count
+        out.nrows = self.nrows
+        return out
 
     def filter_rows(self, mask: np.ndarray) -> "BlockResult":
         keep = np.nonzero(mask)[0]

@@ -313,20 +313,26 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             out.append(bi)
         return out
 
+    next_bis: list | None = None
     for pi, part in enumerate(parts):
         if deadline is not None and time.monotonic() > deadline:
             raise QueryTimeoutError(
                 "query exceeded -search.maxQueryDuration")
+        part_bis = next_bis if next_bis is not None \
+            else cand_block_idxs(part)
+        next_bis = None
         if batch and pi + 1 < len(parts):
             # double-buffer: stage part N+1 (host decode + upload) while
             # the device scans part N (SURVEY §7 hard-part 3); the
             # prefetcher applies the evaluator's own bloom/narrowness
-            # gates over the same candidate set
+            # gates over the same candidate set (carried forward so the
+            # header walk isn't repeated when the part is scanned)
             nxt = parts[pi + 1]
+            next_bis = cand_block_idxs(nxt)
             runner.submit_prefetch(nxt, q.filter, stats_spec,
-                                   cand_bis=cand_block_idxs(nxt))
+                                   cand_bis=next_bis)
         cand: dict[int, BlockSearch] = {}
-        for bi in cand_block_idxs(part):
+        for bi in part_bis:
             if head.is_done():
                 raise QueryCancelled()
             bs = BlockSearch(part, bi)

@@ -187,6 +187,18 @@ class PipeCopy(Pipe):
     def can_live_tail(self):
         return True
 
+    def input_fields(self, out_needed):
+        # a needed dst maps back to its src (the copy produces dst);
+        # srcs also pass through unchanged
+        if "*" in out_needed:
+            return out_needed
+        out = set(out_needed)
+        for s, d in reversed(self.pairs):
+            if d in out:
+                out.discard(d)
+                out.add(s)
+        return out
+
     def make_processor(self, next_p):
         pairs = self.pairs
 
@@ -210,6 +222,20 @@ class PipeRename(Pipe):
 
     def can_live_tail(self):
         return True
+
+    def input_fields(self, out_needed):
+        # dst maps back to src; the src name itself no longer exists
+        # downstream (rename removes it), so it is only needed via dst
+        if "*" in out_needed:
+            return out_needed
+        out = set(out_needed)
+        for s, d in reversed(self.pairs):
+            if d in out:
+                out.discard(d)
+                out.add(s)
+            else:
+                out.discard(s)
+        return out
 
     def make_processor(self, next_p):
         pairs = self.pairs
@@ -747,16 +773,21 @@ class PipeStats(Pipe):
                 for fn in pipe.funcs:
                     fn.budget = self.budget
 
-            def _key_columns(self, br):
+            def _key_columns(self, br, skip=()):
                 """Per-row group-key value lists (bucketing applied).
 
                 _time:step buckets vectorize over the int64 timestamps —
                 only distinct buckets pay string formatting (the per-row
-                Python path was the hits-endpoint hot loop)."""
+                Python path was the hits-endpoint hot loop).
+                skip: by-field indices the caller handles itself (dict
+                codes) — their slot is None, nothing materializes."""
                 n = br.nrows
                 ts = br.timestamps
                 key_cols = []
-                for b in pipe.by:
+                for ci, b in enumerate(pipe.by):
+                    if ci in skip:
+                        key_cols.append(None)
+                        continue
                     if b.bucket and b.name == "_time" and ts is not None \
                             and b.bucket.lower() not in ("week", "month",
                                                          "year"):
@@ -801,27 +832,44 @@ class PipeStats(Pipe):
                     for k in range(len(pipe.funcs)):
                         states[k] += n
                     return True
-                key_cols = self._key_columns(br)
+                # dict-encoded by-columns factorize through their stored
+                # codes — no per-row Python, no string materialization
+                # (typed lazy columns, block_result.go:26-63)
+                dict_cols = {}
+                for ci, b in enumerate(pipe.by):
+                    if not b.bucket and hasattr(br, "dict_column"):
+                        dc = br.dict_column(b.name)
+                        if dc is not None:
+                            dict_cols[ci] = dc
+                key_cols = self._key_columns(br, skip=dict_cols)
                 # factorize each key column; bail to the generic path when
                 # the dense code space would blow up (multiple
                 # high-cardinality by-fields)
                 codes = np.zeros(n, dtype=np.int64)
                 uniques_per_col = []
                 stride = 1
-                for vals in key_cols:
-                    mapping: dict = {}
-                    col_codes = np.empty(n, dtype=np.int64)
-                    for i, v in enumerate(vals):
-                        c = mapping.get(v)
-                        if c is None:
-                            c = mapping[v] = len(mapping)
-                        col_codes[i] = c
-                    stride *= max(len(mapping), 1)
+                for ci in range(len(pipe.by)):
+                    if ci in dict_cols:
+                        ids, dvals = dict_cols[ci]
+                        nuniq = len(dvals)
+                        col_codes = ids.astype(np.int64)
+                        uniq_map = dict(enumerate(dvals))
+                    else:
+                        vals = key_cols[ci]
+                        mapping: dict = {}
+                        col_codes = np.empty(n, dtype=np.int64)
+                        for i, v in enumerate(vals):
+                            c = mapping.get(v)
+                            if c is None:
+                                c = mapping[v] = len(mapping)
+                            col_codes[i] = c
+                        nuniq = len(mapping)
+                        uniq_map = {c: v for v, c in mapping.items()}
+                    stride *= max(nuniq, 1)
                     if stride > max(4 * n, 1 << 16):
                         return False
-                    codes = codes * len(mapping) + col_codes
-                    uniques_per_col.append(
-                        {c: v for v, c in mapping.items()})
+                    codes = codes * max(nuniq, 1) + col_codes
+                    uniques_per_col.append(uniq_map)
                 counts = np.bincount(codes, minlength=0)
                 for code in np.nonzero(counts)[0]:
                     cnt = int(counts[code])

@@ -210,15 +210,98 @@ def _num_or_str_less(a: str, b: str) -> bool:
     return a < b
 
 
+class _LazyMinMaxCol:
+    """Deferred typed view for min/max: numeric columns consult the block
+    HEADER min/max first and decode only when the block can actually
+    improve the running state; dict columns reduce over their (<=8)
+    distinct values via the stored codes.  Either way no per-row string
+    list materializes (reference typed columns with per-column min/max
+    skips — block_result.go:26-63,2149-2199).
+
+    Numeric soundness: uint/int/float encodings are round-trip exact, so
+    numeric selection maps back to the same strings the per-row path
+    would pick, and equal numbers can't be distinct strings."""
+    __slots__ = ("br", "name", "is_dict")
+
+    def __init__(self, br, name, is_dict: bool):
+        self.br = br
+        self.name = name
+        self.is_dict = is_dict
+
+    def candidate(self, idxs, want_min: bool) -> str | None:
+        """Extreme among the selected rows as the stored string."""
+        import numpy as np
+        if self.is_dict:
+            ids, dvals = self.br.dict_column(self.name)
+            sub = ids if len(idxs) == ids.shape[0] else ids[idxs]
+            if not sub.size:
+                return None
+            best = None
+            for j in np.unique(sub):
+                v = dvals[j]
+                if v == "":
+                    continue  # empty string == absent field
+                if best is None or (
+                        _num_or_str_less(v, best) if want_min
+                        else _num_or_str_less(best, v)):
+                    best = v
+            return best
+        tn = self.br.typed_numeric(self.name)
+        if tn is None:  # pragma: no cover - gated by header_min_max
+            return None
+        arr, is_int = tn
+        sub = arr if len(idxs) == arr.shape[0] else arr[idxs]
+        if not sub.size:
+            return None
+        m = sub.min() if want_min else sub.max()
+        if is_int:
+            return str(int(m))
+        from ..storage.values_encoder import _format_floats
+        return str(_format_floats(np.array([m]))[0])
+
+
+def _min_max_block_cols(fn, br):
+    out = []
+    for f in fn.fields:
+        if hasattr(br, "header_min_max"):
+            if br.header_min_max(f) is not None:
+                out.append(_LazyMinMaxCol(br, f, is_dict=False))
+                continue
+            if br.dict_column(f) is not None:
+                out.append(_LazyMinMaxCol(br, f, is_dict=True))
+                continue
+        out.append(br.column(f))
+    return out
+
+
 class StatsMin(StatsFunc):
     name = "min"
 
     def new_state(self):
         return None
 
+    def block_cols(self, br):
+        return _min_max_block_cols(self, br)
+
     def update(self, state, cols, idxs):
         best = state
         for c in cols:
+            if isinstance(c, _LazyMinMaxCol):
+                if not c.is_dict and best is not None:
+                    hdr = c.br.header_min_max(c.name)
+                    fb = parse_number(best)
+                    # the block header min bounds any row subset: once the
+                    # state is strictly below it, this block can't improve
+                    # the min and the column is never read/decoded.
+                    # STRICT compare: numeric ties must decode so the
+                    # string tiebreak (_num_or_str_less) stays authoritative
+                    if not math.isnan(fb) and fb < hdr[0]:
+                        continue
+                got = c.candidate(idxs, want_min=True)
+                if got is not None and (best is None or
+                                        _num_or_str_less(got, best)):
+                    best = got
+                continue
             for i in idxs:
                 v = c[i]
                 if v == "":
@@ -244,6 +327,18 @@ class StatsMax(StatsMin):
     def update(self, state, cols, idxs):
         best = state
         for c in cols:
+            if isinstance(c, _LazyMinMaxCol):
+                if not c.is_dict and best is not None:
+                    hdr = c.br.header_min_max(c.name)
+                    fb = parse_number(best)
+                    # strict for the same tie reason as min
+                    if not math.isnan(fb) and fb > hdr[1]:
+                        continue
+                got = c.candidate(idxs, want_min=False)
+                if got is not None and (best is None or
+                                        _num_or_str_less(best, got)):
+                    best = got
+                continue
             for i in idxs:
                 v = c[i]
                 if v == "":

@@ -440,13 +440,29 @@ class BatchRunner:
         # of duplicating a multi-100MB upload.  A fixed stripe pool keeps
         # lock memory bounded across part churn (merges mint fresh uids).
         self._stage_locks = [threading.Lock() for _ in range(64)]
-        from concurrent.futures import ThreadPoolExecutor
-        self._prefetch_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="vl-prefetch")
+        self._prefetch_pool = None  # lazy; see _prefetcher()
 
     def _bump(self, attr: str, n: int = 1) -> None:
         with self._counter_mu:
             setattr(self, attr, getattr(self, attr) + n)
+
+    def _prefetcher(self):
+        """Lazily create the single prefetch worker (double-checked under
+        the counter lock: partition workers may race here)."""
+        if self._prefetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            with self._counter_mu:
+                if self._prefetch_pool is None:
+                    self._prefetch_pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="vl-prefetch")
+        return self._prefetch_pool
+
+    def close(self) -> None:
+        """Release the prefetch worker (callers owning a per-query runner
+        should close it; the long-lived server runner never needs to)."""
+        if self._prefetch_pool is not None:
+            self._prefetch_pool.shutdown(wait=False)
+            self._prefetch_pool = None
 
     def _key_lock(self, key) -> threading.Lock:
         return self._stage_locks[hash(key) % len(self._stage_locks)]
@@ -501,7 +517,7 @@ class BatchRunner:
                                             stats_spec.offset, MAX_BUCKETS)
             except Exception:
                 pass  # prefetch is best-effort; the scan path re-stages
-        self._prefetch_pool.submit(work)
+        self._prefetcher().submit(work)
 
     # ---- device placement hook (MeshBatchRunner shards the row axis) ----
     def _put(self, arr):
