@@ -1,0 +1,79 @@
+"""Stream-index scale proof: 1M streams/partition (VERDICT r2 item 6).
+
+Measures registration throughput, compaction time, snapshot reopen time,
+RSS, and query latency at N streams.  Run: python tools/bench_indexdb.py
+[N].  Results recorded in PERF.md."""
+
+import os
+import resource
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from victorialogs_tpu.storage.indexdb import IndexDB  # noqa: E402
+from victorialogs_tpu.storage.log_rows import StreamID, TenantID  # noqa
+from victorialogs_tpu.storage.stream_filter import (StreamFilter,  # noqa
+                                                    TagFilter)
+from victorialogs_tpu.utils.hashing import stream_id_hash  # noqa
+
+
+def rss_mb() -> float:
+    """CURRENT resident set (statm), not the ru_maxrss high-water mark —
+    compaction spikes would otherwise mask the steady-state footprint."""
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e6
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    ten = TenantID(0, 0)
+    d = tempfile.mkdtemp(prefix="idxbench")
+    db = IndexDB(d)
+    t0 = time.time()
+    batch = []
+    for i in range(n):
+        tags = f'{{app="app{i % 1000}",host="h{i}",dc="dc{i % 4}"}}'
+        hi, lo = stream_id_hash(tags.encode())
+        batch.append((StreamID(ten, hi, lo), tags))
+        if len(batch) == 20000:
+            db.must_register_streams(batch)
+            batch = []
+    if batch:
+        db.must_register_streams(batch)
+    reg_s = time.time() - t0
+    print(f"register {n}: {reg_s:.1f}s ({n / reg_s:,.0f}/s), "
+          f"rss {rss_mb():.0f}MB")
+    t0 = time.time()
+    db.close()
+    print(f"close (compaction -> snapshot): {time.time() - t0:.1f}s")
+    snap = os.path.join(d, "streams.snap")
+    log = os.path.join(d, "streams.jsonl")
+    print(f"snapshot {os.path.getsize(snap) / 1e6:.1f}MB, "
+          f"log {os.path.getsize(log) / 1e6:.1f}MB")
+
+    t0 = time.time()
+    db2 = IndexDB(d)
+    open_s = time.time() - t0
+    print(f"reopen from snapshot: {open_s:.2f}s, rss {rss_mb():.0f}MB")
+    assert db2.num_streams() == n
+
+    def q(label, op, value):
+        sf = StreamFilter(((TagFilter(label, op, value),),))
+        t0 = time.time()
+        ids = db2.search_stream_ids([ten], sf)
+        return len(ids), (time.time() - t0) * 1e3
+
+    for label, op, value in [("app", "=", "app7"), ("host", "=", "h500"),
+                             ("dc", "=~", "dc[01]"),
+                             ("app", "!=", "app3")]:
+        cnt, ms = q(label, op, value)
+        print(f"query {{{label}{op}\"{value}\"}}: {cnt} ids, {ms:.0f}ms")
+    print(f"final rss {rss_mb():.0f}MB")
+    db2.close()
+
+
+if __name__ == "__main__":
+    main()

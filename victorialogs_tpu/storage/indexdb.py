@@ -2,17 +2,29 @@
 
 Indexes *stream labels only* (never message content), like the reference's
 mergeset-backed indexdb (lib/logstorage/indexdb.go:20-31): it answers
-"which streamIDs in this partition match `{label=...}`" and "what are the tags
-of streamID X".
+"which streamIDs in this partition match `{label=...}`" and "what are the
+tags of streamID X".
 
 The reference stores three key namespaces in an LSM mergeset table —
 streamID registry, streamID->tags, and (tag,value)->streamIDs posting lists
-(indexdb.go:20-31, 182-307).  Our representation keeps all three: an
-append-only registration log (`streams.jsonl`) hydrated at open into the
-registry plus in-memory inverted postings, so `{app="x"}` resolves in
-O(matching streams) via set intersection instead of re-parsing every
-stream's tags.  Results are memoized in the filter cache (indexdb.go:55-57),
-invalidated on registrations.
+(indexdb.go:20-31, 182-307).  This implementation keeps the same namespaces
+in a two-level structure shaped like a single-level mergeset:
+
+- an immutable columnar SNAPSHOT (`streams.snap` — stream_snapshot.py):
+  sorted numpy arrays with binary-searched registry lookups and lazy
+  per-(label,value) posting materialization.  Reopen is a bulk load, not a
+  replay; memory is tens of bytes per stream, not a Python set forest.
+- a mutable TAIL: streams registered since the snapshot, held in dicts/
+  sets exactly as before, backed by the append-only `streams.jsonl` log
+  (fsynced before rows become durable — the register-before-rows
+  invariant partition.py relies on).
+- compaction merges snapshot+tail into a fresh snapshot at close (and
+  after a reopen that replayed a large tail), the analogue of a mergeset
+  background merge with the per-day partition lifecycle doing the
+  scheduling.
+
+Query results are memoized in the two-generation filter cache
+(indexdb.go:55-57), invalidated on registrations.
 """
 
 from __future__ import annotations
@@ -23,8 +35,17 @@ import threading
 
 from .log_rows import StreamID, TenantID
 from .stream_filter import StreamFilter, _compiled, parse_stream_tags
+from .stream_snapshot import StreamSnapshot, write_snapshot
 
 STREAMS_FILENAME = "streams.jsonl"
+SNAPSHOT_FILENAME = "streams.snap"
+
+# compact when the replayed/accumulated tail exceeds this many streams
+SNAPSHOT_MIN_TAIL = 10_000
+# background-compact a LIVE index once its mutable tail reaches this size:
+# bounds tail RAM (~1KB/stream of Python dict+set structure) regardless of
+# daily stream cardinality; the snapshot side is ~100B/stream of numpy
+COMPACT_TAIL_STREAMS = 250_000
 
 
 class IndexDB:
@@ -32,26 +53,42 @@ class IndexDB:
         self.path = path
         os.makedirs(path, exist_ok=True)
         self._lock = threading.Lock()
-        # streamID -> canonical tags string
+        # ---- tail (post-snapshot registrations) ----
         self._streams: dict[StreamID, str] = {}
-        # tenant -> list[StreamID] for tenant-scoped scans
         self._by_tenant: dict[TenantID, list[StreamID]] = {}
-        # inverted postings: tenant -> label -> value -> set[StreamID]
-        # (the (tag,value)->streamIDs namespace — indexdb.go:20-31)
         self._postings: dict[TenantID, dict[str, dict[str, set]]] = {}
-        # tenant -> label -> set[StreamID] having the label at all
         self._label_any: dict[TenantID, dict[str, set]] = {}
-        # two-generation rotating result cache (reference cache.go:13-58,
-        # filterStreamCache — indexdb.go:55-57)
         from ..utils.cache import TwoGenCache
         self._filter_cache = TwoGenCache()
+        # bumped on every registration and snapshot swap: queries that
+        # evaluated against an older generation must not poison the cache
+        self._gen = 0
         self._file_path = os.path.join(path, STREAMS_FILENAME)
+        self._snap_path = os.path.join(path, SNAPSHOT_FILENAME)
+        self._snap: StreamSnapshot | None = None
+        if os.path.exists(self._snap_path):
+            try:
+                self._snap = StreamSnapshot(self._snap_path)
+            except Exception:
+                self._snap = None  # torn snapshot: full log replay below
+        replay_from = self._snap.log_offset if self._snap is not None else 0
         if os.path.exists(self._file_path):
-            self._load()
+            if replay_from > os.path.getsize(self._file_path):
+                # log shrank behind the snapshot (manual tampering):
+                # distrust the snapshot entirely
+                self._snap = None
+                replay_from = 0
+            self._load(replay_from)
         self._file = open(self._file_path, "a", buffering=1 << 16)
+        self._compact_thread: threading.Thread | None = None
+        if len(self._streams) >= SNAPSHOT_MIN_TAIL:
+            # pay compaction once now so every later open is a bulk load
+            self._write_snapshot_locked()
 
-    def _load(self) -> None:
+    def _load(self, offset: int) -> None:
         with open(self._file_path) as f:
+            if offset:
+                f.seek(offset)
             for line in f:
                 line = line.strip()
                 if not line:
@@ -62,6 +99,8 @@ class IndexDB:
                     continue  # torn tail write after crash: ignore
                 sid = StreamID(TenantID(rec["a"], rec["p"]),
                                rec["h"], rec["l"])
+                if self._snap is not None and self._snap.find(sid) >= 0:
+                    continue
                 self._register_mem(sid, rec["t"])
 
     def _register_mem(self, sid: StreamID, tags_str: str) -> None:
@@ -75,10 +114,88 @@ class IndexDB:
             postings.setdefault(label, {}).setdefault(value, set()).add(sid)
             label_any.setdefault(label, set()).add(sid)
 
+    # ---- compaction ----
+    @staticmethod
+    def _merged_streams(snap: StreamSnapshot | None,
+                        tail: dict) -> dict[StreamID, str]:
+        """Snapshot + tail as one map (compaction input).  Decodes the
+        whole snapshot into Python objects — an array-level merge
+        (concat + searchsorted over the already-sorted columns) would
+        avoid that and is the next optimization if compaction cost ever
+        matters more than the ~2x write amplification documented in
+        PERF.md."""
+        out: dict[StreamID, str] = {}
+        if snap is not None:
+            for i in range(snap.n):
+                out[snap.stream_at(i)] = snap.tags_at(i)
+        out.update(tail)
+        return out
+
+    def _all_streams_map(self) -> dict[StreamID, str]:
+        return self._merged_streams(self._snap, self._streams)
+
+    def _write_snapshot_locked(self) -> None:
+        self._file.flush()
+        log_size = os.path.getsize(self._file_path) \
+            if os.path.exists(self._file_path) else 0
+        write_snapshot(self._snap_path, self._all_streams_map(), log_size)
+        self._snap = StreamSnapshot(self._snap_path)
+        self._streams.clear()
+        self._by_tenant.clear()
+        self._postings.clear()
+        self._label_any.clear()
+        self._filter_cache.clear()
+
+    def _maybe_compact_async(self) -> None:
+        """Kick off a background compaction when the tail is large.
+
+        The analogue of a mergeset background merge: a frozen copy of the
+        tail merges with the current snapshot into a fresh snapshot file
+        OUTSIDE the lock (ingest and queries continue against the old
+        levels), then the levels swap under the lock."""
+        if self._compact_thread is not None and \
+                self._compact_thread.is_alive():
+            return
+        frozen = dict(self._streams)
+        old_snap = self._snap
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        log_size = os.path.getsize(self._file_path)
+
+        def work():
+            write_snapshot(self._snap_path,
+                           self._merged_streams(old_snap, frozen),
+                           log_size)
+            new_snap = StreamSnapshot(self._snap_path)
+            with self._lock:
+                self._snap = new_snap
+                self._gen += 1
+                remaining = {sid: tags
+                             for sid, tags in self._streams.items()
+                             if sid not in frozen}
+                self._streams.clear()
+                self._by_tenant.clear()
+                self._postings.clear()
+                self._label_any.clear()
+                for sid, tags in remaining.items():
+                    self._register_mem(sid, tags)
+                self._filter_cache.clear()
+
+        self._compact_thread = threading.Thread(
+            target=work, daemon=True, name="vl-idx-compact")
+        self._compact_thread.start()
+
     def close(self) -> None:
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            t.join()
         with self._lock:
             self._file.flush()
             self._file.close()
+            if len(self._streams) >= SNAPSHOT_MIN_TAIL:
+                log_size = os.path.getsize(self._file_path)
+                write_snapshot(self._snap_path, self._all_streams_map(),
+                               log_size)
 
     def flush(self) -> None:
         with self._lock:
@@ -88,7 +205,8 @@ class IndexDB:
     # ---- write path ----
     def has_stream_id(self, sid: StreamID) -> bool:
         with self._lock:
-            return sid in self._streams
+            return sid in self._streams or (
+                self._snap is not None and self._snap.find(sid) >= 0)
 
     def must_register_stream(self, sid: StreamID, tags_str: str) -> None:
         self.must_register_streams([(sid, tags_str)])
@@ -101,7 +219,9 @@ class IndexDB:
         with self._lock:
             wrote = False
             for sid, tags_str in streams:
-                if sid in self._streams:
+                if sid in self._streams or (
+                        self._snap is not None and
+                        self._snap.find(sid) >= 0):
                     continue
                 self._register_mem(sid, tags_str)
                 self._file.write(json.dumps({
@@ -114,14 +234,24 @@ class IndexDB:
                 os.fsync(self._file.fileno())
                 # registrations invalidate cached filter results
                 self._filter_cache.clear()
+                self._gen += 1
+                if len(self._streams) >= COMPACT_TAIL_STREAMS:
+                    self._maybe_compact_async()
 
     # ---- read path ----
     def get_stream_tags(self, sid: StreamID) -> str | None:
         with self._lock:
-            return self._streams.get(sid)
+            got = self._streams.get(sid)
+            if got is not None:
+                return got
+            if self._snap is not None:
+                i = self._snap.find(sid)
+                if i >= 0:
+                    return self._snap.tags_at(i)
+            return None
 
-    def _match_tag_filter(self, tenant: TenantID, tf, all_sids: set) -> set:
-        """Exact stream set for ONE tag filter via the inverted postings.
+    def _match_tail(self, tenant: TenantID, tf, all_sids: set) -> set:
+        """Tail-level match for ONE tag filter over the in-memory sets.
 
         Semantics match TagFilter.matches over tags.get(label, ""): absent
         labels read as the empty string, so negations and empty-matching
@@ -147,42 +277,138 @@ class IndexDB:
             return hit
         return all_sids - hit                      # '!~'
 
+    @staticmethod
+    def _match_snap(snap: StreamSnapshot, tenant: TenantID,
+                    tf) -> "np.ndarray":
+        """Snapshot-level match for ONE tag filter, entirely in sorted
+        uint32 index space — StreamID objects materialize only for FINAL
+        results (the mergeset analogue: binary-searched posting slices).
+        Static over an explicit snapshot: it runs OUTSIDE the index lock
+        (snapshots are immutable), so multi-second broad queries never
+        stall ingestion."""
+        import numpy as np
+        s, e = snap.tenant_range(tenant)
+        all_idx = None
+
+        def universe():
+            nonlocal all_idx
+            if all_idx is None:
+                all_idx = np.arange(s, e, dtype=np.uint32)
+            return all_idx
+
+        lp = snap.label_postings(tenant, tf.label)
+        empty = np.empty(0, dtype=np.uint32)
+        any_idx = lp.any_idx if lp is not None else empty
+        if tf.op == "=":
+            if tf.value == "":
+                return np.setdiff1d(universe(), any_idx,
+                                    assume_unique=True)
+            return lp.lookup(tf.value) if lp is not None else empty
+        if tf.op == "!=":
+            if tf.value == "":
+                return any_idx
+            miss = lp.lookup(tf.value) if lp is not None else empty
+            return np.setdiff1d(universe(), miss, assume_unique=True)
+        rx = _compiled(tf.value)
+        hits = []
+        if lp is not None:
+            for value, idxs in lp.items():
+                if rx.fullmatch(value) is not None:
+                    hits.append(idxs)
+        hit = np.unique(np.concatenate(hits)) if hits else empty
+        if rx.fullmatch("") is not None:
+            hit = np.union1d(hit, np.setdiff1d(universe(), any_idx,
+                                               assume_unique=True))
+        if tf.op == "=~":
+            return hit
+        return np.setdiff1d(universe(), hit, assume_unique=True)  # '!~'
+
+    def _tail_all(self, tenant: TenantID) -> set:
+        return set(self._by_tenant.get(tenant, ()))
+
     def search_stream_ids(self, tenants: list[TenantID],
                           sf: StreamFilter) -> list[StreamID]:
+        import heapq
+
+        import numpy as np
         key = (tuple(tenants), sf)
+        # phase 1 (locked): cache probe + TAIL evaluation (tail sets are
+        # mutable but small — bounded by COMPACT_TAIL_STREAMS)
         with self._lock:
             cached = self._filter_cache.get(key)
             if cached is not None:
                 return cached
+            gen = self._gen
+            snap = self._snap
             result: set[StreamID] = set()
             for t in tenants:
-                all_sids = set(self._by_tenant.get(t, ()))
-                if not all_sids:
+                tail_all = self._tail_all(t)
+                if not tail_all:
                     continue
                 for grp in sf.or_groups:
-                    # '=' filters first: cheapest and most selective
-                    ordered = sorted(
-                        grp, key=lambda tf: 0 if tf.op == "=" else
-                        1 if tf.op == "=~" else 2)
+                    ordered = self._ordered(grp)
                     cand: set | None = None
                     for tf in ordered:
-                        s = self._match_tag_filter(t, tf, all_sids)
-                        cand = s if cand is None else cand & s
+                        m = self._match_tail(t, tf, tail_all)
+                        cand = m if cand is None else cand & m
                         if not cand:
                             break
-                    result |= cand if cand is not None else all_sids
-            out = sorted(result)
-            self._filter_cache.put(key, out)
-            return out
+                    result |= cand if cand is not None else tail_all
+        # phase 2 (UNLOCKED): snapshot evaluation + materialization —
+        # the snapshot is immutable, so broad multi-second queries never
+        # stall ingestion or other queries
+        snap_result = np.empty(0, dtype=np.uint32)
+        if snap is not None:
+            for t in tenants:
+                s, e = snap.tenant_range(t)
+                if s == e:
+                    continue
+                for grp in sf.or_groups:
+                    scand: np.ndarray | None = None
+                    for tf in self._ordered(grp):
+                        m = self._match_snap(snap, t, tf)
+                        scand = m if scand is None else \
+                            np.intersect1d(scand, m, assume_unique=True)
+                        if not scand.size:
+                            break
+                    if scand is None:
+                        scand = np.arange(s, e, dtype=np.uint32)
+                    snap_result = np.union1d(snap_result, scand)
+        # snapshot rows are stored sorted by (tenant, hi, lo) — the same
+        # order StreamID sorts by — so ascending indices are already
+        # sorted; merge with the sorted tail instead of re-sorting
+        snap_list = snap.streams_at(snap_result) if snap_result.size \
+            else []
+        out = list(heapq.merge(sorted(result), snap_list))
+        with self._lock:
+            if self._gen == gen:  # no registration/swap raced us
+                self._filter_cache.put(key, out)
+        return out
+
+    @staticmethod
+    def _ordered(grp):
+        # '=' filters first: cheapest and most selective
+        return sorted(grp, key=lambda tf: 0 if tf.op == "=" else
+                      1 if tf.op == "=~" else 2)
 
     def all_stream_ids(self, tenants: list[TenantID]) -> list[StreamID]:
+        import numpy as np
         with self._lock:
+            snap = self._snap
             out: list[StreamID] = []
             for t in tenants:
-                out.extend(self._by_tenant.get(t, ()))
-            out.sort()
-            return out
+                out.extend(self._tail_all(t))
+        # snapshot materialization outside the lock (immutable)
+        if snap is not None:
+            for t in tenants:
+                s, e = snap.tenant_range(t)
+                if s != e:
+                    out.extend(snap.streams_at(
+                        np.arange(s, e, dtype=np.uint32)))
+        out.sort()
+        return out
 
     def num_streams(self) -> int:
         with self._lock:
-            return len(self._streams)
+            return len(self._streams) + \
+                (self._snap.n if self._snap is not None else 0)
