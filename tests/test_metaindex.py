@@ -1,0 +1,110 @@
+"""Two-level part index (format v2): open parses only the metaindex,
+header groups decode lazily, time-range candidate selection skips whole
+groups, and v1 parts stay readable (index_block_header.go analogue)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.storage.block import build_block_from_columns
+from victorialogs_tpu.storage.log_rows import LogRows, StreamID, TenantID
+from victorialogs_tpu.storage.part import (HEADER_GROUP_SIZE, INDEX_FILENAME,
+                                           METADATA_FILENAME, LazyHeaders,
+                                           Part, _compress, write_part)
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+N_BLOCKS = 3 * HEADER_GROUP_SIZE + 10  # 4 groups
+
+
+def _mk_part(tmp_path, n_blocks=N_BLOCKS, rows_per_block=4):
+    lr = LogRows(stream_fields=["app"])
+    lr.add(TEN, T0, [("app", "a"), ("_msg", "x")])
+    sid, tags = lr.stream_ids[0], lr.stream_tags_str[0]
+    blocks = []
+    for b in range(n_blocks):
+        ts = T0 + np.arange(rows_per_block, dtype=np.int64) * NS \
+            + b * rows_per_block * NS
+        cols = {"_msg": [f"blk{b} row{r}" for r in range(rows_per_block)]}
+        blocks.append(build_block_from_columns(sid, ts, cols,
+                                               stream_tags_str=tags))
+    path = str(tmp_path / "part1")
+    write_part(path, blocks)
+    return path
+
+
+def test_open_parses_only_metaindex(tmp_path):
+    path = _mk_part(tmp_path)
+    p = Part(path)
+    assert isinstance(p.headers, LazyHeaders)
+    assert len(p.headers) == N_BLOCKS
+    assert p.headers.groups_loaded == 0  # nothing decoded at open
+    # touching ONE block decodes exactly one group
+    h = p.headers[5]
+    assert h.rows == 4
+    assert p.headers.groups_loaded == 1
+    # a block in the last group decodes one more
+    p.headers[N_BLOCKS - 1]
+    assert p.headers.groups_loaded == 2
+    p.close()
+
+
+def test_candidate_blocks_skips_groups(tmp_path):
+    path = _mk_part(tmp_path)
+    p = Part(path)
+    # range covering only the first group's blocks
+    lo = T0
+    hi = T0 + (4 * 10) * NS  # first ~10 blocks
+    got = list(p.candidate_blocks(lo, hi))
+    assert got and all(bi < HEADER_GROUP_SIZE for bi in got)
+    assert p.headers.groups_loaded == 1  # later groups never decoded
+    # full range touches every group
+    all_bis = list(p.candidate_blocks(T0, T0 + N_BLOCKS * 4 * NS))
+    assert len(all_bis) == N_BLOCKS
+    p.close()
+
+
+def test_blocks_readable_through_lazy_headers(tmp_path):
+    path = _mk_part(tmp_path, n_blocks=HEADER_GROUP_SIZE + 3)
+    p = Part(path)
+    b0 = p.read_block(0)
+    assert b0.num_rows == 4
+    blast = p.read_block(HEADER_GROUP_SIZE + 2)
+    assert blast.timestamps[0] > b0.timestamps[0]
+    p.close()
+
+
+def test_v1_part_still_readable(tmp_path):
+    """A part written in the old single-blob format opens and reads."""
+    path = _mk_part(tmp_path, n_blocks=20)
+    p = Part(path)
+    # re-serialize headers into the v1 layout
+    v1_headers = []
+    for i in range(20):
+        h = p.headers[i]
+        sid = h.stream_id
+        v1_headers.append({
+            "sid": [sid.tenant.account_id, sid.tenant.project_id,
+                    sid.hi, sid.lo],
+            "tags": h.stream_tags_str, "rows": h.rows,
+            "min_ts": h.min_ts, "max_ts": h.max_ts,
+            "ts": list(h.ts_region), "cols": h.cols,
+            "consts": [list(c) for c in h.consts],
+        })
+    p.close()
+    with open(os.path.join(path, INDEX_FILENAME), "wb") as f:
+        f.write(_compress(json.dumps(v1_headers).encode(), hi=True))
+    meta_path = os.path.join(path, METADATA_FILENAME)
+    meta = json.load(open(meta_path))
+    meta["format_version"] = 1
+    json.dump(meta, open(meta_path, "w"))
+
+    p1 = Part(path)
+    assert isinstance(p1.headers, list)
+    assert len(p1.headers) == 20
+    assert p1.read_block(7).num_rows == 4
+    assert list(p1.candidate_blocks(T0, T0 + 10 * NS))
+    p1.close()

@@ -299,12 +299,11 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
              if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
 
     def cand_block_idxs(part) -> list:
-        """Header-only candidate selection (shared with the prefetcher)."""
+        """Header-only candidate selection (shared with the prefetcher);
+        candidate_blocks skips whole header groups outside the query's
+        time range without decoding them (v2 metaindex)."""
         out = []
-        for bi in range(part.num_blocks):
-            if part.block_min_ts(bi) > max_ts or \
-               part.block_max_ts(bi) < min_ts:
-                continue
+        for bi in part.candidate_blocks(min_ts, max_ts):
             sid = part.block_stream_id(bi)
             if sid.tenant not in tenant_set:
                 continue

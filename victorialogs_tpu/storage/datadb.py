@@ -50,6 +50,11 @@ class InmemoryPart:
         self.path = None
 
     # ---- uniform block-access API (see part.Part) ----
+    def candidate_blocks(self, min_ts, max_ts):
+        for bi, b in enumerate(self.blocks):
+            if b.min_ts <= max_ts and b.max_ts >= min_ts:
+                yield bi
+
     def block_stream_id(self, i):
         return self.blocks[i].stream_id
 

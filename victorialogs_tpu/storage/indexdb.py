@@ -79,8 +79,20 @@ class IndexDB:
                 self._snap = None
                 replay_from = 0
             self._load(replay_from)
+            # crash repair: a torn final line (no trailing newline) would
+            # otherwise MERGE with the first post-crash append, silently
+            # losing that registration on the next reopen
+            with open(self._file_path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size:
+                    f.seek(size - 1)
+                    if f.read(1) != b"\n":
+                        f.write(b"\n")
         self._file = open(self._file_path, "a", buffering=1 << 16)
         self._compact_thread: threading.Thread | None = None
+        self._compact_backoff_until = 0.0
+        self._compact_error: str | None = None
         if len(self._streams) >= SNAPSHOT_MIN_TAIL:
             # pay compaction once now so every later open is a bulk load
             self._write_snapshot_locked()
@@ -156,6 +168,9 @@ class IndexDB:
         if self._compact_thread is not None and \
                 self._compact_thread.is_alive():
             return
+        import time
+        if time.monotonic() < self._compact_backoff_until:
+            return
         frozen = dict(self._streams)
         old_snap = self._snap
         self._file.flush()
@@ -163,10 +178,20 @@ class IndexDB:
         log_size = os.path.getsize(self._file_path)
 
         def work():
-            write_snapshot(self._snap_path,
-                           self._merged_streams(old_snap, frozen),
-                           log_size)
-            new_snap = StreamSnapshot(self._snap_path)
+            try:
+                write_snapshot(self._snap_path,
+                               self._merged_streams(old_snap, frozen),
+                               log_size)
+                new_snap = StreamSnapshot(self._snap_path)
+            except Exception as e:
+                # disk full / permissions: keep serving from the old
+                # levels, back off so registrations don't re-pay a full
+                # merge per batch just to fail again
+                import time
+                with self._lock:
+                    self._compact_backoff_until = time.monotonic() + 60.0
+                    self._compact_error = repr(e)
+                return
             with self._lock:
                 self._snap = new_snap
                 self._gen += 1
@@ -357,7 +382,7 @@ class IndexDB:
         # phase 2 (UNLOCKED): snapshot evaluation + materialization —
         # the snapshot is immutable, so broad multi-second queries never
         # stall ingestion or other queries
-        snap_result = np.empty(0, dtype=np.uint32)
+        snap_chunks: list = []
         if snap is not None:
             for t in tenants:
                 s, e = snap.tenant_range(t)
@@ -373,7 +398,11 @@ class IndexDB:
                             break
                     if scand is None:
                         scand = np.arange(s, e, dtype=np.uint32)
-                    snap_result = np.union1d(snap_result, scand)
+                    if scand.size:
+                        snap_chunks.append(scand)
+        # one sort at the end instead of re-sorting per or-group/tenant
+        snap_result = np.unique(np.concatenate(snap_chunks)) \
+            if snap_chunks else np.empty(0, dtype=np.uint32)
         # snapshot rows are stored sorted by (tenant, hi, lo) — the same
         # order StreamID sorts by — so ascending indices are already
         # sorted; merge with the sorted tail instead of re-sorting

@@ -5,8 +5,15 @@ A part is an immutable directory of column-oriented files (the reference uses
 to five with the same capabilities):
 
   metadata.json    part-level stats (rows, blocks, time range, sizes, version)
-  index.bin        zstd-compressed JSON array of block headers (stream id,
-                   row count, time range, per-column regions + min/max + dicts)
+  index.bin        TWO-LEVEL block-header index (format v2): a small zstd
+                   metaindex (per-group offset/length + block count + time
+                   range) followed by independently-compressed GROUPS of
+                   block headers (HEADER_GROUP_SIZE blocks each).  Opening
+                   a part parses only the metaindex — O(groups) — and
+                   header groups decode lazily on first touch, so
+                   open+first-block cost stays flat as block counts grow
+                   (reference index_block_header.go:1-175, where
+                   metaindex.bin points at indexBlockHeader groups).
   timestamps.bin   per-block zstd(delta-encoded int64 nanos)
   columns.bin      per-(block,column) zstd-compressed payload regions
   blooms.bin       raw uint64 bloom words, memory-mapped at query time
@@ -14,6 +21,9 @@ to five with the same capabilities):
 Bloom words stay uncompressed on purpose: they are probed for *every* block a
 query touches (the cheap kill-path), so they must be random-accessible without
 a decompress step — the reader memory-maps them.
+
+Format v1 (one zstd-JSON array of every header) remains readable: merges
+naturally rewrite old parts into v2.
 """
 
 from __future__ import annotations
@@ -32,7 +42,8 @@ from .values_encoder import (EncodedColumn, VT_DICT, VT_FLOAT64, VT_INT64,
                              VT_IPV4, VT_STRING, VT_TIMESTAMP_ISO8601,
                              VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64)
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+HEADER_GROUP_SIZE = 256   # blocks per header group (v2 index.bin)
 
 # Process-unique part identity for caches keyed across part lifetimes:
 # id(part) is unsafe (CPython reuses freed addresses — ADVICE r1), so every
@@ -136,19 +147,40 @@ def write_part(path: str, blocks, big: bool = False) -> None:
         for fh in (ts_f, col_f, bloom_f):
             fh.flush()
             os.fsync(fh.fileno())
-    index_z = _compress(json.dumps(headers, separators=(",", ":"))
-                        .encode("utf-8"), hi=True)
+    # two-level index: compressed header GROUPS + a tiny metaindex that
+    # locates them (open parses only the metaindex)
+    groups_meta = []
+    group_blobs = []
+    goff = 0
+    for g0 in range(0, len(headers), HEADER_GROUP_SIZE):
+        grp = headers[g0:g0 + HEADER_GROUP_SIZE]
+        blob = _compress(json.dumps(grp, separators=(",", ":"))
+                         .encode("utf-8"), hi=True)
+        groups_meta.append({
+            "o": goff, "l": len(blob), "n": len(grp),
+            "min_ts": min(h["min_ts"] for h in grp),
+            "max_ts": max(h["max_ts"] for h in grp),
+        })
+        group_blobs.append(blob)
+        goff += len(blob)
+    metaindex_z = _compress(json.dumps(groups_meta, separators=(",", ":"))
+                            .encode("utf-8"), hi=True)
+    import struct as _struct
     with open(os.path.join(tmp, INDEX_FILENAME), "wb") as f:
-        f.write(index_z)
+        f.write(_struct.pack(">I", len(metaindex_z)))
+        f.write(metaindex_z)
+        for blob in group_blobs:
+            f.write(blob)
         f.flush()
         os.fsync(f.fileno())
+    index_z_len = 4 + len(metaindex_z) + goff
     meta = {
         "format_version": FORMAT_VERSION,
         "rows": total_rows,
         "blocks": len(headers),
         "min_ts": min_ts or 0,
         "max_ts": max_ts or 0,
-        "compressed_size": comp_size + len(index_z),
+        "compressed_size": comp_size + index_z_len,
         "uncompressed_size": uncomp_size,
     }
     with open(os.path.join(tmp, METADATA_FILENAME), "w") as f:
@@ -199,26 +231,93 @@ class BlockHeader:
         return None
 
 
+def _parse_header(h: dict) -> BlockHeader:
+    a, p, hi, lo = h["sid"]
+    return BlockHeader(
+        stream_id=StreamID(TenantID(a, p), hi, lo),
+        stream_tags_str=h.get("tags", ""),
+        rows=h["rows"], min_ts=h["min_ts"], max_ts=h["max_ts"],
+        ts_region=tuple(h["ts"]), cols=h["cols"],
+        consts=[tuple(x) for x in h["consts"]],
+    )
+
+
+class LazyHeaders:
+    """Sequence view over v2 header groups: each group decodes on first
+    touch and is cached; untouched groups never pay decompress+parse."""
+
+    def __init__(self, index_fd: int, base_off: int, groups_meta: list):
+        import threading
+        self._fd = index_fd
+        self._base = base_off
+        self._meta = groups_meta
+        self._starts = []          # first block idx of each group
+        pos = 0
+        for g in groups_meta:
+            self._starts.append(pos)
+            pos += g["n"]
+        self._n = pos
+        self._groups: list[list[BlockHeader] | None] = \
+            [None] * len(groups_meta)
+        self._mu = threading.Lock()
+        self.groups_loaded = 0     # test/observability hook
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _group_of(self, i: int) -> int:
+        import bisect
+        return bisect.bisect_right(self._starts, i) - 1
+
+    def _load_group(self, gi: int) -> list:
+        got = self._groups[gi]
+        if got is not None:
+            return got
+        with self._mu:
+            got = self._groups[gi]
+            if got is not None:
+                return got
+            m = self._meta[gi]
+            raw = _decompress(os.pread(self._fd, m["l"],
+                                       self._base + m["o"]))
+            got = [_parse_header(h) for h in json.loads(raw)]
+            self._groups[gi] = got
+            self.groups_loaded += 1
+            return got
+
+    def __getitem__(self, i: int) -> BlockHeader:
+        if i < 0 or i >= self._n:
+            raise IndexError(i)
+        gi = self._group_of(i)
+        return self._load_group(gi)[i - self._starts[gi]]
+
+    def group_time_ranges(self):
+        """(first_block, n_blocks, min_ts, max_ts) per group — candidate
+        selection skips whole groups without decoding them."""
+        for gi, m in enumerate(self._meta):
+            yield self._starts[gi], m["n"], m["min_ts"], m["max_ts"]
+
+
 class Part:
     """Lazy reader over an immutable part directory (or in-memory buffers)."""
 
     def __init__(self, path: str):
+        import struct as _struct
         self.path = path
         self.uid = next_part_uid()
         with open(os.path.join(path, METADATA_FILENAME)) as f:
             self.meta = json.load(f)
-        with open(os.path.join(path, INDEX_FILENAME), "rb") as f:
-            raw = _decompress(f.read())
-        self.headers: list[BlockHeader] = []
-        for h in json.loads(raw):
-            a, p, hi, lo = h["sid"]
-            self.headers.append(BlockHeader(
-                stream_id=StreamID(TenantID(a, p), hi, lo),
-                stream_tags_str=h.get("tags", ""),
-                rows=h["rows"], min_ts=h["min_ts"], max_ts=h["max_ts"],
-                ts_region=tuple(h["ts"]), cols=h["cols"],
-                consts=[tuple(x) for x in h["consts"]],
-            ))
+        self._idx_f = open(os.path.join(path, INDEX_FILENAME), "rb")
+        if self.meta.get("format_version", 1) >= 2:
+            hlen = _struct.unpack(">I", self._idx_f.read(4))[0]
+            groups_meta = json.loads(_decompress(self._idx_f.read(hlen)))
+            self.headers = LazyHeaders(self._idx_f.fileno(), 4 + hlen,
+                                       groups_meta)
+        else:
+            # format v1: one zstd-JSON array of every header (eager)
+            self._idx_f.seek(0)
+            raw = _decompress(self._idx_f.read())
+            self.headers = [_parse_header(h) for h in json.loads(raw)]
         self._ts_f = open(os.path.join(path, TIMESTAMPS_FILENAME), "rb")
         self._col_f = open(os.path.join(path, COLUMNS_FILENAME), "rb")
         bloom_path = os.path.join(path, BLOOMS_FILENAME)
@@ -247,6 +346,24 @@ class Part:
     def close(self) -> None:
         self._ts_f.close()
         self._col_f.close()
+        self._idx_f.close()
+
+    def candidate_blocks(self, min_ts: int, max_ts: int):
+        """Block idxs whose time range overlaps [min_ts, max_ts]; whole
+        header groups outside the range are skipped WITHOUT decoding
+        (v2 metaindex time ranges)."""
+        if isinstance(self.headers, LazyHeaders):
+            for start, n, g_min, g_max in self.headers.group_time_ranges():
+                if g_min > max_ts or g_max < min_ts:
+                    continue
+                for bi in range(start, start + n):
+                    h = self.headers[bi]
+                    if h.min_ts <= max_ts and h.max_ts >= min_ts:
+                        yield bi
+            return
+        for bi, h in enumerate(self.headers):
+            if h.min_ts <= max_ts and h.max_ts >= min_ts:
+                yield bi
 
     # ---- lazy block access ----
     # reads use os.pread: Part objects are shared between query threads,
