@@ -51,7 +51,11 @@ def test_datadb_merge(tmp_path):
     for k in range(16):
         ddb.must_add_log_rows(_mk_rows(10, t0=T0 + k * 10_000_000))
         ddb.flush_inmemory_parts()
-    # 16 small parts exceeds the merge threshold -> merged into one
+    # 16 small parts exceeds the merge threshold -> the BACKGROUND merge
+    # worker compacts them (merges no longer run on the flush path)
+    deadline = time.monotonic() + 15
+    while ddb.merges_done < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert ddb.merges_done >= 1
     assert len(ddb.small_parts) + len(ddb.big_parts) < 16
     assert _total_rows(ddb) == 160
@@ -203,3 +207,33 @@ def test_day_dir_name_roundtrip():
     assert day_from_dir_name(day_dir_name(0)) == 0
     assert day_from_dir_name(day_dir_name(20297)) == 20297
     assert day_dir_name(0) == "19700101"
+
+
+def test_big_tier_merges_in_background(tmp_path, monkeypatch):
+    """An overgrown big tier compacts too (per-tier merge policy)."""
+    from victorialogs_tpu.storage import datadb as ddb_mod
+    monkeypatch.setattr(ddb_mod, "BIG_PART_SIZE", 1)  # every part is big
+    ddb = DataDB(str(tmp_path / "ddb"), flush_interval=3600)
+    for k in range(16):
+        ddb.must_add_log_rows(_mk_rows(10, t0=T0 + k * 10_000_000))
+        ddb.flush_inmemory_parts()
+    deadline = time.monotonic() + 15
+    while (len(ddb.small_parts) + len(ddb.big_parts)) > 2 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(ddb.small_parts) + len(ddb.big_parts) <= 2
+    assert _total_rows(ddb) == 160
+    ddb.close()
+
+
+def test_ingest_backpressure_bounds_buffer(tmp_path, monkeypatch):
+    """A burst far beyond the in-memory budget blocks briefly instead of
+    growing without bound, and no rows are lost."""
+    from victorialogs_tpu.storage import datadb as ddb_mod
+    ddb = DataDB(str(tmp_path / "ddb"), flush_interval=3600)
+    for k in range(ddb_mod.MAX_INMEMORY_PARTS * 6):
+        ddb.must_add_log_rows(_mk_rows(5, t0=T0 + k * 10_000_000))
+        # the hard cap holds at every step
+        assert len(ddb.inmemory_parts) <= 4 * ddb_mod.MAX_INMEMORY_PARTS + 1
+    assert _total_rows(ddb) == 5 * ddb_mod.MAX_INMEMORY_PARTS * 6
+    ddb.close()

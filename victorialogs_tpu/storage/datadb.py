@@ -273,9 +273,21 @@ class DataDB:
         self._next_part_id = 0
         self._stop = threading.Event()
         self._open_existing()
+        # ingest never merges inline: a flusher thread turns in-memory
+        # parts into small file parts (woken early under buffer pressure),
+        # and a merge worker compacts the small/big tiers in the
+        # background (reference per-tier merge workers — datadb.go:209-262)
+        self._flush_wake = threading.Event()
+        self._buffer_drained = threading.Condition(self._lock)
+        self._merge_wake = threading.Event()
+        self._merge_backoff_until = 0.0
+        self.merges_done = 0
+        # all shared state above must exist before either thread runs
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
-        self.merges_done = 0
+        self._merge_worker = threading.Thread(target=self._merge_loop,
+                                              daemon=True)
+        self._merge_worker.start()
 
     # ---- open / recovery ----
     def _open_existing(self) -> None:
@@ -319,29 +331,67 @@ class DataDB:
 
     # ---- write path ----
     def must_add_blocks(self, blocks: list[BlockData]) -> None:
+        """Rows were already encoded into blocks on the CALLER's thread
+        (blocks_from_log_rows) — concurrent ingest threads parallelize the
+        CPU-heavy encode naturally (numpy/zstd release the GIL), which is
+        this design's analogue of the reference's per-CPU rowsBuffer
+        shards (datadb.go:667-747).  The append itself is a short locked
+        op; the flusher is woken early under pressure, and ingest only
+        BLOCKS (backpressure) when the buffer is far over its budget."""
         if not blocks:
             return
         with self._lock:
             self.inmemory_parts.append(InmemoryPart(blocks))
-            need_flush = len(self.inmemory_parts) > MAX_INMEMORY_PARTS
-        if need_flush:
-            self.flush_inmemory_parts()
+            n = len(self.inmemory_parts)
+            if n > MAX_INMEMORY_PARTS:
+                self._flush_wake.set()
+            # hard backpressure: don't let an ingest burst outrun the
+            # flusher unboundedly (reference blocks in addRows when the
+            # part set explodes)
+            while len(self.inmemory_parts) > 4 * MAX_INMEMORY_PARTS and \
+                    not self._stop.is_set():
+                self._flush_wake.set()
+                self._buffer_drained.wait(timeout=1.0)
 
     def must_add_log_rows(self, lr) -> None:
         self.must_add_blocks(blocks_from_log_rows(lr))
 
     # ---- flush / merge ----
     def _flush_loop(self) -> None:
-        while not self._stop.wait(min(self.flush_interval, 1.0)):
+        while True:
+            self._flush_wake.wait(timeout=min(self.flush_interval, 1.0))
+            if self._stop.is_set():
+                return
+            woken = self._flush_wake.is_set()
+            self._flush_wake.clear()
             with self._lock:
                 oldest = min((p.created_at for p in self.inmemory_parts),
                              default=None)
-            if oldest is not None and \
-               time.monotonic() - oldest >= self.flush_interval:
+            if oldest is None:
+                continue
+            if woken or time.monotonic() - oldest >= self.flush_interval:
                 try:
                     self.flush_inmemory_parts()
                 except Exception:  # pragma: no cover - keep flusher alive
                     pass
+
+    def _merge_loop(self) -> None:
+        """Bounded background merge worker: compacts the small tier (and
+        the big tier when it accumulates) without ever stalling ingest or
+        the flusher."""
+        while True:
+            self._merge_wake.wait(timeout=1.0)
+            if self._stop.is_set():
+                return
+            self._merge_wake.clear()
+            if time.monotonic() < self._merge_backoff_until:
+                continue
+            try:
+                self._maybe_merge()
+            except Exception:
+                # ENOSPC and friends: back off instead of re-running the
+                # same full k-way merge every second against a full disk
+                self._merge_backoff_until = time.monotonic() + 30.0
 
     def flush_inmemory_parts(self) -> None:
         """Merge all in-memory parts into one small file part (durable)."""
@@ -369,6 +419,7 @@ class DataDB:
                                        if id(x) not in gone]
                 self.small_parts.append(p)
                 self._write_manifest_locked()
+                self._buffer_drained.notify_all()
         except BaseException:
             # put the in-memory parts back so their rows stay visible
             with self._lock:
@@ -376,17 +427,22 @@ class DataDB:
                 self.flushing_parts = [x for x in self.flushing_parts
                                        if id(x) not in gone]
                 self.inmemory_parts.extend(imps)
+                self._buffer_drained.notify_all()
             raise
-        self._maybe_merge()
+        self._merge_wake.set()
 
     def _maybe_merge(self) -> None:
-        """Merge small parts when there are too many (bin-pack equivalent)."""
+        """Merge small parts when there are too many (bin-pack equivalent);
+        an overgrown big tier compacts the same way."""
         with self._merge_lock:
             with self._lock:
-                if len(self.small_parts) < DEFAULT_PARTS_TO_MERGE:
+                if len(self.small_parts) >= DEFAULT_PARTS_TO_MERGE:
+                    to_merge, big = list(self.small_parts), False
+                elif len(self.big_parts) >= DEFAULT_PARTS_TO_MERGE:
+                    to_merge, big = list(self.big_parts), True
+                else:
                     return
-                to_merge = list(self.small_parts)
-            self._merge_parts(to_merge, big=False)
+            self._merge_parts(to_merge, big=big)
 
     def force_merge(self) -> None:
         """Merge ALL file parts into one big part (reference MustForceMerge)."""
@@ -416,7 +472,14 @@ class DataDB:
         merged = merge_block_streams([part_iter(p) for p in to_merge])
         with self._lock:
             name = self._new_part_name_locked()
-        write_part(os.path.join(self.path, name), merged, big=big)
+        out_path = os.path.join(self.path, name)
+        try:
+            write_part(out_path, merged, big=big)
+        except BaseException:
+            # a failed write must not leave its .tmp dir eating the very
+            # disk space the merge ran out of
+            shutil.rmtree(out_path + ".tmp", ignore_errors=True)
+            raise
         newp = Part(os.path.join(self.path, name))
         newp.name = name
         with self._lock:
@@ -468,7 +531,10 @@ class DataDB:
 
     def close(self) -> None:
         self._stop.set()
+        self._flush_wake.set()
+        self._merge_wake.set()
         self._flusher.join(timeout=5)
+        self._merge_worker.join(timeout=5)
         self.flush_inmemory_parts()
         with self._lock:
             for p in self.small_parts + self.big_parts:

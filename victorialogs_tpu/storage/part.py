@@ -353,13 +353,14 @@ class Part:
         header groups outside the range are skipped WITHOUT decoding
         (v2 metaindex time ranges)."""
         if isinstance(self.headers, LazyHeaders):
-            for start, n, g_min, g_max in self.headers.group_time_ranges():
+            for gi, (start, n, g_min, g_max) in enumerate(
+                    self.headers.group_time_ranges()):
                 if g_min > max_ts or g_max < min_ts:
                     continue
-                for bi in range(start, start + n):
-                    h = self.headers[bi]
+                grp = self.headers._load_group(gi)
+                for off, h in enumerate(grp):
                     if h.min_ts <= max_ts and h.max_ts >= min_ts:
-                        yield bi
+                        yield start + off
             return
         for bi, h in enumerate(self.headers):
             if h.min_ts <= max_ts and h.max_ts >= min_ts:
