@@ -411,3 +411,14 @@ def test_live_tail_http(server):
     assert done.wait(30), "tail never delivered the ingested rows"
     assert got and b"tailtoken" in got[0]
     conn.close()
+
+
+def test_vmui_page_serves_full_app(server):
+    """The embedded UI ships the full single-file app: histogram panel,
+    table/JSON/fields views, live tail, time-range controls."""
+    status, data = _req(server, "GET", "/select/vmui/")
+    assert status == 200
+    html = data.decode()
+    for marker in ("histtitle", "loadFields", "startTail", "data-tab",
+                   "field_values", "logsql/tail", "prefers-color-scheme"):
+        assert marker in html, marker

@@ -1,106 +1,459 @@
-"""Minimal embedded web UI (the vmui analogue, served at /select/vmui/).
+"""Embedded web UI (the vmui analogue, served at /select/vmui/).
 
 The reference embeds a prebuilt React SPA (app/vlselect/main.go:71-74);
-this is a self-contained single-file UI over the same HTTP API: LogsQL
-query box, time range, hits histogram, streaming results table."""
+this is a self-contained single-file app over the same HTTP API — no
+build step, no external assets (the image has zero egress):
 
-VMUI_HTML = """<!doctype html>
+- LogsQL query editor with time-range presets / custom range, limit and
+  tenant controls, Ctrl+Enter to run;
+- hits histogram over /select/logsql/hits (SVG, per-bar hover tooltip,
+  light/dark aware — single series, labeled by the panel title);
+- results as an expandable table or raw JSON (the table doubles as the
+  chart's accessible data view);
+- field browser over field_names/field_values with click-to-filter;
+- live tail over /select/logsql/tail (streamed fetch).
+"""
+
+VMUI_HTML = r"""<!doctype html>
 <html>
 <head>
 <meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
 <title>VictoriaLogs TPU</title>
 <style>
-  body { font-family: -apple-system, system-ui, sans-serif; margin: 0;
-         background: #f7f7f9; color: #222; }
-  header { background: #1a1a2e; color: #eee; padding: 10px 16px;
-           display: flex; gap: 12px; align-items: center; }
-  header h1 { font-size: 16px; margin: 0; font-weight: 600; }
-  #bar { display: flex; gap: 8px; padding: 12px 16px; }
-  #query { flex: 1; font: 14px monospace; padding: 8px; }
-  select, button, input { font-size: 14px; padding: 8px; }
-  button { background: #4361ee; color: white; border: 0;
-           border-radius: 4px; cursor: pointer; }
-  #hits { display: flex; align-items: flex-end; gap: 1px; height: 64px;
-          padding: 0 16px; }
-  #hits div { background: #4361ee; flex: 1; min-width: 2px; }
-  #meta { padding: 4px 16px; color: #666; font-size: 12px; }
-  table { border-collapse: collapse; margin: 8px 16px; font-size: 13px;
-          width: calc(100% - 32px); }
-  th, td { border: 1px solid #ddd; padding: 4px 8px; text-align: left;
-           font-family: monospace; vertical-align: top;
-           word-break: break-all; }
-  th { background: #eaeaef; position: sticky; top: 0; }
-  #err { color: #b00020; padding: 0 16px; white-space: pre-wrap; }
+  :root {
+    color-scheme: light;
+    --surface: #fcfcfb; --panel: #ffffff; --border: #e4e3df;
+    --text: #0b0b0b; --text-2: #52514e; --muted: #8a897f;
+    --accent: #2a78d6;           /* series-1: the hits histogram */
+    --accent-soft: #2a78d622;
+    --bad: #e34948; --grid: #edece8;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root {
+      color-scheme: dark;
+      --surface: #1a1a19; --panel: #232322; --border: #3a3936;
+      --text: #ffffff; --text-2: #c3c2b7; --muted: #8a897f;
+      --accent: #3987e5; --accent-soft: #3987e533;
+      --bad: #e66767; --grid: #2e2d2b;
+    }
+  }
+  * { box-sizing: border-box; }
+  body { font: 14px/1.45 -apple-system, system-ui, sans-serif; margin: 0;
+         background: var(--surface); color: var(--text); }
+  header { display: flex; gap: 10px; align-items: center;
+           padding: 10px 16px; border-bottom: 1px solid var(--border); }
+  header h1 { font-size: 15px; margin: 0; font-weight: 650; }
+  header .sub { color: var(--muted); font-size: 12px; }
+  #bar { display: flex; gap: 8px; padding: 12px 16px 4px; flex-wrap: wrap; }
+  #query { flex: 1 1 420px; font: 13px/1.4 ui-monospace, monospace;
+           padding: 8px 10px; min-height: 38px; resize: vertical;
+           background: var(--panel); color: var(--text);
+           border: 1px solid var(--border); border-radius: 6px; }
+  select, button, input {
+    font-size: 13px; padding: 7px 10px; background: var(--panel);
+    color: var(--text); border: 1px solid var(--border);
+    border-radius: 6px; }
+  button { cursor: pointer; }
+  button.primary { background: var(--accent); color: #fff;
+                   border-color: var(--accent); font-weight: 600; }
+  button.on { outline: 2px solid var(--accent); }
+  #opts { display: flex; gap: 8px; padding: 4px 16px 8px; flex-wrap: wrap;
+          align-items: center; color: var(--text-2); font-size: 13px; }
+  #opts input { width: 110px; }
+  #opts input.wide { width: 180px; }
+  #status { padding: 2px 16px 6px; font-size: 12px; color: var(--muted); }
+  #error { margin: 0 16px 8px; padding: 8px 12px; border-radius: 6px;
+           background: color-mix(in srgb, var(--bad) 12%, var(--panel));
+           color: var(--bad); white-space: pre-wrap; display: none;
+           font-family: ui-monospace, monospace; font-size: 12px; }
+  .panel { margin: 0 16px 12px; background: var(--panel);
+           border: 1px solid var(--border); border-radius: 8px; }
+  .panel h2 { font-size: 12px; font-weight: 600; color: var(--text-2);
+              margin: 0; padding: 8px 12px 0; }
+  #histwrap { position: relative; padding: 4px 12px 8px; }
+  #hist { width: 100%; height: 110px; display: block; }
+  #tip { position: absolute; pointer-events: none; display: none;
+         background: var(--panel); border: 1px solid var(--border);
+         border-radius: 6px; padding: 4px 8px; font-size: 12px;
+         box-shadow: 0 2px 8px #0003; white-space: nowrap; z-index: 5; }
+  #tabs { display: flex; gap: 2px; padding: 0 16px; }
+  #tabs button { border-radius: 6px 6px 0 0; border-bottom: none; }
+  #tabs button.active { background: var(--panel); font-weight: 600; }
+  #out { margin: 0 16px 16px; background: var(--panel);
+         border: 1px solid var(--border); border-radius: 0 8px 8px 8px;
+         overflow: auto; max-height: 70vh; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 5px 10px;
+           border-bottom: 1px solid var(--grid); vertical-align: top; }
+  th { position: sticky; top: 0; background: var(--panel);
+       color: var(--text-2); font-weight: 600; cursor: default; }
+  td.msg { font-family: ui-monospace, monospace; font-size: 12px;
+           white-space: pre-wrap; word-break: break-word; }
+  tr.row:hover { background: var(--accent-soft); cursor: pointer; }
+  tr.detail td { background: color-mix(in srgb, var(--accent) 4%,
+                 var(--panel)); font-family: ui-monospace, monospace;
+                 font-size: 12px; white-space: pre-wrap; }
+  #json { font: 12px/1.5 ui-monospace, monospace; margin: 0;
+          padding: 10px 12px; white-space: pre-wrap; }
+  #fields { display: flex; min-height: 200px; }
+  #fnames { width: 300px; border-right: 1px solid var(--grid);
+            padding: 6px 0; }
+  #fvals { flex: 1; padding: 6px 0; }
+  .frow { padding: 4px 12px; display: flex; justify-content: space-between;
+          cursor: pointer; }
+  .frow:hover { background: var(--accent-soft); }
+  .frow .hits { color: var(--muted); font-size: 12px; }
+  .fhead { padding: 4px 12px; color: var(--muted); font-size: 12px; }
 </style>
 </head>
 <body>
-<header><h1>VictoriaLogs <small>tpu-native</small></h1></header>
+<header>
+  <h1>VictoriaLogs <span style="color:var(--accent)">TPU</span></h1>
+  <span class="sub">LogsQL over columnar parts + device kernels</span>
+</header>
+
 <div id="bar">
-  <input id="query" value="*" placeholder="LogsQL query, e.g. error | stats count()">
-  <select id="range">
-    <option value="5m">last 5m</option>
-    <option value="1h">last 1h</option>
-    <option value="24h" selected>last 24h</option>
-    <option value="7d">last 7d</option>
-    <option value="">all time</option>
-  </select>
-  <input id="limit" type="number" value="100" style="width:70px">
-  <button onclick="run()">Run</button>
+  <textarea id="query" rows="1" spellcheck="false"
+    placeholder="LogsQL query, e.g.  error _time:5m | stats by (app) count()">*</textarea>
+  <button class="primary" id="run" title="Ctrl+Enter">Run</button>
+  <button id="tailbtn" title="live tail">Tail</button>
 </div>
-<div id="hits"></div>
-<div id="meta"></div>
-<div id="err"></div>
-<table id="out"></table>
+<div id="opts">
+  <label>Range <select id="range">
+    <option value="300s">last 5m</option>
+    <option value="3600s">last 1h</option>
+    <option value="86400s" selected>last 24h</option>
+    <option value="604800s">last 7d</option>
+    <option value="2592000s">last 30d</option>
+    <option value="custom">custom…</option>
+  </select></label>
+  <span id="custom" style="display:none">
+    <input id="start" class="wide" placeholder="start (RFC3339/unix/1d)">
+    <input id="end" class="wide" placeholder="end (RFC3339/unix/now)">
+  </span>
+  <label>Limit <input id="limit" value="1000" size="6"></label>
+  <label>Tenant <input id="tenant" value="0:0" size="5"
+         title="AccountID:ProjectID"></label>
+</div>
+<div id="status"></div>
+<pre id="error"></pre>
+
+<div class="panel">
+  <h2 id="histtitle">Hits over time</h2>
+  <div id="histwrap">
+    <svg id="hist" preserveAspectRatio="none"></svg>
+    <div id="tip"></div>
+  </div>
+</div>
+
+<div id="tabs">
+  <button data-tab="table" class="active">Table</button>
+  <button data-tab="json">JSON</button>
+  <button data-tab="fields">Fields</button>
+</div>
+<div id="out">
+  <div id="tableview"></div>
+  <pre id="json" style="display:none"></pre>
+  <div id="fields" style="display:none">
+    <div id="fnames"></div>
+    <div id="fvals"><div class="fhead">click a field to list its values
+      — click a value to add a filter</div></div>
+  </div>
+</div>
+
 <script>
-async function run() {
-  const q = document.getElementById('query').value;
-  const range = document.getElementById('range').value;
-  const limit = document.getElementById('limit').value || 100;
-  const errEl = document.getElementById('err');
-  errEl.textContent = '';
-  let params = new URLSearchParams({query: q, limit: limit});
-  if (range) params.set('start', new Date(Date.now() -
-      {m: 6e4, h: 36e5, d: 864e5}[range.slice(-1)] *
-      parseInt(range)).toISOString());
-  try {
-    const hp = new URLSearchParams({query: q, step: '1h'});
-    if (range) hp.set('start', params.get('start'));
-    fetch('/select/logsql/hits?' + hp).then(r => r.json()).then(h => {
-      const el = document.getElementById('hits');
-      el.innerHTML = '';
-      const vals = (h.hits || []).flatMap(g => g.values);
-      const mx = Math.max(1, ...vals);
-      vals.forEach(v => {
-        const d = document.createElement('div');
-        d.style.height = (v / mx * 100) + '%';
-        d.title = v;
-        el.appendChild(d);
-      });
-    }).catch(() => {});
-    const t0 = performance.now();
-    const resp = await fetch('/select/logsql/query?' + params);
-    const text = await resp.text();
-    if (!resp.ok) { errEl.textContent = text; return; }
-    const rows = text.trim() ? text.trim().split('\\n').map(JSON.parse)
-        : [];
-    const cols = [];
-    rows.forEach(r => Object.keys(r).forEach(k => {
-      if (!cols.includes(k)) cols.push(k); }));
-    const tbl = document.getElementById('out');
-    tbl.innerHTML = '';
-    const hr = tbl.insertRow();
-    cols.forEach(c => { const th = document.createElement('th');
-                        th.textContent = c; hr.appendChild(th); });
-    rows.forEach(r => { const tr = tbl.insertRow();
-      cols.forEach(c => { tr.insertCell().textContent = r[c] ?? ''; }); });
-    document.getElementById('meta').textContent =
-      rows.length + ' rows in ' +
-      Math.round(performance.now() - t0) + 'ms';
-  } catch (e) { errEl.textContent = String(e); }
+"use strict";
+const $ = id => document.getElementById(id);
+let rows = [], tailing = false, tailAbort = null;
+
+function tenant() {
+  const [a, p] = ($("tenant").value || "0:0").split(":");
+  return {AccountID: a || "0", ProjectID: p || "0"};
 }
-document.getElementById('query').addEventListener('keydown',
-  e => { if (e.key === 'Enter') run(); });
+function timeRange() {
+  const sel = $("range").value;
+  if (sel === "custom") {
+    return {start: $("start").value || "1d", end: $("end").value || "now"};
+  }
+  return {start: sel, end: "now"};
+}
+function hitsStep() {
+  // ~60 buckets across the selected range
+  const sel = $("range").value;
+  const secs = sel === "custom" ? 86400 : parseInt(sel, 10);
+  return Math.max(1, Math.round(secs / 60)) + "s";
+}
+function qs(params) {
+  return Object.entries(params)
+    .map(([k, v]) => `${k}=${encodeURIComponent(v)}`).join("&");
+}
+async function api(path, params) {
+  const t = tenant();
+  const resp = await fetch(`${path}?${qs(params)}`, {
+    headers: {AccountID: t.AccountID, ProjectID: t.ProjectID}});
+  if (!resp.ok) throw new Error(`${path}: HTTP ${resp.status}: ` +
+                                await resp.text());
+  return resp;
+}
+function setError(msg) {
+  $("error").style.display = msg ? "block" : "none";
+  $("error").textContent = msg || "";
+}
+
+// ---- query run ----
+async function run() {
+  stopTail();
+  const q = $("query").value.trim() || "*";
+  const {start, end} = timeRange();
+  setError(""); rows = [];
+  $("status").textContent = "running…";
+  const t0 = performance.now();
+  try {
+    const resp = await api("/select/logsql/query",
+                           {query: q, start, end, limit: $("limit").value});
+    const text = await resp.text();
+    rows = text.split("\n").filter(l => l.trim())
+               .map(l => JSON.parse(l));
+    const ms = Math.round(performance.now() - t0);
+    $("status").textContent = `${rows.length} rows in ${ms}ms`;
+    render();
+    drawHits(q, start, end).catch(() => {});
+    if (currentTab === "fields") loadFields();
+  } catch (e) {
+    $("status").textContent = "";
+    setError(String(e.message || e));
+  }
+}
+
+// ---- hits histogram (single series: titled by the panel, no legend) ----
+let hitsData = [];
+async function drawHits(q, start, end) {
+  // strip pipes: hits wants the filter part only
+  const filt = q.split("|")[0].trim() || "*";
+  const resp = await api("/select/logsql/hits",
+                         {query: filt, start, end, step: hitsStep()});
+  const data = await resp.json();
+  const buckets = new Map();
+  for (const h of (data.hits || [])) {
+    (h.timestamps || []).forEach((ts, i) => {
+      buckets.set(ts, (buckets.get(ts) || 0) + (h.values[i] || 0));
+    });
+  }
+  hitsData = [...buckets.entries()].sort((a, b) => a[0] < b[0] ? -1 : 1);
+  const svg = $("hist");
+  svg.innerHTML = "";
+  const W = svg.clientWidth || 800, H = 110, pad = 2;
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  if (!hitsData.length) {
+    $("histtitle").textContent = "Hits over time — no data";
+    return;
+  }
+  const max = Math.max(...hitsData.map(d => d[1]));
+  $("histtitle").textContent =
+    `Hits over time — ${hitsData.reduce((s, d) => s + d[1], 0)} total`;
+  const slot = (W - pad * 2) / hitsData.length;
+  const bw = Math.max(1, slot - 2);  // 2px surface gap between bars
+  hitsData.forEach(([ts, v], i) => {
+    const h = max ? Math.max(1, (H - 18) * v / max) : 1;
+    const x = pad + i * slot;
+    const r = document.createElementNS("http://www.w3.org/2000/svg",
+                                       "rect");
+    // thin mark, 4px rounded data end anchored to the baseline
+    r.setAttribute("x", x); r.setAttribute("y", H - h);
+    r.setAttribute("width", bw); r.setAttribute("height", h);
+    r.setAttribute("rx", Math.min(4, bw / 2));
+    r.setAttribute("fill", "var(--accent)");
+    r.addEventListener("mousemove", ev => {
+      const tip = $("tip");
+      tip.style.display = "block";
+      tip.textContent = `${ts} — ${v} hits`;
+      const wrap = $("histwrap").getBoundingClientRect();
+      tip.style.left = Math.min(ev.clientX - wrap.left + 12,
+                                wrap.width - 200) + "px";
+      tip.style.top = "8px";
+    });
+    r.addEventListener("mouseleave", () => {
+      $("tip").style.display = "none";
+    });
+    svg.appendChild(r);
+  });
+}
+
+// ---- table / json rendering ----
+function columnsOf(rows) {
+  const pri = ["_time", "_stream", "_msg"];
+  const seen = new Set();
+  for (const r of rows) Object.keys(r).forEach(k => seen.add(k));
+  const rest = [...seen].filter(c => !pri.includes(c)).sort();
+  return pri.filter(c => seen.has(c)).concat(rest);
+}
+function render() {
+  const cols = columnsOf(rows);
+  const tbl = document.createElement("table");
+  const thead = document.createElement("thead");
+  thead.innerHTML = "<tr>" + cols.map(c =>
+    `<th>${esc(c)}</th>`).join("") + "</tr>";
+  tbl.appendChild(thead);
+  const tb = document.createElement("tbody");
+  const maxRender = 2000;
+  rows.slice(0, maxRender).forEach(r => {
+    const tr = document.createElement("tr");
+    tr.className = "row";
+    tr.innerHTML = cols.map(c => {
+      const v = r[c] === undefined ? "" : String(r[c]);
+      const cls = c === "_msg" ? "msg" : "";
+      const shown = v.length > 300 ? v.slice(0, 300) + "…" : v;
+      return `<td class="${cls}">${esc(shown)}</td>`;
+    }).join("");
+    tr.addEventListener("click", () => {
+      if (tr.nextSibling && tr.nextSibling.className === "detail") {
+        tr.nextSibling.remove(); return;
+      }
+      const d = document.createElement("tr");
+      d.className = "detail";
+      d.innerHTML = `<td colspan="${cols.length}">` +
+        esc(JSON.stringify(r, null, 2)) + "</td>";
+      tr.after(d);
+    });
+    tb.appendChild(tr);
+  });
+  tbl.appendChild(tb);
+  const tv = $("tableview");
+  tv.innerHTML = "";
+  if (rows.length > maxRender) {
+    const note = document.createElement("div");
+    note.className = "fhead";
+    note.textContent =
+      `showing first ${maxRender} of ${rows.length} rows`;
+    tv.appendChild(note);
+  }
+  tv.appendChild(tbl);
+  $("json").textContent =
+    rows.slice(0, maxRender).map(r => JSON.stringify(r)).join("\n");
+}
+function esc(s) {
+  return String(s).replace(/[&<>"]/g,
+    c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+}
+
+// ---- fields browser ----
+async function loadFields() {
+  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  const {start, end} = timeRange();
+  try {
+    const resp = await api("/select/logsql/field_names",
+                           {query: q, start, end});
+    const data = await resp.json();
+    const box = $("fnames");
+    box.innerHTML = '<div class="fhead">fields</div>';
+    (data.values || []).forEach(f => {
+      const d = document.createElement("div");
+      d.className = "frow";
+      d.innerHTML = `<span>${esc(f.value)}</span>` +
+                    `<span class="hits">${esc(f.hits)}</span>`;
+      d.addEventListener("click", () => loadValues(f.value));
+      box.appendChild(d);
+    });
+  } catch (e) { setError(String(e.message || e)); }
+}
+async function loadValues(field) {
+  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  const {start, end} = timeRange();
+  try {
+    const resp = await api("/select/logsql/field_values",
+                           {query: q, field, start, end, limit: 50});
+    const data = await resp.json();
+    const box = $("fvals");
+    box.innerHTML = `<div class="fhead">${esc(field)} — click to filter` +
+                    `</div>`;
+    (data.values || []).forEach(v => {
+      const d = document.createElement("div");
+      d.className = "frow";
+      d.innerHTML = `<span>${esc(v.value) || "&lt;empty&gt;"}</span>` +
+                    `<span class="hits">${esc(v.hits)}</span>`;
+      d.addEventListener("click", () => {
+        const qa = $("query");
+        const base = qa.value.trim() === "*" ? "" : qa.value.trim();
+        const fl = `${field}:=${JSON.stringify(v.value)}`;
+        qa.value = base ? `${base} ${fl}` : fl;
+        run();
+      });
+      box.appendChild(d);
+    });
+  } catch (e) { setError(String(e.message || e)); }
+}
+
+// ---- live tail ----
+async function startTail() {
+  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  tailing = true;
+  $("tailbtn").classList.add("on");
+  $("status").textContent = "tailing…";
+  rows = []; render();
+  tailAbort = new AbortController();
+  try {
+    const t = tenant();
+    const resp = await fetch(`/select/logsql/tail?${qs({query: q})}`, {
+      headers: {AccountID: t.AccountID, ProjectID: t.ProjectID},
+      signal: tailAbort.signal});
+    const reader = resp.body.getReader();
+    const dec = new TextDecoder();
+    let buf = "";
+    for (;;) {
+      const {done, value} = await reader.read();
+      if (done || !tailing) break;
+      buf += dec.decode(value, {stream: true});
+      const lines = buf.split("\n");
+      buf = lines.pop();
+      for (const l of lines) {
+        if (!l.trim()) continue;
+        try { rows.push(JSON.parse(l)); } catch (e) {}
+      }
+      if (rows.length > 1000) rows = rows.slice(-1000);
+      $("status").textContent = `tailing… ${rows.length} rows`;
+      render();
+    }
+  } catch (e) {
+    if (tailing) setError(String(e.message || e));
+  }
+  stopTail();
+}
+function stopTail() {
+  if (!tailing) return;
+  tailing = false;
+  $("tailbtn").classList.remove("on");
+  if (tailAbort) tailAbort.abort();
+}
+
+// ---- wiring ----
+let currentTab = "table";
+document.querySelectorAll("#tabs button").forEach(b => {
+  b.addEventListener("click", () => {
+    currentTab = b.dataset.tab;
+    document.querySelectorAll("#tabs button").forEach(x =>
+      x.classList.toggle("active", x === b));
+    $("tableview").style.display =
+      currentTab === "table" ? "block" : "none";
+    $("json").style.display = currentTab === "json" ? "block" : "none";
+    $("fields").style.display = currentTab === "fields" ? "flex" : "none";
+    if (currentTab === "fields") loadFields();
+  });
+});
+$("run").addEventListener("click", run);
+$("tailbtn").addEventListener("click", () =>
+  tailing ? stopTail() : startTail());
+$("range").addEventListener("change", () => {
+  $("custom").style.display =
+    $("range").value === "custom" ? "inline" : "none";
+});
+$("query").addEventListener("keydown", e => {
+  if (e.key === "Enter" && (e.ctrlKey || e.metaKey)) {
+    e.preventDefault(); run();
+  }
+});
 run();
 </script>
 </body>
-</html>"""
+</html>
+"""
