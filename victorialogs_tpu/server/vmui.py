@@ -178,11 +178,48 @@ function timeRange() {
   }
   return {start: sel, end: "now"};
 }
+function durSecs(v) {
+  const m = /^(\d+(?:\.\d+)?)([smhdw])$/.exec(v || "");
+  return m ? m[1] * {s: 1, m: 60, h: 3600, d: 86400, w: 604800}[m[2]]
+           : null;
+}
+function rangeSecs() {
+  const sel = $("range").value;
+  if (sel !== "custom") return parseInt(sel, 10);
+  const s = $("start").value || "1d", e = $("end").value || "now";
+  const ds = durSecs(s);
+  if (ds && (e === "now" || !e)) return ds;
+  const t0 = Date.parse(s);
+  const t1 = (e === "now" || !e) ? Date.now() : Date.parse(e);
+  if (!isNaN(t0) && !isNaN(t1) && t1 > t0) return (t1 - t0) / 1000;
+  return 86400;
+}
 function hitsStep() {
   // ~60 buckets across the selected range
-  const sel = $("range").value;
-  const secs = sel === "custom" ? 86400 : parseInt(sel, 10);
-  return Math.max(1, Math.round(secs / 60)) + "s";
+  return Math.max(1, Math.round(rangeSecs() / 60)) + "s";
+}
+// split at the first TOP-LEVEL '|' (quoted strings, backtick regexes and
+// parenthesized subqueries can all contain pipes)
+function splitTopPipe(q) {
+  let depth = 0, quote = null, escp = false;
+  for (let i = 0; i < q.length; i++) {
+    const c = q[i];
+    if (escp) { escp = false; continue; }
+    if (quote) {
+      if (c === "\\" && quote === '"') escp = true;
+      else if (c === quote) quote = null;
+      continue;
+    }
+    if (c === '"' || c === "'" || c === "`") quote = c;
+    else if (c === "(") depth++;
+    else if (c === ")") depth = Math.max(0, depth - 1);
+    else if (c === "|" && depth === 0) return [q.slice(0, i), q.slice(i)];
+  }
+  return [q, ""];
+}
+function filterPart() {
+  const q = $("query").value.trim() || "*";
+  return splitTopPipe(q)[0].trim() || "*";
 }
 function qs(params) {
   return Object.entries(params)
@@ -229,8 +266,8 @@ async function run() {
 // ---- hits histogram (single series: titled by the panel, no legend) ----
 let hitsData = [];
 async function drawHits(q, start, end) {
-  // strip pipes: hits wants the filter part only
-  const filt = q.split("|")[0].trim() || "*";
+  // hits wants the filter part only
+  const filt = filterPart();
   const resp = await api("/select/logsql/hits",
                          {query: filt, start, end, step: hitsStep()});
   const data = await resp.json();
@@ -297,27 +334,7 @@ function render() {
   tbl.appendChild(thead);
   const tb = document.createElement("tbody");
   const maxRender = 2000;
-  rows.slice(0, maxRender).forEach(r => {
-    const tr = document.createElement("tr");
-    tr.className = "row";
-    tr.innerHTML = cols.map(c => {
-      const v = r[c] === undefined ? "" : String(r[c]);
-      const cls = c === "_msg" ? "msg" : "";
-      const shown = v.length > 300 ? v.slice(0, 300) + "…" : v;
-      return `<td class="${cls}">${esc(shown)}</td>`;
-    }).join("");
-    tr.addEventListener("click", () => {
-      if (tr.nextSibling && tr.nextSibling.className === "detail") {
-        tr.nextSibling.remove(); return;
-      }
-      const d = document.createElement("tr");
-      d.className = "detail";
-      d.innerHTML = `<td colspan="${cols.length}">` +
-        esc(JSON.stringify(r, null, 2)) + "</td>";
-      tr.after(d);
-    });
-    tb.appendChild(tr);
-  });
+  rows.slice(0, maxRender).forEach(r => tb.appendChild(rowTr(r, cols)));
   tbl.appendChild(tb);
   const tv = $("tableview");
   tv.innerHTML = "";
@@ -329,8 +346,45 @@ function render() {
     tv.appendChild(note);
   }
   tv.appendChild(tbl);
-  $("json").textContent =
-    rows.slice(0, maxRender).map(r => JSON.stringify(r)).join("\n");
+  renderedCols = cols;
+  renderJson();
+}
+let renderedCols = [];
+function renderJson() {
+  // the hidden pane re-serializes lazily (tab switch / next render)
+  $("json").textContent = currentTab === "json"
+    ? rows.slice(0, 2000).map(r => JSON.stringify(r)).join("\n") : "";
+}
+function rowTr(r, cols) {
+  const tr = document.createElement("tr");
+  tr.className = "row";
+  tr.innerHTML = cols.map(c => {
+    const v = r[c] === undefined ? "" : String(r[c]);
+    const cls = c === "_msg" ? "msg" : "";
+    const shown = v.length > 300 ? v.slice(0, 300) + "…" : v;
+    return `<td class="${cls}">${esc(shown)}</td>`;
+  }).join("");
+  tr.addEventListener("click", () => {
+    if (tr.nextSibling && tr.nextSibling.className === "detail") {
+      tr.nextSibling.remove(); return;
+    }
+    const d = document.createElement("tr");
+    d.className = "detail";
+    d.innerHTML = `<td colspan="${cols.length}">` +
+      esc(JSON.stringify(r, null, 2)) + "</td>";
+    tr.after(d);
+  });
+  return tr;
+}
+function appendRows(added) {
+  const tb = $("tableview").querySelector("tbody");
+  if (!tb || added.some(r =>
+      Object.keys(r).some(k => !renderedCols.includes(k)))) {
+    render();  // no table yet, or a new column appeared
+    return;
+  }
+  for (const r of added) tb.appendChild(rowTr(r, renderedCols));
+  renderJson();
 }
 function esc(s) {
   return String(s).replace(/[&<>"]/g,
@@ -339,7 +393,7 @@ function esc(s) {
 
 // ---- fields browser ----
 async function loadFields() {
-  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  const q = filterPart();
   const {start, end} = timeRange();
   try {
     const resp = await api("/select/logsql/field_names",
@@ -358,7 +412,7 @@ async function loadFields() {
   } catch (e) { setError(String(e.message || e)); }
 }
 async function loadValues(field) {
-  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  const q = filterPart();
   const {start, end} = timeRange();
   try {
     const resp = await api("/select/logsql/field_values",
@@ -374,9 +428,11 @@ async function loadValues(field) {
                     `<span class="hits">${esc(v.hits)}</span>`;
       d.addEventListener("click", () => {
         const qa = $("query");
-        const base = qa.value.trim() === "*" ? "" : qa.value.trim();
+        const [filt, pipes] = splitTopPipe(qa.value.trim() || "*");
+        const base = filt.trim() === "*" ? "" : filt.trim();
         const fl = `${field}:=${JSON.stringify(v.value)}`;
-        qa.value = base ? `${base} ${fl}` : fl;
+        qa.value = (base ? `${base} ${fl}` : fl) +
+                   (pipes ? ` ${pipes}` : "");
         run();
       });
       box.appendChild(d);
@@ -386,7 +442,7 @@ async function loadValues(field) {
 
 // ---- live tail ----
 async function startTail() {
-  const q = ($("query").value.trim() || "*").split("|")[0].trim() || "*";
+  const q = filterPart();
   tailing = true;
   $("tailbtn").classList.add("on");
   $("status").textContent = "tailing…";
@@ -397,6 +453,9 @@ async function startTail() {
     const resp = await fetch(`/select/logsql/tail?${qs({query: q})}`, {
       headers: {AccountID: t.AccountID, ProjectID: t.ProjectID},
       signal: tailAbort.signal});
+    if (!resp.ok) {
+      throw new Error(`tail: HTTP ${resp.status}: ${await resp.text()}`);
+    }
     const reader = resp.body.getReader();
     const dec = new TextDecoder();
     let buf = "";
@@ -406,13 +465,20 @@ async function startTail() {
       buf += dec.decode(value, {stream: true});
       const lines = buf.split("\n");
       buf = lines.pop();
+      const added = [];
       for (const l of lines) {
         if (!l.trim()) continue;
-        try { rows.push(JSON.parse(l)); } catch (e) {}
+        try { added.push(JSON.parse(l)); } catch (e) {}
       }
-      if (rows.length > 1000) rows = rows.slice(-1000);
+      if (!added.length) continue;
+      rows.push(...added);
+      if (rows.length > 1000) {
+        rows = rows.slice(-1000);
+        render();           // trimmed: rebuild once
+      } else {
+        appendRows(added);  // steady state: append only the new rows
+      }
       $("status").textContent = `tailing… ${rows.length} rows`;
-      render();
     }
   } catch (e) {
     if (tailing) setError(String(e.message || e));
@@ -438,6 +504,7 @@ document.querySelectorAll("#tabs button").forEach(b => {
     $("json").style.display = currentTab === "json" ? "block" : "none";
     $("fields").style.display = currentTab === "fields" ? "flex" : "none";
     if (currentTab === "fields") loadFields();
+    if (currentTab === "json") renderJson();
   });
 });
 $("run").addEventListener("click", run);
