@@ -285,3 +285,22 @@ def test_internal_select_bad_request_is_400(tmp_path):
     finally:
         node.close()
         s.close()
+
+
+def test_regex_hex_escape_literals_sound(tmp_path):
+    """\\xNN/\\uNNNN escapes decode into ONE char in the mandatory-literal
+    extraction — leaving the hex digits in the literal silently pruned
+    real matches once the native prefilter fed the CPU path."""
+    from victorialogs_tpu.logsql.filters import (regex_literal_runs,
+                                                 regex_literal_tokens)
+
+    assert regex_literal_runs(r"\x41bcdef") == ["Abcdef"]
+    assert regex_literal_runs(r"Abc") == ["Abc"]
+    assert regex_literal_runs(r"a\1b") == []       # backref: bail
+    assert regex_literal_runs(r"\012a") == []      # octal: bail
+
+    s = _mk_storage(tmp_path, ["Abcdef here", "41bcdef here", "zzz"])
+    rows = run_query_collect(s, TEN, r'_msg:~"\x41bcdef" | stats count() c',
+                             timestamp=T0)
+    assert rows[0]["c"] == "1"
+    s.close()

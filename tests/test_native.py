@@ -93,3 +93,50 @@ def test_bloom_identical_with_and_without_native(tmp_path, monkeypatch):
     b2 = without[0].get_column("_msg").bloom
     assert np.array_equal(np.sort(b1), np.sort(b2))
     assert np.array_equal(b1, b2)
+
+
+def test_phrase_scan_native_randomized_parity():
+    """The arena scan must agree with the per-row Python matchers (the
+    oracle) across modes on adversarial values: boundaries, unicode,
+    empties, repeats, pattern-at-edges."""
+    import random
+
+    import numpy as np
+
+    from victorialogs_tpu import native
+    from victorialogs_tpu.logsql.matchers import (is_word_char,
+                                                  match_exact_prefix,
+                                                  match_phrase,
+                                                  match_prefix)
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    random.seed(7)
+    words = ["err", "error", "errors", "the", "Err", "err_x", "日本", "x",
+             "a-b", "err.", ".err", "erred"]
+    vals = []
+    for i in range(4000):
+        n = random.randint(0, 6)
+        sep = random.choice([" ", "", "-", "=", "/"])
+        vals.append(sep.join(random.choice(words) for _ in range(n)))
+    vals += ["err", " err", "err ", "xerr", "errx", "", "日本err日本"]
+    bvals = [v.encode("utf-8") for v in vals]
+    lens = np.array([len(b) for b in bvals], dtype=np.int64)
+    offs = np.zeros(len(bvals), dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    arena = np.frombuffer(b"".join(bvals), dtype=np.uint8)
+
+    for pat in ["err", "error", "日本", "err.", "e", "the err"]:
+        st, et = is_word_char(pat[0]), is_word_char(pat[-1])
+        pb = pat.encode("utf-8")
+        cases = [
+            (0, st, et, lambda v: match_phrase(v, pat)),
+            (1, st, False, lambda v: match_prefix(v, pat)),
+            (2, False, False, lambda v: pat in v),
+            (3, False, False, lambda v: v == pat),
+            (4, False, False, lambda v: match_exact_prefix(v, pat)),
+        ]
+        for mode, s, e, oracle in cases:
+            got = native.phrase_scan_native(arena, offs, lens, pb,
+                                            mode, s, e)
+            want = [oracle(v) for v in vals]
+            assert got.tolist() == want, (pat, mode)

@@ -32,9 +32,10 @@ from ..storage.values_encoder import (VT_FLOAT64, VT_INT64, VT_IPV4,
 from ..utils.hashing import hash_tokens
 from ..utils.tokenizer import tokenize_string
 from ..engine.block_search import BlockSearch, visit_values
-from .matchers import (match_any_case_phrase, match_any_case_prefix,
-                       match_exact_prefix, match_ipv4_range, match_len_range,
-                       match_phrase, match_prefix, match_range, match_sequence,
+from .matchers import (is_word_char, match_any_case_phrase,
+                       match_any_case_prefix, match_exact_prefix,
+                       match_ipv4_range, match_len_range, match_phrase,
+                       match_prefix, match_range, match_sequence,
                        match_string_range, parse_ipv4, parse_number)
 
 _NUMERIC_VTS = (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64, VT_INT64,
@@ -94,11 +95,33 @@ class _ValuePredFilter(Filter):
     def _tokens(self) -> list[str]:
         return []
 
+    def _scan_spec(self) -> tuple | None:
+        """(pattern_bytes, mode, starts_tok, ends_tok) for the native
+        arena scan, or None to stay on the per-row Python path.  Modes
+        mirror tpu/kernels.py; the Python matchers remain the oracle
+        (randomized parity in tests/test_native.py)."""
+        return None
+
     def apply_to_block(self, bs: BlockSearch, bm: np.ndarray) -> None:
         fld = canonical_field(self.field)
         if _bloom_prunes(bs, fld, self._tokens()):
             bm[:] = False
             return
+        # native arena scan: one memmem pass over a packed string column
+        # instead of nrows Python predicate calls (host analogue of the
+        # device kernel; ~20-50x on phrase/prefix/exact filters)
+        spec = self._scan_spec()
+        if spec is not None and \
+                fld not in ("_time", "_stream", "_stream_id") and \
+                fld not in bs.consts():
+            col = bs.column(fld)
+            if col is not None and col.vtype == VT_STRING:
+                from .. import native
+                nb = native.phrase_scan_native(
+                    col.arena, col.offsets, col.lengths, *spec)
+                if nb is not None:
+                    bm &= nb
+                    return
         visit_values(bs, fld, bm, self._pred)
 
     def apply_to_values(self, get_values, nrows: int) -> np.ndarray:
@@ -240,6 +263,13 @@ class FilterPhrase(_ValuePredFilter):
     def _pred(self, v):
         return match_phrase(v, self.phrase)
 
+    def _scan_spec(self):
+        if not self.phrase:
+            return None
+        return (self.phrase.encode("utf-8"), 0,
+                is_word_char(self.phrase[0]),
+                is_word_char(self.phrase[-1]))
+
     def _tokens(self):
         return tokenize_string(self.phrase)
 
@@ -254,6 +284,12 @@ class FilterPrefix(_ValuePredFilter):
 
     def _pred(self, v):
         return match_prefix(v, self.prefix)
+
+    def _scan_spec(self):
+        if not self.prefix:
+            return None
+        return (self.prefix.encode("utf-8"), 1,
+                is_word_char(self.prefix[0]), False)
 
     def _tokens(self):
         # trailing partial token can't be bloom-probed
@@ -276,6 +312,11 @@ class FilterExact(_ValuePredFilter):
 
     def _pred(self, v):
         return v == self.value
+
+    def _scan_spec(self):
+        if not self.value:
+            return None
+        return (self.value.encode("utf-8"), 3, False, False)
 
     def _tokens(self):
         return tokenize_string(self.value)
@@ -303,6 +344,11 @@ class FilterExactPrefix(_ValuePredFilter):
 
     def _pred(self, v):
         return match_exact_prefix(v, self.prefix)
+
+    def _scan_spec(self):
+        if not self.prefix:
+            return None
+        return (self.prefix.encode("utf-8"), 4, False, False)
 
     def _tokens(self):
         toks = tokenize_string(self.prefix)
@@ -357,6 +403,45 @@ class FilterRegexp(_ValuePredFilter):
 
     def _tokens(self):
         return self._bloom_tokens
+
+    def apply_to_block(self, bs, bm):
+        # native literal prefilter: every match must contain ALL the
+        # regex's mandatory literal runs (filter_regexp.go:44-51), so one
+        # memmem pass per run prunes candidates and re.search runs only
+        # on survivors — decoded individually from the arena, never as a
+        # whole-column string list
+        fld = canonical_field(self.field)
+        if _bloom_prunes(bs, fld, self._tokens()):
+            bm[:] = False
+            return
+        lits = [t for t in self._substr_literals if t]
+        if lits and fld not in ("_time", "_stream", "_stream_id") and \
+                fld not in bs.consts():
+            col = bs.column(fld)
+            if col is not None and col.vtype == VT_STRING:
+                from .. import native
+                cand = None
+                for lit in lits:
+                    nb = native.phrase_scan_native(
+                        col.arena, col.offsets, col.lengths,
+                        lit.encode("utf-8"), 2, False, False)
+                    if nb is None:
+                        cand = None
+                        break
+                    cand = nb if cand is None else (cand & nb)
+                    if not cand.any():
+                        break
+                if cand is not None:
+                    bm &= cand
+                    arena, offs, lens = col.arena, col.offsets, col.lengths
+                    for i in np.nonzero(bm)[0]:
+                        o = int(offs[i])
+                        v = arena[o:o + int(lens[i])].tobytes().decode(
+                            "utf-8", "replace")
+                        if self._re.search(v) is None:
+                            bm[i] = False
+                    return
+        visit_values(bs, fld, bm, self._pred)
 
     def to_string(self):
         return f"{_q(self.field)}~{quote_str(self.pattern)}"
@@ -416,17 +501,35 @@ def _regex_literal_parts(pattern: str) -> list[tuple[str, bool, bool]]:
             # control escapes denote real characters, not the escape letter
             ctrl = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v",
                     "a": "\a", "0": "\0"}
+            if e == "0" and i + 2 < n and pattern[i + 2] in "01234567":
+                return []  # \0oo octal escape: stay conservative
             if e in ctrl:
                 if depth_unsafe == 0:
                     cur.append(ctrl[e])
                 i += 2
                 continue
-            if e and e not in "wWdDsSbBAZxu123456789":
+            # \xNN / \uNNNN / \UNNNNNNNN denote ONE character: decode it
+            # (leaving the hex digits in the literal run silently pruned
+            # real matches once this fed the native prefilter)
+            if e in ("x", "u", "U"):
+                width = {"x": 2, "u": 4, "U": 8}[e]
+                hexs = pattern[i + 2:i + 2 + width]
+                if len(hexs) != width or \
+                        any(h not in "0123456789abcdefABCDEF"
+                            for h in hexs):
+                    return []  # malformed; re.compile rejects it anyway
+                if depth_unsafe == 0:
+                    cur.append(chr(int(hexs, 16)))
+                i += 2 + width
+                continue
+            if e in "123456789":
+                return []  # backreference: its text is unknown
+            if e and e not in "wWdDsSbBAZ":
                 if depth_unsafe == 0:
                     cur.append(e)
                 i += 2
                 continue
-            # class escapes / numeric escapes: unknown chars — break literal
+            # class escapes: unknown chars — break literal
             cur = _flush_literal(cur, literals, drop_last=True)
             i += 2
             continue

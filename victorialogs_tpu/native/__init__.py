@@ -69,6 +69,10 @@ def _load():
         lib.vl_unique_token_hashes.restype = i64
         lib.vl_xxh64.argtypes = [p_u8, i64, u64]
         lib.vl_xxh64.restype = u64
+        i32 = ctypes.c_int32
+        lib.vl_phrase_scan.argtypes = [p_u8, p_i64, p_i64, i64, p_u8, i64,
+                                       i32, i32, i32, p_u8]
+        lib.vl_phrase_scan.restype = None
         _lib = lib
         return _lib
 
@@ -97,6 +101,32 @@ def to_fixed_width_native(arena: np.ndarray, offsets: np.ndarray,
         _ptr(lengths, ctypes.c_int64), len(offsets),
         _ptr(out, ctypes.c_uint8), rb, w)
     return out
+
+
+def phrase_scan_native(arena: np.ndarray, offsets: np.ndarray,
+                       lengths: np.ndarray, pattern: bytes, mode: int,
+                       starts_tok: bool, ends_tok: bool
+                       ) -> np.ndarray | None:
+    """Arena-level scan (host analogue of the device match_scan kernel):
+    one memmem pass over the packed column instead of a Python call per
+    row.  Returns a bool[nrows] bitmap, or None when the native lib is
+    unavailable or the pattern is empty (Python path handles those)."""
+    lib = _load()
+    if lib is None or not pattern:
+        return None
+    arena = np.ascontiguousarray(arena, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    pat = np.frombuffer(pattern, dtype=np.uint8)
+    nrows = len(offsets)
+    out = np.empty(nrows, dtype=np.uint8)
+    lib.vl_phrase_scan(
+        _ptr(arena, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64), nrows,
+        _ptr(pat, ctypes.c_uint8), len(pattern),
+        mode, int(starts_tok), int(ends_tok),
+        _ptr(out, ctypes.c_uint8))
+    return out.view(np.bool_)
 
 
 def unique_token_hashes_native(arena: np.ndarray, offsets: np.ndarray,

@@ -16,9 +16,13 @@
 //
 // Build: g++ -O3 -shared -fPIC (see native/__init__.py, Makefile).
 
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE  // memmem
+#endif
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <string.h>
 
 namespace {
 
@@ -183,6 +187,72 @@ int64_t vl_unique_token_hashes(const uint8_t* arena, const int64_t* offsets,
 
 uint64_t vl_xxh64(const uint8_t* data, int64_t len, uint64_t seed) {
     return xxh64(data, (size_t)len, seed);
+}
+
+// Arena-level string scan: the host analogue of the device match_scan
+// kernel (tpu/kernels.py), byte-for-byte the same semantics as the
+// per-row Python matchers (logsql/matchers.py) that remain the oracle.
+// Modes mirror tpu/kernels.py: 0 phrase (word boundaries per
+// starts_tok/ends_tok), 1 prefix (boundary before only), 2 plain
+// substring, 3 whole-value equality, 4 value startswith.
+//
+// Substring-family modes scan the WHOLE arena once with memmem (glibc's
+// SIMD path) and map hits back to rows by binary search — a rare phrase
+// costs one pass at memory bandwidth instead of nrows Python calls.
+// Word-boundary checks run on bytes: UTF-8 continuation bytes are >=
+// 0x80 and count as word chars exactly like the Python matcher treats
+// their characters, and an ASCII pattern can never match mid-codepoint.
+void vl_phrase_scan(const uint8_t* arena, const int64_t* offsets,
+                    const int64_t* lengths, int64_t nrows,
+                    const uint8_t* pat, int64_t pat_len,
+                    int32_t mode, int32_t starts_tok, int32_t ends_tok,
+                    uint8_t* out_bm) {
+    std::memset(out_bm, 0, (size_t)nrows);
+    if (pat_len <= 0) return;  // caller keeps empty patterns on the
+                               // Python path (match-all / match-empty)
+    if (mode == 3 || mode == 4) {           // exact / exact-prefix
+        for (int64_t r = 0; r < nrows; r++) {
+            const int64_t len = lengths[r];
+            if (len < pat_len || (mode == 3 && len != pat_len)) continue;
+            if (std::memcmp(arena + offsets[r], pat, (size_t)pat_len)
+                    == 0) {
+                out_bm[r] = 1;
+            }
+        }
+        return;
+    }
+    const int64_t total =
+        nrows ? offsets[nrows - 1] + lengths[nrows - 1] : 0;
+    const uint8_t* base = arena;
+    const uint8_t* end = arena + total;
+    const uint8_t* p = base;
+    int64_t row = 0;
+    while (p < end) {
+        const uint8_t* q = (const uint8_t*)memmem(
+            p, (size_t)(end - p), pat, (size_t)pat_len);
+        if (q == nullptr) break;
+        const int64_t pos = q - base;
+        // advance the row cursor (hits arrive in increasing pos)
+        while (row + 1 < nrows && offsets[row + 1] <= pos) row++;
+        const int64_t r_start = offsets[row];
+        const int64_t r_end = r_start + lengths[row];
+        if (pos + pat_len <= r_end && !out_bm[row]) {
+            bool ok = true;
+            if (mode != 2) {
+                if (starts_tok && pos > r_start &&
+                        word_char(base[pos - 1])) {
+                    ok = false;
+                }
+                if (ok && mode == 0 && ends_tok &&
+                        pos + pat_len < r_end &&
+                        word_char(base[pos + pat_len])) {
+                    ok = false;
+                }
+            }
+            if (ok) out_bm[row] = 1;
+        }
+        p = q + 1;
+    }
 }
 
 }  // extern "C"

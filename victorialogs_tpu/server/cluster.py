@@ -29,8 +29,6 @@ import threading
 import time
 import urllib.request
 
-import zstandard
-
 from ..engine.block_result import BlockResult
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
@@ -40,11 +38,10 @@ from ..utils.hashing import stream_id_hash
 PROTOCOL_VERSION = "v1"
 CIRCUIT_BREAK_SECONDS = 10.0
 
-_zc = zstandard.ZstdCompressor(level=1)
-
-
-def _zd() -> zstandard.ZstdDecompressor:
-    return zstandard.ZstdDecompressor()
+# frames are written/read from many response and fetch threads; the
+# utils.zstd helpers keep per-thread contexts (zstd objects are not
+# thread-safe)
+from ..utils import zstd as _zstd
 
 
 # ---------------- stats split pipes ----------------
@@ -142,7 +139,7 @@ def split_query(q):
 # ---------------- framing ----------------
 
 def write_frame(obj) -> bytes:
-    payload = _zc.compress(json.dumps(obj, ensure_ascii=False,
+    payload = _zstd.compress(json.dumps(obj, ensure_ascii=False,
                                       separators=(",", ":")).encode("utf-8"))
     return struct.pack(">I", len(payload)) + payload
 
@@ -165,7 +162,7 @@ def read_frames(fp):
             if not chunk:
                 raise IOError("truncated frame payload")
             payload += chunk
-        yield json.loads(_zd().decompress(payload, max_output_size=1 << 30))
+        yield json.loads(_zstd.decompress(payload, max_output_size=1 << 30))
 
 
 # ---------------- server side: /internal/select/query ----------------
@@ -229,7 +226,7 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
     if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
         raise ValueError(f"unsupported protocol version "
                          f"{args.get('version')!r}")
-    data = _zd().decompress(body, max_output_size=1 << 30)
+    data = _zstd.decompress(body, max_output_size=1 << 30)
     lr = LogRows()
     n = 0
     for line in data.splitlines():
@@ -289,7 +286,7 @@ class NetInsertStorage:
                 separators=(",", ":")))
         errors = []
         for node, lines in batches.items():
-            body = _zc.compress(("\n".join(lines)).encode("utf-8"))
+            body = _zstd.compress(("\n".join(lines)).encode("utf-8"))
             if not self._send(node, body):
                 # re-route to any healthy node (data locality is a
                 # preference, not a correctness requirement)

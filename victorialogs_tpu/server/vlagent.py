@@ -23,18 +23,16 @@ import threading
 import time
 import urllib.request
 
-import zstandard
-
 from ..storage.log_rows import LogRows
 from ..utils.persistentqueue import PersistentQueue
 from .cluster import PROTOCOL_VERSION
 from .insertutil import LogRowsStorage
 
-_zc = zstandard.ZstdCompressor(level=1)
-
-
 def encode_rows(lr: LogRows) -> bytes:
-    """Native wire block (same format /internal/insert consumes)."""
+    """Native wire block (same format /internal/insert consumes).
+
+    Thread-local compressor (utils.zstd): zstd objects are not
+    thread-safe and ingest handlers encode from many HTTP threads."""
     lines = []
     for i in range(len(lr)):
         ten = lr.tenants[i]
@@ -42,7 +40,8 @@ def encode_rows(lr: LogRows) -> bytes:
             "t": lr.timestamps[i], "a": ten.account_id,
             "p": ten.project_id, "s": lr.stream_tags_str[i],
             "f": lr.rows[i]}, ensure_ascii=False, separators=(",", ":")))
-    return _zc.compress(("\n".join(lines)).encode("utf-8"))
+    from ..utils import zstd as _zstd
+    return _zstd.compress(("\n".join(lines)).encode("utf-8"))
 
 
 class RemoteWriteClient:

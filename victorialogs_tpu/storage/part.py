@@ -65,17 +65,15 @@ _NUM_DTYPES = {
     VT_IPV4: np.uint32, VT_TIMESTAMP_ISO8601: np.int64,
 }
 
-_zc = zstandard.ZstdCompressor(level=1)
-_zc_hi = zstandard.ZstdCompressor(level=3)
-_zd = zstandard.ZstdDecompressor()
+from ..utils import zstd as _zstd
 
 
 def _compress(data: bytes, hi: bool = False) -> bytes:
-    return (_zc_hi if hi else _zc).compress(data)
+    return _zstd.compress(data, level=3 if hi else 1)
 
 
 def _decompress(data: bytes) -> bytes:
-    return _zd.decompress(data)
+    return _zstd.decompress(data)
 
 
 def write_part(path: str, blocks, big: bool = False) -> None:
