@@ -140,3 +140,38 @@ def test_phrase_scan_native_randomized_parity():
                                             mode, s, e)
             want = [oracle(v) for v in vals]
             assert got.tolist() == want, (pat, mode)
+
+
+def test_ordered_pair_scan_parity():
+    """`A.*B` native decision vs re.search oracle, incl. newline rows,
+    B-before-A, overlapping occurrences, and A==B."""
+    import re
+
+    import numpy as np
+
+    from victorialogs_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    vals = ["alpha beta", "beta alpha", "alpha x beta y", "alphabeta",
+            "alpha\nbeta", "beta\nalpha beta", "alpha", "beta", "",
+            "alpha beta alpha", "aalphaa abetaa", "alpha alpha beta"]
+    for a, b in [("alpha", "beta"), ("beta", "alpha"),
+                 ("alpha", "alpha"), ("a", "a")]:
+        rx = re.compile(re.escape(a) + ".*" + re.escape(b))
+        bvals = [v.encode() for v in vals]
+        lens = np.array([len(x) for x in bvals], dtype=np.int64)
+        offs = np.zeros(len(bvals), dtype=np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        arena = np.frombuffer(b"".join(bvals), dtype=np.uint8)
+        definite, verify = native.ordered_pair_scan_native(
+            arena, offs, lens, a.encode(), b.encode())
+        for i, v in enumerate(vals):
+            want = rx.search(v) is not None
+            if definite[i]:
+                assert want, (a, b, v)          # definite => really matches
+            elif verify[i]:
+                pass                            # decided by re.search
+            else:
+                assert not want, (a, b, v)      # rejected => really absent
+            got = bool(definite[i]) or (bool(verify[i]) and want)
+            assert got == want, (a, b, v)

@@ -177,6 +177,21 @@ class BlockResult:
             return col.nums[self._sel], False
         return None
 
+    def const_value(self, name: str) -> str | None:
+        """The single value of a column KNOWN constant across this block
+        (const columns; _stream/_stream_id are per-block constants by
+        construction), or None."""
+        if self._bs is None or name in self._cols or self.nrows == 0:
+            return None
+        c = self._bs.consts().get(name)
+        if c is not None:
+            return c
+        if name == "_stream":
+            return self._bs.stream_tags_str
+        if name == "_stream_id":
+            return self._bs.stream_id.as_string()
+        return None
+
     def dict_column(self, name: str):
         """(selected dict ids uint8, dict value strings) for a
         dict-encoded column, or None — lets group-by factorize through

@@ -102,6 +102,19 @@ class _ValuePredFilter(Filter):
         (randomized parity in tests/test_native.py)."""
         return None
 
+    @staticmethod
+    def _scan_column(bs: BlockSearch, fld: str):
+        """The VT_STRING column eligible for a native arena scan, or None
+        (special fields, consts, dict/numeric encodings stay on the
+        per-value Python path that visit_values optimizes already)."""
+        if fld in ("_time", "_stream", "_stream_id") or \
+                fld in bs.consts():
+            return None
+        col = bs.column(fld)
+        if col is None or col.vtype != VT_STRING:
+            return None
+        return col
+
     def apply_to_block(self, bs: BlockSearch, bm: np.ndarray) -> None:
         fld = canonical_field(self.field)
         if _bloom_prunes(bs, fld, self._tokens()):
@@ -111,11 +124,9 @@ class _ValuePredFilter(Filter):
         # instead of nrows Python predicate calls (host analogue of the
         # device kernel; ~20-50x on phrase/prefix/exact filters)
         spec = self._scan_spec()
-        if spec is not None and \
-                fld not in ("_time", "_stream", "_stream_id") and \
-                fld not in bs.consts():
-            col = bs.column(fld)
-            if col is not None and col.vtype == VT_STRING:
+        if spec is not None:
+            col = self._scan_column(bs, fld)
+            if col is not None:
                 from .. import native
                 nb = native.phrase_scan_native(
                     col.arena, col.offsets, col.lengths, *spec)
@@ -397,6 +408,14 @@ class FilterRegexp(_ValuePredFilter):
         self._re = re.compile(self.pattern)
         self._substr_literals = regex_literal_runs(self.pattern)
         self._bloom_tokens = regex_literal_tokens(self.pattern)
+        # `A.*B` with literal A and B: decided per row natively (same
+        # predicate the device plan uses — tpu/batch.py device_plan)
+        parts = self.pattern.split(".*")
+        self._pair = None
+        if len(parts) == 2 and all(p and re.escape(p) == p
+                                   for p in parts):
+            self._pair = (parts[0].encode("utf-8"),
+                          parts[1].encode("utf-8"))
 
     def _pred(self, v):
         return self._re.search(v) is not None
@@ -415,33 +434,46 @@ class FilterRegexp(_ValuePredFilter):
             bm[:] = False
             return
         lits = [t for t in self._substr_literals if t]
-        if lits and fld not in ("_time", "_stream", "_stream_id") and \
-                fld not in bs.consts():
-            col = bs.column(fld)
-            if col is not None and col.vtype == VT_STRING:
-                from .. import native
-                cand = None
-                for lit in lits:
-                    nb = native.phrase_scan_native(
-                        col.arena, col.offsets, col.lengths,
-                        lit.encode("utf-8"), 2, False, False)
-                    if nb is None:
-                        cand = None
-                        break
-                    cand = nb if cand is None else (cand & nb)
-                    if not cand.any():
-                        break
-                if cand is not None:
-                    bm &= cand
-                    arena, offs, lens = col.arena, col.offsets, col.lengths
-                    for i in np.nonzero(bm)[0]:
-                        o = int(offs[i])
-                        v = arena[o:o + int(lens[i])].tobytes().decode(
-                            "utf-8", "replace")
-                        if self._re.search(v) is None:
-                            bm[i] = False
+        col = self._scan_column(bs, fld) if (lits or self._pair) else None
+        if col is not None:
+            from .. import native
+            if self._pair is not None:
+                got = native.ordered_pair_scan_native(
+                    col.arena, col.offsets, col.lengths, *self._pair)
+                if got is not None:
+                    definite, verify = got
+                    bm &= definite | verify
+                    self._verify_rows(col, bm, verify)
                     return
+            cand = None
+            for lit in lits:
+                nb = native.phrase_scan_native(
+                    col.arena, col.offsets, col.lengths,
+                    lit.encode("utf-8"), 2, False, False)
+                if nb is None:
+                    cand = None
+                    break
+                cand = nb if cand is None else (cand & nb)
+                if not cand.any():
+                    break
+            if cand is not None:
+                bm &= cand
+                self._verify_rows(col, bm, None)
+                return
         visit_values(bs, fld, bm, self._pred)
+
+    def _verify_rows(self, col, bm, only) -> None:
+        """re.search survivors, decoded row-by-row from the arena.
+        only: optional mask restricting which set rows need verification
+        (rows outside it are already definite matches)."""
+        arena, offs, lens = col.arena, col.offsets, col.lengths
+        check = bm & only if only is not None else bm
+        for i in np.nonzero(check)[0]:
+            o = int(offs[i])
+            v = arena[o:o + int(lens[i])].tobytes().decode(
+                "utf-8", "replace")
+            if self._re.search(v) is None:
+                bm[i] = False
 
     def to_string(self):
         return f"{_q(self.field)}~{quote_str(self.pattern)}"

@@ -397,8 +397,40 @@ class StatsCountUniq(StatsFunc):
     def new_state(self):
         return set()
 
+    def block_cols(self, br):
+        # typed lazy shapes (exact type: the hash subclass walks rows):
+        # a block-constant column (consts, _stream, _stream_id) is ONE
+        # candidate value; a dict column is at most its <=8 code values
+        if type(self) is StatsCountUniq and len(self.fields) == 1 and \
+                hasattr(br, "const_value"):
+            f = self.fields[0]
+            v = br.const_value(f)
+            if v is not None:
+                return [("__const__", v)]
+            dc = br.dict_column(f)
+            if dc is not None:
+                return [("__dict__", dc)]
+        return [br.column(f) for f in self.fields]
+
     def update(self, state, cols, idxs):
         if self.limit and len(state) >= self.limit:
+            return state
+        if len(cols) == 1 and isinstance(cols[0], tuple):
+            import numpy as np
+            kind, payload = cols[0]
+            if kind == "__const__":
+                if len(idxs) and payload != "" and \
+                        (payload,) not in state:
+                    state.add((payload,))
+                    self._charge(len(payload) + 64)
+                return state
+            ids, dvals = payload
+            sub = ids if len(idxs) == ids.shape[0] else ids[list(idxs)]
+            for j in np.unique(sub):
+                v = dvals[j]
+                if v != "" and (v,) not in state:
+                    state.add((v,))
+                    self._charge(len(v) + 64)
             return state
         if len(cols) == 1:
             # single-field fast path: set ops run at C speed (the common

@@ -55,24 +55,34 @@ def _load():
             return None
         i64 = ctypes.c_int64
         u64 = ctypes.c_uint64
+        i32 = ctypes.c_int32
         p_u8 = ctypes.POINTER(ctypes.c_uint8)
         p_i64 = ctypes.POINTER(ctypes.c_int64)
         p_u64 = ctypes.POINTER(ctypes.c_uint64)
-        lib.vl_to_fixed_width.argtypes = [p_u8, p_i64, p_i64, i64, p_u8,
-                                          i64, i64]
-        lib.vl_to_fixed_width.restype = None
-        lib.vl_tokenize_arena.argtypes = [p_u8, p_i64, p_i64, i64, p_i64,
-                                          p_i64, p_i64, i64]
-        lib.vl_tokenize_arena.restype = i64
-        lib.vl_unique_token_hashes.argtypes = [p_u8, p_i64, p_i64, i64,
-                                               p_u64, i64]
-        lib.vl_unique_token_hashes.restype = i64
-        lib.vl_xxh64.argtypes = [p_u8, i64, u64]
-        lib.vl_xxh64.restype = u64
-        i32 = ctypes.c_int32
-        lib.vl_phrase_scan.argtypes = [p_u8, p_i64, p_i64, i64, p_u8, i64,
-                                       i32, i32, i32, p_u8]
-        lib.vl_phrase_scan.restype = None
+        try:
+            lib.vl_to_fixed_width.argtypes = [p_u8, p_i64, p_i64, i64,
+                                              p_u8, i64, i64]
+            lib.vl_to_fixed_width.restype = None
+            lib.vl_tokenize_arena.argtypes = [p_u8, p_i64, p_i64, i64,
+                                              p_i64, p_i64, p_i64, i64]
+            lib.vl_tokenize_arena.restype = i64
+            lib.vl_unique_token_hashes.argtypes = [p_u8, p_i64, p_i64, i64,
+                                                   p_u64, i64]
+            lib.vl_unique_token_hashes.restype = i64
+            lib.vl_xxh64.argtypes = [p_u8, i64, u64]
+            lib.vl_xxh64.restype = u64
+            lib.vl_phrase_scan.argtypes = [p_u8, p_i64, p_i64, i64, p_u8,
+                                           i64, i32, i32, i32, p_u8]
+            lib.vl_phrase_scan.restype = None
+            lib.vl_ordered_pair_scan.argtypes = [p_u8, p_i64, p_i64, i64,
+                                                 p_u8, i64, p_u8, i64,
+                                                 p_u8, p_u8]
+            lib.vl_ordered_pair_scan.restype = None
+        except AttributeError:
+            # a stale .so without the newer symbols (mtime tricked the
+            # rebuild check): degrade to the Python paths instead of
+            # failing the first query
+            return None
         _lib = lib
         return _lib
 
@@ -127,6 +137,32 @@ def phrase_scan_native(arena: np.ndarray, offsets: np.ndarray,
         mode, int(starts_tok), int(ends_tok),
         _ptr(out, ctypes.c_uint8))
     return out.view(np.bool_)
+
+
+def ordered_pair_scan_native(arena: np.ndarray, offsets: np.ndarray,
+                             lengths: np.ndarray, pat_a: bytes,
+                             pat_b: bytes
+                             ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Per-row `A.*B` decision (host analogue of match_ordered_pair):
+    (definite_match bool[n], needs_verify bool[n]) or None."""
+    lib = _load()
+    if lib is None or not pat_a or not pat_b:
+        return None
+    arena = np.ascontiguousarray(arena, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    a = np.frombuffer(pat_a, dtype=np.uint8)
+    b = np.frombuffer(pat_b, dtype=np.uint8)
+    nrows = len(offsets)
+    out_m = np.empty(nrows, dtype=np.uint8)
+    out_v = np.empty(nrows, dtype=np.uint8)
+    lib.vl_ordered_pair_scan(
+        _ptr(arena, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64), nrows,
+        _ptr(a, ctypes.c_uint8), len(pat_a),
+        _ptr(b, ctypes.c_uint8), len(pat_b),
+        _ptr(out_m, ctypes.c_uint8), _ptr(out_v, ctypes.c_uint8))
+    return out_m.view(np.bool_), out_v.view(np.bool_)
 
 
 def unique_token_hashes_native(arena: np.ndarray, offsets: np.ndarray,

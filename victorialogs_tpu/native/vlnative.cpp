@@ -255,4 +255,37 @@ void vl_phrase_scan(const uint8_t* arena, const int64_t* offsets,
     }
 }
 
+// `A.*B` regex family, decided per row (host analogue of the device
+// match_ordered_pair kernel): a row DEFINITELY matches /A.*B/ when the
+// first A occurrence ends at or before the last B occurrence and the row
+// has no newline ('.' does not cross newlines); rows that are ordered
+// but contain a newline are flagged for re.search verification.
+void vl_ordered_pair_scan(const uint8_t* arena, const int64_t* offsets,
+                          const int64_t* lengths, int64_t nrows,
+                          const uint8_t* pat_a, int64_t len_a,
+                          const uint8_t* pat_b, int64_t len_b,
+                          uint8_t* out_match, uint8_t* out_verify) {
+    std::memset(out_match, 0, (size_t)nrows);
+    std::memset(out_verify, 0, (size_t)nrows);
+    if (len_a <= 0 || len_b <= 0) return;
+    for (int64_t r = 0; r < nrows; r++) {
+        const uint8_t* row = arena + offsets[r];
+        const size_t len = (size_t)lengths[r];
+        if ((int64_t)len < len_a + len_b) continue;
+        const uint8_t* a = (const uint8_t*)memmem(row, len, pat_a,
+                                                  (size_t)len_a);
+        if (a == nullptr) continue;
+        const size_t after = (size_t)(a - row) + (size_t)len_a;
+        if (len < after + (size_t)len_b) continue;
+        const uint8_t* b = (const uint8_t*)memmem(row + after, len - after,
+                                                  pat_b, (size_t)len_b);
+        if (b == nullptr) continue;
+        if (memchr(row, '\n', len) != nullptr) {
+            out_verify[r] = 1;   // '.' must not cross the newline: verify
+        } else {
+            out_match[r] = 1;
+        }
+    }
+}
+
 }  // extern "C"

@@ -19,7 +19,6 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import zstandard
 
 from ..engine.searcher import QueryTimeoutError
 from ..storage.storage import Storage
@@ -99,7 +98,8 @@ class BaseHTTPApp:
                     if enc == "gzip":
                         body = gzip.decompress(body)
                     elif enc == "zstd":
-                        body = zstandard.ZstdDecompressor().decompress(
+                        from ..utils import zstd as _zstd
+                        body = _zstd.decompress(
                             body, max_output_size=1 << 30)
                     elif enc == "deflate":
                         import zlib

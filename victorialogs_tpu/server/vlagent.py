@@ -24,6 +24,7 @@ import time
 import urllib.request
 
 from ..storage.log_rows import LogRows
+from ..utils import zstd as _zstd
 from ..utils.persistentqueue import PersistentQueue
 from .cluster import PROTOCOL_VERSION
 from .insertutil import LogRowsStorage
@@ -40,7 +41,6 @@ def encode_rows(lr: LogRows) -> bytes:
             "t": lr.timestamps[i], "a": ten.account_id,
             "p": ten.project_id, "s": lr.stream_tags_str[i],
             "f": lr.rows[i]}, ensure_ascii=False, separators=(",", ":")))
-    from ..utils import zstd as _zstd
     return _zstd.compress(("\n".join(lines)).encode("utf-8"))
 
 

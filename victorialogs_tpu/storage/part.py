@@ -34,8 +34,8 @@ import os
 from dataclasses import dataclass
 
 import numpy as np
-import zstandard
 
+from ..utils import zstd as _zstd
 from .block import BlockData
 from .log_rows import StreamID, TenantID
 from .values_encoder import (EncodedColumn, VT_DICT, VT_FLOAT64, VT_INT64,
@@ -64,9 +64,6 @@ _NUM_DTYPES = {
     VT_UINT64: np.uint64, VT_INT64: np.int64, VT_FLOAT64: np.float64,
     VT_IPV4: np.uint32, VT_TIMESTAMP_ISO8601: np.int64,
 }
-
-from ..utils import zstd as _zstd
-
 
 def _compress(data: bytes, hi: bool = False) -> bytes:
     return _zstd.compress(data, level=3 if hi else 1)

@@ -32,8 +32,8 @@ import os
 import struct
 
 import numpy as np
-import zstandard
 
+from ..utils import zstd as _zstd
 from .log_rows import StreamID, TenantID
 from .stream_filter import parse_stream_tags
 
@@ -128,8 +128,8 @@ def write_snapshot(path: str, streams: dict, log_offset: int) -> None:
         "n": n, "tenants": tenants, "arrays": ameta,
         "labels": labels_meta, "log_offset": log_offset,
     }, separators=(",", ":")).encode("utf-8")
-    payload = zstandard.ZstdCompressor(level=3).compress(
-        struct.pack(">I", len(header)) + header + blob)
+    payload = _zstd.compress(
+        struct.pack(">I", len(header)) + header + blob, level=3)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(SNAP_MAGIC)
@@ -181,8 +181,7 @@ class StreamSnapshot:
             magic = f.read(len(SNAP_MAGIC))
             if magic != SNAP_MAGIC:
                 raise ValueError("bad snapshot magic")
-            raw = zstandard.ZstdDecompressor().decompress(
-                f.read(), max_output_size=1 << 33)
+            raw = _zstd.decompress(f.read(), max_output_size=1 << 33)
         hlen = struct.unpack(">I", raw[:4])[0]
         hdr = json.loads(raw[4:4 + hlen])
         blob = memoryview(raw)[4 + hlen:]
