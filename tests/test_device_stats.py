@@ -70,7 +70,12 @@ STATS_QUERIES = [
     "* | stats sum(ratio) s",                   # float column: host path
     "* | stats by (_time:5m) count() if (deadline) c",  # iff: fallback
     "* | stats by (_time:5m) count_uniq(app) u",        # ineligible func
-    "* | stats by (app) count() c",             # non-time by: fallback
+    "* | stats by (app) count() c",             # dict-column group-by
+    "* | stats by (app) sum(dur) s, min(dur) mn, max(dur) mx",
+    "* | stats by (app, _time:10m) count() c, sum(dur) s",
+    "deadline | stats by (_time:5m, app) count() c",    # axis order
+    "* | stats by (app, lvlmissing) count() c",         # absent field -> ''
+    "* | stats by (_stream) count() c",         # special field: fallback
     "nosuchtoken | stats count() c",            # empty result
     "_time:[2025-07-28T00:00:00Z, 2025-07-28T00:10:00Z] | stats "
     "by (_time:1m) rate() r",
@@ -167,3 +172,17 @@ def test_exact_large_sums(tmp_path):
         assert dev[0]["s"] == str(exp)
     finally:
         s.close()
+
+
+def test_dict_group_by_engages_device(storage):
+    """`by (app)` and `by (app, _time:...)` run as device partials, not
+    host fallback."""
+    runner = BatchRunner()
+    run_query_collect(storage, [TEN], "* | stats by (app) count() c",
+                      timestamp=T0, runner=runner)
+    n1 = runner.stats_dispatches
+    assert n1 > 0
+    run_query_collect(storage, [TEN],
+                      "* | stats by (app, _time:10m) sum(dur) s",
+                      timestamp=T0, runner=runner)
+    assert runner.stats_dispatches > n1

@@ -281,12 +281,16 @@ def _eval_block_cpu(q, bs):
 
 
 def _absorb_stats_partials(head, q, spec, partials) -> None:
-    """Fold device per-bucket partials into the stats processor."""
+    """Fold device per-bucket partials into the stats processor.
+
+    key_parts elements: ("t", bucket_ns) -> RFC3339 (identical to the
+    host bucketing), ("v", value) -> the group value string."""
     from ..tpu.stats_device import build_partial_states
     from .block_result import format_rfc3339
     ps = q.pipes[0]
-    for bucket_value, cnt, field_stats in partials:
-        key = (format_rfc3339(bucket_value),) if spec.by_time else ()
+    for key_parts, cnt, field_stats in partials:
+        key = tuple(format_rfc3339(v) if kind == "t" else v
+                    for kind, v in key_parts)
         states = build_partial_states(spec, ps.funcs, key, cnt,
                                       field_stats)
         head.absorb_partials(key, states)

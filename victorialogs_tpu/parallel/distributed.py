@@ -122,13 +122,15 @@ def shard_batch(mesh: Mesh, *arrays):
 
 # ---------------- the multi-chip product runner ----------------
 
-@partial(jax.jit, static_argnames=("num_buckets", "mesh"))
-def _stats_values_mesh(mesh, values, bucket_ids, mask, num_buckets):
+@partial(jax.jit, static_argnames=("num_buckets", "strides", "mesh"))
+def _stats_values_mesh(mesh, values, ids_tuple, strides, mask,
+                       num_buckets):
     """Sharded stats partials: each device reduces its row shard with the
     same chunked kernel body, then count/sums ride psum and min/max ride
     pmin/pmax over ICI — the mesh analogue of the reference's mergeState
     (pipe_stats.go:354-377)."""
-    def shard_fn(v, b, m):
+    def shard_fn(v, ids, m):
+        b = K.combine_ids(ids, strides)
         cnt, sums, lo, hi = K.stats_values_local(v, b, m, num_buckets,
                                                  vary_axes=(BLOCK_AXIS,))
         cnt = jax.lax.psum(cnt, BLOCK_AXIS)
@@ -138,22 +140,25 @@ def _stats_values_mesh(mesh, values, bucket_ids, mask, num_buckets):
         return K.pack_stats(cnt, sums, lo, hi)
 
     spec = P(BLOCK_AXIS)
-    return jax.shard_map(shard_fn, mesh=mesh,
-                         in_specs=(spec, spec, spec),
-                         out_specs=P())(values, bucket_ids, mask)
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, tuple(spec for _ in ids_tuple), spec),
+        out_specs=P())(values, ids_tuple, mask)
 
 
-@partial(jax.jit, static_argnames=("num_buckets", "mesh"))
-def _stats_count_mesh(mesh, bucket_ids, mask, num_buckets):
-    def shard_fn(b, m):
+@partial(jax.jit, static_argnames=("num_buckets", "strides", "mesh"))
+def _stats_count_mesh(mesh, ids_tuple, strides, mask, num_buckets):
+    def shard_fn(ids, m):
+        b = K.combine_ids(ids, strides)
         cnt = K.stats_count_local(b, m, num_buckets,
                                   vary_axes=(BLOCK_AXIS,))
         return jax.lax.psum(cnt, BLOCK_AXIS)
 
     spec = P(BLOCK_AXIS)
-    return jax.shard_map(shard_fn, mesh=mesh,
-                         in_specs=(spec, spec),
-                         out_specs=P())(bucket_ids, mask)
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(tuple(spec for _ in ids_tuple), spec),
+        out_specs=P())(ids_tuple, mask)
 
 
 class MeshBatchRunner(BatchRunner):
@@ -187,9 +192,11 @@ class MeshBatchRunner(BatchRunner):
             return jax.device_put(arr, self._row_sharding)
         return jax.device_put(arr, self._replicated)
 
-    def _dispatch_stats_count(self, ids, mask, nb):
-        return np.array(_stats_count_mesh(self.mesh, ids, mask, nb))
+    def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
+        return np.array(_stats_count_mesh(self.mesh, ids_tuple, strides,
+                                          mask, nb))
 
-    def _dispatch_stats_values(self, values, ids, mask, nb):
-        return np.array(_stats_values_mesh(self.mesh, values, ids, mask,
-                                           nb))
+    def _dispatch_stats_values(self, values, ids_tuple, strides, mask,
+                               nb):
+        return np.array(_stats_values_mesh(self.mesh, values, ids_tuple,
+                                           strides, mask, nb))

@@ -131,11 +131,11 @@ def device_plan(f) -> LeafPlan | None:
         # `A.*B` with literal A and B: decided fully on device (positions +
         # newline guard — kernels.match_ordered_pair); only rows containing
         # a newline fall back to host re.search
-        parts = f.pattern.split(".*")
-        if len(parts) == 2 and all(p and ok(p) and re.escape(p) == p
-                                   for p in parts):
+        pair = getattr(f, "_pair", None)  # computed once in __post_init__
+        if pair is not None and all(len(p) <= K.MAX_PATTERN_LEN
+                                    for p in pair):
             return LeafPlan(f, cf(f.field), [], "and", f._tokens(),
-                            pair=(parts[0].encode(), parts[1].encode()))
+                            pair=pair)
         # full literal RUNS (partial words included) are sound for plain
         # substring prefilters; word tokens stay for the bloom kill-path
         literals = [t for t in getattr(f, "_substr_literals", []) if ok(t)]
@@ -332,6 +332,65 @@ class StagedBuckets:
         return self.nbytes
 
 
+@dataclass
+class StagedDict:
+    """A group-by column staged as per-row GLOBAL dict codes.
+
+    Eligible blocks are dict-encoded, const, or missing (missing/const
+    map every row to one code; '' is a value like any other, matching the
+    host's group-by semantics for absent fields)."""
+    ids: object                    # jax int32[Rp]
+    values: list                   # code -> value string (this part)
+    eligible: frozenset            # block idxs covered
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_dict_codes(part, field: str, layout: StatsLayout,
+                     put=None) -> StagedDict | None:
+    """Stage one group-by column as int32 global codes per row."""
+    import jax.numpy as jnp
+    from ..storage.values_encoder import VT_DICT
+    if put is None:
+        put = jnp.asarray
+
+    ids = np.zeros(layout.nrows_padded, dtype=np.int32)
+    values: list[str] = []
+    code_of: dict[str, int] = {}
+
+    def code(v: str) -> int:
+        c = code_of.get(v)
+        if c is None:
+            c = code_of[v] = len(values)
+            values.append(v)
+        return c
+
+    eligible = []
+    for bi in range(part.num_blocks):
+        start = layout.starts[bi]
+        n = part.block_rows(bi)
+        meta = part.block_column_meta(bi, field)
+        if meta is None:
+            consts = dict(part.block_consts(bi))
+            ids[start:start + n] = code(consts.get(field, ""))
+            eligible.append(bi)
+            continue
+        if meta["t"] != VT_DICT:
+            continue  # string/numeric-encoded: host path for this block
+        col = part.block_column(bi, field)
+        remap = np.fromiter((code(v) for v in col.dict_values),
+                            dtype=np.int32, count=len(col.dict_values))
+        ids[start:start + n] = remap[col.ids]
+        eligible.append(bi)
+    if not eligible:
+        return None
+    return StagedDict(ids=put(ids), values=values,
+                      eligible=frozenset(eligible),
+                      nbytes=layout.nrows_padded * 4)
+
+
 def part_stats_layout(part, shards: int = 1) -> StatsLayout:
     """shards: pad rows to a (STATS_CHUNK * shards) multiple so a mesh
     runner can split the row axis evenly with whole chunks per device."""
@@ -512,9 +571,12 @@ class BatchRunner:
                     for fld in stats_spec.value_fields:
                         self._stage_numeric(part, fld, layout,
                                             MAX_ABS_TIMES_ROWS)
-                    if stats_spec.by_time:
-                        self._stage_buckets(part, layout, stats_spec.step,
-                                            stats_spec.offset, MAX_BUCKETS)
+                    for bk in stats_spec.by:
+                        if bk.kind == "time":
+                            self._stage_buckets(part, layout, bk.step,
+                                                bk.offset, MAX_BUCKETS)
+                        else:
+                            self._stage_dict(part, bk.name, layout)
             except Exception:
                 pass  # prefetch is best-effort; the scan path re-stages
         self._prefetcher().submit(work)
@@ -525,11 +587,14 @@ class BatchRunner:
         return jnp.asarray(arr)
 
     # ---- stats dispatch hooks (MeshBatchRunner shard_maps + psum-reduces)
-    def _dispatch_stats_count(self, ids, mask, nb):
-        return np.array(K.stats_bucket_count(ids, mask, nb))
+    def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
+        return np.array(K.stats_bucket_count(ids_tuple, strides, mask,
+                                             nb))
 
-    def _dispatch_stats_values(self, values, ids, mask, nb):
-        return np.array(K.stats_bucket_values(values, ids, mask, nb))
+    def _dispatch_stats_values(self, values, ids_tuple, strides, mask,
+                               nb):
+        return np.array(K.stats_bucket_values(values, ids_tuple, strides,
+                                              mask, nb))
 
     # ---- staging (cached across queries; parts are immutable) ----
     def stage_part(self, part, field: str) -> StagedPart | None:
@@ -709,6 +774,21 @@ class BatchRunner:
                     self.cache.put(key, got)
             return got
 
+    def _stage_dict(self, part, field: str, layout: StatsLayout):
+        key = (part.uid, "#dict", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
+            if got is None:
+                got = stage_dict_codes(part, field, layout,
+                                       put=self._put)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
+
     def _stage_buckets(self, part, layout: StatsLayout, step: int,
                        offset: int, max_buckets: int):
         key = (part.uid, "#tb", step, offset)
@@ -740,12 +820,14 @@ class BatchRunner:
         - bms: block_idx -> bitmap (same as run_part);
         - handled: block idxs fully accounted for by the partials (the
           caller must NOT feed them through the row path);
-        - partials: list of (bucket_value:int, count:int,
-          field_stats: dict field -> (sum:int, vmin:int, vmax:int));
-          bucket_value is `base + idx*step` ns for by-time specs, 0 else.
+        - partials: list of (key_parts, count, field_stats) where
+          key_parts follows the spec's by order with elements
+          ("t", bucket_ns) for the time axis and ("v", value_str) for
+          group-by fields, and field_stats maps
+          field -> (sum:int, vmin:int, vmax:int).
         """
-        from .stats_device import MAX_ABS_TIMES_ROWS, MAX_BUCKETS, \
-            MAX_STAT_ROWS
+        from .stats_device import (MAX_ABS_TIMES_ROWS, MAX_BUCKETS,
+                                   MAX_STAT_ROWS, combine_plane_sums)
 
         bms = self.run_part(f, part, bss)
         layout = self._stats_layout(part)
@@ -757,12 +839,39 @@ class BatchRunner:
             if sn is None:
                 return bms, set(), []
             numerics[fld] = sn
-        if spec.by_time:
-            sb = self._stage_buckets(part, layout, spec.step, spec.offset,
-                                     MAX_BUCKETS)
-            if sb is None:
-                return bms, set(), []
-            ids, base, nb = sb.ids, sb.base, sb.num_buckets
+
+        # one id axis per by key (time buckets / dict-code tables)
+        axes = []          # (kind, ids_jax, size, decode_payload)
+        eligibility = [numerics[fld].eligible
+                       for fld in spec.value_fields]
+        for bk in spec.by:
+            if bk.kind == "time":
+                sb = self._stage_buckets(part, layout, bk.step, bk.offset,
+                                         MAX_BUCKETS)
+                if sb is None:
+                    return bms, set(), []
+                axes.append(("t", sb.ids, sb.num_buckets,
+                             (sb.base, bk.step)))
+            else:
+                sd = self._stage_dict(part, bk.name, layout)
+                if sd is None:
+                    return bms, set(), []
+                axes.append(("v", sd.ids, len(sd.values), sd.values))
+                eligibility.append(sd.eligible)
+        nb = 1
+        for _k, _i, size, _p in axes:
+            nb *= size
+        if nb > MAX_BUCKETS:
+            return bms, set(), []
+        if axes:
+            ids_tuple = tuple(a[1] for a in axes)
+            # row-major strides in by order
+            strides = []
+            s = 1
+            for _k, _i, size, _p in reversed(axes):
+                strides.append(s)
+                s *= size
+            strides = tuple(reversed(strides))
         else:
             key = (part.uid, "#tb0")
             sb0 = self.cache.get(key)
@@ -773,11 +882,10 @@ class BatchRunner:
                     base=0, num_buckets=1,
                     nbytes=layout.nrows_padded * 4)
                 self.cache.put(key, sb0)
-            ids, base, nb = sb0.ids, 0, 1
+            ids_tuple, strides = (sb0.ids,), (1,)
 
         handled = {bi for bi in bss
-                   if all(bi in numerics[fld].eligible
-                          for fld in spec.value_fields)}
+                   if all(bi in el for el in eligibility)}
         if not handled:
             return bms, set(), []
         mask = np.zeros(layout.nrows_padded, dtype=bool)
@@ -792,6 +900,17 @@ class BatchRunner:
             return bms, handled, []
         mask_j = self._put(mask)
 
+        def key_parts(idx: int) -> tuple:
+            out = []
+            for (kind, _ids, size, payload), stride in zip(axes, strides):
+                k = (idx // stride) % size
+                if kind == "t":
+                    base, step = payload
+                    out.append(("t", base + k * step))
+                else:
+                    out.append(("v", payload[k]))
+            return tuple(out)
+
         if spec.value_fields:
             counts = None
             stats_np = {}
@@ -799,7 +918,7 @@ class BatchRunner:
                 self._bump("device_calls")
                 self._bump("stats_dispatches")
                 packed = self._dispatch_stats_values(
-                    numerics[fld].values, ids, mask_j, nb)
+                    numerics[fld].values, ids_tuple, strides, mask_j, nb)
                 counts = packed[0]
                 stats_np[fld] = packed
             partials = []
@@ -808,19 +927,16 @@ class BatchRunner:
                 fs = {}
                 for fld, packed in stats_np.items():
                     vmin0 = numerics[fld].vmin
-                    from .stats_device import combine_plane_sums
                     s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
                     fs[fld] = (s, int(packed[5, idx]) + vmin0,
                                int(packed[6, idx]) + vmin0)
-                partials.append((base + int(idx) * spec.step
-                                 if spec.by_time else 0, cnt, fs))
+                partials.append((key_parts(int(idx)), cnt, fs))
             return bms, handled, partials
 
         self._bump("device_calls")
         self._bump("stats_dispatches")
-        counts = self._dispatch_stats_count(ids, mask_j, nb)
-        partials = [(base + int(idx) * spec.step if spec.by_time else 0,
-                     int(counts[idx]), {})
+        counts = self._dispatch_stats_count(ids_tuple, strides, mask_j, nb)
+        partials = [(key_parts(int(idx)), int(counts[idx]), {})
                     for idx in np.nonzero(counts)[0]]
         return bms, handled, partials
 

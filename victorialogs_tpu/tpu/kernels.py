@@ -270,26 +270,38 @@ def pack_stats(cnt, sums, lo, hi) -> jnp.ndarray:
     return jnp.concatenate([cnt[None], sums, lo[None], hi[None]], axis=0)
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def stats_bucket_count(bucket_ids: jnp.ndarray, mask: jnp.ndarray,
+def combine_ids(ids_tuple, strides):
+    """Row-major combined bucket index from per-axis id arrays
+    (time buckets x group-by dict codes); computed INSIDE the jit so
+    multi-axis grouping costs no extra dispatch."""
+    c = None
+    for a, s in zip(ids_tuple, strides):
+        t = a * jnp.int32(s) if s != 1 else a
+        c = t if c is None else c + t
+    return c
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "strides"))
+def stats_bucket_count(ids_tuple, strides, mask: jnp.ndarray,
                        num_buckets: int) -> jnp.ndarray:
-    """Masked row count per bucket.
+    """Masked row count per combined bucket.
 
-    bucket_ids: int32[R] in [0, num_buckets); mask: bool[R]; R must be a
-    STATS_CHUNK multiple (pad rows masked off).  Returns uint32[B]."""
-    return stats_count_local(bucket_ids, mask, num_buckets)
+    ids_tuple: per-axis int32[R] arrays; strides: static per-axis
+    multipliers; mask: bool[R]; R must be a STATS_CHUNK multiple (pad
+    rows masked off).  Returns uint32[B]."""
+    return stats_count_local(combine_ids(ids_tuple, strides), mask,
+                             num_buckets)
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def stats_bucket_values(values: jnp.ndarray, bucket_ids: jnp.ndarray,
+@partial(jax.jit, static_argnames=("num_buckets", "strides"))
+def stats_bucket_values(values: jnp.ndarray, ids_tuple, strides,
                         mask: jnp.ndarray, num_buckets: int):
-    """count/sum/min/max partials per bucket for one uint32 value column.
-
-    values: uint32[R] (offsets from the part minimum — see stage_numeric);
+    """count/sum/min/max partials per combined bucket for one uint32
+    value column (offsets from the part minimum — see stage_numeric);
     returns uint32[7, B] packed as [count, plane_sums[0..3], vmin, vmax].
     Buckets with count 0 carry vmin=UINT32_MAX, vmax=0."""
-    return pack_stats(*stats_values_local(values, bucket_ids, mask,
-                                          num_buckets))
+    return pack_stats(*stats_values_local(
+        values, combine_ids(ids_tuple, strides), mask, num_buckets))
 
 
 def pad_bucket(n: int, minimum: int = 8192) -> int:

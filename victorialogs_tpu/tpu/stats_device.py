@@ -45,10 +45,16 @@ class FuncSpec:
 
 
 @dataclass
+class ByKey:
+    kind: str                     # 'time' | 'field'
+    name: str = ""                # field name (kind == 'field')
+    step: int = 0                 # ns (kind == 'time')
+    offset: int = 0               # ns (kind == 'time')
+
+
+@dataclass
 class StatsSpec:
-    by_time: bool                 # False => single global group
-    step: int                     # ns (when by_time)
-    offset: int                   # ns (when by_time)
+    by: list                      # list[ByKey] in the pipe's by order
     funcs: list                   # list[FuncSpec], parallel to pipe.funcs
     value_fields: list            # distinct non-None fields, staging order
 
@@ -91,9 +97,11 @@ def device_stats_spec(q) -> StatsSpec | None:
     """Static per-query analysis: can pipes[0] run as device partials?
 
     Eligible shape: first pipe is a plain `stats` (or the cluster's
-    stats_export wrapper — same grouping semantics), grouped by nothing or
-    by a single `_time:<duration>` bucket, with every function mapping to a
-    device partial and no per-function `if (...)` guards."""
+    stats_export wrapper — same grouping semantics), grouped by nothing,
+    by ONE `_time:<duration>` bucket, and/or by plain fields (those ride
+    the per-part dict-code tables when the columns are dict/const-typed —
+    decided per part at staging), with every function mapping to a device
+    partial and no per-function `if (...)` guards."""
     if not q.pipes:
         return None
     ps = q.pipes[0]
@@ -101,18 +109,24 @@ def device_stats_spec(q) -> StatsSpec | None:
     if not isinstance(ps, PipeStats) or \
             getattr(ps, "name", "") not in ("stats", "stats_export"):
         return None
-    by_time, step, offset = False, 0, 0
-    if ps.by:
-        if len(ps.by) != 1:
-            return None
-        b = ps.by[0]
-        if b.name != "_time" or not b.bucket or \
-                b.bucket.lower() in ("week", "month", "year"):
-            return None
-        d = parse_duration(b.bucket)
-        if not d or d <= 0:
-            return None
-        by_time, step, offset = True, int(d), b.offset_ns()
+    by: list[ByKey] = []
+    n_time = 0
+    for b in ps.by:
+        if b.name == "_time" and b.bucket:
+            if b.bucket.lower() in ("week", "month", "year"):
+                return None
+            d = parse_duration(b.bucket)
+            if not d or d <= 0:
+                return None
+            n_time += 1
+            if n_time > 1:
+                return None
+            by.append(ByKey("time", step=int(d), offset=b.offset_ns()))
+            continue
+        if b.bucket or b.name in ("_time", "_stream", "_stream_id") or \
+                "*" in b.name:
+            return None  # numeric bucketing / special fields: host path
+        by.append(ByKey("field", name=b.name))
     funcs = []
     for fn in ps.funcs:
         if fn.iff is not None:
@@ -125,8 +139,7 @@ def device_stats_spec(q) -> StatsSpec | None:
     for f in funcs:
         if f.field is not None and f.field not in fields:
             fields.append(f.field)
-    return StatsSpec(by_time=by_time, step=step, offset=offset,
-                     funcs=funcs, value_fields=fields)
+    return StatsSpec(by=by, funcs=funcs, value_fields=fields)
 
 
 def combine_plane_sums(planes) -> int:
