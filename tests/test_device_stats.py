@@ -69,7 +69,11 @@ STATS_QUERIES = [
     'item7 | stats by (_time:5m) count() c',
     "* | stats sum(ratio) s",                   # float column: host path
     "* | stats by (_time:5m) count() if (deadline) c",  # iff: fallback
-    "* | stats by (_time:5m) count_uniq(app) u",        # ineligible func
+    "* | stats by (_time:5m) count_uniq(app) u",        # uniq axis
+    "* | stats count() c, count_uniq(_stream_id) u",    # BASELINE config 4
+    "* | stats count_uniq(_stream) s, count_uniq(app) a",
+    "deadline | stats by (app) count_uniq(dur) u",      # numeric: fallback
+    "* | stats count_uniq(app) if (deadline) u",        # iff: fallback
     "* | stats by (app) count() c",             # dict-column group-by
     "* | stats by (app) sum(dur) s, min(dur) mn, max(dur) mx",
     "* | stats by (app, _time:10m) count() c, sum(dur) s",
@@ -185,4 +189,10 @@ def test_dict_group_by_engages_device(storage):
     run_query_collect(storage, [TEN],
                       "* | stats by (app, _time:10m) sum(dur) s",
                       timestamp=T0, runner=runner)
-    assert runner.stats_dispatches > n1
+    n2 = runner.stats_dispatches
+    assert n2 > n1
+    # the flagship count_uniq(_stream_id) shape rides the uniq axis
+    run_query_collect(storage, [TEN],
+                      "* | stats count() c, count_uniq(_stream_id) u",
+                      timestamp=T0, runner=runner)
+    assert runner.stats_dispatches > n2

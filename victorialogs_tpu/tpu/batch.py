@@ -371,6 +371,13 @@ def stage_dict_codes(part, field: str, layout: StatsLayout,
     for bi in range(part.num_blocks):
         start = layout.starts[bi]
         n = part.block_rows(bi)
+        if field in ("_stream", "_stream_id"):
+            # virtual per-block constants
+            v = part.block_tags(bi) if field == "_stream" else \
+                part.block_stream_id(bi).as_string()
+            ids[start:start + n] = code(v)
+            eligible.append(bi)
+            continue
         meta = part.block_column_meta(bi, field)
         if meta is None:
             consts = dict(part.block_consts(bi))
@@ -820,7 +827,8 @@ class BatchRunner:
         - bms: block_idx -> bitmap (same as run_part);
         - handled: block idxs fully accounted for by the partials (the
           caller must NOT feed them through the row path);
-        - partials: list of (key_parts, count, field_stats) where
+        - partials: list of (key_parts, count, field_stats, uniq_vals)
+          where
           key_parts follows the spec's by order with elements
           ("t", bucket_ns) for the time axis and ("v", value_str) for
           group-by fields, and field_stats maps
@@ -840,7 +848,8 @@ class BatchRunner:
                 return bms, set(), []
             numerics[fld] = sn
 
-        # one id axis per by key (time buckets / dict-code tables)
+        # one id axis per by key (time buckets / dict-code tables), plus
+        # one axis per count_uniq field (its codes enumerate the set)
         axes = []          # (kind, ids_jax, size, decode_payload)
         eligibility = [numerics[fld].eligible
                        for fld in spec.value_fields]
@@ -858,6 +867,12 @@ class BatchRunner:
                     return bms, set(), []
                 axes.append(("v", sd.ids, len(sd.values), sd.values))
                 eligibility.append(sd.eligible)
+        for fld in spec.uniq_fields:
+            sd = self._stage_dict(part, fld, layout)
+            if sd is None:
+                return bms, set(), []
+            axes.append(("u", sd.ids, len(sd.values), (fld, sd.values)))
+            eligibility.append(sd.eligible)
         nb = 1
         for _k, _i, size, _p in axes:
             nb *= size
@@ -901,15 +916,20 @@ class BatchRunner:
         mask_j = self._put(mask)
 
         def key_parts(idx: int) -> tuple:
+            """(group-key components, uniq-axis values) for one cell."""
             out = []
+            uniq = {}
             for (kind, _ids, size, payload), stride in zip(axes, strides):
                 k = (idx // stride) % size
                 if kind == "t":
                     base, step = payload
                     out.append(("t", base + k * step))
-                else:
+                elif kind == "v":
                     out.append(("v", payload[k]))
-            return tuple(out)
+                else:  # uniq axis: not part of the group key
+                    fld, values = payload
+                    uniq[fld] = values[k]
+            return tuple(out), uniq
 
         if spec.value_fields:
             counts = None
@@ -930,14 +950,17 @@ class BatchRunner:
                     s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
                     fs[fld] = (s, int(packed[5, idx]) + vmin0,
                                int(packed[6, idx]) + vmin0)
-                partials.append((key_parts(int(idx)), cnt, fs))
+                kp, uniq = key_parts(int(idx))
+                partials.append((kp, cnt, fs, uniq))
             return bms, handled, partials
 
         self._bump("device_calls")
         self._bump("stats_dispatches")
         counts = self._dispatch_stats_count(ids_tuple, strides, mask_j, nb)
-        partials = [(key_parts(int(idx)), int(counts[idx]), {})
-                    for idx in np.nonzero(counts)[0]]
+        partials = []
+        for idx in np.nonzero(counts)[0]:
+            kp, uniq = key_parts(int(idx))
+            partials.append((kp, int(counts[idx]), {}, uniq))
         return bms, handled, partials
 
     def _scan_pair(self, spc: StagedPart, pair: tuple):

@@ -56,7 +56,8 @@ class ByKey:
 class StatsSpec:
     by: list                      # list[ByKey] in the pipe's by order
     funcs: list                   # list[FuncSpec], parallel to pipe.funcs
-    value_fields: list            # distinct non-None fields, staging order
+    value_fields: list            # distinct numeric fields, staging order
+    uniq_fields: list             # distinct count_uniq fields (dict axes)
 
 
 def _func_spec(fn) -> FuncSpec | None:
@@ -89,6 +90,15 @@ def _func_spec(fn) -> FuncSpec | None:
     if t is sf.StatsMax:
         if len(fn.fields) == 1 and "*" not in fn.fields[0]:
             return FuncSpec("max", fn.fields[0])
+        return None
+    if t is sf.StatsCountUniq:
+        # distinct values ride an extra bucket axis over the field's
+        # per-part dict codes; the state stays the exact value SET, so
+        # host/device/cluster merging is unchanged (limit only caps
+        # finalize).  _stream_id/_stream are block constants, so the
+        # flagship `count_uniq(_stream_id)` shape is eligible.
+        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+            return FuncSpec("uniq", fn.fields[0])
         return None
     return None
 
@@ -136,10 +146,15 @@ def device_stats_spec(q) -> StatsSpec | None:
             return None
         funcs.append(spec)
     fields: list[str] = []
+    uniq: list[str] = []
     for f in funcs:
-        if f.field is not None and f.field not in fields:
+        if f.kind == "uniq":
+            if f.field not in uniq:
+                uniq.append(f.field)
+        elif f.field is not None and f.field not in fields:
             fields.append(f.field)
-    return StatsSpec(by=by, funcs=funcs, value_fields=fields)
+    return StatsSpec(by=by, funcs=funcs, value_fields=fields,
+                     uniq_fields=uniq)
 
 
 def combine_plane_sums(planes) -> int:
@@ -151,10 +166,14 @@ def combine_plane_sums(planes) -> int:
 
 
 def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
-                         count: int, field_stats: dict) -> list:
+                         count: int, field_stats: dict,
+                         uniq_vals: dict | None = None) -> list:
     """Per-bucket states list (parallel to pipe_funcs) from kernel outputs.
 
     field_stats: field -> (sum:int, vmin:int, vmax:int) exact integers.
+    uniq_vals: field -> the uniq-axis value this partial covers (one
+    partial is emitted per (group, uniq-code) cell; same-key partials
+    merge through the funcs' own merge(), unioning the value sets).
     The states are merged into the stats processor with the funcs' own
     merge(), so downstream behavior (finalize, export/import for cluster
     pushdown) is identical to the host path."""
@@ -172,6 +191,9 @@ def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
             states.append(str(field_stats[fs.field][1]) if count else None)
         elif fs.kind == "max":
             states.append(str(field_stats[fs.field][2]) if count else None)
+        elif fs.kind == "uniq":
+            v = (uniq_vals or {}).get(fs.field, "")
+            states.append({(v,)} if count and v != "" else set())
         else:  # pragma: no cover - _func_spec gates kinds
             raise AssertionError(fs.kind)
     return states
