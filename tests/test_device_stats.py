@@ -72,6 +72,9 @@ STATS_QUERIES = [
     "* | stats by (_time:5m) count_uniq(app) u",        # uniq axis
     "* | stats count() c, count_uniq(_stream_id) u",    # BASELINE config 4
     "* | stats count_uniq(_stream) s, count_uniq(app) a",
+    "* | stats count_uniq(_time) t",            # virtual col: fallback
+    "* | stats by (app) count_uniq(app) u",     # shared group/uniq axis
+    "deadline | stats by (app, _time:10m) count_uniq(app) u, sum(dur) s",
     "deadline | stats by (app) count_uniq(dur) u",      # numeric: fallback
     "* | stats count_uniq(app) if (deadline) u",        # iff: fallback
     "* | stats by (app) count() c",             # dict-column group-by

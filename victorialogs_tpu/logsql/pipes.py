@@ -923,14 +923,27 @@ class PipeStats(Pipe):
             def absorb_partials(self, key: tuple, states: list) -> None:
                 """Merge device-computed partial states for one group
                 (tpu/stats_device.py) — the in-process analogue of the
-                cluster importState merge (pipe_stats.go:93-125)."""
+                cluster importState merge (pipe_stats.go:93-125).
+
+                Set-valued states (count_uniq) charge the memory budget
+                on actual growth, matching the host update path
+                (pipe_stats.go:314-348)."""
+                def set_cost(s: set) -> int:
+                    return sum(sum(len(x) for x in k) + 64 for k in s)
+
                 cur = self.groups.get(key)
                 if cur is None:
                     self.groups[key] = states
-                    self.budget.add(sum(len(k) for k in key) + 80)
+                    self.budget.add(sum(len(k) for k in key) + 80 +
+                                    sum(set_cost(st) for st in states
+                                        if isinstance(st, set)))
                 else:
                     for k, fn in enumerate(pipe.funcs):
+                        before = len(cur[k]) \
+                            if isinstance(cur[k], set) else None
                         cur[k] = fn.merge(cur[k], states[k])
+                        if before is not None and len(cur[k]) > before:
+                            self.budget.add(set_cost(states[k]))
 
             def flush(self):
                 by_names = [b.name for b in pipe.by]

@@ -865,9 +865,19 @@ class BatchRunner:
                 sd = self._stage_dict(part, bk.name, layout)
                 if sd is None:
                     return bms, set(), []
-                axes.append(("v", sd.ids, len(sd.values), sd.values))
+                axes.append(("v", sd.ids, len(sd.values),
+                             (bk.name, sd.values)))
                 eligibility.append(sd.eligible)
+        uniq_shared = []   # (field, axis_idx): by-field doubles as uniq
         for fld in spec.uniq_fields:
+            shared = next((i for i, (k, _i, _s, p) in enumerate(axes)
+                           if k == "v" and p[0] == fld), None)
+            if shared is not None:
+                # same field grouped AND counted: its group axis already
+                # enumerates the codes (the S x S product would only fill
+                # the diagonal and trip MAX_BUCKETS needlessly)
+                uniq_shared.append((fld, shared))
+                continue
             sd = self._stage_dict(part, fld, layout)
             if sd is None:
                 return bms, set(), []
@@ -917,18 +927,21 @@ class BatchRunner:
 
         def key_parts(idx: int) -> tuple:
             """(group-key components, uniq-axis values) for one cell."""
+            ks = [(idx // stride) % size
+                  for (_k, _i, size, _p), stride in zip(axes, strides)]
             out = []
             uniq = {}
-            for (kind, _ids, size, payload), stride in zip(axes, strides):
-                k = (idx // stride) % size
+            for (kind, _ids, size, payload), k in zip(axes, ks):
                 if kind == "t":
                     base, step = payload
                     out.append(("t", base + k * step))
                 elif kind == "v":
-                    out.append(("v", payload[k]))
+                    out.append(("v", payload[1][k]))
                 else:  # uniq axis: not part of the group key
                     fld, values = payload
                     uniq[fld] = values[k]
+            for fld, ai in uniq_shared:
+                uniq[fld] = axes[ai][3][1][ks[ai]]
             return tuple(out), uniq
 
         if spec.value_fields:

@@ -97,7 +97,10 @@ def _func_spec(fn) -> FuncSpec | None:
         # host/device/cluster merging is unchanged (limit only caps
         # finalize).  _stream_id/_stream are block constants, so the
         # flagship `count_uniq(_stream_id)` shape is eligible.
-        if len(fn.fields) == 1 and "*" not in fn.fields[0]:
+        if len(fn.fields) == 1 and "*" not in fn.fields[0] and \
+                fn.fields[0] != "_time":
+            # _time is a virtual column the dict stager cannot see (it
+            # would stage as the constant '' and silently drop values)
             return FuncSpec("uniq", fn.fields[0])
         return None
     return None
