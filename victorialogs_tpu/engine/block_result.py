@@ -83,7 +83,27 @@ class BlockResult:
         self._bs: BlockSearch | None = None
         self._sel: np.ndarray | None = None   # selected row indices into bs
         self._needed: set | None = None       # needed-columns restriction
-        self.timestamps: list[int] | None = None
+        self._ts_list: list[int] | None = None
+        self._ts_np: np.ndarray | None = None
+
+    # timestamps materialize lazily: storage-backed blocks carry the int64
+    # array and only build the Python list when a consumer indexes it
+    # (stats fast paths read the array directly via timestamps_np())
+    @property
+    def timestamps(self) -> list | None:
+        if self._ts_list is None and self._ts_np is not None:
+            self._ts_list = self._ts_np.tolist()
+        return self._ts_list
+
+    @timestamps.setter
+    def timestamps(self, v) -> None:
+        self._ts_list = v
+        self._ts_np = None
+
+    def timestamps_np(self) -> np.ndarray | None:
+        if self._ts_np is None and self._ts_list is not None:
+            self._ts_np = np.asarray(self._ts_list, dtype=np.int64)
+        return self._ts_np
 
     # ---- constructors ----
     @staticmethod
@@ -99,7 +119,7 @@ class BlockResult:
         if needed is not None and "*" in needed:
             needed = None
         br._needed = needed
-        br.timestamps = bs.timestamps()[sel].tolist()
+        br._ts_np = bs.timestamps()[sel]
         return br
 
     @staticmethod
@@ -250,7 +270,9 @@ class BlockResult:
         """Detach from the underlying block (copy out the needed columns)."""
         names = fields if fields is not None else self.column_names()
         cols = {n: self.column(n) for n in names}
-        out = BlockResult.from_columns(cols, self.timestamps)
+        out = BlockResult.from_columns(cols)
+        out._ts_np = self._ts_np
+        out._ts_list = self._ts_list
         # a needed-columns restriction can leave zero columns while rows
         # still exist (e.g. copy/rename rebuilding them); keep the count
         out.nrows = self.nrows
@@ -270,17 +292,10 @@ class BlockResult:
             if self._bs is not None:
                 br._bs = self._bs
                 br._sel = self._sel[keep]
-        if self.timestamps is not None:
-            br.timestamps = [self.timestamps[i] for i in keep.tolist()]
-        return br
-
-    def take_rows(self, idxs: list[int]) -> "BlockResult":
-        br = BlockResult(len(idxs))
-        for n in self.column_names():
-            vals = self.column(n)
-            br._cols[n] = [vals[i] for i in idxs]
-        if self.timestamps is not None:
-            br.timestamps = [self.timestamps[i] for i in idxs]
+        if self._ts_np is not None:
+            br._ts_np = self._ts_np[keep]
+        elif self._ts_list is not None:
+            br._ts_list = [self._ts_list[i] for i in keep.tolist()]
         return br
 
     def rows(self, fields: list[str] | None = None) -> list[dict]:

@@ -782,7 +782,10 @@ class PipeStats(Pipe):
                 skip: by-field indices the caller handles itself (dict
                 codes) — their slot is None, nothing materializes."""
                 n = br.nrows
-                ts = br.timestamps
+                # array form only when a bucketed _time key needs it
+                ts = br.timestamps_np() if any(
+                    b.bucket and b.name == "_time" for b in pipe.by) \
+                    else None
                 key_cols = []
                 for ci, b in enumerate(pipe.by):
                     if ci in skip:
