@@ -94,3 +94,27 @@ def test_random_filter_parity(storage):
         checked += 1
     assert checked == 150
     assert runner.device_calls > 0
+
+
+def test_random_stats_parity(storage):
+    """Random `<filter> | stats ...` shapes: device partials (time/dict/
+    uniq axes, numeric partials) vs the CPU executor, bit-identical."""
+    rnd = random.Random(777)
+    runner = BatchRunner()
+    funcs = ["count() c", "sum(num) s", "min(num) mn", "max(num) mx",
+             "avg(num) a", "count(num) cn", "count_uniq(app) u",
+             "count_uniq(_stream_id) usid", "count_uniq(_msg) um"]
+    bys = ["", "by (app) ", "by (_time:7m) ", "by (app, _time:13m) ",
+           "by (_time:5m offset 90s) ", "by (app, missingf) "]
+    for i in range(120):
+        filt = _rand_filter(rnd, depth=rnd.randint(0, 2))
+        by = rnd.choice(bys)
+        nf = rnd.randint(1, 3)
+        fl = ", ".join(rnd.sample(funcs, nf))
+        qs = f"{filt} | stats {by}{fl}"
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        norm = lambda rows: sorted(tuple(sorted(r.items())) for r in rows)
+        assert norm(cpu) == norm(dev), qs
+    assert runner.stats_dispatches > 0

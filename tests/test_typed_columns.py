@@ -172,3 +172,27 @@ def test_uint64_min_max_no_wrap(tmp_path):
         assert rows[0]["mn"] == str(big - 6)
     finally:
         s.close()
+
+
+def test_min_after_count_materialization(storage):
+    """count(dur) materializes the column AFTER min(dur)'s lazy wrapper
+    was chosen; min must fall back to the strings instead of silently
+    returning nothing (caught by the stats fuzzer)."""
+    rows = run_query_collect(
+        storage, [TEN], "* | stats min(dur) mn, count(dur) cn",
+        timestamp=T0)
+    assert rows[0]["mn"] == "0"
+    assert rows[0]["cn"] == "4000"
+    rows = run_query_collect(
+        storage, [TEN],
+        "* | stats by (lvl) min(dur) mn, count(dur) cn, max(lvl) mx",
+        timestamp=T0)
+    assert all(r["mn"] == "0" or r["mn"].isdigit() for r in rows)
+    assert all(r["mx"] in ("info", "warn", "error") for r in rows)
+    # dict column shared with a materializing func (the dc-is-None branch
+    # used to crash unpacking None)
+    rows = run_query_collect(
+        storage, [TEN], "* | stats min(lvl) ln, count(lvl) cl",
+        timestamp=T0)
+    assert rows[0]["ln"] == "error"
+    assert rows[0]["cl"] == "4000"

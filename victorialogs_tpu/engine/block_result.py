@@ -75,7 +75,14 @@ def _parse_rfc3339_uncached(s: str) -> int | None:
 
 
 class BlockResult:
-    """A batch of result rows with lazily-materialized string columns."""
+    """A batch of result rows with lazily-materialized string columns.
+
+    Invariant: on a block-backed result (_bs set), _cols only ever holds
+    CACHE FILLS — the decode of the same storage column that column()
+    produced.  Pipes that override or add columns always do so on a
+    materialized copy (materialize() drops _bs), so the typed accessors
+    below stay valid even after another consumer materialized the same
+    column's strings."""
 
     def __init__(self, nrows: int):
         self.nrows = nrows
@@ -156,7 +163,7 @@ class BlockResult:
         or None — lets stats skip per-row string parsing (the reference
         keeps blockResult columns type-encoded for the same reason —
         block_result.go:26-63)."""
-        if self._bs is None or name in self._cols:
+        if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,
@@ -178,7 +185,7 @@ class BlockResult:
         stored strings (round-trip encodings — values_encoder.py) without
         ever materializing a Python string list
         (block_result.go:2149-2199)."""
-        if self._bs is None or name in self._cols:
+        if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,
@@ -201,7 +208,7 @@ class BlockResult:
         """The single value of a column KNOWN constant across this block
         (const columns; _stream/_stream_id are per-block constants by
         construction), or None."""
-        if self._bs is None or name in self._cols or self.nrows == 0:
+        if self._bs is None or self.nrows == 0:
             return None
         c = self._bs.consts().get(name)
         if c is not None:
@@ -216,7 +223,7 @@ class BlockResult:
         """(selected dict ids uint8, dict value strings) for a
         dict-encoded column, or None — lets group-by factorize through
         the stored codes without materializing a per-row string list."""
-        if self._bs is None or name in self._cols:
+        if self._bs is None:
             return None
         from ..storage.values_encoder import VT_DICT
         if name in self._bs.consts() or name in ("_time", "_stream",
@@ -231,7 +238,7 @@ class BlockResult:
         """(min, max) of a numeric column from the BLOCK HEADER — no
         column payload read/decode (reference per-column min/max skips,
         block_result.go:26-63).  None for non-numeric/absent columns."""
-        if self._bs is None or name in self._cols:
+        if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,

@@ -196,6 +196,19 @@ class StatsSumLen(StatsFunc):
         return str(state)
 
 
+def _min_max_reduce(vals, want_min: bool, best: str | None = None):
+    """Reference min/max selection over string values: skip empties,
+    numeric-first ordering with string tiebreak (shared by the plain
+    per-row paths and the lazy-column fallbacks)."""
+    for v in vals:
+        if v == "":
+            continue
+        if best is None or (_num_or_str_less(v, best) if want_min
+                            else _num_or_str_less(best, v)):
+            best = v
+    return best
+
+
 def _num_or_str_less(a: str, b: str) -> bool:
     """Reference lessString semantics: numeric compare when both parse."""
     fa, fb = parse_number(a), parse_number(b)
@@ -231,24 +244,28 @@ class _LazyMinMaxCol:
     def candidate(self, idxs, want_min: bool) -> str | None:
         """Extreme among the selected rows as the stored string."""
         import numpy as np
+
+        def str_reduce(vals) -> str | None:
+            return _min_max_reduce(vals, want_min)
+
         if self.is_dict:
-            ids, dvals = self.br.dict_column(self.name)
+            dc = self.br.dict_column(self.name)
+            if dc is None:
+                # another consumer materialized the column between
+                # block_cols and update: reduce the string list instead
+                # of silently dropping the values
+                vals = self.br.column(self.name)
+                return str_reduce(vals[i] for i in idxs)
+            ids, dvals = dc
             sub = ids if len(idxs) == ids.shape[0] else ids[idxs]
             if not sub.size:
                 return None
-            best = None
-            for j in np.unique(sub):
-                v = dvals[j]
-                if v == "":
-                    continue  # empty string == absent field
-                if best is None or (
-                        _num_or_str_less(v, best) if want_min
-                        else _num_or_str_less(best, v)):
-                    best = v
-            return best
+            return str_reduce(dvals[j] for j in np.unique(sub))
         tn = self.br.typed_numeric(self.name)
-        if tn is None:  # pragma: no cover - gated by header_min_max
-            return None
+        if tn is None:
+            # same materialization race as above: string fallback
+            vals = self.br.column(self.name)
+            return str_reduce(vals[i] for i in idxs)
         arr, is_int = tn
         sub = arr if len(idxs) == arr.shape[0] else arr[idxs]
         if not sub.size:
@@ -288,6 +305,8 @@ class StatsMin(StatsFunc):
         for c in cols:
             if isinstance(c, _LazyMinMaxCol):
                 if not c.is_dict and best is not None:
+                    # hdr can go None if another consumer materialized
+                    # the column meanwhile (same race candidate handles)
                     hdr = c.br.header_min_max(c.name)
                     fb = parse_number(best)
                     # the block header min bounds any row subset: once the
@@ -295,19 +314,15 @@ class StatsMin(StatsFunc):
                     # the min and the column is never read/decoded.
                     # STRICT compare: numeric ties must decode so the
                     # string tiebreak (_num_or_str_less) stays authoritative
-                    if not math.isnan(fb) and fb < hdr[0]:
+                    if hdr is not None and not math.isnan(fb) and \
+                            fb < hdr[0]:
                         continue
                 got = c.candidate(idxs, want_min=True)
                 if got is not None and (best is None or
                                         _num_or_str_less(got, best)):
                     best = got
                 continue
-            for i in idxs:
-                v = c[i]
-                if v == "":
-                    continue
-                if best is None or _num_or_str_less(v, best):
-                    best = v
+            best = _min_max_reduce((c[i] for i in idxs), True, best)
         return best
 
     def merge(self, a, b):
@@ -332,19 +347,15 @@ class StatsMax(StatsMin):
                     hdr = c.br.header_min_max(c.name)
                     fb = parse_number(best)
                     # strict for the same tie reason as min
-                    if not math.isnan(fb) and fb > hdr[1]:
+                    if hdr is not None and not math.isnan(fb) and \
+                            fb > hdr[1]:
                         continue
                 got = c.candidate(idxs, want_min=False)
                 if got is not None and (best is None or
                                         _num_or_str_less(best, got)):
                     best = got
                 continue
-            for i in idxs:
-                v = c[i]
-                if v == "":
-                    continue
-                if best is None or _num_or_str_less(best, v):
-                    best = v
+            best = _min_max_reduce((c[i] for i in idxs), False, best)
         return best
 
     def merge(self, a, b):
