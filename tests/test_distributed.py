@@ -127,6 +127,10 @@ def test_mesh_batch_runner_query_parity(tmp_path):
             "min(dur) mn, max(dur) mx",
             "* | stats count() c, avg(dur) a",
             '_msg:~"dead.*line" | stats by (_time:10m) count() c',
+            "* | stats by (app) count() c, sum(dur) s",
+            "deadline | stats by (app, _time:10m) count_uniq(app) u, "
+            "min(dur) mn",
+            "* | stats count_uniq(_stream_id) u",
         ]:
             cpu = run_query_collect(s, [ten], qs, timestamp=T0)
             dev = run_query_collect(s, [ten], qs, timestamp=T0,
