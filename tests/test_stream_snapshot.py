@@ -141,12 +141,12 @@ def test_background_compaction_under_load(tmp_path, monkeypatch):
     monkeypatch.setattr(idb_mod, "COMPACT_TAIL_STREAMS", 400)
 
     slow_gate = threading.Event()
-    orig_write = snap_mod.write_snapshot
+    orig_compact = snap_mod.compact_snapshot
 
-    def slow_write(path, streams, log_offset):
+    def slow_compact(path, snap, tail, log_offset):
         slow_gate.wait(5)  # hold the merge open while we keep registering
-        return orig_write(path, streams, log_offset)
-    monkeypatch.setattr(idb_mod, "write_snapshot", slow_write)
+        return orig_compact(path, snap, tail, log_offset)
+    monkeypatch.setattr(idb_mod, "compact_snapshot", slow_compact)
 
     d = str(tmp_path / "idb")
     db = IndexDB(d)
@@ -229,4 +229,38 @@ def test_torn_log_tail_does_not_eat_next_registration(tmp_path):
     db3 = IndexDB(d)
     assert db3.has_stream_id(sid)   # survived the torn tail
     assert db3.num_streams() == 21
+    db3.close()
+
+
+def test_merge_adds_tenant_between_existing(tmp_path):
+    """Array-level merge: a tail tenant sorting BETWEEN existing tenants
+    must keep the snapshot's sorted-t_idx invariant (searchsorted
+    tenant bounds) — regression for silent lookup corruption."""
+    d = str(tmp_path / "idb")
+    db = IndexDB(d)
+    _fill(db, SNAPSHOT_MIN_TAIL // 2, TenantID(1, 0))
+    _fill(db, SNAPSHOT_MIN_TAIL // 2 + 7, TenantID(9, 0))
+    db.close()
+
+    db2 = IndexDB(d)
+    mid = TenantID(5, 0)
+    extra = [_mk(30_000_000 + i, mid) for i in range(200)]
+    db2.must_register_streams(extra)
+    with db2._lock:
+        db2._write_snapshot_locked()  # force the array-level merge
+    db2.close()
+
+    db3 = IndexDB(d)
+    assert len(db3._streams) == 0  # all three tenants in the snapshot
+    assert len(db3.all_stream_ids([TenantID(1, 0)])) == \
+        SNAPSHOT_MIN_TAIL // 2
+    assert len(db3.all_stream_ids([mid])) == 200
+    assert len(db3.all_stream_ids([TenantID(9, 0)])) == \
+        SNAPSHOT_MIN_TAIL // 2 + 7
+    for sid, tags in extra[:5]:
+        assert db3.has_stream_id(sid)
+        assert db3.get_stream_tags(sid) == tags
+    got = db3.search_stream_ids([TenantID(9, 0)], _sf("app", "=", "app1"))
+    assert len(got) == len([i for i in range(SNAPSHOT_MIN_TAIL // 2 + 7)
+                            if i % 37 == 1])
     db3.close()

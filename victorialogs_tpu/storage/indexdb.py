@@ -35,7 +35,7 @@ import threading
 
 from .log_rows import StreamID, TenantID
 from .stream_filter import StreamFilter, _compiled, parse_stream_tags
-from .stream_snapshot import StreamSnapshot, write_snapshot
+from .stream_snapshot import StreamSnapshot, compact_snapshot
 
 STREAMS_FILENAME = "streams.jsonl"
 SNAPSHOT_FILENAME = "streams.snap"
@@ -127,30 +127,12 @@ class IndexDB:
             label_any.setdefault(label, set()).add(sid)
 
     # ---- compaction ----
-    @staticmethod
-    def _merged_streams(snap: StreamSnapshot | None,
-                        tail: dict) -> dict[StreamID, str]:
-        """Snapshot + tail as one map (compaction input).  Decodes the
-        whole snapshot into Python objects — an array-level merge
-        (concat + searchsorted over the already-sorted columns) would
-        avoid that and is the next optimization if compaction cost ever
-        matters more than the ~2x write amplification documented in
-        PERF.md."""
-        out: dict[StreamID, str] = {}
-        if snap is not None:
-            for i in range(snap.n):
-                out[snap.stream_at(i)] = snap.tags_at(i)
-        out.update(tail)
-        return out
-
-    def _all_streams_map(self) -> dict[StreamID, str]:
-        return self._merged_streams(self._snap, self._streams)
-
     def _write_snapshot_locked(self) -> None:
         self._file.flush()
         log_size = os.path.getsize(self._file_path) \
             if os.path.exists(self._file_path) else 0
-        write_snapshot(self._snap_path, self._all_streams_map(), log_size)
+        compact_snapshot(self._snap_path, self._snap,
+                         dict(self._streams), log_size)
         self._snap = StreamSnapshot(self._snap_path)
         self._streams.clear()
         self._by_tenant.clear()
@@ -179,9 +161,8 @@ class IndexDB:
 
         def work():
             try:
-                write_snapshot(self._snap_path,
-                               self._merged_streams(old_snap, frozen),
-                               log_size)
+                compact_snapshot(self._snap_path, old_snap, frozen,
+                                 log_size)
                 new_snap = StreamSnapshot(self._snap_path)
             except Exception as e:
                 # disk full / permissions: keep serving from the old
@@ -219,8 +200,8 @@ class IndexDB:
             self._file.close()
             if len(self._streams) >= SNAPSHOT_MIN_TAIL:
                 log_size = os.path.getsize(self._file_path)
-                write_snapshot(self._snap_path, self._all_streams_map(),
-                               log_size)
+                compact_snapshot(self._snap_path, self._snap,
+                                 dict(self._streams), log_size)
 
     def flush(self) -> None:
         with self._lock:

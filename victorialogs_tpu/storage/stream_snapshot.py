@@ -56,6 +56,24 @@ def _pack_arrays(arrays: dict) -> tuple[dict, bytes]:
     return meta, b"".join(blobs)
 
 
+def _finish_snapshot(path: str, arrays: dict, n: int, tenants: list,
+                     labels_meta: dict, log_offset: int) -> None:
+    ameta, blob = _pack_arrays(arrays)
+    header = json.dumps({
+        "n": n, "tenants": tenants, "arrays": ameta,
+        "labels": labels_meta, "log_offset": log_offset,
+    }, separators=(",", ":")).encode("utf-8")
+    payload = _zstd.compress(
+        struct.pack(">I", len(header)) + header + blob, level=3)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(SNAP_MAGIC)
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def write_snapshot(path: str, streams: dict, log_offset: int) -> None:
     """streams: StreamID -> tags_str (any order); atomic tmp+rename."""
     items = sorted(
@@ -99,44 +117,243 @@ def write_snapshot(path: str, streams: dict, log_offset: int) -> None:
     labels_meta: dict = {}
     for ti, per in post.items():
         for label, values in per.items():
-            vkeys = sorted(values, key=lambda v: v.encode("utf-8"))
-            vbytes = [v.encode("utf-8") for v in vkeys]
-            w = max((len(b) for b in vbytes), default=1) or 1
-            vtab = np.zeros((len(vkeys),), dtype=f"S{w}")
-            counts = np.empty(len(vkeys), dtype=np.uint32)
-            idx_chunks = []
-            any_set = set()
-            for k, (vk, vb) in enumerate(zip(vkeys, vbytes)):
-                vtab[k] = vb
-                ids = values[vk]
-                counts[k] = len(ids)
-                idx_chunks.append(np.asarray(ids, dtype=np.uint32))
-                any_set.update(ids)
-            idx_blob = np.concatenate(idx_chunks) if idx_chunks else \
-                np.empty(0, dtype=np.uint32)
-            any_arr = np.fromiter(sorted(any_set), dtype=np.uint32,
-                                  count=len(any_set))
-            base = f"p{ti}:{label}"
-            arrays[base + ":v"] = vtab
-            arrays[base + ":c"] = counts
-            arrays[base + ":i"] = idx_blob
-            arrays[base + ":a"] = any_arr
-            labels_meta.setdefault(str(ti), {})[label] = {"w": w}
+            any_arr = np.fromiter(
+                sorted({i for ids in values.values() for i in ids}),
+                dtype=np.uint32)
+            _emit_label(arrays, labels_meta, ti, label, values, any_arr)
 
-    ameta, blob = _pack_arrays(arrays)
-    header = json.dumps({
-        "n": n, "tenants": tenants, "arrays": ameta,
-        "labels": labels_meta, "log_offset": log_offset,
-    }, separators=(",", ":")).encode("utf-8")
-    payload = _zstd.compress(
-        struct.pack(">I", len(header)) + header + blob, level=3)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(SNAP_MAGIC)
-        f.write(payload)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    _finish_snapshot(path, arrays, n, tenants, labels_meta, log_offset)
+
+
+def compact_snapshot(path: str, snap, tail: dict,
+                     log_offset: int) -> None:
+    """One entry point for every compaction site: array-level merge when
+    a snapshot exists, full write otherwise."""
+    if snap is not None:
+        merge_snapshot(path, snap, tail, log_offset)
+    else:
+        write_snapshot(path, dict(tail), log_offset)
+
+
+def merge_snapshot(path: str, snap: "StreamSnapshot", tail: dict,
+                   log_offset: int) -> None:
+    """Array-level compaction: merge an existing snapshot with a tail map
+    WITHOUT decoding the old rows into Python objects or re-parsing their
+    tags — the mergeset file-to-file merge.  Old registry columns merge by
+    one lexsort; old posting lists remap through the (monotonic) old→new
+    index mapping; only TAIL tags are parsed."""
+    n_old = snap.n
+    t_items = sorted(
+        ((sid.tenant.account_id, sid.tenant.project_id, sid.hi, sid.lo,
+          tags) for sid, tags in tail.items()))
+    n_tail = len(t_items)
+    if n_tail == 0:
+        # nothing to merge: rewrite with the new log offset only
+        _finish_snapshot(path, dict(snap._arrays), n_old,
+                         [(t.account_id, t.project_id)
+                          for t in snap.tenants],
+                         snap._labels_meta, log_offset)
+        return
+
+    # unified tenant table, SORTED by (account, project): rows are sorted
+    # the same way, so t_idx stays monotonic — the invariant
+    # StreamSnapshot._tenant_bounds (searchsorted) depends on
+    old_tenant_keys = [(t.account_id, t.project_id) for t in snap.tenants]
+    tenants = sorted(set(old_tenant_keys) |
+                     {(a, p) for a, p, _h, _l, _t in t_items})
+    tenant_idx_of = {t: i for i, t in enumerate(tenants)}
+
+    # registry columns: concat old arrays with tail columns, one lexsort
+    t_acct = np.fromiter((a for a, _p, _h, _l, _t in t_items),
+                         dtype=np.int64, count=n_tail)
+    t_proj = np.fromiter((p for _a, p, _h, _l, _t in t_items),
+                         dtype=np.int64, count=n_tail)
+    t_hi = np.fromiter((h for _a, _p, h, _l, _t in t_items),
+                       dtype=np.uint64, count=n_tail)
+    t_lo = np.fromiter((lw for _a, _p, _h, lw, _t in t_items),
+                       dtype=np.uint64, count=n_tail)
+    old_tenants = np.asarray([[t.account_id, t.project_id]
+                              for t in snap.tenants], dtype=np.int64) \
+        if snap.tenants else np.empty((0, 2), dtype=np.int64)
+    o_acct = old_tenants[:, 0][snap.t_idx] if n_old else \
+        np.empty(0, dtype=np.int64)
+    o_proj = old_tenants[:, 1][snap.t_idx] if n_old else \
+        np.empty(0, dtype=np.int64)
+    acct = np.concatenate([o_acct, t_acct])
+    proj = np.concatenate([o_proj, t_proj])
+    hi = np.concatenate([snap.hi, t_hi])
+    lo = np.concatenate([snap.lo, t_lo])
+    perm = np.lexsort((lo, hi, proj, acct))
+    n = n_old + n_tail
+    # old/tail position -> new row index (monotonic within each source,
+    # so sorted posting lists stay sorted after remapping)
+    new_of = np.empty(n, dtype=np.int64)
+    new_of[perm] = np.arange(n, dtype=np.int64)
+    old_to_new = new_of[:n_old]
+    tail_to_new = new_of[n_old:]
+
+    old_lut = np.fromiter((tenant_idx_of[k] for k in old_tenant_keys),
+                          dtype=np.uint32, count=len(old_tenant_keys))
+    t_idx_all = np.concatenate([
+        old_lut[snap.t_idx] if n_old else np.empty(0, dtype=np.uint32),
+        np.fromiter((tenant_idx_of[(a, p)]
+                     for a, p, _h, _l, _t in t_items),
+                    dtype=np.uint32, count=n_tail)])[perm].astype(
+                        np.uint32)
+
+    # tags: slice table in merged order (old rows copy bytes, no decode)
+    old_lens = np.diff(snap.tag_off.astype(np.int64))
+    t_tag_bytes = [t.encode("utf-8") for _a, _p, _h, _l, t in t_items]
+    lens_all = np.concatenate([
+        old_lens, np.fromiter((len(b) for b in t_tag_bytes),
+                              dtype=np.int64, count=n_tail)])[perm]
+    tag_off = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(lens_all, out=tag_off[1:])
+    # one fancy gather instead of a per-row slice loop: concatenate the
+    # source blobs, compute each merged row's source start, and index
+    big_src = np.frombuffer(snap.tags_blob + b"".join(t_tag_bytes),
+                            dtype=np.uint8)
+    t_lens = np.fromiter((len(b) for b in t_tag_bytes), dtype=np.int64,
+                         count=n_tail)
+    t_starts = np.zeros(n_tail, dtype=np.int64)
+    np.cumsum(t_lens[:-1], out=t_starts[1:])
+    src_starts = np.concatenate([
+        snap.tag_off[:n_old].astype(np.int64),
+        t_starts + len(snap.tags_blob)])[perm]
+    total_bytes = int(tag_off[n])
+    assert total_bytes < 2 ** 31, "tags blob exceeds int32 gather range"
+    out_off = tag_off[:n].astype(np.int64)
+    gather = (np.repeat(src_starts - out_off, lens_all) +
+              np.arange(total_bytes, dtype=np.int64)).astype(np.int32)
+    tags_blob = big_src[gather].tobytes()
+
+    arrays = {"t_idx": t_idx_all, "hi": hi[perm], "lo": lo[perm],
+              "tag_off": tag_off, "tags_blob": tags_blob}
+
+    # postings: old tables remap; tail postings (parsed here, tail only)
+    # merge in per (tenant, label, value)
+    tail_post: dict = {}
+    for k, (a, p, _h, _l, tags) in enumerate(t_items):
+        ti = tenant_idx_of[(a, p)]
+        per = tail_post.setdefault(ti, {})
+        for label, value in parse_stream_tags(tags).items():
+            per.setdefault(label, {}).setdefault(value, []).append(
+                int(tail_to_new[k]))
+
+    labels_meta: dict = {}
+    old_ti_of = {i: int(old_lut[i]) for i in range(len(old_tenant_keys))}
+    seen: set = set()
+    # old labels (remapped, merged with any tail postings on the same key)
+    for old_ti_s, labels in snap._labels_meta.items():
+        old_ti = int(old_ti_s)
+        ti = old_ti_of[old_ti]
+        for label in labels:
+            seen.add((ti, label))
+            base = f"p{old_ti}:{label}"
+            vtab = snap._arrays[base + ":v"]
+            counts = snap._arrays[base + ":c"]
+            idx_blob = old_to_new[snap._arrays[base + ":i"]]
+            any_arr = np.sort(old_to_new[snap._arrays[base + ":a"]])
+            extra = tail_post.get(ti, {}).pop(label, None)
+            if extra:
+                any_arr = np.sort(np.concatenate(
+                    [any_arr,
+                     np.fromiter(sorted({i for ids in extra.values()
+                                         for i in ids}),
+                                 dtype=np.int64)]))
+            if _merge_label_vectorized(arrays, labels_meta, ti, label,
+                                       vtab, counts, idx_blob, extra,
+                                       any_arr):
+                continue
+            # general path: few distinct values (dict-style labels)
+            starts = np.zeros(len(counts) + 1, dtype=np.int64)
+            np.cumsum(counts, out=starts[1:])
+            values = {v.decode("utf-8"):
+                      idx_blob[starts[k]:starts[k + 1]]
+                      for k, v in enumerate(vtab)}
+            if extra:
+                for v, ids in extra.items():
+                    ids = np.asarray(ids, dtype=np.int64)
+                    values[v] = np.sort(np.concatenate(
+                        [np.asarray(values.get(
+                            v, np.empty(0, dtype=np.int64)),
+                            dtype=np.int64), ids]))
+            _emit_label(arrays, labels_meta, ti, label, values, any_arr)
+    # labels that exist only in the tail
+    for ti, per in tail_post.items():
+        for label, vals in per.items():
+            if (ti, label) in seen:
+                continue
+            values = {v: np.asarray(sorted(ids), dtype=np.int64)
+                      for v, ids in vals.items()}
+            any_arr = np.fromiter(
+                sorted({i for ids in vals.values() for i in ids}),
+                dtype=np.int64)
+            _emit_label(arrays, labels_meta, ti, label, values, any_arr)
+
+    _finish_snapshot(path, arrays, n, tenants, labels_meta, log_offset)
+
+
+def _merge_label_vectorized(arrays: dict, labels_meta: dict, ti: int,
+                            label: str, vtab, counts, idx_blob, extra,
+                            any_arr) -> bool:
+    """Pure-numpy merge for the high-cardinality shape where every value
+    posts exactly ONE stream on both sides and no value repeats across
+    sides (host-/id-like labels — exactly where a Python per-value loop
+    hurts).  Returns False to use the general path otherwise."""
+    if counts.size and int(counts.max()) > 1:
+        return False
+    if extra is not None and any(len(ids) != 1 for ids in extra.values()):
+        return False
+    if extra:
+        skeys = sorted(extra, key=lambda v: v.encode("utf-8"))
+        t_vals = np.array([v.encode("utf-8") for v in skeys], dtype="S")
+        w = max(int(vtab.dtype.itemsize), int(t_vals.dtype.itemsize))
+        t_ids = np.fromiter((extra[v][0] for v in skeys),
+                            dtype=np.uint32, count=len(skeys))
+        combined = np.concatenate([vtab.astype(f"S{w}"),
+                                   t_vals.astype(f"S{w}")])
+        ids_all = np.concatenate([idx_blob.astype(np.uint32), t_ids])
+    else:
+        combined = vtab
+        ids_all = idx_blob.astype(np.uint32)
+    order = np.argsort(combined, kind="stable")
+    merged_vals = combined[order]
+    if merged_vals.size > 1 and \
+            bool((merged_vals[1:] == merged_vals[:-1]).any()):
+        return False  # a value on both sides: counts would exceed 1
+    base = f"p{ti}:{label}"
+    arrays[base + ":v"] = merged_vals
+    arrays[base + ":c"] = np.ones(merged_vals.size, dtype=np.uint32)
+    arrays[base + ":i"] = ids_all[order]
+    arrays[base + ":a"] = np.asarray(any_arr, dtype=np.uint32)
+    labels_meta.setdefault(str(ti), {})[label] = {
+        "w": int(merged_vals.dtype.itemsize) or 1}
+    return True
+
+
+def _emit_label(arrays: dict, labels_meta: dict, ti: int, label: str,
+                values: dict, any_arr) -> None:
+    """Serialize one (tenant, label) posting table into the arrays dict."""
+    vkeys = sorted(values, key=lambda v: v.encode("utf-8"))
+    vbytes = [v.encode("utf-8") for v in vkeys]
+    w = max((len(b) for b in vbytes), default=1) or 1
+    vtab = np.zeros((len(vkeys),), dtype=f"S{w}")
+    counts = np.empty(len(vkeys), dtype=np.uint32)
+    chunks = []
+    for k, (vk, vb) in enumerate(zip(vkeys, vbytes)):
+        vtab[k] = vb
+        ids = values[vk]
+        counts[k] = len(ids)
+        chunks.append(np.asarray(ids, dtype=np.uint32))
+    idx_blob = np.concatenate(chunks) if chunks else \
+        np.empty(0, dtype=np.uint32)
+    base = f"p{ti}:{label}"
+    arrays[base + ":v"] = vtab
+    arrays[base + ":c"] = counts
+    arrays[base + ":i"] = idx_blob
+    arrays[base + ":a"] = np.asarray(any_arr, dtype=np.uint32)
+    labels_meta.setdefault(str(ti), {})[label] = {"w": w}
 
 
 class _LabelPostings:
