@@ -1928,6 +1928,7 @@ def _parse_drop_empty_fields(lex: Lexer):
 register_pipe("extract", _parse_extract)
 register_pipe("extract_regexp", _parse_extract_regexp)
 register_pipe("format", _parse_format)
+register_pipe("fmt", _parse_format)     # reference alias
 register_pipe("math", _parse_math)
 register_pipe("eval", _parse_math)
 register_pipe("unpack_json", _parse_unpack_json)
