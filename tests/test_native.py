@@ -175,3 +175,37 @@ def test_ordered_pair_scan_parity():
                 assert not want, (a, b, v)      # rejected => really absent
             got = bool(definite[i]) or (bool(verify[i]) and want)
             assert got == want, (a, b, v)
+
+
+def test_sequence_single_phrase_word_boundaries(tmp_path):
+    """seq('err') must NOT match 'error ...' (word boundaries per
+    phrase_pos) on the native host path OR the device plan — regression
+    for a substring prefilter that skipped verification."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    from victorialogs_tpu.tpu.batch import BatchRunner
+
+    T0 = 1_753_660_800_000_000_000
+    ten = TenantID(0, 0)
+    s = Storage(str(tmp_path / "seq"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        lr = LogRows(stream_fields=["app"])
+        for i, msg in enumerate(["error happened", "err happened",
+                                 "an err", "xerr", "err"]):
+            lr.add(ten, T0 + i * 1_000_000_000,
+                   [("app", "a"), ("_msg", msg)])
+        s.must_add_rows(lr)
+        s.debug_flush()
+        for runner in (None, BatchRunner()):
+            rows = run_query_collect(
+                s, [ten], '_msg:seq("err") | stats count() c',
+                timestamp=T0, runner=runner)
+            assert rows[0]["c"] == "3", runner
+            rows = run_query_collect(
+                s, [ten], '_msg:seq("err", "happened") | stats count() c',
+                timestamp=T0, runner=runner)
+            assert rows[0]["c"] == "1", runner
+    finally:
+        s.close()

@@ -84,6 +84,37 @@ def canonical_field(field: str) -> str:
     return field or "_msg"
 
 
+def _native_scan_ops(col, ops, combine: str):
+    """AND/OR native scans over one column; None if any scan unavailable
+    (caller falls back to the per-row Python path)."""
+    from .. import native
+    acc = None
+    for op in ops:
+        nb = native.phrase_scan_native(col.arena, col.offsets,
+                                       col.lengths, *op)
+        if nb is None:
+            return None
+        if acc is None:
+            acc = nb
+        elif combine == "and":
+            acc &= nb
+        else:
+            acc |= nb
+        if combine == "and" and not acc.any():
+            break
+    return acc
+
+
+def _native_verify(col, bm, pred) -> None:
+    """pred() survivors of a native prefilter, decoded row-by-row."""
+    arena, offs, lens = col.arena, col.offsets, col.lengths
+    for i in np.nonzero(bm)[0]:
+        o = int(offs[i])
+        v = arena[o:o + int(lens[i])].tobytes().decode("utf-8", "replace")
+        if not pred(v):
+            bm[i] = False
+
+
 class _ValuePredFilter(Filter):
     """Base for single-field filters evaluated as a per-value predicate."""
 
@@ -100,6 +131,13 @@ class _ValuePredFilter(Filter):
         arena scan, or None to stay on the per-row Python path.  Modes
         mirror tpu/kernels.py; the Python matchers remain the oracle
         (randomized parity in tests/test_native.py)."""
+        return None
+
+    def _multi_scan_spec(self) -> tuple | None:
+        """(ops, combine, verify) for multi-pattern native scans:
+        ops = [(pattern_bytes, mode, starts_tok, ends_tok)], combine in
+        {'and','or'}, verify => re-check survivors with _pred (mirrors
+        the device leaf plans in tpu/batch.py)."""
         return None
 
     @staticmethod
@@ -124,15 +162,25 @@ class _ValuePredFilter(Filter):
         # instead of nrows Python predicate calls (host analogue of the
         # device kernel; ~20-50x on phrase/prefix/exact filters)
         spec = self._scan_spec()
-        if spec is not None:
+        multi = None if spec is not None else self._multi_scan_spec()
+        if spec is not None or multi is not None:
             col = self._scan_column(bs, fld)
             if col is not None:
                 from .. import native
-                nb = native.phrase_scan_native(
-                    col.arena, col.offsets, col.lengths, *spec)
-                if nb is not None:
-                    bm &= nb
-                    return
+                if spec is not None:
+                    nb = native.phrase_scan_native(
+                        col.arena, col.offsets, col.lengths, *spec)
+                    if nb is not None:
+                        bm &= nb
+                        return
+                else:
+                    ops, combine, verify = multi
+                    acc = _native_scan_ops(col, ops, combine)
+                    if acc is not None:
+                        bm &= acc
+                        if verify:
+                            _native_verify(col, bm, self._pred)
+                        return
         visit_values(bs, fld, bm, self._pred)
 
     def apply_to_values(self, get_values, nrows: int) -> np.ndarray:
@@ -445,17 +493,9 @@ class FilterRegexp(_ValuePredFilter):
                     bm &= definite | verify
                     self._verify_rows(col, bm, verify)
                     return
-            cand = None
-            for lit in lits:
-                nb = native.phrase_scan_native(
-                    col.arena, col.offsets, col.lengths,
-                    lit.encode("utf-8"), 2, False, False)
-                if nb is None:
-                    cand = None
-                    break
-                cand = nb if cand is None else (cand & nb)
-                if not cand.any():
-                    break
+            cand = _native_scan_ops(
+                col, [(lit.encode("utf-8"), 2, False, False)
+                      for lit in lits], "and")
             if cand is not None:
                 bm &= cand
                 self._verify_rows(col, bm, None)
@@ -463,17 +503,14 @@ class FilterRegexp(_ValuePredFilter):
         visit_values(bs, fld, bm, self._pred)
 
     def _verify_rows(self, col, bm, only) -> None:
-        """re.search survivors, decoded row-by-row from the arena.
-        only: optional mask restricting which set rows need verification
-        (rows outside it are already definite matches)."""
-        arena, offs, lens = col.arena, col.offsets, col.lengths
-        check = bm & only if only is not None else bm
-        for i in np.nonzero(check)[0]:
-            o = int(offs[i])
-            v = arena[o:o + int(lens[i])].tobytes().decode(
-                "utf-8", "replace")
-            if self._re.search(v) is None:
-                bm[i] = False
+        """re.search survivors; only: optional mask restricting which set
+        rows need verification (others are already definite matches)."""
+        if only is None:
+            _native_verify(col, bm, self._pred)
+            return
+        check = bm & only
+        _native_verify(col, check, self._pred)  # clears failed rows
+        bm &= ~only | check
 
     def to_string(self):
         return f"{_q(self.field)}~{quote_str(self.pattern)}"
@@ -644,6 +681,13 @@ class FilterContainsAll(_ValuePredFilter):
     def _pred(self, v):
         return all(match_phrase(v, p) for p in self.values)
 
+    def _multi_scan_spec(self):
+        if not self.values or any(not p for p in self.values):
+            return None  # empty value: keep the Python semantics
+        ops = [(p.encode("utf-8"), 0, is_word_char(p[0]),
+                is_word_char(p[-1])) for p in self.values]
+        return ops, "and", False
+
     def _tokens(self):
         out = []
         for p in self.values:
@@ -667,6 +711,13 @@ class FilterContainsAny(_ValuePredFilter):
     def _pred(self, v):
         return any(match_phrase(v, p) for p in self.values)
 
+    def _multi_scan_spec(self):
+        if not self.values or any(not p for p in self.values):
+            return None
+        ops = [(p.encode("utf-8"), 0, is_word_char(p[0]),
+                is_word_char(p[-1])) for p in self.values]
+        return ops, "or", False
+
     def to_string(self):
         return (f"{_q(self.field)}contains_any("
                 f"{','.join(quote_str(v) for v in self.values)})")
@@ -679,6 +730,16 @@ class FilterSequence(_ValuePredFilter):
 
     def _pred(self, v):
         return match_sequence(v, self.phrases)
+
+    def _multi_scan_spec(self):
+        if not self.phrases or any(not p for p in self.phrases):
+            return None
+        # each phrase must appear at word boundaries (match_sequence uses
+        # phrase_pos), so MODE_PHRASE prefilters are exact per phrase;
+        # ORDER is checked by _pred on survivors when more than one
+        ops = [(p.encode("utf-8"), 0, is_word_char(p[0]),
+                is_word_char(p[-1])) for p in self.phrases]
+        return ops, "and", len(self.phrases) > 1
 
     def _tokens(self):
         out = []

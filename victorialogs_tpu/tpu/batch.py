@@ -111,7 +111,11 @@ def device_plan(f) -> LeafPlan | None:
     if isinstance(f, F.FilterSequence):
         if not f.phrases or any(not ok(p) for p in f.phrases):
             return None
-        ops = [ScanOp(p.encode(), K.MODE_SUBSTRING) for p in f.phrases]
+        # phrases carry word boundaries (match_sequence via phrase_pos):
+        # MODE_PHRASE is exact per phrase; ORDER still needs host verify
+        # when there is more than one
+        ops = [ScanOp(p.encode(), K.MODE_PHRASE, is_word_char(p[0]),
+                      is_word_char(p[-1])) for p in f.phrases]
         return LeafPlan(f, canonical_field(f.field), ops, "and",
                         f._tokens(), verify=len(f.phrases) > 1)
 
