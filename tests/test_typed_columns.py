@@ -196,3 +196,33 @@ def test_min_after_count_materialization(storage):
         timestamp=T0)
     assert rows[0]["ln"] == "error"
     assert rows[0]["cl"] == "4000"
+
+
+def test_top_and_uniq_dict_fast_paths(storage, monkeypatch):
+    """`top by (lvl)` / `uniq by (lvl)` count through dict codes without
+    materializing the string column; results identical to the generic
+    path (forced via copy)."""
+    calls = []
+    orig = br_mod.BlockResult.column
+
+    def spy(self, name):
+        if self._bs is not None:
+            calls.append(name)
+        return orig(self, name)
+    monkeypatch.setattr(br_mod.BlockResult, "column", spy)
+    top = run_query_collect(storage, [TEN], "* | top 3 by (lvl)",
+                            timestamp=T0)
+    unq = run_query_collect(storage, [TEN], "* | uniq by (lvl) with hits",
+                            timestamp=T0)
+    assert "lvl" not in calls
+    top2 = run_query_collect(storage, [TEN],
+                             "* | copy lvl lx | top 3 by (lx)",
+                             timestamp=T0)
+    unq2 = run_query_collect(storage, [TEN],
+                             "* | copy lvl lx | uniq by (lx) with hits",
+                             timestamp=T0)
+    strip = lambda rows: sorted(tuple(sorted(
+        ("lvl" if k == "lx" else k, v) for k, v in r.items()))
+        for r in rows)
+    assert strip(top) == strip(top2)
+    assert strip(unq) == strip(unq2)

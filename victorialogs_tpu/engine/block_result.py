@@ -219,6 +219,20 @@ class BlockResult:
             return self._bs.stream_id.as_string()
         return None
 
+    def dict_value_counts(self, name: str):
+        """[(value, count)] over the selected rows of a const/dict
+        column, or None — group-by/top/uniq count through the stored
+        codes instead of materializing strings."""
+        cv = self.const_value(name)
+        if cv is not None:
+            return [(cv, self.nrows)]
+        dc = self.dict_column(name)
+        if dc is None:
+            return None
+        ids, dvals = dc
+        binc = np.bincount(ids, minlength=len(dvals))
+        return [(dvals[j], int(binc[j])) for j in np.nonzero(binc)[0]]
+
     def dict_column(self, name: str):
         """(selected dict ids uint8, dict value strings) for a
         dict-encoded column, or None — lets group-by factorize through

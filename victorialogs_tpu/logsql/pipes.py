@@ -623,6 +623,20 @@ class PipeUniq(Pipe):
             def write_block(self, br):
                 if pipe.limit and len(self.seen) > pipe.limit:
                     return  # limit exceeded: stop accumulating
+                if len(pipe.by) == 1 and \
+                        hasattr(br, "dict_value_counts"):
+                    # typed fast path for one const/dict by-column
+                    f = pipe.by[0]
+                    pairs = br.dict_value_counts(f)
+                    if pairs is not None:
+                        for v, cnt in pairs:
+                            key = ((f, v),) if v != "" else ()
+                            if key not in self.seen:
+                                self.seen[key] = cnt
+                                self.budget.add(len(f) + len(v) + 80)
+                            else:
+                                self.seen[key] += cnt
+                        return
                 fields = pipe.by or br.column_names()
                 cols = [(f, br.column(f)) for f in fields]
                 for ri in range(br.nrows):

@@ -1130,6 +1130,20 @@ class PipeTop(Pipe):
                 self.budget = MemoryBudget(0.4, "top")
 
             def write_block(self, br):
+                if len(pipe.by) == 1 and \
+                        hasattr(br, "dict_value_counts"):
+                    # typed fast path: const/dict columns count through
+                    # their stored codes, no per-row Python
+                    pairs = br.dict_value_counts(pipe.by[0])
+                    if pairs is not None:
+                        for v, cnt in pairs:
+                            key = (v,)
+                            if key not in self.counts:
+                                self.counts[key] = cnt
+                                self.budget.add(len(v) + 80)
+                            else:
+                                self.counts[key] += cnt
+                        return
                 if pipe.by:
                     cols = [br.column(f) for f in pipe.by]
                     keys = (tuple(c[i] for c in cols)
