@@ -118,3 +118,46 @@ def test_random_stats_parity(storage):
         norm = lambda rows: sorted(tuple(sorted(r.items())) for r in rows)
         assert norm(cpu) == norm(dev), qs
     assert runner.stats_dispatches > 0
+
+
+def test_random_pipe_chains_parity(storage):
+    """Random filter + pipe chains: device runner vs CPU executor.
+    Catches integration bugs across needed-fields propagation, typed
+    fast paths, and the stats device spec (the last two real bugs came
+    from exactly this kind of composition)."""
+    rnd = random.Random(4242)
+    runner = BatchRunner()
+    pipe_pool = [
+        "fields _time, _msg, app, num",
+        "copy num n2",
+        "rename num n3",
+        "where num:>100",
+        "filter err",
+        "sort by (num) limit 7",
+        "sort by (_time) desc limit 5",
+        "uniq by (app) with hits",
+        "top 3 by (app)",
+        "stats by (app) count() c, sum(num) s",
+        "stats by (_time:9m) count() c",
+        "stats count_uniq(app) u, min(num) mn, max(num) mx",
+        "limit 20",
+        "offset 3 | limit 5",
+        "format '<app>:<num>' as fx",
+        "extract 'tok<w>' from _msg",
+        "math num * 2 as dbl",
+        "len(_msg) as L",
+        "drop_empty_fields",
+        "unroll by (app)",
+    ]
+    for i in range(80):
+        filt = _rand_filter(rnd, depth=rnd.randint(0, 2))
+        chain = " | ".join(rnd.sample(pipe_pool, rnd.randint(1, 3)))
+        qs = f"{filt} | {chain}"
+        try:
+            cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        except Exception:
+            continue  # invalid combo: both sides must agree it's invalid
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        norm = lambda rows: sorted(tuple(sorted(r.items())) for r in rows)
+        assert norm(cpu) == norm(dev), qs
