@@ -226,3 +226,36 @@ def test_top_and_uniq_dict_fast_paths(storage, monkeypatch):
         for r in rows)
     assert strip(top) == strip(top2)
     assert strip(unq) == strip(unq2)
+
+
+def test_math_vectorized_matches_row_path(storage):
+    """Arithmetic math exprs vectorize over typed columns; forcing the
+    string path (copy) must give identical output, including div-by-zero
+    -> NaN and float formatting."""
+    for expr in ["dur * 2", "dur + ratio", "(dur - 100) / ratio",
+                 "dur / (dur - dur)", "dur * 2 + 1 - ratio / 4"]:
+        q1 = f"* | math {expr} as r | stats sum(r) s, count(r) c"
+        q2 = ("* | copy dur durc, ratio ratioc | math "
+              f"{expr.replace('dur', 'durc').replace('ratio', 'ratioc')}"
+              " as r | stats sum(r) s, count(r) c")
+        r1 = run_query_collect(storage, [TEN], q1, timestamp=T0)
+        r2 = run_query_collect(storage, [TEN], q2, timestamp=T0)
+        assert r1 == r2, expr
+
+
+def test_math_numeric_view_staleness(storage):
+    """Overwriting a math result (format/copy/another math) or shadowing
+    a source column must invalidate/compose the numeric view — repro
+    queries from review."""
+    cases = [
+        ('* | math dur * 2 as r | format "7" as r | stats sum(r) s',
+         str(7 * 4000)),
+        ("* | math dur * 2 as r | math r % 3 as r | stats count(r) c",
+         "4000"),
+        ("* | math dur * 2 as dur, dur + 1 as x | stats max(x) m",
+         str(906 * 2 + 1)),
+    ]
+    for qs, want in cases:
+        rows = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        (_k, got), = [kv for kv in rows[0].items()]
+        assert got == want, (qs, rows[0])

@@ -92,6 +92,12 @@ class BlockResult:
         self._needed: set | None = None       # needed-columns restriction
         self._ts_list: list[int] | None = None
         self._ts_np: np.ndarray | None = None
+        # numeric views of produced columns (e.g. math results): maps
+        # name -> (string_list_identity, float64 array).  The view is
+        # only honored while _cols[name] IS that exact list object, so
+        # any pipe overwriting the column silently invalidates it —
+        # no per-pipe bookkeeping needed.
+        self._num_cols: dict[str, tuple] = {}
 
     # timestamps materialize lazily: storage-backed blocks carry the int64
     # array and only build the Python list when a consumer indexes it
@@ -163,6 +169,9 @@ class BlockResult:
         or None — lets stats skip per-row string parsing (the reference
         keeps blockResult columns type-encoded for the same reason —
         block_result.go:26-63)."""
+        got = self._num_cols.get(name)
+        if got is not None and self._cols.get(name) is got[0]:
+            return got[1]
         if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
@@ -294,6 +303,9 @@ class BlockResult:
         out = BlockResult.from_columns(cols)
         out._ts_np = self._ts_np
         out._ts_list = self._ts_list
+        for nm, (ref, arr) in self._num_cols.items():
+            if out._cols.get(nm) is ref:
+                out._num_cols[nm] = (ref, arr)
         # a needed-columns restriction can leave zero columns while rows
         # still exist (e.g. copy/rename rebuilding them); keep the count
         out.nrows = self.nrows
@@ -317,6 +329,11 @@ class BlockResult:
             br._ts_np = self._ts_np[keep]
         elif self._ts_list is not None:
             br._ts_list = [self._ts_list[i] for i in keep.tolist()]
+        for nm, (ref, arr) in self._num_cols.items():
+            if br._cols.get(nm) is not None and \
+                    self._cols.get(nm) is ref:
+                # pair the view with the freshly sliced list
+                br._num_cols[nm] = (br._cols[nm], arr[keep])
         return br
 
     def rows(self, fields: list[str] | None = None) -> list[dict]:

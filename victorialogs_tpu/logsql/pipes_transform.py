@@ -676,6 +676,41 @@ class MathExpr:
         except (ValueError, OverflowError, ZeroDivisionError):
             return math.nan
 
+    # vectorizable core: const/field/{+,-,*,/} over storage-typed numeric
+    # columns — identical IEEE semantics to the per-row path (division by
+    # zero maps to NaN exactly like eval_row).  Anything else returns
+    # None and the per-row interpreter runs.
+    _VEC_OPS = {"+", "-", "*", "/"}
+
+    def eval_vec(self, br, n, produced=None):
+        k = self.kind
+        if k == "const":
+            return np.full(n, float(self.value))
+        if k == "field":
+            if produced and self.value in produced:
+                # an earlier entry (re)wrote this field: its vec result,
+                # or None when it took the row path (bail to rows too)
+                return produced[self.value]
+            if not hasattr(br, "numeric_column"):
+                return None
+            return br.numeric_column(self.value)
+        if k == "binop" and self.op in self._VEC_OPS:
+            a = self.args[0].eval_vec(br, n, produced)
+            if a is None:
+                return None
+            b = self.args[1].eval_vec(br, n, produced)
+            if b is None:
+                return None
+            with np.errstate(all="ignore"):
+                if self.op == "+":
+                    return a + b
+                if self.op == "-":
+                    return a - b
+                if self.op == "*":
+                    return a * b
+                return np.where(b == 0.0, np.nan, a / b)
+        return None
+
     def to_string(self) -> str:
         if self.kind == "const":
             from .stats_funcs import format_number
@@ -803,13 +838,23 @@ class PipeMath(Pipe):
                 def get(name):
                     return out.column(name) if out.has_column(name) \
                         else [""] * out.nrows
+                produced: dict = {}
                 for expr, res in pipe.entries:
-                    vals = []
-                    for i in range(br.nrows):
-                        v = expr.eval_row(get, i)
-                        vals.append("NaN" if math.isnan(v)
-                                    else format_number(v))
-                    out._cols[res] = vals
+                    vec = expr.eval_vec(br, br.nrows, produced)
+                    if vec is not None:
+                        vals = [
+                            "NaN" if math.isnan(v) else format_number(v)
+                            for v in vec.tolist()]
+                        out._cols[res] = vals
+                        out._num_cols[res] = (vals, vec)
+                    else:
+                        vals = []
+                        for i in range(br.nrows):
+                            v = expr.eval_row(get, i)
+                            vals.append("NaN" if math.isnan(v)
+                                        else format_number(v))
+                        out._cols[res] = vals
+                    produced[res] = vec
                 self.next_p.write_block(out)
         return P(next_p)
 

@@ -155,14 +155,22 @@ class StatsSum(StatsFunc):
         for c in cols:
             if isinstance(c, np.ndarray):
                 sub = c if len(idxs) == c.shape[0] else c[idxs]
+                # produced numeric views (math results) may carry NaN for
+                # non-numeric rows: skip them exactly like the string path
+                nanmask = np.isnan(sub)
+                if nanmask.any():
+                    sub = sub[~nanmask]
                 if sub.size:
                     add = float(np.sum(sub))
                     s = add if math.isnan(s) else s + add
                 continue
-            for i in idxs:
-                v = parse_number(c[i]) if c[i] else math.nan
-                if not math.isnan(v):
-                    s = v if math.isnan(s) else s + v
+            # same per-block pairwise summation as the array branch, so
+            # typed and string paths produce bit-identical float sums
+            buf = [v for i in idxs
+                   if c[i] and not math.isnan(v := parse_number(c[i]))]
+            if buf:
+                add = float(np.sum(np.asarray(buf, dtype=np.float64)))
+                s = add if math.isnan(s) else s + add
         return s
 
     def merge(self, a, b):
@@ -380,14 +388,17 @@ class StatsAvg(StatsFunc):
         for c in cols:
             if isinstance(c, np.ndarray):
                 sub = c if len(idxs) == c.shape[0] else c[idxs]
+                nanmask = np.isnan(sub)
+                if nanmask.any():
+                    sub = sub[~nanmask]
                 s += float(np.sum(sub))
                 n += int(sub.size)
                 continue
-            for i in idxs:
-                v = parse_number(c[i]) if c[i] else math.nan
-                if not math.isnan(v):
-                    s += v
-                    n += 1
+            buf = [v for i in idxs
+                   if c[i] and not math.isnan(v := parse_number(c[i]))]
+            if buf:
+                s += float(np.sum(np.asarray(buf, dtype=np.float64)))
+                n += len(buf)
         return (s, n)
 
     def merge(self, a, b):
