@@ -339,7 +339,18 @@ class PipeWhere(Pipe):
 
         class P(Processor):
             def write_block(self, br):
-                mask = flt.apply_to_values(br.column, br.nrows)
+                bs = getattr(br, "_bs", None)
+                if bs is not None and not br._cols:
+                    # storage-backed rows: evaluate through the block path
+                    # (bloom kill-path + native arena scans) and slice the
+                    # full-block bitmap through the selection — identical
+                    # semantics to per-value apply (both use _pred)
+                    import numpy as np
+                    full = np.ones(bs.nrows, dtype=bool)
+                    flt.apply_to_block(bs, full)
+                    mask = full[br._sel]
+                else:
+                    mask = flt.apply_to_values(br.column, br.nrows)
                 if mask.all():
                     self.next_p.write_block(br)
                 elif mask.any():
