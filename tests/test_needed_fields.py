@@ -95,3 +95,32 @@ def test_full_output_still_complete(store):
     rows = run_query_collect(store, [TEN], "* | limit 1", timestamp=T0)
     assert set(rows[0]) >= {"_time", "_stream", "app", "_msg", "payload",
                             "code"}
+
+
+def test_chained_copy_needed_fields_parallel_semantics(tmp_path):
+    """copy reads every src from the ORIGINAL block: `copy a as b, b as c`
+    needs {a, b} from its input even when only c is consumed — caught as
+    silently-empty output after a materializing pipe (review repro)."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+
+    T0 = 1_753_660_800_000_000_000
+    ten = TenantID(0, 0)
+    s = Storage(str(tmp_path / "cpnf"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        lr = LogRows(stream_fields=["app"])
+        for i in range(4):
+            lr.add(ten, T0 + i * 1_000_000_000,
+                   [("app", "x"), ("_msg", "m"),
+                    ("a", f"A{i}"), ("b", f"B{i}")])
+        s.must_add_rows(lr)
+        s.debug_flush()
+        rows = run_query_collect(
+            s, [ten],
+            '* | format "<a>" as z | copy a as b, b as c | fields c',
+            timestamp=T0)
+        assert [r.get("c") for r in rows] == ["B0", "B1", "B2", "B3"]
+    finally:
+        s.close()

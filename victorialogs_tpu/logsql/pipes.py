@@ -188,14 +188,16 @@ class PipeCopy(Pipe):
         return True
 
     def input_fields(self, out_needed):
-        # a needed dst maps back to its src (the copy produces dst);
-        # srcs also pass through unchanged
+        # the processor reads EVERY pair's src from the ORIGINAL block
+        # (parallel semantics), so a needed dst requires its src as-is —
+        # no sequential substitution through chained pairs
         if "*" in out_needed:
             return out_needed
         out = set(out_needed)
-        for s, d in reversed(self.pairs):
-            if d in out:
-                out.discard(d)
+        for _s, d in self.pairs:
+            out.discard(d)          # produced/overwritten by the copy
+        for s, d in self.pairs:
+            if d in out_needed:
                 out.add(s)
         return out
 
