@@ -83,6 +83,12 @@ STATS_QUERIES = [
     "deadline | stats by (_time:5m, app) count() c",    # axis order
     "* | stats by (app, lvlmissing) count() c",         # absent field -> ''
     "* | stats by (_stream) count() c",         # special field: fallback
+    "* | stats by (dur:100) count() c, sum(delta) s",   # numeric buckets
+    "* | stats by (ratio:0.25) count() c",      # float-column buckets
+    "* | stats by (dur:50 offset 7) count() c",
+    "deadline | stats by (dur:100, _time:10m) count() c, min(dur) mn",
+    "* | stats by (dur:-5) count() c",          # invalid step -> raw keys
+    "* | stats by (dur:100) count_uniq(dur) u", # bucket + raw uniq axis
     "nosuchtoken | stats count() c",            # empty result
     "_time:[2025-07-28T00:00:00Z, 2025-07-28T00:10:00Z] | stats "
     "by (_time:1m) rate() r",

@@ -105,7 +105,9 @@ def test_random_stats_parity(storage):
              "avg(num) a", "count(num) cn", "count_uniq(app) u",
              "count_uniq(_stream_id) usid", "count_uniq(_msg) um"]
     bys = ["", "by (app) ", "by (_time:7m) ", "by (app, _time:13m) ",
-           "by (_time:5m offset 90s) ", "by (app, missingf) "]
+           "by (_time:5m offset 90s) ", "by (app, missingf) ",
+           "by (num:40) ", "by (num:25 offset 3, app) ",
+           "by (num:7, _time:11m) "]
     for i in range(120):
         filt = _rand_filter(rnd, depth=rnd.randint(0, 2))
         by = rnd.choice(bys)

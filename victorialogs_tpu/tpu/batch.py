@@ -352,6 +352,50 @@ class StagedDict:
         return self.nbytes
 
 
+def stage_num_buckets(part, field: str, layout: StatsLayout,
+                      fstep: float, foff: float,
+                      put=None) -> StagedDict | None:
+    """Stage a numeric group-by bucket axis: per-row codes into a table
+    of bucket-KEY strings, using the HOST's exact formula
+    (floor((v - off) / step) * step + off, keys via format_number) so
+    group keys are bit-identical (pipes.PipeStats._bucket_value)."""
+    import jax.numpy as jnp
+    from ..logsql.stats_funcs import format_number
+    from ..storage.values_encoder import VT_FLOAT64
+    if put is None:
+        put = jnp.asarray
+
+    ids = np.zeros(layout.nrows_padded, dtype=np.int32)
+    values: list[str] = []
+    code_of: dict[str, int] = {}
+    eligible = []
+    numeric_vts = _int_vtypes() + (VT_FLOAT64,)
+    for bi in range(part.num_blocks):
+        meta = part.block_column_meta(bi, field)
+        if meta is None or meta["t"] not in numeric_vts:
+            continue  # const/dict/string/ipv4/ts blocks: host path
+        col = part.block_column(bi, field)
+        f = col.nums.astype(np.float64)
+        vb = np.floor((f - foff) / fstep) * fstep + foff
+        uniq, inv = np.unique(vb, return_inverse=True)
+        remap = np.empty(uniq.shape[0], dtype=np.int32)
+        for k, v in enumerate(uniq.tolist()):
+            key = format_number(v)
+            c = code_of.get(key)
+            if c is None:
+                c = code_of[key] = len(values)
+                values.append(key)
+            remap[k] = c
+        start = layout.starts[bi]
+        ids[start:start + f.shape[0]] = remap[inv]
+        eligible.append(bi)
+    if not eligible:
+        return None
+    return StagedDict(ids=put(ids), values=values,
+                      eligible=frozenset(eligible),
+                      nbytes=layout.nrows_padded * 4)
+
+
 def stage_dict_codes(part, field: str, layout: StatsLayout,
                      put=None) -> StagedDict | None:
     """Stage one group-by column as int32 global codes per row."""
@@ -865,6 +909,25 @@ class BatchRunner:
                     return bms, set(), []
                 axes.append(("t", sb.ids, sb.num_buckets,
                              (sb.base, bk.step)))
+            elif bk.kind == "numbucket":
+                key = (part.uid, "#nb", bk.name, bk.fstep, bk.foff)
+                with self._key_lock(key):
+                    sd = self.cache.get(key)
+                    if sd is _UNSTAGEABLE:
+                        return bms, set(), []
+                    if sd is None:
+                        sd = stage_num_buckets(part, bk.name, layout,
+                                               bk.fstep, bk.foff,
+                                               put=self._put)
+                        if sd is None:
+                            self.cache.put_small(key, _UNSTAGEABLE)
+                            return bms, set(), []
+                        self.cache.put(key, sd)
+                # payload name None: a uniq axis must never share a
+                # BUCKETED axis (it needs raw value codes)
+                axes.append(("v", sd.ids, len(sd.values),
+                             (None, sd.values)))
+                eligibility.append(sd.eligible)
             else:
                 sd = self._stage_dict(part, bk.name, layout)
                 if sd is None:

@@ -32,6 +32,7 @@ from dataclasses import dataclass
 
 from ..logsql import stats_funcs as sf
 from ..logsql.duration import parse_duration
+from ..logsql.matchers import parse_number as _parse_num
 
 MAX_BUCKETS = 8192
 MAX_STAT_ROWS = 16 << 20          # plane-sum bound: 255 * R < 2**32
@@ -46,10 +47,12 @@ class FuncSpec:
 
 @dataclass
 class ByKey:
-    kind: str                     # 'time' | 'field'
-    name: str = ""                # field name (kind == 'field')
+    kind: str                     # 'time' | 'field' | 'numbucket'
+    name: str = ""                # field name ('field'/'numbucket')
     step: int = 0                 # ns (kind == 'time')
     offset: int = 0               # ns (kind == 'time')
+    fstep: float = 0.0            # numeric bucket size ('numbucket')
+    foff: float = 0.0             # numeric bucket offset ('numbucket')
 
 
 @dataclass
@@ -136,9 +139,21 @@ def device_stats_spec(q) -> StatsSpec | None:
                 return None
             by.append(ByKey("time", step=int(d), offset=b.offset_ns()))
             continue
-        if b.bucket or b.name in ("_time", "_stream", "_stream_id") or \
-                "*" in b.name:
-            return None  # numeric bucketing / special fields: host path
+        if b.name in ("_time", "_stream", "_stream_id") or "*" in b.name:
+            return None  # special fields: host path
+        if b.bucket:
+            fstep = _parse_num(b.bucket)
+            if math.isnan(fstep) or fstep <= 0:
+                # invalid bucket: the host keys on the raw value, which
+                # is exactly the plain dict-code axis
+                by.append(ByKey("field", name=b.name))
+                continue
+            foff = _parse_num(b.bucket_offset) if b.bucket_offset else 0.0
+            if math.isnan(foff):
+                foff = 0.0
+            by.append(ByKey("numbucket", name=b.name, fstep=fstep,
+                            foff=foff))
+            continue
         by.append(ByKey("field", name=b.name))
     funcs = []
     for fn in ps.funcs:
