@@ -209,3 +209,48 @@ def test_sequence_single_phrase_word_boundaries(tmp_path):
             assert rows[0]["c"] == "1", runner
     finally:
         s.close()
+
+
+def test_any_case_native_parity(tmp_path, monkeypatch):
+    """i("...") case-insensitive filters: native ascii-lower scan (with
+    unicode rows verified per-row) vs the pure-Python path."""
+    from victorialogs_tpu import native
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    if not native.available():
+        pytest.skip("native lib unavailable")
+
+    T0 = 1_753_660_800_000_000_000
+    ten = TenantID(0, 0)
+    s = Storage(str(tmp_path / "ac"), retention_days=100000,
+                flush_interval=3600)
+    try:
+        import random
+        rnd = random.Random(11)
+        words = ["Error", "ERROR", "error", "ErRoR", "err", "İstanbul",
+                 "STRASSE", "straße", "ok", "xerror", "errorx", "İ"]
+        lr = LogRows(stream_fields=["app"])
+        for i in range(3000):
+            msg = " ".join(rnd.choice(words)
+                           for _ in range(rnd.randint(0, 4)))
+            lr.add(ten, T0 + i * 1_000_000, [("app", "a"), ("_msg", msg)])
+        s.must_add_rows(lr)
+        s.debug_flush()
+
+        queries = ['i("error")', 'i("ERR"*)', 'i("istanbul")',
+                   'i("strasse")', '_msg:i("İSTANBUL")', 'i("er"*)',
+                   'i("ok")']
+        native_res = [run_query_collect(
+            s, [ten], f"{q} | stats count() c", timestamp=T0)
+            for q in queries]
+        # force the pure-Python path
+        monkeypatch.setattr(native, "phrase_scan_native",
+                            lambda *a, **k: None)
+        python_res = [run_query_collect(
+            s, [ten], f"{q} | stats count() c", timestamp=T0)
+            for q in queries]
+        assert native_res == python_res, list(zip(queries, native_res,
+                                                  python_res))
+    finally:
+        s.close()

@@ -105,6 +105,35 @@ def _native_scan_ops(col, ops, combine: str):
     return acc
 
 
+def _any_case_scan(col, phrase_lower: str, mode: int, st: bool,
+                   et: bool, pred, bm) -> bool:
+    """Case-insensitive native scan: ASCII-lower a copy of the arena and
+    scan it; rows containing non-ASCII bytes verify through pred (their
+    unicode case folding can differ, e.g. 'İ').lower()).  Returns False
+    to fall back entirely."""
+    if not phrase_lower.isascii() or not phrase_lower:
+        return False
+    from .. import native
+    arena = col.arena
+    low = arena.copy()
+    up = (low >= 65) & (low <= 90)
+    low[up] += 32
+    nb = native.phrase_scan_native(low, col.offsets, col.lengths,
+                                   phrase_lower.encode(), mode, st, et)
+    if nb is None:
+        return False
+    highs = np.zeros(arena.shape[0] + 1, dtype=np.int64)
+    np.cumsum(arena >= 128, out=highs[1:])
+    offs = col.offsets
+    rowhigh = (highs[offs + col.lengths] - highs[offs]) > 0
+    bm &= nb | rowhigh
+    check = bm & rowhigh
+    if check.any():
+        _native_verify(col, check, pred)
+        bm &= ~rowhigh | check
+    return True
+
+
 def _native_verify(col, bm, pred) -> None:
     """pred() survivors of a native prefilter, decoded row-by-row."""
     arena, offs, lens = col.arena, col.offsets, col.lengths
@@ -428,6 +457,17 @@ class FilterAnyCasePhrase(_ValuePredFilter):
     def _pred(self, v):
         return match_any_case_phrase(v, self._lower)
 
+    def apply_to_block(self, bs, bm):
+        fld = canonical_field(self.field)
+        col = self._scan_column(bs, fld)
+        if col is not None and self._lower and \
+                _any_case_scan(col, self._lower, 0,
+                               is_word_char(self._lower[0]),
+                               is_word_char(self._lower[-1]),
+                               self._pred, bm):
+            return
+        visit_values(bs, fld, bm, self._pred)
+
     def to_string(self):
         return f"{_q(self.field)}i({quote_str(self.phrase)})"
 
@@ -442,6 +482,16 @@ class FilterAnyCasePrefix(_ValuePredFilter):
 
     def _pred(self, v):
         return match_any_case_prefix(v, self._lower)
+
+    def apply_to_block(self, bs, bm):
+        fld = canonical_field(self.field)
+        col = self._scan_column(bs, fld)
+        if col is not None and self._lower and \
+                _any_case_scan(col, self._lower, 1,
+                               is_word_char(self._lower[0]), False,
+                               self._pred, bm):
+            return
+        visit_values(bs, fld, bm, self._pred)
 
     def to_string(self):
         return f"{_q(self.field)}i({quote_str(self.prefix)}*)"
