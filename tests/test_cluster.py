@@ -283,3 +283,74 @@ def test_cluster_matches_single_node(ingested, tmp_path_factory):
     finally:
         single.terminate()
         single.wait(10)
+
+
+def test_cluster_with_tpu_storage_nodes(tmp_path):
+    """Full multi-process cluster where the STORAGE NODES run the device
+    runner (-tpu on the jax-CPU backend): sharded ingest, stats pushdown
+    through the device partials, results identical to a plain node."""
+    procs = []
+    tmp = str(tmp_path)
+    try:
+        ports = []
+        for k in range(2):
+            port = _free_port()
+            procs.append(_start(
+                ["-storageDataPath", f"{tmp}/tnode{k}",
+                 "-httpListenAddr", f"127.0.0.1:{port}",
+                 "-retentionPeriod", "100y", "-tpu"]))
+            ports.append(port)
+        front_port = _free_port()
+        procs.append(_start(
+            ["-storageDataPath", f"{tmp}/tfront",
+             "-httpListenAddr", f"127.0.0.1:{front_port}",
+             "-retentionPeriod", "100y"]
+            + sum((["-storageNode", f"http://127.0.0.1:{p}"]
+                   for p in ports), [])))
+        for p in ports + [front_port]:
+            assert _wait_http(p), "server did not start"
+
+        rows = []
+        for i in range(4000):
+            rows.append({"_time": 1_753_660_800_000_000_000 + i * 1_000_000,
+                         "app": f"app{i % 5}",
+                         "_msg": f"m {'err' if i % 3 == 0 else 'ok'} {i}",
+                         "dur": str(i % 211)})
+        _insert(front_port, rows)
+        for p in ports:
+            _flush(p)
+
+        def q(query):
+            url = (f"http://127.0.0.1:{front_port}/select/logsql/query?"
+                   + urllib.parse.urlencode({
+                       "query": query,
+                       "start": "2025-07-01T00:00:00Z",
+                       "end": "2025-08-30T00:00:00Z"}))
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                return sorted(
+                    (json.loads(l)
+                     for l in resp.read().decode().splitlines()
+                     if l.strip()), key=lambda r: sorted(r.items()))
+
+        got = q("err | stats by (app) count() c, sum(dur) s")
+        # expected computed directly
+        exp = {}
+        for i in range(4000):
+            if i % 3 == 0:
+                k = f"app{i % 5}"
+                c, s_ = exp.get(k, (0, 0))
+                exp[k] = (c + 1, s_ + i % 211)
+        want = sorted(({"app": k, "c": str(c), "s": str(s_)}
+                       for k, (c, s_) in exp.items()),
+                      key=lambda r: sorted(r.items()))
+        assert got == want
+        got2 = q("* | stats count_uniq(_stream_id) u, count() c")
+        assert got2 == [{"u": "5", "c": "4000"}]
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()

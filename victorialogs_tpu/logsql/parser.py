@@ -758,6 +758,14 @@ def _time_bound(lex: Lexer, s: str, end: bool) -> int:
         return _now_ns(lex) + d if d < 0 else _now_ns(lex) - d
     tb = ts_bounds(s)
     if tb is None:
+        if s.isdigit() or (s[:1] == "-" and s[1:].isdigit()):
+            # bare integer: unix seconds/millis/micros/nanos by magnitude
+            # — FilterTime.to_string() serializes raw nanos, and the
+            # cluster frontend round-trips queries through to_string()
+            from ..server.insertutil import parse_timestamp
+            ts = parse_timestamp(int(s))
+            if ts is not None:
+                return ts
         raise ParseError(f"cannot parse time bound {s!r}")
     return tb[1] if end else tb[0]
 
