@@ -1,0 +1,169 @@
+"""Single-dispatch fused filter|stats path (tpu/fused.py) vs the CPU
+executor: bit-exact over adversarial tree shapes, with the residue
+(maybe-row) machinery explicitly exercised.
+
+The fused path's contract: same rows, same group keys, same aggregates
+as the host executor for every query it accepts — and clean fallback
+(still correct) for everything it declines."""
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fused"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    rng = np.random.default_rng(11)
+    words = ["deadline exceeded", "connection reset", "ok", "retry later",
+             "cache miss", "flushed"]
+    for i in range(9000):
+        msg = f"GET /api/x{i % 71} {words[i % 6]} dur={i % 351}ms"
+        if i % 97 == 0:
+            # newline between the A..B literals: the ordered-pair scan
+            # must route these rows through the host residue pass
+            msg = f"GET /api\nlate {words[i % 6]} tail"
+        fields = [
+            ("app", f"app{i % 4}"),
+            ("_msg", msg),
+            ("lvl", ["info", "warn", "error"][i % 3]),   # dict column
+            ("dur", str(i % 351)),                        # uint column
+        ]
+        lr.add(TEN, T0 + i * 200_000_000, fields)
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+FUSED_QUERIES = [
+    # plain scans, and/or/not trees
+    '"deadline exceeded" | stats count() c',
+    '"deadline exceeded" OR "connection reset" | stats count() c',
+    'NOT "ok" | stats count() c',
+    '("retry later" OR "cache miss") "GET" | stats count() c',
+    'NOT ("ok" OR "retry later") | stats by (_time:5m) count() c',
+    # time filter composes on device (inclusive-bound semantics)
+    '_time:[2025-07-28T00:05:00Z, 2025-07-28T00:20:00Z] "deadline '
+    'exceeded" | stats count() c',
+    '_time:[2025-07-28T00:00:00Z, 2025-07-28T00:10:00Z] | stats '
+    'by (_time:1m) count() c',
+    # prefix / exact / contains / substring-regex leaves
+    '_msg:"GET"* | stats count() c',
+    # numeric-typed column scanned as text: stage_layout_column declines,
+    # the unfused path answers (still bit-identical)
+    'dur:13* | stats count() c',
+    'lvl:exact("error") | stats by (_time:10m) count() c',
+    'lvl:contains_any("warn", "error") | stats count() c',
+    '_msg:~"deadline" | stats count() c',
+    # ordered-pair regex incl. newline rows -> host residue partials
+    '_msg:~"GET.*exceeded" | stats count() c',
+    '_msg:~"GET.*tail" | stats count() c',                # only \n rows
+    '_msg:~"GET.*exceeded" | stats by (_time:5m, app) count() c',
+    '_msg:~"GET.*exceeded" | stats by (app) sum(dur) s, min(dur) mn, '
+    'max(dur) mx, count_uniq(lvl) u',
+    # dict-column scans (materialized into the fused matrix)
+    'lvl:error | stats by (app) count() c',
+    'NOT lvl:error "deadline exceeded" | stats count() c',
+    # stream filters fold to constants / mask leaves
+    '{app="app1"} | stats count() c',
+    '{app=~"app[12]"} "deadline exceeded" | stats by (_time:5m) count() c',
+    # value-column stats + group-by + uniq through one dispatch
+    '"GET" | stats by (app, _time:10m) count() c, sum(dur) s',
+    '* | stats count_uniq(app) u, count() c',
+    # empty-ish matches
+    'nosuchliteral42 | stats count() c',
+    '_msg:"" | stats count() c',
+]
+
+
+def _norm(rows):
+    return sorted(tuple(sorted(r.items())) for r in rows)
+
+
+def test_fused_parity_and_engagement(storage):
+    runner = BatchRunner()
+    engaged = 0
+    for qs in FUSED_QUERIES:
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        before = runner.fused_dispatches
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        assert _norm(cpu) == _norm(dev), qs
+        engaged += runner.fused_dispatches - before
+    # most of the matrix must actually take the single-dispatch path
+    assert engaged >= len(FUSED_QUERIES) // 2
+
+
+def test_fused_residue_rows_are_settled(storage):
+    """Newline rows flagged maybe by the pair kernel must contribute via
+    the host residue: compare against CPU on a query whose ONLY hits are
+    newline rows."""
+    runner = BatchRunner()
+    qs = '_msg:~"GET.*late" | stats count() c'
+    cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+    before = runner.fused_dispatches
+    dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                            runner=runner)
+    assert runner.fused_dispatches > before
+    assert cpu == dev
+    assert int(cpu[0]["c"]) > 0  # the newline rows really match
+
+
+def test_fused_declines_to_unfused_shapes(storage):
+    """Non-fusable leaves (case-insensitive phrase) must fall back and
+    still match the CPU executor."""
+    runner = BatchRunner()
+    qs = 'i("DEADLINE exceeded") | stats count() c'
+    cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+    before = runner.fused_dispatches
+    dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                            runner=runner)
+    assert runner.fused_dispatches == before
+    assert _norm(cpu) == _norm(dev)
+
+
+def test_fused_row_queries_unaffected(storage):
+    """Queries with row output (no stats pipe) keep the ordinary path."""
+    runner = BatchRunner()
+    qs = '"deadline exceeded" | fields _msg, app | limit 5'
+    cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+    dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                            runner=runner)
+    assert runner.fused_dispatches == 0
+    assert _norm(cpu) == _norm(dev)
+
+
+def test_fused_truncation_overflow(tmp_path):
+    """Values beyond MAX_ROW_WIDTH are truncated in staging; phrases
+    hitting the truncated tail must be settled by the residue pass."""
+    from victorialogs_tpu.tpu.layout import MAX_ROW_WIDTH
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(4000):
+        body = "x" * (MAX_ROW_WIDTH + 50) + " needle77" if i % 11 == 0 \
+            else f"short {i}"
+        lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", body)])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    try:
+        runner = BatchRunner()
+        for qs in ['needle77 | stats count() c',
+                   '"x" OR needle77 | stats by (_time:10m) count() c']:
+            cpu = run_query_collect(s, [TEN], qs, timestamp=T0)
+            dev = run_query_collect(s, [TEN], qs, timestamp=T0,
+                                    runner=runner)
+            assert _norm(cpu) == _norm(dev), qs
+        assert int(cpu[0]["c"]) > 0
+    finally:
+        s.close()

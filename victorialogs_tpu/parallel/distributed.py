@@ -176,6 +176,10 @@ class MeshBatchRunner(BatchRunner):
     degenerates); engine.searcher drives both through the same interface.
     """
 
+    # the mesh path keeps its explicit shard_map stats pipeline; the
+    # single-dispatch fusion (tpu/fused.py) is a single-device fast path
+    fused_enabled = False
+
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)
         self.mesh = mesh if mesh is not None else make_mesh()

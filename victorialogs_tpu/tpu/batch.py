@@ -446,6 +446,19 @@ def stage_dict_codes(part, field: str, layout: StatsLayout,
                       nbytes=layout.nrows_padded * 4)
 
 
+@dataclass
+class AxesAssembly:
+    """Everything _assemble_axes staged for one part's stats dispatch."""
+    layout: StatsLayout
+    numerics: dict                 # field -> StagedNumeric
+    axes: list                     # (kind, ids_jax, size, decode_payload)
+    eligibility: list              # frozensets of eligible block idxs
+    ids_tuple: tuple
+    strides: tuple
+    nb: int
+    uniq_shared: list              # (field, axis_idx)
+
+
 def part_stats_layout(part, shards: int = 1) -> StatsLayout:
     """shards: pad rows to a (STATS_CHUNK * shards) multiple so a mesh
     runner can split the row axis evenly with whole chunks per device."""
@@ -539,6 +552,13 @@ class BatchRunner:
     Exposes run_part() (used by engine.searcher.run_query when present) and
     a per-block apply_filter() shim for callers holding one BlockSearch."""
 
+    # single-dispatch filter->stats fusion (tpu/fused.py); MeshBatchRunner
+    # keeps its shard_map stats path instead
+    fused_enabled = True
+    # below this many matched rows the unfused stats path hands the rows
+    # to the host pipe instead of paying an upload + dispatch round trip
+    stats_host_threshold = 1024
+
     def __init__(self, max_cache_bytes: int = 8 << 30,
                  max_part_bytes: int = 4 << 30):
         self.cache = StagingCache(max_cache_bytes)
@@ -546,6 +566,7 @@ class BatchRunner:
         self.device_calls = 0
         self.cpu_fallbacks = 0
         self.stats_dispatches = 0
+        self.fused_dispatches = 0
         self.stats_shards = 1          # mesh runners stripe rows over >1
         self._counter_mu = threading.Lock()
         # striped staging locks: the prefetcher, concurrent partition
@@ -860,40 +881,19 @@ class BatchRunner:
                     self.cache.put(key, got)
             return got
 
-    def run_part_stats(self, f, part, bss: dict, spec):
-        """Filter + stats partials for one part.
-
-        Runs the ordinary filter evaluation (run_part), then computes
-        per-bucket count/sum/min/max partials ON DEVICE for every
-        candidate block whose value columns are int-typed — one stats
-        dispatch per value field (or one count dispatch), with the row
-        bitmap uploaded once and only (buckets,)-sized results downloaded.
-        This is the fused analogue of the reference's per-worker stats
-        shards merged at flush (pipe_stats.go:354-377).
-
-        Returns (bms, handled, partials):
-        - bms: block_idx -> bitmap (same as run_part);
-        - handled: block idxs fully accounted for by the partials (the
-          caller must NOT feed them through the row path);
-        - partials: list of (key_parts, count, field_stats, uniq_vals)
-          where
-          key_parts follows the spec's by order with elements
-          ("t", bucket_ns) for the time axis and ("v", value_str) for
-          group-by fields, and field_stats maps
-          field -> (sum:int, vmin:int, vmax:int).
-        """
+    def _assemble_axes(self, part, spec) -> "AxesAssembly | None":
+        """Stage everything the stats dispatch needs (value columns,
+        bucket/dict/uniq axes); None => this part can't run device stats."""
         from .stats_device import (MAX_ABS_TIMES_ROWS, MAX_BUCKETS,
-                                   MAX_STAT_ROWS, combine_plane_sums)
-
-        bms = self.run_part(f, part, bss)
+                                   MAX_STAT_ROWS)
         layout = self._stats_layout(part)
         if layout.nrows > MAX_STAT_ROWS:
-            return bms, set(), []
+            return None
         numerics = {}
         for fld in spec.value_fields:
             sn = self._stage_numeric(part, fld, layout, MAX_ABS_TIMES_ROWS)
             if sn is None:
-                return bms, set(), []
+                return None
             numerics[fld] = sn
 
         # one id axis per by key (time buckets / dict-code tables), plus
@@ -906,7 +906,7 @@ class BatchRunner:
                 sb = self._stage_buckets(part, layout, bk.step, bk.offset,
                                          MAX_BUCKETS)
                 if sb is None:
-                    return bms, set(), []
+                    return None
                 axes.append(("t", sb.ids, sb.num_buckets,
                              (sb.base, bk.step)))
             elif bk.kind == "numbucket":
@@ -914,14 +914,14 @@ class BatchRunner:
                 with self._key_lock(key):
                     sd = self.cache.get(key)
                     if sd is _UNSTAGEABLE:
-                        return bms, set(), []
+                        return None
                     if sd is None:
                         sd = stage_num_buckets(part, bk.name, layout,
                                                bk.fstep, bk.foff,
                                                put=self._put)
                         if sd is None:
                             self.cache.put_small(key, _UNSTAGEABLE)
-                            return bms, set(), []
+                            return None
                         self.cache.put(key, sd)
                 # payload name None: a uniq axis must never share a
                 # BUCKETED axis (it needs raw value codes)
@@ -931,7 +931,7 @@ class BatchRunner:
             else:
                 sd = self._stage_dict(part, bk.name, layout)
                 if sd is None:
-                    return bms, set(), []
+                    return None
                 axes.append(("v", sd.ids, len(sd.values),
                              (bk.name, sd.values)))
                 eligibility.append(sd.eligible)
@@ -947,14 +947,14 @@ class BatchRunner:
                 continue
             sd = self._stage_dict(part, fld, layout)
             if sd is None:
-                return bms, set(), []
+                return None
             axes.append(("u", sd.ids, len(sd.values), (fld, sd.values)))
             eligibility.append(sd.eligible)
         nb = 1
         for _k, _i, size, _p in axes:
             nb *= size
         if nb > MAX_BUCKETS:
-            return bms, set(), []
+            return None
         if axes:
             ids_tuple = tuple(a[1] for a in axes)
             # row-major strides in by order
@@ -975,41 +975,130 @@ class BatchRunner:
                     nbytes=layout.nrows_padded * 4)
                 self.cache.put(key, sb0)
             ids_tuple, strides = (sb0.ids,), (1,)
+        return AxesAssembly(layout=layout, numerics=numerics, axes=axes,
+                            eligibility=eligibility, ids_tuple=ids_tuple,
+                            strides=strides, nb=nb,
+                            uniq_shared=uniq_shared)
 
+    def _key_parts(self, asm: "AxesAssembly", idx: int) -> tuple:
+        """(group-key components, uniq-axis values) for one cell."""
+        ks = [(idx // stride) % size
+              for (_k, _i, size, _p), stride in zip(asm.axes, asm.strides)]
+        out = []
+        uniq = {}
+        for (kind, _ids, size, payload), k in zip(asm.axes, ks):
+            if kind == "t":
+                base, step = payload
+                out.append(("t", base + k * step))
+            elif kind == "v":
+                out.append(("v", payload[1][k]))
+            else:  # uniq axis: not part of the group key
+                fld, values = payload
+                uniq[fld] = values[k]
+        for fld, ai in asm.uniq_shared:
+            uniq[fld] = asm.axes[ai][3][1][ks[ai]]
+        return tuple(out), uniq
+
+    def _partials_from_counts(self, asm: "AxesAssembly", counts,
+                              stats_np: dict) -> list:
+        from .stats_device import combine_plane_sums
+        partials = []
+        for idx in np.nonzero(counts)[0]:
+            cnt = int(counts[idx])
+            fs = {}
+            for fld, packed in stats_np.items():
+                vmin0 = asm.numerics[fld].vmin
+                s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
+                fs[fld] = (s, int(packed[5, idx]) + vmin0,
+                           int(packed[6, idx]) + vmin0)
+            kp, uniq = self._key_parts(asm, int(idx))
+            partials.append((kp, cnt, fs, uniq))
+        return partials
+
+    # -- fused-path staging hooks (layout-coordinate columns, ts planes) --
+
+    def _stage_fused_field(self, part, field: str, layout):
+        from .fused import stage_layout_column
+        key = (part.uid, "#fl", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
+            if got is None:
+                got = stage_layout_column(part, field, layout,
+                                          self.max_part_bytes,
+                                          put=self._put)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
+
+    def _stage_ts_planes(self, part, layout):
+        from .fused import stage_ts_planes
+        key = (part.uid, "#ts2")
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                got = stage_ts_planes(part, layout, put=self._put)
+                self.cache.put(key, got)
+            return got
+
+    def run_part_stats(self, f, part, bss: dict, spec):
+        """Filter + stats partials for one part.
+
+        Fast path (tpu/fused.py): when the whole filter tree is
+        device-expressible and every candidate block is stats-eligible,
+        filter AND stats run as ONE device dispatch — the row bitmap
+        never leaves HBM.  Otherwise: ordinary filter evaluation
+        (run_part), then per-bucket count/sum/min/max partials on
+        device with the row bitmap uploaded once and only
+        (buckets,)-sized results downloaded.  This is the fused
+        analogue of the reference's per-worker stats shards merged at
+        flush (pipe_stats.go:354-377).
+
+        Returns (bms, handled, partials):
+        - bms: block_idx -> bitmap (covers at least the non-handled
+          blocks; empty when everything was handled on device);
+        - handled: block idxs fully accounted for by the partials (the
+          caller must NOT feed them through the row path);
+        - partials: list of (key_parts, count, field_stats, uniq_vals)
+          where
+          key_parts follows the spec's by order with elements
+          ("t", bucket_ns) for the time axis and ("v", value_str) for
+          group-by fields, and field_stats maps
+          field -> (sum:int, vmin:int, vmax:int).
+        """
+        asm = self._assemble_axes(part, spec)
+        if asm is not None and self.fused_enabled:
+            from .fused import try_fused
+            res = try_fused(self, f, part, bss, spec, asm)
+            if res is not None:
+                return res
+
+        bms = self.run_part(f, part, bss)
+        if asm is None:
+            return bms, set(), []
+        layout = asm.layout
         handled = {bi for bi in bss
-                   if all(bi in el for el in eligibility)}
+                   if all(bi in el for el in asm.eligibility)}
         if not handled:
             return bms, set(), []
         mask = np.zeros(layout.nrows_padded, dtype=bool)
-        any_rows = False
+        matched = 0
         for bi in handled:
             bm = bms[bi]
             if bm.any():
                 start = layout.starts[bi]
                 mask[start:start + bm.shape[0]] = bm
-                any_rows = True
-        if not any_rows:
+                matched += int(bm.sum())
+        if not matched:
             return bms, handled, []
+        if matched < self.stats_host_threshold:
+            # a handful of rows: the host pipe aggregates them faster
+            # than a mask upload (+~97ms) and a dispatch (+~65ms)
+            return bms, set(), []
         mask_j = self._put(mask)
-
-        def key_parts(idx: int) -> tuple:
-            """(group-key components, uniq-axis values) for one cell."""
-            ks = [(idx // stride) % size
-                  for (_k, _i, size, _p), stride in zip(axes, strides)]
-            out = []
-            uniq = {}
-            for (kind, _ids, size, payload), k in zip(axes, ks):
-                if kind == "t":
-                    base, step = payload
-                    out.append(("t", base + k * step))
-                elif kind == "v":
-                    out.append(("v", payload[1][k]))
-                else:  # uniq axis: not part of the group key
-                    fld, values = payload
-                    uniq[fld] = values[k]
-            for fld, ai in uniq_shared:
-                uniq[fld] = axes[ai][3][1][ks[ai]]
-            return tuple(out), uniq
 
         if spec.value_fields:
             counts = None
@@ -1018,30 +1107,18 @@ class BatchRunner:
                 self._bump("device_calls")
                 self._bump("stats_dispatches")
                 packed = self._dispatch_stats_values(
-                    numerics[fld].values, ids_tuple, strides, mask_j, nb)
+                    asm.numerics[fld].values, asm.ids_tuple, asm.strides,
+                    mask_j, asm.nb)
                 counts = packed[0]
                 stats_np[fld] = packed
-            partials = []
-            for idx in np.nonzero(counts)[0]:
-                cnt = int(counts[idx])
-                fs = {}
-                for fld, packed in stats_np.items():
-                    vmin0 = numerics[fld].vmin
-                    s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
-                    fs[fld] = (s, int(packed[5, idx]) + vmin0,
-                               int(packed[6, idx]) + vmin0)
-                kp, uniq = key_parts(int(idx))
-                partials.append((kp, cnt, fs, uniq))
-            return bms, handled, partials
+            return bms, handled, self._partials_from_counts(
+                asm, counts, stats_np)
 
         self._bump("device_calls")
         self._bump("stats_dispatches")
-        counts = self._dispatch_stats_count(ids_tuple, strides, mask_j, nb)
-        partials = []
-        for idx in np.nonzero(counts)[0]:
-            kp, uniq = key_parts(int(idx))
-            partials.append((kp, int(counts[idx]), {}, uniq))
-        return bms, handled, partials
+        counts = self._dispatch_stats_count(asm.ids_tuple, asm.strides,
+                                            mask_j, asm.nb)
+        return bms, handled, self._partials_from_counts(asm, counts, {})
 
     def _scan_pair(self, spc: StagedPart, pair: tuple):
         """Device `A.*B` evaluation; returns (survivors, host_verify_mask)."""

@@ -1,0 +1,642 @@
+"""Fully-fused `filter | stats` device path: ONE dispatch per part.
+
+Why this exists (measured on the real chip, tools/profile_device.py):
+under the axon tunnel every completed device call costs ~65ms and a
+bool[4M] bitmap download costs ~213ms, so the unfused pipeline
+(scan dispatch -> bitmap download -> host slice -> mask re-upload ->
+stats dispatch) spends ~90% of its time in transfers.  This module
+evaluates the WHOLE filter tree and the stats partials inside a single
+jit: the bitmap never leaves HBM, and the host downloads only the
+(7, num_buckets) partials plus (when needed) a bit-packed
+"needs-host-verify" vector (~R/8 bytes, ~12ms vs ~213ms unpacked).
+
+Key design points:
+- Staging is in STATS-LAYOUT coordinates (every block of the part, in
+  index order — tpu/batch.py part_stats_layout), not the string-only
+  packing of stage_part_column.  Dict/const/missing blocks are
+  MATERIALIZED into the fixed-width matrix (a const block is one
+  template row broadcast), so every filter leaf is a pure scan and the
+  jitted program needs no per-block composition tables — which keeps
+  the jit cache keyed on query SHAPE, not on part-specific block maps.
+- Three-valued logic: each tree node evaluates to (definite, maybe)
+  row vectors.  `maybe` collects truncation-overflow rows and the
+  ordered-pair regex's newline rows; they are excluded from the device
+  partials and settled by a host residue pass (filters' own
+  apply_to_block over just those rows) whose per-row partials merge
+  through the same absorb path — bit-identical to the CPU executor.
+- The host-side planner simplifies the tree first: bloom kill-paths
+  and block-uniform leaves (stream filters after candidate pruning)
+  fold to constants, so `{app="x"} "y" | stats count()` compiles to a
+  single scan + reduction.
+
+Reference parity: this is the TPU-shaped fusion of the reference's
+per-worker stats shards merged at flush (pipe_stats.go:354-377) with
+its batched block scanning (storage_search.go:1035-1121); the
+correctness oracle is the CPU executor (tests/test_fused.py diffs
+them bit-exactly over randomized query matrices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..logsql import filters as F
+from ..storage.bloom import bloom_contains_all
+from ..storage.values_encoder import VT_DICT, VT_STRING
+from ..utils.hashing import hash_tokens
+from . import kernels as K
+from .batch import device_plan, StatsLayout
+from .layout import MAX_ROW_WIDTH, row_width_bucket, to_fixed_width
+
+
+# ---------------- layout-coordinate string staging ----------------
+
+@dataclass
+class FusedField:
+    """One column staged over EVERY block of a part, layout coords."""
+    rows: object                   # jax uint8[RLp, W]
+    lengths: object                # jax int32[RLp]
+    width: int
+    ovf_packed: object | None      # jax uint8[RLp//8] bit-packed overflow
+    ovf_np: np.ndarray             # host bool[RLp] (residue bookkeeping)
+    has_ovf: bool
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_layout_column(part, field: str, layout: StatsLayout,
+                        max_bytes: int, put) -> FusedField | None:
+    """Materialize `field` for all blocks into one (RLp, W) matrix.
+
+    String blocks ride the native fixed-width transpose; dict blocks
+    are gathered per code; const/missing blocks broadcast a template
+    row ('' for missing — the host's value semantics for absent
+    fields).  Returns None when any block is numeric/ipv4/ts-typed
+    (the caller falls back to the unfused path) or the matrix would
+    exceed max_bytes."""
+    virtual = field in ("_stream", "_stream_id")
+    plans = []        # (start, n, kind, payload)
+    max_len = 0
+    for bi in range(part.num_blocks):
+        start = layout.starts[bi]
+        n = part.block_rows(bi)
+        if virtual:
+            v = part.block_tags(bi) if field == "_stream" else \
+                part.block_stream_id(bi).as_string()
+            b = v.encode("utf-8", "replace")
+            max_len = max(max_len, len(b))
+            plans.append((start, n, "const", b))
+            continue
+        meta = part.block_column_meta(bi, field)
+        if meta is None:
+            consts = dict(part.block_consts(bi))
+            b = consts.get(field, "").encode("utf-8", "replace")
+            max_len = max(max_len, len(b))
+            plans.append((start, n, "const", b))
+            continue
+        if meta["t"] == VT_STRING:
+            col = part.block_column(bi, field)
+            if col.lengths.size:
+                max_len = max(max_len, int(col.lengths.max()))
+            plans.append((start, n, "str", col))
+        elif meta["t"] == VT_DICT:
+            col = part.block_column(bi, field)
+            enc = [v.encode("utf-8", "replace") for v in col.dict_values]
+            if enc:
+                max_len = max(max_len, max(len(b) for b in enc))
+            plans.append((start, n, "dict", (col.ids, enc)))
+        else:
+            return None  # numeric/ipv4/ts block: host path decodes these
+    w = row_width_bucket(max_len)
+    rlp = layout.nrows_padded
+    if rlp * (w + 4) > max_bytes:
+        return None
+    mat = np.full((rlp, w), 0xFF, dtype=np.uint8)
+    lens = np.zeros(rlp, dtype=np.int32)
+    ovf = np.zeros(rlp, dtype=bool)
+    for start, n, kind, payload in plans:
+        if kind == "str":
+            col = payload
+            sub, _w, ov = to_fixed_width(col.arena, col.offsets,
+                                         col.lengths, n, width=w)
+            mat[start:start + n] = sub
+            lens[start:start + n] = np.minimum(col.lengths, w - 1)
+            if ov.size:
+                ovf[start + ov] = True
+        elif kind == "dict":
+            ids, enc = payload
+            for code, b in enumerate(enc):
+                sel = np.nonzero(ids == code)[0]
+                if not sel.size:
+                    continue
+                cl = min(len(b), w - 1)
+                row = np.full(w, 0xFF, dtype=np.uint8)
+                row[:cl] = np.frombuffer(b[:cl], dtype=np.uint8)
+                mat[start + sel] = row
+                lens[start + sel] = cl
+                if len(b) > w - 1:
+                    ovf[start + sel] = True
+        else:  # const ('' included)
+            b = payload
+            cl = min(len(b), w - 1)
+            row = np.full(w, 0xFF, dtype=np.uint8)
+            row[:cl] = np.frombuffer(b[:cl], dtype=np.uint8)
+            mat[start:start + n] = row
+            lens[start:start + n] = cl
+            if len(b) > w - 1:
+                ovf[start:start + n] = True
+    has_ovf = bool(ovf.any())
+    ovp = put(np.packbits(ovf)) if has_ovf else None
+    return FusedField(rows=put(mat), lengths=put(lens), width=w,
+                      ovf_packed=ovp, ovf_np=ovf, has_ovf=has_ovf,
+                      nbytes=rlp * (w + 5))
+
+
+@dataclass
+class _CandMask:
+    packed: object                 # jax uint8[RLp/8]
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+@dataclass
+class TsPlanes:
+    """Block timestamps as two int32 planes (hi = off>>16, lo = off&0xFFFF)
+    of ns offsets from the part minimum — exact int64 compares without
+    x64 mode (a per-day partition's offsets fit 47 bits)."""
+    hi: object
+    lo: object
+    base: int                      # part min ts (ns)
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_ts_planes(part, layout: StatsLayout, put) -> TsPlanes:
+    off = np.zeros(layout.nrows_padded, dtype=np.int64)
+    # single decode pass per block: base comes from the header min-ts
+    base = min((part.block_min_ts(bi) for bi in range(part.num_blocks)),
+               default=0)
+    for bi in range(part.num_blocks):
+        ts = part.block_timestamps(bi).astype(np.int64)
+        start = layout.starts[bi]
+        off[start:start + ts.shape[0]] = ts - base
+    hi = (off >> 16).astype(np.int32)
+    lo = (off & 0xFFFF).astype(np.int32)
+    return TsPlanes(hi=put(hi), lo=put(lo), base=base,
+                    nbytes=layout.nrows_padded * 8)
+
+
+def _split_bound(v: int) -> tuple[int, int]:
+    return int(v) >> 16, int(v) & 0xFFFF
+
+
+# ---------------- planner: filter tree -> static program ----------------
+
+class _NoFuse(Exception):
+    pass
+
+
+class _Planner:
+    """Walks the filter tree, staging what it needs and emitting a
+    hashable program plus the parallel dynamic-argument list."""
+
+    def __init__(self, runner, part, bss, layout):
+        self.runner = runner
+        self.part = part
+        self.bss = bss
+        self.layout = layout
+        self.args: list = []
+        self.field_slots: dict[str, int] = {}
+        self.fields: list[FusedField] = []
+        self._slot_args: list = []
+        self.ts_slot: tuple | None = None
+        self.has_maybe = False
+
+    def arg(self, a) -> int:
+        self.args.append(a)
+        return len(self.args) - 1
+
+    def field_slot(self, field: str) -> tuple[int, FusedField]:
+        slot = self.field_slots.get(field)
+        if slot is not None:
+            return slot, self.fields[slot]
+        ff = self.runner._stage_fused_field(self.part, field, self.layout)
+        if ff is None:
+            raise _NoFuse(field)
+        ri = self.arg(ff.rows)
+        li = self.arg(ff.lengths)
+        oi = self.arg(ff.ovf_packed) if ff.has_ovf else -1
+        slot = len(self.fields)
+        self.field_slots[field] = slot
+        self.fields.append(ff)
+        self._slot_args.append((ri, li, oi))
+        if ff.has_ovf:
+            self.has_maybe = True
+        return slot, ff
+
+    def slot_args(self, slot: int) -> tuple[int, int, int]:
+        return self._slot_args[slot]
+
+    # -- tree walk --
+
+    def plan(self, f):
+        if isinstance(f, F.FilterAnd):
+            return self._combine("and", [self.plan(s) for s in f.filters])
+        if isinstance(f, F.FilterOr):
+            return self._combine("or", [self.plan(s) for s in f.filters])
+        if isinstance(f, F.FilterNot):
+            inner = self.plan(f.inner)
+            if inner == ("true",):
+                return ("false",)
+            if inner == ("false",):
+                return ("true",)
+            return ("not", inner)
+        if isinstance(f, F.FilterNoop):
+            return ("true",)
+        if isinstance(f, F.FilterNone):
+            return ("false",)
+        if isinstance(f, F.FilterTime):
+            return self._time_leaf(f)
+        if isinstance(f, (F.FilterStream, F.FilterStreamID)):
+            return self._block_uniform_leaf(f)
+        return self._scan_leaf(f)
+
+    @staticmethod
+    def _combine(op, kids):
+        flat = []
+        for k in kids:
+            if op == "and":
+                if k == ("false",):
+                    return ("false",)
+                if k == ("true",):
+                    continue
+            else:
+                if k == ("true",):
+                    return ("true",)
+                if k == ("false",):
+                    continue
+            flat.append(k)
+        if not flat:
+            return ("true",) if op == "and" else ("false",)
+        if len(flat) == 1:
+            return flat[0]
+        return (op, tuple(flat))
+
+    def _time_leaf(self, f: F.FilterTime):
+        ts = self.runner._stage_ts_planes(self.part, self.layout)
+        if self.ts_slot is None:
+            hi = self.arg(ts.hi)
+            lo = self.arg(ts.lo)
+            self.ts_slot = (hi, lo)
+        # clamp query bounds into the part's offset space; the leaf is
+        # inclusive on both ends (FilterTime semantics)
+        lo_off = max(0, f.min_ts - ts.base)
+        hi_off = f.max_ts - ts.base
+        if hi_off < 0 or lo_off >= (1 << 47):
+            return ("false",)
+        b = [self.arg(np.int32(x)) for x in
+             (*_split_bound(lo_off),
+              *_split_bound(min(hi_off, (1 << 47) - 1)))]
+        return ("time", self.ts_slot[0], self.ts_slot[1], *b)
+
+    def _block_uniform_leaf(self, f):
+        """Stream filters: per-block constants after candidate pruning.
+        Uniform over the candidates -> constant; mixed -> a bit-packed
+        row mask built host-side (cheap: range fills)."""
+        truths = {}
+        for bi, bs in self.bss.items():
+            if isinstance(f, F.FilterStream):
+                ctx = getattr(bs, "ctx", None)
+                if ctx is None:
+                    truths[bi] = True
+                    continue
+                sids = f.resolve(ctx.partition, ctx.tenants)
+                truths[bi] = bs.stream_id in sids
+            else:
+                truths[bi] = bs.stream_id.as_string() in f._set
+        vals = set(truths.values())
+        if vals == {True}:
+            return ("true",)
+        if vals == {False}:
+            return ("false",)
+        m = np.zeros(self.layout.nrows_padded, dtype=bool)
+        for bi, t in truths.items():
+            if t:
+                s = self.layout.starts[bi]
+                m[s:s + self.part.block_rows(bi)] = True
+        return ("maskleaf", self.arg(self.runner._put(np.packbits(m))))
+
+    def _scan_leaf(self, f):
+        plan = device_plan(f)
+        if plan is None:
+            raise _NoFuse(type(f).__name__)
+        if plan.verify and plan.pair is None:
+            raise _NoFuse("verify")          # multi-seq / impure regex
+        if plan.field == "_time":
+            raise _NoFuse("_time-as-string")
+        # bloom kill-path: when a required token is absent from every
+        # candidate block's bloom, the leaf is constant false — no scan.
+        # And when bloom + candidate pruning leave only a small row
+        # fraction, the host path over those few blocks beats staging +
+        # whole-part scanning (same narrowness gate as _eval_leaf).
+        surv_rows = 0
+        if plan.bloom_tokens:
+            hashes = hash_tokens(plan.bloom_tokens)
+            for bi in self.bss:
+                words = self.part.block_column_bloom(bi, plan.field)
+                if words is not None and words.shape[0] and \
+                        not bloom_contains_all(words, hashes):
+                    continue
+                surv_rows += self.part.block_rows(bi)
+            if surv_rows == 0:
+                return ("false",)
+        else:
+            surv_rows = sum(self.part.block_rows(bi) for bi in self.bss)
+        if surv_rows * 8 < self.part.num_rows and \
+                not self.runner.cache.contains(
+                    (self.part.uid, "#fl", plan.field)):
+            raise _NoFuse("narrow")
+        slot, ff = self.field_slot(plan.field)
+        ri, li, oi = self.slot_args(slot)
+        if plan.pair is not None:
+            a, b = plan.pair
+            if max(len(a), len(b)) >= ff.width:
+                return self._ovf_only(oi)
+            self.has_maybe = True
+            pa = self.arg(np.frombuffer(a, dtype=np.uint8))
+            pb = self.arg(np.frombuffer(b, dtype=np.uint8))
+            return ("pair", ri, li, oi, pa, len(a), pb, len(b))
+        kids = []
+        for op in plan.ops:
+            if op.match_nonempty:
+                kids.append(("nonempty", li))
+            elif op.match_empty:
+                # truncated rows have true length > W-1 > 0: never empty,
+                # so the lengths compare is definitive even for overflow
+                kids.append(("empty", li))
+            elif len(op.pattern) >= ff.width:
+                kids.append(self._ovf_only(oi))
+            else:
+                pi = self.arg(np.frombuffer(op.pattern, dtype=np.uint8))
+                kids.append(("scan", ri, li, oi, pi, len(op.pattern),
+                             op.mode, op.starts_tok, op.ends_tok))
+        return self._combine(plan.combine, kids)
+
+    def _ovf_only(self, oi: int):
+        """Pattern wider than the staging: no staged row can match; only
+        overflow rows might."""
+        if oi < 0:
+            return ("false",)
+        self.has_maybe = True
+        return ("ovfmaybe", oi)
+
+
+# ---------------- the jitted program evaluator ----------------
+
+def _unpack_bits(packed, n):
+    import jax.numpy as jnp
+    bits = jnp.unpackbits(packed)
+    return bits[:n].astype(jnp.bool_)
+
+
+def _eval_node(node, args, rlp):
+    """Recursive (definite, maybe) evaluation; maybe may be None (==0)."""
+    import jax.numpy as jnp
+    kind = node[0]
+    if kind == "true":
+        return jnp.ones(rlp, dtype=bool), None
+    if kind == "false":
+        return jnp.zeros(rlp, dtype=bool), None
+    if kind == "maskleaf":
+        return _unpack_bits(args[node[1]], rlp), None
+    if kind == "nonempty":
+        return args[node[1]] > 0, None
+    if kind == "empty":
+        return args[node[1]] == 0, None
+    if kind == "ovfmaybe":
+        ov = _unpack_bits(args[node[1]], rlp)
+        return jnp.zeros(rlp, dtype=bool), ov
+    if kind == "time":
+        _, hi_i, lo_i, a, b, c, d = node
+        hi, lo = args[hi_i], args[lo_i]
+        lo_hi, lo_lo, hi_hi, hi_lo = args[a], args[b], args[c], args[d]
+        ge = (hi > lo_hi) | ((hi == lo_hi) & (lo >= lo_lo))
+        le = (hi < hi_hi) | ((hi == hi_hi) & (lo <= hi_lo))
+        return ge & le, None
+    if kind == "scan":
+        _, ri, li, oi, pi, plen, mode, st, et = node
+        m = K.match_scan(args[ri], args[li], args[pi], plen, mode, st, et)
+        if oi >= 0:
+            ov = _unpack_bits(args[oi], rlp)
+            return m & ~ov, ov
+        return m, None
+    if kind == "pair":
+        _, ri, li, oi, pa, la, pb, lb = node
+        definite, needsv = K.match_ordered_pair(args[ri], args[li],
+                                                args[pa], la, args[pb], lb)
+        may = needsv
+        if oi >= 0:
+            ov = _unpack_bits(args[oi], rlp)
+            definite = definite & ~ov
+            may = may | ov
+        return definite, may
+    if kind == "not":
+        d, m = _eval_node(node[1], args, rlp)
+        if m is None:
+            return ~d, None
+        return ~(d | m), m
+    # and / or
+    kids = [_eval_node(k, args, rlp) for k in node[1]]
+    if kind == "and":
+        d = kids[0][0]
+        pos = d if kids[0][1] is None else d | kids[0][1]
+        for kd, km in kids[1:]:
+            d = d & kd
+            pos = pos & (kd if km is None else kd | km)
+        may = pos & ~d
+        return d, (None if all(km is None for _, km in kids) else may)
+    d = kids[0][0]
+    pos = d if kids[0][1] is None else d | kids[0][1]
+    for kd, km in kids[1:]:
+        d = d | kd
+        pos = pos | (kd if km is None else kd | km)
+    may = pos & ~d
+    return d, (None if all(km is None for _, km in kids) else may)
+
+
+@partial(jax.jit, static_argnames=("prog", "strides", "nb", "n_values"))
+def _fused_dispatch(prog, strides, nb, n_values, nrows, cand_packed,
+                    ids_tuple, values_tuple, args):
+    """One device call: filter tree -> stats partials (+ packed maybe).
+
+    prog: (tree, rlp, has_maybe, has_cand) — static, hashable.
+    nrows: dynamic scalar (rows < nrows are live when cand_packed is
+    None-shaped); cand_packed: uint8[RLp/8] or zeros(1) when unused.
+    Returns (flat, maybe_packed): flat is uint32[nb + 1] for count-only
+    or uint32[n_values*7*nb + 1] — the trailing element is the
+    maybe-any flag; maybe_packed is uint8[RLp/8] (zeros(1) when the
+    program proves no maybe rows exist) and is only worth downloading
+    when the flag is nonzero."""
+    import jax.numpy as jnp
+    tree, rlp, has_maybe, has_cand = prog
+    d, m = _eval_node(tree, args, rlp)
+    if has_cand:
+        cand = _unpack_bits(cand_packed, rlp)
+    else:
+        cand = jnp.arange(rlp, dtype=jnp.int32) < nrows
+    d = d & cand
+    ids = K.combine_ids(ids_tuple, strides)
+    if n_values == 0:
+        flat = K.stats_count_local(ids, d, nb)
+    else:
+        outs = []
+        for v in values_tuple:
+            outs.append(K.pack_stats(*K.stats_values_local(v, ids, d, nb)))
+        flat = jnp.stack(outs, axis=0).reshape(-1)
+    # the maybe-any flag rides INSIDE the stats download so the host can
+    # skip the packed-maybe transfer entirely in the common no-maybe case
+    if has_maybe and m is not None:
+        mc = m & cand
+        many = jnp.any(mc).astype(jnp.uint32)
+        mp = jnp.packbits(mc.astype(jnp.uint8))
+    else:
+        many = jnp.uint32(0)
+        mp = jnp.zeros(1, dtype=jnp.uint8)
+    return jnp.concatenate([flat, many[None]]), mp
+
+
+# ---------------- residue: host settles the maybe rows ----------------
+
+def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
+    """Verify maybe rows with the filters' own host path and emit one
+    partial per surviving row, keyed exactly like the device cells."""
+    from ..logsql.matchers import parse_number
+    from ..logsql.stats_funcs import format_number
+    partials = []
+    for bi, bs in bss.items():
+        start = layout.starts[bi]
+        n = bs.nrows
+        sel = maybe_np[start:start + n]
+        if not sel.any():
+            continue
+        bm = sel.copy()
+        f.apply_to_block(bs, bm)
+        rows = np.nonzero(bm)[0]
+        if not rows.size:
+            continue
+        ts = None
+        val_cache: dict[str, list] = {}
+
+        def vals(field):
+            got = val_cache.get(field)
+            if got is None:
+                got = val_cache[field] = bs.values(field)
+            return got
+
+        for i in rows:
+            key_parts = []
+            uniq = {}
+            for bk in spec.by:
+                if bk.kind == "time":
+                    if ts is None:
+                        ts = bs.timestamps()
+                    t = int(ts[i])
+                    vb = (t - bk.offset) // bk.step * bk.step + bk.offset
+                    key_parts.append(("t", vb))
+                elif bk.kind == "numbucket":
+                    v = parse_number(vals(bk.name)[i])
+                    vb = np.floor((v - bk.foff) / bk.fstep) * bk.fstep \
+                        + bk.foff
+                    key_parts.append(("v", format_number(vb)))
+                else:
+                    key_parts.append(("v", vals(bk.name)[i]))
+            for fld in spec.uniq_fields:
+                uniq[fld] = vals(fld)[i]
+            fs = {}
+            for fld in spec.value_fields:
+                v = int(vals(fld)[i])
+                fs[fld] = (v, v, v)
+            partials.append((tuple(key_parts), 1, fs, uniq))
+    return partials
+
+
+# ---------------- entry ----------------
+
+def try_fused(runner, f, part, bss, spec, asm):
+    """Attempt the single-dispatch path; None -> caller falls back.
+
+    asm: the runner's assembled stats axes (AxesAssembly).  Requires
+    every candidate block to be stats-eligible (the fused path never
+    routes blocks through the row pipeline)."""
+    import jax.numpy as jnp
+    layout = asm.layout
+    if any(any(bi not in el for el in asm.eligibility) for bi in bss):
+        return None
+    planner = _Planner(runner, part, bss, layout)
+    try:
+        tree = planner.plan(f)
+    except _NoFuse:
+        return None
+
+    handled = set(bss)
+    if tree == ("false",):
+        return {}, handled, []
+
+    # candidate mask: all-blocks-candidate uses the cheap rows<nrows
+    # form (no upload); partial candidate sets ship as packed bits
+    all_cand = len(bss) == part.num_blocks
+    if all_cand:
+        cand_packed = jnp.zeros(1, dtype=jnp.uint8)
+    else:
+        ckey = (part.uid, "#cand", tuple(sorted(bss)))
+        with runner._key_lock(ckey):
+            cm = runner.cache.get(ckey)
+            if cm is None:
+                m = np.zeros(layout.nrows_padded, dtype=bool)
+                for bi in bss:
+                    s = layout.starts[bi]
+                    m[s:s + part.block_rows(bi)] = True
+                cm = _CandMask(packed=runner._put(np.packbits(m)),
+                               nbytes=layout.nrows_padded // 8)
+                runner.cache.put(ckey, cm)
+        cand_packed = cm.packed
+
+    prog = (tree, layout.nrows_padded, planner.has_maybe, not all_cand)
+    values_tuple = tuple(asm.numerics[fld].values
+                         for fld in spec.value_fields)
+    runner._bump("device_calls")
+    runner._bump("stats_dispatches")
+    runner._bump("fused_dispatches")
+    flat, mp = _fused_dispatch(
+        prog, asm.strides, asm.nb, len(values_tuple),
+        jnp.int32(layout.nrows), cand_packed, asm.ids_tuple,
+        values_tuple, tuple(planner.args))
+    flat = np.array(flat)
+    any_maybe = bool(flat[-1])
+
+    if spec.value_fields:
+        stats = flat[:-1].reshape(len(spec.value_fields), 7, asm.nb)
+        counts = stats[0][0]
+        stats_np = {fld: stats[k] for k, fld in
+                    enumerate(spec.value_fields)}
+    else:
+        counts = flat[:-1]
+        stats_np = {}
+    partials = runner._partials_from_counts(asm, counts, stats_np)
+
+    if any_maybe:
+        maybe_np = np.unpackbits(np.array(mp))[:layout.nrows_padded] \
+            .astype(bool)
+        partials.extend(_residue_partials(f, bss, spec, layout,
+                                          maybe_np))
+    return {}, handled, partials

@@ -58,9 +58,17 @@ def pallas_ok(r: int, w: int) -> bool:
 def _scan_kernel(rows_ref, len_ref, pat_ref, out_ref, *, pat_len: int,
                  mode: int, starts_tok: bool, ends_tok: bool, w: int):
     """One (TILE_ROWS, W) tile: test every window offset from VMEM."""
-    rows = rows_ref[:]                      # uint8[TR, W] — single VMEM read
+    # Single VMEM read, then widen to int32: this Mosaic target supports
+    # neither 8-bit vector compares nor 8-bit scalar extracts, so all
+    # byte math runs as i32 lanes (the load itself stays uint8 in HBM —
+    # traffic is still R×W bytes; widening happens on-chip).
+    rows = rows_ref[:].astype(jnp.int32)    # int32[TR, W]
     tr = rows.shape[0]
-    ff = jnp.uint8(0xFF)
+    ff = jnp.int32(0xFF)
+    # lengths/out ride as (TR, 1) column blocks: Mosaic requires the last
+    # two block dims to be (8k, 128k) or equal to the array dims, so a
+    # column vector is the only legal per-tile 1-value-per-row layout —
+    # and it matches the sublane-resident layout of a lane-axis reduction.
 
     def shifted(j):
         # rows shifted left by j columns, tail-filled with 0xFF (never a
@@ -68,21 +76,24 @@ def _scan_kernel(rows_ref, len_ref, pat_ref, out_ref, *, pat_len: int,
         if j == 0:
             return rows
         return jnp.concatenate(
-            [rows[:, j:], jnp.full((tr, j), ff, dtype=jnp.uint8)], axis=1)
+            [rows[:, j:], jnp.full((tr, j), ff, dtype=jnp.int32)], axis=1)
 
     acc = jnp.ones((tr, w), dtype=jnp.bool_)
     for j in range(pat_len):
+        # pattern rides as int32 (Mosaic only extracts 32-bit scalars);
+        # cast the scalar back down for the byte compare
         acc = jnp.logical_and(acc, shifted(j) == pat_ref[0, j])
 
-    lengths = len_ref[0, :]                 # int32[TR]
+    lengths = len_ref[:, :]                 # int32[TR, 1] — stay 2-D:
+    # Mosaic's layout inference crashes on rank-1 intermediates here
 
     if mode in (K.MODE_EXACT, K.MODE_EXACT_PREFIX):
-        hit = acc[:, 0]
+        hit = acc[:, 0:1]
         if mode == K.MODE_EXACT:
             hit = jnp.logical_and(hit, lengths == pat_len)
         else:
             hit = jnp.logical_and(hit, lengths >= pat_len)
-        out_ref[0, :] = hit.astype(jnp.int8)
+        out_ref[:, :] = hit.astype(jnp.int8)
         return
 
     def is_word(b):
@@ -93,15 +104,17 @@ def _scan_kernel(rows_ref, len_ref, pat_ref, out_ref, *, pat_len: int,
 
     if starts_tok and mode in (K.MODE_PHRASE, K.MODE_PREFIX):
         prev = jnp.concatenate(
-            [jnp.full((tr, 1), ff, dtype=jnp.uint8), rows[:, :w - 1]],
+            [jnp.full((tr, 1), ff, dtype=jnp.int32), rows[:, :w - 1]],
             axis=1)
         acc = jnp.logical_and(acc, jnp.logical_not(is_word(prev)))
     if ends_tok and mode == K.MODE_PHRASE:
         nxt = shifted(pat_len)
         acc = jnp.logical_and(acc, jnp.logical_not(is_word(nxt)))
 
-    hit = jnp.logical_and(jnp.any(acc, axis=1), lengths >= pat_len)
-    out_ref[0, :] = hit.astype(jnp.int8)
+    # reduce through int32 — Mosaic rejects the bool any() relayout
+    anyhit = jnp.max(acc.astype(jnp.int32), axis=1, keepdims=True)
+    hit = jnp.logical_and(anyhit > 0, lengths >= pat_len)
+    out_ref[:, :] = hit.astype(jnp.int8)
 
 
 @partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",
@@ -115,9 +128,9 @@ def match_scan_pallas(rows: jnp.ndarray, lengths: jnp.ndarray,
     r, w = rows.shape
     assert pallas_ok(r, w), (r, w)
     g = r // TILE_ROWS
-    lengths2d = lengths.reshape(g, TILE_ROWS).astype(jnp.int32)
-    pat128 = jnp.zeros((1, LANE), dtype=jnp.uint8)
-    pat128 = pat128.at[0, :pat_len].set(pattern[:pat_len])
+    lengths_col = lengths.reshape(r, 1).astype(jnp.int32)
+    pat128 = jnp.zeros((1, LANE), dtype=jnp.int32)
+    pat128 = pat128.at[0, :pat_len].set(pattern[:pat_len].astype(jnp.int32))
 
     kernel = partial(_scan_kernel, pat_len=pat_len, mode=mode,
                      starts_tok=starts_tok, ends_tok=ends_tok, w=w)
@@ -132,13 +145,13 @@ def match_scan_pallas(rows: jnp.ndarray, lengths: jnp.ndarray,
         grid=(g,),
         in_specs=[
             spec((TILE_ROWS, w), lambda i: (i, 0)),
-            spec((1, TILE_ROWS), lambda i: (i, 0)),
+            spec((TILE_ROWS, 1), lambda i: (i, 0)),
             spec((1, LANE), lambda i: (0, 0)),
         ],
-        out_specs=spec((1, TILE_ROWS), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((g, TILE_ROWS), jnp.int8),
+        out_specs=spec((TILE_ROWS, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, 1), jnp.int8),
         interpret=interpret,
-    )(rows, lengths2d, pat128)
+    )(rows, lengths_col, pat128)
     return out.reshape(r).astype(jnp.bool_)
 
 
