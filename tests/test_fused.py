@@ -24,7 +24,6 @@ def storage(tmp_path_factory):
     path = str(tmp_path_factory.mktemp("fused"))
     s = Storage(path, retention_days=100000, flush_interval=3600)
     lr = LogRows(stream_fields=["app"])
-    rng = np.random.default_rng(11)
     words = ["deadline exceeded", "connection reset", "ok", "retry later",
              "cache miss", "flushed"]
     for i in range(9000):

@@ -179,6 +179,9 @@ class MeshBatchRunner(BatchRunner):
     # the mesh path keeps its explicit shard_map stats pipeline; the
     # single-dispatch fusion (tpu/fused.py) is a single-device fast path
     fused_enabled = False
+    # always reduce on device: the point of the mesh runner is that
+    # partials ride psum over ICI, however small the shard's share
+    stats_host_threshold = 0
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)

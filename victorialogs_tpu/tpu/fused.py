@@ -50,7 +50,7 @@ from ..storage.values_encoder import VT_DICT, VT_STRING
 from ..utils.hashing import hash_tokens
 from . import kernels as K
 from .batch import device_plan, StatsLayout
-from .layout import MAX_ROW_WIDTH, row_width_bucket, to_fixed_width
+from .layout import row_width_bucket, to_fixed_width
 
 
 # ---------------- layout-coordinate string staging ----------------
