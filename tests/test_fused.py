@@ -80,6 +80,16 @@ FUSED_QUERIES = [
     # value-column stats + group-by + uniq through one dispatch
     '"GET" | stats by (app, _time:10m) count() c, sum(dur) s',
     '* | stats count_uniq(app) u, count() c',
+    # numeric range on the int column (device compare over uint32 offsets)
+    'dur:>300 | stats count() c',
+    'dur:range[100, 200] | stats by (app) count() c',
+    'dur:<=5 "deadline exceeded" | stats count() c',
+    'dur:>10000 | stats count() c',                      # empty range
+    'NOT dur:>=175 | stats by (_time:10m) count() c',
+    # in() = OR of exact scans (dict + string columns)
+    'lvl:in(error, warn) | stats count() c',
+    'app:in(app1, app3) "deadline exceeded" | stats count() c',
+    'lvl:in() | stats count() c',                         # empty set
     # empty-ish matches
     'nosuchliteral42 | stats count() c',
     '_msg:"" | stats count() c',

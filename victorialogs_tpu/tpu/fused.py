@@ -38,6 +38,7 @@ them bit-exactly over randomized query matrices).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from functools import partial
 
@@ -269,6 +270,10 @@ class _Planner:
             return self._time_leaf(f)
         if isinstance(f, (F.FilterStream, F.FilterStreamID)):
             return self._block_uniform_leaf(f)
+        if isinstance(f, F.FilterRange):
+            return self._numrange_leaf(f)
+        if isinstance(f, F.FilterIn):
+            return self._in_leaf(f)
         return self._scan_leaf(f)
 
     @staticmethod
@@ -392,6 +397,65 @@ class _Planner:
                              op.mode, op.starts_tok, op.ends_tok))
         return self._combine(plan.combine, kids)
 
+    def _numrange_leaf(self, f: F.FilterRange):
+        """`status:>=500`-family on int-typed columns: the uint32 offset
+        staging the stats path already uses doubles as the compare
+        operand (host analogue: FilterRange.apply_to_block's vectorized
+        numeric branch).  Declines when any candidate block is not
+        int-typed (string/float/missing: host semantics differ)."""
+        from .stats_device import MAX_ABS_TIMES_ROWS
+        field = F.canonical_field(f.field)
+        if math.isnan(f.min_value) or math.isnan(f.max_value):
+            raise _NoFuse("numrange-nan")
+        sn = self.runner._stage_numeric(self.part, field, self.layout,
+                                        MAX_ABS_TIMES_ROWS)
+        if sn is None or any(bi not in sn.eligible for bi in self.bss):
+            raise _NoFuse("numrange")
+        # integer-exact bounds, mirroring the host's ceil/floor treatment;
+        # +-inf saturates OUTWARD (>=inf matches nothing staged, <=-inf
+        # likewise) — ceil/floor of an infinity would raise OverflowError
+        lo = (-(1 << 62) if f.min_value < 0 else (1 << 62)) \
+            if math.isinf(f.min_value) else math.ceil(f.min_value)
+        hi = ((1 << 62) if f.max_value > 0 else -(1 << 62)) \
+            if math.isinf(f.max_value) else math.floor(f.max_value)
+        lo_off = lo - sn.vmin
+        hi_off = hi - sn.vmin
+        if lo_off > hi_off or hi_off < 0 or lo_off >= (1 << 32):
+            return ("false",)
+        lo_off = max(0, lo_off)
+        hi_off = min(hi_off, (1 << 32) - 1)
+        vi = self.arg(sn.values)
+        a = self.arg(np.uint32(lo_off))
+        b = self.arg(np.uint32(hi_off))
+        return ("numrange", vi, a, b)
+
+    def _in_leaf(self, f: F.FilterIn):
+        """`lvl:in(a, b, ...)` = OR of exact scans over the materialized
+        matrix (dict/const blocks included)."""
+        if f.subquery is not None and not f.values:
+            raise _NoFuse("in-subquery")
+        if len(f.values) > 16:
+            raise _NoFuse("in-cardinality")
+        field = F.canonical_field(f.field)
+        if field == "_time":
+            raise _NoFuse("_time-as-string")
+        slot, ff = self.field_slot(field)
+        ri, li, oi = self.slot_args(slot)
+        kids = []
+        for v in f.values:
+            if not v:
+                kids.append(("empty", li))
+                continue
+            if not v.isascii() or len(v) > K.MAX_PATTERN_LEN:
+                raise _NoFuse("in-value")
+            if len(v) >= ff.width:
+                kids.append(self._ovf_only(oi))
+                continue
+            pi = self.arg(np.frombuffer(v.encode(), dtype=np.uint8))
+            kids.append(("scan", ri, li, oi, pi, len(v),
+                         K.MODE_EXACT, False, False))
+        return self._combine("or", kids)
+
     def _ovf_only(self, oi: int):
         """Pattern wider than the staging: no staged row can match; only
         overflow rows might."""
@@ -426,6 +490,10 @@ def _eval_node(node, args, rlp):
     if kind == "ovfmaybe":
         ov = _unpack_bits(args[node[1]], rlp)
         return jnp.zeros(rlp, dtype=bool), ov
+    if kind == "numrange":
+        _, vi, a, b = node
+        v = args[vi]
+        return (v >= args[a]) & (v <= args[b]), None
     if kind == "time":
         _, hi_i, lo_i, a, b, c, d = node
         hi, lo = args[hi_i], args[lo_i]
