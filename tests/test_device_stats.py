@@ -89,6 +89,15 @@ STATS_QUERIES = [
     "deadline | stats by (dur:100, _time:10m) count() c, min(dur) mn",
     "* | stats by (dur:-5) count() c",          # invalid step -> raw keys
     "* | stats by (dur:100) count_uniq(dur) u", # bucket + raw uniq axis
+    # quantile/median: per-value histogram axes (exact — states are the
+    # host's own value lists, reconstructed as [v]*count per cell)
+    "* | stats median(dur) m, quantile(0.9, dur) q9",
+    "deadline | stats by (app) quantile(0.5, dur) q5, count() c",
+    "* | stats by (_time:10m) median(dur) m",
+    "* | stats by (app) quantile(0.99, dur) p99, sum(dur) s, "
+    "count_uniq(app) u",
+    "* | stats quantile(0.5, ratio) q",         # float column: host path
+    "* | stats median(dur) if (deadline) m",    # iff: fallback
     "nosuchtoken | stats count() c",            # empty result
     "_time:[2025-07-28T00:00:00Z, 2025-07-28T00:10:00Z] | stats "
     "by (_time:1m) rate() r",

@@ -288,11 +288,11 @@ def _absorb_stats_partials(head, q, spec, partials) -> None:
     from ..tpu.stats_device import build_partial_states
     from .block_result import format_rfc3339
     ps = q.pipes[0]
-    for key_parts, cnt, field_stats, uniq_vals in partials:
+    for key_parts, cnt, field_stats, uniq_vals, quant_vals in partials:
         key = tuple(format_rfc3339(v) if kind == "t" else v
                     for kind, v in key_parts)
         states = build_partial_states(spec, ps.funcs, key, cnt,
-                                      field_stats, uniq_vals)
+                                      field_stats, uniq_vals, quant_vals)
         head.absorb_partials(key, states)
 
 

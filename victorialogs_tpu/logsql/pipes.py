@@ -955,10 +955,13 @@ class PipeStats(Pipe):
                 (tpu/stats_device.py) — the in-process analogue of the
                 cluster importState merge (pipe_stats.go:93-125).
 
-                Set-valued states (count_uniq) charge the memory budget
+                Set-valued states (count_uniq) and list-valued states
+                (quantile/median value lists) charge the memory budget
                 on actual growth, matching the host update path
                 (pipe_stats.go:314-348)."""
-                def set_cost(s: set) -> int:
+                def set_cost(s) -> int:
+                    if isinstance(s, list):
+                        return 32 * len(s)
                     return sum(sum(len(x) for x in k) + 64 for k in s)
 
                 cur = self.groups.get(key)
@@ -966,11 +969,11 @@ class PipeStats(Pipe):
                     self.groups[key] = states
                     self.budget.add(sum(len(k) for k in key) + 80 +
                                     sum(set_cost(st) for st in states
-                                        if isinstance(st, set)))
+                                        if isinstance(st, (set, list))))
                 else:
                     for k, fn in enumerate(pipe.funcs):
                         before = len(cur[k]) \
-                            if isinstance(cur[k], set) else None
+                            if isinstance(cur[k], (set, list)) else None
                         cur[k] = fn.merge(cur[k], states[k])
                         if before is not None and len(cur[k]) > before:
                             self.budget.add(set_cost(states[k]))

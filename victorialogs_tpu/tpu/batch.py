@@ -315,9 +315,12 @@ class StagedNumeric:
     """One value column staged for exact device stats.
 
     values: uint32 offsets from vmin over eligible (int-typed) blocks;
-    other blocks hold 0 and must be masked off by the caller."""
+    other blocks hold 0 and must be masked off by the caller.  The same
+    array doubles as the quantile-axis ids when vmax-vmin fits the
+    histogram cap (combine_ids casts on device)."""
     values: object                 # jax uint32[Rp]
     vmin: int
+    vmax: int
     eligible: frozenset            # block idxs with int-typed columns
     nbytes: int
 
@@ -508,7 +511,7 @@ def stage_numeric(part, field: str, layout: StatsLayout,
         start = layout.starts[bi]
         vals[start:start + col.nums.shape[0]] = \
             (col.nums.astype(np.int64) - vmin).astype(np.uint32)
-    return StagedNumeric(values=put(vals), vmin=vmin,
+    return StagedNumeric(values=put(vals), vmin=vmin, vmax=vmax,
                          eligible=frozenset(cols),
                          nbytes=layout.nrows_padded * 4)
 
@@ -885,7 +888,7 @@ class BatchRunner:
         """Stage everything the stats dispatch needs (value columns,
         bucket/dict/uniq axes); None => this part can't run device stats."""
         from .stats_device import (MAX_ABS_TIMES_ROWS, MAX_BUCKETS,
-                                   MAX_STAT_ROWS)
+                                   MAX_QUANTILE_RANGE, MAX_STAT_ROWS)
         layout = self._stats_layout(part)
         if layout.nrows > MAX_STAT_ROWS:
             return None
@@ -950,6 +953,16 @@ class BatchRunner:
                 return None
             axes.append(("u", sd.ids, len(sd.values), (fld, sd.values)))
             eligibility.append(sd.eligible)
+        for fld in spec.quantile_fields:
+            # the value staging doubles as the histogram axis: same
+            # uint32 offsets, cast to int32 inside the jit (combine_ids)
+            sn = self._stage_numeric(part, fld, layout,
+                                     MAX_ABS_TIMES_ROWS)
+            if sn is None or sn.vmax - sn.vmin + 1 > MAX_QUANTILE_RANGE:
+                return None
+            axes.append(("q", sn.values, sn.vmax - sn.vmin + 1,
+                         (fld, sn.vmin)))
+            eligibility.append(sn.eligible)
         nb = 1
         for _k, _i, size, _p in axes:
             nb *= size
@@ -986,18 +999,22 @@ class BatchRunner:
               for (_k, _i, size, _p), stride in zip(asm.axes, asm.strides)]
         out = []
         uniq = {}
+        qv = {}
         for (kind, _ids, size, payload), k in zip(asm.axes, ks):
             if kind == "t":
                 base, step = payload
                 out.append(("t", base + k * step))
             elif kind == "v":
                 out.append(("v", payload[1][k]))
+            elif kind == "q":     # quantile histogram: numeric cell value
+                fld, vmin0 = payload
+                qv[fld] = vmin0 + k
             else:  # uniq axis: not part of the group key
                 fld, values = payload
                 uniq[fld] = values[k]
         for fld, ai in asm.uniq_shared:
             uniq[fld] = asm.axes[ai][3][1][ks[ai]]
-        return tuple(out), uniq
+        return tuple(out), uniq, qv
 
     def _partials_from_counts(self, asm: "AxesAssembly", counts,
                               stats_np: dict) -> list:
@@ -1011,8 +1028,8 @@ class BatchRunner:
                 s = combine_plane_sums(packed[1:5, idx]) + cnt * vmin0
                 fs[fld] = (s, int(packed[5, idx]) + vmin0,
                            int(packed[6, idx]) + vmin0)
-            kp, uniq = self._key_parts(asm, int(idx))
-            partials.append((kp, cnt, fs, uniq))
+            kp, uniq, qv = self._key_parts(asm, int(idx))
+            partials.append((kp, cnt, fs, uniq, qv))
         return partials
 
     # -- fused-path staging hooks (layout-coordinate columns, ts planes) --
@@ -1062,12 +1079,14 @@ class BatchRunner:
           blocks; empty when everything was handled on device);
         - handled: block idxs fully accounted for by the partials (the
           caller must NOT feed them through the row path);
-        - partials: list of (key_parts, count, field_stats, uniq_vals)
-          where
+        - partials: list of
+          (key_parts, count, field_stats, uniq_vals, quant_vals) where
           key_parts follows the spec's by order with elements
           ("t", bucket_ns) for the time axis and ("v", value_str) for
-          group-by fields, and field_stats maps
-          field -> (sum:int, vmin:int, vmax:int).
+          group-by fields, field_stats maps
+          field -> (sum:int, vmin:int, vmax:int), uniq_vals maps
+          count_uniq fields to the cell's value string, and quant_vals
+          maps quantile/median fields to the cell's numeric value.
         """
         asm = self._assemble_axes(part, spec)
         if asm is not None and self.fused_enabled:
@@ -1127,12 +1146,12 @@ class BatchRunner:
         if max(len(a), len(b)) >= spc.width:
             return np.zeros(spc.nrows, dtype=bool), None
         self._bump("device_calls")
-        definite, needs_verify = K.match_ordered_pair(
+        packed = np.array(K.match_ordered_pair_packed(
             spc.rows, spc.lengths,
             jnp.asarray(np.frombuffer(a, dtype=np.uint8)), len(a),
-            jnp.asarray(np.frombuffer(b, dtype=np.uint8)), len(b))
-        definite = np.array(definite[:spc.nrows])
-        needs_verify = np.array(needs_verify[:spc.nrows])
+            jnp.asarray(np.frombuffer(b, dtype=np.uint8)), len(b)))
+        definite = np.unpackbits(packed[0])[:spc.nrows].astype(bool)
+        needs_verify = np.unpackbits(packed[1])[:spc.nrows].astype(bool)
         return definite | needs_verify, needs_verify
 
     def _run_ops(self, spc: StagedPart, plan: LeafPlan) -> np.ndarray | None:
@@ -1166,6 +1185,8 @@ class BatchRunner:
             return np.zeros(spc.nrows, dtype=bool)
         self._bump("device_calls")
         pat = jnp.asarray(np.frombuffer(op.pattern, dtype=np.uint8))
-        res = K.match_scan(spc.rows, spc.lengths, pat, len(op.pattern),
-                           op.mode, op.starts_tok, op.ends_tok)
-        return np.array(res[:spc.nrows])  # writable host copy
+        res = K.match_scan_packed(spc.rows, spc.lengths, pat,
+                                  len(op.pattern), op.mode, op.starts_tok,
+                                  op.ends_tok)
+        # bit-packed download (~20x less transfer); unpack is a writable copy
+        return np.unpackbits(np.array(res))[:spc.nrows].astype(bool)

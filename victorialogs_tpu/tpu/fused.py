@@ -630,11 +630,14 @@ def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
                     key_parts.append(("v", vals(bk.name)[i]))
             for fld in spec.uniq_fields:
                 uniq[fld] = vals(fld)[i]
+            qv = {}
+            for fld in spec.quantile_fields:
+                qv[fld] = parse_number(vals(fld)[i])
             fs = {}
             for fld in spec.value_fields:
                 v = int(vals(fld)[i])
                 fs[fld] = (v, v, v)
-            partials.append((tuple(key_parts), 1, fs, uniq))
+            partials.append((tuple(key_parts), 1, fs, uniq, qv))
     return partials
 
 

@@ -156,6 +156,32 @@ def match_ordered_pair(rows: jnp.ndarray, lengths: jnp.ndarray,
     return ordered & ~has_nl, ordered & has_nl
 
 
+@partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",
+                                   "ends_tok"))
+def match_scan_packed(rows: jnp.ndarray, lengths: jnp.ndarray,
+                      pattern: jnp.ndarray, pat_len: int, mode: int,
+                      starts_tok: bool, ends_tok: bool) -> jnp.ndarray:
+    """match_scan with the bitmap bit-packed ON DEVICE before download.
+
+    A bool[4M] download costs ~213ms through the axon tunnel; the same
+    bits packed cost ~11ms (tools/profile_device.py).  R is always a
+    pad_bucket multiple, hence divisible by 8."""
+    return jnp.packbits(match_scan(rows, lengths, pattern, pat_len, mode,
+                                   starts_tok, ends_tok).astype(jnp.uint8))
+
+
+@partial(jax.jit, static_argnames=("len_a", "len_b"))
+def match_ordered_pair_packed(rows: jnp.ndarray, lengths: jnp.ndarray,
+                              pat_a: jnp.ndarray, len_a: int,
+                              pat_b: jnp.ndarray, len_b: int) -> jnp.ndarray:
+    """match_ordered_pair with BOTH result vectors packed into ONE
+    download: uint8[2, R/8] — row 0 definite, row 1 needs-verify."""
+    definite, needsv = match_ordered_pair(rows, lengths, pat_a, len_a,
+                                          pat_b, len_b)
+    return jnp.stack([jnp.packbits(definite.astype(jnp.uint8)),
+                      jnp.packbits(needsv.astype(jnp.uint8))], axis=0)
+
+
 # ---------------- bitmap combine (trivial but device-resident) ----------------
 
 @jax.jit
@@ -272,11 +298,15 @@ def pack_stats(cnt, sums, lo, hi) -> jnp.ndarray:
 
 def combine_ids(ids_tuple, strides):
     """Row-major combined bucket index from per-axis id arrays
-    (time buckets x group-by dict codes); computed INSIDE the jit so
-    multi-axis grouping costs no extra dispatch."""
+    (time buckets x group-by dict codes x quantile histograms); computed
+    INSIDE the jit so multi-axis grouping costs no extra dispatch.
+    Axes arrive as int32 (dict/time codes) or uint32 (quantile axes
+    reusing the value staging) — cast unifies them."""
     c = None
     for a, s in zip(ids_tuple, strides):
-        t = a * jnp.int32(s) if s != 1 else a
+        t = a.astype(jnp.int32)
+        if s != 1:
+            t = t * jnp.int32(s)
         c = t if c is None else c + t
     return c
 

@@ -37,6 +37,7 @@ from ..logsql.matchers import parse_number as _parse_num
 MAX_BUCKETS = 8192
 MAX_STAT_ROWS = 16 << 20          # plane-sum bound: 255 * R < 2**32
 MAX_ABS_TIMES_ROWS = 1 << 53      # keep the host float64 path exact as well
+MAX_QUANTILE_RANGE = 2048         # per-value histogram axis width cap
 
 
 @dataclass
@@ -61,6 +62,8 @@ class StatsSpec:
     funcs: list                   # list[FuncSpec], parallel to pipe.funcs
     value_fields: list            # distinct numeric fields, staging order
     uniq_fields: list             # distinct count_uniq fields (dict axes)
+    quantile_fields: list         # distinct quantile/median fields
+    #                               (per-value histogram axes)
 
 
 def _func_spec(fn) -> FuncSpec | None:
@@ -93,6 +96,15 @@ def _func_spec(fn) -> FuncSpec | None:
     if t is sf.StatsMax:
         if len(fn.fields) == 1 and "*" not in fn.fields[0]:
             return FuncSpec("max", fn.fields[0])
+        return None
+    if t in (sf.StatsQuantile, sf.StatsMedian):
+        # exact per-value histogram over an int column with a SMALL value
+        # range: the (group, value) counts reconstruct the host's value
+        # list bit-for-bit ([v]*c per cell), so finalize's sort+select is
+        # unchanged; several quantiles of one field share the axis
+        if len(fn.fields) == 1 and "*" not in fn.fields[0] and \
+                fn.fields[0] != "_time":
+            return FuncSpec("quantile", fn.fields[0])
         return None
     if t is sf.StatsCountUniq:
         # distinct values ride an extra bucket axis over the field's
@@ -165,14 +177,18 @@ def device_stats_spec(q) -> StatsSpec | None:
         funcs.append(spec)
     fields: list[str] = []
     uniq: list[str] = []
+    quant: list[str] = []
     for f in funcs:
         if f.kind == "uniq":
             if f.field not in uniq:
                 uniq.append(f.field)
+        elif f.kind == "quantile":
+            if f.field not in quant:
+                quant.append(f.field)
         elif f.field is not None and f.field not in fields:
             fields.append(f.field)
     return StatsSpec(by=by, funcs=funcs, value_fields=fields,
-                     uniq_fields=uniq)
+                     uniq_fields=uniq, quantile_fields=quant)
 
 
 def combine_plane_sums(planes) -> int:
@@ -185,13 +201,17 @@ def combine_plane_sums(planes) -> int:
 
 def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
                          count: int, field_stats: dict,
-                         uniq_vals: dict | None = None) -> list:
+                         uniq_vals: dict | None = None,
+                         quant_vals: dict | None = None) -> list:
     """Per-bucket states list (parallel to pipe_funcs) from kernel outputs.
 
     field_stats: field -> (sum:int, vmin:int, vmax:int) exact integers.
     uniq_vals: field -> the uniq-axis value this partial covers (one
     partial is emitted per (group, uniq-code) cell; same-key partials
     merge through the funcs' own merge(), unioning the value sets).
+    quant_vals: field -> the quantile-axis numeric value of this cell;
+    the state contribution is [v]*count — the exact list the host's
+    update() would have built for these rows.
     The states are merged into the stats processor with the funcs' own
     merge(), so downstream behavior (finalize, export/import for cluster
     pushdown) is identical to the host path."""
@@ -212,6 +232,10 @@ def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
         elif fs.kind == "uniq":
             v = (uniq_vals or {}).get(fs.field, "")
             states.append({(v,)} if count and v != "" else set())
+        elif fs.kind == "quantile":
+            v = (quant_vals or {}).get(fs.field)
+            states.append([float(v)] * count if count and v is not None
+                          else [])
         else:  # pragma: no cover - _func_spec gates kinds
             raise AssertionError(fs.kind)
     return states
