@@ -138,6 +138,42 @@ def test_mesh_batch_runner_query_parity(tmp_path):
             assert sorted(map(str, cpu)) == sorted(map(str, dev)), qs
         assert runner.stats_dispatches > 0
         assert runner.device_calls > 0
+        # the SPMD fused single-dispatch path must have carried most of
+        # these (shard_map + psum/pmin/pmax over the mesh)
+        assert runner.fused_dispatches > 0
+    finally:
+        s.close()
+
+
+def test_mesh_fused_residue_and_quantile(tmp_path):
+    """Mesh fused path: the packed maybe-vector concatenates across
+    shards (pair-regex newline rows settle via host residue) and the
+    quantile histogram axis psums correctly."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.parallel.distributed import MeshBatchRunner
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+
+    ten = TenantID(0, 0)
+    s = Storage(str(tmp_path / "mfr"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(4000):
+        msg = f"GET item deadline x{i}" if i % 9 else "GET\nitem deadline"
+        lr.add(ten, T0 + i * 250_000_000,
+               [("app", f"a{i % 2}"), ("_msg", msg), ("dur", str(i % 97))])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    try:
+        runner = MeshBatchRunner(make_mesh(8))
+        for qs in ['_msg:~"GET.*deadline" | stats count() c',
+                   '_msg:~"GET.*item" | stats by (app) median(dur) m, '
+                   'count() c']:
+            cpu = run_query_collect(s, [ten], qs, timestamp=T0)
+            dev = run_query_collect(s, [ten], qs, timestamp=T0,
+                                    runner=runner)
+            assert sorted(map(str, cpu)) == sorted(map(str, dev)), qs
+        assert runner.fused_dispatches > 0
     finally:
         s.close()
 

@@ -176,9 +176,10 @@ class MeshBatchRunner(BatchRunner):
     degenerates); engine.searcher drives both through the same interface.
     """
 
-    # the mesh path keeps its explicit shard_map stats pipeline; the
-    # single-dispatch fusion (tpu/fused.py) is a single-device fast path
-    fused_enabled = False
+    # the fused single-dispatch path runs SPMD here: the program is
+    # shard_mapped over the row axis with psum'd partials (ICI), so a
+    # fused query is ONE collective dispatch across the whole mesh
+    fused_enabled = True
     # always reduce on device: the point of the mesh runner is that
     # partials ride psum over ICI, however small the shard's share
     stats_host_threshold = 0
@@ -198,6 +199,13 @@ class MeshBatchRunner(BatchRunner):
         if arr.shape[0] % self.ndev == 0:
             return jax.device_put(arr, self._row_sharding)
         return jax.device_put(arr, self._replicated)
+
+    def _dispatch_fused(self, prog, strides, nb, n_values, nrows,
+                        cand_packed, ids_tuple, values_tuple, args):
+        from ..tpu.fused import _fused_dispatch_mesh
+        return _fused_dispatch_mesh(self.mesh, BLOCK_AXIS, prog, strides,
+                                    nb, n_values, nrows, cand_packed,
+                                    ids_tuple, values_tuple, args)
 
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
         return np.array(_stats_count_mesh(self.mesh, ids_tuple, strides,

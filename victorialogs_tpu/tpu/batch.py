@@ -666,6 +666,12 @@ class BatchRunner:
         return jnp.asarray(arr)
 
     # ---- stats dispatch hooks (MeshBatchRunner shard_maps + psum-reduces)
+    def _dispatch_fused(self, prog, strides, nb, n_values, nrows,
+                        cand_packed, ids_tuple, values_tuple, args):
+        from .fused import _fused_dispatch
+        return _fused_dispatch(prog, strides, nb, n_values, nrows,
+                               cand_packed, ids_tuple, values_tuple, args)
+
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
         return np.array(K.stats_bucket_count(ids_tuple, strides, mask,
                                              nb))

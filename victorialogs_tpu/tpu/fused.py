@@ -217,14 +217,19 @@ class _Planner:
         self.bss = bss
         self.layout = layout
         self.args: list = []
+        self.arg_rows: list = []
         self.field_slots: dict[str, int] = {}
         self.fields: list[FusedField] = []
         self._slot_args: list = []
         self.ts_slot: tuple | None = None
         self.has_maybe = False
 
-    def arg(self, a) -> int:
+    def arg(self, a, row: bool = False) -> int:
+        """Register a dynamic input; row=True marks row-aligned arrays
+        (leading dim RLp or RLp/8) that a mesh dispatch shards — recorded
+        explicitly so sharding never relies on shape coincidences."""
         self.args.append(a)
+        self.arg_rows.append(bool(row))
         return len(self.args) - 1
 
     def field_slot(self, field: str) -> tuple[int, FusedField]:
@@ -234,9 +239,9 @@ class _Planner:
         ff = self.runner._stage_fused_field(self.part, field, self.layout)
         if ff is None:
             raise _NoFuse(field)
-        ri = self.arg(ff.rows)
-        li = self.arg(ff.lengths)
-        oi = self.arg(ff.ovf_packed) if ff.has_ovf else -1
+        ri = self.arg(ff.rows, row=True)
+        li = self.arg(ff.lengths, row=True)
+        oi = self.arg(ff.ovf_packed, row=True) if ff.has_ovf else -1
         slot = len(self.fields)
         self.field_slots[field] = slot
         self.fields.append(ff)
@@ -300,8 +305,8 @@ class _Planner:
     def _time_leaf(self, f: F.FilterTime):
         ts = self.runner._stage_ts_planes(self.part, self.layout)
         if self.ts_slot is None:
-            hi = self.arg(ts.hi)
-            lo = self.arg(ts.lo)
+            hi = self.arg(ts.hi, row=True)
+            lo = self.arg(ts.lo, row=True)
             self.ts_slot = (hi, lo)
         # clamp query bounds into the part's offset space; the leaf is
         # inclusive on both ends (FilterTime semantics)
@@ -339,7 +344,8 @@ class _Planner:
             if t:
                 s = self.layout.starts[bi]
                 m[s:s + self.part.block_rows(bi)] = True
-        return ("maskleaf", self.arg(self.runner._put(np.packbits(m))))
+        return ("maskleaf",
+                self.arg(self.runner._put(np.packbits(m)), row=True))
 
     def _scan_leaf(self, f):
         plan = device_plan(f)
@@ -424,7 +430,7 @@ class _Planner:
             return ("false",)
         lo_off = max(0, lo_off)
         hi_off = min(hi_off, (1 << 32) - 1)
-        vi = self.arg(sn.values)
+        vi = self.arg(sn.values, row=True)
         a = self.arg(np.uint32(lo_off))
         b = self.arg(np.uint32(hi_off))
         return ("numrange", vi, a, b)
@@ -542,12 +548,68 @@ def _eval_node(node, args, rlp):
     return d, (None if all(km is None for _, km in kids) else may)
 
 
+def _fused_local(prog, strides, nb, n_values, axis, nrows, cand_packed,
+                 ids_tuple, values_tuple, args):
+    """The fused program body, single-device or per-shard.
+
+    axis: None for single-device execution; a mesh axis name when
+    running inside shard_map — row-sized inputs arrive as this shard's
+    stripe, stats reduce with psum/pmin/pmax over ICI, and the row
+    index for the rows<nrows candidate form is offset by the shard's
+    global position."""
+    import jax.numpy as jnp
+    tree, _rlp_global, has_maybe, has_cand = prog[:4]
+    rl = ids_tuple[0].shape[0]         # LOCAL rows (== global w/o axis)
+    d, m = _eval_node(tree, args, rl)
+    if has_cand:
+        cand = _unpack_bits(cand_packed, rl)
+    else:
+        idx = jnp.arange(rl, dtype=jnp.int32)
+        if axis is not None:
+            idx = idx + jax.lax.axis_index(axis) * rl
+        cand = idx < nrows
+    d = d & cand
+    vary = (axis,) if axis is not None else ()
+    ids = K.combine_ids(ids_tuple, strides)
+    if n_values == 0:
+        flat = K.stats_count_local(ids, d, nb, vary_axes=vary)
+        if axis is not None:
+            flat = jax.lax.psum(flat, axis)
+    else:
+        outs = []
+        for v in values_tuple:
+            cnt, sums, lo, hi = K.stats_values_local(v, ids, d, nb,
+                                                     vary_axes=vary)
+            if axis is not None:
+                cnt = jax.lax.psum(cnt, axis)
+                sums = jax.lax.psum(sums, axis)
+                lo = jax.lax.pmin(lo, axis)
+                hi = jax.lax.pmax(hi, axis)
+            outs.append(K.pack_stats(cnt, sums, lo, hi))
+        flat = jnp.stack(outs, axis=0).reshape(-1)
+    # the maybe-any flag rides INSIDE the stats download so the host can
+    # skip the packed-maybe transfer entirely in the common no-maybe case
+    if has_maybe and m is not None:
+        mc = m & cand
+        many = jnp.any(mc).astype(jnp.uint32)
+        if axis is not None:
+            many = jax.lax.psum(many, axis)    # nonzero iff any shard hit
+        mp = jnp.packbits(mc.astype(jnp.uint8))
+    else:
+        many = jnp.uint32(0)
+        mp = jnp.zeros(1, dtype=jnp.uint8)
+        if axis is not None:
+            mp = K._vary(mp, (axis,))
+    return jnp.concatenate([flat, many[None]]), mp
+
+
 @partial(jax.jit, static_argnames=("prog", "strides", "nb", "n_values"))
 def _fused_dispatch(prog, strides, nb, n_values, nrows, cand_packed,
                     ids_tuple, values_tuple, args):
     """One device call: filter tree -> stats partials (+ packed maybe).
 
-    prog: (tree, rlp, has_maybe, has_cand) — static, hashable.
+    prog: (tree, rlp, has_maybe, has_cand, arg_rows) — static, hashable;
+    arg_rows marks which leaf args are row-aligned (mesh sharding).
     nrows: dynamic scalar (rows < nrows are live when cand_packed is
     None-shaped); cand_packed: uint8[RLp/8] or zeros(1) when unused.
     Returns (flat, maybe_packed): flat is uint32[nb + 1] for count-only
@@ -555,32 +617,37 @@ def _fused_dispatch(prog, strides, nb, n_values, nrows, cand_packed,
     maybe-any flag; maybe_packed is uint8[RLp/8] (zeros(1) when the
     program proves no maybe rows exist) and is only worth downloading
     when the flag is nonzero."""
-    import jax.numpy as jnp
-    tree, rlp, has_maybe, has_cand = prog
-    d, m = _eval_node(tree, args, rlp)
-    if has_cand:
-        cand = _unpack_bits(cand_packed, rlp)
-    else:
-        cand = jnp.arange(rlp, dtype=jnp.int32) < nrows
-    d = d & cand
-    ids = K.combine_ids(ids_tuple, strides)
-    if n_values == 0:
-        flat = K.stats_count_local(ids, d, nb)
-    else:
-        outs = []
-        for v in values_tuple:
-            outs.append(K.pack_stats(*K.stats_values_local(v, ids, d, nb)))
-        flat = jnp.stack(outs, axis=0).reshape(-1)
-    # the maybe-any flag rides INSIDE the stats download so the host can
-    # skip the packed-maybe transfer entirely in the common no-maybe case
-    if has_maybe and m is not None:
-        mc = m & cand
-        many = jnp.any(mc).astype(jnp.uint32)
-        mp = jnp.packbits(mc.astype(jnp.uint8))
-    else:
-        many = jnp.uint32(0)
-        mp = jnp.zeros(1, dtype=jnp.uint8)
-    return jnp.concatenate([flat, many[None]]), mp
+    return _fused_local(prog, strides, nb, n_values, None, nrows,
+                        cand_packed, ids_tuple, values_tuple, args)
+
+
+@partial(jax.jit, static_argnames=("prog", "strides", "nb", "n_values",
+                                   "mesh", "axis"))
+def _fused_dispatch_mesh(mesh, axis, prog, strides, nb, n_values, nrows,
+                         cand_packed, ids_tuple, values_tuple, args):
+    """The fused program under shard_map: each device evaluates the tree
+    over its row stripe; stats partials psum/pmin/pmax over ICI; the
+    packed maybe-vector concatenates along the row axis.  This is the
+    multi-chip product form of the reference's mergeState split
+    (pipe_stats.go:55-60) — one SPMD dispatch, in-network reduction."""
+    from jax.sharding import PartitionSpec as P
+    has_cand = prog[3]
+    arg_rows = prog[4]
+    # roles are explicit: the planner marked row-aligned leaf args;
+    # ids/values axes are always row-aligned; cand is row-aligned only
+    # when a real candidate mask was shipped (else it is a zeros(1) stub)
+    in_specs = (P(), P(axis) if has_cand else P(),
+                tuple(P(axis) for _ in ids_tuple),
+                tuple(P(axis) for _ in values_tuple),
+                tuple(P(axis) if r else P() for r in arg_rows))
+
+    def fn(nrows, cp, ids, vals, leaf_args):
+        return _fused_local(prog, strides, nb, n_values, axis, nrows,
+                            cp, ids, vals, leaf_args)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=(P(), P(axis)))(
+        nrows, cand_packed, ids_tuple, values_tuple, args)
 
 
 # ---------------- residue: host settles the maybe rows ----------------
@@ -682,13 +749,14 @@ def try_fused(runner, f, part, bss, spec, asm):
                 runner.cache.put(ckey, cm)
         cand_packed = cm.packed
 
-    prog = (tree, layout.nrows_padded, planner.has_maybe, not all_cand)
+    prog = (tree, layout.nrows_padded, planner.has_maybe, not all_cand,
+            tuple(planner.arg_rows))
     values_tuple = tuple(asm.numerics[fld].values
                          for fld in spec.value_fields)
     runner._bump("device_calls")
     runner._bump("stats_dispatches")
     runner._bump("fused_dispatches")
-    flat, mp = _fused_dispatch(
+    flat, mp = runner._dispatch_fused(
         prog, asm.strides, asm.nb, len(values_tuple),
         jnp.int32(layout.nrows), cand_packed, asm.ids_tuple,
         values_tuple, tuple(planner.args))
