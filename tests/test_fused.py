@@ -28,6 +28,10 @@ def storage(tmp_path_factory):
              "cache miss", "flushed"]
     for i in range(9000):
         msg = f"GET /api/x{i % 71} {words[i % 6]} dur={i % 351}ms"
+        if i % 37 == 0:
+            # multibyte runes: len_range must route these through the
+            # residue (code points != bytes)
+            msg = f"GÉT /äpi/x{i % 71} {words[i % 6]} ⏱={i % 351}"
         if i % 97 == 0:
             # newline between the A..B literals: the ordered-pair scan
             # must route these rows through the host residue pass
@@ -90,6 +94,10 @@ FUSED_QUERIES = [
     'lvl:in(error, warn) | stats count() c',
     'app:in(app1, app3) "deadline exceeded" | stats count() c',
     'lvl:in() | stats count() c',                         # empty set
+    # len_range: byte lengths decide ASCII rows; multibyte rows in the
+    # ambiguous byte window route through residue
+    '_msg:len_range(10, 30) | stats count() c',
+    'NOT _msg:len_range(0, 25) | stats by (app) count() c',
     # empty-ish matches
     'nosuchliteral42 | stats count() c',
     '_msg:"" | stats count() c',

@@ -279,6 +279,8 @@ class _Planner:
             return self._numrange_leaf(f)
         if isinstance(f, F.FilterIn):
             return self._in_leaf(f)
+        if isinstance(f, F.FilterLenRange):
+            return self._lenrange_leaf(f)
         return self._scan_leaf(f)
 
     @staticmethod
@@ -435,6 +437,33 @@ class _Planner:
         b = self.arg(np.uint32(hi_off))
         return ("numrange", vi, a, b)
 
+    def _lenrange_leaf(self, f: F.FilterLenRange):
+        """len_range(lo, hi): rune counts equal byte lengths for pure
+        ASCII, so the staged lengths decide those rows.  Multibyte rows
+        are ambiguous only inside [lo, 4*hi] bytes (codepoints <= bytes
+        <= 4*codepoints): below lo no row can reach lo codepoints, above
+        4*hi it must exceed hi — so the maybe/residue set stays small
+        even for heavily non-ASCII columns.  Truncated rows join the
+        maybe set unless even the truncation floor (W-1 bytes) already
+        exceeds 4*hi."""
+        if f.max_len < max(0, f.min_len):
+            return ("false",)
+        field = F.canonical_field(f.field)
+        if field == "_time":
+            raise _NoFuse("_time-as-string")
+        slot, ff = self.field_slot(field)
+        ri, li, oi = self.slot_args(slot)
+        self.has_maybe = True
+        imax = (1 << 31) - 1
+        a = self.arg(np.int32(min(max(0, f.min_len), imax)))
+        b = self.arg(np.int32(min(f.max_len, imax)))
+        b4 = self.arg(np.int32(min(4 * f.max_len, imax)))
+        # overflow rows whose true length must exceed 4*hi are
+        # definitively false (their staged length W-1 > hi keeps d false)
+        if ff.width - 1 > min(4 * f.max_len, imax):
+            oi = -1
+        return ("lenrange", ri, li, oi, a, b, b4)
+
     def _in_leaf(self, f: F.FilterIn):
         """`lvl:in(a, b, ...)` = OR of exact scans over the materialized
         matrix (dict/const blocks included)."""
@@ -496,6 +525,16 @@ def _eval_node(node, args, rlp):
     if kind == "ovfmaybe":
         ov = _unpack_bits(args[node[1]], rlp)
         return jnp.zeros(rlp, dtype=bool), ov
+    if kind == "lenrange":
+        _, ri, li, oi, a, b, b4 = node
+        lens = args[li]
+        d = (lens >= args[a]) & (lens <= args[b])
+        rows = args[ri]
+        multibyte = jnp.any((rows >= 0x80) & (rows != 0xFF), axis=1)
+        may = multibyte & (lens >= args[a]) & (lens <= args[b4])
+        if oi >= 0:
+            may = may | _unpack_bits(args[oi], rlp)
+        return d & ~may, may
     if kind == "numrange":
         _, vi, a, b = node
         v = args[vi]
