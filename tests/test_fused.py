@@ -98,6 +98,10 @@ FUSED_QUERIES = [
     # ambiguous byte window route through residue
     '_msg:len_range(10, 30) | stats count() c',
     'NOT _msg:len_range(0, 25) | stats by (app) count() c',
+    # value_type: block-uniform constant from the column encoding
+    'dur:value_type(uint16) | stats count() c',
+    'NOT dur:value_type(uint16) | stats by (app) count() c',
+    'lvl:value_type(dict) "deadline exceeded" | stats count() c',
     # empty-ish matches
     'nosuchliteral42 | stats count() c',
     '_msg:"" | stats count() c',

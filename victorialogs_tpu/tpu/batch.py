@@ -1057,6 +1057,17 @@ class BatchRunner:
                     self.cache.put(key, got)
             return got
 
+    def _stage_multibyte(self, part, field: str, layout):
+        from .fused import stage_multibyte_mask
+        key = (part.uid, "#mb", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                got = stage_multibyte_mask(part, field, layout,
+                                           put=self._put)
+                self.cache.put(key, got)
+            return got
+
     def _stage_ts_planes(self, part, layout):
         from .fused import stage_ts_planes
         key = (part.uid, "#ts2")

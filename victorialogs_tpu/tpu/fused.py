@@ -160,6 +160,66 @@ def stage_layout_column(part, field: str, layout: StatsLayout,
 
 
 @dataclass
+class MultibyteMask:
+    """Per-row 'contains a byte >= 0x80' flags for one column, packed.
+    A static property of the part, computed host-side from the SOURCE
+    values (so truncated tails count) and staged lazily the first time
+    a len_range leaf needs it.  any=False => the column is pure ASCII
+    and len_range is fully definitive on byte lengths."""
+    packed: object | None          # jax uint8[RLp/8]; None when not any
+    any: bool
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_multibyte_mask(part, field: str, layout: StatsLayout,
+                         put) -> MultibyteMask:
+    virtual = field in ("_stream", "_stream_id")
+    mb = np.zeros(layout.nrows_padded, dtype=bool)
+    for bi in range(part.num_blocks):
+        start = layout.starts[bi]
+        n = part.block_rows(bi)
+        if virtual:
+            v = part.block_tags(bi) if field == "_stream" else \
+                part.block_stream_id(bi).as_string()
+            if max(v.encode("utf-8", "replace"), default=0) >= 0x80:
+                mb[start:start + n] = True
+            continue
+        meta = part.block_column_meta(bi, field)
+        if meta is None:
+            consts = dict(part.block_consts(bi))
+            b = consts.get(field, "").encode("utf-8", "replace")
+            if b and max(b) >= 0x80:
+                mb[start:start + n] = True
+            continue
+        if meta["t"] == VT_STRING:
+            col = part.block_column(bi, field)
+            if col.arena.size:
+                # per-row any(byte >= 0x80) via prefix sums (exact even
+                # for zero-length rows)
+                cs = np.zeros(col.arena.size + 1, dtype=np.int64)
+                np.cumsum(col.arena >= 0x80, out=cs[1:])
+                offs = col.offsets.astype(np.int64)
+                lens = col.lengths.astype(np.int64)
+                mb[start:start + n] = cs[offs + lens] > cs[offs]
+        elif meta["t"] == VT_DICT:
+            col = part.block_column(bi, field)
+            flags = np.array([bool(v.encode("utf-8", "replace") and
+                                   max(v.encode("utf-8", "replace"))
+                                   >= 0x80)
+                              for v in col.dict_values], dtype=bool)
+            if flags.any():
+                mb[start:start + n] = flags[col.ids]
+        # numeric/ipv4/ts blocks: canonical decimals are pure ASCII
+    has = bool(mb.any())
+    return MultibyteMask(packed=put(np.packbits(mb)) if has else None,
+                         any=has,
+                         nbytes=layout.nrows_padded // 8 if has else 64)
+
+
+@dataclass
 class _CandMask:
     packed: object                 # jax uint8[RLp/8]
     nbytes: int
@@ -273,7 +333,8 @@ class _Planner:
             return ("false",)
         if isinstance(f, F.FilterTime):
             return self._time_leaf(f)
-        if isinstance(f, (F.FilterStream, F.FilterStreamID)):
+        if isinstance(f, (F.FilterStream, F.FilterStreamID,
+                          F.FilterValueType)):
             return self._block_uniform_leaf(f)
         if isinstance(f, F.FilterRange):
             return self._numrange_leaf(f)
@@ -322,9 +383,10 @@ class _Planner:
         return ("time", self.ts_slot[0], self.ts_slot[1], *b)
 
     def _block_uniform_leaf(self, f):
-        """Stream filters: per-block constants after candidate pruning.
-        Uniform over the candidates -> constant; mixed -> a bit-packed
-        row mask built host-side (cheap: range fills)."""
+        """Per-block-constant filters (stream filters after candidate
+        pruning; value_type, which depends only on the block's column
+        encoding).  Uniform over the candidates -> constant; mixed -> a
+        bit-packed row mask built host-side (cheap: range fills)."""
         truths = {}
         for bi, bs in self.bss.items():
             if isinstance(f, F.FilterStream):
@@ -334,6 +396,9 @@ class _Planner:
                     continue
                 sids = f.resolve(ctx.partition, ctx.tenants)
                 truths[bi] = bs.stream_id in sids
+            elif isinstance(f, F.FilterValueType):
+                truths[bi] = bs.value_type_name(
+                    F.canonical_field(f.field)) == f.type_name
             else:
                 truths[bi] = bs.stream_id.as_string() in f._set
         vals = set(truths.values())
@@ -440,20 +505,20 @@ class _Planner:
     def _lenrange_leaf(self, f: F.FilterLenRange):
         """len_range(lo, hi): rune counts equal byte lengths for pure
         ASCII, so the staged lengths decide those rows.  Multibyte rows
-        are ambiguous only inside [lo, 4*hi] bytes (codepoints <= bytes
-        <= 4*codepoints): below lo no row can reach lo codepoints, above
-        4*hi it must exceed hi — so the maybe/residue set stays small
-        even for heavily non-ASCII columns.  Truncated rows join the
-        maybe set unless even the truncation floor (W-1 bytes) already
-        exceeds 4*hi."""
+        (precomputed packed mask, a static property of the part) are
+        ambiguous only inside [lo, 4*hi] bytes (codepoints <= bytes <=
+        4*codepoints); a pure-ASCII column has no maybe rows at all.
+        Truncated rows join the maybe set unless even the truncation
+        floor (W-1 bytes) already exceeds 4*hi."""
         if f.max_len < max(0, f.min_len):
             return ("false",)
         field = F.canonical_field(f.field)
         if field == "_time":
             raise _NoFuse("_time-as-string")
         slot, ff = self.field_slot(field)
-        ri, li, oi = self.slot_args(slot)
-        self.has_maybe = True
+        _ri, li, oi = self.slot_args(slot)
+        mbm = self.runner._stage_multibyte(self.part, field, self.layout)
+        mi = self.arg(mbm.packed, row=True) if mbm.any else -1
         imax = (1 << 31) - 1
         a = self.arg(np.int32(min(max(0, f.min_len), imax)))
         b = self.arg(np.int32(min(f.max_len, imax)))
@@ -462,7 +527,9 @@ class _Planner:
         # definitively false (their staged length W-1 > hi keeps d false)
         if ff.width - 1 > min(4 * f.max_len, imax):
             oi = -1
-        return ("lenrange", ri, li, oi, a, b, b4)
+        if mi >= 0 or oi >= 0:
+            self.has_maybe = True
+        return ("lenrange", li, oi, mi, a, b, b4)
 
     def _in_leaf(self, f: F.FilterIn):
         """`lvl:in(a, b, ...)` = OR of exact scans over the materialized
@@ -526,14 +593,18 @@ def _eval_node(node, args, rlp):
         ov = _unpack_bits(args[node[1]], rlp)
         return jnp.zeros(rlp, dtype=bool), ov
     if kind == "lenrange":
-        _, ri, li, oi, a, b, b4 = node
+        _, li, oi, mi, a, b, b4 = node
         lens = args[li]
         d = (lens >= args[a]) & (lens <= args[b])
-        rows = args[ri]
-        multibyte = jnp.any((rows >= 0x80) & (rows != 0xFF), axis=1)
-        may = multibyte & (lens >= args[a]) & (lens <= args[b4])
+        may = None
+        if mi >= 0:
+            multibyte = _unpack_bits(args[mi], rlp)
+            may = multibyte & (lens >= args[a]) & (lens <= args[b4])
         if oi >= 0:
-            may = may | _unpack_bits(args[oi], rlp)
+            ov = _unpack_bits(args[oi], rlp)
+            may = ov if may is None else may | ov
+        if may is None:
+            return d, None
         return d & ~may, may
     if kind == "numrange":
         _, vi, a, b = node
