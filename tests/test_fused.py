@@ -105,6 +105,13 @@ FUSED_QUERIES = [
     # empty-ish matches
     'nosuchliteral42 | stats count() c',
     '_msg:"" | stats count() c',
+    # case-insensitive phrase/prefix: ASCII byte fold on device, rows
+    # with multibyte bytes settled by the host residue
+    'i("DEADLINE Exceeded") | stats count() c',
+    'i("CONNECTION reset") OR i("CACHE Miss") | stats by (app) count() c',
+    '_msg:i("GeT"*) | stats count() c',
+    'NOT i("OK") | stats count() c',
+    'lvl:i("ERROR") | stats by (_time:10m) count() c',
 ]
 
 
@@ -142,16 +149,44 @@ def test_fused_residue_rows_are_settled(storage):
 
 
 def test_fused_declines_to_unfused_shapes(storage):
-    """Non-fusable leaves (case-insensitive phrase) must fall back and
-    still match the CPU executor."""
+    """Non-fusable leaves (field-vs-field compare; non-ASCII any-case
+    pattern) must fall back and still match the CPU executor."""
     runner = BatchRunner()
-    qs = 'i("DEADLINE exceeded") | stats count() c'
-    cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
-    before = runner.fused_dispatches
-    dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
-                            runner=runner)
-    assert runner.fused_dispatches == before
-    assert _norm(cpu) == _norm(dev)
+    for qs in ['lvl:eq_field(app) | stats count() c',
+               'i("GÉT") | stats count() c']:
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        before = runner.fused_dispatches
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        assert runner.fused_dispatches == before, qs
+        assert _norm(cpu) == _norm(dev), qs
+
+
+def test_fused_any_case_unicode_divergence(tmp_path):
+    """U+212A (KELVIN SIGN) lowercases to ASCII 'k': the device byte fold
+    cannot see that match, so the row must reach the host residue and
+    still count.  Pure-ASCII mixed-case rows are decided on device."""
+    s = Storage(str(tmp_path), retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    bodies = ["TEMP 30K outside", "temp 30K inside", "Temp 30k mid",
+              "cool 20c none"] * 500
+    for i, b in enumerate(bodies):
+        lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", b)])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    try:
+        runner = BatchRunner()
+        for qs in ['i("30K") | stats count() c',
+                   'i("TEMP 30k") | stats count() c',
+                   'i("temp"*) | stats count() c']:
+            cpu = run_query_collect(s, [TEN], qs, timestamp=T0)
+            dev = run_query_collect(s, [TEN], qs, timestamp=T0,
+                                    runner=runner)
+            assert _norm(cpu) == _norm(dev), qs
+        assert int(cpu[0]["c"]) == 1500  # all three temp variants match
+        assert runner.fused_dispatches > 0
+    finally:
+        s.close()
 
 
 def test_fused_row_queries_unaffected(storage):

@@ -49,7 +49,14 @@ def storage(tmp_path_factory):
 def _rand_leaf(rnd: random.Random) -> str:
     w = rnd.choice(WORDS)
     w2 = rnd.choice(WORDS)
-    kind = rnd.randrange(10)
+    kind = rnd.randrange(12)
+    if kind >= 10:
+        # case-insensitive phrase/prefix (device ASCII fold + host residue
+        # for multibyte rows — WORDS includes 日本)
+        mangled = rnd.choice([w.upper(), w.swapcase(), w.capitalize()])
+        if kind == 10:
+            return f'i("{mangled}")'
+        return f'i("{mangled}"*)'
     if kind == 0:
         return w
     if kind == 1:

@@ -53,6 +53,10 @@ class ScanOp:
     # specials that need no device scan:
     match_nonempty: bool = False   # prefix "": any non-empty value
     match_empty: bool = False      # contains "": only the empty value
+    # ASCII-case-insensitive compare (pattern pre-lowered); rows with any
+    # byte >= 0x80 are re-checked on the host — Unicode lower() can map
+    # non-ASCII onto ASCII (U+212A -> 'k'), which the byte fold can't see
+    fold: bool = False
 
 
 @dataclass
@@ -94,6 +98,29 @@ def device_plan(f) -> LeafPlan | None:
                         [ScanOp(f.prefix.encode(), K.MODE_PREFIX,
                                 is_word_char(f.prefix[0]), False)],
                         "and", f._tokens())
+
+    if isinstance(f, F.FilterAnyCasePhrase):
+        if not ok(f._lower):
+            return None
+        return LeafPlan(f, canonical_field(f.field),
+                        [ScanOp(f._lower.encode(), K.MODE_PHRASE,
+                                is_word_char(f._lower[0]),
+                                is_word_char(f._lower[-1]), fold=True)],
+                        "and", [])
+
+    if isinstance(f, F.FilterAnyCasePrefix):
+        fld = canonical_field(f.field)
+        if not f._lower:
+            # match_any_case_prefix("") == any non-empty value
+            return LeafPlan(f, fld, [ScanOp(b"", 0, match_nonempty=True)],
+                            "and", [])
+        if not ok(f._lower):
+            return None
+        return LeafPlan(f, fld,
+                        [ScanOp(f._lower.encode(), K.MODE_PREFIX,
+                                is_word_char(f._lower[0]), False,
+                                fold=True)],
+                        "and", [])
 
     if isinstance(f, F.FilterExact):
         if not ok(f.value):
@@ -201,6 +228,7 @@ class StagedPart:
     width: int
     block_rows: dict               # block_idx -> (start, nrows)
     overflow: dict                 # block_idx -> np.ndarray of row idxs
+    nonascii: dict                 # block_idx -> row idxs with bytes >=0x80
     nbytes: int
 
     def device_bytes(self) -> int:
@@ -266,8 +294,9 @@ def stage_part_column(part, field: str,
     lens = np.zeros(rb, dtype=np.int32)
     block_rows = {}
     overflow = {}
+    nonascii = {}
     start = 0
-    from .layout import to_fixed_width
+    from .layout import to_fixed_width, rows_with_multibyte
     for bi, col in cols.items():
         r = int(col.offsets.shape[0])
         sub, _w, ov = to_fixed_width(col.arena, col.offsets, col.lengths,
@@ -277,11 +306,15 @@ def stage_part_column(part, field: str,
         block_rows[bi] = (start, r)
         if ov.size:
             overflow[bi] = ov
+        na = np.nonzero(rows_with_multibyte(col.arena, col.offsets,
+                                            col.lengths))[0]
+        if na.size:
+            nonascii[bi] = na
         start += r
     return StagedPart(rows=put(mat), lengths=put(lens),
                       lengths_np=lens, nrows=start, width=w,
                       block_rows=block_rows, overflow=overflow,
-                      nbytes=rb * (w + 4))
+                      nonascii=nonascii, nbytes=rb * (w + 4))
 
 
 # ---------------- stats staging (device partials) ----------------
@@ -808,16 +841,25 @@ class BatchRunner:
             need_verify = True
         else:
             combined = self._run_ops(spc, plan)
+        folds = any(op.fold for op in plan.ops)
         for bi in dev_bis:
             start, n = spc.block_rows[bi]
             bm = combined[start:start + n].copy() if combined is not None \
                 else np.ones(n, dtype=bool)
-            ov = spc.overflow.get(bi)
+            recheck = spc.overflow.get(bi)
+            if folds:
+                # case-fold leaves: rows with non-ASCII bytes can diverge
+                # from the byte fold in EITHER direction (U+212A lowers to
+                # ASCII 'k') — the host predicate decides them outright
+                na = spc.nonascii.get(bi)
+                if na is not None:
+                    recheck = na if recheck is None else \
+                        np.union1d(recheck, na)
             value_at = None
-            if ov is not None and ov.size:
+            if recheck is not None and recheck.size:
                 # truncated rows: ask the filter's full predicate
                 value_at = _row_accessor(bss[bi], plan.field)
-                for i in ov:
+                for i in recheck:
                     bm[i] = plan.filter._pred(value_at(i))
             if need_verify and bm.any():
                 check = np.nonzero(
@@ -1204,6 +1246,6 @@ class BatchRunner:
         pat = jnp.asarray(np.frombuffer(op.pattern, dtype=np.uint8))
         res = K.match_scan_packed(spc.rows, spc.lengths, pat,
                                   len(op.pattern), op.mode, op.starts_tok,
-                                  op.ends_tok)
+                                  op.ends_tok, op.fold)
         # bit-packed download (~20x less transfer); unpack is a writable copy
         return np.unpackbits(np.array(res))[:spc.nrows].astype(bool)

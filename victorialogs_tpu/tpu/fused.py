@@ -51,7 +51,7 @@ from ..storage.values_encoder import VT_DICT, VT_STRING
 from ..utils.hashing import hash_tokens
 from . import kernels as K
 from .batch import device_plan, StatsLayout
-from .layout import row_width_bucket, to_fixed_width
+from .layout import row_width_bucket, rows_with_multibyte, to_fixed_width
 
 
 # ---------------- layout-coordinate string staging ----------------
@@ -196,14 +196,8 @@ def stage_multibyte_mask(part, field: str, layout: StatsLayout,
             continue
         if meta["t"] == VT_STRING:
             col = part.block_column(bi, field)
-            if col.arena.size:
-                # per-row any(byte >= 0x80) via prefix sums (exact even
-                # for zero-length rows)
-                cs = np.zeros(col.arena.size + 1, dtype=np.int64)
-                np.cumsum(col.arena >= 0x80, out=cs[1:])
-                offs = col.offsets.astype(np.int64)
-                lens = col.lengths.astype(np.int64)
-                mb[start:start + n] = cs[offs + lens] > cs[offs]
+            mb[start:start + n] = rows_with_multibyte(
+                col.arena, col.offsets, col.lengths)
         elif meta["t"] == VT_DICT:
             col = part.block_column(bi, field)
             flags = np.array([bool(v.encode("utf-8", "replace") and
@@ -454,6 +448,16 @@ class _Planner:
             pa = self.arg(np.frombuffer(a, dtype=np.uint8))
             pb = self.arg(np.frombuffer(b, dtype=np.uint8))
             return ("pair", ri, li, oi, pa, len(a), pb, len(b))
+        # case-fold leaves: non-ASCII rows diverge from the byte fold in
+        # either direction, so they ride the maybe channel (host residue
+        # settles them with the filter's own predicate)
+        mb_mi = -1
+        if any(op.fold for op in plan.ops):
+            mbm = self.runner._stage_multibyte(self.part, plan.field,
+                                               self.layout)
+            if mbm.any:
+                mb_mi = self.arg(mbm.packed, row=True)
+                self.has_maybe = True
         kids = []
         for op in plan.ops:
             if op.match_nonempty:
@@ -466,8 +470,10 @@ class _Planner:
                 kids.append(self._ovf_only(oi))
             else:
                 pi = self.arg(np.frombuffer(op.pattern, dtype=np.uint8))
-                kids.append(("scan", ri, li, oi, pi, len(op.pattern),
-                             op.mode, op.starts_tok, op.ends_tok))
+                kids.append(("scan", ri, li, oi,
+                             mb_mi if op.fold else -1, pi,
+                             len(op.pattern), op.mode, op.starts_tok,
+                             op.ends_tok, op.fold))
         return self._combine(plan.combine, kids)
 
     def _numrange_leaf(self, f: F.FilterRange):
@@ -554,8 +560,8 @@ class _Planner:
                 kids.append(self._ovf_only(oi))
                 continue
             pi = self.arg(np.frombuffer(v.encode(), dtype=np.uint8))
-            kids.append(("scan", ri, li, oi, pi, len(v),
-                         K.MODE_EXACT, False, False))
+            kids.append(("scan", ri, li, oi, -1, pi, len(v),
+                         K.MODE_EXACT, False, False, False))
         return self._combine("or", kids)
 
     def _ovf_only(self, oi: int):
@@ -618,12 +624,18 @@ def _eval_node(node, args, rlp):
         le = (hi < hi_hi) | ((hi == hi_hi) & (lo <= hi_lo))
         return ge & le, None
     if kind == "scan":
-        _, ri, li, oi, pi, plen, mode, st, et = node
-        m = K.match_scan(args[ri], args[li], args[pi], plen, mode, st, et)
+        _, ri, li, oi, mi, pi, plen, mode, st, et, fold = node
+        m = K.match_scan(args[ri], args[li], args[pi], plen, mode, st, et,
+                         fold)
+        may = None
         if oi >= 0:
-            ov = _unpack_bits(args[oi], rlp)
-            return m & ~ov, ov
-        return m, None
+            may = _unpack_bits(args[oi], rlp)
+        if mi >= 0:
+            mb = _unpack_bits(args[mi], rlp)
+            may = mb if may is None else may | mb
+        if may is None:
+            return m, None
+        return m & ~may, may
     if kind == "pair":
         _, ri, li, oi, pa, la, pb, lb = node
         definite, needsv = K.match_ordered_pair(args[ri], args[li],

@@ -46,11 +46,21 @@ def _is_word_u8(b: jnp.ndarray) -> jnp.ndarray:
            (b == ord("_")) | ((b >= 0x80) & (b != 0xFF))
 
 
+def _fold_ascii(rows: jnp.ndarray) -> jnp.ndarray:
+    """ASCII-lowercase fold on uint8 bytes (A-Z -> a-z; everything else —
+    including the 0xFF padding and multibyte UTF-8 — unchanged).  Exact
+    vs Python str.lower() for pure-ASCII values; rows containing bytes
+    >= 0x80 are routed to host verification by the callers (Unicode case
+    folding can map non-ASCII onto ASCII, e.g. U+212A -> 'k')."""
+    return jnp.where((rows >= 0x41) & (rows <= 0x5A), rows + 0x20, rows)
+
+
 @partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",
-                                   "ends_tok"))
+                                   "ends_tok", "fold"))
 def match_scan(rows: jnp.ndarray, lengths: jnp.ndarray,
                pattern: jnp.ndarray, pat_len: int, mode: int,
-               starts_tok: bool, ends_tok: bool) -> jnp.ndarray:
+               starts_tok: bool, ends_tok: bool,
+               fold: bool = False) -> jnp.ndarray:
     """Per-row match bitmap over a fixed-width staged string column.
 
     rows: uint8[R, W] — one value per row starting at column 0, tail-padded
@@ -63,8 +73,13 @@ def match_scan(rows: jnp.ndarray, lengths: jnp.ndarray,
           host (runner overflow path).
     lengths: int32[R] true value byte lengths
     pattern: uint8[pat_len]
+    fold: ASCII-case-insensitive compare (pattern must arrive pre-lowered;
+          the word-boundary table is case-agnostic so boundaries are
+          computed on the folded bytes without semantic drift)
     returns bool[R]
     """
+    if fold:
+        rows = _fold_ascii(rows)
     r, w = rows.shape
     nwc = w - pat_len + 1  # window start columns
 
@@ -157,17 +172,19 @@ def match_ordered_pair(rows: jnp.ndarray, lengths: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("pat_len", "mode", "starts_tok",
-                                   "ends_tok"))
+                                   "ends_tok", "fold"))
 def match_scan_packed(rows: jnp.ndarray, lengths: jnp.ndarray,
                       pattern: jnp.ndarray, pat_len: int, mode: int,
-                      starts_tok: bool, ends_tok: bool) -> jnp.ndarray:
+                      starts_tok: bool, ends_tok: bool,
+                      fold: bool = False) -> jnp.ndarray:
     """match_scan with the bitmap bit-packed ON DEVICE before download.
 
     A bool[4M] download costs ~213ms through the axon tunnel; the same
     bits packed cost ~11ms (tools/profile_device.py).  R is always a
     pad_bucket multiple, hence divisible by 8."""
     return jnp.packbits(match_scan(rows, lengths, pattern, pat_len, mode,
-                                   starts_tok, ends_tok).astype(jnp.uint8))
+                                   starts_tok, ends_tok,
+                                   fold).astype(jnp.uint8))
 
 
 @partial(jax.jit, static_argnames=("len_a", "len_b"))

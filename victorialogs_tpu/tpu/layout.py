@@ -76,6 +76,22 @@ def to_fixed_width(arena_np: np.ndarray, offsets_np: np.ndarray,
     return out, w, overflow
 
 
+def rows_with_multibyte(arena_np: np.ndarray, offsets_np: np.ndarray,
+                        lengths_np: np.ndarray) -> np.ndarray:
+    """Per-row any(byte >= 0x80) over the SOURCE values (truncated tails
+    included), via prefix sums — exact even for zero-length rows.
+    Returns bool[r].  Consumed by case-fold and len_range device leaves,
+    whose byte-level compares are only definitive for pure-ASCII rows."""
+    r = int(offsets_np.shape[0])
+    if not arena_np.size or not (arena_np >= 0x80).any():
+        return np.zeros(r, dtype=bool)
+    cs = np.zeros(arena_np.size + 1, dtype=np.int64)
+    np.cumsum(arena_np >= 0x80, out=cs[1:])
+    offs = offsets_np.astype(np.int64)
+    lens = lengths_np.astype(np.int64)
+    return cs[offs + lens] > cs[offs]
+
+
 def _ranges(lengths: np.ndarray) -> np.ndarray:
     """Concatenated [0..l) ranges for each l in lengths."""
     total = int(lengths.sum())
