@@ -141,6 +141,15 @@ def test_mesh_batch_runner_query_parity(tmp_path):
         # the SPMD fused single-dispatch path must have carried most of
         # these (shard_map + psum/pmin/pmax over the mesh)
         assert runner.fused_dispatches > 0
+        # sort-topk prefilter compiles under GSPMD over the sharded
+        # staging (exact order parity incl. boundary ties)
+        for qs in ['deadline | sort by (dur desc) limit 6 | fields dur',
+                   '* | sort by (dur) limit 9 | fields dur, app']:
+            cpu = run_query_collect(s, [ten], qs, timestamp=T0)
+            dev = run_query_collect(s, [ten], qs, timestamp=T0,
+                                    runner=runner)
+            assert cpu == dev, qs
+        assert runner.topk_dispatches > 0
     finally:
         s.close()
 

@@ -200,6 +200,51 @@ def test_fused_row_queries_unaffected(storage):
     assert _norm(cpu) == _norm(dev)
 
 
+def test_fused_topk_parity(storage):
+    """Device sort-topk prefilter: `<filter> | sort by (f) limit N` must
+    return the SAME rows in the SAME order as the CPU path — including
+    ties at the k-th boundary (broken by arrival order on both engines)
+    and maybe rows (pair-regex newlines) verified on host."""
+    runner = BatchRunner()
+    queries = [
+        '"GET" | sort by (dur desc) limit 7 | fields dur, app',
+        'lvl:error | sort by (dur) limit 5 | fields dur, lvl',
+        '* | sort by (dur desc) offset 3 limit 4 | fields dur',
+        'dur:>340 | sort by (dur) limit 1000 | fields dur',  # k > matches
+        '_msg:~"GET.*exceeded" | sort by (dur desc) limit 5 | fields dur',
+        '"deadline exceeded" | sort by (dur) limit 3 rank as r '
+        '| fields dur, r',
+        # heavy boundary ties: every dur value repeats across apps
+        'app:in(app1, app2) | sort by (dur desc) limit 9 | fields dur, app',
+    ]
+    engaged = 0
+    for qs in queries:
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        before = runner.topk_dispatches
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        assert cpu == dev, qs          # exact rows, exact order
+        engaged += runner.topk_dispatches - before
+    assert engaged >= 5
+
+
+def test_fused_topk_declines_cleanly(storage):
+    """Shapes the topk prefilter must decline (string sort field,
+    multi-field sort, partition_by) still match the CPU path through the
+    ordinary device filter path."""
+    runner = BatchRunner()
+    for qs in ['* | sort by (lvl) limit 5 | fields lvl',
+               '* | sort by (dur, app) limit 5 | fields dur, app',
+               '* | sort by (dur) partition by (app) limit 2 '
+               '| fields dur, app']:
+        cpu = run_query_collect(storage, [TEN], qs, timestamp=T0)
+        before = runner.topk_dispatches
+        dev = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                                runner=runner)
+        assert runner.topk_dispatches == before, qs
+        assert _norm(cpu) == _norm(dev), qs
+
+
 def test_fused_truncation_overflow(tmp_path):
     """Values beyond MAX_ROW_WIDTH are truncated in staging; phrases
     hitting the truncated tail must be settled by the residue pass."""

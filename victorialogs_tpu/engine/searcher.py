@@ -175,6 +175,14 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         from ..tpu.stats_device import device_stats_spec
         stats_spec = device_stats_spec(q)
 
+    # device sort-topk prefilter: `<filter> | sort by (f) limit N` keeps
+    # only rows at-or-above each part's k-th best key (tpu/sort_device.py)
+    sort_spec = None
+    if stats_spec is None and runner is not None and \
+            hasattr(runner, "run_part_topk"):
+        from ..tpu.sort_device import device_sort_spec
+        sort_spec = device_sort_spec(q)
+
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
 
@@ -200,7 +208,7 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                 return
         _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
                     allowed_sids, min_ts, max_ts, ctx, needed,
-                    deadline, pool, stats_spec)
+                    deadline, pool, stats_spec, sort_spec)
 
     try:
         pts = storage.select_partitions(min_ts, max_ts)
@@ -298,7 +306,7 @@ def _absorb_stats_partials(head, q, spec, partials) -> None:
 
 def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 min_ts, max_ts, ctx, needed, deadline, pool,
-                stats_spec=None) -> None:
+                stats_spec=None, sort_spec=None) -> None:
     parts = [p for p in pt.ddb.snapshot_parts()
              if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
 
@@ -367,7 +375,12 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 for bi in handled:
                     del cand[bi]
             else:
-                bms = runner.run_part(q.filter, part, cand)
+                bms = None
+                if sort_spec is not None:
+                    bms = runner.run_part_topk(q.filter, part, cand,
+                                               sort_spec)
+                if bms is None:
+                    bms = runner.run_part(q.filter, part, cand)
         else:
             # CPU worker pool: filters evaluate in parallel, results
             # are written downstream in deterministic block order

@@ -228,7 +228,6 @@ class StagedPart:
     width: int
     block_rows: dict               # block_idx -> (start, nrows)
     overflow: dict                 # block_idx -> np.ndarray of row idxs
-    nonascii: dict                 # block_idx -> row idxs with bytes >=0x80
     nbytes: int
 
     def device_bytes(self) -> int:
@@ -294,9 +293,8 @@ def stage_part_column(part, field: str,
     lens = np.zeros(rb, dtype=np.int32)
     block_rows = {}
     overflow = {}
-    nonascii = {}
     start = 0
-    from .layout import to_fixed_width, rows_with_multibyte
+    from .layout import to_fixed_width
     for bi, col in cols.items():
         r = int(col.offsets.shape[0])
         sub, _w, ov = to_fixed_width(col.arena, col.offsets, col.lengths,
@@ -306,15 +304,11 @@ def stage_part_column(part, field: str,
         block_rows[bi] = (start, r)
         if ov.size:
             overflow[bi] = ov
-        na = np.nonzero(rows_with_multibyte(col.arena, col.offsets,
-                                            col.lengths))[0]
-        if na.size:
-            nonascii[bi] = na
         start += r
     return StagedPart(rows=put(mat), lengths=put(lens),
                       lengths_np=lens, nrows=start, width=w,
                       block_rows=block_rows, overflow=overflow,
-                      nonascii=nonascii, nbytes=rb * (w + 4))
+                      nbytes=rb * (w + 4))
 
 
 # ---------------- stats staging (device partials) ----------------
@@ -603,6 +597,7 @@ class BatchRunner:
         self.cpu_fallbacks = 0
         self.stats_dispatches = 0
         self.fused_dispatches = 0
+        self.topk_dispatches = 0
         self.stats_shards = 1          # mesh runners stripe rows over >1
         self._counter_mu = threading.Lock()
         # striped staging locks: the prefetcher, concurrent partition
@@ -705,6 +700,12 @@ class BatchRunner:
         return _fused_dispatch(prog, strides, nb, n_values, nrows,
                                cand_packed, ids_tuple, values_tuple, args)
 
+    def _dispatch_topk(self, prog, k, desc, nrows, cand_packed, values,
+                       args):
+        from .fused import _topk_dispatch
+        return _topk_dispatch(prog, k, desc, nrows, cand_packed, values,
+                              args)
+
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
         return np.array(K.stats_bucket_count(ids_tuple, strides, mask,
                                              nb))
@@ -730,6 +731,29 @@ class BatchRunner:
                 return None
             self.cache.put(key, spc)
             return spc
+
+    def _stage_nonascii(self, part, field: str) -> dict:
+        """block_idx -> row idxs whose SOURCE value has a byte >= 0x80,
+        for string-typed blocks.  Computed lazily on first use by a
+        case-fold leaf (most queries never pay for it) and cached per
+        (part, field)."""
+        key = (part.uid, "#na", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                from .layout import rows_with_multibyte
+                na = {}
+                for bi in range(part.num_blocks):
+                    col = part.block_column(bi, field)
+                    if col is None or col.vtype != VT_STRING:
+                        continue
+                    idx = np.nonzero(rows_with_multibyte(
+                        col.arena, col.offsets, col.lengths))[0]
+                    if idx.size:
+                        na[bi] = idx
+                got = na
+                self.cache.put_small(key, got)
+            return got
 
     # ---- per-block compatibility shim ----
     def apply_filter(self, f, bs: BlockSearch) -> np.ndarray:
@@ -841,20 +865,20 @@ class BatchRunner:
             need_verify = True
         else:
             combined = self._run_ops(spc, plan)
-        folds = any(op.fold for op in plan.ops)
+        na_map = self._stage_nonascii(part, plan.field) \
+            if any(op.fold for op in plan.ops) else {}
         for bi in dev_bis:
             start, n = spc.block_rows[bi]
             bm = combined[start:start + n].copy() if combined is not None \
                 else np.ones(n, dtype=bool)
             recheck = spc.overflow.get(bi)
-            if folds:
-                # case-fold leaves: rows with non-ASCII bytes can diverge
-                # from the byte fold in EITHER direction (U+212A lowers to
-                # ASCII 'k') — the host predicate decides them outright
-                na = spc.nonascii.get(bi)
-                if na is not None:
-                    recheck = na if recheck is None else \
-                        np.union1d(recheck, na)
+            # case-fold leaves: rows with non-ASCII bytes can diverge
+            # from the byte fold in EITHER direction (U+212A lowers to
+            # ASCII 'k') — the host predicate decides them outright
+            na = na_map.get(bi)
+            if na is not None:
+                recheck = na if recheck is None else \
+                    np.union1d(recheck, na)
             value_at = None
             if recheck is not None and recheck.size:
                 # truncated rows: ask the filter's full predicate
@@ -1119,6 +1143,17 @@ class BatchRunner:
                 got = stage_ts_planes(part, layout, put=self._put)
                 self.cache.put(key, got)
             return got
+
+    def run_part_topk(self, f, part, bss: dict, spec):
+        """Filter + sort-topk threshold prefilter for one part in ONE
+        dispatch (tpu/fused.py try_fused_topk; spec from
+        sort_device.device_sort_spec).  Returns block_idx -> bitmap
+        holding exactly the filter-matching rows at-or-above the part's
+        k-th best sort key (a superset of the part's contribution to the
+        global top-k — the host sort processor resolves order and ties
+        exactly like the CPU path), or None when the shape declines."""
+        from .fused import try_fused_topk
+        return try_fused_topk(self, f, part, bss, spec)
 
     def run_part_stats(self, f, part, bss: dict, spec):
         """Filter + stats partials for one part.

@@ -832,6 +832,28 @@ def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
 
 # ---------------- entry ----------------
 
+def _stage_cand_mask(runner, part, bss, layout):
+    """Candidate-row mask for a dispatch: all-blocks-candidate uses the
+    cheap rows<nrows form (no upload); partial candidate sets ship as
+    packed bits, cached per (part, block-set)."""
+    import jax.numpy as jnp
+    all_cand = len(bss) == part.num_blocks
+    if all_cand:
+        return jnp.zeros(1, dtype=jnp.uint8), False
+    ckey = (part.uid, "#cand", tuple(sorted(bss)))
+    with runner._key_lock(ckey):
+        cm = runner.cache.get(ckey)
+        if cm is None:
+            m = np.zeros(layout.nrows_padded, dtype=bool)
+            for bi in bss:
+                s = layout.starts[bi]
+                m[s:s + part.block_rows(bi)] = True
+            cm = _CandMask(packed=runner._put(np.packbits(m)),
+                           nbytes=layout.nrows_padded // 8)
+            runner.cache.put(ckey, cm)
+    return cm.packed, True
+
+
 def try_fused(runner, f, part, bss, spec, asm):
     """Attempt the single-dispatch path; None -> caller falls back.
 
@@ -852,26 +874,8 @@ def try_fused(runner, f, part, bss, spec, asm):
     if tree == ("false",):
         return {}, handled, []
 
-    # candidate mask: all-blocks-candidate uses the cheap rows<nrows
-    # form (no upload); partial candidate sets ship as packed bits
-    all_cand = len(bss) == part.num_blocks
-    if all_cand:
-        cand_packed = jnp.zeros(1, dtype=jnp.uint8)
-    else:
-        ckey = (part.uid, "#cand", tuple(sorted(bss)))
-        with runner._key_lock(ckey):
-            cm = runner.cache.get(ckey)
-            if cm is None:
-                m = np.zeros(layout.nrows_padded, dtype=bool)
-                for bi in bss:
-                    s = layout.starts[bi]
-                    m[s:s + part.block_rows(bi)] = True
-                cm = _CandMask(packed=runner._put(np.packbits(m)),
-                               nbytes=layout.nrows_padded // 8)
-                runner.cache.put(ckey, cm)
-        cand_packed = cm.packed
-
-    prog = (tree, layout.nrows_padded, planner.has_maybe, not all_cand,
+    cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
+    prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
             tuple(planner.arg_rows))
     values_tuple = tuple(asm.numerics[fld].values
                          for fld in spec.value_fields)
@@ -901,3 +905,97 @@ def try_fused(runner, f, part, bss, spec, asm):
         partials.extend(_residue_partials(f, bss, spec, layout,
                                           maybe_np))
     return {}, handled, partials
+
+
+# ---------------- fused filter | sort-topk prefilter ----------------
+
+@partial(jax.jit, static_argnames=("prog", "k", "desc"))
+def _topk_dispatch(prog, k, desc, nrows, cand_packed, values, args):
+    """One device call: filter tree -> top-k threshold -> packed row sets.
+
+    values: uint32[RLp] offsets from the part's column minimum (the same
+    staging the stats path uses); the threshold is the k-th best key
+    among DEFINITE filter matches, and the return is
+    (packed definite rows >= threshold, packed maybe rows >= threshold)
+    — see sort_device.py for the soundness argument.  Scores ride int32
+    (eligibility caps vmax-vmin below 2**31-2); -1 marks non-candidates,
+    so a part with fewer than k matches degenerates to the full match
+    set.  Runs unchanged over mesh-sharded inputs (GSPMD inserts the
+    top_k gather; only the packed bits come back).
+    """
+    import jax.numpy as jnp
+    tree, _rlp, has_maybe, has_cand = prog[:4]
+    rl = values.shape[0]
+    d, m = _eval_node(tree, args, rl)
+    if has_cand:
+        cand = _unpack_bits(cand_packed, rl)
+    else:
+        cand = jnp.arange(rl, dtype=jnp.int32) < nrows
+    d = d & cand
+    mv = (m & cand) if (has_maybe and m is not None) else None
+    v = values.astype(jnp.int32)
+    if not desc:
+        v = jnp.int32((1 << 31) - 2) - v   # ascending: reverse the order
+    s = jnp.where(d, v, jnp.int32(-1))
+    kv = jax.lax.top_k(s, k)[0][k - 1]
+    out_d = d & (s >= kv)
+    if mv is not None:
+        out_m = mv & (jnp.where(mv, v, jnp.int32(-1)) >= kv)
+    else:
+        out_m = jnp.zeros(rl, dtype=bool)
+    return (jnp.packbits(out_d.astype(jnp.uint8)),
+            jnp.packbits(out_m.astype(jnp.uint8)))
+
+
+def try_fused_topk(runner, f, part, bss, spec):
+    """Attempt the filter|sort-topk single-dispatch path for one part.
+
+    Returns block_idx -> bitmap covering EVERY candidate block (the
+    bitmaps hold exactly the filter-matching rows whose sort key is
+    at-or-above the part's k-th best — a superset of the part's
+    contribution to the global top-k), or None when the shape declines
+    (caller falls back to ordinary filter evaluation)."""
+    import jax.numpy as jnp
+    from .stats_device import MAX_ABS_TIMES_ROWS, MAX_STAT_ROWS
+    layout = runner._stats_layout(part)
+    if layout.nrows > MAX_STAT_ROWS:
+        return None
+    sn = runner._stage_numeric(part, spec.field, layout,
+                               MAX_ABS_TIMES_ROWS)
+    if sn is None or any(bi not in sn.eligible for bi in bss):
+        return None
+    if sn.vmax - sn.vmin > (1 << 31) - 2:
+        return None                # int32 score space
+    planner = _Planner(runner, part, bss, layout)
+    try:
+        tree = planner.plan(f)
+    except _NoFuse:
+        return None
+    if tree == ("false",):
+        return {bi: np.zeros(bss[bi].nrows, dtype=bool) for bi in bss}
+
+    cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
+    prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
+            tuple(planner.arg_rows))
+    k = min(spec.k, layout.nrows_padded)
+    runner._bump("device_calls")
+    runner._bump("topk_dispatches")
+    dm, mm = runner._dispatch_topk(
+        prog, k, spec.desc, jnp.int32(layout.nrows), cand_packed,
+        sn.values, tuple(planner.args))
+    dm = np.unpackbits(np.array(dm))[:layout.nrows_padded].astype(bool)
+    mm = np.unpackbits(np.array(mm))[:layout.nrows_padded].astype(bool)
+    bms = {}
+    for bi, bs in bss.items():
+        start = layout.starts[bi]
+        n = bs.nrows
+        bm = dm[start:start + n].copy()
+        sel = mm[start:start + n]
+        if sel.any():
+            # maybe rows above threshold: the filter tree's own host
+            # path decides them (same residue discipline as try_fused)
+            vbm = sel.copy()
+            f.apply_to_block(bs, vbm)
+            bm |= vbm
+        bms[bi] = bm
+    return bms

@@ -1,0 +1,61 @@
+"""Device top-k prefilter for `<filter> | sort by (field [desc]) limit N`.
+
+The reference's pipe_sort_topk.go keeps only offset+limit rows in a heap
+while every matching row still flows through the pipe (all values
+materialize on the host).  The TPU-shaped move: the k-th best sort key
+among the filter's definite matches is computed ON DEVICE in the same
+dispatch as the filter tree (jax.lax.top_k over the staged uint32 value
+offsets), and only rows at-or-above that threshold come back to the
+host.  The host-side topk processor then runs unchanged over a few
+hundred rows instead of millions — same comparator, same seq tie-breaks,
+bit-identical output.
+
+Soundness of the threshold (why the prefilter never drops a true top-k
+row): let D = definite matches, M = maybe rows (truncation overflow
+etc.), T = true matches (D ⊆ T ⊆ D ∪ M).  kv_D, the k-th best key over
+D, satisfies kv_T >= kv_D (adding candidates only raises the k-th best),
+so every true top-k row has key >= kv_T >= kv_D.  The dispatch returns
+(D above threshold) plus (M above threshold); the host verifies the M
+rows with the filter's own predicate before feeding them downstream.
+
+Eligibility mirrors the host comparator: a single by-field whose
+candidate blocks are all int-typed (canonical decimal encodings —
+numeric order == _cmp_values order, ties only between equal values,
+which the processor breaks by arrival order exactly like the CPU path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+MAX_TOPK = 4096  # top_k cost grows with k; beyond this the host heap wins
+
+
+@dataclass
+class SortSpec:
+    field: str
+    desc: bool                    # effective order (field desc XOR global)
+    k: int                        # limit + offset
+
+
+def device_sort_spec(q) -> SortSpec | None:
+    """Static per-query analysis: can pipes[0] run as a device top-k
+    prefilter?  Shape: plain `sort by (one_field [desc]) [offset O]
+    limit N` — partition_by, multi-field sorts and special fields
+    decline (the host path handles them)."""
+    if not q.pipes:
+        return None
+    ps = q.pipes[0]
+    from ..logsql.pipes import PipeSort
+    if type(ps) is not PipeSort or getattr(ps, "name", "") != "sort":
+        return None
+    if ps.partition_by or ps.limit <= 0 or len(ps.by) != 1:
+        return None
+    fld, fdesc = ps.by[0]
+    if fld in ("_time", "_stream", "_stream_id") or "*" in fld:
+        return None
+    k = ps.limit + ps.offset
+    if k <= 0 or k > MAX_TOPK:
+        return None
+    # effective descending iff field-desc XOR global desc (PipeSort._sort_cmp)
+    return SortSpec(field=fld, desc=(bool(fdesc) != bool(ps.desc)), k=k)
