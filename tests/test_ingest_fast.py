@@ -1,0 +1,219 @@
+"""Columnar jsonline fast path (server/vlinsert._jsonline_fast +
+storage LogColumns) vs the per-row pipeline: the two ingestion paths
+must produce bit-identical query results for every input shape —
+including the rows the fast path itself must hand back to the per-row
+fallback (nested objects, arrays, nulls)."""
+
+import json
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.server.insertutil import (CommonParams,
+                                                LocalLogRowsStorage,
+                                                LogMessageProcessor)
+from victorialogs_tpu.server.vlinsert import handle_jsonline
+from victorialogs_tpu.storage.log_rows import TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+class _SlowOnlySink(LocalLogRowsStorage):
+    """Sink without must_add_columns: forces the per-row path."""
+    must_add_columns = property()  # attribute access raises
+
+
+def _ingest(tmp_path, name, body: bytes, slow: bool, **cp_kw):
+    s = Storage(str(tmp_path / name), retention_days=100000,
+                flush_interval=3600)
+    cp = CommonParams(tenant=TEN, **cp_kw)
+    sink = _SlowOnlySink(s) if slow else LocalLogRowsStorage(s)
+    lmp = LogMessageProcessor(cp, sink)
+    n = handle_jsonline(cp, body, lmp)
+    lmp.flush()
+    s.debug_flush()
+    return s, n
+
+
+def _rows(s, q="* | sort by (_time) | fields -_stream_id"):
+    out = run_query_collect(s, [TEN], q, timestamp=T0)
+    return sorted(tuple(sorted(r.items())) for r in out)
+
+
+def _diff_paths(tmp_path, body: bytes, **cp_kw):
+    fast_s, fast_n = _ingest(tmp_path, "fast", body, slow=False, **cp_kw)
+    slow_s, slow_n = _ingest(tmp_path, "slow", body, slow=True, **cp_kw)
+    try:
+        assert fast_n == slow_n
+        assert _rows(fast_s) == _rows(slow_s)
+        assert _rows(fast_s, '* | stats by (_stream) count() c') == \
+            _rows(slow_s, '* | stats by (_stream) count() c')
+    finally:
+        fast_s.close()
+        slow_s.close()
+    return fast_n
+
+
+def _body(rows) -> bytes:
+    return "\n".join(json.dumps(r) for r in rows).encode()
+
+
+def test_fast_slow_parity_basic(tmp_path):
+    rows = []
+    for i in range(3000):
+        rows.append({"_msg": f"msg {i % 50}", "app": f"app{i % 4}",
+                     "lvl": ["info", "warn", "error"][i % 3],
+                     "dur": i % 211,                # int value
+                     "ok": i % 2 == 0,             # bool value
+                     "ratio": i / 7,               # float value
+                     "_time": str(T0 + i * 1_000_000)})
+    n = _diff_paths(tmp_path, _body(rows), stream_fields=["app"])
+    assert n == 3000
+
+
+def test_fast_slow_parity_nested_fallback(tmp_path):
+    """Nested objects / arrays / nulls route through the per-row path
+    inside the fast handler — mixed batches must still match."""
+    rows = []
+    for i in range(1200):
+        r = {"_msg": f"m{i}", "app": "a", "_time": str(T0 + i * NS)}
+        if i % 5 == 0:
+            r["ctx"] = {"k": f"v{i}", "deep": {"x": i}}   # dot-flattened
+        if i % 7 == 0:
+            r["tags"] = ["x", i]                          # JSON-encoded
+        if i % 11 == 0:
+            r["absent"] = None                            # dropped
+        rows.append(r)
+    _diff_paths(tmp_path, _body(rows), stream_fields=["app"])
+
+
+def test_fast_slow_parity_time_and_msg_rules(tmp_path):
+    """Custom time field, msg-field renaming, default _msg value."""
+    rows = []
+    for i in range(900):
+        rows.append({"when": str(T0 + i * NS), "message": f"hello {i%9}",
+                     "app": f"s{i % 3}"})
+        if i % 4 == 0:
+            rows.append({"when": str(T0 + i * NS), "app": "nomsg"})
+    _diff_paths(tmp_path, _body(rows), stream_fields=["app"],
+                time_field="when", msg_fields=["message"],
+                default_msg_value="-")
+
+
+def test_fast_slow_parity_schema_changes_and_shared_stream(tmp_path):
+    """Schema alternates mid-batch while the SAME stream spans both
+    schemas: the fast path must fall back to row blocks for that stream
+    (non-overlapping within-part invariant) and still match."""
+    rows = []
+    for i in range(2000):
+        if i % 2:
+            rows.append({"_msg": f"a{i}", "app": "shared", "x": str(i),
+                         "_time": str(T0 + i * NS)})
+        else:
+            rows.append({"_msg": f"b{i}", "app": "shared", "y": str(i),
+                         "_time": str(T0 + i * NS)})
+    _diff_paths(tmp_path, _body(rows), stream_fields=["app"])
+
+
+def test_fast_slow_parity_multiday(tmp_path):
+    rows = [{"_msg": f"d{i}", "app": "a",
+             "_time": str(T0 + i * 86400 * NS // 4)} for i in range(200)]
+    _diff_paths(tmp_path, _body(rows), stream_fields=["app"])
+
+
+def test_fast_path_engaged_and_blocks_sorted(tmp_path):
+    """The fast path must actually run (not silently fall back) and the
+    produced per-stream blocks must be time-sorted and non-overlapping."""
+    import victorialogs_tpu.server.vlinsert as vi
+    calls = {"n": 0}
+    orig = vi._jsonline_fast
+
+    def spy(cp, body, lmp):
+        calls["n"] += 1
+        return orig(cp, body, lmp)
+    vi._jsonline_fast = spy
+    try:
+        rows = [{"_msg": f"m{i}", "app": f"a{i % 3}",
+                 "_time": str(T0 + (i * 37 % 500) * NS)}
+                for i in range(1500)]
+        s, _ = _ingest(tmp_path, "fast", _body(rows), slow=False,
+                       stream_fields=["app"])
+    finally:
+        vi._jsonline_fast = orig
+    assert calls["n"] == 1
+    try:
+        for pt in s.partitions.values():
+            for part in pt.ddb.snapshot_parts():
+                seen = {}
+                for bi in range(part.num_blocks):
+                    ts = part.block_timestamps(bi)
+                    assert (ts[1:] >= ts[:-1]).all()
+                    sid = part.block_stream_id(bi)
+                    lo, hi = int(ts[0]), int(ts[-1])
+                    for plo, phi in seen.get(sid, []):
+                        assert hi < plo or lo > phi, \
+                            "overlapping same-stream blocks in one part"
+                    seen.setdefault(sid, []).append((lo, hi))
+    finally:
+        s.close()
+
+
+def test_fast_slow_parity_weird_time_values(tmp_path):
+    """Adversarial time fields: JSON bool (stringifies to 'true' ->
+    unparseable -> now), non-ASCII digit strings ('²' must not 500),
+    floats, numeric seconds.  Rows whose effective timestamp is 'now'
+    are compared by _msg only (both paths must ingest them)."""
+    body = _body([
+        {"_msg": "tbool", "app": "a", "_time": True},
+        {"_msg": "tsup", "app": "a", "_time": "²"},
+        {"_msg": "tsecs", "app": "a", "_time": 1753660800},
+        {"_msg": "tfloat", "app": "a", "_time": 1753660800.5},
+        {"_msg": "tns", "app": "a", "_time": str(T0 + NS)},
+    ])
+    import time as _t
+    now = _t.time_ns()
+    fast_s, fn = _ingest(tmp_path, "fast", body, slow=False,
+                         stream_fields=["app"])
+    slow_s, sn = _ingest(tmp_path, "slow", body, slow=True,
+                         stream_fields=["app"])
+    try:
+        assert fn == sn == 5
+        q = "* | fields _msg"
+        fm = sorted(r["_msg"] for r in
+                    run_query_collect(fast_s, [TEN], q, timestamp=now))
+        sm = sorted(r["_msg"] for r in
+                    run_query_collect(slow_s, [TEN], q, timestamp=now))
+        assert fm == sm == ["tbool", "tfloat", "tns", "tsecs", "tsup"]
+        # deterministic timestamps must agree exactly
+        qd = '_msg:in(tsecs, tfloat, tns) | sort by (_msg) | fields _time'
+        assert run_query_collect(fast_s, [TEN], qd, timestamp=now) == \
+            run_query_collect(slow_s, [TEN], qd, timestamp=now)
+    finally:
+        fast_s.close()
+        slow_s.close()
+
+
+def test_fast_path_retention_drops(tmp_path):
+    """Too-old rows are counted and dropped identically."""
+    import time as _t
+    now = _t.time_ns()
+    rows = [{"_msg": "new", "app": "a", "_time": str(now)},
+            {"_msg": "old", "app": "a",
+             "_time": str(now - 400 * 86400 * NS)}]
+    s = Storage(str(tmp_path / "ret"), retention_days=100,
+                flush_interval=3600)
+    cp = CommonParams(tenant=TEN, stream_fields=["app"])
+    lmp = LogMessageProcessor(cp, LocalLogRowsStorage(s))
+    handle_jsonline(cp, _body(rows), lmp)
+    lmp.flush()
+    s.debug_flush()
+    try:
+        assert s.rows_dropped_too_old == 1
+        got = run_query_collect(s, [TEN], "* | fields _msg",
+                                timestamp=now)
+        assert [r["_msg"] for r in got] == ["new"]
+    finally:
+        s.close()

@@ -83,13 +83,17 @@ def parse_timestamp(v, default_ns: int | None = None) -> int | None:
     if v is None or v == "" or v == 0:
         return default_ns if default_ns is not None else time.time_ns()
     if isinstance(v, str):
-        ts = parse_rfc3339(v)
-        if ts is not None:
-            return ts
-        try:
-            v = float(v) if ("." in v or "e" in v or "E" in v) else int(v)
-        except ValueError:
-            return None
+        if v.isascii() and v.isdigit():
+            v = int(v)       # pure unix numbers skip the RFC3339 regex
+        else:
+            ts = parse_rfc3339(v)
+            if ts is not None:
+                return ts
+            try:
+                v = float(v) if ("." in v or "e" in v or "E" in v) \
+                    else int(v)
+            except ValueError:
+                return None
     if isinstance(v, float):
         # floats are unix seconds with fraction
         return int(v * 1e9)
@@ -140,6 +144,9 @@ class LocalLogRowsStorage(LogRowsStorage):
 
     def must_add_rows(self, lr: LogRows) -> None:
         self.storage.must_add_rows(lr)
+
+    def must_add_columns(self, lc) -> None:
+        self.storage.must_add_columns(lc)
 
 
 class LogMessageProcessor:
@@ -211,6 +218,26 @@ class LogMessageProcessor:
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
+
+    def supports_columns(self) -> bool:
+        """True when the sink accepts columnar batches directly and no
+        per-row transform (decolorize) is configured — the jsonline bulk
+        fast path's gate."""
+        return not self.cp.decolorize_fields and \
+            hasattr(self.sink, "must_add_columns")
+
+    def ingest_columns(self, lc) -> None:
+        """Hand a pre-assembled columnar batch to the sink.  Flushes any
+        pending row batch FIRST; callers that interleave fallback rows
+        with columnar accumulation must flush the columnar batch before
+        each fallback add_row (as _jsonline_fast does) so arrival order
+        is preserved end to end."""
+        if lc.nrows == 0:
+            return
+        with self._lock:
+            self._flush_locked()
+            self.sink.must_add_columns(lc)
+            self.rows_total += lc.nrows
 
 
 def _match_any(name: str, patterns: list[str]) -> bool:

@@ -43,6 +43,14 @@ class Partition:
             self.idb.must_register_streams(unseen)
         self.ddb.must_add_blocks(blocks_from_log_rows(lr))
 
+    def must_add_columns(self, lc) -> None:
+        """Columnar-batch twin of must_add_rows (LogColumns fast path)."""
+        unseen = [(sid, tags) for sid, tags in lc.unique_streams()
+                  if not self.idb.has_stream_id(sid)]
+        if unseen:
+            self.idb.must_register_streams(unseen)
+        self.ddb.must_add_blocks(lc.build_blocks())
+
     def debug_flush(self) -> None:
         self.idb.flush()
         self.ddb.flush_inmemory_parts()

@@ -111,6 +111,21 @@ class Storage:
                     sub.tenants.append(lr.tenants[i])
                 pt.must_add_rows(sub)
 
+    def must_add_columns(self, lc) -> None:
+        """Columnar-batch twin of must_add_rows (LogColumns fast path)."""
+        if self.is_read_only:
+            raise RuntimeError("storage is read-only (disk usage limit)")
+        if lc.nrows == 0:
+            return
+        now_ns = time.time_ns()
+        min_ts = now_ns - int(self.retention_days * NSECS_PER_DAY)
+        max_ts = now_ns + int(self.future_retention_days * NSECS_PER_DAY)
+        by_day, old, new = lc.split_by_day(min_ts, max_ts, NSECS_PER_DAY)
+        self.rows_dropped_too_old += old
+        self.rows_dropped_too_new += new
+        for day, sub in by_day.items():
+            self._get_partition(day).must_add_columns(sub)
+
     def _get_partition(self, day: int) -> Partition:
         with self._lock:
             pt = self.partitions.get(day)
