@@ -38,9 +38,13 @@ def _ingest(tmp_path, name, body: bytes, slow: bool, **cp_kw):
     return s, n
 
 
-def _rows(s, q="* | sort by (_time) | fields -_stream_id"):
+def _rows(s, q="*"):
     out = run_query_collect(s, [TEN], q, timestamp=T0)
-    return sorted(tuple(sorted(r.items())) for r in out)
+    # drop nothing but the volatile _stream_id hex; every FIELD VALUE
+    # participates in the parity comparison
+    return sorted(
+        tuple(sorted((k, v) for k, v in r.items() if k != "_stream_id"))
+        for r in out)
 
 
 def _diff_paths(tmp_path, body: bytes, **cp_kw):
@@ -159,6 +163,47 @@ def test_fast_path_engaged_and_blocks_sorted(tmp_path):
                     seen.setdefault(sid, []).append((lo, hi))
     finally:
         s.close()
+
+
+def test_fast_slow_parity_number_edge_cases(tmp_path):
+    """Exact number stringification: ints stay raw text, floats format
+    via json.dumps, and JSON -0 must land as '0' (json.loads -> int 0)
+    on BOTH paths."""
+    rows = []
+    for i in range(300):
+        rows.append({"_msg": f"m{i}", "app": "a",
+                     "v": [-0, 0, 12, -7, 1.50, 2.0, 1e3, -0.0,
+                           10**25, 0.1][i % 10],
+                     "_time": str(T0 + i * NS)})
+    body = "\n".join(json.dumps(r).replace('"v": 0,', '"v": -0,')
+                     if i % 10 == 0 else json.dumps(r)
+                     for i, r in enumerate(rows)).encode()
+    # non-canonical raw number text must reformat identically
+    t1 = json.dumps(str(T0))
+    body += (f'\n{{"_msg":"raw1","app":"a","v":1.50,"_time":{t1}}}'
+             f'\n{{"_msg":"raw2","app":"a","v":1e3,"_time":{t1}}}'
+             f'\n{{"_msg":"raw3","app":"a","v":-0,"_time":{t1}}}'
+             ).encode()
+    _diff_paths(tmp_path, body, stream_fields=["app"])
+
+
+def test_fast_path_cross_schema_stream_order(tmp_path):
+    """Two schemas whose streams sort OPPOSITE to schema arrival order:
+    build_blocks must still hand the flush merger a (stream_id, min_ts)-
+    sorted block list (the k-way merge input invariant), so flush+merge
+    keep every row and queries agree with the slow path."""
+    rows = []
+    for i in range(4000):
+        # schema A rows for many streams, then schema B rows for the
+        # same time range but different streams — orders collide
+        if i % 2:
+            rows.append({"_msg": f"a{i}", "app": f"s{i % 7}",
+                         "x": str(i), "_time": str(T0 + (i % 97) * NS)})
+        else:
+            rows.append({"_msg": f"b{i}", "app": f"z{i % 5}",
+                         "y": str(i), "_time": str(T0 + (i % 97) * NS)})
+    fast_n = _diff_paths(tmp_path, _body(rows), stream_fields=["app"])
+    assert fast_n == 4000
 
 
 def test_fast_slow_parity_weird_time_values(tmp_path):

@@ -78,6 +78,11 @@ def _load():
                                                  p_u8, i64, p_u8, i64,
                                                  p_u8, p_u8]
             lib.vl_ordered_pair_scan.restype = None
+            p_i32 = ctypes.POINTER(ctypes.c_int32)
+            lib.vl_jsonline_scan.argtypes = [p_u8, i64, p_u8, i64,
+                                             p_i32, i64, p_i32, i64,
+                                             p_i64, p_i64]
+            lib.vl_jsonline_scan.restype = i64
         except AttributeError:
             # a stale .so without the newer symbols (mtime tricked the
             # rebuild check): degrade to the Python paths instead of
@@ -217,3 +222,35 @@ def xxh64_native(data: bytes, seed: int = 0) -> int | None:
         buf = np.zeros(1, dtype=np.uint8)
         return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), 0, seed))
     return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), buf.size, seed))
+
+
+def jsonline_scan_native(body: bytes):
+    """Native strict-subset JSON-lines scan (the columnar ingest fast
+    path's parser).  Returns (arena_bytes, fields int32[N,5],
+    lines int32[M,5], sigs int64[M], arena_is_ascii) or None when the
+    native lib is unavailable or a capacity bound trips (caller uses the
+    per-line Python parser)."""
+    lib = _load()
+    if lib is None or not body:
+        return None
+    blen = len(body)
+    buf = np.frombuffer(body, dtype=np.uint8)
+    arena = np.empty(blen, dtype=np.uint8)
+    fields_cap = blen // 4 + 64
+    lines_cap = blen // 3 + 64
+    fields = np.empty((fields_cap, 5), dtype=np.int32)
+    lines = np.empty((lines_cap, 5), dtype=np.int32)
+    sigs = np.empty(lines_cap, dtype=np.int64)
+    counts = np.zeros(4, dtype=np.int64)
+    rc = lib.vl_jsonline_scan(
+        _ptr(buf, ctypes.c_uint8), blen,
+        _ptr(arena, ctypes.c_uint8), blen,
+        _ptr(fields, ctypes.c_int32), fields_cap,
+        _ptr(lines, ctypes.c_int32), lines_cap,
+        _ptr(sigs, ctypes.c_int64), _ptr(counts, ctypes.c_int64))
+    if rc != 0:
+        return None
+    nl, nf, used, ascii_ = int(counts[0]), int(counts[1]), \
+        int(counts[2]), bool(counts[3])
+    return (arena[:used].tobytes(), fields[:nf], lines[:nl], sigs[:nl],
+            ascii_)

@@ -288,4 +288,381 @@ void vl_ordered_pair_scan(const uint8_t* arena, const int64_t* offsets,
     }
 }
 
+
+// ---------------- jsonline scanner (native data loader) ----------------
+//
+// Strict-subset JSON-lines parser for the columnar ingest fast path
+// (server/vlinsert.py).  Handles flat objects whose values are strings,
+// numbers, true or false; everything else (nested objects, arrays,
+// nulls, lone surrogates, duplicate keys, malformed lines) flags the
+// line for the Python fallback, which re-parses it with json.loads so
+// semantics (including error behavior) stay identical to the per-row
+// path.  The reference's equivalent is the fastjson-backed parser in
+// lib/logstorage/json_parser.go.
+//
+// Output layout:
+//   arena      : unescaped key/value bytes (escapes only shrink text,
+//                so cap = body_len is always enough)
+//   fields i32 : per field [key_off, key_len, val_off, val_len, kind]
+//                kind 0 = string, 1 = exact-int raw text,
+//                2 = float raw text (Python re-formats via json.dumps),
+//                3 = true, 4 = false
+//   lines  i32 : per line  [field_start, nfields, flags, raw_off, raw_len]
+//                flags bit0 = Python fallback required
+//   sigs   i64 : per line xxh64 over (key_len, key bytes)* — the schema
+//                signature the Python side keys its plan cache on
+//   counts i64 : [nlines, nfields_total, arena_used, arena_is_ascii]
+// Returns 0 on success, -1 when a capacity limit would be exceeded
+// (caller falls back to the per-line path).
+
+static inline bool js_ws(uint8_t c) {
+    return c == ' ' || c == '\t' || c == '\r';
+}
+
+extern "C" int64_t vl_jsonline_scan(
+        const uint8_t* body, int64_t body_len,
+        uint8_t* arena, int64_t arena_cap,
+        int32_t* fields, int64_t fields_cap,
+        int32_t* lines, int64_t lines_cap,
+        int64_t* sigs, int64_t* counts) {
+    int64_t nl = 0, nf = 0, ap = 0;
+    int64_t ascii = 1;
+    int64_t pos = 0;
+    while (pos < body_len) {
+        int64_t eol = pos;
+        while (eol < body_len && body[eol] != '\n') eol++;
+        int64_t s = pos, e = eol;
+        pos = eol + 1;
+        while (s < e && (js_ws(body[s]) || body[s] == '\n')) s++;
+        while (e > s && (js_ws(body[e - 1]))) e--;
+        if (s >= e) continue;          // blank line
+        if (nl >= lines_cap) return -1;
+        int32_t* L = lines + nl * 5;
+        L[0] = (int32_t)nf;
+        L[1] = 0;
+        L[2] = 0;
+        L[3] = (int32_t)s;
+        L[4] = (int32_t)(e - s);
+        sigs[nl] = 0;
+        nl++;
+        // strict-subset parse; any trouble -> fallback flag
+        int64_t i = s;
+        bool fall = false;
+        int64_t line_fields = nf;
+        uint64_t sig = 1469598103934665603ULL;  // fnv offset (seed only)
+        if (body[i] != '{') { L[2] = 1; continue; }
+        i++;
+        while (i < e && js_ws(body[i])) i++;
+        if (i < e && body[i] == '}') {
+            // empty object
+            i++;
+            while (i < e && js_ws(body[i])) i++;
+            if (i != e) L[2] = 1;
+            else L[1] = 0;
+            continue;
+        }
+        for (;;) {
+            while (i < e && js_ws(body[i])) i++;
+            if (i >= e || body[i] != '"') { fall = true; break; }
+            // key string
+            int64_t ko = ap;
+            i++;
+            bool bad = false;
+            while (i < e) {
+                uint8_t c = body[i];
+                if (c == '"') break;
+                if (c == '\\') {
+                    if (i + 1 >= e) { bad = true; break; }
+                    uint8_t n = body[i + 1];
+                    i += 2;
+                    switch (n) {
+                        case '"': arena[ap++] = '"'; break;
+                        case '\\': arena[ap++] = '\\'; break;
+                        case '/': arena[ap++] = '/'; break;
+                        case 'b': arena[ap++] = '\b'; break;
+                        case 'f': arena[ap++] = '\f'; break;
+                        case 'n': arena[ap++] = '\n'; break;
+                        case 'r': arena[ap++] = '\r'; break;
+                        case 't': arena[ap++] = '\t'; break;
+                        case 'u': {
+                            if (i + 4 > e) { bad = true; break; }
+                            uint32_t cp = 0;
+                            for (int k = 0; k < 4; k++) {
+                                uint8_t h = body[i + k];
+                                cp <<= 4;
+                                if (h >= '0' && h <= '9') cp |= h - '0';
+                                else if (h >= 'a' && h <= 'f')
+                                    cp |= h - 'a' + 10;
+                                else if (h >= 'A' && h <= 'F')
+                                    cp |= h - 'A' + 10;
+                                else { bad = true; break; }
+                            }
+                            if (bad) break;
+                            i += 4;
+                            if (cp >= 0xD800 && cp <= 0xDBFF) {
+                                // surrogate pair
+                                if (i + 6 <= e && body[i] == '\\' &&
+                                    body[i + 1] == 'u') {
+                                    uint32_t lo2 = 0;
+                                    bool ok2 = true;
+                                    for (int k = 0; k < 4; k++) {
+                                        uint8_t h = body[i + 2 + k];
+                                        lo2 <<= 4;
+                                        if (h >= '0' && h <= '9')
+                                            lo2 |= h - '0';
+                                        else if (h >= 'a' && h <= 'f')
+                                            lo2 |= h - 'a' + 10;
+                                        else if (h >= 'A' && h <= 'F')
+                                            lo2 |= h - 'A' + 10;
+                                        else { ok2 = false; break; }
+                                    }
+                                    if (!ok2 || lo2 < 0xDC00 ||
+                                        lo2 > 0xDFFF) { bad = true; break; }
+                                    i += 6;
+                                    cp = 0x10000 +
+                                         ((cp - 0xD800) << 10) +
+                                         (lo2 - 0xDC00);
+                                } else { bad = true; break; }
+                            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+                                bad = true; break;  // lone low surrogate
+                            }
+                            if (cp < 0x80) {
+                                arena[ap++] = (uint8_t)cp;
+                            } else if (cp < 0x800) {
+                                arena[ap++] = 0xC0 | (cp >> 6);
+                                arena[ap++] = 0x80 | (cp & 0x3F);
+                                ascii = 0;
+                            } else if (cp < 0x10000) {
+                                arena[ap++] = 0xE0 | (cp >> 12);
+                                arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
+                                arena[ap++] = 0x80 | (cp & 0x3F);
+                                ascii = 0;
+                            } else {
+                                arena[ap++] = 0xF0 | (cp >> 18);
+                                arena[ap++] = 0x80 | ((cp >> 12) & 0x3F);
+                                arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
+                                arena[ap++] = 0x80 | (cp & 0x3F);
+                                ascii = 0;
+                            }
+                            break;
+                        }
+                        default: bad = true; break;
+                    }
+                    if (bad) break;
+                } else {
+                    if (c < 0x20) { bad = true; break; }
+                    if (c >= 0x80) ascii = 0;
+                    arena[ap++] = c;
+                    i++;
+                }
+            }
+            if (bad || i >= e || body[i] != '"') { fall = true; break; }
+            i++;
+            int64_t klen = ap - ko;
+            while (i < e && js_ws(body[i])) i++;
+            if (i >= e || body[i] != ':') { fall = true; break; }
+            i++;
+            while (i < e && js_ws(body[i])) i++;
+            if (i >= e) { fall = true; break; }
+            // value
+            int64_t vo = ap, vlen = 0;
+            int32_t kind;
+            uint8_t c = body[i];
+            if (c == '"') {
+                // string value: same unescape loop (shared via goto-less
+                // duplication kept simple: call a lambda)
+                i++;
+                bool vbad = false;
+                while (i < e) {
+                    uint8_t vc = body[i];
+                    if (vc == '"') break;
+                    if (vc == '\\') {
+                        if (i + 1 >= e) { vbad = true; break; }
+                        uint8_t n2 = body[i + 1];
+                        i += 2;
+                        switch (n2) {
+                            case '"': arena[ap++] = '"'; break;
+                            case '\\': arena[ap++] = '\\'; break;
+                            case '/': arena[ap++] = '/'; break;
+                            case 'b': arena[ap++] = '\b'; break;
+                            case 'f': arena[ap++] = '\f'; break;
+                            case 'n': arena[ap++] = '\n'; break;
+                            case 'r': arena[ap++] = '\r'; break;
+                            case 't': arena[ap++] = '\t'; break;
+                            case 'u': {
+                                if (i + 4 > e) { vbad = true; break; }
+                                uint32_t cp = 0;
+                                bool okh = true;
+                                for (int k = 0; k < 4; k++) {
+                                    uint8_t h = body[i + k];
+                                    cp <<= 4;
+                                    if (h >= '0' && h <= '9')
+                                        cp |= h - '0';
+                                    else if (h >= 'a' && h <= 'f')
+                                        cp |= h - 'a' + 10;
+                                    else if (h >= 'A' && h <= 'F')
+                                        cp |= h - 'A' + 10;
+                                    else { okh = false; break; }
+                                }
+                                if (!okh) { vbad = true; break; }
+                                i += 4;
+                                if (cp >= 0xD800 && cp <= 0xDBFF) {
+                                    if (i + 6 <= e && body[i] == '\\' &&
+                                        body[i + 1] == 'u') {
+                                        uint32_t lo2 = 0;
+                                        bool ok2 = true;
+                                        for (int k = 0; k < 4; k++) {
+                                            uint8_t h = body[i + 2 + k];
+                                            lo2 <<= 4;
+                                            if (h >= '0' && h <= '9')
+                                                lo2 |= h - '0';
+                                            else if (h >= 'a' &&
+                                                     h <= 'f')
+                                                lo2 |= h - 'a' + 10;
+                                            else if (h >= 'A' &&
+                                                     h <= 'F')
+                                                lo2 |= h - 'A' + 10;
+                                            else { ok2 = false; break; }
+                                        }
+                                        if (!ok2 || lo2 < 0xDC00 ||
+                                            lo2 > 0xDFFF) {
+                                            vbad = true; break;
+                                        }
+                                        i += 6;
+                                        cp = 0x10000 +
+                                             ((cp - 0xD800) << 10) +
+                                             (lo2 - 0xDC00);
+                                    } else { vbad = true; break; }
+                                } else if (cp >= 0xDC00 &&
+                                           cp <= 0xDFFF) {
+                                    vbad = true; break;
+                                }
+                                if (cp < 0x80) {
+                                    arena[ap++] = (uint8_t)cp;
+                                } else if (cp < 0x800) {
+                                    arena[ap++] = 0xC0 | (cp >> 6);
+                                    arena[ap++] = 0x80 | (cp & 0x3F);
+                                    ascii = 0;
+                                } else if (cp < 0x10000) {
+                                    arena[ap++] = 0xE0 | (cp >> 12);
+                                    arena[ap++] =
+                                        0x80 | ((cp >> 6) & 0x3F);
+                                    arena[ap++] = 0x80 | (cp & 0x3F);
+                                    ascii = 0;
+                                } else {
+                                    arena[ap++] = 0xF0 | (cp >> 18);
+                                    arena[ap++] =
+                                        0x80 | ((cp >> 12) & 0x3F);
+                                    arena[ap++] =
+                                        0x80 | ((cp >> 6) & 0x3F);
+                                    arena[ap++] = 0x80 | (cp & 0x3F);
+                                    ascii = 0;
+                                }
+                                break;
+                            }
+                            default: vbad = true; break;
+                        }
+                        if (vbad) break;
+                    } else {
+                        if (vc < 0x20) { vbad = true; break; }
+                        if (vc >= 0x80) ascii = 0;
+                        arena[ap++] = vc;
+                        i++;
+                    }
+                }
+                if (vbad || i >= e || body[i] != '"') {
+                    fall = true; break;
+                }
+                i++;
+                vlen = ap - vo;
+                kind = 0;
+            } else if (c == 't') {
+                if (e - i < 4 || memcmp(body + i, "true", 4) != 0) {
+                    fall = true; break;
+                }
+                i += 4; kind = 3;
+            } else if (c == 'f') {
+                if (e - i < 5 || memcmp(body + i, "false", 5) != 0) {
+                    fall = true; break;
+                }
+                i += 5; kind = 4;
+            } else if (c == '-' || (c >= '0' && c <= '9')) {
+                // strict JSON number
+                int64_t ns = i;
+                bool neg = false, isflt = false, ok = true;
+                if (c == '-') { neg = true; i++; }
+                if (i >= e || body[i] < '0' || body[i] > '9') ok = false;
+                else if (body[i] == '0') { i++; }
+                else { while (i < e && body[i] >= '0' && body[i] <= '9') i++; }
+                if (ok && i < e && body[i] == '.') {
+                    isflt = true; i++;
+                    if (i >= e || body[i] < '0' || body[i] > '9')
+                        ok = false;
+                    while (i < e && body[i] >= '0' && body[i] <= '9') i++;
+                }
+                if (ok && i < e && (body[i] == 'e' || body[i] == 'E')) {
+                    isflt = true; i++;
+                    if (i < e && (body[i] == '+' || body[i] == '-')) i++;
+                    if (i >= e || body[i] < '0' || body[i] > '9')
+                        ok = false;
+                    while (i < e && body[i] >= '0' && body[i] <= '9') i++;
+                }
+                if (!ok) { fall = true; break; }
+                vlen = i - ns;
+                if (!isflt && neg && i - ns == 2 && body[ns + 1] == '0') {
+                    // JSON "-0": json.loads -> int 0 -> dumps -> "0"
+                    arena[ap++] = '0';
+                    vlen = 1;
+                } else {
+                    std::memcpy(arena + ap, body + ns, (size_t)vlen);
+                    ap += vlen;
+                }
+                kind = isflt ? 2 : 1;
+            } else {
+                fall = true; break;   // null / object / array / other
+            }
+            if (nf >= fields_cap) return -1;
+            int32_t* F = fields + nf * 5;
+            F[0] = (int32_t)ko; F[1] = (int32_t)klen;
+            F[2] = (int32_t)vo; F[3] = (int32_t)vlen; F[4] = kind;
+            nf++;
+            // schema signature: xxh64 chained over (klen, key bytes)
+            sig = xxh64(arena + ko, (size_t)klen, sig ^ (uint64_t)klen);
+            while (i < e && js_ws(body[i])) i++;
+            if (i < e && body[i] == ',') { i++; continue; }
+            if (i < e && body[i] == '}') {
+                i++;
+                while (i < e && js_ws(body[i])) i++;
+                if (i != e) fall = true;
+                break;
+            }
+            fall = true; break;
+        }
+        int64_t cnt = nf - line_fields;
+        if (!fall) {
+            // duplicate keys -> Python dict keeps the LAST value; fall back
+            for (int64_t a = line_fields; a < nf && !fall; a++) {
+                for (int64_t b = a + 1; b < nf; b++) {
+                    if (fields[a * 5 + 1] == fields[b * 5 + 1] &&
+                        memcmp(arena + fields[a * 5],
+                               arena + fields[b * 5],
+                               (size_t)fields[a * 5 + 1]) == 0) {
+                        fall = true; break;
+                    }
+                }
+            }
+        }
+        if (fall) {
+            nf = line_fields;          // discard partial fields
+            L[2] = 1;
+            continue;
+        }
+        L[1] = (int32_t)cnt;
+        sigs[nl - 1] = (int64_t)sig;
+        (void)arena_cap;
+    }
+    counts[0] = nl; counts[1] = nf; counts[2] = ap; counts[3] = ascii;
+    return 0;
+}
+
 }  // extern "C"

@@ -9,8 +9,12 @@ TCP/UDP listeners).  All parsers return the number of ingested rows.
 from __future__ import annotations
 
 import json
+import time as _time
 
+from ..storage.log_rows import (LogColumns, StreamID,
+                                canonical_stream_tags)
 from ..utils import protobuf as pb
+from ..utils.hashing import stream_id_hash
 from ..utils.snappy import SnappyError, decompress as snappy_decompress
 from .insertutil import CommonParams, LogMessageProcessor, parse_timestamp
 
@@ -126,104 +130,313 @@ class _SchemaPlan:
 _FAST_CHUNK_ROWS = 200_000
 
 
+class _FastState:
+    """Shared accumulation state for the fast jsonline path (columnar
+    batch + per-request plan/stream/timestamp caches)."""
+
+    __slots__ = ("cp", "lmp", "lc", "plans", "scache", "tcache", "n")
+
+    def __init__(self, cp: CommonParams, lmp: LogMessageProcessor):
+        self.cp = cp
+        self.lmp = lmp
+        self.lc = LogColumns()
+        self.plans: dict = {}
+        self.scache: dict = {}
+        self.tcache: dict = {}
+        self.n = 0
+
+
+def _fast_fallback_obj(st: _FastState, obj: dict) -> None:
+    """Per-row path for rows the columnar form can't express (nested
+    objects, arrays, nulls).  Flushes accumulated columnar rows FIRST so
+    arrival order is preserved around the fallback row."""
+    if st.lc.nrows:
+        st.lmp.ingest_columns(st.lc)
+        st.lc = LogColumns()
+    fields = _fields_from_json_obj(obj)
+    ts, fields = _pop_time(st.cp, fields)
+    fields = _rename_msg(st.cp, fields)
+    st.lmp.add_row(ts, fields)
+    st.n += 1
+
+
+def _fast_add(st: _FastState, plan: _SchemaPlan, vals: list) -> None:
+    """One stringified row -> the columnar batch.  vals holds ALL values
+    in raw key order, already stringified exactly like the per-row path
+    (numbers via json.dumps, bools as true/false)."""
+    # the STRINGIFIED time value, exactly what _pop_time would parse on
+    # the per-row path (bools become "true" -> None -> now)
+    tval = vals[plan.time_idx] if plan.time_idx >= 0 else ""
+    if tval:
+        ts = st.tcache.get(tval)
+        if ts is None:
+            ts = parse_timestamp(tval)
+            if ts is not None and len(st.tcache) < 65536:
+                st.tcache[tval] = ts
+    else:
+        ts = None
+    if ts is None:
+        ts = _time.time_ns()
+    out_vals = [vals[i] for i in plan.val_idx]
+    if plan.msg_default:
+        out_vals.append(st.cp.default_msg_value)
+    skey = (plan.stream_names,
+            tuple(out_vals[p] for p in plan.stream_pos))
+    info = st.scache.get(skey)
+    if info is None:
+        pairs = [(plan.names[p], out_vals[p]) for p in plan.stream_pos]
+        tags = canonical_stream_tags(pairs)
+        hi, lo = stream_id_hash(tags.encode("utf-8"))
+        info = st.scache[skey] = (StreamID(st.cp.tenant, hi, lo), tags)
+    lc = st.lc
+    g = lc.group(plan.names, plan.stream_pos)
+    lc.add(g, st.cp.tenant, ts, out_vals, info[0], info[1])
+    st.n += 1
+    if lc.nrows >= _FAST_CHUNK_ROWS:
+        st.lmp.ingest_columns(lc)
+        st.lc = LogColumns()
+
+
+def _scan_chunk_py(st: _FastState, text: str) -> None:
+    """Python-parser chunk scan (no native lib, or native declined)."""
+    for line in text.split("\n"):
+        line = line.strip()
+        if line:
+            _ingest_line(st, line)
+
+
+def _ingest_line(st: _FastState, line) -> None:
+    """Parse one JSON line with json.loads and ingest it: scalar rows
+    stringify into the columnar batch, rows the columnar form can't
+    express (nested objects, arrays, nulls) take the per-row fallback.
+    Shared by the no-native chunk scan and the native scanner's flagged
+    lines, so semantics and error behavior have exactly one home."""
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"cannot parse JSON line: {e}") from None
+    if not isinstance(obj, dict):
+        raise IngestError("JSON line must be an object")
+    vals = list(obj.values())
+    ok = True
+    for p, v in enumerate(vals):
+        t = type(v)
+        if t is str:
+            continue
+        if t is bool:
+            vals[p] = "true" if v else "false"
+        elif t is int or t is float:
+            vals[p] = json.dumps(v)
+        else:
+            ok = False    # nested object / array / null
+            break
+    if not ok:
+        _fast_fallback_obj(st, obj)
+        return
+    keys = tuple(obj.keys())
+    plan = st.plans.get(keys)
+    if plan is None:
+        plan = st.plans[keys] = _SchemaPlan(st.cp, keys)
+    _fast_add(st, plan, vals)
+
+
+_U32 = 1 << 32
+
+
+def _vector_ts(tvals: list) -> list | None:
+    """Vectorized unix-number timestamp parse for a whole column: exact
+    parse_timestamp() int semantics (unit inference by magnitude) when
+    every value is an int64 decimal; None -> caller parses per row."""
+    import numpy as np
+    try:
+        ints = np.array(tvals, dtype=np.int64)
+    except (ValueError, OverflowError):
+        return None
+    if (ints == 0).any():
+        return None          # 0 means "now": per-row path handles it
+    ns = np.where(
+        ints < _U32, ints * 1_000_000_000,
+        np.where(ints < _U32 * 1_000, ints * 1_000_000,
+                 np.where(ints < _U32 * 1_000_000, ints * 1_000, ints)))
+    return ns.tolist()
+
+
+def _scan_chunk_native(st: _FastState, chunk: bytes, scan) -> None:
+    """Consume one native vl_jsonline_scan result COLUMN-WISE: contiguous
+    runs of non-flagged lines are grouped by schema signature, each
+    group's columns materialize as one tight slice loop over the arena,
+    timestamps parse vectorized, and the rows land via LogColumns.add_bulk
+    — per-row Python work is a few list operations.  Flagged lines
+    (nested values, nulls, duplicate keys, malformed JSON, lone
+    surrogates) re-parse with json.loads in arrival order, so every
+    divergence case keeps the exact semantics and error behavior of the
+    per-row path."""
+    import numpy as np
+    arena, fields, lines, sigs, is_ascii = scan
+    arena_s = arena.decode("utf-8") if is_ascii else None
+    vo_np = fields[:, 2]
+    ve_np = vo_np + fields[:, 3]
+    kd_np = fields[:, 4]
+    ko_np = fields[:, 0]
+    ke_np = ko_np + fields[:, 1]
+    fs_np = lines[:, 0]
+    fl_np = lines[:, 2]
+    M = lines.shape[0]
+    dumps = json.dumps
+
+    def col_values(fseg: "np.ndarray", jraw: int) -> list:
+        idx = fseg + jraw
+        kds = kd_np[idx]
+        vos = vo_np[idx].tolist()
+        ves = ve_np[idx].tolist()
+        if int(kds.max(initial=0)) <= 1:     # strings / exact-int raw
+            if arena_s is not None:
+                return [arena_s[o:e] for o, e in zip(vos, ves)]
+            return [arena[o:e].decode("utf-8")
+                    for o, e in zip(vos, ves)]
+        out = []
+        for o, e, k in zip(vos, ves, kds.tolist()):
+            if k <= 1:
+                out.append(arena_s[o:e] if arena_s is not None
+                           else arena[o:e].decode("utf-8"))
+            elif k == 2:
+                out.append(dumps(float(
+                    arena_s[o:e] if arena_s is not None
+                    else arena[o:e].decode("utf-8"))))
+            elif k == 3:
+                out.append("true")
+            else:
+                out.append("false")
+        return out
+
+    def segment(a: int, b: int) -> None:
+        seg_sigs = sigs[a:b]
+        seg_fs = fs_np[a:b]
+        for sig in np.unique(seg_sigs):
+            rows = np.nonzero(seg_sigs == sig)[0]
+            fseg = seg_fs[rows]
+            li0 = a + int(rows[0])
+            nfl = int(lines[li0, 1])
+            pkey = (nfl, int(sig))
+            plan = st.plans.get(pkey)
+            if plan is None:
+                f0 = int(fs_np[li0])
+                if arena_s is not None:
+                    keys = tuple(arena_s[int(ko_np[f0 + j]):
+                                         int(ke_np[f0 + j])]
+                                 for j in range(nfl))
+                else:
+                    keys = tuple(
+                        arena[int(ko_np[f0 + j]):
+                              int(ke_np[f0 + j])].decode("utf-8")
+                        for j in range(nfl))
+                plan = st.plans[pkey] = _SchemaPlan(st.cp, keys)
+            n = rows.shape[0]
+            # output columns in plan order
+            out_cols = [col_values(fseg, j) for j in plan.val_idx]
+            if plan.msg_default:
+                out_cols.append([st.cp.default_msg_value] * n)
+            # timestamps
+            if plan.time_idx >= 0:
+                tvals = col_values(fseg, plan.time_idx)
+                ts_list = _vector_ts(tvals)
+                if ts_list is None:
+                    tc = st.tcache
+                    ts_list = []
+                    ap = ts_list.append
+                    for tv in tvals:
+                        if tv:
+                            ts = tc.get(tv)
+                            if ts is None:
+                                ts = parse_timestamp(tv)
+                                if ts is not None and len(tc) < 65536:
+                                    tc[tv] = ts
+                        else:
+                            ts = None
+                        ap(ts if ts is not None else _time.time_ns())
+            else:
+                tns = _time.time_ns
+                ts_list = [tns() for _ in range(n)]
+            # stream identity per row
+            scache = st.scache
+            snames = plan.stream_names
+            if plan.stream_pos:
+                scols = [out_cols[p] for p in plan.stream_pos]
+                sids = []
+                tagsl = []
+                for skv in zip(*scols):
+                    info = scache.get((snames, skv))
+                    if info is None:
+                        pairs = list(zip(snames, skv))
+                        tags = canonical_stream_tags(pairs)
+                        hi, lo = stream_id_hash(tags.encode("utf-8"))
+                        info = scache[(snames, skv)] = \
+                            (StreamID(st.cp.tenant, hi, lo), tags)
+                    sids.append(info[0])
+                    tagsl.append(info[1])
+            else:
+                info = scache.get((snames, ()))
+                if info is None:
+                    tags = canonical_stream_tags([])
+                    hi, lo = stream_id_hash(tags.encode("utf-8"))
+                    info = scache[(snames, ())] = \
+                        (StreamID(st.cp.tenant, hi, lo), tags)
+                sids = [info[0]] * n
+                tagsl = [info[1]] * n
+            lc = st.lc
+            g = lc.group(plan.names, plan.stream_pos)
+            lc.add_bulk(g, st.cp.tenant, ts_list, out_cols, sids, tagsl)
+            st.n += n
+            if lc.nrows >= _FAST_CHUNK_ROWS:
+                st.lmp.ingest_columns(lc)
+                st.lc = LogColumns()
+
+    fb = np.nonzero(fl_np)[0].tolist()
+    seg_start = 0
+    for stop in fb + [M]:
+        if stop > seg_start:
+            segment(seg_start, stop)
+        if stop < M:
+            ro, rl = int(lines[stop, 3]), int(lines[stop, 4])
+            _ingest_line(st, chunk[ro:ro + rl])
+        seg_start = stop + 1
+
+
+_NATIVE_CHUNK = 4 << 20   # scan buffer bound (fields/lines arrays)
+
+
 def _jsonline_fast(cp: CommonParams, body: bytes,
                    lmp: LogMessageProcessor) -> int:
-    """Bulk columnar jsonline ingestion (the hot path: ~4x the per-row
-    pipeline).  Rows whose values need flattening (nested objects,
-    arrays, nulls) fall back to the per-row path; everything else goes
-    straight into a LogColumns batch."""
-    from ..storage.log_rows import (LogColumns, StreamID,
-                                    canonical_stream_tags)
-    from ..utils.hashing import stream_id_hash
-    import time as _time
-
-    loads = json.loads
-    default_msg = cp.default_msg_value
-    lc = LogColumns()
-    plans: dict = {}
-    scache: dict = {}
-    tcache: dict = {}
-    tenant = cp.tenant
-    n = 0
+    """Bulk columnar jsonline ingestion: the native strict-subset
+    scanner (vl_jsonline_scan) tokenizes newline-aligned chunks into
+    key/value spans over an unescape arena; rows map through per-schema
+    plans straight into LogColumns batches.  Rows the columnar form
+    can't express fall back to the per-row path line by line."""
+    from .. import native
     try:
-        # one decode for the whole body: json.loads(bytes) would redo
-        # encoding detection per line
-        text = body.decode("utf-8")
+        # upfront validation for the whole body, exactly like the
+        # per-line path's decode (errors must fire BEFORE any ingestion)
+        body.decode("utf-8")
     except UnicodeDecodeError as e:
         raise IngestError(f"request body is not valid UTF-8: {e}") \
             from None
-    for line in text.split("\n"):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            obj = loads(line)
-        except json.JSONDecodeError as e:
-            raise IngestError(f"cannot parse JSON line: {e}") from None
-        if not isinstance(obj, dict):
-            raise IngestError("JSON line must be an object")
-        keys = tuple(obj.keys())
-        plan = plans.get(keys)
-        if plan is None:
-            plan = plans[keys] = _SchemaPlan(cp, keys)
-        vals = list(obj.values())
-        ok = True
-        for p, v in enumerate(vals):
-            t = type(v)
-            if t is str:
-                continue
-            if t is bool:
-                vals[p] = "true" if v else "false"
-            elif t is int or t is float:
-                vals[p] = json.dumps(v)
-            else:
-                ok = False    # nested object / array / null
-                break
-        if not ok:
-            # flush accumulated columnar rows FIRST so arrival order is
-            # preserved around the fallback row
-            if lc.nrows:
-                lmp.ingest_columns(lc)
-                lc = LogColumns()
-            fields = _fields_from_json_obj(obj)
-            ts, fields = _pop_time(cp, fields)
-            fields = _rename_msg(cp, fields)
-            lmp.add_row(ts, fields)
-            n += 1
-            continue
-        # the STRINGIFIED time value, exactly what _pop_time would parse
-        # on the per-row path (bools become "true" -> None -> now)
-        tval = vals[plan.time_idx] if plan.time_idx >= 0 else ""
-        if tval:
-            ts = tcache.get(tval)
-            if ts is None:
-                ts = parse_timestamp(tval)
-                if ts is not None and len(tcache) < 65536:
-                    tcache[tval] = ts
+    st = _FastState(cp, lmp)
+    pos = 0
+    blen = len(body)
+    while pos < blen:
+        end = min(pos + _NATIVE_CHUNK, blen)
+        if end < blen:
+            nl = body.rfind(b"\n", pos, end)
+            end = nl + 1 if nl > pos else blen
+        chunk = body[pos:end]
+        pos = end
+        scan = native.jsonline_scan_native(chunk)
+        if scan is None:
+            _scan_chunk_py(st, chunk.decode("utf-8"))
         else:
-            ts = None
-        if ts is None:
-            ts = _time.time_ns()
-        out_vals = [vals[i] for i in plan.val_idx]
-        if plan.msg_default:
-            out_vals.append(default_msg)
-        skey = (plan.stream_names,
-                tuple(out_vals[p] for p in plan.stream_pos))
-        info = scache.get(skey)
-        if info is None:
-            pairs = [(plan.names[p], out_vals[p])
-                     for p in plan.stream_pos]
-            tags = canonical_stream_tags(pairs)
-            hi, lo = stream_id_hash(tags.encode("utf-8"))
-            info = scache[skey] = (StreamID(tenant, hi, lo), tags)
-        g = lc.group(plan.names, plan.stream_pos)
-        lc.add(g, tenant, ts, out_vals, info[0], info[1])
-        n += 1
-        if lc.nrows >= _FAST_CHUNK_ROWS:
-            lmp.ingest_columns(lc)
-            lc = LogColumns()
-    lmp.ingest_columns(lc)
-    return n
+            _scan_chunk_native(st, chunk, scan)
+    lmp.ingest_columns(st.lc)
+    return st.n
 
 
 def handle_jsonline(cp: CommonParams, body: bytes,

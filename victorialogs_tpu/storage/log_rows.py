@@ -213,6 +213,29 @@ class LogColumns:
             col.append(v)
         self.nrows += 1
 
+    def add_bulk(self, g: "_ColGroup", tenant: TenantID, ts_list: list,
+                 col_lists: list, sid_list: list, tags_list: list) -> None:
+        """Append many rows of ONE schema at once: per-column extends
+        instead of per-row appends (the native-scanner ingest path)."""
+        sidx = g.stream_idx
+        streams = g.streams
+        stags = self.stream_tags
+        srefs = []
+        ap = srefs.append
+        for sid, tags in zip(sid_list, tags_list):
+            si = sidx.get(sid)
+            if si is None:
+                si = sidx[sid] = len(streams)
+                streams.append((sid, tenant, tags))
+                if sid not in stags:
+                    stags[sid] = tags
+            ap(si)
+        g.ts.extend(ts_list)
+        g.sref.extend(srefs)
+        for col, vals in zip(g.cols, col_lists):
+            col.extend(vals)
+        self.nrows += len(ts_list)
+
     def unique_streams(self) -> list:
         return list(self.stream_tags.items())
 
@@ -330,6 +353,10 @@ class LogColumns:
                     np.array([r[1] for r in run], dtype=np.int64),
                     [r[2] for r in run], stream_tags_str=run[0][3]))
                 i = j
+        # global (stream_id, min_ts) order across schema groups: the
+        # flush merger's k-way heap requires each part's block list
+        # sorted this way (datadb.merge_block_streams input invariant)
+        out.sort(key=lambda b: (b.stream_id, int(b.timestamps[0])))
         return out
 
 
