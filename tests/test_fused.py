@@ -105,6 +105,14 @@ FUSED_QUERIES = [
     # empty-ish matches
     'nosuchliteral42 | stats count() c',
     '_msg:"" | stats count() c',
+    # sum_len/count_empty: derived uint32 columns through the standard
+    # sum partials (code points, not bytes — the GÉT/⏱ rows check that)
+    '* | stats sum_len(_msg) s, count_empty(_msg) e',
+    '"deadline exceeded" | stats by (app) sum_len(_msg) s, count() c',
+    '* | stats by (_time:10m) count_empty(lvl) e, sum_len(lvl) s',
+    'NOT "ok" | stats sum_len(dur) s',         # int column digit count
+    '* | stats count_empty(nosuchfield) e, sum_len(nosuchfield) s',
+    'dur:>100 | stats by (app) count_empty(app) e, sum_len(app) s',
     # case-insensitive phrase/prefix: ASCII byte fold on device, rows
     # with multibyte bytes settled by the host residue
     'i("DEADLINE Exceeded") | stats count() c',

@@ -110,7 +110,9 @@ def test_random_stats_parity(storage):
     runner = BatchRunner()
     funcs = ["count() c", "sum(num) s", "min(num) mn", "max(num) mx",
              "avg(num) a", "count(num) cn", "count_uniq(app) u",
-             "count_uniq(_stream_id) usid", "count_uniq(_msg) um"]
+             "count_uniq(_stream_id) usid", "count_uniq(_msg) um",
+             "sum_len(_msg) sl", "sum_len(num) sln",
+             "count_empty(_msg) ce", "count_empty(app) ca"]
     bys = ["", "by (app) ", "by (_time:7m) ", "by (app, _time:13m) ",
            "by (_time:5m offset 90s) ", "by (app, missingf) ",
            "by (num:40) ", "by (num:25 offset 3, app) ",

@@ -231,8 +231,8 @@ def jsonline_scan_native(body: bytes):
     native lib is unavailable or a capacity bound trips (caller uses the
     per-line Python parser)."""
     lib = _load()
-    if lib is None or not body:
-        return None
+    if lib is None or not body or len(body) >= (1 << 31) - 8:
+        return None    # offsets are int32; huge bodies take the py path
     blen = len(body)
     buf = np.frombuffer(body, dtype=np.uint8)
     arena = np.empty(blen, dtype=np.uint8)

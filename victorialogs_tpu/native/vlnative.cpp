@@ -319,6 +319,98 @@ static inline bool js_ws(uint8_t c) {
     return c == ' ' || c == '\t' || c == '\r';
 }
 
+// Unescape one JSON string: body[*pi] is the first char AFTER the
+// opening quote; on success *pi points at the closing quote, the
+// unescaped bytes are appended at *app, and *ascii drops to 0 when any
+// non-ASCII byte lands in the arena.  Returns false on any invalid
+// escape, control char, lone surrogate, or missing close quote —
+// the caller falls back to the Python parser for the line.
+static bool js_unescape(const uint8_t* body, int64_t* pi, int64_t e,
+                        uint8_t* arena, int64_t* app, int64_t* ascii) {
+    int64_t i = *pi, ap = *app;
+    while (i < e) {
+        uint8_t c = body[i];
+        if (c == '"') {
+            *pi = i;
+            *app = ap;
+            return true;
+        }
+        if (c != '\\') {
+            if (c < 0x20) return false;
+            if (c >= 0x80) *ascii = 0;
+            arena[ap++] = c;
+            i++;
+            continue;
+        }
+        if (i + 1 >= e) return false;
+        uint8_t n = body[i + 1];
+        i += 2;
+        switch (n) {
+            case '"': arena[ap++] = '"'; break;
+            case '\\': arena[ap++] = '\\'; break;
+            case '/': arena[ap++] = '/'; break;
+            case 'b': arena[ap++] = '\b'; break;
+            case 'f': arena[ap++] = '\f'; break;
+            case 'n': arena[ap++] = '\n'; break;
+            case 'r': arena[ap++] = '\r'; break;
+            case 't': arena[ap++] = '\t'; break;
+            case 'u': {
+                if (i + 4 > e) return false;
+                uint32_t cp = 0;
+                for (int k = 0; k < 4; k++) {
+                    uint8_t h = body[i + k];
+                    cp <<= 4;
+                    if (h >= '0' && h <= '9') cp |= h - '0';
+                    else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                    else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                    else return false;
+                }
+                i += 4;
+                if (cp >= 0xD800 && cp <= 0xDBFF) {
+                    // high surrogate: require the low half
+                    if (i + 6 > e || body[i] != '\\' || body[i + 1] != 'u')
+                        return false;
+                    uint32_t lo = 0;
+                    for (int k = 0; k < 4; k++) {
+                        uint8_t h = body[i + 2 + k];
+                        lo <<= 4;
+                        if (h >= '0' && h <= '9') lo |= h - '0';
+                        else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                        else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                        else return false;
+                    }
+                    if (lo < 0xDC00 || lo > 0xDFFF) return false;
+                    i += 6;
+                    cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+                    return false;  // lone low surrogate
+                }
+                if (cp < 0x80) {
+                    arena[ap++] = (uint8_t)cp;
+                } else if (cp < 0x800) {
+                    arena[ap++] = 0xC0 | (cp >> 6);
+                    arena[ap++] = 0x80 | (cp & 0x3F);
+                    *ascii = 0;
+                } else if (cp < 0x10000) {
+                    arena[ap++] = 0xE0 | (cp >> 12);
+                    arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
+                    arena[ap++] = 0x80 | (cp & 0x3F);
+                    *ascii = 0;
+                } else {
+                    arena[ap++] = 0xF0 | (cp >> 18);
+                    arena[ap++] = 0x80 | ((cp >> 12) & 0x3F);
+                    arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
+                    arena[ap++] = 0x80 | (cp & 0x3F);
+                    *ascii = 0;
+                }
+                break;
+            }
+            default: return false;
+        }
+    }
+    return false;  // no closing quote before end of line
+}
+
 extern "C" int64_t vl_jsonline_scan(
         const uint8_t* body, int64_t body_len,
         uint8_t* arena, int64_t arena_cap,
@@ -334,7 +426,7 @@ extern "C" int64_t vl_jsonline_scan(
         int64_t s = pos, e = eol;
         pos = eol + 1;
         while (s < e && (js_ws(body[s]) || body[s] == '\n')) s++;
-        while (e > s && (js_ws(body[e - 1]))) e--;
+        while (e > s && js_ws(body[e - 1])) e--;
         if (s >= e) continue;          // blank line
         if (nl >= lines_cap) return -1;
         int32_t* L = lines + nl * 5;
@@ -345,232 +437,40 @@ extern "C" int64_t vl_jsonline_scan(
         L[4] = (int32_t)(e - s);
         sigs[nl] = 0;
         nl++;
-        // strict-subset parse; any trouble -> fallback flag
         int64_t i = s;
         bool fall = false;
         int64_t line_fields = nf;
-        uint64_t sig = 1469598103934665603ULL;  // fnv offset (seed only)
+        uint64_t sig = 1469598103934665603ULL;  // seed only
         if (body[i] != '{') { L[2] = 1; continue; }
         i++;
         while (i < e && js_ws(body[i])) i++;
         if (i < e && body[i] == '}') {
-            // empty object
             i++;
             while (i < e && js_ws(body[i])) i++;
             if (i != e) L[2] = 1;
-            else L[1] = 0;
-            continue;
+            continue;                  // empty object: zero fields
         }
         for (;;) {
             while (i < e && js_ws(body[i])) i++;
             if (i >= e || body[i] != '"') { fall = true; break; }
-            // key string
             int64_t ko = ap;
             i++;
-            bool bad = false;
-            while (i < e) {
-                uint8_t c = body[i];
-                if (c == '"') break;
-                if (c == '\\') {
-                    if (i + 1 >= e) { bad = true; break; }
-                    uint8_t n = body[i + 1];
-                    i += 2;
-                    switch (n) {
-                        case '"': arena[ap++] = '"'; break;
-                        case '\\': arena[ap++] = '\\'; break;
-                        case '/': arena[ap++] = '/'; break;
-                        case 'b': arena[ap++] = '\b'; break;
-                        case 'f': arena[ap++] = '\f'; break;
-                        case 'n': arena[ap++] = '\n'; break;
-                        case 'r': arena[ap++] = '\r'; break;
-                        case 't': arena[ap++] = '\t'; break;
-                        case 'u': {
-                            if (i + 4 > e) { bad = true; break; }
-                            uint32_t cp = 0;
-                            for (int k = 0; k < 4; k++) {
-                                uint8_t h = body[i + k];
-                                cp <<= 4;
-                                if (h >= '0' && h <= '9') cp |= h - '0';
-                                else if (h >= 'a' && h <= 'f')
-                                    cp |= h - 'a' + 10;
-                                else if (h >= 'A' && h <= 'F')
-                                    cp |= h - 'A' + 10;
-                                else { bad = true; break; }
-                            }
-                            if (bad) break;
-                            i += 4;
-                            if (cp >= 0xD800 && cp <= 0xDBFF) {
-                                // surrogate pair
-                                if (i + 6 <= e && body[i] == '\\' &&
-                                    body[i + 1] == 'u') {
-                                    uint32_t lo2 = 0;
-                                    bool ok2 = true;
-                                    for (int k = 0; k < 4; k++) {
-                                        uint8_t h = body[i + 2 + k];
-                                        lo2 <<= 4;
-                                        if (h >= '0' && h <= '9')
-                                            lo2 |= h - '0';
-                                        else if (h >= 'a' && h <= 'f')
-                                            lo2 |= h - 'a' + 10;
-                                        else if (h >= 'A' && h <= 'F')
-                                            lo2 |= h - 'A' + 10;
-                                        else { ok2 = false; break; }
-                                    }
-                                    if (!ok2 || lo2 < 0xDC00 ||
-                                        lo2 > 0xDFFF) { bad = true; break; }
-                                    i += 6;
-                                    cp = 0x10000 +
-                                         ((cp - 0xD800) << 10) +
-                                         (lo2 - 0xDC00);
-                                } else { bad = true; break; }
-                            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
-                                bad = true; break;  // lone low surrogate
-                            }
-                            if (cp < 0x80) {
-                                arena[ap++] = (uint8_t)cp;
-                            } else if (cp < 0x800) {
-                                arena[ap++] = 0xC0 | (cp >> 6);
-                                arena[ap++] = 0x80 | (cp & 0x3F);
-                                ascii = 0;
-                            } else if (cp < 0x10000) {
-                                arena[ap++] = 0xE0 | (cp >> 12);
-                                arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
-                                arena[ap++] = 0x80 | (cp & 0x3F);
-                                ascii = 0;
-                            } else {
-                                arena[ap++] = 0xF0 | (cp >> 18);
-                                arena[ap++] = 0x80 | ((cp >> 12) & 0x3F);
-                                arena[ap++] = 0x80 | ((cp >> 6) & 0x3F);
-                                arena[ap++] = 0x80 | (cp & 0x3F);
-                                ascii = 0;
-                            }
-                            break;
-                        }
-                        default: bad = true; break;
-                    }
-                    if (bad) break;
-                } else {
-                    if (c < 0x20) { bad = true; break; }
-                    if (c >= 0x80) ascii = 0;
-                    arena[ap++] = c;
-                    i++;
-                }
+            if (!js_unescape(body, &i, e, arena, &ap, &ascii)) {
+                fall = true; break;
             }
-            if (bad || i >= e || body[i] != '"') { fall = true; break; }
-            i++;
+            i++;                       // past the closing quote
             int64_t klen = ap - ko;
             while (i < e && js_ws(body[i])) i++;
             if (i >= e || body[i] != ':') { fall = true; break; }
             i++;
             while (i < e && js_ws(body[i])) i++;
             if (i >= e) { fall = true; break; }
-            // value
             int64_t vo = ap, vlen = 0;
             int32_t kind;
             uint8_t c = body[i];
             if (c == '"') {
-                // string value: same unescape loop (shared via goto-less
-                // duplication kept simple: call a lambda)
                 i++;
-                bool vbad = false;
-                while (i < e) {
-                    uint8_t vc = body[i];
-                    if (vc == '"') break;
-                    if (vc == '\\') {
-                        if (i + 1 >= e) { vbad = true; break; }
-                        uint8_t n2 = body[i + 1];
-                        i += 2;
-                        switch (n2) {
-                            case '"': arena[ap++] = '"'; break;
-                            case '\\': arena[ap++] = '\\'; break;
-                            case '/': arena[ap++] = '/'; break;
-                            case 'b': arena[ap++] = '\b'; break;
-                            case 'f': arena[ap++] = '\f'; break;
-                            case 'n': arena[ap++] = '\n'; break;
-                            case 'r': arena[ap++] = '\r'; break;
-                            case 't': arena[ap++] = '\t'; break;
-                            case 'u': {
-                                if (i + 4 > e) { vbad = true; break; }
-                                uint32_t cp = 0;
-                                bool okh = true;
-                                for (int k = 0; k < 4; k++) {
-                                    uint8_t h = body[i + k];
-                                    cp <<= 4;
-                                    if (h >= '0' && h <= '9')
-                                        cp |= h - '0';
-                                    else if (h >= 'a' && h <= 'f')
-                                        cp |= h - 'a' + 10;
-                                    else if (h >= 'A' && h <= 'F')
-                                        cp |= h - 'A' + 10;
-                                    else { okh = false; break; }
-                                }
-                                if (!okh) { vbad = true; break; }
-                                i += 4;
-                                if (cp >= 0xD800 && cp <= 0xDBFF) {
-                                    if (i + 6 <= e && body[i] == '\\' &&
-                                        body[i + 1] == 'u') {
-                                        uint32_t lo2 = 0;
-                                        bool ok2 = true;
-                                        for (int k = 0; k < 4; k++) {
-                                            uint8_t h = body[i + 2 + k];
-                                            lo2 <<= 4;
-                                            if (h >= '0' && h <= '9')
-                                                lo2 |= h - '0';
-                                            else if (h >= 'a' &&
-                                                     h <= 'f')
-                                                lo2 |= h - 'a' + 10;
-                                            else if (h >= 'A' &&
-                                                     h <= 'F')
-                                                lo2 |= h - 'A' + 10;
-                                            else { ok2 = false; break; }
-                                        }
-                                        if (!ok2 || lo2 < 0xDC00 ||
-                                            lo2 > 0xDFFF) {
-                                            vbad = true; break;
-                                        }
-                                        i += 6;
-                                        cp = 0x10000 +
-                                             ((cp - 0xD800) << 10) +
-                                             (lo2 - 0xDC00);
-                                    } else { vbad = true; break; }
-                                } else if (cp >= 0xDC00 &&
-                                           cp <= 0xDFFF) {
-                                    vbad = true; break;
-                                }
-                                if (cp < 0x80) {
-                                    arena[ap++] = (uint8_t)cp;
-                                } else if (cp < 0x800) {
-                                    arena[ap++] = 0xC0 | (cp >> 6);
-                                    arena[ap++] = 0x80 | (cp & 0x3F);
-                                    ascii = 0;
-                                } else if (cp < 0x10000) {
-                                    arena[ap++] = 0xE0 | (cp >> 12);
-                                    arena[ap++] =
-                                        0x80 | ((cp >> 6) & 0x3F);
-                                    arena[ap++] = 0x80 | (cp & 0x3F);
-                                    ascii = 0;
-                                } else {
-                                    arena[ap++] = 0xF0 | (cp >> 18);
-                                    arena[ap++] =
-                                        0x80 | ((cp >> 12) & 0x3F);
-                                    arena[ap++] =
-                                        0x80 | ((cp >> 6) & 0x3F);
-                                    arena[ap++] = 0x80 | (cp & 0x3F);
-                                    ascii = 0;
-                                }
-                                break;
-                            }
-                            default: vbad = true; break;
-                        }
-                        if (vbad) break;
-                    } else {
-                        if (vc < 0x20) { vbad = true; break; }
-                        if (vc >= 0x80) ascii = 0;
-                        arena[ap++] = vc;
-                        i++;
-                    }
-                }
-                if (vbad || i >= e || body[i] != '"') {
+                if (!js_unescape(body, &i, e, arena, &ap, &ascii)) {
                     fall = true; break;
                 }
                 i++;
@@ -587,7 +487,7 @@ extern "C" int64_t vl_jsonline_scan(
                 }
                 i += 5; kind = 4;
             } else if (c == '-' || (c >= '0' && c <= '9')) {
-                // strict JSON number
+                // strict JSON number grammar
                 int64_t ns = i;
                 bool neg = false, isflt = false, ok = true;
                 if (c == '-') { neg = true; i++; }
@@ -619,7 +519,7 @@ extern "C" int64_t vl_jsonline_scan(
                 }
                 kind = isflt ? 2 : 1;
             } else {
-                fall = true; break;   // null / object / array / other
+                fall = true; break;    // null / object / array / other
             }
             if (nf >= fields_cap) return -1;
             int32_t* F = fields + nf * 5;
@@ -640,7 +540,7 @@ extern "C" int64_t vl_jsonline_scan(
         }
         int64_t cnt = nf - line_fields;
         if (!fall) {
-            // duplicate keys -> Python dict keeps the LAST value; fall back
+            // duplicate keys: Python dict keeps the LAST value; fall back
             for (int64_t a = line_fields; a < nf && !fall; a++) {
                 for (int64_t b = a + 1; b < nf; b++) {
                     if (fields[a * 5 + 1] == fields[b * 5 + 1] &&

@@ -200,7 +200,6 @@ def _fast_add(st: _FastState, plan: _SchemaPlan, vals: list) -> None:
 def _scan_chunk_py(st: _FastState, text: str) -> None:
     """Python-parser chunk scan (no native lib, or native declined)."""
     for line in text.split("\n"):
-        line = line.strip()
         if line:
             _ingest_line(st, line)
 
@@ -211,6 +210,9 @@ def _ingest_line(st: _FastState, line) -> None:
     express (nested objects, arrays, nulls) take the per-row fallback.
     Shared by the no-native chunk scan and the native scanner's flagged
     lines, so semantics and error behavior have exactly one home."""
+    line = line.strip()    # incl. \x0b/\x0c, which the C scanner's
+    if not line:           # space/tab/CR trim does not cover
+        return
     try:
         obj = json.loads(line)
     except json.JSONDecodeError as e:
@@ -312,12 +314,18 @@ def _scan_chunk_native(st: _FastState, chunk: bytes, scan) -> None:
     def segment(a: int, b: int) -> None:
         seg_sigs = sigs[a:b]
         seg_fs = fs_np[a:b]
-        for sig in np.unique(seg_sigs):
-            rows = np.nonzero(seg_sigs == sig)[0]
+        # one stable argsort groups schemas in O(M log M); within a
+        # group, line order is preserved (stable sort of equal keys)
+        order = np.argsort(seg_sigs, kind="stable")
+        ssorted = seg_sigs[order]
+        bounds = [0] + (np.nonzero(np.diff(ssorted))[0] + 1).tolist() \
+            + [order.shape[0]]
+        for gi in range(len(bounds) - 1):
+            rows = order[bounds[gi]:bounds[gi + 1]]
             fseg = seg_fs[rows]
             li0 = a + int(rows[0])
             nfl = int(lines[li0, 1])
-            pkey = (nfl, int(sig))
+            pkey = (nfl, int(ssorted[bounds[gi]]))
             plan = st.plans.get(pkey)
             if plan is None:
                 f0 = int(fs_np[li0])
@@ -416,11 +424,16 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
     try:
         # upfront validation for the whole body, exactly like the
         # per-line path's decode (errors must fire BEFORE any ingestion)
-        body.decode("utf-8")
+        text = body.decode("utf-8")
     except UnicodeDecodeError as e:
         raise IngestError(f"request body is not valid UTF-8: {e}") \
             from None
     st = _FastState(cp, lmp)
+    if not native.available():
+        _scan_chunk_py(st, text)     # one pass over the validated text
+        lmp.ingest_columns(st.lc)
+        return st.n
+    del text
     pos = 0
     blen = len(body)
     while pos < blen:

@@ -35,7 +35,7 @@ import numpy as np
 from ..engine.block_search import BlockSearch
 from ..logsql import filters as F
 from ..storage.bloom import bloom_contains_all
-from ..storage.values_encoder import VT_STRING
+from ..storage.values_encoder import VT_DICT, VT_STRING
 from ..utils.hashing import hash_tokens
 from . import kernels as K
 from .layout import StagingCache, row_width_bucket
@@ -543,6 +543,125 @@ def stage_numeric(part, field: str, layout: StatsLayout,
                          nbytes=layout.nrows_padded * 4)
 
 
+def stage_len_column(part, field: str, layout: StatsLayout,
+                     max_abs_times_rows: int, put=None
+                     ) -> StagedNumeric | None:
+    """Per-row CODE-POINT length of `field` as a synthetic uint32 value
+    column — the device carrier for `sum_len(field)` partials (the sum
+    plane of the standard stats kernel IS the total length; host
+    semantics: Python len(value)).  Eligible block kinds: string (bytes
+    minus UTF-8 continuation bytes via prefix sums), dict, const,
+    missing, and int-typed (canonical decimal digit count); float/ipv4/
+    ts-typed blocks decline to the host path."""
+    import jax.numpy as jnp
+    if put is None:
+        put = jnp.asarray
+    virtual = field in ("_stream", "_stream_id")
+    vals = np.zeros(layout.nrows_padded, dtype=np.uint32)
+    eligible = []
+    vmax = 0
+    i64min = np.iinfo(np.int64).min
+    for bi in range(part.num_blocks):
+        start = layout.starts[bi]
+        n = part.block_rows(bi)
+        if virtual:
+            v = part.block_tags(bi) if field == "_stream" else \
+                part.block_stream_id(bi).as_string()
+            vals[start:start + n] = len(v)
+            vmax = max(vmax, len(v))
+            eligible.append(bi)
+            continue
+        meta = part.block_column_meta(bi, field)
+        if meta is None:
+            consts = dict(part.block_consts(bi))
+            ln = len(consts.get(field, ""))
+            vals[start:start + n] = ln
+            vmax = max(vmax, ln)
+        elif meta["t"] == VT_STRING:
+            col = part.block_column(bi, field)
+            if col.arena.size:
+                cs = np.zeros(col.arena.size + 1, dtype=np.int64)
+                np.cumsum((col.arena & 0xC0) != 0x80, out=cs[1:])
+                offs = col.offsets.astype(np.int64)
+                lens = col.lengths.astype(np.int64)
+                cp = cs[offs + lens] - cs[offs]
+            else:
+                cp = np.zeros(n, dtype=np.int64)
+            vals[start:start + n] = cp.astype(np.uint32)
+            vmax = max(vmax, int(cp.max(initial=0)))
+        elif meta["t"] == VT_DICT:
+            col = part.block_column(bi, field)
+            remap = np.array([len(v) for v in col.dict_values],
+                             dtype=np.uint32)
+            if remap.size:
+                rowl = remap[col.ids]
+                vals[start:start + n] = rowl
+                vmax = max(vmax, int(remap.max()))
+        elif meta["t"] in _int_vtypes():
+            col = part.block_column(bi, field)
+            v = col.nums.astype(np.int64)
+            a = np.abs(v)
+            d = np.ones(n, dtype=np.int64)
+            t = 10
+            while t <= 10 ** 18:
+                d += a >= t
+                t *= 10
+            d += v < 0
+            d = np.where(v == i64min, 20, d)  # abs(int64 min) wraps
+            vals[start:start + n] = d.astype(np.uint32)
+            vmax = max(vmax, int(d.max(initial=0)))
+        else:
+            continue       # float/ipv4/ts: host decodes these
+        eligible.append(bi)
+    if not eligible:
+        return None
+    if vmax * max(layout.nrows, 1) >= max_abs_times_rows:
+        return None
+    return StagedNumeric(values=put(vals), vmin=0, vmax=vmax,
+                         eligible=frozenset(eligible),
+                         nbytes=layout.nrows_padded * 4)
+
+
+def stage_empty_column(part, field: str, layout: StatsLayout,
+                       put=None) -> StagedNumeric | None:
+    """Synthetic 0/1 column: 1 where `field` is the empty string — the
+    device carrier for `count_empty(field)` (its sum plane is the empty
+    count).  Every block kind is eligible: numeric/ipv4/ts-typed blocks
+    have a value in every row (never empty)."""
+    import jax.numpy as jnp
+    if put is None:
+        put = jnp.asarray
+    vals = np.zeros(layout.nrows_padded, dtype=np.uint32)
+    eligible = []
+    for bi in range(part.num_blocks):
+        start = layout.starts[bi]
+        n = part.block_rows(bi)
+        if field in ("_stream", "_stream_id"):
+            eligible.append(bi)   # virtual renderings are never empty
+            continue
+        meta = part.block_column_meta(bi, field)
+        if meta is None:
+            consts = dict(part.block_consts(bi))
+            if consts.get(field, "") == "":
+                vals[start:start + n] = 1
+        elif meta["t"] == VT_STRING:
+            col = part.block_column(bi, field)
+            em = col.lengths == 0
+            if em.any():
+                vals[start:start + n] = em.astype(np.uint32)
+        elif meta["t"] == VT_DICT:
+            col = part.block_column(bi, field)
+            remap = np.array([1 if v == "" else 0
+                              for v in col.dict_values], dtype=np.uint32)
+            if remap.size and remap.any():
+                vals[start:start + n] = remap[col.ids]
+        # numeric/ipv4/ts blocks: never empty
+        eligible.append(bi)
+    return StagedNumeric(values=put(vals), vmin=0, vmax=1,
+                         eligible=frozenset(eligible),
+                         nbytes=layout.nrows_padded * 4)
+
+
 def stage_time_buckets(part, layout: StatsLayout, step: int, offset: int,
                        max_buckets: int, put=None) -> StagedBuckets | None:
     """Bucket ids per row from block timestamps, matching the host's
@@ -911,14 +1030,28 @@ class BatchRunner:
 
     def _stage_numeric(self, part, field: str, layout: StatsLayout,
                        max_abs_times_rows: int):
+        """Stage a value column for device stats.  `field` may be a
+        synthetic token (stats_device.SYNTH_LEN/SYNTH_EMPTY prefixes)
+        carrying sum_len/count_empty as derived uint32 columns."""
+        from .stats_device import SYNTH_EMPTY, SYNTH_LEN
         key = (part.uid, "#num", field)
         with self._key_lock(key):
             got = self.cache.get(key)
             if got is _UNSTAGEABLE:
                 return None
             if got is None:
-                got = stage_numeric(part, field, layout,
-                                    max_abs_times_rows, put=self._put)
+                if field.startswith(SYNTH_LEN):
+                    got = stage_len_column(part, field[len(SYNTH_LEN):],
+                                           layout, max_abs_times_rows,
+                                           put=self._put)
+                elif field.startswith(SYNTH_EMPTY):
+                    got = stage_empty_column(
+                        part, field[len(SYNTH_EMPTY):], layout,
+                        put=self._put)
+                else:
+                    got = stage_numeric(part, field, layout,
+                                        max_abs_times_rows,
+                                        put=self._put)
                 if got is None:
                     self.cache.put_small(key, _UNSTAGEABLE)
                 else:

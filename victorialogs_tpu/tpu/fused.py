@@ -779,6 +779,7 @@ def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
     partial per surviving row, keyed exactly like the device cells."""
     from ..logsql.matchers import parse_number
     from ..logsql.stats_funcs import format_number
+    from .stats_device import SYNTH_EMPTY, SYNTH_LEN
     partials = []
     for bi, bs in bss.items():
         start = layout.starts[bi]
@@ -824,7 +825,12 @@ def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
                 qv[fld] = parse_number(vals(fld)[i])
             fs = {}
             for fld in spec.value_fields:
-                v = int(vals(fld)[i])
+                if fld.startswith(SYNTH_LEN):
+                    v = len(vals(fld[len(SYNTH_LEN):])[i])
+                elif fld.startswith(SYNTH_EMPTY):
+                    v = 1 if vals(fld[len(SYNTH_EMPTY):])[i] == "" else 0
+                else:
+                    v = int(vals(fld)[i])
                 fs[fld] = (v, v, v)
             partials.append((tuple(key_parts), 1, fs, uniq, qv))
     return partials

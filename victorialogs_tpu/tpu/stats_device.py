@@ -39,6 +39,14 @@ MAX_STAT_ROWS = 16 << 20          # plane-sum bound: 255 * R < 2**32
 MAX_ABS_TIMES_ROWS = 1 << 53      # keep the host float64 path exact as well
 MAX_QUANTILE_RANGE = 2048         # per-value histogram axis width cap
 
+# synthetic value-column tokens: sum_len/count_empty ride the standard
+# stats kernel over DERIVED uint32 columns (code-point lengths / 0-1
+# empty flags) — the sum plane is the answer (batch.stage_len_column,
+# stage_empty_column).  Tokens flow through value_fields/staging keys;
+# the prefixes cannot collide with parsed field names in practice.
+SYNTH_LEN = "#synth:len:"
+SYNTH_EMPTY = "#synth:empty:"
+
 
 @dataclass
 class FuncSpec:
@@ -96,6 +104,20 @@ def _func_spec(fn) -> FuncSpec | None:
     if t is sf.StatsMax:
         if len(fn.fields) == 1 and "*" not in fn.fields[0]:
             return FuncSpec("max", fn.fields[0])
+        return None
+    if t is sf.StatsSumLen:
+        # total CODE-POINT length per group: a derived uint32 column
+        # (stage_len_column) through the standard sum partials
+        if len(fn.fields) == 1 and "*" not in fn.fields[0] and \
+                fn.fields[0] != "_time":
+            return FuncSpec("sum_len", SYNTH_LEN + fn.fields[0])
+        return None
+    if t is sf.StatsCountEmpty:
+        # empty-value count per group: a derived 0/1 column
+        # (stage_empty_column) through the standard sum partials
+        if len(fn.fields) == 1 and "*" not in fn.fields[0] and \
+                fn.fields[0] != "_time":
+            return FuncSpec("count_empty", SYNTH_EMPTY + fn.fields[0])
         return None
     if t in (sf.StatsQuantile, sf.StatsMedian):
         # exact per-value histogram over an int column with a SMALL value
@@ -225,6 +247,10 @@ def build_partial_states(spec: StatsSpec, pipe_funcs, bucket_key,
         elif fs.kind == "avg":
             s = field_stats[fs.field][0]
             states.append((float(s), count))
+        elif fs.kind in ("sum_len", "count_empty"):
+            # host state is a plain int; the derived column's sum plane
+            # is exactly the total length / empty count for these rows
+            states.append(int(field_stats[fs.field][0]))
         elif fs.kind == "min":
             states.append(str(field_stats[fs.field][1]) if count else None)
         elif fs.kind == "max":
