@@ -241,6 +241,50 @@ def test_fast_slow_parity_weird_time_values(tmp_path):
         slow_s.close()
 
 
+def test_loki_json_bulk_parity(tmp_path):
+    """Loki JSON push: attr-less entries ride the columnar bulk path;
+    entries with structured metadata stay per-row — both must match the
+    forced per-row path exactly (labels as fields, stream identity,
+    '_msg'/'_time' label collisions)."""
+    from victorialogs_tpu.server.vlinsert import handle_loki_json
+    streams = [
+        {"stream": {"app": "w", "env": "prod"},
+         "values": [[str(T0 + i * NS), f"line {i}"] for i in range(500)]},
+        {"stream": {"app": "w", "env": "dev"},
+         "values": [[str(T0 + i * NS), f"dev {i}",
+                     {"trace": f"t{i}"}] if i % 5 == 0
+                    else [str(T0 + i * NS), f"dev {i}"]
+                    for i in range(300)]},
+        {"stream": {"_msg": "labelmsg", "_time": "labeltime",
+                    "app": "odd"},
+         "values": [[str(T0 + i * NS), f"dropped {i}"]
+                    for i in range(50)]},
+    ]
+    body = json.dumps({"streams": streams}).encode()
+
+    def ingest(name, slow):
+        s = Storage(str(tmp_path / name), retention_days=100000,
+                    flush_interval=3600)
+        cp = CommonParams(tenant=TEN)
+        sink = _SlowOnlySink(s) if slow else LocalLogRowsStorage(s)
+        lmp = LogMessageProcessor(cp, sink)
+        n = handle_loki_json(cp, body, lmp)
+        lmp.flush()
+        s.debug_flush()
+        return s, n
+
+    fast_s, fn = ingest("fast", False)
+    slow_s, sn = ingest("slow", True)
+    try:
+        assert fn == sn == 850
+        assert _rows(fast_s) == _rows(slow_s)
+        q = '* | stats by (_stream) count() c'
+        assert _rows(fast_s, q) == _rows(slow_s, q)
+    finally:
+        fast_s.close()
+        slow_s.close()
+
+
 def test_fast_path_retention_drops(tmp_path):
     """Too-old rows are counted and dropped identically."""
     import time as _t

@@ -210,8 +210,13 @@ def _ingest_line(st: _FastState, line) -> None:
     express (nested objects, arrays, nulls) take the per-row fallback.
     Shared by the no-native chunk scan and the native scanner's flagged
     lines, so semantics and error behavior have exactly one home."""
-    line = line.strip()    # incl. \x0b/\x0c, which the C scanner's
-    if not line:           # space/tab/CR trim does not cover
+    # explicit ASCII whitespace only (matches bytes.strip; str.strip
+    # would also eat NBSP/U+2028 and silently accept lines the per-row
+    # path rejects) — incl. \x0b/\x0c, which the C scanner's trim skips
+    ws = " \t\n\r\x0b\x0c" if isinstance(line, str) \
+        else b" \t\n\r\x0b\x0c"
+    line = line.strip(ws)
+    if not line:
         return
     try:
         obj = json.loads(line)
@@ -529,6 +534,41 @@ def handle_elasticsearch_bulk(cp: CommonParams, body: bytes,
 
 # ---------------- loki ----------------
 
+def _protocol_stream_bulk(lmp: LogMessageProcessor, cp: CommonParams,
+                          labels: list, ts_list: list,
+                          lines: list) -> None:
+    """Columnar bulk add for protocol streams (Loki): many (ts, line)
+    entries sharing one label set.  Replicates LogMessageProcessor.
+    add_row(..., stream_fields=labels) + LogRows.add semantics: labels
+    become row fields (keep-first dedupe, '_time' keys dropped), the
+    line is '_msg', and the stream identity is the label pairs that
+    survived cleaning."""
+    seen: set = set()
+    clean: list = []
+    for k, v in labels:
+        if k == "_time" or k in seen:
+            continue
+        seen.add(k)
+        clean.append((k, v))
+    if "_msg" not in seen:
+        clean.append(("_msg", None))     # per-row line slot
+    names = tuple(k for k, _ in clean)
+    label_names = {k for k, _ in labels}
+    stream_pairs = [(k, v) for k, v in clean
+                    if k in label_names and v is not None]
+    stream_pos = tuple(p for p, (k, v) in enumerate(clean)
+                       if k in label_names and v is not None)
+    tags = canonical_stream_tags(stream_pairs)
+    hi, lo = stream_id_hash(tags.encode("utf-8"))
+    sid = StreamID(cp.tenant, hi, lo)
+    n = len(ts_list)
+    cols = [lines if v is None else [v] * n for _k, v in clean]
+    lc = LogColumns()
+    g = lc.group(names, stream_pos)
+    lc.add_bulk(g, cp.tenant, ts_list, cols, [sid] * n, [tags] * n)
+    lmp.ingest_columns(lc)
+
+
 def handle_loki_json(cp: CommonParams, body: bytes,
                      lmp: LogMessageProcessor) -> int:
     try:
@@ -536,18 +576,38 @@ def handle_loki_json(cp: CommonParams, body: bytes,
     except json.JSONDecodeError as e:
         raise IngestError(f"cannot parse Loki JSON: {e}") from None
     n = 0
+    bulk_ok = not cp.ignore_fields and not cp.extra_fields and \
+        lmp.supports_columns()
     for stream in obj.get("streams", []):
         labels = stream.get("stream", {})
         stream_fields = [(str(k), str(v)) for k, v in labels.items()]
+        ts_bulk: list = []
+        ln_bulk: list = []
         for entry in stream.get("values", []):
             ts = parse_timestamp(int(entry[0])) if str(entry[0]).isdigit() \
                 else parse_timestamp(entry[0])
+            attrs = entry[2] if len(entry) > 2 and \
+                isinstance(entry[2], dict) else None
+            if bulk_ok and not attrs and ts is not None and \
+                    isinstance(entry[1], str):
+                ts_bulk.append(ts)
+                ln_bulk.append(entry[1])
+                n += 1
+                continue
+            if ts_bulk:
+                # keep arrival order around per-row entries (same
+                # discipline as _fast_fallback_obj)
+                _protocol_stream_bulk(lmp, cp, stream_fields, ts_bulk,
+                                      ln_bulk)
+                ts_bulk, ln_bulk = [], []
             fields = [("_msg", entry[1])]
-            if len(entry) > 2 and isinstance(entry[2], dict):
-                fields.extend((str(k), str(v))
-                              for k, v in entry[2].items())
+            if attrs:
+                fields.extend((str(k), str(v)) for k, v in attrs.items())
             lmp.add_row(ts, fields, stream_fields=stream_fields)
             n += 1
+        if ts_bulk:
+            _protocol_stream_bulk(lmp, cp, stream_fields, ts_bulk,
+                                  ln_bulk)
     return n
 
 
@@ -575,6 +635,10 @@ def handle_loki_protobuf(cp: CommonParams, body: bytes,
                 labels = _parse_loki_labels(v2.decode("utf-8", "replace"))
             elif f2 == 2:
                 entries.append(v2)
+        bulk_ok = not cp.ignore_fields and not cp.extra_fields and \
+            lmp.supports_columns()
+        ts_bulk: list = []
+        ln_bulk: list = []
         for ent in entries:
             ts_ns = None
             line = ""
@@ -599,9 +663,20 @@ def handle_loki_protobuf(cp: CommonParams, body: bytes,
                             v = v4.decode("utf-8", "replace")
                     if k:
                         attrs.append((k, v))
+            if bulk_ok and not attrs and ts_ns is not None:
+                ts_bulk.append(ts_ns)
+                ln_bulk.append(line)
+                n += 1
+                continue
+            if ts_bulk:
+                # keep arrival order around per-row entries
+                _protocol_stream_bulk(lmp, cp, labels, ts_bulk, ln_bulk)
+                ts_bulk, ln_bulk = [], []
             lmp.add_row(ts_ns, [("_msg", line)] + attrs,
                         stream_fields=labels)
             n += 1
+        if ts_bulk:
+            _protocol_stream_bulk(lmp, cp, labels, ts_bulk, ln_bulk)
     return n
 
 
