@@ -14,6 +14,10 @@ Two things must happen before the first backend init:
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# parity suites exist to diff the device kernels against the host path —
+# pin the cost gate so it never silently routes everything to host on the
+# (fast-RTT) CPU backend; the gate has its own dedicated tests
+os.environ.setdefault("VL_COST_FORCE", "device")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,182 @@
+"""Parity: the u32-lane kernels (tpu/kernels32.py) vs the round-3 byte
+kernels (tpu/kernels.py), which are themselves bit-exact vs the scalar
+matchers (test_tpu_runner.py).  Any drift here breaks "identical hit
+sets"."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from victorialogs_tpu.tpu import kernels as K
+from victorialogs_tpu.tpu import kernels32 as K32
+from victorialogs_tpu.tpu.layout import to_fixed_width, to_lanes32
+
+MODES = [K.MODE_PHRASE, K.MODE_PREFIX, K.MODE_SUBSTRING, K.MODE_EXACT,
+         K.MODE_EXACT_PREFIX]
+
+
+def test_bitcast_little_endian():
+    """The lane-combine shifts in kernels32 assume a little-endian
+    backend; assert the XLA bitcast agrees with the numpy '<u4' view
+    used by layout.to_lanes32."""
+    x = jnp.array([[1, 2, 3, 4]], dtype=jnp.uint8)
+    v = int(np.asarray(jax.lax.bitcast_convert_type(x, jnp.uint32))[0])
+    assert v == 0x04030201
+
+
+def _stage(values: list[bytes], width: int | None = None):
+    arena = np.frombuffer(b"".join(values), dtype=np.uint8)
+    lengths = np.array([len(v) for v in values], dtype=np.int64)
+    offsets = np.zeros(len(values), dtype=np.int64)
+    if len(values):
+        offsets[1:] = np.cumsum(lengths)[:-1]
+    rb = max(8, (len(values) + 7) // 8 * 8)
+    mat, w, _ovf = to_fixed_width(arena, offsets, lengths, rb, width=width)
+    lens = np.zeros(rb, dtype=np.int32)
+    lens[:len(values)] = np.minimum(lengths, w - 1)
+    return mat, lens, w
+
+
+def _rand_value(rng: random.Random) -> bytes:
+    words = ["alpha", "beta", "err", "GET", "x", "_u", "123", "a1b2",
+             "日本", "é", "\xff".encode("latin-1").decode("latin-1")]
+    kind = rng.random()
+    if kind < 0.05:
+        return b""
+    if kind < 0.15:  # binary-ish (but no 0xFF: staging reserves it)
+        return bytes(rng.randrange(0, 255) for _ in range(rng.randrange(1, 40)))
+    n = rng.randrange(1, 9)
+    sep = rng.choice([" ", "", "/", "=", "-", ":", "\n"])
+    return sep.join(rng.choice(words) for _ in range(n)).encode()
+
+
+def _rand_pattern(rng: random.Random, values: list[bytes]) -> bytes:
+    if values and rng.random() < 0.6:
+        v = rng.choice([v for v in values if v] or [b"x"])
+        if len(v) == 0:
+            return b"x"
+        i = rng.randrange(len(v))
+        j = min(len(v), i + rng.randrange(1, 20))
+        p = v[i:j]
+        if p:
+            return p
+    n = rng.randrange(1, 18)
+    return bytes(rng.randrange(1, 128) for _ in range(n))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_match_scan_parity_random(seed):
+    rng = random.Random(seed)
+    values = [_rand_value(rng) for _ in range(rng.randrange(1, 300))]
+    mat, lens, w = _stage(values)
+    lanes = to_lanes32(mat)
+    for _ in range(25):
+        pat = _rand_pattern(rng, values)
+        if len(pat) > w - 1:
+            pat = pat[:w - 1]
+        if not pat:
+            continue
+        mode = rng.choice(MODES)
+        st, et = rng.random() < 0.5, rng.random() < 0.5
+        fold = rng.random() < 0.3
+        if fold:
+            pat = pat.lower()
+        pj = jnp.asarray(np.frombuffer(pat, dtype=np.uint8))
+        want = np.asarray(K.match_scan(
+            jnp.asarray(mat), jnp.asarray(lens), pj, len(pat), mode,
+            st, et, fold))
+        got = np.asarray(K32.match_scan_t(
+            jnp.asarray(lanes), jnp.asarray(lens), pj, len(pat), mode,
+            st, et, fold))
+        if not np.array_equal(want, got):
+            bad = np.nonzero(want != got)[0]
+            raise AssertionError(
+                f"mode={mode} st={st} et={et} fold={fold} pat={pat!r} "
+                f"rows={bad[:5]} vals="
+                f"{[values[i] if i < len(values) else None for i in bad[:5]]}")
+
+
+def test_match_scan_boundaries_exhaustive():
+    """Hand-picked boundary shapes: word edges, pattern at row start/end,
+    pattern == value, pattern crossing the truncation width."""
+    values = [b"error", b"xerror", b"error7", b"an error here",
+              b"error_code", b"err", b"", b" error ", b"ERROR",
+              b"e", b"errorerror", b"-error-", b"a" * 40,
+              ("日本語 error 日本語").encode(), b"error\nerror"]
+    mat, lens, w = _stage(values, width=32)  # force truncation of a*40
+    lanes = to_lanes32(mat)
+    for pat in [b"error", b"err", b"e", b"error here", b" ", b"a" * 31]:
+        for mode in MODES:
+            for st in (False, True):
+                for et in (False, True):
+                    pj = jnp.asarray(np.frombuffer(pat, dtype=np.uint8))
+                    want = np.asarray(K.match_scan(
+                        jnp.asarray(mat), jnp.asarray(lens), pj,
+                        len(pat), mode, st, et))
+                    got = np.asarray(K32.match_scan_t(
+                        jnp.asarray(lanes), jnp.asarray(lens), pj,
+                        len(pat), mode, st, et))
+                    assert np.array_equal(want, got), (pat, mode, st, et)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ordered_pair_parity(seed):
+    rng = random.Random(1000 + seed)
+    values = [_rand_value(rng) for _ in range(rng.randrange(1, 200))]
+    mat, lens, w = _stage(values)
+    lanes = to_lanes32(mat)
+    for _ in range(15):
+        pa = _rand_pattern(rng, values)[:8] or b"a"
+        pb = _rand_pattern(rng, values)[:8] or b"b"
+        wd, wv = K.match_ordered_pair(
+            jnp.asarray(mat), jnp.asarray(lens),
+            jnp.asarray(np.frombuffer(pa, dtype=np.uint8)), len(pa),
+            jnp.asarray(np.frombuffer(pb, dtype=np.uint8)), len(pb))
+        gd, gv = K32.match_ordered_pair_t(
+            jnp.asarray(lanes), jnp.asarray(lens),
+            jnp.asarray(np.frombuffer(pa, dtype=np.uint8)), len(pa),
+            jnp.asarray(np.frombuffer(pb, dtype=np.uint8)), len(pb))
+        assert np.array_equal(np.asarray(wd), np.asarray(gd)), (pa, pb)
+        assert np.array_equal(np.asarray(wv), np.asarray(gv)), (pa, pb)
+
+
+def test_packed_variants():
+    values = [b"hello world", b"goodbye", b"hello", b""] * 4
+    mat, lens, w = _stage(values)
+    lanes = to_lanes32(mat)
+    pat = jnp.asarray(np.frombuffer(b"hello", dtype=np.uint8))
+    want = np.asarray(K.match_scan_packed(
+        jnp.asarray(mat), jnp.asarray(lens), pat, 5, K.MODE_PHRASE,
+        True, True))
+    got = np.asarray(K32.match_scan_t_packed(
+        jnp.asarray(lanes), jnp.asarray(lens), pat, 5, K.MODE_PHRASE,
+        True, True))
+    assert np.array_equal(want, got)
+
+
+def test_swar_word_hibits_exhaustive():
+    """Every byte value 0..255 through the SWAR word-char test vs the
+    byte-plane oracle."""
+    b = np.arange(256, dtype=np.uint8)
+    mat = b.reshape(64, 4)
+    lanes = jnp.asarray(np.ascontiguousarray(mat.view("<u4")[:, 0]))
+    hi = np.asarray(K32.word_hibits(lanes))
+    got = np.zeros(256, dtype=bool)
+    for i in range(64):
+        for k in range(4):
+            got[4 * i + k] = bool((int(hi[i]) >> (8 * k + 7)) & 1)
+    want = np.asarray(K._is_word_u8(jnp.asarray(b)))
+    assert np.array_equal(want, got)
+
+
+def test_swar_fold_exhaustive():
+    b = np.arange(256, dtype=np.uint8)
+    mat = b.reshape(64, 4)
+    lanes = jnp.asarray(np.ascontiguousarray(mat.view("<u4")[:, 0]))
+    folded = np.asarray(K32.fold_ascii32(lanes))
+    got = folded.view(np.uint32).astype("<u4").tobytes()
+    want = np.asarray(K._fold_ascii(jnp.asarray(b))).tobytes()
+    assert got == want

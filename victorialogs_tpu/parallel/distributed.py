@@ -186,18 +186,27 @@ class MeshBatchRunner(BatchRunner):
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)
+        # the mesh runner exists to run SPMD — the whole point is ICI
+        # reductions, so the per-part cost gate never routes it to host
+        # (an explicit VL_COST_FORCE still wins)
+        if not self.cost.force:
+            self.cost.force = "device"
         self.mesh = mesh if mesh is not None else make_mesh()
         self.ndev = int(self.mesh.devices.size)
         self.stats_shards = self.ndev
         self._row_sharding = NamedSharding(self.mesh, P(BLOCK_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
 
-    def _put(self, arr):
-        # shard axis 0 when it divides evenly (stats layouts always do;
-        # string-staging row buckets do for power-of-two mesh sizes),
-        # else replicate — correctness never depends on the placement
-        if arr.shape[0] % self.ndev == 0:
-            return jax.device_put(arr, self._row_sharding)
+    def _put(self, arr, row_axis: int = 0):
+        # shard the row axis when it divides evenly (stats layouts always
+        # do; string-staging row buckets do for power-of-two mesh sizes),
+        # else replicate — correctness never depends on the placement.
+        # row_axis=1: lane-major uint32[W/4, R] string staging.
+        if arr.shape[row_axis] % self.ndev == 0:
+            if row_axis == 0:
+                return jax.device_put(arr, self._row_sharding)
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, P(None, BLOCK_AXIS)))
         return jax.device_put(arr, self._replicated)
 
     def _dispatch_fused(self, prog, strides, nb, n_values, nrows,

@@ -50,8 +50,10 @@ from ..storage.bloom import bloom_contains_all
 from ..storage.values_encoder import VT_DICT, VT_STRING
 from ..utils.hashing import hash_tokens
 from . import kernels as K
+from . import kernels32 as K32
 from .batch import device_plan, StatsLayout
-from .layout import row_width_bucket, rows_with_multibyte, to_fixed_width
+from .layout import (row_width_bucket, rows_with_multibyte, to_fixed_width,
+                     to_lanes32)
 
 
 # ---------------- layout-coordinate string staging ----------------
@@ -59,7 +61,7 @@ from .layout import row_width_bucket, rows_with_multibyte, to_fixed_width
 @dataclass
 class FusedField:
     """One column staged over EVERY block of a part, layout coords."""
-    rows: object                   # jax uint8[RLp, W]
+    rows: object                   # jax uint32[W/4, RLp] lane-major
     lengths: object                # jax int32[RLp]
     width: int
     ovf_packed: object | None      # jax uint8[RLp//8] bit-packed overflow
@@ -154,7 +156,8 @@ def stage_layout_column(part, field: str, layout: StatsLayout,
                 ovf[start:start + n] = True
     has_ovf = bool(ovf.any())
     ovp = put(np.packbits(ovf)) if has_ovf else None
-    return FusedField(rows=put(mat), lengths=put(lens), width=w,
+    return FusedField(rows=put(to_lanes32(mat), row_axis=1),
+                      lengths=put(lens), width=w,
                       ovf_packed=ovp, ovf_np=ovf, has_ovf=has_ovf,
                       nbytes=rlp * (w + 5))
 
@@ -278,12 +281,14 @@ class _Planner:
         self.ts_slot: tuple | None = None
         self.has_maybe = False
 
-    def arg(self, a, row: bool = False) -> int:
-        """Register a dynamic input; row=True marks row-aligned arrays
-        (leading dim RLp or RLp/8) that a mesh dispatch shards — recorded
-        explicitly so sharding never relies on shape coincidences."""
+    def arg(self, a, row: int = 0) -> int:
+        """Register a dynamic input; row marks row-aligned arrays that a
+        mesh dispatch shards — recorded explicitly so sharding never
+        relies on shape coincidences.  row=1 (or True): the row axis is
+        axis 0 (RLp or RLp/8 leading dim); row=2: axis 1 (the lane-major
+        uint32[W/4, RLp] string staging)."""
         self.args.append(a)
-        self.arg_rows.append(bool(row))
+        self.arg_rows.append(int(row))
         return len(self.args) - 1
 
     def field_slot(self, field: str) -> tuple[int, FusedField]:
@@ -293,7 +298,7 @@ class _Planner:
         ff = self.runner._stage_fused_field(self.part, field, self.layout)
         if ff is None:
             raise _NoFuse(field)
-        ri = self.arg(ff.rows, row=True)
+        ri = self.arg(ff.rows, row=2)
         li = self.arg(ff.lengths, row=True)
         oi = self.arg(ff.ovf_packed, row=True) if ff.has_ovf else -1
         slot = len(self.fields)
@@ -625,8 +630,8 @@ def _eval_node(node, args, rlp):
         return ge & le, None
     if kind == "scan":
         _, ri, li, oi, mi, pi, plen, mode, st, et, fold = node
-        m = K.match_scan(args[ri], args[li], args[pi], plen, mode, st, et,
-                         fold)
+        m = K32.match_scan_t(args[ri], args[li], args[pi], plen, mode, st,
+                             et, fold)
         may = None
         if oi >= 0:
             may = _unpack_bits(args[oi], rlp)
@@ -638,8 +643,9 @@ def _eval_node(node, args, rlp):
         return m & ~may, may
     if kind == "pair":
         _, ri, li, oi, pa, la, pb, lb = node
-        definite, needsv = K.match_ordered_pair(args[ri], args[li],
-                                                args[pa], la, args[pb], lb)
+        definite, needsv = K32.match_ordered_pair_t(args[ri], args[li],
+                                                    args[pa], la,
+                                                    args[pb], lb)
         may = needsv
         if oi >= 0:
             ov = _unpack_bits(args[oi], rlp)
@@ -761,7 +767,8 @@ def _fused_dispatch_mesh(mesh, axis, prog, strides, nb, n_values, nrows,
     in_specs = (P(), P(axis) if has_cand else P(),
                 tuple(P(axis) for _ in ids_tuple),
                 tuple(P(axis) for _ in values_tuple),
-                tuple(P(axis) if r else P() for r in arg_rows))
+                tuple(P(None, axis) if r == 2 else
+                      (P(axis) if r else P()) for r in arg_rows))
 
     def fn(nrows, cp, ids, vals, leaf_args):
         return _fused_local(prog, strides, nb, n_values, axis, nrows,

@@ -76,6 +76,18 @@ def to_fixed_width(arena_np: np.ndarray, offsets_np: np.ndarray,
     return out, w, overflow
 
 
+def to_lanes32(mat: np.ndarray) -> np.ndarray:
+    """(R, W) uint8 staging matrix -> (W/4, R) uint32 lane-major layout
+    for the u32-chunk kernels (tpu/kernels32.py): lanes[q, r] is the
+    little-endian word of bytes mat[r, 4q:4q+4].  Transposed so the row
+    axis rides the 128-wide TPU lane dimension (and shards over a mesh
+    along axis 1).  W is always a multiple of 4 (row_width_bucket)."""
+    r, w = mat.shape
+    assert w % 4 == 0
+    return np.ascontiguousarray(
+        mat.reshape(r, w // 4, 4).view("<u4")[:, :, 0].T)
+
+
 def rows_with_multibyte(arena_np: np.ndarray, offsets_np: np.ndarray,
                         lengths_np: np.ndarray) -> np.ndarray:
     """Per-row any(byte >= 0x80) over the SOURCE values (truncated tails
