@@ -224,8 +224,6 @@ class BaseHTTPApp:
                 return
             elif path in ("/insert/datadog/api/v2/logs",
                           "/insert/datadog/api/v1/input"):
-                obj = json.loads(body) if body[:1] not in (b"[", b"{") \
-                    else None
                 n = vlinsert.handle_datadog(cp, body, lmp)
                 m.inc("vl_rows_ingested_total{type=\"datadog\"}", n)
                 lmp.flush()

@@ -8,6 +8,7 @@ TCP/UDP listeners).  All parsers return the number of ingested rows.
 
 from __future__ import annotations
 
+import functools
 import json
 import time as _time
 
@@ -21,6 +22,57 @@ from .insertutil import CommonParams, LogMessageProcessor, parse_timestamp
 
 class IngestError(ValueError):
     pass
+
+
+# Structural errors a malformed request body can provoke while a parser
+# walks it.  Handlers translate these to IngestError so the HTTP layer
+# answers 400, matching the reference's per-protocol parse-error paths
+# (app/vlinsert/datadog/datadog.go, app/vlinsert/loki/loki_protobuf.go).
+_PARSE_ERRORS = (pb.PBError, json.JSONDecodeError, UnicodeDecodeError,
+                 KeyError, IndexError, TypeError, AttributeError,
+                 OverflowError, ValueError, RecursionError)
+
+# Exceptions raised from these modules are server-side faults, not body
+# parse failures — the guard re-raises them so the HTTP layer answers 500
+# with a traceback instead of blaming the client's payload.
+_INTERNAL_MODULE_PREFIXES = ("victorialogs_tpu.storage",
+                             "victorialogs_tpu.tpu",
+                             "victorialogs_tpu.server.insertutil")
+
+
+def _raised_internally(e: BaseException) -> bool:
+    tb = e.__traceback__
+    while tb is not None:
+        mod = tb.tb_frame.f_globals.get("__name__", "")
+        if mod.startswith(_INTERNAL_MODULE_PREFIXES):
+            return True
+        tb = tb.tb_next
+    return False
+
+
+def _ingest_guard(proto: str):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(cp, body, lmp, *a, **kw):
+            try:
+                return fn(cp, body, lmp, *a, **kw)
+            except IngestError:
+                raise
+            except _PARSE_ERRORS as e:
+                if _raised_internally(e):
+                    raise
+                if not isinstance(e, (json.JSONDecodeError, pb.PBError,
+                                      UnicodeDecodeError, IngestError)):
+                    # structural errors (TypeError/KeyError/...) can also
+                    # be latent parser bugs — keep the traceback visible
+                    # to operators while still answering 400
+                    import traceback
+                    traceback.print_exc()
+                raise IngestError(
+                    f"cannot parse {proto} request: "
+                    f"{type(e).__name__}: {e}") from None
+        return wrapper
+    return deco
 
 
 def _fields_from_json_obj(obj: dict, prefix: str = "") -> list:
@@ -457,6 +509,7 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
     return st.n
 
 
+@_ingest_guard("jsonline")
 def handle_jsonline(cp: CommonParams, body: bytes,
                     lmp: LogMessageProcessor) -> int:
     if not cp.ignore_fields and not cp.extra_fields and \
@@ -483,6 +536,7 @@ def handle_jsonline(cp: CommonParams, body: bytes,
 
 # ---------------- elasticsearch bulk ----------------
 
+@_ingest_guard("Elasticsearch bulk")
 def handle_elasticsearch_bulk(cp: CommonParams, body: bytes,
                               lmp: LogMessageProcessor) -> tuple[int, dict]:
     lines = body.split(b"\n")
@@ -569,6 +623,7 @@ def _protocol_stream_bulk(lmp: LogMessageProcessor, cp: CommonParams,
     lmp.ingest_columns(lc)
 
 
+@_ingest_guard("Loki JSON")
 def handle_loki_json(cp: CommonParams, body: bytes,
                      lmp: LogMessageProcessor) -> int:
     try:
@@ -584,6 +639,10 @@ def handle_loki_json(cp: CommonParams, body: bytes,
         ts_bulk: list = []
         ln_bulk: list = []
         for entry in stream.get("values", []):
+            if len(entry) < 2 or not isinstance(entry[1], str):
+                raise IngestError(
+                    "Loki values entry must be [ts, line] with a string "
+                    "line")
             ts = parse_timestamp(int(entry[0])) if str(entry[0]).isdigit() \
                 else parse_timestamp(entry[0])
             attrs = entry[2] if len(entry) > 2 and \
@@ -617,6 +676,7 @@ def _parse_loki_labels(s: str) -> list:
     return sorted(parse_stream_tags(s).items())
 
 
+@_ingest_guard("Loki protobuf")
 def handle_loki_protobuf(cp: CommonParams, body: bytes,
                          lmp: LogMessageProcessor) -> int:
     try:
@@ -732,6 +792,7 @@ def _otlp_severity(num: int) -> str:
     return name + (str(off + 1) if off else "")
 
 
+@_ingest_guard("OTLP protobuf")
 def handle_otlp_protobuf(cp: CommonParams, body: bytes,
                          lmp: LogMessageProcessor) -> int:
     n = 0
@@ -780,6 +841,7 @@ def handle_otlp_protobuf(cp: CommonParams, body: bytes,
     return n
 
 
+@_ingest_guard("OTLP JSON")
 def handle_otlp_json(cp: CommonParams, body: bytes,
                      lmp: LogMessageProcessor) -> int:
     try:
@@ -824,6 +886,7 @@ def _otlp_json_value(v) -> str:
 
 # ---------------- datadog ----------------
 
+@_ingest_guard("Datadog")
 def handle_datadog(cp: CommonParams, body: bytes,
                    lmp: LogMessageProcessor) -> int:
     try:
@@ -838,7 +901,8 @@ def handle_datadog(cp: CommonParams, body: bytes,
             continue
         fields = []
         msg = item.get("message", "")
-        fields.append(("_msg", msg))
+        fields.append(("_msg", msg if isinstance(msg, str)
+                       else json.dumps(msg)))
         for k in ("ddsource", "service", "hostname", "status"):
             if item.get(k):
                 fields.append((k, str(item[k])))
@@ -857,6 +921,7 @@ def handle_datadog(cp: CommonParams, body: bytes,
 
 # ---------------- journald export format ----------------
 
+@_ingest_guard("journald")
 def handle_journald(cp: CommonParams, body: bytes,
                     lmp: LogMessageProcessor) -> int:
     n = 0
