@@ -16,7 +16,8 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # parity suites exist to diff the device kernels against the host path —
 # pin the cost gate so it never silently routes everything to host on the
-# (fast-RTT) CPU backend; the gate has its own dedicated tests
+# (fast-RTT) CPU backend; the gate itself is covered by
+# tests/test_cost_model.py, which overrides this per-test
 os.environ.setdefault("VL_COST_FORCE", "device")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -895,6 +895,11 @@ def try_fused(runner, f, part, bss, spec, asm):
     runner._bump("device_calls")
     runner._bump("stats_dispatches")
     runner._bump("fused_dispatches")
+    runner._kind("fused_stats")
+    if spec.uniq_fields:
+        runner._kind("fused_uniq")
+    if spec.quantile_fields:
+        runner._kind("fused_quantile")
     flat, mp = runner._dispatch_fused(
         prog, asm.strides, asm.nb, len(values_tuple),
         jnp.int32(layout.nrows), cand_packed, asm.ids_tuple,
@@ -993,6 +998,7 @@ def try_fused_topk(runner, f, part, bss, spec):
     k = min(spec.k, layout.nrows_padded)
     runner._bump("device_calls")
     runner._bump("topk_dispatches")
+    runner._kind("topk")
     dm, mm = runner._dispatch_topk(
         prog, k, spec.desc, jnp.int32(layout.nrows), cand_packed,
         sn.values, tuple(planner.args))
