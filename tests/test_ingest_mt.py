@@ -1,0 +1,89 @@
+"""Sharded multi-threaded jsonline ingestion: results must be identical
+to the serial path (as sets — shard interleaving changes arrival order
+only), and errors must still surface as IngestError.
+
+Reference: per-CPU rowsBuffer shards, lib/logstorage/datadb.go:667-747.
+"""
+
+import json
+
+import pytest
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.server import vlinsert
+from victorialogs_tpu.server.insertutil import (CommonParams,
+                                                LogMessageProcessor)
+from victorialogs_tpu.storage.log_rows import TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+T0 = 1_753_660_800_000_000_000
+TEN = TenantID(0, 0)
+
+
+def _body(n):
+    return ("\n".join(json.dumps({
+        "_time": T0 + i * 1_000_000,
+        "_msg": f"msg {i} " + ("x" * (i % 40)),
+        "app": f"app{i % 5}",
+        "level": "error" if i % 7 == 0 else "info",
+    }) for i in range(n)) + "\n").encode()
+
+
+def _ingest(tmp_path, name, body, threads, min_body=0, monkeypatch=None):
+    if monkeypatch is not None:
+        monkeypatch.setenv("VL_INGEST_THREADS", str(threads))
+        if min_body:
+            monkeypatch.setattr(vlinsert, "_MT_MIN_BODY", min_body)
+    s = Storage(str(tmp_path / name), retention_days=100000,
+                flush_interval=3600)
+    cp = CommonParams(tenant=TEN, stream_fields=["app"])
+    lmp = LogMessageProcessor(cp, s)
+    n = vlinsert.handle_jsonline(cp, body, lmp)
+    lmp.flush()
+    s.debug_flush()
+    return s, n
+
+
+def _rows(s):
+    out = run_query_collect(s, [TEN], "*")
+    return sorted(json.dumps(r, sort_keys=True) for r in out)
+
+
+def test_mt_matches_serial(tmp_path, monkeypatch):
+    body = _body(20_000)
+    s1, n1 = _ingest(tmp_path, "serial", body, 1)
+    s2, n2 = _ingest(tmp_path, "mt", body, 8, min_body=1024,
+                     monkeypatch=monkeypatch)
+    try:
+        assert n1 == n2 == 20_000
+        assert _rows(s1) == _rows(s2)
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_mt_small_body_stays_serial(tmp_path, monkeypatch):
+    monkeypatch.setenv("VL_INGEST_THREADS", "8")
+    # default _MT_MIN_BODY is 8MB; a small body must not shard
+    body = _body(100)
+    s, n = _ingest(tmp_path, "small", body, 8)
+    try:
+        assert n == 100
+        assert len(_rows(s)) == 100
+    finally:
+        s.close()
+
+
+def test_mt_error_still_400_shape(tmp_path, monkeypatch):
+    body = _body(30_000)[:-1] + b'\n{"_msg": tru\n'
+    monkeypatch.setenv("VL_INGEST_THREADS", "4")
+    monkeypatch.setattr(vlinsert, "_MT_MIN_BODY", 1024)
+    s = Storage(str(tmp_path / "err"), retention_days=100000,
+                flush_interval=3600)
+    cp = CommonParams(tenant=TEN, stream_fields=["app"])
+    lmp = LogMessageProcessor(cp, s)
+    try:
+        with pytest.raises(vlinsert.IngestError):
+            vlinsert.handle_jsonline(cp, body, lmp)
+    finally:
+        s.close()

@@ -1,12 +1,19 @@
-"""Stream-index snapshot (single-level mergeset): compaction at close,
-bulk reopen, snapshot+tail query merging, crash safety."""
+"""Stream-index snapshot levels (mergeset-style): tail flush at close,
+bulk reopen, multi-level query merging, crash safety."""
 
 import os
 
 import pytest
 
-from victorialogs_tpu.storage.indexdb import (SNAPSHOT_FILENAME, IndexDB,
+from victorialogs_tpu.storage.indexdb import (MANIFEST_FILENAME,
+                                              SNAPSHOT_FILENAME, IndexDB,
                                               SNAPSHOT_MIN_TAIL)
+
+
+def _snap_paths(d):
+    import json
+    with open(os.path.join(d, MANIFEST_FILENAME)) as f:
+        return [os.path.join(d, fn) for fn in json.load(f)["files"]]
 from victorialogs_tpu.storage.log_rows import StreamID, TenantID
 from victorialogs_tpu.storage.stream_filter import StreamFilter, TagFilter
 
@@ -38,7 +45,8 @@ def test_snapshot_written_at_close_and_reopened(tmp_path):
     _fill(db, n)
     assert db.num_streams() == n
     db.close()
-    assert os.path.exists(os.path.join(d, SNAPSHOT_FILENAME))
+    paths = _snap_paths(d)
+    assert paths and all(os.path.exists(p) for p in paths)
 
     db2 = IndexDB(d)
     assert db2.num_streams() == n
@@ -85,7 +93,7 @@ def test_torn_snapshot_falls_back_to_log_replay(tmp_path):
     db = IndexDB(d)
     _fill(db, SNAPSHOT_MIN_TAIL)
     db.close()
-    snap = os.path.join(d, SNAPSHOT_FILENAME)
+    snap = _snap_paths(d)[0]
     with open(snap, "r+b") as f:
         f.truncate(os.path.getsize(snap) // 2)
     db2 = IndexDB(d)
@@ -120,10 +128,10 @@ def test_reopen_compacts_large_replayed_tail(tmp_path):
     _fill(db, SNAPSHOT_MIN_TAIL + 100)
     db._file.flush()
     os.fsync(db._file.fileno())
-    # simulate crash: no close() -> no snapshot yet
-    assert not os.path.exists(os.path.join(d, SNAPSHOT_FILENAME))
-    db2 = IndexDB(d)  # replays, then self-compacts
-    assert os.path.exists(os.path.join(d, SNAPSHOT_FILENAME))
+    # simulate crash: no close() -> no snapshot level yet
+    assert not os.path.exists(os.path.join(d, MANIFEST_FILENAME))
+    db2 = IndexDB(d)  # replays, then self-flushes a level
+    assert _snap_paths(d)
     assert db2.num_streams() == SNAPSHOT_MIN_TAIL + 100
     assert len(db2._streams) == 0
     db2.close()
@@ -141,12 +149,12 @@ def test_background_compaction_under_load(tmp_path, monkeypatch):
     monkeypatch.setattr(idb_mod, "COMPACT_TAIL_STREAMS", 400)
 
     slow_gate = threading.Event()
-    orig_compact = snap_mod.compact_snapshot
+    orig_write = snap_mod.write_snapshot
 
-    def slow_compact(path, snap, tail, log_offset):
-        slow_gate.wait(5)  # hold the merge open while we keep registering
-        return orig_compact(path, snap, tail, log_offset)
-    monkeypatch.setattr(idb_mod, "compact_snapshot", slow_compact)
+    def slow_write(path, streams, log_offset):
+        slow_gate.wait(5)  # hold the flush open while we keep registering
+        return orig_write(path, streams, log_offset)
+    monkeypatch.setattr(idb_mod, "write_snapshot", slow_write)
 
     d = str(tmp_path / "idb")
     db = IndexDB(d)
@@ -190,7 +198,7 @@ def test_stale_query_does_not_poison_cache(tmp_path, monkeypatch):
 
     # register a matching stream DURING phase 2 (deterministic race):
     # streams_at runs unlocked right before the final cache put
-    orig = type(db._snap).streams_at
+    orig = type(db._snaps[0]).streams_at
     fired = []
 
     def racing_streams_at(self, idxs):
@@ -198,11 +206,12 @@ def test_stale_query_does_not_poison_cache(tmp_path, monkeypatch):
             fired.append(1)
             db.must_register_streams([(sid, tags)])
         return orig(self, idxs)
-    monkeypatch.setattr(type(db._snap), "streams_at", racing_streams_at)
+    monkeypatch.setattr(type(db._snaps[0]), "streams_at",
+                        racing_streams_at)
 
     stale = db.search_stream_ids([TEN], sf)
     assert sid not in stale          # raced query: allowed to miss it
-    monkeypatch.setattr(type(db._snap), "streams_at", orig)
+    monkeypatch.setattr(type(db._snaps[0]), "streams_at", orig)
     fresh = db.search_stream_ids([TEN], sf)
     assert sid in fresh              # but it must NOT have been cached
     db.close()
@@ -247,7 +256,8 @@ def test_merge_adds_tenant_between_existing(tmp_path):
     extra = [_mk(30_000_000 + i, mid) for i in range(200)]
     db2.must_register_streams(extra)
     with db2._lock:
-        db2._write_snapshot_locked()  # force the array-level merge
+        db2._flush_tail_locked()      # new level with the mid tenant
+    db2.force_merge()                 # k-way merge across the levels
     db2.close()
 
     db3 = IndexDB(d)

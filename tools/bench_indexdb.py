@@ -48,11 +48,17 @@ def main():
           f"rss {rss_mb():.0f}MB")
     t0 = time.time()
     db.close()
-    print(f"close (compaction -> snapshot): {time.time() - t0:.1f}s")
-    snap = os.path.join(d, "streams.snap")
+    print(f"close (tail flush -> level): {time.time() - t0:.1f}s")
+    import json as _json
+    with open(os.path.join(d, "streams.parts.json")) as f:
+        files = _json.load(f)["files"]
+    snap_bytes = sum(os.path.getsize(os.path.join(d, fn)) for fn in files)
     log = os.path.join(d, "streams.jsonl")
-    print(f"snapshot {os.path.getsize(snap) / 1e6:.1f}MB, "
-          f"log {os.path.getsize(log) / 1e6:.1f}MB")
+    amp = db.snap_bytes_written / max(snap_bytes, 1)
+    print(f"levels: {len(files)} files {snap_bytes / 1e6:.1f}MB "
+          f"({db.merge_count} merges), log {os.path.getsize(log)/1e6:.1f}MB")
+    print(f"write amp: {db.snap_bytes_written / 1e6:.1f}MB written / "
+          f"{snap_bytes / 1e6:.1f}MB live = {amp:.2f}x")
 
     t0 = time.time()
     db2 = IndexDB(d)

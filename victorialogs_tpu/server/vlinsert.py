@@ -468,6 +468,30 @@ def _scan_chunk_native(st: _FastState, chunk: bytes, scan) -> None:
 
 
 _NATIVE_CHUNK = 4 << 20   # scan buffer bound (fields/lines arrays)
+# shard a single large body across VL_INGEST_THREADS workers past this
+# size: the native scan (ctypes, GIL dropped) and the numpy/zstd encode
+# both run truly parallel, the reference's per-CPU rowsBuffer shards
+# (lib/logstorage/datadb.go:667-747) mapped onto request threads
+_MT_MIN_BODY = 8 << 20
+
+
+def _scan_span(st: _FastState, body: bytes, pos: int, end_all: int,
+               use_native: bool) -> None:
+    """Scan body[pos:end_all] (newline-aligned) in _NATIVE_CHUNK steps
+    into st — the shared inner loop of the serial and sharded paths."""
+    from .. import native
+    while pos < end_all:
+        end = min(pos + _NATIVE_CHUNK, end_all)
+        if end < end_all:
+            nl = body.rfind(b"\n", pos, end)
+            end = nl + 1 if nl > pos else end_all
+        chunk = body[pos:end]
+        pos = end
+        scan = native.jsonline_scan_native(chunk) if use_native else None
+        if scan is None:
+            _scan_chunk_py(st, chunk.decode("utf-8"))
+        else:
+            _scan_chunk_native(st, chunk, scan)
 
 
 def _jsonline_fast(cp: CommonParams, body: bytes,
@@ -476,7 +500,14 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
     scanner (vl_jsonline_scan) tokenizes newline-aligned chunks into
     key/value spans over an unescape arena; rows map through per-schema
     plans straight into LogColumns batches.  Rows the columnar form
-    can't express fall back to the per-row path line by line."""
+    can't express fall back to the per-row path line by line.
+
+    Large bodies shard across VL_INGEST_THREADS workers (each with its
+    own scan state and LogColumns batch; only the final sink append is
+    lock-serialized).  Rows within a shard keep arrival order; shards
+    interleave — same contract as concurrent client connections."""
+    import os as _os
+
     from .. import native
     try:
         # upfront validation for the whole body, exactly like the
@@ -485,28 +516,54 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
     except UnicodeDecodeError as e:
         raise IngestError(f"request body is not valid UTF-8: {e}") \
             from None
-    st = _FastState(cp, lmp)
     if not native.available():
+        st = _FastState(cp, lmp)
         _scan_chunk_py(st, text)     # one pass over the validated text
         lmp.ingest_columns(st.lc)
         return st.n
     del text
-    pos = 0
     blen = len(body)
-    while pos < blen:
-        end = min(pos + _NATIVE_CHUNK, blen)
-        if end < blen:
-            nl = body.rfind(b"\n", pos, end)
-            end = nl + 1 if nl > pos else blen
-        chunk = body[pos:end]
-        pos = end
-        scan = native.jsonline_scan_native(chunk)
-        if scan is None:
-            _scan_chunk_py(st, chunk.decode("utf-8"))
-        else:
-            _scan_chunk_native(st, chunk, scan)
+    try:
+        nthreads = int(_os.environ.get("VL_INGEST_THREADS", "1") or "1")
+    except ValueError:
+        nthreads = 1
+    if nthreads > 1 and blen >= _MT_MIN_BODY:
+        return _jsonline_fast_mt(cp, body, lmp, nthreads)
+    st = _FastState(cp, lmp)
+    _scan_span(st, body, 0, blen, True)
     lmp.ingest_columns(st.lc)
     return st.n
+
+
+def _jsonline_fast_mt(cp: CommonParams, body: bytes,
+                      lmp: LogMessageProcessor, nthreads: int) -> int:
+    """Shard one body across worker threads at newline boundaries."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    blen = len(body)
+    bounds = [0]
+    for k in range(1, nthreads):
+        want = blen * k // nthreads
+        nl = body.find(b"\n", want)
+        cut = nl + 1 if nl >= 0 else blen
+        bounds.append(max(cut, bounds[-1]))
+    bounds.append(blen)
+    spans = [(s, e) for s, e in zip(bounds[:-1], bounds[1:]) if s < e]
+    states = [_FastState(cp, lmp) for _ in spans]
+
+    def work(k: int) -> None:
+        s, e = spans[k]
+        _scan_span(states[k], body, s, e, True)
+
+    with ThreadPoolExecutor(max_workers=len(spans)) as pool:
+        # surface the first worker error (e.g. IngestError) to the caller
+        for fut in [pool.submit(work, k) for k in range(len(spans))]:
+            fut.result()
+    n = 0
+    for st in states:
+        lmp.ingest_columns(st.lc)
+        n += st.n
+    return n
 
 
 @_ingest_guard("jsonline")

@@ -1,28 +1,34 @@
-"""Columnar stream-index snapshot: the compacted base of the stream index.
+"""Columnar stream-index snapshot files: the immutable levels of the
+multi-level stream index (indexdb.py).
 
 The reference backs its stream index with a mergeset LSM
 (vendor/.../lib/mergeset/table.go: sorted immutable parts + background
-merges + binary-searched lookups).  This module is that idea reduced to the
-per-day partition lifecycle: the append-only registration log compacts into
-ONE immutable sorted columnar snapshot (at close, or when the tail grows
-past a threshold), and reopen becomes a bulk numpy load — O(streams) bytes,
-near-zero Python-object work — instead of a JSON replay that rebuilds every
-posting set eagerly.
+merges + binary-searched lookups).  A snapshot file is one such part:
+the tail of registrations flushes into a new file, and merge_snapshots()
+is the k-way file-to-file background merge.
 
-Layout (single zstd-framed file, `streams.snap`):
-- streams sorted by (tenant, hi, lo): u32 tenant_idx[], u64 hi[], u64 lo[],
-  tags offsets into one utf-8 blob — membership and tag lookups are
-  binary searches, no per-stream Python objects at load;
-- per (tenant, label): a sorted fixed-width bytes table of the label's
-  values (searchsorted for '=' lookups, linear decode only for regex
-  filters) with each value's posting list as a slice of one u32 stream-
-  index blob, plus the label's "any" posting list.  Posting sets
-  materialize lazily per (label, value) on first query and are memoized.
+Layout (v2, `streams.snap.NNNNNN`): a JSON section directory followed by
+the section payloads —
+- registry sections (RAW, np.frombuffer over an mmap): streams sorted by
+  (tenant, hi, lo) as u32 tenant_idx[], u64 hi[], u64 lo[], plus tag
+  offsets.  Reopen is O(header); pages fault in on first touch, so RSS
+  tracks what queries actually read (the mergeset part.go idea: mmapped
+  parts, per-block decompression).
+- a zstd tags-blob section (lazy: decompressed on first tags_at), and
+- one zstd section per (tenant, label) posting group: a sorted
+  fixed-width value table (searchsorted '=' lookups, linear decode only
+  for regex filters), per-value posting slices of one u32 stream-index
+  blob, and the label's "any" posting list.  Decompressed lazily on the
+  first query touching that label, memoized per (label, value).
 
-Crash safety: the snapshot is written tmp+fsync+rename and records the log
-byte offset it covers; reopen loads the snapshot and replays only the log
-tail past that offset.  A torn snapshot is discarded (full log replay
-still works — the log is never truncated).
+v1 files (single zstd frame, pre-round-5) still load via the legacy
+eager path.
+
+Crash safety: files are written tmp+fsync+rename and record the log byte
+offset they cover; reopen loads the manifest's levels and replays only
+the log tail past the contiguous-healthy coverage (indexdb._load_levels).
+A torn file is discarded — the log is never truncated, so nothing is
+lost.
 """
 
 from __future__ import annotations
@@ -37,38 +43,63 @@ from ..utils import zstd as _zstd
 from .log_rows import StreamID, TenantID
 from .stream_filter import parse_stream_tags
 
-SNAP_MAGIC = b"VLSNAP1\n"
+SNAP_MAGIC = b"VLSNAP1\n"       # legacy: whole file one zstd frame
+SNAP2_MAGIC = b"VLSNAP2\n"      # sectioned: mmap registry, lazy labels
 
-
-def _pack_arrays(arrays: dict) -> tuple[dict, bytes]:
-    meta = {}
-    blobs = []
-    off = 0
-    for name, arr in arrays.items():
-        raw = arr.tobytes() if isinstance(arr, np.ndarray) else arr
-        meta[name] = {
-            "off": off, "len": len(raw),
-            "dtype": str(arr.dtype) if isinstance(arr, np.ndarray)
-            else "bytes",
-        }
-        blobs.append(raw)
-        off += len(raw)
-    return meta, b"".join(blobs)
+_REGISTRY_SECTIONS = ("t_idx", "hi", "lo", "tag_off")
 
 
 def _finish_snapshot(path: str, arrays: dict, n: int, tenants: list,
                      labels_meta: dict, log_offset: int) -> None:
-    ameta, blob = _pack_arrays(arrays)
+    """v2 writer: registry arrays land RAW (np.frombuffer over an mmap
+    at open — reopen is O(header), pages fault in on demand), tags and
+    each (tenant, label) posting group land as independent zstd
+    sections decompressed lazily on first query.  This is what makes a
+    10M-stream reopen sub-second and keeps RSS at touched-pages instead
+    of whole-index (the mergeset part.go idea: mmapped part files,
+    per-block decompression)."""
+    payloads: list = []
+    sections: dict = {}
+    off = 0
+
+    def add(name: str, data, dtype: str, comp: str) -> None:
+        nonlocal off
+        sections[name] = {"off": off, "len": len(data), "dtype": dtype,
+                          "comp": comp}
+        payloads.append(data)
+        off += len(data)
+
+    for name in _REGISTRY_SECTIONS:
+        arr = np.ascontiguousarray(arrays[name])
+        add(name, memoryview(arr).cast("B"), str(arr.dtype), "raw")
+    add("tags_blob", _zstd.compress(arrays["tags_blob"], level=3),
+        "bytes", "zstd")
+    for ti_s, labels in labels_meta.items():
+        for label in labels:
+            base = f"p{ti_s}:{label}"
+            v = np.ascontiguousarray(arrays[base + ":v"])
+            c = np.ascontiguousarray(arrays[base + ":c"],
+                                     dtype=np.uint32)
+            i = np.ascontiguousarray(arrays[base + ":i"],
+                                     dtype=np.uint32)
+            a = np.ascontiguousarray(arrays[base + ":a"],
+                                     dtype=np.uint32)
+            blob = struct.pack("<IIQQ", v.size, v.dtype.itemsize or 1,
+                               i.size, a.size) + \
+                v.tobytes() + c.tobytes() + i.tobytes() + a.tobytes()
+            add(base, _zstd.compress(blob, level=3), "label", "zstd")
+
     header = json.dumps({
-        "n": n, "tenants": tenants, "arrays": ameta,
+        "n": n, "tenants": tenants, "sections": sections,
         "labels": labels_meta, "log_offset": log_offset,
     }, separators=(",", ":")).encode("utf-8")
-    payload = _zstd.compress(
-        struct.pack(">I", len(header)) + header + blob, level=3)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(SNAP_MAGIC)
-        f.write(payload)
+        f.write(SNAP2_MAGIC)
+        f.write(struct.pack(">I", len(header)))
+        f.write(header)
+        for data in payloads:
+            f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -125,211 +156,150 @@ def write_snapshot(path: str, streams: dict, log_offset: int) -> None:
     _finish_snapshot(path, arrays, n, tenants, labels_meta, log_offset)
 
 
-def compact_snapshot(path: str, snap, tail: dict,
-                     log_offset: int) -> None:
-    """One entry point for every compaction site: array-level merge when
-    a snapshot exists, full write otherwise."""
-    if snap is not None:
-        merge_snapshot(path, snap, tail, log_offset)
-    else:
-        write_snapshot(path, dict(tail), log_offset)
+def merge_snapshots(path: str, snaps: list["StreamSnapshot"],
+                    log_offset: int) -> None:
+    """k-way array-level merge of immutable snapshot files into one —
+    the mergeset file-to-file merge (vendor/.../lib/mergeset/table.go
+    background merges).  No row is ever decoded into Python objects:
+    registry columns merge by one lexsort over the concatenated arrays,
+    posting lists remap through the source-position→new-row mapping and
+    regroup with a stable two-pass sort, tags copy via one byte gather.
 
+    Duplicate StreamIDs across sources (possible after a crash-replay
+    overlap) collapse onto one row; their postings converge on the kept
+    row and dedupe."""
+    n_srcs = [s.n for s in snaps]
+    n_total = sum(n_srcs)
+    base_of = np.zeros(len(snaps) + 1, dtype=np.int64)
+    np.cumsum(np.asarray(n_srcs, dtype=np.int64), out=base_of[1:])
 
-def merge_snapshot(path: str, snap: "StreamSnapshot", tail: dict,
-                   log_offset: int) -> None:
-    """Array-level compaction: merge an existing snapshot with a tail map
-    WITHOUT decoding the old rows into Python objects or re-parsing their
-    tags — the mergeset file-to-file merge.  Old registry columns merge by
-    one lexsort; old posting lists remap through the (monotonic) old→new
-    index mapping; only TAIL tags are parsed."""
-    n_old = snap.n
-    t_items = sorted(
-        ((sid.tenant.account_id, sid.tenant.project_id, sid.hi, sid.lo,
-          tags) for sid, tags in tail.items()))
-    n_tail = len(t_items)
-    if n_tail == 0:
-        # nothing to merge: rewrite with the new log offset only
-        _finish_snapshot(path, dict(snap._arrays), n_old,
-                         [(t.account_id, t.project_id)
-                          for t in snap.tenants],
-                         snap._labels_meta, log_offset)
-        return
+    # unified tenant table, sorted by (account, project)
+    tenant_keys = sorted({(t.account_id, t.project_id)
+                          for s in snaps for t in s.tenants})
+    tenant_idx_of = {t: i for i, t in enumerate(tenant_keys)}
 
-    # unified tenant table, SORTED by (account, project): rows are sorted
-    # the same way, so t_idx stays monotonic — the invariant
-    # StreamSnapshot._tenant_bounds (searchsorted) depends on
-    old_tenant_keys = [(t.account_id, t.project_id) for t in snap.tenants]
-    tenants = sorted(set(old_tenant_keys) |
-                     {(a, p) for a, p, _h, _l, _t in t_items})
-    tenant_idx_of = {t: i for i, t in enumerate(tenants)}
+    def _src_cols(s):
+        tn = np.asarray([[t.account_id, t.project_id] for t in s.tenants],
+                        dtype=np.int64) if s.tenants else \
+            np.empty((0, 2), dtype=np.int64)
+        return tn[:, 0][s.t_idx], tn[:, 1][s.t_idx]
 
-    # registry columns: concat old arrays with tail columns, one lexsort
-    t_acct = np.fromiter((a for a, _p, _h, _l, _t in t_items),
-                         dtype=np.int64, count=n_tail)
-    t_proj = np.fromiter((p for _a, p, _h, _l, _t in t_items),
-                         dtype=np.int64, count=n_tail)
-    t_hi = np.fromiter((h for _a, _p, h, _l, _t in t_items),
-                       dtype=np.uint64, count=n_tail)
-    t_lo = np.fromiter((lw for _a, _p, _h, lw, _t in t_items),
-                       dtype=np.uint64, count=n_tail)
-    old_tenants = np.asarray([[t.account_id, t.project_id]
-                              for t in snap.tenants], dtype=np.int64) \
-        if snap.tenants else np.empty((0, 2), dtype=np.int64)
-    o_acct = old_tenants[:, 0][snap.t_idx] if n_old else \
-        np.empty(0, dtype=np.int64)
-    o_proj = old_tenants[:, 1][snap.t_idx] if n_old else \
-        np.empty(0, dtype=np.int64)
-    acct = np.concatenate([o_acct, t_acct])
-    proj = np.concatenate([o_proj, t_proj])
-    hi = np.concatenate([snap.hi, t_hi])
-    lo = np.concatenate([snap.lo, t_lo])
+    acct = np.concatenate([_src_cols(s)[0] for s in snaps])
+    proj = np.concatenate([_src_cols(s)[1] for s in snaps])
+    hi = np.concatenate([s.hi for s in snaps])
+    lo = np.concatenate([s.lo for s in snaps])
     perm = np.lexsort((lo, hi, proj, acct))
-    n = n_old + n_tail
-    # old/tail position -> new row index (monotonic within each source,
-    # so sorted posting lists stay sorted after remapping)
-    new_of = np.empty(n, dtype=np.int64)
-    new_of[perm] = np.arange(n, dtype=np.int64)
-    old_to_new = new_of[:n_old]
-    tail_to_new = new_of[n_old:]
 
-    old_lut = np.fromiter((tenant_idx_of[k] for k in old_tenant_keys),
-                          dtype=np.uint32, count=len(old_tenant_keys))
-    t_idx_all = np.concatenate([
-        old_lut[snap.t_idx] if n_old else np.empty(0, dtype=np.uint32),
-        np.fromiter((tenant_idx_of[(a, p)]
-                     for a, p, _h, _l, _t in t_items),
-                    dtype=np.uint32, count=n_tail)])[perm].astype(
-                        np.uint32)
+    # duplicate collapse: equal (acct,proj,hi,lo) runs share one new row
+    sa, sp_, sh, sl = acct[perm], proj[perm], hi[perm], lo[perm]
+    first = np.ones(n_total, dtype=bool)
+    if n_total > 1:
+        first[1:] = ~((sa[1:] == sa[:-1]) & (sp_[1:] == sp_[:-1]) &
+                      (sh[1:] == sh[:-1]) & (sl[1:] == sl[:-1]))
+    new_idx_sorted = np.cumsum(first) - 1          # sorted pos -> new row
+    n = int(new_idx_sorted[-1]) + 1 if n_total else 0
+    new_of = np.empty(n_total, dtype=np.int64)     # source pos -> new row
+    new_of[perm] = new_idx_sorted
 
-    # tags: slice table in merged order (old rows copy bytes, no decode)
-    old_lens = np.diff(snap.tag_off.astype(np.int64))
-    t_tag_bytes = [t.encode("utf-8") for _a, _p, _h, _l, t in t_items]
-    lens_all = np.concatenate([
-        old_lens, np.fromiter((len(b) for b in t_tag_bytes),
-                              dtype=np.int64, count=n_tail)])[perm]
+    keep_pos = perm[first]                         # source pos of kept rows
+    t_idx_all = np.fromiter(
+        (tenant_idx_of[(int(a), int(p))]
+         for a, p in zip(sa[first], sp_[first])),
+        dtype=np.uint32, count=n)
+
+    # tags: gather kept rows' bytes from the concatenated source blobs
+    blob_base = np.zeros(len(snaps) + 1, dtype=np.int64)
+    np.cumsum(np.asarray([len(s.tags_blob) for s in snaps],
+                         dtype=np.int64), out=blob_base[1:])
+    src_tag_start = np.concatenate(
+        [s.tag_off[:s.n].astype(np.int64) + blob_base[k]
+         for k, s in enumerate(snaps)]) if n_total else \
+        np.empty(0, dtype=np.int64)
+    src_tag_len = np.concatenate(
+        [np.diff(s.tag_off.astype(np.int64)) for s in snaps]) \
+        if n_total else np.empty(0, dtype=np.int64)
+    lens_kept = src_tag_len[keep_pos]
     tag_off = np.zeros(n + 1, dtype=np.uint64)
-    np.cumsum(lens_all, out=tag_off[1:])
-    # one fancy gather instead of a per-row slice loop: concatenate the
-    # source blobs, compute each merged row's source start, and index
-    big_src = np.frombuffer(snap.tags_blob + b"".join(t_tag_bytes),
-                            dtype=np.uint8)
-    t_lens = np.fromiter((len(b) for b in t_tag_bytes), dtype=np.int64,
-                         count=n_tail)
-    t_starts = np.zeros(n_tail, dtype=np.int64)
-    np.cumsum(t_lens[:-1], out=t_starts[1:])
-    src_starts = np.concatenate([
-        snap.tag_off[:n_old].astype(np.int64),
-        t_starts + len(snap.tags_blob)])[perm]
+    np.cumsum(lens_kept, out=tag_off[1:])
     total_bytes = int(tag_off[n])
-    assert total_bytes < 2 ** 31, "tags blob exceeds int32 gather range"
+    big_src = np.frombuffer(b"".join(s.tags_blob for s in snaps),
+                            dtype=np.uint8)
     out_off = tag_off[:n].astype(np.int64)
-    gather = (np.repeat(src_starts - out_off, lens_all) +
-              np.arange(total_bytes, dtype=np.int64)).astype(np.int32)
-    tags_blob = big_src[gather].tobytes()
+    # chunked gather: an index entry per output byte costs 8x the blob;
+    # bound the transient to ~8MB of payload (64MB of index) per step
+    tags_out = np.empty(total_bytes, dtype=np.uint8)
+    _CHUNK_BYTES = 8 << 20
+    row = 0
+    while row < n:
+        hic = int(np.searchsorted(out_off,
+                                  out_off[row] + _CHUNK_BYTES, "right"))
+        hic = max(hic, row + 1)
+        lens_c = lens_kept[row:hic]
+        nb = int(lens_c.sum())
+        if nb:
+            dst0 = int(out_off[row])
+            gather = (np.repeat(src_tag_start[keep_pos[row:hic]] -
+                                (out_off[row:hic] - dst0), lens_c) +
+                      np.arange(nb, dtype=np.int64))
+            tags_out[dst0:dst0 + nb] = big_src[gather]
+        row = hic
+    tags_blob = tags_out.tobytes() if total_bytes else b""
 
-    arrays = {"t_idx": t_idx_all, "hi": hi[perm], "lo": lo[perm],
+    arrays = {"t_idx": t_idx_all, "hi": sh[first], "lo": sl[first],
               "tag_off": tag_off, "tags_blob": tags_blob}
 
-    # postings: old tables remap; tail postings (parsed here, tail only)
-    # merge in per (tenant, label, value)
-    tail_post: dict = {}
-    for k, (a, p, _h, _l, tags) in enumerate(t_items):
-        ti = tenant_idx_of[(a, p)]
-        per = tail_post.setdefault(ti, {})
-        for label, value in parse_stream_tags(tags).items():
-            per.setdefault(label, {}).setdefault(value, []).append(
-                int(tail_to_new[k]))
+    # postings: per (new tenant, label), gather every source table,
+    # remap ids, regroup by value with a stable two-pass sort
+    by_key: dict = {}            # (new_ti, label) -> [(vals_S, ids_i64)]
+    for k, s in enumerate(snaps):
+        old_keys = [(t.account_id, t.project_id) for t in s.tenants]
+        for old_ti_s, labels in s._labels_meta.items():
+            old_ti = int(old_ti_s)
+            ti = tenant_idx_of[old_keys[old_ti]]
+            for label in labels:
+                vtab, counts, idx_blob, any_blob = \
+                    s.label_arrays(old_ti, label)
+                ids = new_of[base_of[k] + idx_blob.astype(np.int64)]
+                vals = np.repeat(vtab, counts)
+                any_ids = new_of[base_of[k] + any_blob.astype(np.int64)]
+                by_key.setdefault((ti, label), []).append(
+                    (vals, ids, any_ids))
 
     labels_meta: dict = {}
-    old_ti_of = {i: int(old_lut[i]) for i in range(len(old_tenant_keys))}
-    seen: set = set()
-    # old labels (remapped, merged with any tail postings on the same key)
-    for old_ti_s, labels in snap._labels_meta.items():
-        old_ti = int(old_ti_s)
-        ti = old_ti_of[old_ti]
-        for label in labels:
-            seen.add((ti, label))
-            base = f"p{old_ti}:{label}"
-            vtab = snap._arrays[base + ":v"]
-            counts = snap._arrays[base + ":c"]
-            idx_blob = old_to_new[snap._arrays[base + ":i"]]
-            any_arr = np.sort(old_to_new[snap._arrays[base + ":a"]])
-            extra = tail_post.get(ti, {}).pop(label, None)
-            if extra:
-                any_arr = np.sort(np.concatenate(
-                    [any_arr,
-                     np.fromiter(sorted({i for ids in extra.values()
-                                         for i in ids}),
-                                 dtype=np.int64)]))
-            if _merge_label_vectorized(arrays, labels_meta, ti, label,
-                                       vtab, counts, idx_blob, extra,
-                                       any_arr):
-                continue
-            # general path: few distinct values (dict-style labels)
-            starts = np.zeros(len(counts) + 1, dtype=np.int64)
-            np.cumsum(counts, out=starts[1:])
-            values = {v.decode("utf-8"):
-                      idx_blob[starts[k]:starts[k + 1]]
-                      for k, v in enumerate(vtab)}
-            if extra:
-                for v, ids in extra.items():
-                    ids = np.asarray(ids, dtype=np.int64)
-                    values[v] = np.sort(np.concatenate(
-                        [np.asarray(values.get(
-                            v, np.empty(0, dtype=np.int64)),
-                            dtype=np.int64), ids]))
-            _emit_label(arrays, labels_meta, ti, label, values, any_arr)
-    # labels that exist only in the tail
-    for ti, per in tail_post.items():
-        for label, vals in per.items():
-            if (ti, label) in seen:
-                continue
-            values = {v: np.asarray(sorted(ids), dtype=np.int64)
-                      for v, ids in vals.items()}
-            any_arr = np.fromiter(
-                sorted({i for ids in vals.values() for i in ids}),
-                dtype=np.int64)
-            _emit_label(arrays, labels_meta, ti, label, values, any_arr)
+    for (ti, label), parts in by_key.items():
+        w = max(int(v.dtype.itemsize) for v, _i, _a in parts) or 1
+        vcat = np.concatenate([v.astype(f"S{w}") for v, _i, _a in parts])
+        icat = np.concatenate([i for _v, i, _a in parts])
+        # stable two-pass == lexsort by (value, id) without S-dtype keys
+        o1 = np.argsort(icat, kind="stable")
+        o2 = np.argsort(vcat[o1], kind="stable")
+        order = o1[o2]
+        sv, si = vcat[order], icat[order]
+        if sv.size > 1:                       # drop (value,id) duplicates
+            dup = (sv[1:] == sv[:-1]) & (si[1:] == si[:-1])
+            if dup.any():
+                keep = np.concatenate([[True], ~dup])
+                sv, si = sv[keep], si[keep]
+        # run-length by value -> vtab/counts/idx_blob
+        if sv.size:
+            starts = np.concatenate(
+                [[True], sv[1:] != sv[:-1]]).nonzero()[0]
+            vtab_new = sv[starts]
+            counts_new = np.diff(
+                np.concatenate([starts, [sv.size]])).astype(np.uint32)
+        else:
+            vtab_new = sv
+            counts_new = np.empty(0, dtype=np.uint32)
+        any_new = np.unique(np.concatenate([a for _v, _i, a in parts]))
+        base = f"p{ti}:{label}"
+        arrays[base + ":v"] = vtab_new
+        arrays[base + ":c"] = counts_new
+        arrays[base + ":i"] = si.astype(np.uint32)
+        arrays[base + ":a"] = any_new.astype(np.uint32)
+        labels_meta.setdefault(str(ti), {})[label] = {"w": w}
 
-    _finish_snapshot(path, arrays, n, tenants, labels_meta, log_offset)
-
-
-def _merge_label_vectorized(arrays: dict, labels_meta: dict, ti: int,
-                            label: str, vtab, counts, idx_blob, extra,
-                            any_arr) -> bool:
-    """Pure-numpy merge for the high-cardinality shape where every value
-    posts exactly ONE stream on both sides and no value repeats across
-    sides (host-/id-like labels — exactly where a Python per-value loop
-    hurts).  Returns False to use the general path otherwise."""
-    if counts.size and int(counts.max()) > 1:
-        return False
-    if extra is not None and any(len(ids) != 1 for ids in extra.values()):
-        return False
-    if extra:
-        skeys = sorted(extra, key=lambda v: v.encode("utf-8"))
-        t_vals = np.array([v.encode("utf-8") for v in skeys], dtype="S")
-        w = max(int(vtab.dtype.itemsize), int(t_vals.dtype.itemsize))
-        t_ids = np.fromiter((extra[v][0] for v in skeys),
-                            dtype=np.uint32, count=len(skeys))
-        combined = np.concatenate([vtab.astype(f"S{w}"),
-                                   t_vals.astype(f"S{w}")])
-        ids_all = np.concatenate([idx_blob.astype(np.uint32), t_ids])
-    else:
-        combined = vtab
-        ids_all = idx_blob.astype(np.uint32)
-    order = np.argsort(combined, kind="stable")
-    merged_vals = combined[order]
-    if merged_vals.size > 1 and \
-            bool((merged_vals[1:] == merged_vals[:-1]).any()):
-        return False  # a value on both sides: counts would exceed 1
-    base = f"p{ti}:{label}"
-    arrays[base + ":v"] = merged_vals
-    arrays[base + ":c"] = np.ones(merged_vals.size, dtype=np.uint32)
-    arrays[base + ":i"] = ids_all[order]
-    arrays[base + ":a"] = np.asarray(any_arr, dtype=np.uint32)
-    labels_meta.setdefault(str(ti), {})[label] = {
-        "w": int(merged_vals.dtype.itemsize) or 1}
-    return True
+    _finish_snapshot(path, arrays, n, tenant_keys, labels_meta,
+                     log_offset)
 
 
 def _emit_label(arrays: dict, labels_meta: dict, ti: int, label: str,
@@ -391,21 +361,59 @@ class _LabelPostings:
 
 
 class StreamSnapshot:
-    """Read-only view over one snapshot file."""
+    """Read-only view over one snapshot file (v2 sectioned/mmap, or the
+    legacy v1 single-frame format for files written before round 5)."""
 
     def __init__(self, path: str):
-        with open(path, "rb") as f:
-            magic = f.read(len(SNAP_MAGIC))
-            if magic != SNAP_MAGIC:
-                raise ValueError("bad snapshot magic")
-            raw = _zstd.decompress(f.read(), max_output_size=1 << 33)
+        f = open(path, "rb")
+        magic = f.read(len(SNAP2_MAGIC))
+        if magic == SNAP2_MAGIC:
+            self._init_v2(f)
+        elif magic == SNAP_MAGIC:
+            with f:
+                raw = _zstd.decompress(f.read(), max_output_size=1 << 33)
+            self._init_v1(raw)
+        else:
+            f.close()
+            raise ValueError("bad snapshot magic")
+        self._tenant_idx = {t: i for i, t in enumerate(self.tenants)}
+        self._postings_cache: dict = {}
+        # rows are sorted by (tenant, hi, lo): per-tenant contiguous slices
+        self._tenant_bounds = np.searchsorted(
+            self.t_idx, np.arange(len(self.tenants) + 1, dtype=np.uint32))
+
+    def _init_v2(self, f) -> None:
+        import mmap as _mmap
+        hlen = struct.unpack(">I", f.read(4))[0]
+        hdr = json.loads(f.read(hlen))
+        self._sections = hdr["sections"]
+        need = len(SNAP2_MAGIC) + 4 + hlen + max(
+            (m["off"] + m["len"] for m in self._sections.values()),
+            default=0)
+        size = os.fstat(f.fileno()).st_size
+        if size < need:
+            f.close()
+            raise ValueError("truncated snapshot")
+        self._mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        f.close()                      # the mmap keeps the file alive
+        self._data0 = len(SNAP2_MAGIC) + 4 + hlen
+        self.n = hdr["n"]
+        self.log_offset = hdr["log_offset"]
+        self.tenants = [TenantID(a, p) for a, p in hdr["tenants"]]
+        self._labels_meta = hdr["labels"]
+        self.t_idx = self._reg_array("t_idx")
+        self.hi = self._reg_array("hi")
+        self.lo = self._reg_array("lo")
+        self.tag_off = self._reg_array("tag_off")
+        self._tags_blob: bytes | None = None
+
+    def _init_v1(self, raw: bytes) -> None:
         hlen = struct.unpack(">I", raw[:4])[0]
         hdr = json.loads(raw[4:4 + hlen])
         blob = memoryview(raw)[4 + hlen:]
-        self.n: int = hdr["n"]
-        self.log_offset: int = hdr["log_offset"]
+        self.n = hdr["n"]
+        self.log_offset = hdr["log_offset"]
         self.tenants = [TenantID(a, p) for a, p in hdr["tenants"]]
-        self._tenant_idx = {t: i for i, t in enumerate(self.tenants)}
         arrays = {}
         for name, m in hdr["arrays"].items():
             seg = blob[m["off"]:m["off"] + m["len"]]
@@ -415,13 +423,52 @@ class StreamSnapshot:
         self.hi = arrays["hi"]
         self.lo = arrays["lo"]
         self.tag_off = arrays["tag_off"]
-        self.tags_blob = arrays["tags_blob"]
+        self._tags_blob = arrays["tags_blob"]
         self._labels_meta = hdr["labels"]
-        self._arrays = arrays
-        self._postings_cache: dict = {}
-        # rows are sorted by (tenant, hi, lo): per-tenant contiguous slices
-        self._tenant_bounds = np.searchsorted(
-            self.t_idx, np.arange(len(self.tenants) + 1, dtype=np.uint32))
+        self._v1_arrays = arrays
+        self._mm = None
+
+    def _reg_array(self, name: str) -> np.ndarray:
+        m = self._sections[name]
+        dt = np.dtype(m["dtype"])
+        return np.frombuffer(self._mm, dtype=dt,
+                             count=m["len"] // dt.itemsize,
+                             offset=self._data0 + m["off"])
+
+    def _section_bytes(self, name: str) -> bytes:
+        m = self._sections[name]
+        start = self._data0 + m["off"]
+        raw = self._mm[start:start + m["len"]]
+        if m["comp"] == "zstd":
+            return _zstd.decompress(raw, max_output_size=1 << 33)
+        return raw
+
+    @property
+    def tags_blob(self) -> bytes:
+        if self._tags_blob is None:
+            self._tags_blob = self._section_bytes("tags_blob")
+        return self._tags_blob
+
+    def label_arrays(self, ti: int, label: str):
+        """(vtab, counts, idx_blob, any) for one (tenant, label) — the
+        raw posting tables, decoded lazily for v2 sections.  Used by
+        label_postings and the k-way merge."""
+        base = f"p{ti}:{label}"
+        if self._mm is None:                      # v1: already in memory
+            a = self._v1_arrays
+            return (a[base + ":v"], a[base + ":c"], a[base + ":i"],
+                    a[base + ":a"])
+        blob = self._section_bytes(base)
+        nv, w, ni, na = struct.unpack_from("<IIQQ", blob, 0)
+        o = struct.calcsize("<IIQQ")
+        v = np.frombuffer(blob, dtype=f"S{w}", count=nv, offset=o)
+        o += nv * w
+        c = np.frombuffer(blob, dtype=np.uint32, count=nv, offset=o)
+        o += nv * 4
+        i = np.frombuffer(blob, dtype=np.uint32, count=int(ni), offset=o)
+        o += int(ni) * 4
+        a = np.frombuffer(blob, dtype=np.uint32, count=int(na), offset=o)
+        return v, c, i, a
 
     # ---- registry lookups ----
     def find(self, sid: StreamID) -> int:
@@ -439,6 +486,35 @@ class StreamSnapshot:
                 return -1
             i += 1
         return -1
+
+    def contains_batch(self, tenant: TenantID, hi_arr: np.ndarray,
+                       lo_arr: np.ndarray) -> np.ndarray:
+        """Vectorized membership for one tenant's (hi, lo) id batch.
+
+        Registration dedupe calls this once per snapshot level instead of
+        a Python find() per stream: the hi probe is one searchsorted pair;
+        the per-id loop below only runs for ids whose 64-bit hi hash HAS a
+        run in this snapshot (i.e. ids that are present, or ~n/2^64
+        false candidates), so registering new streams stays loop-free."""
+        out = np.zeros(hi_arr.size, dtype=bool)
+        ti = self._tenant_idx.get(tenant)
+        if ti is None:
+            return out
+        s, e = (int(self._tenant_bounds[ti]),
+                int(self._tenant_bounds[ti + 1]))
+        if s == e:
+            return out
+        seg_hi = self.hi[s:e]
+        seg_lo = self.lo[s:e]
+        h = hi_arr.astype(np.uint64, copy=False)
+        left = np.searchsorted(seg_hi, h, side="left")
+        right = np.searchsorted(seg_hi, h, side="right")
+        for k in np.nonzero(right > left)[0].tolist():
+            lw, r = int(left[k]), int(right[k])
+            j = lw + int(np.searchsorted(seg_lo[lw:r], lo_arr[k]))
+            if j < r and seg_lo[j] == lo_arr[k]:
+                out[k] = True
+        return out
 
     def tags_at(self, i: int) -> str:
         a, b = int(self.tag_off[i]), int(self.tag_off[i + 1])
@@ -477,10 +553,6 @@ class StreamSnapshot:
             return got
         if label not in self._labels_meta.get(str(ti), {}):
             return None
-        base = f"p{ti}:{label}"
-        lp = _LabelPostings(self._arrays[base + ":v"],
-                            self._arrays[base + ":c"],
-                            self._arrays[base + ":i"],
-                            self._arrays[base + ":a"])
+        lp = _LabelPostings(*self.label_arrays(ti, label))
         self._postings_cache[key] = lp
         return lp
