@@ -5,7 +5,7 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test bench clean
+.PHONY: all native test lint bench clean
 
 all: native
 
@@ -16,6 +16,12 @@ $(NATIVE_DIR)/libvlnative.so: $(NATIVE_DIR)/vlnative.cpp
 
 test:
 	python -m pytest tests/ -x -q
+
+# repo-native static analysis (tools/vlint/README.md) + a compile sweep.
+# Fails on any finding not in tools/vlint/baseline.json.
+lint:
+	python -m tools.vlint victorialogs_tpu/
+	python -m compileall -q victorialogs_tpu tools tests
 
 bench:
 	python bench.py

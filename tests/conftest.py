@@ -34,3 +34,44 @@ try:
     _jax.config.update("jax_platforms", "cpu")
 except Exception:  # pragma: no cover - plain environments need no surgery
     pass
+
+# ---- vlint runtime lock-order sanitizer (opt-in) ----
+# VLINT_LOCK_ORDER=1 wraps every threading.Lock constructed inside
+# victorialogs_tpu with an acquisition-order-recording shim
+# (tools/vlint/runtime.py).  Installed here, at conftest import, so it
+# precedes every storage/server object the tests build.  At session end
+# the observed acquisition graph must (a) contain no runtime-observed
+# cycle and (b) stay acyclic when merged with the static lock-order
+# graph from tools.vlint.locks — the race suites and the static
+# analyzer validate each other.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_VLINT_SANITIZER = None
+if os.environ.get("VLINT_LOCK_ORDER") == "1":
+    import sys
+
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from tools.vlint.runtime import install as _vlint_install
+
+    _VLINT_SANITIZER = _vlint_install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _VLINT_SANITIZER is None:
+        return
+    from tools.vlint.locks import build_static_graph
+
+    edges, site_map = build_static_graph(
+        [os.path.join(_REPO_ROOT, "victorialogs_tpu")], root=_REPO_ROOT)
+    problems = _VLINT_SANITIZER.check_static_consistency(edges, site_map)
+    n_edges = len(_VLINT_SANITIZER.edges)
+    if problems:
+        print("\nvlint lock-order sanitizer FAILED "
+              f"({n_edges} observed edge(s)):")
+        for p in problems:
+            print(f"  {p}")
+        session.exitstatus = 1
+    else:
+        print(f"\nvlint lock-order sanitizer: {n_edges} observed "
+              "acquisition edge(s), consistent with the static graph")

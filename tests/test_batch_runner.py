@@ -176,3 +176,44 @@ def test_staging_cache_eviction_under_pressure(tmp_path):
         assert runner.cache.misses > len(queries) * 2
     finally:
         s.close()
+
+
+def test_close_never_leaks_a_racing_prefetch_pool(monkeypatch):
+    """Regression (vlint lock-unguarded-write): close() cleared
+    self._prefetch_pool without _counter_mu, so a partition worker
+    racing through _prefetcher() could publish a fresh pool that
+    close() then overwrote with None — leaking a live worker thread.
+    Both sides now serialize on _counter_mu: after the final close,
+    every pool ever created must be shut down."""
+    import concurrent.futures as cf
+    import threading
+
+    created = []
+    real_pool = cf.ThreadPoolExecutor
+
+    class TrackingPool(real_pool):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            created.append(self)
+
+    monkeypatch.setattr(cf, "ThreadPoolExecutor", TrackingPool)
+    runner = BatchRunner()
+    stop = threading.Event()
+
+    def prefetch_loop():
+        while not stop.is_set():
+            runner._prefetcher()
+
+    workers = [threading.Thread(target=prefetch_loop, daemon=True)
+               for _ in range(2)]
+    for t in workers:
+        t.start()
+    for _ in range(300):
+        runner.close()
+    stop.set()
+    for t in workers:
+        t.join()
+    runner.close()
+    assert created, "prefetcher never built a pool"
+    leaked = [p for p in created if not p._shutdown]
+    assert not leaked, f"{len(leaked)} pool(s) leaked un-shut-down"

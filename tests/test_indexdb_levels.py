@@ -127,3 +127,50 @@ def test_re_registration_across_levels_is_deduped(tmp_path, monkeypatch):
     db2 = IndexDB(d)
     assert db2.num_streams() == before + 5
     db2.close()
+
+
+def test_snapshot_accounting_exact_under_concurrent_flushes(
+        tmp_path, monkeypatch):
+    """Regression (vlint lock-unguarded-write): snap_files_written /
+    snap_bytes_written were `+=`-ed from the background compaction
+    thread without the lock, racing foreground flush accounting and
+    losing updates.  Accounting now happens under self._lock at every
+    call site — the counters must match the snapshot writes exactly."""
+    import threading
+
+    counts = {"n": 0}
+    mu = threading.Lock()
+    real_write, real_merge = idb_mod.write_snapshot, idb_mod.merge_snapshots
+
+    def counting_write(path, streams, log_offset):
+        with mu:
+            counts["n"] += 1
+        return real_write(path, streams, log_offset)
+
+    def counting_merge(path, srcs, log_offset):
+        with mu:
+            counts["n"] += 1
+        return real_merge(path, srcs, log_offset)
+
+    monkeypatch.setattr(idb_mod, "write_snapshot", counting_write)
+    monkeypatch.setattr(idb_mod, "merge_snapshots", counting_merge)
+    monkeypatch.setattr(idb_mod, "COMPACT_TAIL_STREAMS", 100)
+    monkeypatch.setattr(idb_mod, "MAX_SNAPSHOTS", 4)
+    monkeypatch.setattr(idb_mod, "MERGE_BATCH", 3)
+    db = IndexDB(str(tmp_path / "idb"))
+
+    def register(worker):
+        for start in range(0, 1000, 50):
+            db.must_register_streams(
+                [_mk(worker * 10_000 + start + i) for i in range(50)])
+
+    threads = [threading.Thread(target=register, args=(w,), daemon=True)
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    db.force_merge()
+    db.close()
+    assert db.snap_files_written == counts["n"]
+    assert db.snap_bytes_written > 0

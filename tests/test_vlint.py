@@ -1,0 +1,487 @@
+"""vlint: per-checker fixtures (violating / clean / annotated), the
+runtime lock-order sanitizer, the CLI exit codes, and the tier-1 gate
+asserting the repo itself is clean against the committed baseline."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.vlint.core import (load_baseline, new_findings, run_paths,
+                              run_source)
+from tools.vlint.runtime import (InstrumentedLock, LockOrderSanitizer,
+                                 install, uninstall)
+
+
+def lint(src: str, path: str = "victorialogs_tpu/mod.py"):
+    return run_source(path, textwrap.dedent(src))
+
+
+def checkers(findings):
+    return {f.checker for f in findings}
+
+
+# ---------------- lock discipline ----------------
+
+LOCK_BASE = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.x = 0
+
+        def good(self):
+            with self._lock:
+                self.x += 1
+"""
+
+
+def test_unguarded_write_flagged():
+    out = lint(LOCK_BASE + """
+        def bad(self):
+            self.x = 5
+    """)
+    assert "lock-unguarded-write" in checkers(out)
+    assert any("self.x" in f.message for f in out)
+
+
+def test_unguarded_write_clean_and_init_exempt():
+    assert "lock-unguarded-write" not in checkers(lint(LOCK_BASE))
+
+
+def test_unguarded_write_annotated():
+    out = lint(LOCK_BASE + """
+        def bad(self):
+            # vlint: allow-lock-unguarded-write(single-writer thread)
+            self.x = 5
+    """)
+    assert "lock-unguarded-write" not in checkers(out)
+
+
+def test_unguarded_write_through_private_helper():
+    # a private method reached both locked and unlocked: the unlocked
+    # path must flag (the indexdb._account_write class of race)
+    out = lint(LOCK_BASE + """
+        def _bump(self):
+            self.x += 1
+
+        def locked_path(self):
+            with self._lock:
+                self._bump()
+
+        def unlocked_path(self):
+            self._bump()
+    """)
+    assert "lock-unguarded-write" in checkers(out)
+
+
+def test_blocking_call_under_lock_flagged():
+    out = lint(LOCK_BASE + """
+        def bad(self):
+            with self._lock:
+                with open("/tmp/f") as f:
+                    return f.read()
+    """)
+    assert "lock-blocking-call" in checkers(out)
+
+
+def test_blocking_call_outside_lock_clean():
+    out = lint(LOCK_BASE + """
+        def fine(self):
+            with open("/tmp/f") as f:
+                return f.read()
+    """)
+    assert "lock-blocking-call" not in checkers(out)
+
+
+def test_blocking_call_annotated():
+    out = lint(LOCK_BASE + """
+        # vlint: allow-lock-blocking-call(durability by design)
+        def bad(self):
+            with self._lock:
+                with open("/tmp/f") as f:
+                    return f.read()
+    """)
+    assert "lock-blocking-call" not in checkers(out)
+
+
+def test_os_path_join_not_blocking():
+    out = lint(LOCK_BASE + """
+        def fine(self):
+            import os
+            with self._lock:
+                return os.path.join("a", "b")
+    """)
+    assert "lock-blocking-call" not in checkers(out)
+
+
+def test_lock_order_cycle_flagged():
+    out = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "lock-order-cycle" in checkers(out)
+
+
+def test_lock_order_consistent_clean():
+    out = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert "lock-order-cycle" not in checkers(out)
+
+
+def test_self_reacquire_flagged():
+    out = lint(LOCK_BASE + """
+        def bad(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """)
+    assert "lock-order-cycle" in checkers(out)
+
+
+# ---------------- hygiene ----------------
+
+def test_broad_except_flagged():
+    out = lint("""
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 0
+    """)
+    assert "broad-except" in checkers(out)
+
+
+def test_broad_except_reraise_clean():
+    out = lint("""
+        def f():
+            try:
+                return 1
+            except Exception:
+                raise
+    """)
+    assert "broad-except" not in checkers(out)
+
+
+def test_broad_except_annotated():
+    out = lint("""
+        def f():
+            try:
+                return 1
+            # vlint: allow-broad-except(best-effort)
+            except Exception:
+                return 0
+    """)
+    assert "broad-except" not in checkers(out)
+
+
+def test_mutable_default_flagged_and_clean():
+    assert "mutable-default" in checkers(lint("def f(a, b=[]): pass"))
+    assert "mutable-default" not in checkers(
+        lint("def f(a, b=None, c=()): pass"))
+
+
+def test_wall_clock_flagged_clean_annotated():
+    assert "wall-clock" in checkers(lint("""
+        import time
+        def f():
+            return time.time()
+    """))
+    assert "wall-clock" not in checkers(lint("""
+        import time
+        def f():
+            return time.monotonic(), time.time_ns()
+    """))
+    assert "wall-clock" not in checkers(lint("""
+        import time
+        def f():
+            # vlint: allow-wall-clock(persisted timestamp)
+            return time.time()
+    """))
+
+
+def test_nondaemon_thread_flagged_and_clean():
+    assert "nondaemon-thread" in checkers(lint("""
+        import threading
+        def f():
+            threading.Thread(target=f).start()
+    """))
+    assert "nondaemon-thread" not in checkers(lint("""
+        import threading
+        def f():
+            threading.Thread(target=f, daemon=True).start()
+    """))
+
+
+# ---------------- JAX hot path ----------------
+
+def test_host_sync_flagged():
+    out = lint("""
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            return float(x)
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-host-sync" in checkers(out)
+
+
+def test_host_sync_out_of_scope_and_clean():
+    src = """
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            return float(x)
+    """
+    # same code outside tpu/ or engine/ is not hot-path scoped
+    assert "jax-host-sync" not in checkers(
+        lint(src, path="victorialogs_tpu/storage/mod.py"))
+    clean = """
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            return x
+    """
+    assert "jax-host-sync" not in checkers(
+        lint(clean, path="victorialogs_tpu/tpu/mod.py"))
+
+
+def test_host_sync_annotated_and_variants():
+    out = lint("""
+        import jax.numpy as jnp
+        import numpy as np
+        def f(a):
+            x = jnp.sum(a)
+            # vlint: allow-jax-host-sync(result boundary)
+            return np.asarray(x)
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-host-sync" not in checkers(out)
+    out = lint("""
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            if x:
+                return x.item()
+            return 0
+    """, path="victorialogs_tpu/tpu/mod.py")
+    msgs = [f.message for f in out if f.checker == "jax-host-sync"]
+    assert any("truth test" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+
+
+def test_jit_closure_flagged_and_clean():
+    out = lint("""
+        import jax
+        state = {"k": 1}
+        @jax.jit
+        def f(x):
+            return x + state["k"]
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-jit-closure" in checkers(out)
+    out = lint("""
+        import jax
+        K = 2
+        @jax.jit
+        def f(x):
+            return x + K
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-jit-closure" not in checkers(out)
+
+
+def test_static_arg_flagged_and_clean():
+    out = lint("""
+        import jax
+        from functools import partial
+        n = 3
+        @partial(jax.jit, static_argnums=n)
+        def f(x):
+            return x
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-static-arg" in checkers(out)
+    out = lint("""
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnums=(0, 1),
+                 static_argnames=("mode",))
+        def f(x, n, mode=0):
+            return x
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "jax-static-arg" not in checkers(out)
+
+
+# ---------------- baseline workflow ----------------
+
+def test_baseline_absorbs_then_catches_new(tmp_path):
+    from tools.vlint.core import write_baseline
+    src = textwrap.dedent("""
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 0
+    """)
+    found = run_source("victorialogs_tpu/mod.py", src)
+    assert found
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(found, bl_path)
+    assert new_findings(found, load_baseline(bl_path)) == []
+    # a SECOND identical violation exceeds the baselined count
+    src2 = src + textwrap.dedent("""
+        def g():
+            try:
+                return 1
+            except Exception:
+                return 0
+    """)
+    found2 = run_source("victorialogs_tpu/mod.py", src2)
+    fresh = new_findings(found2, load_baseline(bl_path))
+    assert len(fresh) == 1
+
+
+# ---------------- runtime lock-order sanitizer ----------------
+
+def test_sanitizer_detects_inversion():
+    san = LockOrderSanitizer()
+    a = InstrumentedLock(san, "victorialogs_tpu/x.py:1")
+    b = InstrumentedLock(san, "victorialogs_tpu/x.py:2")
+    with a:
+        with b:
+            pass
+    assert not san.violations
+    with b:
+        with a:
+            pass
+    assert san.violations, "A->B then B->A must be a violation"
+
+
+def test_sanitizer_static_consistency():
+    san = LockOrderSanitizer()
+    a = InstrumentedLock(san, "victorialogs_tpu/x.py:1")
+    b = InstrumentedLock(san, "victorialogs_tpu/x.py:2")
+    with a:
+        with b:
+            pass
+    site_map = {("victorialogs_tpu/x.py", 1): "C._a",
+                ("victorialogs_tpu/x.py", 2): "C._b"}
+    # observed a->b agrees with static a->b
+    assert san.check_static_consistency({("C._a", "C._b")}, site_map) == []
+    # observed a->b REVERSES a static b->a edge
+    problems = san.check_static_consistency({("C._b", "C._a")}, site_map)
+    assert problems
+
+
+def test_sanitizer_install_scopes_to_repo(tmp_path):
+    import threading
+
+    import pytest
+
+    from tools.vlint.runtime import get_sanitizer
+    if get_sanitizer() is not None:
+        pytest.skip("session-wide sanitizer active (VLINT_LOCK_ORDER=1);"
+                    " uninstalling here would disarm it")
+    try:
+        san = install()
+        # a lock created from repo code is instrumented ...
+        from victorialogs_tpu.utils.cache import TwoGenCache
+        c = TwoGenCache()
+        assert isinstance(c._lock, InstrumentedLock)
+        c.put("k", "v")
+        assert c.get("k") == "v"
+        # ... a lock created from non-repo code is not
+        assert not isinstance(threading.Lock(), InstrumentedLock)
+        assert not san.violations
+    finally:
+        uninstall()
+
+
+def test_sanitizer_condition_wait_order():
+    # Condition(instrumented lock): wait() releases out of LIFO order —
+    # the held-stack bookkeeping must survive it
+    import threading
+    san = LockOrderSanitizer()
+    lk = InstrumentedLock(san, "victorialogs_tpu/x.py:9")
+    cond = threading.Condition(lk)
+    with cond:
+        cond.wait(timeout=0.01)
+    assert not san.violations
+    assert san._stack() == []
+
+
+# ---------------- the tier-1 gate + CLI ----------------
+
+def test_repo_is_clean_against_baseline():
+    findings = run_paths([os.path.join(REPO, "victorialogs_tpu")],
+                         root=REPO)
+    fresh = new_findings(findings, load_baseline())
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.vlint", "victorialogs_tpu"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    # one seeded violation per checker family must each fail the CLI
+    seeds = {
+        "locks.py": LOCK_BASE + """
+        def bad(self):
+            self.x = 5
+        """,
+        "hygiene.py": """
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 0
+        """,
+        os.path.join("tpu", "hot.py"): """
+        import jax.numpy as jnp
+        def f(a):
+            return float(jnp.sum(a))
+        """,
+    }
+    for rel, src in seeds.items():
+        p = tmp_path / "pkg" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.vlint", str(p.parent),
+             "--no-baseline"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert r.returncode == 1, f"{rel}: {r.stdout}{r.stderr}"
+        p.unlink()

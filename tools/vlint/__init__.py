@@ -1,0 +1,23 @@
+"""vlint — repo-native static analysis for victorialogs_tpu.
+
+Three checker families (see tools/vlint/README.md):
+
+- lock discipline (locks.py): unguarded writes to lock-guarded
+  attributes, blocking calls made while a lock is held, and a
+  cross-method lock-acquisition-order graph with cycle detection.
+- JAX hot path (hotpath.py): implicit host syncs on device values,
+  jit closures over mutable state, unstable static_argnums.
+- hygiene (hygiene.py): silent broad excepts, mutable default args,
+  time.time() used for durations, non-daemon background threads.
+
+Findings are keyed to tools/vlint/baseline.json: pre-existing accepted
+sites don't fail the run, any NEW finding does.  Deliberate sites are
+annotated in source with `# vlint: allow-<checker>(<why>)`.
+
+Run as `python -m tools.vlint victorialogs_tpu/` or through the tier-1
+gate in tests/test_vlint.py.  The runtime lock-order sanitizer
+(runtime.py) is opt-in via VLINT_LOCK_ORDER=1 (wired in
+tests/conftest.py for the race suites).
+"""
+
+from .core import Finding, load_baseline, run_paths  # noqa: F401

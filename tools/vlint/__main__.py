@@ -1,0 +1,60 @@
+"""CLI: python -m tools.vlint [paths...] [options].
+
+Exit codes: 0 = clean (no findings beyond the baseline), 1 = new
+findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (BASELINE_DEFAULT, load_baseline, new_findings,
+                   run_paths, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.vlint",
+        description="repo-native static analysis for victorialogs_tpu")
+    ap.add_argument("paths", nargs="*", default=["victorialogs_tpu"],
+                    help="files or directories to check")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline file (default: tools/vlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    paths = args.paths or ["victorialogs_tpu"]
+
+    findings = run_paths(paths)
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh = new_findings(findings, baseline)
+    if args.as_json:
+        print(json.dumps({
+            "total": len(findings), "new": len(fresh),
+            "findings": [{"checker": f.checker, "path": f.path,
+                          "line": f.line, "symbol": f.symbol,
+                          "message": f.message,
+                          "fingerprint": f.fingerprint()}
+                         for f in fresh]}, indent=1))
+    else:
+        for f in fresh:
+            print(f.render())
+        base_n = len(findings) - len(fresh)
+        print(f"vlint: {len(fresh)} new finding(s), "
+              f"{base_n} baselined, {len(findings)} total")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""vlint core: findings, annotations, baseline, and the file runner.
+
+A Finding fingerprints to (path, checker, symbol, message) — no line
+numbers — so unrelated edits above a baselined site don't churn the
+baseline.  Duplicate fingerprints are counted: the baseline stores a
+count per fingerprint and only findings IN EXCESS of the baselined
+count are "new".
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# `# vlint: allow-<checker>(<why>)` — why is required: the annotation is
+# the documentation trail for every deliberately accepted site
+_ALLOW_RE = re.compile(r"#\s*vlint:\s*allow-([a-z0-9-]+)\s*\(([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str          # e.g. "lock-unguarded-write"
+    path: str             # repo-relative, forward slashes
+    line: int
+    symbol: str           # "Class.method", "function", or ""
+    message: str
+
+    def fingerprint(self) -> str:
+        raw = f"{self.path}|{self.checker}|{self.symbol}|{self.message}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.checker}{sym}: " \
+               f"{self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus its allow-annotations."""
+    path: str                      # as reported in findings
+    text: str
+    tree: ast.AST
+    # line -> set of allowed checker ids (annotation on that line)
+    allows: dict = field(default_factory=dict)
+    # (start, end) line ranges of function defs whose def line carries an
+    # annotation: the allow covers the whole function body
+    allow_spans: list = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, path: str, text: str | None = None,
+              display_path: str | None = None) -> "SourceFile":
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        tree = ast.parse(text, filename=path)
+        sf = cls(path=(display_path or path).replace(os.sep, "/"),
+                 text=text, tree=tree)
+        sf._collect_allows()
+        return sf
+
+    def _collect_allows(self) -> None:
+        for i, line in enumerate(self.text.splitlines(), start=1):
+            for m in _ALLOW_RE.finditer(line):
+                self.allows.setdefault(i, set()).add(m.group(1))
+        if not self.allows:
+            return
+        lines = self.text.splitlines()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # an annotation on the def line, a decorator line, or a
+                # contiguous comment block directly above covers the
+                # whole function
+                start = min([node.lineno]
+                            + [d.lineno for d in node.decorator_list])
+                head = set()
+                for ln in range(start, node.body[0].lineno):
+                    head |= self.allows.get(ln, set())
+                ln = start - 1
+                while ln >= 1 and lines[ln - 1].lstrip().startswith("#"):
+                    head |= self.allows.get(ln, set())
+                    ln -= 1
+                if head:
+                    end = max(n.lineno for n in ast.walk(node)
+                              if hasattr(n, "lineno"))
+                    self.allow_spans.append((node.lineno, end, head))
+
+    def allowed(self, checker: str, line: int) -> bool:
+        """True when `checker` findings at `line` are annotated away:
+        same line, the line above (comment-above style), or anywhere in
+        a function whose def line carries the annotation."""
+        for ln in (line, line - 1):
+            if checker in self.allows.get(ln, ()):
+                return True
+        for start, end, names in self.allow_spans:
+            if start <= line <= end and checker in names:
+                return True
+        return False
+
+
+# ---------------- baseline ----------------
+
+def load_baseline(path: str = BASELINE_DEFAULT) -> dict:
+    """fingerprint -> allowed count."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {fp: int(meta["count"])
+            for fp, meta in data.get("findings", {}).items()}
+
+
+def write_baseline(findings: list[Finding],
+                   path: str = BASELINE_DEFAULT) -> None:
+    agg: dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in agg:
+            agg[fp]["count"] += 1
+        else:
+            agg[fp] = {"count": 1, "checker": f.checker, "path": f.path,
+                       "note": f.message}
+    out = {"version": 1,
+           "comment": "accepted pre-existing vlint findings; "
+                      "regenerate with python -m tools.vlint "
+                      "--write-baseline <paths>",
+           "findings": {fp: agg[fp] for fp in sorted(agg)}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def new_findings(findings: list[Finding], baseline: dict) -> list[Finding]:
+    """Findings in excess of their baselined count, stable order."""
+    budget = dict(baseline)
+    out = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+# ---------------- runner ----------------
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(root, fn))
+    return out
+
+
+def _checkers():
+    # late import: checker modules import core for Finding
+    from . import hotpath, hygiene, locks
+    return [locks.check, hygiene.check, hotpath.check]
+
+
+def run_source(path: str, text: str, root: str = ".") -> list[Finding]:
+    """Run every checker over one in-memory module (test fixtures)."""
+    display = os.path.relpath(path, root) if os.path.isabs(path) else path
+    sf = SourceFile.parse(path, text=text, display_path=display)
+    found: list[Finding] = []
+    for chk in _checkers():
+        found.extend(chk(sf))
+    found = [f for f in found if not sf.allowed(f.checker, f.line)]
+    from .locks import check_global_graph
+    found.extend(check_global_graph([sf]))
+    found.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return found
+
+
+def run_paths(paths: list[str], root: str = ".") -> list[Finding]:
+    """Run every checker over every .py file under `paths`.
+
+    Annotated sites are dropped here; baseline filtering is the
+    caller's job (new_findings)."""
+    findings: list[Finding] = []
+    sources: list[SourceFile] = []
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        try:
+            sf = SourceFile.parse(fp, display_path=rel)
+        except SyntaxError as e:
+            findings.append(Finding("syntax-error", rel.replace(os.sep, "/"),
+                                    e.lineno or 0, "", str(e.msg)))
+            continue
+        sources.append(sf)
+    for sf in sources:
+        for chk in _checkers():
+            for f in chk(sf):
+                if not sf.allowed(f.checker, f.line):
+                    findings.append(f)
+    # the lock-order graph is global: cycles only emerge across files
+    from .locks import check_global_graph
+    findings.extend(check_global_graph(sources))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return findings

@@ -1,0 +1,282 @@
+"""JAX hot-path checkers (scoped to tpu/ and engine/ sources).
+
+The device plane is transfer-bound: one stray host sync inside a scan
+re-introduces the full tunnel RTT per block (PERF.md).  These checkers
+flag the statically detectable cases:
+
+- jax-host-sync: float()/int()/bool()/.item()/.tolist()/np.asarray()
+  on a value produced by jnp.*, a jit-wrapped callable, or a kernels
+  module, and implicit truthiness (`if x:`) on such values.  Deliberate
+  result readbacks carry `# vlint: allow-jax-host-sync(<why>)`.
+- jax-jit-closure: a jit-compiled function reading `self.*` or a
+  module-level mutable literal — the closure is baked in at trace time
+  and silently goes stale when the state mutates.
+- jax-static-arg: static_argnums/static_argnames that are not
+  int/str literals (or tuples thereof) — unstable or unhashable
+  statics retrigger compilation per call (the EWMA-poisoning
+  compile-timing class of bug from the cost-gate hardening).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, SourceFile
+from .locks import _dotted, _module_jit_names
+
+SCOPE_RE = re.compile(r"(^|/)(tpu|engine)(/|$)")
+
+# module names whose call results live on device in this repo
+_DEVICE_MODULE_HINTS = ("kernels", "fused", "stats_device", "sort_device")
+
+_SYNC_CASTS = {"float", "int", "bool"}
+
+
+def _device_module_aliases(tree: ast.Module) -> set:
+    """Local aliases of the device-kernel modules, e.g.
+    `from . import kernels as K` -> {'K'}."""
+    out: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                name = a.asname or a.name
+                if any(h in a.name for h in _DEVICE_MODULE_HINTS):
+                    out.add(name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if any(h in a.name.split(".")[-1]
+                       for h in _DEVICE_MODULE_HINTS):
+                    out.add(a.asname or a.name.split(".")[0])
+    return out
+
+
+def _is_jit_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d in ("jax.jit", "jit"):
+        return True
+    if d in ("partial", "functools.partial") and node.args:
+        return _dotted(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+class _FuncScope:
+    """One-pass per-function tracking of device-valued names."""
+
+    def __init__(self, sf, symbol, jit_names, dev_modules, findings):
+        self.sf = sf
+        self.symbol = symbol
+        self.jit_names = set(jit_names)   # callables returning device
+        self.dev_modules = dev_modules
+        self.device_vars: set = set()
+        self.findings = findings
+
+    def _produces_device(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device_vars
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            root = d.split(".")[0] if d else ""
+            if root in ("jnp",) or d.startswith("jax.numpy."):
+                return True
+            if d in self.jit_names:
+                return True
+            if root in self.dev_modules and "." in d:
+                return True
+            return False
+        if isinstance(node, ast.Subscript) or isinstance(node, ast.BinOp):
+            inner = node.value if isinstance(node, ast.Subscript) \
+                else node.left
+            return self._produces_device(inner)
+        return False
+
+    def _flag(self, line: int, what: str) -> None:
+        self.findings.append(Finding(
+            "jax-host-sync", self.sf.path, line, self.symbol,
+            f"implicit host sync: {what}"))
+
+    def run(self, body: list) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own scope via check()
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            if self._produces_device(node.value) or (
+                    isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value)):
+                names = []
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names.extend(e.id for e in t.elts
+                                     if isinstance(e, ast.Name))
+                if isinstance(node.value, ast.Call) and \
+                        _is_jit_call(node.value):
+                    self.jit_names.update(names)
+                else:
+                    self.device_vars.update(names)
+            else:
+                # reassignment to a host value clears the taint
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.device_vars.discard(t.id)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self._test(node.test)
+            self._expr(node.test)
+            for sub in node.body + node.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(node, (ast.For,)):
+            self._expr(node.iter)
+            for sub in node.body + node.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(node, (ast.With, ast.Try)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.stmt):
+                    self._stmt(sub)
+                elif isinstance(sub, ast.withitem):
+                    self._expr(sub.context_expr)
+                elif isinstance(sub, ast.ExceptHandler):
+                    for s2 in sub.body:
+                        self._stmt(s2)
+            return
+        if isinstance(node, ast.Assert):
+            self._test(node.test)
+            self._expr(node.test)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _test(self, test) -> None:
+        names = [test] if isinstance(test, ast.Name) else (
+            [v for v in test.values if isinstance(v, ast.Name)]
+            if isinstance(test, ast.BoolOp) else [])
+        for n in names:
+            if n.id in self.device_vars:
+                self._flag(n.lineno,
+                           f"truth test on device value '{n.id}'")
+
+    def _expr(self, node) -> None:
+        if node is None:
+            return
+        for call in [n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)]:
+            d = _dotted(call.func)
+            if d in _SYNC_CASTS and len(call.args) == 1 and \
+                    self._produces_device(call.args[0]):
+                self._flag(call.lineno,
+                           f"{d}() on device value")
+            elif d in ("np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array") and call.args and \
+                    self._produces_device(call.args[0]):
+                self._flag(call.lineno, f"{d}() on device value")
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in ("item", "tolist") and \
+                    self._produces_device(call.func.value):
+                self._flag(call.lineno,
+                           f".{call.func.attr}() on device value")
+
+
+def _jit_decorated(node) -> bool:
+    return any(_is_jit_call(d) or _dotted(d) in ("jax.jit", "jit")
+               for d in node.decorator_list)
+
+
+def _check_static_args(call: ast.Call, sf, symbol, findings) -> None:
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        ok_types = (int,) if kw.arg == "static_argnums" else (str,)
+        v = kw.value
+        elts = v.elts if isinstance(v, ast.Tuple) else [v]
+        good = all(isinstance(e, ast.Constant)
+                   and isinstance(e.value, ok_types) for e in elts)
+        if not good:
+            findings.append(Finding(
+                "jax-static-arg", sf.path, kw.value.lineno, symbol,
+                f"{kw.arg} is not a literal — unstable statics "
+                f"retrigger compilation per call"))
+
+
+def _check_jit_closure(fnode, sf, symbol, module_mutables,
+                       findings) -> None:
+    params = {a.arg for a in fnode.args.args + fnode.args.kwonlyargs
+              + fnode.args.posonlyargs}
+    if fnode.args.vararg:
+        params.add(fnode.args.vararg.arg)
+    if fnode.args.kwarg:
+        params.add(fnode.args.kwarg.arg)
+    assigned = {n.id for n in ast.walk(fnode)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, (ast.Store,))}
+    for node in ast.walk(fnode):
+        attr_self = (isinstance(node, ast.Attribute)
+                     and isinstance(node.value, ast.Name)
+                     and node.value.id == "self")
+        if attr_self:
+            findings.append(Finding(
+                "jax-jit-closure", sf.path, node.lineno, symbol,
+                f"jit-compiled {fnode.name}() closes over mutable "
+                f"self.{node.attr} — baked in at trace time"))
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                node.id in module_mutables and \
+                node.id not in params and node.id not in assigned:
+            findings.append(Finding(
+                "jax-jit-closure", sf.path, node.lineno, symbol,
+                f"jit-compiled {fnode.name}() closes over module-level "
+                f"mutable '{node.id}'"))
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    if not SCOPE_RE.search(sf.path):
+        return []
+    findings: list[Finding] = []
+    tree = sf.tree
+    jit_names = _module_jit_names(tree)
+    dev_modules = _device_module_aliases(tree)
+    # module-level mutable literals (jit closures over them go stale)
+    module_mutables: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, (ast.List, ast.Dict, ast.Set)):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and not t.id.isupper():
+                    module_mutables.add(t.id)
+
+    def visit_funcs(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = _FuncScope(sf, sym, jit_names, dev_modules,
+                                   findings)
+                scope.run(child.body)
+                if _jit_decorated(child):
+                    _check_jit_closure(child, sf, sym, module_mutables,
+                                       findings)
+                for d in child.decorator_list:
+                    if isinstance(d, ast.Call):
+                        _check_static_args(d, sf, sym, findings)
+            visit_funcs(child, sym)
+
+    visit_funcs(tree, "")
+    # jax.jit(...) call sites anywhere (assignments, lambdas)
+    for node in ast.walk(tree):
+        if _is_jit_call(node):
+            _check_static_args(node, sf, "", findings)
+    return findings

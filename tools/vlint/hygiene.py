@@ -1,0 +1,105 @@
+"""Hygiene checkers.
+
+- broad-except: `except Exception` / `except BaseException` / bare
+  `except` that does not re-raise.  Deliberate sites carry
+  `# vlint: allow-broad-except(<why>)`.
+- mutable-default: list/dict/set (literal or constructor) default args.
+- wall-clock: `time.time()` — durations must use time.monotonic();
+  persisted timestamps use time.time_ns().  Deliberate wall-clock
+  reads carry `# vlint: allow-wall-clock(<why>)`.
+- nondaemon-thread: `threading.Thread(...)` without daemon=True; a
+  joined-on-shutdown thread carries
+  `# vlint: allow-nondaemon-thread(<why>)`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import _dotted
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _has_reraise(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+def _broad_name(handler: ast.ExceptHandler) -> str | None:
+    t = handler.type
+    if t is None:
+        return "bare except"
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        d = _dotted(n)
+        if d.split(".")[-1] in _BROAD:
+            return f"except {d.split('.')[-1]}"
+    return None
+
+
+def _mutable_default(node) -> str | None:
+    if isinstance(node, ast.List):
+        return "[]"
+    if isinstance(node, ast.Dict):
+        return "{}"
+    if isinstance(node, ast.Set):
+        return "{...}"
+    if isinstance(node, ast.Call) and \
+            _dotted(node.func) in ("list", "dict", "set"):
+        return f"{_dotted(node.func)}()"
+    return None
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def walk(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in (child.args.defaults
+                          + child.args.kw_defaults):
+                    lit = _mutable_default(d) if d is not None else None
+                    if lit is not None:
+                        findings.append(Finding(
+                            "mutable-default", sf.path, d.lineno, sym,
+                            f"mutable default argument {lit} in "
+                            f"{child.name}()"))
+            if isinstance(child, ast.ExceptHandler):
+                broad = _broad_name(child)
+                if broad is not None and not _has_reraise(child):
+                    findings.append(Finding(
+                        "broad-except", sf.path, child.lineno, sym,
+                        f"{broad} without re-raise — narrow it, or "
+                        f"annotate allow-broad-except(<why>)"))
+            if isinstance(child, ast.Call):
+                d = _dotted(child.func)
+                if d == "time.time":
+                    findings.append(Finding(
+                        "wall-clock", sf.path, child.lineno, sym,
+                        "time.time() — use time.monotonic() for "
+                        "durations (annotate allow-wall-clock for real "
+                        "wall-clock reads)"))
+                elif d == "threading.Thread":
+                    daemon = any(
+                        kw.arg == "daemon"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in child.keywords)
+                    if not daemon:
+                        findings.append(Finding(
+                            "nondaemon-thread", sf.path, child.lineno,
+                            sym,
+                            "threading.Thread without daemon=True — a "
+                            "crashed main thread would hang shutdown"))
+            walk(child, sym)
+
+    walk(sf.tree, "")
+    return findings

@@ -1,0 +1,499 @@
+"""Lock-discipline checkers.
+
+Per class, this module:
+
+1. finds the lock attributes (`self._x = threading.Lock()`, lock POOLS
+   like `[threading.Lock() for _ in range(N)]`, Condition aliases, and
+   helper methods that return a pool member);
+2. walks every method tracking which locks are held at each statement,
+   and PROPAGATES held-lock context through intraclass `self.m()` calls
+   (a private method called only under `with self._lock` is analyzed as
+   running under it; `*_locked`-suffixed methods are assumed to run
+   under the class's primary lock by convention; nested closures are
+   separate entry points — they run on other threads);
+3. infers which attributes are lock-GUARDED (written at least once with
+   a lock held outside __init__) and flags writes to them reachable
+   with no guard held (`lock-unguarded-write`);
+4. flags blocking calls — file I/O, fsync/replace, subprocess, sleep,
+   urlopen, thread .join(), future .result(), queue .get(), jit
+   dispatch — reachable with a lock held (`lock-blocking-call`);
+5. emits a lock-acquisition-order graph; cycle detection over the
+   whole run (core.run_paths) reports potential deadlocks
+   (`lock-order-cycle`), including acquiring a lock already held
+   and nesting two members of the same pool.
+
+The graph is also the static side of the runtime sanitizer
+(runtime.py): build_static_graph() returns (edges, site_map) so the
+race suites can assert observed acquisition order against it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .core import Finding, SourceFile
+
+# attribute methods that mutate their receiver in place
+_MUTATORS = {"append", "extend", "add", "update", "pop", "clear",
+             "remove", "discard", "insert", "setdefault", "popitem",
+             "appendleft", "extendleft"}
+
+_THREADING_LOCKS = {"Lock", "RLock"}
+
+
+def _dotted(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lock_ctor(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func) in
+            {f"threading.{n}" for n in _THREADING_LOCKS})
+
+
+def _self_attr(node) -> str | None:
+    """'X' for a `self.X` attribute node."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    is_init: bool = False
+    locked_suffix: bool = False
+    # (attr, rel_held frozenset, line)
+    writes: list = field(default_factory=list)
+    # (lock_attr, rel_held frozenset, line)
+    acquires: list = field(default_factory=list)
+    # (callee, rel_held frozenset, line)
+    self_calls: list = field(default_factory=list)
+    # (desc, rel_held frozenset, line)
+    blocking: list = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    lock_attrs: dict = field(default_factory=dict)   # attr -> ctor line
+    pool_attrs: set = field(default_factory=set)
+    cond_alias: dict = field(default_factory=dict)   # cond attr -> lock
+    helper_locks: dict = field(default_factory=dict)  # method -> pool
+    file_attrs: set = field(default_factory=set)     # self.X = open(...)
+    methods: dict = field(default_factory=dict)      # name -> MethodInfo
+    closures: list = field(default_factory=list)     # MethodInfo
+
+
+class _MethodWalker:
+    """Single-method AST walk tracking the rel-held lock set."""
+
+    def __init__(self, cls: ClassInfo, mi: MethodInfo, jit_names: set):
+        self.cls = cls
+        self.mi = mi
+        self.jit_names = jit_names
+
+    def lock_of_expr(self, node) -> str | None:
+        attr = _self_attr(node)
+        if attr is not None:
+            if attr in self.cls.lock_attrs:
+                return attr
+            if attr in self.cls.cond_alias:
+                return self.cls.cond_alias[attr]
+        if isinstance(node, ast.Call):
+            m = _self_attr(node.func)
+            if m is not None and m in self.cls.helper_locks:
+                return self.cls.helper_locks[m]
+        if isinstance(node, ast.Subscript):
+            attr = _self_attr(node.value)
+            if attr in self.cls.pool_attrs:
+                return attr
+        return None
+
+    def visit(self, node, held: frozenset) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit_one(child, held)
+
+    def _visit_one(self, node, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested closure: runs later, usually on another thread —
+            # its body starts with nothing held
+            sub = MethodInfo(name=f"{self.mi.name}.<{node.name}>",
+                             node=node)
+            _MethodWalker(self.cls, sub, self.jit_names).visit(
+                node, frozenset())
+            self.cls.closures.append(sub)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.With):
+            add = []
+            for item in node.items:
+                lk = self.lock_of_expr(item.context_expr)
+                if lk is not None:
+                    self.mi.acquires.append((lk, held, item.context_expr.lineno))
+                    add.append(lk)
+                else:
+                    self._visit_one(item.context_expr, held)
+            inner = held | frozenset(add)
+            for stmt in node.body:
+                self._visit_one(stmt, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self._record_write_target(t, held)
+            if node.value is not None:
+                self._visit_one(node.value, held)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                self._visit_one(child, held)
+            return
+        self.visit(node, held)
+
+    def _record_write_target(self, t, held: frozenset) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._record_write_target(e, held)
+            return
+        node = t
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        attr = _self_attr(node)
+        if attr is not None:
+            self.mi.writes.append((attr, held, t.lineno))
+
+    def _record_call(self, call: ast.Call, held: frozenset) -> None:
+        func = call.func
+        # explicit acquire()/release() on a lock attr: treated as an
+        # acquisition event for the order graph (scope not tracked)
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lk = self.lock_of_expr(func.value)
+            if lk is not None:
+                self.mi.acquires.append((lk, held, call.lineno))
+                return
+        # mutating method on self.X => write to X
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            attr = _self_attr(func.value)
+            if attr is None and isinstance(func.value, ast.Subscript):
+                attr = _self_attr(func.value.value)
+            if attr is not None:
+                self.mi.writes.append((attr, held, call.lineno))
+        # intraclass call
+        m = _self_attr(func)
+        if m is not None:
+            self.mi.self_calls.append((m, held, call.lineno))
+        # blocking-call candidates (flagged later if reachable held)
+        desc = self._blocking_desc(call)
+        if desc is not None:
+            self.mi.blocking.append((desc, held, call.lineno))
+
+    def _blocking_desc(self, call: ast.Call) -> str | None:
+        func = call.func
+        name = _dotted(func)
+        if name == "open":
+            return "open()"
+        if name in ("os.fsync", "os.replace", "time.sleep"):
+            return f"{name}()"
+        root = name.split(".")[0] if name else ""
+        if root in ("subprocess", "shutil"):
+            return f"{name}()"
+        if name.endswith("urlopen"):
+            return "urlopen()"
+        if name in self.jit_names:
+            return f"jit dispatch {name}()"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "result":
+                return ".result()"
+            if func.attr == "join" and len(call.args) < 2 and \
+                    not isinstance(func.value, ast.Constant) and \
+                    not _dotted(func).startswith("os.path."):
+                # thread/process join; os.path.join takes 2+ args and
+                # str.join has a Constant receiver
+                return ".join()"
+            if func.attr == "get" and "queue" in _dotted(func.value).lower():
+                return "queue.get()"
+            base = _self_attr(func.value)
+            if base in self.cls.file_attrs and \
+                    func.attr in ("write", "flush", "read", "close"):
+                return f"file self.{base}.{func.attr}()"
+        return None
+
+
+# ---------------- class collection ----------------
+
+def _module_jit_names(tree: ast.AST) -> set:
+    """Module-level names bound to jax.jit-wrapped callables."""
+    names: set = set()
+
+    def is_jit_expr(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func)
+        if d in ("jax.jit", "jit"):
+            return True
+        if d in ("partial", "functools.partial") and node.args:
+            return _dotted(node.args[0]) in ("jax.jit", "jit")
+        return False
+
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_expr(d) or _dotted(d) in ("jax.jit", "jit")
+                   for d in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign) and is_jit_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _collect_class(cnode: ast.ClassDef, jit_names: set) -> ClassInfo:
+    ci = ClassInfo(name=cnode.name)
+    # pass A: lock/pool/cond/file attrs + pool helper methods
+    for node in ast.walk(cnode):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            v = node.value
+            if _is_lock_ctor(v):
+                ci.lock_attrs[attr] = v.lineno
+            elif isinstance(v, (ast.ListComp, ast.List)):
+                inner = v.elt if isinstance(v, ast.ListComp) else \
+                    (v.elts[0] if v.elts else None)
+                if inner is not None and _is_lock_ctor(inner):
+                    ci.lock_attrs[attr] = inner.lineno
+                    ci.pool_attrs.add(attr)
+            elif isinstance(v, ast.Call) and \
+                    _dotted(v.func) == "threading.Condition" and v.args:
+                src = _self_attr(v.args[0])
+                if src is not None:
+                    ci.cond_alias[attr] = src
+            elif isinstance(v, ast.Call) and _dotted(v.func) == "open":
+                ci.file_attrs.add(attr)
+    for node in cnode.body:
+        if isinstance(node, ast.FunctionDef) and len(node.body) >= 1:
+            last = node.body[-1]
+            if isinstance(last, ast.Return) and \
+                    isinstance(last.value, ast.Subscript):
+                attr = _self_attr(last.value.value)
+                if attr in ci.pool_attrs:
+                    ci.helper_locks[node.name] = attr
+    # pass B: per-method walks
+    for node in cnode.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mi = MethodInfo(name=node.name, node=node,
+                        is_init=node.name == "__init__",
+                        locked_suffix=node.name.endswith("_locked"))
+        _MethodWalker(ci, mi, jit_names).visit(node, frozenset())
+        ci.methods[node.name] = mi
+    return ci
+
+
+def _primary_guard(ci: ClassInfo) -> frozenset:
+    if "_lock" in ci.lock_attrs:
+        return frozenset(["_lock"])
+    plain = [a for a in ci.lock_attrs if a not in ci.pool_attrs]
+    return frozenset(plain[:1])
+
+
+# ---------------- context propagation + findings ----------------
+
+def _analyze_class(ci: ClassInfo, sf: SourceFile,
+                   edges: set, site_map: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    for attr, line in ci.lock_attrs.items():
+        site_map[(sf.path, line)] = f"{ci.name}.{attr}"
+
+    units = dict(ci.methods)
+    for c in ci.closures:
+        units[c.name] = c
+
+    callers: dict[str, int] = {}
+    for mi in units.values():
+        for callee, _h, _ln in mi.self_calls:
+            if callee in units:
+                callers[callee] = callers.get(callee, 0) + 1
+
+    # context -> (held, is_init); seeds per the conventions above
+    contexts: dict[str, set] = {n: set() for n in units}
+    work: list[tuple[str, frozenset, bool]] = []
+
+    def seed(name: str, held: frozenset, is_init: bool) -> None:
+        if (held, is_init) not in contexts[name]:
+            contexts[name].add((held, is_init))
+            work.append((name, held, is_init))
+
+    for name, mi in units.items():
+        if mi.locked_suffix:
+            seed(name, _primary_guard(ci), False)
+        elif mi.is_init:
+            seed(name, frozenset(), True)
+        elif not name.startswith("_") or callers.get(name, 0) == 0:
+            seed(name, frozenset(), False)
+
+    while work:
+        name, held, is_init = work.pop()
+        mi = units[name]
+        for callee, rel, _ln in mi.self_calls:
+            if callee in units and callee != name:
+                seed(callee, held | rel, is_init)
+
+    # effective events across achievable contexts
+    guard_writes: dict[str, set] = {}
+    eff_writes: list = []     # (attr, held, is_init, line, method)
+    eff_blocking: dict = {}   # dedupe on (line, desc)
+    for name, mi in units.items():
+        for held, is_init in contexts[name] or {(frozenset(), False)}:
+            for attr, rel, line in mi.writes:
+                h = held | rel
+                eff_writes.append((attr, h, is_init, line, name))
+                if h and not is_init:
+                    guard_writes.setdefault(attr, set()).update(h)
+            for desc, rel, line in mi.blocking:
+                h = held | rel
+                if h:
+                    eff_blocking.setdefault((line, desc), (name, h))
+            for lk, rel, line in mi.acquires:
+                h = held | rel
+                for other in h:
+                    a = f"{ci.name}.{other}"
+                    b = f"{ci.name}.{lk}"
+                    if other == lk:
+                        kind = "pool" if lk in ci.pool_attrs else "lock"
+                        findings.append(Finding(
+                            "lock-order-cycle", sf.path, line,
+                            f"{ci.name}.{name}",
+                            f"acquires {kind} self.{lk} while already "
+                            f"holding self.{lk}"))
+                    else:
+                        edges.add((a, b, sf.path, line))
+
+    flagged: set = set()
+    for attr, held, is_init, line, name in eff_writes:
+        guards = guard_writes.get(attr)
+        if not guards or is_init or (held & guards):
+            continue
+        if (attr, line) in flagged:
+            continue
+        flagged.add((attr, line))
+        glist = ",".join(sorted(guards))
+        findings.append(Finding(
+            "lock-unguarded-write", sf.path, line, f"{ci.name}.{name}",
+            f"write to self.{attr} without holding self.{glist} "
+            f"(guarded elsewhere)"))
+
+    for (line, desc), (name, held) in sorted(eff_blocking.items()):
+        hlist = ",".join(sorted(held))
+        findings.append(Finding(
+            "lock-blocking-call", sf.path, line, f"{ci.name}.{name}",
+            f"blocking {desc} while holding self.{hlist}"))
+
+    return findings
+
+
+# ---------------- public entry points ----------------
+
+def _analyze(sf: SourceFile):
+    if not hasattr(sf, "_vlint_locks"):
+        findings: list[Finding] = []
+        edges: set = set()
+        site_map: dict = {}
+        jit_names = _module_jit_names(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(
+                    _analyze_class(_collect_class(node, jit_names),
+                                   sf, edges, site_map))
+        sf._vlint_locks = (findings, edges, site_map)
+    return sf._vlint_locks
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    return list(_analyze(sf)[0])
+
+
+def check_global_graph(sources: list[SourceFile]) -> list[Finding]:
+    """Cycle detection over the union of every file's lock-order edges."""
+    edges: set = set()
+    for sf in sources:
+        _, e, _ = _analyze(sf)
+        for a, b, path, line in e:
+            if not sf.allowed("lock-order-cycle", line):
+                edges.add((a, b, path, line))
+    graph: dict[str, set] = {}
+    anchor: dict = {}
+    for a, b, path, line in sorted(edges):
+        graph.setdefault(a, set()).add(b)
+        anchor.setdefault((a, b), (path, line))
+    findings = []
+    for cyc in _find_cycles(graph):
+        path, line = anchor[(cyc[0], cyc[1])]
+        findings.append(Finding(
+            "lock-order-cycle", path, line, "",
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(cyc + [cyc[0]])))
+    return findings
+
+
+def _find_cycles(graph: dict) -> list[list[str]]:
+    """Elementary cycles, canonicalized (smallest node first), deduped."""
+    seen: set = set()
+    out: list[list[str]] = []
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(list(canon))
+            elif nxt not in on_path and nxt > start:
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return out
+
+
+def build_static_graph(paths: list[str], root: str = "."):
+    """(edges, site_map) for the runtime sanitizer.
+
+    edges: {(node_a, node_b)} meaning a is held while b is acquired.
+    site_map: {(relpath, lineno) -> node} for the threading.Lock()
+    constructor sites, matching what runtime.py records."""
+    from .core import iter_py_files
+    edges: set = set()
+    site_map: dict = {}
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        try:
+            sf = SourceFile.parse(fp, display_path=rel)
+        except SyntaxError:
+            continue
+        _, e, smap = _analyze(sf)
+        site_map.update(smap)
+        for a, b, _path, _line in e:
+            edges.add((a, b))
+    return edges, site_map

@@ -1,0 +1,222 @@
+"""Runtime lock-order sanitizer (opt-in: VLINT_LOCK_ORDER=1).
+
+install() replaces threading.Lock with a factory returning instrumented
+locks for locks CONSTRUCTED from victorialogs_tpu code (stdlib-internal
+locks — Event/Condition internals, loggers — keep the real primitive).
+Each instrumented lock remembers its construction site (file:line of
+the `threading.Lock()` call — the same site locks.build_static_graph
+keys its nodes on), and every acquire records
+
+    (deepest-held site) -> (acquired site)
+
+edges into a process-global graph, with ONLINE cycle detection: the
+first acquisition that closes a cycle is recorded as a violation with
+both stacks' sites.  The race suites (tests/conftest.py) then assert
+no violations and that the observed edges are consistent with the
+static lock-order graph — static analysis and the race tests
+validating each other.
+
+The shim only wraps threading.Lock (this codebase holds no RLocks);
+Condition(instrumented_lock) works because Condition drives any
+acquire/release pair.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_REAL_LOCK = threading.Lock
+
+_SCOPE_MARKERS = (f"victorialogs_tpu{os.sep}",)
+_SKIP_FILES = (os.sep + "threading.py", os.sep + "vlint" + os.sep)
+
+_sanitizer = None
+
+
+def _repo_rel(path: str) -> str:
+    marker = "victorialogs_tpu" + os.sep
+    i = path.rfind(marker)
+    return path[i:].replace(os.sep, "/") if i >= 0 else path
+
+
+class LockOrderSanitizer:
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        # site -> set of successor sites (edges: held -> acquired)
+        self.graph: dict[str, set] = {}
+        self.edges: dict[tuple, int] = {}      # (a, b) -> count
+        self.violations: list[str] = []
+
+    # ---- per-thread held stack ----
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, site: str) -> None:
+        st = self._stack()
+        if st:
+            top = st[-1]
+            if top != site:
+                self._record_edge(top, site)
+        st.append(site)
+
+    def on_released(self, site: str) -> None:
+        st = self._stack()
+        # Condition.wait releases out of LIFO order: remove by value
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == site:
+                del st[i]
+                return
+
+    def _record_edge(self, a: str, b: str) -> None:
+        with self._mu:
+            key = (a, b)
+            first = key not in self.edges
+            self.edges[key] = self.edges.get(key, 0) + 1
+            if not first:
+                return
+            self.graph.setdefault(a, set()).add(b)
+            path = self._find_path(b, a)
+            if path is not None:
+                self.violations.append(
+                    "lock-order cycle observed at runtime: "
+                    + " -> ".join([a, b] + path[1:]))
+
+    def _find_path(self, src: str, dst: str):
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self.graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ---- consistency against the static graph ----
+    def check_static_consistency(self, static_edges: set,
+                                 site_map: dict) -> list[str]:
+        """Map observed edges onto static lock nodes and verify the
+        merged graph stays acyclic.  Runtime sites with no static node
+        (function-local locks) participate under their site id."""
+        def node_of(site: str) -> str:
+            try:
+                path, line = site.rsplit(":", 1)
+                return site_map.get((path, int(line)), site)
+            except ValueError:
+                return site
+        merged: dict[str, set] = {}
+        for a, b in static_edges:
+            merged.setdefault(a, set()).add(b)
+        runtime_nodes: list[tuple] = []
+        for (a, b), _n in self.edges.items():
+            na, nb = node_of(a), node_of(b)
+            if na != nb:
+                merged.setdefault(na, set()).add(nb)
+                runtime_nodes.append((na, nb))
+        problems = list(self.violations)
+        # cycle check over the merged graph
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(merged) | {x for s in merged.values() for x in s}}
+
+        def dfs(n, trail):
+            color[n] = GRAY
+            for nxt in sorted(merged.get(n, ())):
+                if color[nxt] == GRAY:
+                    cyc = trail[trail.index(nxt):] + [nxt] \
+                        if nxt in trail else [n, nxt]
+                    problems.append(
+                        "observed acquisition order conflicts with "
+                        "static lock graph: " + " -> ".join(cyc))
+                elif color[nxt] == WHITE:
+                    dfs(nxt, trail + [nxt])
+            color[n] = BLACK
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                dfs(n, [n])
+        return problems
+
+
+class InstrumentedLock:
+    """Drop-in for a threading.Lock with acquisition-order recording."""
+
+    __slots__ = ("_lock", "_site", "_san")
+
+    def __init__(self, san: LockOrderSanitizer, site: str):
+        self._lock = _REAL_LOCK()
+        self._site = site
+        self._san = san
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._san.on_acquired(self._site)
+        return got
+
+    def release(self):
+        self._lock.release()
+        self._san.on_released(self._site)
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def _creation_site() -> str | None:
+    """file:line of the frame that called threading.Lock(), if it is
+    inside victorialogs_tpu (None otherwise)."""
+    f = sys._getframe(2)
+    depth = 0
+    while f is not None and depth < 12:
+        fn = f.f_code.co_filename
+        if not any(s in fn for s in _SKIP_FILES):
+            if any(m in fn for m in _SCOPE_MARKERS):
+                return f"{_repo_rel(fn)}:{f.f_lineno}"
+            return None
+        f = f.f_back
+        depth += 1
+    return None
+
+
+def install() -> LockOrderSanitizer:
+    """Idempotent; returns the active sanitizer."""
+    global _sanitizer
+    if _sanitizer is not None:
+        return _sanitizer
+    san = LockOrderSanitizer()
+
+    def factory():
+        site = _creation_site()
+        if site is None:
+            return _REAL_LOCK()
+        return InstrumentedLock(san, site)
+
+    threading.Lock = factory
+    _sanitizer = san
+    return san
+
+
+def uninstall() -> None:
+    global _sanitizer
+    threading.Lock = _REAL_LOCK
+    _sanitizer = None
+
+
+def get_sanitizer() -> LockOrderSanitizer | None:
+    return _sanitizer

@@ -114,6 +114,7 @@ def repl(client: Client) -> int:
                     print(render(row, mode))
             except KeyboardInterrupt:
                 print()
+            # vlint: allow-broad-except(REPL prints and keeps running)
             except Exception as e:
                 print(f"error: {e}")
             continue
@@ -123,6 +124,7 @@ def repl(client: Client) -> int:
                 print(render(row, mode))
                 n += 1
             print(f"-- {n} rows")
+        # vlint: allow-broad-except(REPL prints and keeps running)
         except Exception as e:
             print(f"error: {e}")
 

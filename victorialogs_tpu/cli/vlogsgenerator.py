@@ -97,7 +97,7 @@ def main(argv=None) -> int:
     total = args.streams * args.logsPerStream
     span = max(end_ns - start_ns, 1)
 
-    t0 = time.time()
+    t0 = time.monotonic()
     emitted = 0
     batch: list[str] = []
 
@@ -125,7 +125,7 @@ def main(argv=None) -> int:
             if len(batch) >= args.batchSize:
                 flush_batch()
     flush_batch()
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
     print(f"emitted {emitted} rows in {dt:.2f}s "
           f"({emitted / max(dt, 1e-9):.0f} rows/s)", file=sys.stderr)
     return 0

@@ -272,6 +272,7 @@ def _scan_partitions_parallel(pts, scan_partition, head, npw) -> None:
             scan_partition(pt, sync_head)
         except QueryCancelled:
             stop.set()
+        # vlint: allow-broad-except(fan-out error channel, re-raised)
         except Exception as e:
             errors.append(e)
             stop.set()

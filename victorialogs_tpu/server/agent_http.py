@@ -18,7 +18,7 @@ class AgentServer(BaseHTTPApp):
         self.agent = agent
         self.sink = agent
         self.metrics = Metrics()
-        self.start_time = time.time()
+        self.start_time = time.monotonic()
         self._start_http(listen_addr, port)
 
     def route(self, h, path, args, body, ctype) -> None:
@@ -42,7 +42,7 @@ class AgentServer(BaseHTTPApp):
         if path == "/":
             self.respond_json(h, {
                 "app": "vlagent",
-                "uptime_seconds": round(time.time() - self.start_time, 1)})
+                "uptime_seconds": round(time.monotonic() - self.start_time, 1)})
             return
         if path.startswith("/insert/"):
             self.handle_insert(h, path, args, body, ctype)

@@ -106,6 +106,7 @@ class BaseHTTPApp:
                         body = zlib.decompress(body)
                     elif enc == "snappy":
                         pass  # loki protobuf handles snappy itself
+                # vlint: allow-broad-except(malformed body maps to 400)
                 except Exception:
                     outer.respond(self, 400, "text/plain",
                                   b"cannot decompress request body")
@@ -183,6 +184,7 @@ class BaseHTTPApp:
             self.respond(h, 422, "text/plain", str(e).encode("utf-8"))
         except (BrokenPipeError, ConnectionResetError):
             pass
+        # vlint: allow-broad-except(last-resort 500 handler, logged)
         except Exception as e:  # pragma: no cover
             import traceback
             traceback.print_exc()
@@ -258,7 +260,7 @@ class VLServer(BaseHTTPApp):
         self.storage = storage
         self.metrics = Metrics()
         self.runner = runner
-        self.start_time = time.time()
+        self.start_time = time.monotonic()
         self._sem = threading.Semaphore(max_concurrent)
         # internal (cluster) sub-queries get their own gate: a node acting
         # as both frontend and storage node must not have frontend queries
@@ -291,7 +293,7 @@ class VLServer(BaseHTTPApp):
         if path == "/":
             self.respond_json(h, {
                 "app": "victorialogs-tpu",
-                "uptime_seconds": round(time.time() - self.start_time, 1)})
+                "uptime_seconds": round(time.monotonic() - self.start_time, 1)})
             return
 
         # ---- embedded web UI (reference vmui — vlselect/main.go:71-74) ----
@@ -412,7 +414,7 @@ class VLServer(BaseHTTPApp):
         s = self.query_storage
         m = self.metrics
         m.inc("vl_http_requests_total{path=\"" + path + "\"}")
-        t0 = time.time()
+        t0 = time.monotonic()
         if path == "/select/logsql/query":
             gen = handle_query(s, args, headers, runner=self.runner)
             self.respond_stream(h, gen)
@@ -456,4 +458,4 @@ class VLServer(BaseHTTPApp):
         else:
             raise HTTPError(404, f"unknown select path {path}")
         m.inc("vl_http_request_duration_ms_total{path=\"" + path + "\"}",
-              int((time.time() - t0) * 1000))
+              int((time.monotonic() - t0) * 1000))

@@ -23,6 +23,7 @@ Wire formats are this repo's own (JSON + zstd frames): versioned via the
 
 from __future__ import annotations
 
+import http.client
 import json
 import struct
 import threading
@@ -311,7 +312,7 @@ class NetInsertStorage:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return 200 <= resp.status < 300
-        except Exception:
+        except (OSError, http.client.HTTPException):
             self._mark_broken(idx)
             return False
 
@@ -415,6 +416,8 @@ class NetSelectStorage:
                             if head.is_done():
                                 stop.set()
                                 return
+            # collected errors re-raise on the caller thread after join
+            # vlint: allow-broad-except(fan-out error channel)
             except Exception as e:
                 errors.append(e)
                 stop.set()

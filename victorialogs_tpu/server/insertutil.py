@@ -175,6 +175,7 @@ class LogMessageProcessor:
         while not self._stop.wait(FLUSH_INTERVAL):
             try:
                 self.flush()
+            # vlint: allow-broad-except(flusher thread must survive)
             except Exception:  # pragma: no cover - keep the flusher alive
                 pass
 

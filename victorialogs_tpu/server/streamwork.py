@@ -52,6 +52,7 @@ def stream_blocks(run, encode):
             put(DONE)
         except StreamAbandoned:
             pass
+        # vlint: allow-broad-except(propagated to the response loop)
         except Exception as e:  # propagate to the response loop
             put(e)
 

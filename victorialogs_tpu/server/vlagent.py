@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import http.client
 import json
 import os
 import signal
@@ -81,7 +82,7 @@ class RemoteWriteClient:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return 200 <= resp.status < 300
-        except Exception:
+        except (OSError, http.client.HTTPException):
             return False
 
     def close(self) -> None:

@@ -315,6 +315,7 @@ class DataDB:
             except ValueError:
                 pass
 
+    # vlint: allow-lock-blocking-call(manifest swap atomic with part swap)
     def _write_manifest_locked(self) -> None:
         names = [p.name for p in self.small_parts + self.big_parts]
         tmp = os.path.join(self.path, PARTS_JSON + ".tmp")
@@ -372,6 +373,7 @@ class DataDB:
             if woken or time.monotonic() - oldest >= self.flush_interval:
                 try:
                     self.flush_inmemory_parts()
+                # vlint: allow-broad-except(flusher thread must survive)
                 except Exception:  # pragma: no cover - keep flusher alive
                     pass
 
@@ -388,6 +390,7 @@ class DataDB:
                 continue
             try:
                 self._maybe_merge()
+            # vlint: allow-broad-except(backoff keeps merge worker alive)
             except Exception:
                 # ENOSPC and friends: back off instead of re-running the
                 # same full k-way merge every second against a full disk
@@ -453,6 +456,8 @@ class DataDB:
             if len(to_merge) > 1:
                 self._merge_parts(to_merge, big=True)
 
+    # long I/O under _merge_lock is its purpose: it serializes merges
+    # vlint: allow-lock-blocking-call(coarse merge serialization lock)
     def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
         # disk-space reservation: skip the merge when the output could not
         # fit (reference reserves before merging — datadb.go:478-493)

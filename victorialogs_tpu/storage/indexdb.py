@@ -131,8 +131,8 @@ class IndexDB:
             try:
                 with open(self._manifest_path) as f:
                     files = json.load(f)["files"]
-            except Exception:
-                files = []
+            except (OSError, ValueError, KeyError, TypeError):
+                files = []  # unreadable/torn manifest: full log replay
         elif os.path.exists(os.path.join(self.path, SNAPSHOT_FILENAME)):
             files = [SNAPSHOT_FILENAME]          # pre-manifest layout
         loaded: list[tuple[str, StreamSnapshot | None]] = []
@@ -141,6 +141,7 @@ class IndexDB:
             p = os.path.join(self.path, fn)
             try:
                 loaded.append((fn, StreamSnapshot(p)))
+            # vlint: allow-broad-except(any parse error means torn file)
             except Exception:
                 loaded.append((fn, None))        # torn file
                 manifest_dirty = True
@@ -191,6 +192,7 @@ class IndexDB:
         self._snap_files.clear()
         self._write_manifest()
 
+    # vlint: allow-lock-blocking-call(manifest swap atomic with level swap)
     def _write_manifest(self) -> None:
         tmp = self._manifest_path + ".tmp"
         with open(tmp, "w") as f:
@@ -234,6 +236,7 @@ class IndexDB:
             label_any.setdefault(label, set()).add(sid)
 
     # ---- tail flush + background merge ----
+    # vlint: allow-lock-blocking-call(durability-ordered tail flush)
     def _flush_tail_locked(self) -> None:
         """Write the tail as a NEW snapshot level (O(tail); existing
         files untouched), swap it in, clear the tail."""
@@ -243,7 +246,7 @@ class IndexDB:
         fn = self._next_snap_file()
         p = os.path.join(self.path, fn)
         write_snapshot(p, dict(self._streams), log_size)
-        self._account_write(p)
+        self._account_write_locked(p)
         self._snaps.append(StreamSnapshot(p))
         self._snap_files.append(fn)
         self._write_manifest()
@@ -254,10 +257,13 @@ class IndexDB:
         self._filter_cache.clear()
         self._gen += 1
 
-    def _account_write(self, path: str) -> None:
+    def _account_write_locked(self, path: str) -> None:
+        # caller holds self._lock: the compaction thread and foreground
+        # flushes both account here, and unlocked `+=` loses updates
         self.snap_bytes_written += os.path.getsize(path)
         self.snap_files_written += 1
 
+    # vlint: allow-lock-blocking-call(log fsync before freeze, durability)
     def _maybe_compact_async(self) -> None:
         """Kick off a background tail flush (and, when the level count
         passed MAX_SNAPSHOTS, a k-way merge of the smallest levels).
@@ -283,7 +289,10 @@ class IndexDB:
                 p = os.path.join(self.path, fn)
                 write_snapshot(p, frozen, log_size)
                 new_snap = StreamSnapshot(p)
-                self._account_write(p)
+            # any write failure (disk full, permissions, serialization)
+            # must back off, not kill the compaction thread; the error
+            # is kept in _compact_error
+            # vlint: allow-broad-except(backoff keeps compactor alive)
             except Exception as e:
                 # disk full / permissions: keep serving from the old
                 # levels, back off so registrations don't re-pay a
@@ -294,6 +303,7 @@ class IndexDB:
                     self._compact_error = repr(e)
                 return
             with self._lock:
+                self._account_write_locked(p)
                 self._snaps.append(new_snap)
                 self._snap_files.append(fn)
                 self._write_manifest()
@@ -333,7 +343,7 @@ class IndexDB:
                 merge_snapshots(p, srcs,
                                 max(s.log_offset for s in srcs))
                 merged = StreamSnapshot(p)
-                self._account_write(p)
+            # vlint: allow-broad-except(backoff keeps compactor alive)
             except Exception as e:
                 import time
                 with self._lock:
@@ -341,6 +351,7 @@ class IndexDB:
                     self._compact_error = repr(e)
                 return
             with self._lock:
+                self._account_write_locked(p)
                 # replace the sources BY NAME: a concurrent tail flush
                 # may have appended levels since the pick — they must
                 # survive the swap
@@ -377,8 +388,8 @@ class IndexDB:
         p = os.path.join(self.path, fn)
         merge_snapshots(p, srcs, max(s.log_offset for s in srcs))
         merged = StreamSnapshot(p)
-        self._account_write(p)
         with self._lock:
+            self._account_write_locked(p)
             # a background flush may have appended a level since the
             # capture — replace only the merged sources, keep the rest
             gone = set(src_files)
@@ -396,6 +407,7 @@ class IndexDB:
             except OSError:
                 pass
 
+    # vlint: allow-lock-blocking-call(shutdown: final flush under lock)
     def close(self) -> None:
         t = self._compact_thread
         if t is not None and t.is_alive():
@@ -408,7 +420,7 @@ class IndexDB:
                 fn = self._next_snap_file()
                 p = os.path.join(self.path, fn)
                 write_snapshot(p, dict(self._streams), log_size)
-                self._account_write(p)
+                self._account_write_locked(p)
                 self._snap_files.append(fn)
                 self._snaps.append(StreamSnapshot(p))
                 self._write_manifest()
@@ -419,6 +431,7 @@ class IndexDB:
                 self._postings.clear()
                 self._label_any.clear()
 
+    # vlint: allow-lock-blocking-call(explicit durability barrier)
     def flush(self) -> None:
         with self._lock:
             self._file.flush()
@@ -433,6 +446,7 @@ class IndexDB:
     def must_register_stream(self, sid: StreamID, tags_str: str) -> None:
         self.must_register_streams([(sid, tags_str)])
 
+    # vlint: allow-lock-blocking-call(register-before-rows fsync invariant)
     def must_register_streams(
             self, streams: list[tuple[StreamID, str]]) -> None:
         """Durably register new streams (fsynced before returning, so rows

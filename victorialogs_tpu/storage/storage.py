@@ -161,6 +161,7 @@ class Storage:
         while not self._stop.wait(3600.0):
             try:
                 self.drop_expired_partitions()
+            # vlint: allow-broad-except(retention watcher must survive)
             except Exception:  # pragma: no cover
                 pass
 
@@ -170,6 +171,7 @@ class Storage:
         while not self._stop.wait(10.0):
             try:
                 self.enforce_max_disk_usage()
+            # vlint: allow-broad-except(disk watcher must survive)
             except Exception:  # pragma: no cover
                 pass
 

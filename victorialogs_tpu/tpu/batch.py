@@ -741,6 +741,7 @@ class CostModel:
         self.upload_bytes_per_s = 1e9
         self.force = os.environ.get("VL_COST_FORCE", "")
 
+    # vlint: allow-jax-host-sync(the blocking round trip IS the probe)
     def measured_rtt(self) -> float:
         if self.rtt is None:
             import time
@@ -861,22 +862,27 @@ class BatchRunner:
             self.dispatch_kinds.add(label)
 
     def _prefetcher(self):
-        """Lazily create the single prefetch worker (double-checked under
-        the counter lock: partition workers may race here)."""
-        if self._prefetch_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            with self._counter_mu:
-                if self._prefetch_pool is None:
-                    self._prefetch_pool = ThreadPoolExecutor(
-                        max_workers=1, thread_name_prefix="vl-prefetch")
-        return self._prefetch_pool
+        """Lazily create the single prefetch worker.  Fully under the
+        counter lock: partition workers race here against each other AND
+        against close(), and an unlocked fast-path read could return the
+        pool close() is concurrently shutting down (or None)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with self._counter_mu:
+            if self._prefetch_pool is None:
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="vl-prefetch")
+            return self._prefetch_pool
 
     def close(self) -> None:
         """Release the prefetch worker (callers owning a per-query runner
         should close it; the long-lived server runner never needs to)."""
-        if self._prefetch_pool is not None:
-            self._prefetch_pool.shutdown(wait=False)
-            self._prefetch_pool = None
+        # under _counter_mu: a partition worker racing through
+        # _prefetcher() must either see the live pool or rebuild one,
+        # never shut down a pool it is about to submit to
+        with self._counter_mu:
+            pool, self._prefetch_pool = self._prefetch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def _key_lock(self, key) -> threading.Lock:
         return self._stage_locks[hash(key) % len(self._stage_locks)]
@@ -937,9 +943,13 @@ class BatchRunner:
                                                 bk.offset, MAX_BUCKETS)
                         else:
                             self._stage_dict(part, bk.name, layout)
+            # vlint: allow-broad-except(prefetch is best-effort)
             except Exception:
                 pass  # prefetch is best-effort; the scan path re-stages
-        self._prefetcher().submit(work)
+        try:
+            self._prefetcher().submit(work)
+        except RuntimeError:
+            pass  # pool closed between return and submit; best-effort
 
     # ---- device placement hook (MeshBatchRunner shards the row axis) ----
     def _put(self, arr, row_axis: int = 0):
@@ -960,11 +970,13 @@ class BatchRunner:
                               args)
 
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
+        # vlint: allow-jax-host-sync(result readback at dispatch boundary)
         return np.array(K.stats_bucket_count(ids_tuple, strides, mask,
                                              nb))
 
     def _dispatch_stats_values(self, values, ids_tuple, strides, mask,
                                nb):
+        # vlint: allow-jax-host-sync(result readback at dispatch boundary)
         return np.array(K.stats_bucket_values(values, ids_tuple, strides,
                                               mask, nb))
 
@@ -1566,6 +1578,7 @@ class BatchRunner:
             return np.zeros(spc.nrows, dtype=bool), None
         self._bump("device_calls")
         self._kind("scan_pair")
+        # vlint: allow-jax-host-sync(bit-packed survivor download)
         packed = np.array(K32.match_ordered_pair_t_packed(
             spc.rows, spc.lengths,
             jnp.asarray(np.frombuffer(a, dtype=np.uint8)), len(a),
@@ -1621,6 +1634,7 @@ class BatchRunner:
                                       len(op.pattern), op.mode,
                                       op.starts_tok, op.ends_tok, op.fold)
         # bit-packed download (~20x less transfer); unpack is a writable copy
+        # vlint: allow-jax-host-sync(bit-packed survivor download)
         out = np.unpackbits(np.array(res))[:spc.nrows].astype(bool)
         elapsed = time.perf_counter() - t0
         with self._counter_mu:

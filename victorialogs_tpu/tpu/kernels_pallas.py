@@ -39,9 +39,11 @@ try:
     try:
         from jax.experimental.pallas import tpu as pltpu
         _VMEM = pltpu.VMEM
+    # vlint: allow-broad-except(pallas probe: any import failure = off)
     except Exception:  # pragma: no cover - slim builds
         _VMEM = None
     PALLAS_AVAILABLE = True
+# vlint: allow-broad-except(pallas probe: any import failure = off)
 except Exception:  # pragma: no cover
     pl = None
     _VMEM = None

@@ -83,6 +83,7 @@ class PersistentQueue:
         return os.path.join(self.path, f"seg_{n:08d}.bin")
 
     # ---- writer ----
+    # vlint: allow-lock-blocking-call(durable queue: fsync under lock)
     def append(self, data: bytes) -> None:
         """Durably append one block (fsynced before returning)."""
         rec = struct.pack(">I", len(data)) + data
@@ -131,6 +132,7 @@ class PersistentQueue:
                     continue
                 return None
 
+    # vlint: allow-lock-blocking-call(segment read under lock by design)
     def _read_locked(self) -> bytes | None:
         while True:
             seg_path = self._seg_path(self._read_seg)
@@ -158,6 +160,7 @@ class PersistentQueue:
                 continue
             return None
 
+    # vlint: allow-lock-blocking-call(durable reader-state swap)
     def ack(self, data_len: int) -> None:
         """Advance past the block returned by read() (durable)."""
         with self._lock:
@@ -170,6 +173,7 @@ class PersistentQueue:
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.path, READER_STATE))
 
+    # vlint: allow-lock-blocking-call(shutdown flush under lock)
     def close(self) -> None:
         with self._lock:
             self._writer.flush()
