@@ -5,7 +5,7 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test lint bench clean
+.PHONY: all native test lint bench bench-bloom clean
 
 all: native
 
@@ -25,6 +25,11 @@ lint:
 
 bench:
 	python bench.py
+
+# prune throughput: per-block bloom loop vs batched plane probe at 10k
+# blocks (filter-index subsystem; fails under 5x — see PERF.md)
+bench-bloom:
+	python tools/bench_bloom.py
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

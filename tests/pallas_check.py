@@ -71,3 +71,50 @@ got = np.asarray(match_scan_pallas(mat3, lens3, pat, 5, K.MODE_PHRASE,
 assert np.array_equal(got, want)
 
 print(f"PALLAS_PARITY_OK patterns={len(PATTERNS)} rows={mat3.shape[0]}")
+
+# ---- bloom plane probe parity (tpu/bloom_device.py) ----
+
+import numpy as _np  # noqa: E402
+
+from victorialogs_tpu.storage import filterbank as FB  # noqa: E402
+from victorialogs_tpu.storage.bloom import bloom_build  # noqa: E402
+from victorialogs_tpu.tpu.bloom_device import (  # noqa: E402
+    pad_plane, pad_probe_args, plane_keep_pallas, probe_np)
+from victorialogs_tpu.utils.hashing import hash_tokens  # noqa: E402
+
+
+class _FakePart:
+    def __init__(self, blooms):
+        self._b = blooms
+        self.num_blocks = len(blooms)
+
+    def block_column_bloom(self, i, name):
+        return self._b[i]
+
+
+rng = _np.random.default_rng(29)
+universe = [f"tok{i}" for i in range(1500)]
+blooms = []
+for bi in range(300):
+    if bi % 13 == 0:
+        blooms.append(None)
+        continue
+    n = int(rng.integers(1, 250))
+    toks = list(rng.choice(universe, size=n, replace=False))
+    blooms.append(bloom_build(hash_tokens(toks)))
+part = _FakePart(blooms)
+plb = FB.filter_bank(part).plane(part, "f")
+checked = 0
+for t in (1, 2, 3, 8):
+    qt = list(rng.choice(universe, size=t, replace=False))
+    hashes = hash_tokens(qt)
+    idx, shift = plb.block_probe_args(hashes)
+    want = probe_np(plb.plane, idx, shift, plb.nwords)
+    plane_p, nw_p = pad_plane(plb.plane, plb.nwords)
+    idx_p, shift_p = pad_probe_args(idx, shift, plane_p.shape[0])
+    got = _np.asarray(plane_keep_pallas(plane_p, idx_p, shift_p, nw_p,
+                                        interpret=True))
+    assert _np.array_equal(got[:plb.plane.shape[0]], want), t
+    assert got[plb.plane.shape[0]:].all()    # pad blocks: nwords=0 keeps
+    checked += 1
+print(f"BLOOM_PROBE_PARITY_OK tokensets={checked} blocks={len(blooms)}")

@@ -1,0 +1,157 @@
+"""Prune-throughput bench: host per-block bloom loop vs the batched
+plane probe (filter-index subsystem, ISSUE 2 acceptance: >=5x at 10k
+blocks on CPU).
+
+Builds BENCH_BLOOM_BLOCKS synthetic block filters (mixed sizes, the
+realistic shape: per-block distinct-token counts vary), then times
+
+  - loop:  the pre-subsystem kill-path — hash_tokens once, then
+           bloom_contains_all per block in a Python loop;
+  - plane: FilterBank packed-plane probe (plane prebuilt and cached on
+           the part, exactly like the query path after first touch);
+  - agg:   the O(1) part-level aggregate probe (absent tokens only).
+
+Prints ONE JSON line:
+  {"metric": "bloom_prune_throughput", "value": <plane blocks/s>,
+   "unit": "blocks/s", "vs_baseline": <plane/loop speedup>, ...}
+
+Run via `make bench-bloom`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from victorialogs_tpu.storage import filterbank as FB            # noqa: E402
+from victorialogs_tpu.storage.bloom import (bloom_build,         # noqa: E402
+                                            bloom_contains_all)
+from victorialogs_tpu.utils.hashing import hash_tokens           # noqa: E402
+
+N_BLOCKS = int(os.environ.get("BENCH_BLOOM_BLOCKS", "10000"))
+N_QUERIES = 20
+REPS = 5
+
+
+class SyntheticPart:
+    def __init__(self, blooms):
+        self._b = blooms
+        self.num_blocks = len(blooms)
+
+    def block_column_bloom(self, i, name):
+        return self._b[i]
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    universe = [f"tok{i}" for i in range(20000)]
+    t0 = time.perf_counter()
+    blooms = []
+    for _ in range(N_BLOCKS):
+        n = int(rng.integers(8, 256))
+        toks = rng.choice(len(universe), size=n, replace=False)
+        blooms.append(bloom_build(hash_tokens(
+            [universe[int(i)] for i in toks])))
+    build_s = time.perf_counter() - t0
+    part = SyntheticPart(blooms)
+
+    # half the queries present-ish, half absent (the kill case)
+    queries = []
+    for qi in range(N_QUERIES):
+        if qi % 2 == 0:
+            queries.append([universe[int(i)] for i in
+                            rng.choice(len(universe), size=3,
+                                       replace=False)])
+        else:
+            queries.append([f"absent{qi}a", f"absent{qi}b"])
+
+    hashes = [hash_tokens(q) for q in queries]
+
+    # ---- baseline: the per-block Python loop (pre-subsystem path) ----
+    def run_loop():
+        kills = 0
+        for h in hashes:
+            for w in blooms:
+                if not bloom_contains_all(w, h):
+                    kills += 1
+        return kills
+
+    loop_times = []
+    kills = run_loop()                         # warm caches
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        run_loop()
+        loop_times.append(time.perf_counter() - t0)
+    loop_s = statistics.median(loop_times)
+
+    # ---- plane probe (prebuilt, cached on the part) ----
+    t0 = time.perf_counter()
+    pl = FB.filter_bank(part).plane(part, "f")
+    pack_s = time.perf_counter() - t0
+
+    def run_plane():
+        kills = 0
+        for h in hashes:
+            kills += int((~pl.keep_mask(h)).sum())
+        return kills
+
+    plane_kills = run_plane()
+    assert plane_kills == kills, (plane_kills, kills)
+    plane_times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        run_plane()
+        plane_times.append(time.perf_counter() - t0)
+    plane_s = statistics.median(plane_times)
+
+    # ---- aggregate: O(1) part kills, in the searcher's real shape ----
+    # (one probe per PART: the same 10k blocks as 100 parts x 100
+    # blocks — per-size folds discriminate when same-size buckets are
+    # small, which is what real parts look like)
+    ppart = N_BLOCKS // 100
+    parts = [SyntheticPart(blooms[i:i + ppart])
+             for i in range(0, N_BLOCKS, ppart)]
+    t0 = time.perf_counter()
+    aggs = [FB.filter_bank(p).aggregate(p, "f") for p in parts]
+    agg_build_s = time.perf_counter() - t0
+    absent = [h for qi, h in enumerate(hashes) if qi % 2 == 1]
+    agg_kills = 0
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        agg_kills = sum(1 for h in absent for a in aggs
+                        if not a.may_contain_all(h))
+    agg_s = (time.perf_counter() - t0) / REPS
+
+    probes = N_QUERIES * N_BLOCKS
+    out = {
+        "metric": "bloom_prune_throughput",
+        "value": round(probes / plane_s, 1),
+        "unit": "blocks/s",
+        "vs_baseline": round(loop_s / plane_s, 2),
+        "blocks": N_BLOCKS,
+        "queries": N_QUERIES,
+        "loop_blocks_per_s": round(probes / loop_s, 1),
+        "plane_blocks_per_s": round(probes / plane_s, 1),
+        "plane_pack_s": round(pack_s, 4),
+        "agg_build_s": round(agg_build_s, 4),
+        "agg_probe_s_per_part": round(
+            agg_s / max(len(absent) * len(parts), 1), 9),
+        "agg_part_kills": f"{agg_kills}/{len(absent) * len(parts)}",
+        "bloom_build_s": round(build_s, 2),
+    }
+    print(json.dumps(out))
+    if out["vs_baseline"] < 5:
+        print(f"WARN: speedup {out['vs_baseline']}x below the 5x target",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,6 +186,14 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
 
+    # part-level aggregate pruning (filter-index subsystem): AND-path
+    # leaves with required word tokens can kill a WHOLE part in O(1)
+    # against its Bloofi-style aggregate filter before any per-block
+    # work — the per-block bloom kill-path would have zeroed each block
+    # anyway, so results are identical (storage/filterbank.py)
+    from ..logsql.filters import iter_and_path_token_leaves
+    token_leaves = list(iter_and_path_token_leaves(q.filter))
+
     tenant_set = set(tenants)
     batch = runner is not None and hasattr(runner, "run_part")
     # CPU-path block workers (reference spawns GetConcurrency() workers
@@ -208,7 +216,7 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                 return
         _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
                     allowed_sids, min_ts, max_ts, ctx, needed,
-                    deadline, pool, stats_spec, sort_spec)
+                    deadline, pool, stats_spec, sort_spec, token_leaves)
 
     try:
         pts = storage.select_partitions(min_ts, max_ts)
@@ -307,7 +315,9 @@ def _absorb_stats_partials(head, q, spec, partials) -> None:
 
 def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 min_ts, max_ts, ctx, needed, deadline, pool,
-                stats_spec=None, sort_spec=None) -> None:
+                stats_spec=None, sort_spec=None,
+                token_leaves=None) -> None:
+    from ..storage.filterbank import part_aggregate_prunes
     parts = [p for p in pt.ddb.snapshot_parts()
              if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
 
@@ -333,6 +343,20 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
         part_bis = next_bis if next_bis is not None \
             else cand_block_idxs(part)
         next_bis = None
+        if token_leaves and part_bis:
+            # part-level aggregate kill (filter-index subsystem): an
+            # AND-path leaf's required token absent from EVERY block
+            # skips the whole part — identical results, the per-block
+            # kill-path would have zeroed each block anyway.  A COLD
+            # aggregate build reads all the part's blooms, so it only
+            # pays when the candidate set covers a sizable fraction;
+            # narrow queries probe an already-built aggregate for free.
+            if part_aggregate_prunes(
+                    part, token_leaves,
+                    build=len(part_bis) * 4 >= part.num_blocks):
+                if batch and hasattr(runner, "_bump"):
+                    runner._bump("agg_pruned_parts")
+                continue
         if batch and pi + 1 < len(parts):
             # double-buffer: stage part N+1 (host decode + upload) while
             # the device scans part N (SURVEY §7 hard-part 3); the

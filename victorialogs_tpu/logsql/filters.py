@@ -29,7 +29,7 @@ from ..storage.values_encoder import (VT_FLOAT64, VT_INT64, VT_IPV4,
                                       VT_TIMESTAMP_ISO8601, VT_UINT8,
                                       VT_UINT16, VT_UINT32, VT_UINT64,
                                       VT_NAMES, VT_STRING, VT_DICT)
-from ..utils.hashing import hash_tokens
+from ..utils.hashing import cached_token_hashes
 from ..utils.tokenizer import tokenize_string
 from ..engine.block_search import BlockSearch, visit_values
 from .matchers import (is_word_char, match_any_case_phrase,
@@ -68,20 +68,43 @@ class Filter:
         return f"<{type(self).__name__} {self.to_string()}>"
 
 
-def _bloom_prunes(bs: BlockSearch, fld: str, tokens: list[str]) -> bool:
-    """True if the column bloom proves no row can match (all tokens needed)."""
+def _bloom_prunes(bs: BlockSearch, fld: str, f) -> bool:
+    """True if the column bloom proves no row can match (all tokens of
+    filter `f` are required); token hashes memoized on the filter so a
+    query hashes them once, not once per block."""
+    tokens = f._tokens()
     if not tokens:
         return False
     words = bs.bloom(fld)
     if words is None or words.shape[0] == 0:
         return False
-    return not bloom_contains_all(words, hash_tokens(tokens))
+    return not bloom_contains_all(words, cached_token_hashes(f, tokens))
 
 
 def canonical_field(field: str) -> str:
     """Empty field name targets the message column (reference
     getCanonicalColumnName — a bare `foo` searches `_msg`)."""
     return field or "_msg"
+
+
+def iter_and_path_token_leaves(f):
+    """Yield (field, tokens, leaf) for bloom-prunable leaves on the
+    top-level AND path.
+
+    These leaves match nothing anywhere their required word tokens are
+    absent, so a part whose aggregate filter (storage/filterbank.py)
+    proves a token absent from EVERY block can be skipped outright —
+    the per-block kill-path would have zeroed each block one by one.
+    Only FilterAnd is recursed: under OR/NOT a leaf's emptiness doesn't
+    imply the tree's.
+    """
+    if isinstance(f, FilterAnd):
+        for sub in f.filters:
+            yield from iter_and_path_token_leaves(sub)
+    elif isinstance(f, _ValuePredFilter):
+        toks = f._tokens()
+        if toks:
+            yield canonical_field(f.field), toks, f
 
 
 def _native_scan_ops(col, ops, combine: str):
@@ -184,7 +207,7 @@ class _ValuePredFilter(Filter):
 
     def apply_to_block(self, bs: BlockSearch, bm: np.ndarray) -> None:
         fld = canonical_field(self.field)
-        if _bloom_prunes(bs, fld, self._tokens()):
+        if _bloom_prunes(bs, fld, self):
             bm[:] = False
             return
         # native arena scan: one memmem pass over a packed string column
@@ -528,7 +551,7 @@ class FilterRegexp(_ValuePredFilter):
         # on survivors — decoded individually from the arena, never as a
         # whole-column string list
         fld = canonical_field(self.field)
-        if _bloom_prunes(bs, fld, self._tokens()):
+        if _bloom_prunes(bs, fld, self):
             bm[:] = False
             return
         lits = [t for t in self._substr_literals if t]

@@ -86,7 +86,7 @@ def distributed_scan_count(mesh, rows, lengths,
         return bms, total, hist.astype(jnp.int32)
 
     spec = P(BLOCK_AXIS)
-    return jax.shard_map(
+    return K.shard_map_fn()(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=(spec, P(), P()))(rows, lengths, bucket_ids)
@@ -140,7 +140,7 @@ def _stats_values_mesh(mesh, values, ids_tuple, strides, mask,
         return K.pack_stats(cnt, sums, lo, hi)
 
     spec = P(BLOCK_AXIS)
-    return jax.shard_map(
+    return K.shard_map_fn()(
         shard_fn, mesh=mesh,
         in_specs=(spec, tuple(spec for _ in ids_tuple), spec),
         out_specs=P())(values, ids_tuple, mask)
@@ -155,7 +155,7 @@ def _stats_count_mesh(mesh, ids_tuple, strides, mask, num_buckets):
         return jax.lax.psum(cnt, BLOCK_AXIS)
 
     spec = P(BLOCK_AXIS)
-    return jax.shard_map(
+    return K.shard_map_fn()(
         shard_fn, mesh=mesh,
         in_specs=(tuple(spec for _ in ids_tuple), spec),
         out_specs=P())(ids_tuple, mask)
@@ -207,6 +207,13 @@ class MeshBatchRunner(BatchRunner):
                 return jax.device_put(arr, self._row_sharding)
             return jax.device_put(
                 arr, NamedSharding(self.mesh, P(None, BLOCK_AXIS)))
+        return jax.device_put(arr, self._replicated)
+
+    def _put_replicated(self, arr):
+        # block-axis arrays (bloom planes / keep-mask operands): every
+        # shard probes the full block axis, so these never stripe —
+        # matches the P() in_specs the fused mesh dispatch declares for
+        # non-row args
         return jax.device_put(arr, self._replicated)
 
     def _dispatch_fused(self, prog, strides, nb, n_values, nrows,

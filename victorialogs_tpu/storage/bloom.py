@@ -64,13 +64,31 @@ def bloom_contains_all(words: np.ndarray, token_hashes: np.ndarray) -> bool:
 def bloom_probe_positions(token_hashes: np.ndarray, nwords: int) -> np.ndarray:
     """All probe bit positions for the given hashes -> uint64[T, 6].
 
-    Used by the TPU path: positions are computed host-side for the (few) query
-    tokens, the device only tests bits across many block blooms at once.
+    The host side of the batched probe: positions are computed once per
+    distinct filter word-count for the (few) query tokens, then tested
+    against MANY block filters at once — the packed plane and aggregate
+    probes in storage/filterbank.py and the device keep-mask in
+    tpu/bloom_device.py all consume these positions.  The iteration must
+    stay in lockstep with bloom_contains_all's splitmix64 stream
+    (pinned by tests/test_filterbank.py) or host and device pruning
+    would drift.
     """
-    nbits = np.uint64(nwords * 64)
+    return bloom_probe_positions_multi(token_hashes, (nwords,))[0]
+
+
+def bloom_probe_positions_multi(token_hashes: np.ndarray,
+                                nwords_list) -> np.ndarray:
+    """Probe positions for SEVERAL filter sizes at once -> uint64[S, T, 6].
+
+    A part's blocks carry different-size filters (word count tracks the
+    block's distinct token count), so batched probing needs positions
+    per distinct size; the splitmix64 stream depends only on the hashes
+    and is iterated once here, then reduced modulo each size's bit
+    count — a single broadcast instead of S separate iterations."""
     h = token_hashes.astype(np.uint64, copy=True)
-    out = np.empty((len(h), BLOOM_HASHES), dtype=np.uint64)
+    hs = np.empty((len(h), BLOOM_HASHES), dtype=np.uint64)
     for k in range(BLOOM_HASHES):
-        out[:, k] = h % nbits
+        hs[:, k] = h
         h = splitmix64_np(h)
-    return out
+    nbits = np.asarray(nwords_list, dtype=np.uint64) * np.uint64(64)
+    return hs[None, :, :] % nbits[:, None, None]

@@ -1,0 +1,414 @@
+"""Filter-index subsystem: packed per-part bloom planes + part aggregates.
+
+Turns bloom pruning from an O(blocks) host Python loop into one dense
+batched probe per (part, column), plus an O(1) part-level kill:
+
+- **Bloom plane** (split-block layout, Lang et al. arXiv:2101.01719
+  reshaped for whole-part probing): every block's bloom words for one
+  column packed into a single zero-padded uint32 matrix `[B, 2*Wmax]`
+  (uint64 words as 2 little-endian uint32 lanes — the same lane
+  reinterpretation the device kernels use).  Probe positions are
+  computed host-side ONCE PER DISTINCT FILTER SIZE with
+  `bloom.bloom_probe_positions` and broadcast to per-block gather
+  indices, so testing T tokens against B blocks is a single vectorized
+  gather + bit-test instead of B Python calls.  The same
+  (plane, idx, shift, nwords) arguments drive the device probe
+  (tpu/bloom_device.py) unchanged.
+
+- **Part aggregate** (Bloofi-style, arXiv:1501.01941): fixed-width
+  OR-folds of the block filters, one fold per distinct filter size
+  (probe positions of a size-w filter span only w words, so sizes must
+  not share a fold).  Word i of a block filter folds into aggregate
+  word ``i % width``, so a bit set by ANY block is set in its size's
+  aggregate and the probe has no false negatives.  A token whose
+  probes miss for EVERY distinct block-filter size present in the part
+  is absent from every block — the whole part dies in O(1) before any
+  block header is touched by the query.
+
+Both are derived purely from the existing blooms.bin sidecar (no format
+change) and cached on the part object (parts are immutable).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.hashing import cached_token_hashes
+from .bloom import (BLOOM_HASHES, bloom_contains_all,
+                    bloom_probe_positions_multi)
+
+# aggregate fold width cap, in uint64 words (4096 words = 32 KiB bits);
+# small parts fold at their own max filter size instead
+AGG_WORDS = 4096
+
+# planes beyond this decline to the per-block path (a pathological part
+# with huge per-block filters must not balloon host memory)
+_MAX_PLANE_BYTES = int(os.environ.get("VL_BLOOM_PLANE_MAX_BYTES",
+                                      str(256 << 20)))
+
+# global budget for ALL host-resident planes: planes duplicate the
+# mmap'd blooms.bin data in RAM, so a long-lived server querying many
+# (part, column) pairs must stay bounded — past the budget, new columns
+# take the per-block fallback (identical semantics, just slower) until
+# parts (and their banks) are garbage-collected
+_BANK_MAX_BYTES = int(os.environ.get("VL_BLOOM_BANK_MAX_BYTES",
+                                     str(1 << 30)))
+_bank_mu = threading.Lock()
+_bank_bytes = 0
+
+
+def _bank_try_charge(n: int) -> bool:
+    global _bank_bytes
+    with _bank_mu:
+        if _bank_bytes + n > _BANK_MAX_BYTES:
+            return False
+        _bank_bytes += n
+        return True
+
+
+def _bank_release(charges: list) -> None:
+    """weakref.finalize callback: a collected FilterBank returns its
+    planes' bytes to the budget (charges is the bank's live list)."""
+    global _bank_bytes
+    with _bank_mu:
+        _bank_bytes -= sum(charges)
+        charges.clear()
+
+
+@dataclass
+class BloomPlane:
+    """All (block, column) bloom filters of one part column, packed."""
+    plane: np.ndarray              # uint32[B, 2*Wmax], zero-padded
+    nwords: np.ndarray             # int32[B]; 0 = block has no bloom
+    sizes: tuple                   # distinct nonzero word counts, sorted
+    size_id: np.ndarray            # int32[B] index into sizes (0 if none)
+    nbytes: int
+
+    # single-slot memo: the same (leaf, part) pair probes with the same
+    # hashes from the planner, the evaluator and the prefetcher.  One
+    # (key, value) tuple, swapped atomically (GIL) — concurrent probers
+    # may duplicate work but never see a key/value mismatch.
+    _memo: tuple | None = None
+
+    def probe_tables(self, hashes: np.ndarray
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-size gather tables -> (idx, shift) int32[S, T*6].
+
+        idx is the uint32-lane index of each probe bit inside a plane
+        row (2*word + high-half), shift the bit position within the
+        lane; both derived from bloom_probe_positions so the host and
+        device probes share one position derivation.
+        """
+        key = hashes.tobytes()
+        memo = self._memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        p = len(hashes) * BLOOM_HASHES
+        pos = bloom_probe_positions_multi(hashes, self.sizes) \
+            .reshape(len(self.sizes), p)
+        idx = ((pos >> np.uint64(6)) * np.uint64(2)
+               + ((pos >> np.uint64(5)) & np.uint64(1))).astype(np.int32)
+        shift = (pos & np.uint64(31)).astype(np.int32)
+        self._memo = (key, (idx, shift))
+        return idx, shift
+
+    def block_probe_args(self, hashes: np.ndarray
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """(idx, shift) int32[B, T*6] — per-block gather arguments."""
+        idx_s, shift_s = self.probe_tables(hashes)
+        return idx_s[self.size_id], shift_s[self.size_id]
+
+    def keep_mask(self, hashes: np.ndarray,
+                  bis=None) -> np.ndarray:
+        """bool keep-mask: True where the block may contain ALL tokens
+        (or has no bloom).  bis: optional block-idx list restricting the
+        probe (returned mask is aligned with bis)."""
+        from ..tpu.bloom_device import probe_np
+        if bis is None:
+            if len(hashes) == 0:
+                return np.ones(self.plane.shape[0], dtype=bool)
+            idx, shift = self.block_probe_args(hashes)
+            return probe_np(self.plane, idx, shift, self.nwords)
+        sel = np.asarray(list(bis), dtype=np.int64)
+        if len(hashes) == 0:
+            return np.ones(sel.shape[0], dtype=bool)
+        idx_s, shift_s = self.probe_tables(hashes)
+        sid = self.size_id[sel]
+        # gather ONLY the probed lanes (cost scales with T*6, not Wmax;
+        # plane[sel] would copy whole rows first).  Bit-test semantics
+        # are probe_np's, pinned by the differential tests.
+        words = self.plane[sel[:, None], idx_s[sid]]
+        bits = (words >> shift_s[sid].astype(np.uint32)) & np.uint32(1)
+        return (bits != 0).all(axis=1) | (self.nwords[sel] == 0)
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+@dataclass
+class AggregateFilter:
+    """Fixed-width OR-folds of the part's block filters, one per
+    distinct filter size, padded into one matrix so a probe is a
+    single vectorized gather over every (size, token, probe) at once.
+
+    Probe positions of a size-w filter only span w words, so folding
+    different sizes together saturates immediately; folding WITHIN a
+    size is exact up to the width cap (word i ORs into i % width), and
+    same-size blocks are naturally few — block filter size tracks the
+    block's distinct token count."""
+    mat: np.ndarray                # uint64[S, Wcap] zero-padded folds
+    widths: np.ndarray             # uint64[S] fold width per size
+    sizes: tuple                   # distinct filter word counts (|| mat)
+    all_have: bool                 # every block has a non-empty bloom
+
+    def may_contain_all(self, hashes: np.ndarray) -> bool:
+        """False only when some token is PROVABLY absent from every
+        block (=> a filter requiring all tokens matches nothing in the
+        part).  Blocks without blooms can hide anything, so a part
+        where any block lacks one is never killable."""
+        if not self.all_have or len(hashes) == 0:
+            return True
+        pos = bloom_probe_positions_multi(hashes, self.sizes)  # [S,T,6]
+        wi = (pos >> np.uint64(6)) % self.widths[:, None, None]
+        bit = (self.mat[np.arange(len(self.sizes))[:, None, None],
+                        wi.astype(np.int64)]
+               >> (pos & np.uint64(63))) & np.uint64(1)
+        # a token is possible if SOME size's fold holds all its probes
+        return bool(bit.astype(bool).all(axis=2).any(axis=0).all())
+
+
+class FilterBank:
+    """Per-part cache of bloom planes and aggregate filters.
+
+    Attached lazily to the part object (Part and InmemoryPart both
+    expose the uniform block_column_bloom API); parts are immutable so
+    entries never invalidate.  Thread-safe: the evaluator, the
+    prefetcher and concurrent partition workers may probe one part at
+    once — builds run outside the lock and the first insert wins.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._planes: dict = {}
+        self._aggs: dict = {}
+        # plane byte charges against the global budget, released when
+        # the bank (== its part) is garbage-collected
+        self._charged: list = []
+        weakref.finalize(self, _bank_release, self._charged)
+
+    def plane(self, part, field: str) -> BloomPlane | None:
+        with self._mu:
+            got = self._planes.get(field, _MISSING)
+        if got is not _MISSING:
+            return got
+        built = _build_plane(part, field)
+        if built is not None and not _bank_try_charge(built.nbytes):
+            built = None               # budget exhausted: per-block path
+        with self._mu:
+            got = self._planes.setdefault(field, built)
+            if got is built and built is not None:
+                self._charged.append(built.nbytes)
+        if got is not built and built is not None:
+            _bank_release([built.nbytes])  # lost the build race
+        return got
+
+    def cached_plane(self, field: str) -> "BloomPlane | None":
+        """The plane if one was already built; never builds (the
+        aggregate can fold from raw blooms directly, so a pure CPU-path
+        query must not pay the plane's B x 2*Wmax host memory)."""
+        with self._mu:
+            got = self._planes.get(field, _MISSING)
+        return None if got is _MISSING else got
+
+    def aggregate(self, part, field: str) -> AggregateFilter | None:
+        with self._mu:
+            got = self._aggs.get(field, _MISSING)
+        if got is not _MISSING:
+            return got
+        built = _build_aggregate(part, field, self.cached_plane(field))
+        with self._mu:
+            got = self._aggs.setdefault(field, built)
+        return got
+
+    def cached_aggregate(self, field: str) -> "AggregateFilter | None":
+        with self._mu:
+            got = self._aggs.get(field, _MISSING)
+        return None if got is _MISSING else got
+
+
+_MISSING = object()
+_attach_mu = threading.Lock()
+
+
+def filter_bank(part) -> FilterBank:
+    """The part's FilterBank, attached on first use."""
+    fb = getattr(part, "_filter_bank", None)
+    if fb is None:
+        with _attach_mu:
+            fb = getattr(part, "_filter_bank", None)
+            if fb is None:
+                fb = FilterBank()
+                part._filter_bank = fb
+    return fb
+
+
+def _build_plane(part, field: str) -> BloomPlane | None:
+    """Pack every block's bloom words for `field` into one uint32 plane.
+
+    None when no block has a bloom for the column (nothing to probe) or
+    the padded plane would exceed the size cap (per-block fallback)."""
+    nblocks = part.num_blocks
+    words_by_block: list = [None] * nblocks
+    nwords = np.zeros(nblocks, dtype=np.int32)
+    wmax = 0
+    for bi in range(nblocks):
+        w = part.block_column_bloom(bi, field)
+        if w is None or w.shape[0] == 0:
+            continue
+        words_by_block[bi] = w
+        nwords[bi] = w.shape[0]
+        if w.shape[0] > wmax:
+            wmax = int(w.shape[0])
+    if wmax == 0:
+        return None
+    if nblocks * wmax * 8 > _MAX_PLANE_BYTES:
+        return None
+    plane = np.zeros((nblocks, 2 * wmax), dtype=np.uint32)
+    for bi, w in enumerate(words_by_block):
+        if w is None:
+            continue
+        lanes = np.ascontiguousarray(w, dtype=np.uint64).view(np.uint32)
+        plane[bi, :lanes.shape[0]] = lanes
+    sizes = tuple(sorted(int(s) for s in np.unique(nwords[nwords > 0])))
+    size_of = {s: i for i, s in enumerate(sizes)}
+    size_id = np.zeros(nblocks, dtype=np.int32)
+    for bi in range(nblocks):
+        if nwords[bi]:
+            size_id[bi] = size_of[int(nwords[bi])]
+    return BloomPlane(plane=plane, nwords=nwords, sizes=sizes,
+                      size_id=size_id, nbytes=plane.nbytes)
+
+
+def _fold_into(agg: np.ndarray, words: np.ndarray) -> None:
+    aw = agg.shape[0]
+    for start in range(0, words.shape[0], aw):
+        chunk = np.asarray(words[start:start + aw], dtype=np.uint64)
+        agg[:chunk.shape[0]] |= chunk
+
+
+def _pack_aggs(aggs: dict, all_have: bool) -> AggregateFilter:
+    sizes = tuple(sorted(aggs))
+    wcap = max(a.shape[0] for a in aggs.values())
+    mat = np.zeros((len(sizes), wcap), dtype=np.uint64)
+    widths = np.empty(len(sizes), dtype=np.uint64)
+    for si, s in enumerate(sizes):
+        a = aggs[s]
+        mat[si, :a.shape[0]] = a
+        widths[si] = a.shape[0]
+    return AggregateFilter(mat=mat, widths=widths, sizes=sizes,
+                           all_have=all_have)
+
+
+def _build_aggregate(part, field: str,
+                     plane: BloomPlane | None) -> AggregateFilter | None:
+    """Per-size OR-folds of the block filters.
+
+    Rides the packed plane when available (pure row reductions per size
+    group); falls back to a direct per-block fold when the plane
+    declined on size.  None when no block has a bloom for the column."""
+    if plane is not None:
+        aggs = {}
+        for si, w in enumerate(plane.sizes):
+            rows = plane.plane[(plane.size_id == si)
+                               & (plane.nwords > 0)]
+            col_or = np.bitwise_or.reduce(rows[:, :2 * w], axis=0)
+            lo = col_or[0::2].astype(np.uint64)
+            hi = col_or[1::2].astype(np.uint64)
+            words = lo | (hi << np.uint64(32))          # uint64[w]
+            agg = np.zeros(min(w, AGG_WORDS), dtype=np.uint64)
+            _fold_into(agg, words)
+            aggs[w] = agg
+        return _pack_aggs(aggs, bool((plane.nwords > 0).all()))
+    aggs = {}
+    have = 0
+    nblocks = part.num_blocks
+    for bi in range(nblocks):
+        w = part.block_column_bloom(bi, field)
+        if w is None or w.shape[0] == 0:
+            continue
+        have += 1
+        size = int(w.shape[0])
+        agg = aggs.get(size)
+        if agg is None:
+            agg = aggs[size] = np.zeros(min(size, AGG_WORDS),
+                                        dtype=np.uint64)
+        _fold_into(agg, w)
+    if not aggs:
+        return None
+    return _pack_aggs(aggs, have == nblocks)
+
+
+# ---------------- query-path entry points ----------------
+
+def bloom_keep_mask(part, field: str, hashes: np.ndarray,
+                    bis=None) -> np.ndarray:
+    """THE bloom kill-path: bool keep-mask over `bis` (or all blocks),
+    True where the block may contain ALL tokens (or has no bloom).
+
+    Rides the packed plane when the column has one; columns without a
+    plane (no blooms anywhere, or past the size cap) fall back to a
+    per-block probe with identical semantics — every caller sees one
+    contract, so the evaluator, prefetcher and fused planner can never
+    diverge on survivors.
+
+    A COLD plane build reads every block's bloom (forcing all lazy
+    header groups) and charges the bank budget, so it only pays when
+    the probed candidate set covers a sizable fraction of the part —
+    the same coverage gate the searcher applies to aggregate builds;
+    narrow probes ride an already-built plane or the per-block loop."""
+    fb = filter_bank(part)
+    pl = fb.cached_plane(field)
+    if pl is None and (bis is None
+                       or len(bis) * 4 >= part.num_blocks):
+        pl = fb.plane(part, field)
+    if pl is not None:
+        return pl.keep_mask(hashes, bis)
+    idxs = list(bis) if bis is not None else list(range(part.num_blocks))
+    keep = np.ones(len(idxs), dtype=bool)
+    if len(hashes) == 0:
+        return keep
+    for k, bi in enumerate(idxs):
+        w = part.block_column_bloom(bi, field)
+        if w is not None and w.shape[0] and \
+                not bloom_contains_all(w, hashes):
+            keep[k] = False
+    return keep
+
+
+def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
+    """O(1) part-level kill: True when some AND-path filter leaf's
+    required tokens are provably absent from every block of the part.
+
+    leaves: [(field, tokens, owner_filter)] from
+    logsql.filters.iter_and_path_token_leaves — owner_filter carries the
+    per-filter token-hash cache so tokens hash once per query.
+    build=False probes only aggregates that already exist (a cold build
+    reads every block's bloom, which a time-narrow query touching few
+    candidate blocks should not pay — the caller gates on candidate
+    coverage)."""
+    fb = filter_bank(part) if build else \
+        getattr(part, "_filter_bank", None)
+    if fb is None:
+        return False
+    for field, tokens, f in leaves:
+        agg = fb.aggregate(part, field) if build else \
+            fb.cached_aggregate(field)
+        if agg is not None and \
+                not agg.may_contain_all(cached_token_hashes(f, tokens)):
+            return True
+    return False

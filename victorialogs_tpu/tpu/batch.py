@@ -16,7 +16,10 @@ a dispatch-latency-bound accelerator: fewer, bigger kernels win.
 Filter-tree semantics are identical to the CPU path (the parity tests in
 tests/test_tpu_runner.py and tests/test_batch_runner.py diff them bit-exactly):
 - AND children evaluate left-to-right with block-level early exit;
-- bloom pruning stays on the host kill-path (filter_phrase.go:302 analogue);
+- bloom pruning stays on the kill-path BEFORE staging
+  (filter_phrase.go:302 analogue), but runs as one batched plane probe
+  per (part, column) via the filter-index subsystem
+  (storage/filterbank.py + tpu/bloom_device.py), not per block;
 - rows longer than the staging width are truncated on device and re-checked
   on the host with the filter's full predicate;
 - regex runs its mandatory-literal substring prefilter on device and
@@ -34,9 +37,9 @@ import numpy as np
 
 from ..engine.block_search import BlockSearch
 from ..logsql import filters as F
-from ..storage.bloom import bloom_contains_all
+from ..storage.filterbank import bloom_keep_mask
 from ..storage.values_encoder import VT_DICT, VT_STRING
-from ..utils.hashing import hash_tokens
+from ..utils.hashing import cached_token_hashes
 from . import kernels as K
 from . import kernels32 as K32
 from .layout import StagingCache, row_width_bucket
@@ -840,6 +843,8 @@ class BatchRunner:
         self.stats_dispatches = 0
         self.fused_dispatches = 0
         self.topk_dispatches = 0
+        self.bloom_plane_probes = 0
+        self.agg_pruned_parts = 0
         self.stats_shards = 1          # mesh runners stripe rows over >1
         # distinct dispatch shapes this runner has sent to the device —
         # the multichip dryrun asserts breadth here (verdict r4 weak #6)
@@ -913,14 +918,11 @@ class BatchRunner:
                 for plan in device_plans(f):
                     surv = bis
                     if plan.bloom_tokens:
-                        hashes = hash_tokens(plan.bloom_tokens)
-                        surv = []
-                        for bi in bis:
-                            words = part.block_column_bloom(bi, plan.field)
-                            if words is not None and words.shape[0] and \
-                                    not bloom_contains_all(words, hashes):
-                                continue
-                            surv.append(bi)
+                        hashes = cached_token_hashes(plan.filter,
+                                                     plan.bloom_tokens)
+                        keep = bloom_keep_mask(part, plan.field, hashes,
+                                               bis)
+                        surv = [bi for bi, k in zip(bis, keep) if k]
                     if not surv:
                         continue
                     cand_rows = sum(part.block_rows(bi) for bi in surv)
@@ -953,6 +955,13 @@ class BatchRunner:
 
     # ---- device placement hook (MeshBatchRunner shards the row axis) ----
     def _put(self, arr, row_axis: int = 0):
+        import jax.numpy as jnp
+        return jnp.asarray(arr)
+
+    def _put_replicated(self, arr):
+        """Placement for block-axis arrays (bloom planes): every device
+        needs the whole array — a mesh runner replicates instead of
+        striping (the block axis is not the sharded row axis)."""
         import jax.numpy as jnp
         return jnp.asarray(arr)
 
@@ -1134,19 +1143,26 @@ class BatchRunner:
 
     def _eval_leaf(self, plan: LeafPlan, part, bss, alive) -> dict:
         out = {}
-        # host bloom kill-path FIRST (cheap, mmap'd words): when a rare
-        # token prunes every candidate block, the part is never staged
+        # bloom kill-path FIRST (cheap, mmap'd words): when a rare token
+        # prunes every candidate block, the part is never staged.  The
+        # probe is one dense gather over the part's packed bloom plane
+        # (storage/filterbank.py + tpu/bloom_device.py), not a per-block
+        # Python loop; columns without a plane keep the per-block path.
         survivors = list(alive)
         if plan.bloom_tokens:
-            hashes = hash_tokens(plan.bloom_tokens)
+            from ..storage.filterbank import filter_bank
+            hashes = cached_token_hashes(plan.filter, plan.bloom_tokens)
+            keep = bloom_keep_mask(part, plan.field, hashes, alive)
+            if filter_bank(part).cached_plane(plan.field) is not None:
+                # evidence the PLANE path served the probe (a declined
+                # column rode the per-block fallback instead)
+                self._bump("bloom_plane_probes")
             survivors = []
-            for bi in alive:
-                words = bss[bi].bloom(plan.field)
-                if words is not None and words.shape[0] and \
-                        not bloom_contains_all(words, hashes):
-                    out[bi] = np.zeros(bss[bi].nrows, dtype=bool)
-                else:
+            for bi, k in zip(alive, keep):
+                if k:
                     survivors.append(bi)
+                else:
+                    out[bi] = np.zeros(bss[bi].nrows, dtype=bool)
             if not survivors:
                 return out
 
@@ -1469,6 +1485,34 @@ class BatchRunner:
             got = self.cache.get(key)
             if got is None:
                 got = stage_ts_planes(part, layout, put=self._put)
+                self.cache.put(key, got)
+            return got
+
+    def _stage_bloom_plane(self, part, field: str):
+        """HBM-resident packed bloom plane for the fused in-dispatch
+        bloom kill (tpu/bloom_device.py); cached like all staging."""
+        from .bloom_device import stage_bloom_plane
+        key = (part.uid, "#bloom", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
+            if got is None:
+                got = stage_bloom_plane(part, field,
+                                        put=self._put_replicated)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
+
+    def _stage_block_ids(self, part, layout):
+        from .bloom_device import stage_block_ids
+        key = (part.uid, "#bid")
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                got = stage_block_ids(part, layout, put=self._put)
                 self.cache.put(key, got)
             return got
 

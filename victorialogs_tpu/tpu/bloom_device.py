@@ -1,0 +1,221 @@
+"""Batched bloom-plane probes: one dense bit-test over all blocks.
+
+The host packs a part column's bloom filters into a zero-padded uint32
+plane `[B, 2*Wmax]` and derives per-block probe coordinates from the
+query tokens (storage/filterbank.py — positions come from
+``bloom_probe_positions`` so host and device share one derivation).
+This module evaluates the keep-mask three ways off those SAME
+arguments:
+
+- ``probe_np``: vectorized numpy — the host kill-path in
+  tpu/batch.py's leaf evaluation and the prefetcher (a probe over 10k
+  blocks is one gather + bit-test instead of 10k Python calls).
+- ``plane_keep``: the jnp expression, traceable inside the fused
+  single-dispatch jit (tpu/fused.py) — the per-block keep-mask gathers
+  to rows through the staged block-id column and ANDs against the scan
+  tree IN HBM, no host round-trip.
+- ``plane_keep_pallas``: a VMEM-tiled Pallas variant (gate behind
+  VL_PALLAS=1, exactly like kernels_pallas.match_scan) replacing the
+  gather with a lane-select so the probe stays a dense VPU op;
+  interpret-mode parity is pinned in tests/pallas_check.py.
+
+Layout contract (split-block style, Lang et al. arXiv:2101.01719):
+  plane  uint32[B, WP]  2 little-endian lanes per uint64 word, 0-padded
+  idx    int32[B, P]    uint32-lane index of each probe bit (< 2*nwords)
+  shift  int32[B, P]    bit position within the lane (0..31)
+  nwords int32[B]       0 => block has no bloom => always keep
+returns bool[B]: True where the block may contain ALL probed tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kernels_pallas import _VMEM, PALLAS_AVAILABLE, pl
+
+PROBE_TILE_B = 128     # pallas block-axis tile (int32 sublane multiple)
+PROBE_LANE = 128       # pallas lane width; also the max probe count
+MAX_PALLAS_PROBES = PROBE_LANE
+
+
+def probe_np(plane: np.ndarray, idx: np.ndarray, shift: np.ndarray,
+             nwords: np.ndarray) -> np.ndarray:
+    """Vectorized host probe; bit-identical to per-block
+    bloom_contains_all (tests/test_filterbank.py differentials)."""
+    if idx.shape[1] == 0:
+        return np.ones(plane.shape[0], dtype=bool)
+    words = np.take_along_axis(plane, idx, axis=1)
+    bits = (words >> shift.astype(np.uint32)) & np.uint32(1)
+    return (bits != 0).all(axis=1) | (nwords == 0)
+
+
+def plane_keep(plane, idx, shift, nwords, use_pallas: bool = False,
+               interpret: bool = False):
+    """jnp keep-mask; traceable inside an outer jit (fused dispatch)."""
+    if use_pallas and PALLAS_AVAILABLE and \
+            _pallas_ok(plane.shape, idx.shape):
+        return plane_keep_pallas(plane, idx, shift, nwords,
+                                 interpret=interpret)
+    words = jnp.take_along_axis(plane, idx, axis=1)
+    bits = (words >> shift.astype(jnp.uint32)) & jnp.uint32(1)
+    return jnp.all(bits != 0, axis=1) | (nwords == 0)
+
+
+@jax.jit
+def plane_probe(plane, idx, shift, nwords):
+    """Standalone jitted probe -> bool[B] (bench/parity entry point)."""
+    return plane_keep(plane, idx, shift, nwords)
+
+
+# ---------------- pallas variant ----------------
+
+def _pallas_ok(plane_shape, idx_shape) -> bool:
+    b, wp = plane_shape
+    return (b % PROBE_TILE_B == 0 and wp % PROBE_LANE == 0
+            and 0 < idx_shape[1] <= MAX_PALLAS_PROBES)
+
+
+def _probe_kernel(plane_ref, idx_ref, shift_ref, nw_ref, out_ref, *,
+                  nprobes: int, wp: int):
+    """One (PROBE_TILE_B, WP) tile: all probes tested from VMEM.
+
+    No gather: each probe selects its lane by comparing a broadcast
+    iota against the per-block lane index and sum-reducing the masked
+    plane (exactly one lane matches; idx < 2*nwords <= WP always), so
+    the probe lowers to dense VPU compare/select/reduce ops — the same
+    Mosaic-friendly shape discipline as kernels_pallas._scan_kernel.
+    """
+    plane = plane_ref[:]                       # int32[TB, WP] bit pattern
+    tb = plane.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tb, wp), 1)
+    ok = jnp.ones((tb, 1), dtype=jnp.bool_)
+    for j in range(nprobes):
+        sel = lane == idx_ref[:, j:j + 1]
+        word = jnp.sum(jnp.where(sel, plane, 0), axis=1, keepdims=True)
+        # arithmetic >> then &1 extracts the bit regardless of sign
+        bit = (word >> shift_ref[:, j:j + 1]) & 1
+        ok = jnp.logical_and(ok, bit > 0)
+    keep = jnp.logical_or(ok, nw_ref[:, :] == 0)
+    out_ref[:, :] = keep.astype(jnp.int8)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def plane_keep_pallas(plane, idx, shift, nwords, interpret: bool = False):
+    """Pallas drop-in for the jnp probe on aligned shapes -> bool[B]."""
+    b, wp = plane.shape
+    assert _pallas_ok(plane.shape, idx.shape), (plane.shape, idx.shape)
+    nprobes = idx.shape[1]
+    g = b // PROBE_TILE_B
+    # uint32 planes ride as int32 bit patterns (Mosaic int32 lanes)
+    plane_i = jax.lax.bitcast_convert_type(plane, jnp.int32)
+    pad = PROBE_LANE - nprobes
+    if pad:
+        idx = jnp.pad(idx, ((0, 0), (0, pad)))
+        shift = jnp.pad(shift, ((0, 0), (0, pad)))
+    nw_col = nwords.reshape(b, 1).astype(jnp.int32)
+    vmem = None if interpret else _VMEM
+
+    def spec(block, index_map):
+        if vmem is None:
+            return pl.BlockSpec(block, index_map)
+        return pl.BlockSpec(block, index_map, memory_space=vmem)
+
+    kernel = partial(_probe_kernel, nprobes=nprobes, wp=wp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            spec((PROBE_TILE_B, wp), lambda i: (i, 0)),
+            spec((PROBE_TILE_B, PROBE_LANE), lambda i: (i, 0)),
+            spec((PROBE_TILE_B, PROBE_LANE), lambda i: (i, 0)),
+            spec((PROBE_TILE_B, 1), lambda i: (i, 0)),
+        ],
+        out_specs=spec((PROBE_TILE_B, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1), jnp.int8),
+        interpret=interpret,
+    )(plane_i, idx.astype(jnp.int32), shift.astype(jnp.int32), nw_col)
+    return out.reshape(b).astype(jnp.bool_)
+
+
+# ---------------- device staging helpers ----------------
+
+@dataclass
+class StagedBloomPlane:
+    """One part column's bloom plane resident in HBM (replicated on a
+    mesh: every shard probes the full block axis)."""
+    plane: object                  # jax uint32[Bp, WP]
+    nwords: object                 # jax int32[Bp]; 0 = always keep
+    bp: int                        # padded block count
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+@dataclass
+class StagedBlockIds:
+    """Layout-coordinate block id per row: the gather bridge from a
+    bool[B] keep-mask to a row bitmap, staged once per part."""
+    ids: object                    # jax int32[RLp], row-aligned
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_bloom_plane(part, field: str, put) -> StagedBloomPlane | None:
+    """Upload the part column's packed plane (padded to device tiles);
+    None when the column has no plane (no blooms / oversized)."""
+    from ..storage.filterbank import filter_bank
+    plb = filter_bank(part).plane(part, field)
+    if plb is None:
+        return None
+    plane, nw = pad_plane(plb.plane, plb.nwords)
+    return StagedBloomPlane(plane=put(plane), nwords=put(nw),
+                            bp=plane.shape[0],
+                            nbytes=plane.nbytes + nw.nbytes)
+
+
+def stage_block_ids(part, layout, put) -> StagedBlockIds:
+    bid = np.zeros(layout.nrows_padded, dtype=np.int32)
+    for bi in range(part.num_blocks):
+        s = layout.starts[bi]
+        bid[s:s + part.block_rows(bi)] = bi
+    return StagedBlockIds(ids=put(bid), nbytes=bid.nbytes)
+
+def pad_plane(plane: np.ndarray, nwords: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray]:
+    """Pad a host plane to the device layout: block axis to a
+    PROBE_TILE_B multiple, lanes to a PROBE_LANE multiple.  Pad blocks
+    carry nwords=0 (always-keep) and are never gathered by a real row;
+    padding also buckets jit signatures so part-shape churn doesn't
+    recompile the fused program per part."""
+    b, wp = plane.shape
+    bp = ((b + PROBE_TILE_B - 1) // PROBE_TILE_B) * PROBE_TILE_B
+    wpp = max(PROBE_LANE,
+              ((wp + PROBE_LANE - 1) // PROBE_LANE) * PROBE_LANE)
+    if bp == b and wpp == wp:
+        return plane, nwords
+    out = np.zeros((bp, wpp), dtype=np.uint32)
+    out[:b, :wp] = plane
+    nw = np.zeros(bp, dtype=np.int32)
+    nw[:b] = nwords
+    return out, nw
+
+
+def pad_probe_args(idx: np.ndarray, shift: np.ndarray,
+                   bp: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pad per-block (idx, shift) to the padded block count."""
+    b = idx.shape[0]
+    if bp == b:
+        return idx, shift
+    out_i = np.zeros((bp, idx.shape[1]), dtype=np.int32)
+    out_s = np.zeros((bp, idx.shape[1]), dtype=np.int32)
+    out_i[:b] = idx
+    out_s[:b] = shift
+    return out_i, out_s

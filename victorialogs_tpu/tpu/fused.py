@@ -27,7 +27,12 @@ Key design points:
 - The host-side planner simplifies the tree first: bloom kill-paths
   and block-uniform leaves (stream filters after candidate pruning)
   fold to constants, so `{app="x"} "y" | stats count()` compiles to a
-  single scan + reduction.
+  single scan + reduction.  Bloom planning probes the part's packed
+  bloom plane in one batch (storage/filterbank.py); when only SOME
+  candidate blocks die, the plane is staged to HBM and the keep-mask
+  is re-probed INSIDE the dispatch (tpu/bloom_device.py), gathered to
+  rows through a staged block-id column and ANDed with the scan tree —
+  the bloom kill bitmap never crosses the host boundary.
 
 Reference parity: this is the TPU-shaped fusion of the reference's
 per-worker stats shards merged at flush (pipe_stats.go:354-377) with
@@ -39,6 +44,7 @@ them bit-exactly over randomized query matrices).
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -46,12 +52,13 @@ import jax
 import numpy as np
 
 from ..logsql import filters as F
-from ..storage.bloom import bloom_contains_all
+from ..storage.filterbank import bloom_keep_mask, filter_bank
 from ..storage.values_encoder import VT_DICT, VT_STRING
-from ..utils.hashing import hash_tokens
+from ..utils.hashing import cached_token_hashes
 from . import kernels as K
 from . import kernels32 as K32
 from .batch import device_plan, StatsLayout
+from .bloom_device import MAX_PALLAS_PROBES, pad_probe_args, plane_keep
 from .layout import (row_width_bucket, rows_with_multibyte, to_fixed_width,
                      to_lanes32)
 
@@ -426,17 +433,23 @@ class _Planner:
         # And when bloom + candidate pruning leave only a small row
         # fraction, the host path over those few blocks beats staging +
         # whole-part scanning (same narrowness gate as _eval_leaf).
+        # The probe is the packed-plane batch probe (filterbank); when
+        # only SOME blocks die, the same plane is staged to HBM and the
+        # kill bitmap ANDs into the tree inside the dispatch
+        # (_bloom_node) — the device result needs no host mask.
         surv_rows = 0
+        bloom_node = None
         if plan.bloom_tokens:
-            hashes = hash_tokens(plan.bloom_tokens)
-            for bi in self.bss:
-                words = self.part.block_column_bloom(bi, plan.field)
-                if words is not None and words.shape[0] and \
-                        not bloom_contains_all(words, hashes):
-                    continue
-                surv_rows += self.part.block_rows(bi)
+            hashes = cached_token_hashes(plan.filter, plan.bloom_tokens)
+            bis = list(self.bss)
+            keep = bloom_keep_mask(self.part, plan.field, hashes, bis)
+            for i, bi in enumerate(bis):
+                if keep[i]:
+                    surv_rows += self.part.block_rows(bi)
             if surv_rows == 0:
                 return ("false",)
+            if not keep.all():
+                bloom_node = self._bloom_node(plan.field, hashes)
         else:
             surv_rows = sum(self.part.block_rows(bi) for bi in self.bss)
         if surv_rows * 8 < self.part.num_rows and \
@@ -448,11 +461,12 @@ class _Planner:
         if plan.pair is not None:
             a, b = plan.pair
             if max(len(a), len(b)) >= ff.width:
-                return self._ovf_only(oi)
+                return self._with_bloom(bloom_node, self._ovf_only(oi))
             self.has_maybe = True
             pa = self.arg(np.frombuffer(a, dtype=np.uint8))
             pb = self.arg(np.frombuffer(b, dtype=np.uint8))
-            return ("pair", ri, li, oi, pa, len(a), pb, len(b))
+            return self._with_bloom(
+                bloom_node, ("pair", ri, li, oi, pa, len(a), pb, len(b)))
         # case-fold leaves: non-ASCII rows diverge from the byte fold in
         # either direction, so they ride the maybe channel (host residue
         # settles them with the filter's own predicate)
@@ -479,7 +493,42 @@ class _Planner:
                              mb_mi if op.fold else -1, pi,
                              len(op.pattern), op.mode, op.starts_tok,
                              op.ends_tok, op.fold))
-        return self._combine(plan.combine, kids)
+        return self._with_bloom(bloom_node,
+                                self._combine(plan.combine, kids))
+
+    @staticmethod
+    def _with_bloom(bloom_node, res):
+        if bloom_node is None:
+            return res
+        return _Planner._combine("and", [bloom_node, res])
+
+    def _bloom_node(self, field: str, hashes):
+        """Emit the in-dispatch bloom kill: the packed plane rides HBM
+        (staged once per part+column), the per-block keep-mask is
+        probed INSIDE the fused jit from host-computed positions, and
+        gathers to rows through the staged block-id column — so the
+        bloom kill bitmap ANDs against the scan tree without any host
+        round-trip.  None (leaf keeps host-planning semantics only)
+        when staging declines or VL_DEVICE_BLOOM=0."""
+        if os.environ.get("VL_DEVICE_BLOOM", "1") == "0":
+            return None
+        sp = self.runner._stage_bloom_plane(self.part, field)
+        if sp is None:
+            return None
+        plb = filter_bank(self.part).plane(self.part, field)
+        if plb is None:
+            return None
+        idx, shift = plb.block_probe_args(hashes)
+        idx, shift = pad_probe_args(idx, shift, sp.bp)
+        # the Pallas probe replaces the gather with a VMEM lane-select;
+        # gated like kernels_pallas.match_scan, never on by default
+        use_pallas = (os.environ.get("VL_PALLAS") == "1"
+                      and idx.shape[1] <= MAX_PALLAS_PROBES)
+        bid = self.runner._stage_block_ids(self.part, self.layout)
+        self.runner._kind("bloom_device")
+        return ("bloom", self.arg(sp.plane), self.arg(sp.nwords),
+                self.arg(idx), self.arg(shift),
+                self.arg(bid.ids, row=True), use_pallas)
 
     def _numrange_leaf(self, f: F.FilterRange):
         """`status:>=500`-family on int-typed columns: the uint32 offset
@@ -603,6 +652,13 @@ def _eval_node(node, args, rlp):
     if kind == "ovfmaybe":
         ov = _unpack_bits(args[node[1]], rlp)
         return jnp.zeros(rlp, dtype=bool), ov
+    if kind == "bloom":
+        # per-block keep-mask probed from the HBM-resident bloom plane,
+        # gathered to rows via the block-id column (tpu/bloom_device.py)
+        _, pi, nwi, ii, si, bidi, use_pallas = node
+        keep = plane_keep(args[pi], args[ii], args[si], args[nwi],
+                          use_pallas=use_pallas)
+        return keep[args[bidi]], None
     if kind == "lenrange":
         _, li, oi, mi, a, b, b4 = node
         lens = args[li]
@@ -774,7 +830,7 @@ def _fused_dispatch_mesh(mesh, axis, prog, strides, nb, n_values, nrows,
         return _fused_local(prog, strides, nb, n_values, axis, nrows,
                             cp, ids, vals, leaf_args)
 
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+    return K.shard_map_fn()(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=(P(), P(axis)))(
         nrows, cand_packed, ids_tuple, values_tuple, args)
 

@@ -249,7 +249,18 @@ def _vary(x, axes):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)  # older jax
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x  # pre-0.5 jax: carry types already agree without the cast
+
+
+def shard_map_fn():
+    """`jax.shard_map` graduated from jax.experimental between releases;
+    resolve whichever this jax provides (same call signature)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # pragma: no cover - depends on installed jax
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
 
 
 def stats_count_local(bucket_ids: jnp.ndarray, mask: jnp.ndarray,

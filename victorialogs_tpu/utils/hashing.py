@@ -60,6 +60,27 @@ def hash_tokens(tokens: list[bytes] | list[str]) -> np.ndarray:
     return out
 
 
+def cached_token_hashes(owner, tokens) -> np.ndarray:
+    """hash_tokens memoized on the owning filter object.
+
+    The same filter leaf probes the same tokens against every part of
+    every partition a query touches; hashing them once per query (not
+    once per part) keeps the kill-path cost independent of part count.
+    Keyed on the token tuple so filters whose values mutate between
+    runs (in()/contains_all set_values) never serve stale hashes.
+    """
+    key = tuple(tokens)
+    got = getattr(owner, "_token_hash_cache", None)
+    if got is not None and got[0] == key:
+        return got[1]
+    h = hash_tokens(key)
+    try:
+        owner._token_hash_cache = (key, h)
+    except AttributeError:  # slotted/foreign owner: just skip the memo
+        pass
+    return h
+
+
 def stream_id_hash(canonical_tags: bytes) -> tuple[int, int]:
     """128-bit stream hash -> (hi, lo) uint64 pair."""
     h = _xxhash.xxh128_intdigest(canonical_tags)
