@@ -5,7 +5,7 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test lint bench bench-bloom clean
+.PHONY: all native test lint bench bench-bloom bench-pipeline clean
 
 all: native
 
@@ -30,6 +30,11 @@ bench:
 # blocks (filter-index subsystem; fails under 5x — see PERF.md)
 bench-bloom:
 	python tools/bench_bloom.py
+
+# many-small-parts async pipeline: serial vs windowed vs packed on the
+# jax-CPU backend (fails under 4x dispatch cut / 1.5x wall — PERF.md)
+bench-pipeline:
+	python tools/bench_pipeline.py --json BENCH_pipeline.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

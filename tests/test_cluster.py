@@ -21,6 +21,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_port():
+    """A port nothing is listening on RIGHT NOW — only safe for
+    simulating a DEAD endpoint.  Servers must never be started on a
+    pre-picked port (two processes can draw the same one — the
+    historical flake in the tpu-storage-nodes test); use _start_bound,
+    which binds to port 0 and reports the OS-assigned port."""
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -48,6 +53,47 @@ def _start(args):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, cwd=REPO)
 
 
+def _read_banner(proc, timeout=60):
+    """Scan the child's merged stdout for the startup banner
+    ("started victoria-logs server at http://127.0.0.1:PORT/") with a
+    wall-clock bound, skipping pre-banner noise (jax/absl warnings land
+    on the same merged pipe under -tpu).  Returns the port, or None on
+    EOF / timeout / unparseable banner.  The reader thread is daemonized
+    so a child hung before printing can never block the suite."""
+    import threading
+    got = {}
+
+    def rd():
+        for raw in proc.stdout:
+            line = raw.decode("utf-8", "replace").strip()
+            if "started victoria-logs server at" in line:
+                try:
+                    got["port"] = int(line.rstrip("/").rsplit(":", 1)[1])
+                except (IndexError, ValueError):
+                    pass
+                return
+
+    t = threading.Thread(target=rd, daemon=True)
+    t.start()
+    t.join(timeout)
+    return got.get("port")
+
+
+def _start_bound(args, retries=3):
+    """Start a server on an OS-assigned port (-httpListenAddr :0) and
+    return (proc, port) parsed from the startup banner.  Retries when
+    startup dies early (e.g. EADDRINUSE from an auxiliary listener) —
+    binding to port 0 removes the pick-then-bind race entirely."""
+    for _ in range(retries):
+        proc = _start(["-httpListenAddr", "127.0.0.1:0"] + args)
+        port = _read_banner(proc)
+        if port is not None and _wait_http(port):
+            return proc, port
+        proc.terminate()
+        proc.wait(10)
+    raise RuntimeError("server did not start (no startup banner)")
+
+
 @pytest.fixture(scope="module")
 def cluster():
     procs = []
@@ -55,20 +101,15 @@ def cluster():
     try:
         storage_ports = []
         for k in range(2):
-            port = _free_port()
-            procs.append(_start(
-                ["-storageDataPath", f"{tmp}/node{k}",
-                 "-httpListenAddr", f"127.0.0.1:{port}"]))
+            proc, port = _start_bound(
+                ["-storageDataPath", f"{tmp}/node{k}"])
+            procs.append(proc)
             storage_ports.append(port)
-        front_port = _free_port()
-        front = _start(
-            ["-storageDataPath", f"{tmp}/front",
-             "-httpListenAddr", f"127.0.0.1:{front_port}"]
+        front, front_port = _start_bound(
+            ["-storageDataPath", f"{tmp}/front"]
             + sum((["-storageNode", f"http://127.0.0.1:{p}"]
                    for p in storage_ports), []))
         procs.append(front)
-        for p in storage_ports + [front_port]:
-            assert _wait_http(p), "server did not start"
         yield {"front": front_port, "nodes": storage_ports}
     finally:
         for p in procs:
@@ -189,16 +230,11 @@ def test_cluster_node_down_fails_query(ingested):
     dead = _free_port()
     import tempfile as tf
     tmp2 = tf.mkdtemp(prefix="vlfront2")
-    front2 = _start(["-storageDataPath", tmp2,
-                     "-httpListenAddr", "127.0.0.1:0",
-                     "-storageNode",
-                     f"http://127.0.0.1:{ingested['nodes'][0]}",
-                     "-storageNode", f"http://127.0.0.1:{dead}"])
+    front2, port = _start_bound(
+        ["-storageDataPath", tmp2,
+         "-storageNode", f"http://127.0.0.1:{ingested['nodes'][0]}",
+         "-storageNode", f"http://127.0.0.1:{dead}"])
     try:
-        # discover the bound port from startup output
-        line = front2.stdout.readline().decode()
-        port = int(line.rsplit(":", 1)[1].strip().rstrip("/"))
-        assert _wait_http(port)
         u = (f"http://127.0.0.1:{port}/select/logsql/query?"
              + urllib.parse.urlencode({"query": "* | stats count() n"}))
         try:
@@ -242,11 +278,8 @@ def test_cluster_matches_single_node(ingested, tmp_path_factory):
     import subprocess
 
     tmp = tempfile.mkdtemp(prefix="vlsingle")
-    port = _free_port()
-    single = _start(["-storageDataPath", tmp,
-                     "-httpListenAddr", f"127.0.0.1:{port}"])
+    single, port = _start_bound(["-storageDataPath", tmp])
     try:
-        assert _wait_http(port)
         rows = []
         for i in range(N_ROWS):
             rows.append({
@@ -294,21 +327,17 @@ def test_cluster_with_tpu_storage_nodes(tmp_path):
     try:
         ports = []
         for k in range(2):
-            port = _free_port()
-            procs.append(_start(
+            proc, port = _start_bound(
                 ["-storageDataPath", f"{tmp}/tnode{k}",
-                 "-httpListenAddr", f"127.0.0.1:{port}",
-                 "-retentionPeriod", "100y", "-tpu"]))
+                 "-retentionPeriod", "100y", "-tpu"])
+            procs.append(proc)
             ports.append(port)
-        front_port = _free_port()
-        procs.append(_start(
+        front, front_port = _start_bound(
             ["-storageDataPath", f"{tmp}/tfront",
-             "-httpListenAddr", f"127.0.0.1:{front_port}",
              "-retentionPeriod", "100y"]
             + sum((["-storageNode", f"http://127.0.0.1:{p}"]
-                   for p in ports), [])))
-        for p in ports + [front_port]:
-            assert _wait_http(p), "server did not start"
+                   for p in ports), []))
+        procs.append(front)
 
         rows = []
         for i in range(4000):

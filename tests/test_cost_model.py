@@ -201,7 +201,10 @@ def test_unforced_gate_routes_to_device_when_cheap(storage, monkeypatch):
 
 def test_first_scan_timing_is_discarded(storage, monkeypatch):
     # ADVICE r4: the first call of a jit signature includes compilation;
-    # it must NOT seed dev_bytes_per_s
+    # it must NOT seed dev_bytes_per_s.  The EWMA is fed by the per-leaf
+    # scan path — pin it on (row queries default to the fused filter
+    # dispatch since the async pipeline round, which never calls _scan)
+    monkeypatch.setenv("VL_FUSED_FILTER", "0")
     monkeypatch.setenv("VL_COST_FORCE", "")
     monkeypatch.setenv("VL_COST_RTT_MS", "0")
     monkeypatch.setenv("VL_COST_HOST_MROWS", "0.001")  # route to device

@@ -253,6 +253,58 @@ def test_fused_topk_declines_cleanly(storage):
         assert _norm(cpu) == _norm(dev), qs
 
 
+@pytest.fixture(scope="module")
+def multipart_storage(tmp_path_factory):
+    """The FUSED_QUERIES corpus spread over several small parts, so the
+    async pipeline's window and small-part packing engage."""
+    path = str(tmp_path_factory.mktemp("fusedmp"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    words = ["deadline exceeded", "connection reset", "ok", "retry later",
+             "cache miss", "flushed"]
+    n = 0
+    for _pp in range(6):
+        lr = LogRows(stream_fields=["app"])
+        for _i in range(1500):
+            i = n
+            n += 1
+            msg = f"GET /api/x{i % 71} {words[i % 6]} dur={i % 351}ms"
+            if i % 37 == 0:
+                msg = f"GÉT /äpi/x{i % 71} {words[i % 6]} ⏱={i % 351}"
+            if i % 97 == 0:
+                msg = f"GET /api\nlate {words[i % 6]} tail"
+            lr.add(TEN, T0 + i * 200_000_000, [
+                ("app", f"app{i % 4}"),
+                ("_msg", msg),
+                ("lvl", ["info", "warn", "error"][i % 3]),
+                ("dur", str(i % 351)),
+            ])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    yield s
+    s.close()
+
+
+@pytest.mark.parametrize("inflight,pack",
+                         [("1", "1"), ("4", "1"), ("1", "8"), ("4", "8")])
+def test_fused_parity_windowed_and_packed(multipart_storage, monkeypatch,
+                                          inflight, pack):
+    """The fused parity matrix re-run through the async pipeline over
+    MANY small parts, at every window/packing config (tpu/pipeline.py):
+    window depth and super-dispatch packing must be invisible in the
+    results — residue rows, dict axes and value stats included."""
+    monkeypatch.setenv("VL_INFLIGHT", inflight)
+    monkeypatch.setenv("VL_PACK_PARTS", pack)
+    runner = BatchRunner()
+    for qs in FUSED_QUERIES[::3]:   # every 3rd query: runtime-bounded
+        cpu = run_query_collect(multipart_storage, [TEN], qs,
+                                timestamp=T0)
+        dev = run_query_collect(multipart_storage, [TEN], qs,
+                                timestamp=T0, runner=runner)
+        assert _norm(cpu) == _norm(dev), (qs, inflight, pack)
+    if pack != "1":
+        assert runner.packed_dispatches > 0
+
+
 def test_fused_truncation_overflow(tmp_path):
     """Values beyond MAX_ROW_WIDTH are truncated in staging; phrases
     hitting the truncated tail must be settled by the residue pass."""

@@ -443,6 +443,36 @@ def test_sanitizer_condition_wait_order():
 
 # ---------------- the tier-1 gate + CLI ----------------
 
+def test_hotpath_covers_pipeline_module():
+    """The async pipeline (tpu/pipeline.py) is hot-path scoped: the
+    checker must SEE the file (an unannotated sync there is flagged),
+    the real module must run clean, and the single deliberate harvest
+    sync must carry the allow-annotation with its rationale."""
+    from tools.vlint import hotpath
+    from tools.vlint.core import SourceFile
+
+    # the file is in scope: a synthetic host sync at the same path flags
+    out = lint("""
+        import jax.numpy as jnp
+        def harvest(window):
+            x = jnp.zeros(8)
+            return float(x)
+    """, path="victorialogs_tpu/tpu/pipeline.py")
+    assert "jax-host-sync" in checkers(out)
+
+    # the real module runs clean under the full checker set
+    path = os.path.join(REPO, "victorialogs_tpu", "tpu", "pipeline.py")
+    sf = SourceFile.parse(path,
+                          display_path="victorialogs_tpu/tpu/pipeline.py")
+    found = [f for f in hotpath.check(sf)
+             if not sf.allowed(f.checker, f.line)]
+    assert found == [], [f.render() for f in found]
+
+    # the ONE harvest sync point is annotated with a rationale
+    assert "vlint: allow-jax-host-sync(" in sf.text
+    assert sf.text.count("np.asarray") == 1   # a single sync site
+
+
 def test_repo_is_clean_against_baseline():
     findings = run_paths([os.path.join(REPO, "victorialogs_tpu")],
                          root=REPO)

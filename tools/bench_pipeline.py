@@ -1,0 +1,197 @@
+"""Many-small-parts pipeline benchmark: serial vs windowed vs packed.
+
+The async pipeline (tpu/pipeline.py) exists for exactly this shape: an
+LSM partition full of small fresh parts, where the serial device walk
+pays one dispatch round trip per part.  This bench builds N_PARTS
+equal-sized parts, runs the same queries end-to-end through run_query
+in three configs —
+
+  serial    VL_INFLIGHT=1  VL_PACK_PARTS=1   (the round-3 walk)
+  windowed  VL_INFLIGHT=4  VL_PACK_PARTS=1   (in-flight dispatch window)
+  packed    VL_INFLIGHT=4  VL_PACK_PARTS=8   (window + super-dispatches)
+
+— and reports wall clock (p50 of R runs, warm staging) plus device
+dispatches per query.  Hit sets must be bit-identical across configs
+and vs the CPU executor; with packing on, dispatches/query must drop
+>=4x on the stats shape (the acceptance bar; dispatch-count model:
+P parts -> ceil(P / VL_PACK_PARTS) fused dispatches).
+
+Run: make bench-pipeline   (defaults: 32 parts x 2048 rows, 5 runs)
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("VL_COST_FORCE", "device")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+try:
+    # neutralize the axon TPU plugin exactly like tests/conftest.py: the
+    # bench must run on the local jax-CPU backend, never the tunnel
+    from jax._src import xla_bridge as _xb
+    for _k in [k for k in list(_xb._backend_factories) if k != "cpu"]:
+        _xb._backend_factories.pop(_k, None)
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - plain environments need no surgery
+    pass
+
+QUERIES = [
+    ("stats", "err | stats by (app) count() c, sum(dur) s"),
+    ("rows", "err warn | fields _time"),
+]
+
+CONFIGS = [
+    ("serial", "1", "1"),
+    ("windowed", "4", "1"),
+    ("windowed+packed", "4", "8"),
+]
+
+
+def build_storage(path, n_parts, rows_per_part):
+    from victorialogs_tpu.storage import datadb
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    # the bench IS the many-small-parts shape: keep the background
+    # merger from folding the parts together mid-measurement
+    datadb.DEFAULT_PARTS_TO_MERGE = 10 ** 9
+    t0 = 1_753_660_800_000_000_000
+    ten = TenantID(0, 0)
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    n = 0
+    for _pp in range(n_parts):
+        lr = LogRows(stream_fields=["app"])
+        for _i in range(rows_per_part):
+            g = n
+            n += 1
+            lvl = ["info", "warn", "err"][g % 3]
+            lr.add(ten, t0 + g * 1_000_000, [
+                ("app", f"app{g % 5}"),
+                ("_msg", f"m {lvl} request x{g % 97} of {g}"),
+                ("dur", str(g % 211)),
+            ])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    parts = [p for pt in s.partitions.values()
+             for p in pt.ddb.snapshot_parts() if p.num_rows]
+    assert len(parts) == n_parts, f"expected {n_parts} parts, got " \
+                                  f"{len(parts)} (merge interfered?)"
+    return s, ten, t0
+
+
+def run_config(storage, ten, t0, inflight, pack, runs):
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    os.environ["VL_INFLIGHT"] = inflight
+    os.environ["VL_PACK_PARTS"] = pack
+    runner = BatchRunner()
+    out = {}
+    for name, qs in QUERIES:
+        # warmup: XLA compiles + cold staging (parts are immutable, so
+        # staging is reused across queries — steady-state is warm)
+        rows = run_query_collect(storage, [ten], qs, timestamp=t0,
+                                 runner=runner)
+        d0 = runner.device_calls
+        times = []
+        for _r in range(runs):
+            t0s = time.perf_counter()
+            rows = run_query_collect(storage, [ten], qs, timestamp=t0,
+                                     runner=runner)
+            times.append(time.perf_counter() - t0s)
+        out[name] = {
+            "p50_ms": statistics.median(times) * 1e3,
+            "dispatches_per_query":
+                (runner.device_calls - d0) / runs,
+            "rows": sorted(map(str, rows)),
+        }
+    out["counters"] = {k: v for k, v in runner.stats().items()
+                       if not k.startswith("staging_")}
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parts", type=int, default=32)
+    ap.add_argument("--rows", type=int, default=2048)
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--json", default="")
+    ap.add_argument("--no-assert", action="store_true")
+    args = ap.parse_args()
+
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    with tempfile.TemporaryDirectory(prefix="vlbenchpipe") as tmp:
+        print(f"building {args.parts} parts x {args.rows} rows ...",
+              flush=True)
+        storage, ten, t0 = build_storage(tmp, args.parts, args.rows)
+        cpu = {name: sorted(map(str, run_query_collect(
+            storage, [ten], qs, timestamp=t0)))
+            for name, qs in QUERIES}
+        results = {}
+        for label, inflight, pack in CONFIGS:
+            print(f"config {label} (VL_INFLIGHT={inflight} "
+                  f"VL_PACK_PARTS={pack}) ...", flush=True)
+            results[label] = run_config(storage, ten, t0, inflight,
+                                        pack, args.runs)
+        storage.close()
+
+    print(f"\npipeline bench — {args.parts} parts x {args.rows} rows, "
+          f"p50 of {args.runs} (jax-CPU backend)")
+    print(f"{'config':>16} {'query':>6} {'p50 ms':>9} {'disp/query':>11}")
+    for label, _i, _p in CONFIGS:
+        for name, _qs in QUERIES:
+            r = results[label][name]
+            print(f"{label:>16} {name:>6} {r['p50_ms']:>9.1f} "
+                  f"{r['dispatches_per_query']:>11.1f}")
+
+    # hit sets must be bit-identical everywhere
+    for label, _i, _p in CONFIGS:
+        for name, _qs in QUERIES:
+            assert results[label][name]["rows"] == cpu[name], \
+                f"{label}/{name} diverged from the CPU executor"
+    print("hit sets: bit-identical across serial/windowed/packed "
+          "and vs CPU")
+
+    serial = results["serial"]
+    packed = results["windowed+packed"]
+    disp_ratio = serial["stats"]["dispatches_per_query"] / \
+        max(packed["stats"]["dispatches_per_query"], 1e-9)
+    wall_ratio = min(
+        serial[n]["p50_ms"] / max(packed[n]["p50_ms"], 1e-9)
+        for n, _q in QUERIES)
+    print(f"dispatch reduction (stats, packed vs serial): "
+          f"{disp_ratio:.1f}x")
+    for name, _qs in QUERIES:
+        print(f"wall clock {name}: serial/packed = "
+              f"{results['serial'][name]['p50_ms'] / max(packed[name]['p50_ms'], 1e-9):.2f}x")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"parts": args.parts, "rows": args.rows,
+                       "cpu": {k: len(v) for k, v in cpu.items()},
+                       "results": {k: {n: {kk: vv for kk, vv in r.items()
+                                           if kk != "rows"}
+                                       for n, r in v.items()}
+                                   for k, v in results.items()}},
+                      f, indent=1)
+        print(f"wrote {args.json}")
+
+    if not args.no_assert:
+        assert disp_ratio >= 4.0, \
+            f"packing must cut dispatches >=4x, got {disp_ratio:.1f}x"
+        assert wall_ratio >= 1.5, \
+            f"windowed+packed must beat serial >=1.5x, got " \
+            f"{wall_ratio:.2f}x"
+        print("acceptance: >=4x fewer dispatches, >=1.5x wall clock OK")
+
+
+if __name__ == "__main__":
+    main()

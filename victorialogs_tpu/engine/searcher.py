@@ -335,14 +335,23 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             out.append(bi)
         return out
 
-    next_bis: list | None = None
-    for pi, part in enumerate(parts):
+    if batch:
+        # async device pipeline: dispatches for up to VL_INFLIGHT units
+        # stay outstanding, small parts pack into super-dispatches, and
+        # results harvest in submission order — block order and stats
+        # absorb granularity are identical to this serial walk
+        # (tpu/pipeline.py)
+        from ..tpu.pipeline import scan_parts_device
+        scan_parts_device(parts, q, head, runner, cand_block_idxs, ctx,
+                          needed, deadline, stats_spec, sort_spec,
+                          token_leaves)
+        return
+
+    for part in parts:
         if deadline is not None and time.monotonic() > deadline:
             raise QueryTimeoutError(
                 "query exceeded -search.maxQueryDuration")
-        part_bis = next_bis if next_bis is not None \
-            else cand_block_idxs(part)
-        next_bis = None
+        part_bis = cand_block_idxs(part)
         if token_leaves and part_bis:
             # part-level aggregate kill (filter-index subsystem): an
             # AND-path leaf's required token absent from EVERY block
@@ -354,26 +363,14 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             if part_aggregate_prunes(
                     part, token_leaves,
                     build=len(part_bis) * 4 >= part.num_blocks):
-                if batch and hasattr(runner, "_bump"):
-                    runner._bump("agg_pruned_parts")
                 continue
-        if batch and pi + 1 < len(parts):
-            # double-buffer: stage part N+1 (host decode + upload) while
-            # the device scans part N (SURVEY §7 hard-part 3); the
-            # prefetcher applies the evaluator's own bloom/narrowness
-            # gates over the same candidate set (carried forward so the
-            # header walk isn't repeated when the part is scanned)
-            nxt = parts[pi + 1]
-            next_bis = cand_block_idxs(nxt)
-            runner.submit_prefetch(nxt, q.filter, stats_spec,
-                                   cand_bis=next_bis)
         cand: dict[int, BlockSearch] = {}
         for bi in part_bis:
             if head.is_done():
                 raise QueryCancelled()
             bs = BlockSearch(part, bi)
             bs.ctx = ctx
-            if batch or pool is not None:
+            if pool is not None:
                 cand[bi] = bs
                 continue
             if runner is not None:
@@ -389,30 +386,12 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             continue
         if head.is_done():
             raise QueryCancelled()
-        if batch:
-            # batched device path: one dispatch per filter leaf over
-            # the whole part (tpu/batch.py)
-            if stats_spec is not None:
-                bms, handled, partials = runner.run_part_stats(
-                    q.filter, part, cand, stats_spec)
-                if partials:
-                    _absorb_stats_partials(head, q, stats_spec, partials)
-                for bi in handled:
-                    del cand[bi]
-            else:
-                bms = None
-                if sort_spec is not None:
-                    bms = runner.run_part_topk(q.filter, part, cand,
-                                               sort_spec)
-                if bms is None:
-                    bms = runner.run_part(q.filter, part, cand)
-        else:
-            # CPU worker pool: filters evaluate in parallel, results
-            # are written downstream in deterministic block order
-            order = list(cand)
-            results = pool.map(lambda bi: _eval_block_cpu(q, cand[bi]),
-                               order)
-            bms = dict(zip(order, results))
+        # CPU worker pool: filters evaluate in parallel, results
+        # are written downstream in deterministic block order
+        order = list(cand)
+        results = pool.map(lambda bi: _eval_block_cpu(q, cand[bi]),
+                           order)
+        bms = dict(zip(order, results))
         for bi, bs in cand.items():
             if head.is_done():
                 raise QueryCancelled()

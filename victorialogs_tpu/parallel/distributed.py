@@ -223,6 +223,19 @@ class MeshBatchRunner(BatchRunner):
                                     nb, n_values, nrows, cand_packed,
                                     ids_tuple, values_tuple, args)
 
+    def _dispatch_filter(self, prog, nrows, cand_packed, args):
+        # row-query fused filter under shard_map: each device evaluates
+        # its row stripe, packed (definite, maybe) bits concatenate over
+        # the row axis.  Layouts are padded to STATS_CHUNK * ndev rows
+        # (stats_shards), so stripes are whole and byte-aligned — this
+        # holds for packed super-parts too (their layout rides the same
+        # padding).  The async window (tpu/pipeline.py) drives this
+        # exactly like the single-chip runner: submission issues the
+        # collective dispatch, harvest materializes in order.
+        from ..tpu.fused import _filter_dispatch_mesh
+        return _filter_dispatch_mesh(self.mesh, BLOCK_AXIS, prog, nrows,
+                                     cand_packed, args)
+
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
         return np.array(_stats_count_mesh(self.mesh, ids_tuple, strides,
                                           mask, nb))

@@ -44,11 +44,17 @@ class Metrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + delta
 
-    def render(self, storage: Storage) -> str:
+    def render(self, storage: Storage, runner=None) -> str:
         out = []
         with self._lock:
             for name in sorted(self.counters):
                 out.append(f"{name} {self.counters[name]}")
+        if runner is not None and hasattr(runner, "stats"):
+            # device-runner counters incl. the async pipeline's
+            # (dispatches issued, packed parts, in-flight high-water
+            # mark, host-sync wait — tpu/batch.py BatchRunner.stats)
+            for name, v in sorted(runner.stats().items()):
+                out.append(f"vl_tpu_{name} {v}")
         s = storage.update_stats()
         gauges = {
             "vl_partitions": s["partitions"],
@@ -288,7 +294,8 @@ class VLServer(BaseHTTPApp):
             return
         if path == "/metrics":
             self.respond(h, 200, "text/plain",
-                         m.render(self.storage).encode())
+                         m.render(self.storage,
+                                  runner=self.runner).encode())
             return
         if path == "/":
             self.respond_json(h, {

@@ -28,6 +28,7 @@ tests/test_tpu_runner.py and tests/test_batch_runner.py diff them bit-exactly):
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field as dc_field
 
 import os
@@ -200,6 +201,18 @@ def device_plans(f) -> list:
                 out.append(plan)
     walk(f)
     return out
+
+
+def _tree_has_time(f) -> bool:
+    """Does the tree hold a FilterTime leaf (fused prefetch must stage
+    the timestamp planes the planner's _time_leaf will ask for)?"""
+    if isinstance(f, F.FilterTime):
+        return True
+    if isinstance(f, (F.FilterAnd, F.FilterOr)):
+        return any(_tree_has_time(s) for s in f.filters)
+    if isinstance(f, F.FilterNot):
+        return _tree_has_time(f.inner)
+    return False
 
 
 def _contains_plan(f, require_all: bool) -> LeafPlan | None:
@@ -837,14 +850,21 @@ class BatchRunner:
         self.max_part_bytes = max_part_bytes
         self.cost = CostModel()
         self._scan_sigs: set = set()   # jit signatures already compiled
-        self.device_calls = 0
+        self.device_calls = 0          # every dispatch issued to the device
         self.cpu_fallbacks = 0
         self.gated_host_parts = 0
         self.stats_dispatches = 0
         self.fused_dispatches = 0
+        self.filter_dispatches = 0     # fused filter-only row dispatches
         self.topk_dispatches = 0
         self.bloom_plane_probes = 0
         self.agg_pruned_parts = 0
+        # async pipeline observability (tpu/pipeline.py)
+        self.pipeline_units = 0        # units driven through the window
+        self.packed_dispatches = 0     # super-dispatches over packed parts
+        self.packed_parts = 0         # parts folded into super-dispatches
+        self.inflight_hwm = 0          # in-flight window high-water mark
+        self.host_sync_wait_s = 0.0    # time blocked materializing results
         self.stats_shards = 1          # mesh runners stripe rows over >1
         # distinct dispatch shapes this runner has sent to the device —
         # the multichip dryrun asserts breadth here (verdict r4 weak #6)
@@ -856,15 +876,50 @@ class BatchRunner:
         # of duplicating a multi-100MB upload.  A fixed stripe pool keeps
         # lock memory bounded across part churn (merges mint fresh uids).
         self._stage_locks = [threading.Lock() for _ in range(64)]
+        # PackedPart instances (tpu/pipeline.py): a SMALL dedicated LRU,
+        # not the byte-budgeted StagingCache — a pack strongly references
+        # its member parts (incl. in-RAM InmemoryPart blocks), so its
+        # true cost is member lifetime, not device bytes; the hard entry
+        # cap bounds how long retired members can stay pinned.
+        self._pack_mu = threading.Lock()
+        self._packs: OrderedDict = OrderedDict()
         self._prefetch_pool = None  # lazy; see _prefetcher()
 
-    def _bump(self, attr: str, n: int = 1) -> None:
+    def _bump(self, attr: str, n=1) -> None:
         with self._counter_mu:
             setattr(self, attr, getattr(self, attr) + n)
+
+    def _bump_max(self, attr: str, v) -> None:
+        with self._counter_mu:
+            if v > getattr(self, attr):
+                setattr(self, attr, v)
 
     def _kind(self, label: str) -> None:
         with self._counter_mu:
             self.dispatch_kinds.add(label)
+
+    def stats(self) -> dict:
+        """Counter snapshot (served under /metrics as vl_tpu_*)."""
+        with self._counter_mu:
+            out = {
+                "device_calls": self.device_calls,
+                "cpu_fallbacks": self.cpu_fallbacks,
+                "gated_host_parts": self.gated_host_parts,
+                "stats_dispatches": self.stats_dispatches,
+                "fused_dispatches": self.fused_dispatches,
+                "filter_dispatches": self.filter_dispatches,
+                "topk_dispatches": self.topk_dispatches,
+                "bloom_plane_probes": self.bloom_plane_probes,
+                "agg_pruned_parts": self.agg_pruned_parts,
+                "pipeline_units": self.pipeline_units,
+                "packed_dispatches": self.packed_dispatches,
+                "packed_parts": self.packed_parts,
+                "inflight_hwm": self.inflight_hwm,
+                "host_sync_wait_s": self.host_sync_wait_s,
+            }
+        out.update({f"staging_cache_{k}": v
+                    for k, v in self.cache.stats().items()})
+        return out
 
     def _prefetcher(self):
         """Lazily create the single prefetch worker.  Fully under the
@@ -892,19 +947,26 @@ class BatchRunner:
     def _key_lock(self, key) -> threading.Lock:
         return self._stage_locks[hash(key) % len(self._stage_locks)]
 
-    # ---- prefetch (stage part N+1 while part N scans) ----
+    # ---- prefetch (stage part N+k while parts N..N+k-1 scan) ----
     def submit_prefetch(self, part, f, stats_spec=None,
-                        cand_bis=None) -> None:
+                        cand_bis=None, fused=False) -> None:
         """Queue background staging of what the query will need from
-        `part`, so the host decode/upload of the NEXT part overlaps the
-        device scans of the current one (SURVEY §7 hard-part 3).
+        `part`, so the host decode/upload of UPCOMING parts overlaps the
+        device scans of the current ones (SURVEY §7 hard-part 3).  The
+        async pipeline (tpu/pipeline.py) submits this for every part
+        within its in-flight window, so staging depth follows
+        VL_INFLIGHT instead of the old depth-1 double buffer.
 
-        Applies the SAME gates as _eval_leaf so prefetch never stages a
-        column the evaluator would skip: the bloom kill-path over the
-        candidate blocks, and the narrow-candidate heuristic (a small
-        candidate fraction takes the host path instead of staging).
+        Applies the SAME gates as the evaluator so prefetch never stages
+        a column it would skip: the bloom kill-path over the candidate
+        blocks, and the narrow-candidate heuristic (a small candidate
+        fraction takes the host path instead of staging).
         cand_bis: candidate block idxs (after tenant/stream/time
-        pruning); None means every block is a candidate."""
+        pruning); None means every block is a candidate.
+        fused=True stages for the single-dispatch fused programs
+        (layout-coordinate columns + timestamp planes — what the
+        windowed pipeline dispatches, including packed super-parts)
+        instead of the per-leaf string staging."""
 
         def work():
             try:
@@ -915,6 +977,14 @@ class BatchRunner:
                         f, part, cand_rows,
                         stats_rows=cand_rows if stats_spec else 0):
                     return     # the evaluator will take the host path
+                layout = None
+                if fused:
+                    from .stats_device import MAX_STAT_ROWS
+                    layout = self._stats_layout(part)
+                    if layout.nrows > MAX_STAT_ROWS:
+                        layout = None
+                    elif _tree_has_time(f):
+                        self._stage_ts_planes(part, layout)
                 for plan in device_plans(f):
                     surv = bis
                     if plan.bloom_tokens:
@@ -926,6 +996,15 @@ class BatchRunner:
                     if not surv:
                         continue
                     cand_rows = sum(part.block_rows(bi) for bi in surv)
+                    if layout is not None:
+                        # fused staging key (#fl) mirrors _scan_leaf's
+                        # narrowness gate
+                        if self.cache.contains(
+                                (part.uid, "#fl", plan.field)) or \
+                                cand_rows * 8 >= part.num_rows:
+                            self._stage_fused_field(part, plan.field,
+                                                    layout)
+                        continue
                     if not self.cache.contains((part.uid, plan.field)) \
                             and cand_rows * 8 < part.num_rows:
                         continue  # evaluator will take the host path
@@ -977,6 +1056,10 @@ class BatchRunner:
         from .fused import _topk_dispatch
         return _topk_dispatch(prog, k, desc, nrows, cand_packed, values,
                               args)
+
+    def _dispatch_filter(self, prog, nrows, cand_packed, args):
+        from .fused import _filter_dispatch
+        return _filter_dispatch(prog, nrows, cand_packed, args)
 
     def _dispatch_stats_count(self, ids_tuple, strides, mask, nb):
         # vlint: allow-jax-host-sync(result readback at dispatch boundary)
@@ -1089,6 +1172,11 @@ class BatchRunner:
         if self._gate_host(f, part, bss):
             self._bump("gated_host_parts")
             return self._host_eval_part(f, bss)
+        return self._run_part_device(f, part, bss)
+
+    def _run_part_device(self, f, part, bss: dict) -> dict:
+        """run_part past the host gate (run_part_submit's fused-decline
+        fallback lands here directly — its gate already ran)."""
         trace_dir = os.environ.get("VL_XLA_TRACE_DIR")
         if trace_dir:
             # XLA profiler hook at the block-runner seam (SURVEY §5);
@@ -1284,6 +1372,30 @@ class BatchRunner:
                     self.cache.put(key, got)
             return got
 
+    def _stage_segments(self, part, layout: StatsLayout):
+        """Per-row segment ids for a packed part (block -> member
+        ordinal); None when the part has no segment map (plain parts
+        never see a 'seg' by-key)."""
+        seg_of = getattr(part, "segment_of_block", None)
+        nseg = getattr(part, "num_segments", 0)
+        if seg_of is None or not nseg:
+            return None
+        key = (part.uid, "#seg")
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                ids = np.zeros(layout.nrows_padded, dtype=np.int32)
+                for bi in range(part.num_blocks):
+                    start = layout.starts[bi]
+                    ids[start:start + part.block_rows(bi)] = seg_of(bi)
+                got = StagedDict(
+                    ids=self._put(ids),
+                    values=[str(s) for s in range(nseg)],
+                    eligible=frozenset(range(part.num_blocks)),
+                    nbytes=layout.nrows_padded * 4)
+                self.cache.put(key, got)
+            return got
+
     def _stage_buckets(self, part, layout: StatsLayout, step: int,
                        offset: int, max_buckets: int):
         key = (part.uid, "#tb", step, offset)
@@ -1321,6 +1433,17 @@ class BatchRunner:
         eligibility = [numerics[fld].eligible
                        for fld in spec.value_fields]
         for bk in spec.by:
+            if bk.kind == "seg":
+                # per-part segment axis of a packed super-dispatch: the
+                # PackedPart's block->member map as per-row int32 ids
+                # (tpu/pipeline.py; stats_device.with_segment_axis)
+                sg = self._stage_segments(part, layout)
+                if sg is None:
+                    return None
+                axes.append(("s", sg.ids, len(sg.values), None))
+                # every block belongs to exactly one segment
+                eligibility.append(sg.eligible)
+                continue
             if bk.kind == "time":
                 sb = self._stage_buckets(part, layout, bk.step, bk.offset,
                                          MAX_BUCKETS)
@@ -1417,7 +1540,11 @@ class BatchRunner:
         uniq = {}
         qv = {}
         for (kind, _ids, size, payload), k in zip(asm.axes, ks):
-            if kind == "t":
+            if kind == "s":
+                # packed-part segment: stripped (and used to route the
+                # partial to its member part) by the pipeline harvest
+                out.append(("s", k))
+            elif kind == "t":
                 base, step = payload
                 out.append(("t", base + k * step))
             elif kind == "v":
@@ -1557,17 +1684,45 @@ class BatchRunner:
           count_uniq fields to the cell's value string, and quant_vals
           maps quantile/median fields to the cell's numeric value.
         """
+        return self.run_part_stats_submit(f, part, bss, spec).harvest()
+
+    def run_part_stats_submit(self, f, part, bss: dict, spec):
+        """Async variant of run_part_stats: the fused dispatch (when the
+        shape allows one) is ISSUED now and materialized at harvest(), so
+        the windowed pipeline can keep several parts outstanding.  Host-
+        gated and unfused shapes compute synchronously and come back as
+        ready handles — one protocol either way."""
+        from .fused import _Ready, fused_stats_submit
         cand_rows = sum(bs.nrows for bs in bss.values())
         if self._gate_host(f, part, bss, stats_rows=max(cand_rows, 1)):
             self._bump("gated_host_parts")
-            return self._host_eval_part(f, bss), set(), []
+            return _Ready((self._host_eval_part(f, bss), set(), []))
         asm = self._assemble_axes(part, spec)
         if asm is not None and self.fused_enabled:
-            from .fused import try_fused
-            res = try_fused(self, f, part, bss, spec, asm)
-            if res is not None:
-                return res
+            pending = fused_stats_submit(self, f, part, bss, spec, asm)
+            if pending is not None:
+                return pending
+        return _Ready(self._run_part_stats_unfused(f, part, bss, spec,
+                                                   asm))
 
+    def run_part_submit(self, f, part, bss: dict):
+        """Async variant of run_part for ROW queries: the whole filter
+        tree compiles into ONE fused dispatch (fused.fused_filter_submit)
+        whose packed result is materialized at harvest(); shapes the
+        planner declines fall back to the per-leaf path synchronously."""
+        from .fused import _Ready, fused_filter_submit
+        if self._gate_host(f, part, bss):
+            self._bump("gated_host_parts")
+            return _Ready(self._host_eval_part(f, bss))
+        if self.fused_enabled:
+            pending = fused_filter_submit(self, f, part, bss)
+            if pending is not None:
+                return pending
+        return _Ready(self._run_part_device(f, part, bss))
+
+    def _run_part_stats_unfused(self, f, part, bss: dict, spec, asm):
+        """The two-dispatch fallback: ordinary filter evaluation, then
+        per-bucket partials over the uploaded row mask."""
         bms = self.run_part(f, part, bss)
         if asm is None:
             return bms, set(), []

@@ -443,6 +443,11 @@ class _Planner:
             hashes = cached_token_hashes(plan.filter, plan.bloom_tokens)
             bis = list(self.bss)
             keep = bloom_keep_mask(self.part, plan.field, hashes, bis)
+            if filter_bank(self.part).cached_plane(plan.field) \
+                    is not None:
+                # same evidence counter _eval_leaf keeps on the per-leaf
+                # path: the PLANE served this probe
+                self.runner._bump("bloom_plane_probes")
             for i, bi in enumerate(bis):
                 if keep[i]:
                     surv_rows += self.part.block_rows(bi)
@@ -837,9 +842,14 @@ def _fused_dispatch_mesh(mesh, axis, prog, strides, nb, n_values, nrows,
 
 # ---------------- residue: host settles the maybe rows ----------------
 
-def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
+def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray,
+                      part=None) -> list:
     """Verify maybe rows with the filters' own host path and emit one
-    partial per surviving row, keyed exactly like the device cells."""
+    partial per surviving row, keyed exactly like the device cells.
+
+    part: the dispatched part — only consulted for 'seg' by-keys, whose
+    component is the packed part's member ordinal for the block
+    (PackedPart.segment_of_block)."""
     from ..logsql.matchers import parse_number
     from ..logsql.stats_funcs import format_number
     from .stats_device import SYNTH_EMPTY, SYNTH_LEN
@@ -868,7 +878,9 @@ def _residue_partials(f, bss, spec, layout, maybe_np: np.ndarray) -> list:
             key_parts = []
             uniq = {}
             for bk in spec.by:
-                if bk.kind == "time":
+                if bk.kind == "seg":
+                    key_parts.append(("s", part.segment_of_block(bi)))
+                elif bk.kind == "time":
                     if ts is None:
                         ts = bs.timestamps()
                     t = int(ts[i])
@@ -923,8 +935,72 @@ def _stage_cand_mask(runner, part, bss, layout):
     return cm.packed, True
 
 
-def try_fused(runner, f, part, bss, spec, asm):
-    """Attempt the single-dispatch path; None -> caller falls back.
+class _Ready:
+    """A pending-result shim for values already materialized (constant
+    trees, host-gated parts): harvest() is a no-op handoff, so callers
+    drive one protocol whether or not a dispatch is in flight."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def harvest(self, sync=None):
+        return self._value
+
+
+class _StatsPending:
+    """An in-flight fused filter|stats dispatch.
+
+    Holds the asynchronous jax result arrays; nothing blocks until
+    harvest(), so a caller can keep several parts' dispatches
+    outstanding (tpu/pipeline.py) and materialize them in submission
+    order.  sync: host-materialization hook (np.asarray semantics) —
+    the pipeline passes a timed wrapper so host-sync wait is counted."""
+
+    __slots__ = ("runner", "f", "part", "bss", "spec", "asm", "handled",
+                 "flat", "mp")
+
+    def __init__(self, runner, f, part, bss, spec, asm, handled, flat,
+                 mp):
+        self.runner = runner
+        self.f = f
+        self.part = part
+        self.bss = bss
+        self.spec = spec
+        self.asm = asm
+        self.handled = handled
+        self.flat = flat
+        self.mp = mp
+
+    def harvest(self, sync=None):
+        sync = sync or np.asarray
+        asm, spec = self.asm, self.spec
+        flat = np.asarray(sync(self.flat))
+        any_maybe = bool(flat[-1])
+        if spec.value_fields:
+            stats = flat[:-1].reshape(len(spec.value_fields), 7, asm.nb)
+            counts = stats[0][0]
+            stats_np = {fld: stats[k] for k, fld in
+                        enumerate(spec.value_fields)}
+        else:
+            counts = flat[:-1]
+            stats_np = {}
+        partials = self.runner._partials_from_counts(asm, counts,
+                                                     stats_np)
+        if any_maybe:
+            maybe_np = np.unpackbits(np.asarray(sync(self.mp))) \
+                [:asm.layout.nrows_padded].astype(bool)
+            partials.extend(_residue_partials(self.f, self.bss, spec,
+                                              asm.layout, maybe_np,
+                                              part=self.part))
+        return {}, self.handled, partials
+
+
+def fused_stats_submit(runner, f, part, bss, spec, asm):
+    """Plan + DISPATCH the single fused filter|stats program without
+    materializing anything; returns a pending handle (harvest() ->
+    (bms, handled, partials)) or None when the shape declines.
 
     asm: the runner's assembled stats axes (AxesAssembly).  Requires
     every candidate block to be stats-eligible (the fused path never
@@ -941,7 +1017,7 @@ def try_fused(runner, f, part, bss, spec, asm):
 
     handled = set(bss)
     if tree == ("false",):
-        return {}, handled, []
+        return _Ready(({}, handled, []))
 
     cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
     prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
@@ -960,25 +1036,10 @@ def try_fused(runner, f, part, bss, spec, asm):
         prog, asm.strides, asm.nb, len(values_tuple),
         jnp.int32(layout.nrows), cand_packed, asm.ids_tuple,
         values_tuple, tuple(planner.args))
-    flat = np.array(flat)
-    any_maybe = bool(flat[-1])
+    return _StatsPending(runner, f, part, bss, spec, asm, handled, flat,
+                         mp)
 
-    if spec.value_fields:
-        stats = flat[:-1].reshape(len(spec.value_fields), 7, asm.nb)
-        counts = stats[0][0]
-        stats_np = {fld: stats[k] for k, fld in
-                    enumerate(spec.value_fields)}
-    else:
-        counts = flat[:-1]
-        stats_np = {}
-    partials = runner._partials_from_counts(asm, counts, stats_np)
 
-    if any_maybe:
-        maybe_np = np.unpackbits(np.array(mp))[:layout.nrows_padded] \
-            .astype(bool)
-        partials.extend(_residue_partials(f, bss, spec, layout,
-                                          maybe_np))
-    return {}, handled, partials
 
 
 # ---------------- fused filter | sort-topk prefilter ----------------
@@ -1068,9 +1129,159 @@ def try_fused_topk(runner, f, part, bss, spec):
         sel = mm[start:start + n]
         if sel.any():
             # maybe rows above threshold: the filter tree's own host
-            # path decides them (same residue discipline as try_fused)
+            # path decides them (same residue discipline as the fused
+            # stats harvest, _StatsPending)
             vbm = sel.copy()
             f.apply_to_block(bs, vbm)
             bm |= vbm
         bms[bi] = bm
     return bms
+
+
+# ---------------- fused filter-only dispatch (row queries) ----------------
+
+def _filter_local(prog, axis, nrows, cand_packed, args, rl):
+    """Whole-filter-tree evaluation body: bit-packed (definite, maybe)
+    row vectors.  axis/rl as in _fused_local (rl is this shard's rows)."""
+    import jax.numpy as jnp
+    tree, _rlp, has_maybe, has_cand = prog[:4]
+    d, m = _eval_node(tree, args, rl)
+    if has_cand:
+        cand = _unpack_bits(cand_packed, rl)
+    else:
+        idx = jnp.arange(rl, dtype=jnp.int32)
+        if axis is not None:
+            idx = idx + jax.lax.axis_index(axis) * rl
+        cand = idx < nrows
+    d = d & cand
+    if has_maybe and m is not None:
+        mp = jnp.packbits((m & cand).astype(jnp.uint8))
+    else:
+        mp = jnp.zeros(1, dtype=jnp.uint8)
+        if axis is not None:
+            mp = K._vary(mp, (axis,))
+    return jnp.packbits(d.astype(jnp.uint8)), mp
+
+
+@partial(jax.jit, static_argnames=("prog",))
+def _filter_dispatch(prog, nrows, cand_packed, args):
+    """One device call: the WHOLE filter tree -> bit-packed (definite,
+    maybe) row vectors — the row-query analogue of _fused_dispatch.
+
+    Round 3 evaluated row-query trees leaf-by-leaf (one dispatch per
+    device leaf, host AND/OR combination); this compiles the same
+    three-valued program the stats/topk paths already trust into a
+    single dispatch per part whose only downloads are two R/8-byte
+    packed vectors, which is what makes the dispatch window's
+    submit/harvest split (tpu/pipeline.py) worthwhile: one async
+    handle per part instead of a host sync per leaf."""
+    return _filter_local(prog, None, nrows, cand_packed, args, prog[1])
+
+
+@partial(jax.jit, static_argnames=("prog", "mesh", "axis"))
+def _filter_dispatch_mesh(mesh, axis, prog, nrows, cand_packed, args):
+    """The filter-only program under shard_map: each device evaluates
+    its row stripe, the packed (definite, maybe) vectors concatenate
+    along the row axis (rl per shard is a multiple of 8, so the bit
+    packing aligns across shard boundaries)."""
+    from jax.sharding import PartitionSpec as P
+    has_cand = prog[3]
+    arg_rows = prog[4]
+    rl = prog[1] // int(mesh.devices.size)
+    in_specs = (P(), P(axis) if has_cand else P(),
+                tuple(P(None, axis) if r == 2 else
+                      (P(axis) if r else P()) for r in arg_rows))
+
+    def fn(nrows, cp, leaf_args):
+        return _filter_local(prog, axis, nrows, cp, leaf_args, rl)
+
+    return K.shard_map_fn()(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=(P(axis), P(axis)))(
+        nrows, cand_packed, args)
+
+
+class _FilterPending:
+    """An in-flight fused filter dispatch for a row query; harvest()
+    returns block_idx -> bool bitmap, bit-identical to the CPU path
+    (maybe rows are settled by the filter tree's own apply_to_block,
+    the same residue discipline as try_fused/try_fused_topk)."""
+
+    __slots__ = ("runner", "f", "part", "bss", "layout", "dm", "mm",
+                 "has_maybe")
+
+    def __init__(self, runner, f, part, bss, layout, dm, mm, has_maybe):
+        self.runner = runner
+        self.f = f
+        self.part = part
+        self.bss = bss
+        self.layout = layout
+        self.dm = dm
+        self.mm = mm
+        self.has_maybe = has_maybe
+
+    def harvest(self, sync=None):
+        sync = sync or np.asarray
+        rlp = self.layout.nrows_padded
+        dm = np.unpackbits(np.asarray(sync(self.dm)))[:rlp].astype(bool)
+        mm = None
+        if self.has_maybe:
+            mm = np.unpackbits(np.asarray(sync(self.mm)))[:rlp] \
+                .astype(bool)
+        bms = {}
+        for bi, bs in self.bss.items():
+            start = self.layout.starts[bi]
+            n = bs.nrows
+            bm = dm[start:start + n].copy()
+            if mm is not None:
+                sel = mm[start:start + n]
+                if sel.any():
+                    vbm = sel.copy()
+                    self.f.apply_to_block(bs, vbm)
+                    bm |= vbm
+            bms[bi] = bm
+        return bms
+
+
+def fused_filter_enabled() -> bool:
+    """The VL_FUSED_FILTER kill-switch, shared by the dispatch gate and
+    the pipeline's prefetch-mode decision so the two can never diverge
+    (prefetching #fl layout staging for a path that will dispatch
+    per-leaf would waste the upload AND leave the real staging cold)."""
+    return os.environ.get("VL_FUSED_FILTER", "1") != "0"
+
+
+def fused_filter_submit(runner, f, part, bss):
+    """Single-dispatch evaluation of a row query's whole filter tree.
+
+    Returns a pending handle (harvest() -> block_idx -> bitmap), a
+    _Ready result for constant trees, or None when the shape declines
+    (caller falls back to the per-leaf run_part path).  Kill-switch:
+    VL_FUSED_FILTER=0 restores the round-3 per-leaf behavior."""
+    import jax.numpy as jnp
+    from .stats_device import MAX_STAT_ROWS
+    if not fused_filter_enabled():
+        return None
+    layout = runner._stats_layout(part)
+    if layout.nrows > MAX_STAT_ROWS:
+        return None
+    planner = _Planner(runner, part, bss, layout)
+    try:
+        tree = planner.plan(f)
+    except _NoFuse:
+        return None
+    if tree == ("false",):
+        return _Ready({bi: np.zeros(bss[bi].nrows, dtype=bool)
+                       for bi in bss})
+    if tree == ("true",):
+        return _Ready({bi: np.ones(bss[bi].nrows, dtype=bool)
+                       for bi in bss})
+    cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
+    prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
+            tuple(planner.arg_rows))
+    runner._bump("device_calls")
+    runner._bump("filter_dispatches")
+    runner._kind("fused_filter")
+    dm, mm = runner._dispatch_filter(prog, jnp.int32(layout.nrows),
+                                     cand_packed, tuple(planner.args))
+    return _FilterPending(runner, f, part, bss, layout, dm, mm,
+                          planner.has_maybe)

@@ -181,6 +181,21 @@ class StagingCache:
         with self._mu:
             return key in self._lru
 
+    def stats(self) -> dict:
+        """Observability snapshot (runner stats / pipeline tests)."""
+        with self._mu:
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes": self._bytes, "entries": len(self._lru)}
+
+    def check_balanced(self) -> bool:
+        """Budget-accounting invariant: the running byte total equals
+        the recomputed cost of every live entry.  The pipeline's
+        cancellation tests assert this after draining an in-flight
+        window (a poisoned/partial entry would break the equality)."""
+        with self._mu:
+            return self._bytes == sum(self._cost(c)
+                                      for c in self._lru.values())
+
     def clear(self) -> None:
         with self._mu:
             self._lru.clear()

@@ -1,0 +1,587 @@
+"""Async multi-part device pipeline: in-flight dispatch window +
+small-part packing.
+
+PERF.md's hardware profile proved the device plane is transfer/RTT-bound
+(~65 ms per completed dispatch under the tunnel; fused kernel time
+~11 ms), which is why round 3 collapsed each part to ONE fused dispatch —
+but the part walk itself stayed serial: every part's dispatch blocked on
+the previous part's host materialization, so a query over P parts paid P
+serial round trips even though the dispatches are independent.  This
+module is the per-part execution driver that removes that serialization
+(engine/searcher._scan_parts delegates here for batch runners):
+
+1. **In-flight dispatch window** — fused dispatches return asynchronous
+   jax arrays; nothing forces them to the host at submit time.  Up to
+   ``VL_INFLIGHT`` (default 4) units keep their dispatches outstanding;
+   completed results are harvested strictly in submission order, so the
+   downstream block order (and the stats absorb order) is bit-identical
+   to the serial walk.  Prefetch staging (BatchRunner.submit_prefetch)
+   follows the same depth, so the host decode/upload of part N+k
+   overlaps the device scans of parts N..N+k-1 instead of the old
+   depth-1 double buffer.
+
+2. **Small-part packing** — LSM partitions are full of small fresh
+   parts, and each one still costs a full dispatch RTT.  Consecutive
+   parts whose row counts share a padded-size bucket (kernels.pad_bucket
+   — the same bucketing the staging layer uses to keep jit caches small)
+   are presented to the fused planner as ONE part-like value
+   (PackedPart: members' blocks concatenated, in member order) and
+   evaluated in ONE fused super-dispatch.  Row bitmaps split back per
+   member on the host; stats partials carry a per-part segment axis
+   (stats_device.with_segment_axis) and are segment-reduced back to
+   per-member partials, so the stats processor sees exactly the per-part
+   absorb granularity of the serial path.  P small parts cost
+   ceil(P / VL_PACK_PARTS) dispatches instead of P.
+
+Cancellation (`QueryCancelled`) and deadline expiry
+(`QueryTimeoutError`) drain the window without writing partial blocks
+downstream: in-flight handles are simply dropped (jax buffers are
+released when the device finishes; staging entries are complete,
+keyed, budget-accounted values, so the StagingCache stays balanced).
+
+Kill-switches: VL_INFLIGHT=1 reduces to the serial submit-then-harvest
+walk; VL_PACK_PARTS=1 disables packing; VL_FUSED_FILTER=0 restores the
+per-leaf row-query path inside each unit (tpu/fused.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from .kernels import pad_bucket
+
+# adaptive pack-size clamps: parts below the floor always pack (the
+# bench measures 1.4-4x wins for flush-sized parts even on a ~0.1ms
+# local backend); parts above the ceiling never do
+_PACK_ROWS_FLOOR = 16384
+_PACK_ROWS_CEIL = 1 << 20
+
+
+def inflight_depth() -> int:
+    """VL_INFLIGHT: max units with outstanding dispatches (>=1)."""
+    try:
+        return max(1, int(os.environ.get("VL_INFLIGHT", "4")))
+    except ValueError:
+        return 4
+
+
+def pack_limit() -> int:
+    """VL_PACK_PARTS: max parts per super-dispatch (<=1 disables)."""
+    try:
+        return max(1, int(os.environ.get("VL_PACK_PARTS", "8")))
+    except ValueError:
+        return 8
+
+
+def pack_rows_cap(runner) -> int:
+    """Parts above this many rows never pack.
+
+    Packing trades per-dispatch overhead for a bigger fused program, so
+    it pays while a part's whole-part scan time is below the dispatch
+    round trip — which the cost model MEASURES (65 ms through the axon
+    tunnel, ~0.1 ms on a local backend).  The cap scales with rtt *
+    device_rate (at ~128 scanned bytes/row), so big parts keep their own
+    dispatches on fast-RTT backends (measured 0.5-0.7x regressions when
+    packing 128k-row parts on jax-CPU) while the tunnel packs far larger
+    parts.  VL_PACK_MAX_ROWS overrides the adaptive cap outright."""
+    v = os.environ.get("VL_PACK_MAX_ROWS")
+    if v:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    cap = runner.cost.measured_rtt() * runner.cost._dev_rate() / 128
+    return int(min(max(cap, _PACK_ROWS_FLOOR), _PACK_ROWS_CEIL))
+
+
+# ---------------- packed parts ----------------
+
+class PackedPart:
+    """Several small immutable parts presented as ONE part-like value.
+
+    Blocks are the members' blocks concatenated in member order with
+    re-based indices, so every staging/planning routine that walks
+    ``range(part.num_blocks)`` (stage_layout_column, part_stats_layout,
+    stage_numeric/dict/buckets, the bloom filterbank, the fused planner)
+    works unchanged over the pack;  ``segment_of_block`` maps a pack
+    block back to its member ordinal — the segment id of the
+    super-dispatch.  The uid is the member-uid tuple, so StagingCache
+    entries for a pack are stable across queries exactly like per-part
+    staging (parts are immutable; a merge mints fresh member uids and
+    therefore a fresh pack identity)."""
+
+    def __init__(self, members: list):
+        self.members = list(members)
+        self.uid = ("pack",) + tuple(p.uid for p in self.members)
+        self._offsets = []
+        self._map = []
+        for mi, p in enumerate(self.members):
+            self._offsets.append(len(self._map))
+            for bi in range(p.num_blocks):
+                self._map.append((mi, p, bi))
+        self.num_rows = sum(p.num_rows for p in self.members)
+        self.min_ts = min(p.min_ts for p in self.members)
+        self.max_ts = max(p.max_ts for p in self.members)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._map)
+
+    @property
+    def num_segments(self) -> int:
+        return len(self.members)
+
+    def block_offset(self, mi: int) -> int:
+        """Pack block index of member mi's block 0."""
+        return self._offsets[mi]
+
+    def segment_of_block(self, bi: int) -> int:
+        return self._map[bi][0]
+
+    # -- block-level delegation (Part / InmemoryPart uniform API) --
+    def block_rows(self, bi: int) -> int:
+        _mi, p, b = self._map[bi]
+        return p.block_rows(b)
+
+    def block_min_ts(self, bi: int) -> int:
+        _mi, p, b = self._map[bi]
+        return p.block_min_ts(b)
+
+    def block_stream_id(self, bi: int):
+        _mi, p, b = self._map[bi]
+        return p.block_stream_id(b)
+
+    def block_tags(self, bi: int) -> str:
+        _mi, p, b = self._map[bi]
+        return p.block_tags(b)
+
+    def block_consts(self, bi: int):
+        _mi, p, b = self._map[bi]
+        return p.block_consts(b)
+
+    def block_column_meta(self, bi: int, name: str):
+        _mi, p, b = self._map[bi]
+        return p.block_column_meta(b, name)
+
+    def block_column(self, bi: int, name: str):
+        _mi, p, b = self._map[bi]
+        return p.block_column(b, name)
+
+    def block_column_bloom(self, bi: int, name: str):
+        _mi, p, b = self._map[bi]
+        return p.block_column_bloom(b, name)
+
+    def block_timestamps(self, bi: int):
+        _mi, p, b = self._map[bi]
+        return p.block_timestamps(b)
+
+
+# pack instances strongly reference their members (incl. in-RAM
+# InmemoryPart blocks), so the cache is a SMALL hard-capped LRU — it
+# only needs to keep the hot packs' filter banks warm across queries;
+# the staged tensors live in the byte-budgeted StagingCache keyed by
+# the (deterministic) pack uid and survive regardless of this cache
+_PACK_CACHE_MAX = 32
+
+
+def _get_pack(runner, members: list) -> PackedPart:
+    key = tuple(p.uid for p in members)
+    with runner._pack_mu:
+        got = runner._packs.get(key)
+        if got is None:
+            got = runner._packs[key] = PackedPart(members)
+        runner._packs.move_to_end(key)
+        while len(runner._packs) > _PACK_CACHE_MAX:
+            runner._packs.popitem(last=False)
+        return got
+
+
+# ---------------- units and harvested results ----------------
+
+@dataclass
+class _Member:
+    """One member part's share of a harvested unit."""
+    part: object
+    blocks: list                   # [(orig block idx, BlockSearch)]
+    bms: dict                      # orig block idx -> bool bitmap
+    handled: set                   # orig idxs fully covered by partials
+    partials: list
+
+
+@dataclass
+class _Unit:
+    part: object                   # Part or PackedPart (dispatch target)
+    bss: dict                      # dispatch-coord block idx -> BlockSearch
+    members: list                  # [(member part, [(orig_bi, bs), ...])]
+    pack: bool = False
+
+
+class _UnitReady:
+    """Already-materialized unit result (host paths, constant trees)."""
+
+    def __init__(self, members: list):
+        self._members = members
+
+    def harvest(self, sync) -> list:
+        return self._members
+
+
+class _SingleRows:
+    def __init__(self, unit: _Unit, pending):
+        self.unit = unit
+        self.pending = pending
+
+    def harvest(self, sync) -> list:
+        bms = self.pending.harvest(sync)
+        part, blocks = self.unit.members[0]
+        return [_Member(part, blocks, bms, set(), [])]
+
+
+class _SingleStats:
+    def __init__(self, unit: _Unit, pending):
+        self.unit = unit
+        self.pending = pending
+
+    def harvest(self, sync) -> list:
+        bms, handled, partials = self.pending.harvest(sync)
+        part, blocks = self.unit.members[0]
+        return [_Member(part, blocks, bms, handled, partials)]
+
+
+class _PackRows:
+    def __init__(self, unit: _Unit, pending):
+        self.unit = unit
+        self.pending = pending
+
+    def harvest(self, sync) -> list:
+        packbms = self.pending.harvest(sync)   # keyed by pack block idx
+        out = []
+        for mi, (p, blocks) in enumerate(self.unit.members):
+            off = self.unit.part.block_offset(mi)
+            bms = {bi: packbms[off + bi] for bi, _bs in blocks}
+            out.append(_Member(p, blocks, bms, set(), []))
+        return out
+
+
+class _PackStats:
+    """Harvest of a packed stats super-dispatch: partials come back with
+    a leading ("s", member_idx) key component (the segment axis) and are
+    segment-reduced to per-member partial lists, absorbed in member
+    order — exactly the serial per-part granularity."""
+
+    def __init__(self, unit: _Unit, pending):
+        self.unit = unit
+        self.pending = pending
+
+    def harvest(self, sync) -> list:
+        _bms, _handled, partials = self.pending.harvest(sync)
+        per_seg: dict[int, list] = {}
+        for kp, cnt, fs, uniq, qv in partials:
+            seg = int(kp[0][1])     # leading component IS the segment
+            per_seg.setdefault(seg, []).append((kp[1:], cnt, fs, uniq,
+                                                qv))
+        out = []
+        for mi, (p, blocks) in enumerate(self.unit.members):
+            out.append(_Member(p, blocks, {}, {bi for bi, _bs in blocks},
+                               per_seg.get(mi, [])))
+        return out
+
+
+# ---------------- planning ----------------
+
+def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
+                 sort_spec, token_leaves, check_deadline):
+    """Lazily fold the pruned (part, candidate-bis) stream into dispatch
+    units, in part order.
+
+    Consecutive parts pack when packing is on, the query shape supports
+    a pack dispatch (sort-topk thresholds are per part, so sort queries
+    never pack), every member is small (pack_rows_cap) and the members
+    share a padded-row bucket (the shared width/nrows bucketing that
+    keeps the jit cache small keeps pack shapes small too).  Lazy on
+    purpose: a `limit`-style early exit (head.is_done) or a deadline
+    must stop the header walk exactly like the serial loop did — the
+    consumer only pulls the window's lookahead ahead of execution."""
+    from ..engine.block_search import BlockSearch
+    from ..engine.searcher import QueryCancelled
+    from ..storage.filterbank import part_aggregate_prunes
+    pack_max = pack_limit()
+    packable = pack_max > 1 and sort_spec is None
+    rows_cap = pack_rows_cap(runner) if packable else 0
+
+    def bucket(p) -> int:
+        return pad_bucket(max(p.num_rows, 1), minimum=1024)
+
+    def make_unit(group) -> _Unit:
+        if len(group) == 1:
+            p, bis = group[0]
+            bss = {}
+            blocks = []
+            for bi in bis:
+                bs = BlockSearch(p, bi)
+                bs.ctx = ctx
+                bss[bi] = bs
+                blocks.append((bi, bs))
+            return _Unit(p, bss, [(p, blocks)])
+        pack = _get_pack(runner, [p for p, _b in group])
+        bss = {}
+        members = []
+        for mi, (p, bis) in enumerate(group):
+            off = pack.block_offset(mi)
+            blocks = []
+            for bi in bis:
+                bs = BlockSearch(p, bi)
+                bs.ctx = ctx
+                bss[off + bi] = bs
+                blocks.append((bi, bs))
+            members.append((p, blocks))
+        return _Unit(pack, bss, members, pack=True)
+
+    group: list = []        # packable run sharing one row bucket
+    for part in parts:
+        check_deadline()
+        if head.is_done():
+            raise QueryCancelled()
+        bis = cand_fn(part)
+        if not bis:
+            continue
+        if token_leaves and part_aggregate_prunes(
+                part, token_leaves,
+                build=len(bis) * 4 >= part.num_blocks):
+            runner._bump("agg_pruned_parts")
+            continue
+        small = packable and part.num_rows <= rows_cap
+        if not small:
+            if group:
+                yield make_unit(group)
+                group = []
+            yield make_unit([(part, bis)])
+            continue
+        if group and bucket(group[0][0]) != bucket(part):
+            yield make_unit(group)
+            group = []
+        group.append((part, bis))
+        if len(group) >= pack_max:
+            yield make_unit(group)
+            group = []
+    if group:
+        yield make_unit(group)
+
+
+# ---------------- submission ----------------
+
+def _submit(runner, f, unit: _Unit, stats_spec, sort_spec, spec_seg):
+    if stats_spec is not None:
+        if unit.pack:
+            return _submit_pack_stats(runner, f, unit, stats_spec,
+                                      spec_seg)
+        return _SingleStats(unit, runner.run_part_stats_submit(
+            f, unit.part, unit.bss, stats_spec))
+    if sort_spec is not None:
+        part, blocks = unit.members[0]
+        bms = runner.run_part_topk(f, part, unit.bss, sort_spec)
+        if bms is None:
+            bms = runner.run_part(f, part, unit.bss)
+        return _UnitReady([_Member(part, blocks, bms, set(), [])])
+    if unit.pack:
+        return _submit_pack_rows(runner, f, unit)
+    return _SingleRows(unit, runner.run_part_submit(f, unit.part,
+                                                    unit.bss))
+
+
+def _count_pack(runner, unit: _Unit, pending) -> None:
+    """Count a packed SUPER-DISPATCH — constant-tree packs come back as
+    _Ready without touching the device, and must not inflate the
+    dispatch-reduction numbers the bench/PERF cost model reports."""
+    from .fused import _Ready
+    if isinstance(pending, _Ready):
+        return
+    runner._bump("packed_dispatches")
+    runner._bump("packed_parts", len(unit.members))
+
+
+def _host_members(runner, f, unit: _Unit) -> list:
+    out = []
+    for p, blocks in unit.members:
+        mbss = dict(blocks)
+        out.append(_Member(p, blocks, runner._host_eval_part(f, mbss),
+                           set(), []))
+    return out
+
+
+def _submit_pack_rows(runner, f, unit: _Unit):
+    if runner._gate_host(f, unit.part, unit.bss):
+        runner._bump("gated_host_parts", len(unit.members))
+        return _UnitReady(_host_members(runner, f, unit))
+    pending = None
+    if runner.fused_enabled:
+        from .fused import fused_filter_submit
+        pending = fused_filter_submit(runner, f, unit.part, unit.bss)
+    if pending is not None:
+        _count_pack(runner, unit, pending)
+        return _PackRows(unit, pending)
+    # the planner declined the pack: fall back to the serial per-member
+    # path (results identical to the unpacked walk)
+    out = []
+    for p, blocks in unit.members:
+        bms = runner.run_part_submit(f, p, dict(blocks)).harvest()
+        out.append(_Member(p, blocks, bms, set(), []))
+    return _UnitReady(out)
+
+
+def _submit_pack_stats(runner, f, unit: _Unit, stats_spec, spec_seg):
+    cand_rows = sum(bs.nrows for bs in unit.bss.values())
+    if runner._gate_host(f, unit.part, unit.bss,
+                         stats_rows=max(cand_rows, 1)):
+        runner._bump("gated_host_parts", len(unit.members))
+        return _UnitReady(_host_members(runner, f, unit))
+    pending = None
+    if runner.fused_enabled:
+        from .fused import fused_stats_submit
+        asm = runner._assemble_axes(unit.part, spec_seg)
+        if asm is not None:
+            pending = fused_stats_submit(runner, f, unit.part, unit.bss,
+                                         spec_seg, asm)
+    if pending is not None:
+        _count_pack(runner, unit, pending)
+        return _PackStats(unit, pending)
+    # decline (ineligible column, bucket blowup, unfusable leaf): serial
+    # per-member fallback with the ORIGINAL spec
+    out = []
+    for p, blocks in unit.members:
+        bms, handled, partials = runner.run_part_stats(f, p, dict(blocks),
+                                                       stats_spec)
+        out.append(_Member(p, blocks, bms, handled, partials))
+    return _UnitReady(out)
+
+
+# ---------------- the window driver ----------------
+
+def _make_sync(runner):
+    """The window's SINGLE deliberate host-sync point: everything the
+    device path downloads during a windowed scan funnels through here,
+    so the blocked time is measurable (host_sync_wait_s) and the hot
+    path stays statically clean (tools/vlint hotpath checker)."""
+
+    def sync(arr):
+        t0 = time.perf_counter()
+        # vlint: allow-jax-host-sync(the window's single harvest point —
+        # materializing a completed dispatch in submission order IS the
+        # pipeline's output step; everything upstream stays async)
+        out = np.asarray(arr)
+        runner._bump("host_sync_wait_s", time.perf_counter() - t0)
+        return out
+
+    return sync
+
+
+def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
+                      deadline, stats_spec, sort_spec,
+                      token_leaves) -> None:
+    """Drive one partition's parts through the async dispatch window.
+
+    Replaces the serial device walk of engine/searcher._scan_parts:
+    candidate pruning and part-aggregate kills are unchanged; submission
+    keeps up to VL_INFLIGHT units' dispatches outstanding; harvest is in
+    submission order, so downstream block order and stats absorb
+    granularity are identical to the serial path."""
+    from ..engine.block_result import BlockResult
+    from ..engine.searcher import (QueryCancelled, QueryTimeoutError,
+                                   _absorb_stats_partials)
+
+    def check_deadline():
+        if deadline is not None and time.monotonic() > deadline:
+            raise QueryTimeoutError(
+                "query exceeded -search.maxQueryDuration")
+
+    f = q.filter
+    depth = inflight_depth()
+    sync = _make_sync(runner)
+    window: deque = deque()
+    spec_seg = None
+    if stats_spec is not None and pack_limit() > 1 and sort_spec is None:
+        from .stats_device import with_segment_axis
+        spec_seg = with_segment_axis(stats_spec)
+
+    def emit(members: list) -> None:
+        for m in members:
+            if stats_spec is not None and m.partials:
+                _absorb_stats_partials(head, q, stats_spec, m.partials)
+            for bi, bs in m.blocks:
+                if bi in m.handled:
+                    continue
+                if head.is_done():
+                    raise QueryCancelled()
+                bm = m.bms[bi]
+                if not bm.any():
+                    continue
+                head.write_block(
+                    BlockResult.from_block_search(bs, bm, needed))
+
+    stream = _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
+                          sort_spec, token_leaves, check_deadline)
+    lookahead: deque = deque()
+    exhausted = False
+    prefetched: set = set()
+    # prefetch staging mode must match what the units will dispatch:
+    # fused layout staging for stats and (unless the VL_FUSED_FILTER
+    # kill-switch reverts to the per-leaf path) row queries; the sort
+    # shape keeps string staging for its run_part fallback
+    from .fused import fused_filter_enabled
+    fused_pf = stats_spec is not None or (
+        sort_spec is None and fused_filter_enabled()
+        and runner.fused_enabled)
+
+    def refill() -> None:
+        # plan only the window's lookahead ahead of execution: an early
+        # exit (limit hit, deadline) stops the header walk right where
+        # the serial loop would have
+        nonlocal exhausted
+        while not exhausted and len(lookahead) < depth + 1:
+            try:
+                lookahead.append(next(stream))
+            except StopIteration:
+                exhausted = True
+
+    try:
+        while True:
+            refill()
+            if not lookahead:
+                break
+            unit = lookahead.popleft()
+            check_deadline()
+            if head.is_done():
+                raise QueryCancelled()
+            # deepened prefetch: stage every unit inside the window's
+            # lookahead, so part N+k's host decode/upload overlaps the
+            # scans of N..N+k-1 (packs prefetch as the pack, hitting the
+            # same #fl/#num staging keys the super-dispatch will use)
+            for uj in lookahead:
+                if uj.part.uid in prefetched:
+                    continue
+                prefetched.add(uj.part.uid)
+                runner.submit_prefetch(uj.part, f, stats_spec,
+                                       cand_bis=list(uj.bss),
+                                       fused=fused_pf)
+            while len(window) >= depth:
+                check_deadline()
+                emit(window.popleft().harvest(sync))
+            runner._bump("pipeline_units")
+            window.append(_submit(runner, f, unit, stats_spec, sort_spec,
+                                  spec_seg))
+            runner._bump_max("inflight_hwm", len(window))
+        while window:
+            check_deadline()
+            emit(window.popleft().harvest(sync))
+    finally:
+        # cancellation/deadline drain: drop in-flight handles without
+        # writing anything downstream.  jax releases the device buffers
+        # when the dispatches complete, and every StagingCache entry is
+        # a complete, budget-accounted value (staged under its key lock),
+        # so the cache stays balanced for the next query.
+        window.clear()

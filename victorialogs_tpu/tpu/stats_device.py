@@ -56,7 +56,10 @@ class FuncSpec:
 
 @dataclass
 class ByKey:
-    kind: str                     # 'time' | 'field' | 'numbucket'
+    kind: str                     # 'time' | 'field' | 'numbucket' | 'seg'
+    #                               ('seg': per-part segment axis of a
+    #                               packed super-dispatch — see
+    #                               with_segment_axis below)
     name: str = ""                # field name ('field'/'numbucket')
     step: int = 0                 # ns (kind == 'time')
     offset: int = 0               # ns (kind == 'time')
@@ -72,6 +75,28 @@ class StatsSpec:
     uniq_fields: list             # distinct count_uniq fields (dict axes)
     quantile_fields: list         # distinct quantile/median fields
     #                               (per-value histogram axes)
+
+
+def with_segment_axis(spec: StatsSpec) -> StatsSpec:
+    """Pack-dispatch variant of a stats spec: a LEADING per-part segment
+    axis (ByKey kind 'seg') so ONE fused super-dispatch over several
+    concatenated small parts yields per-part partials.
+
+    The segment axis multiplies the bucket product by the pack's member
+    count and every partial's key_parts leads with ("s", member_idx) —
+    batch._assemble_axes stages the per-row segment ids from the packed
+    part's block->member map and fused._residue_partials keys residue
+    rows the same way.  The pipeline (tpu/pipeline.py) strips that
+    component and absorbs each member's partials in submission order, so
+    the stats processor sees EXACTLY the per-part absorb granularity the
+    serial path produces.  (The funcs' merge() is commutative, so this
+    is an auditability/parity guarantee, not a correctness requirement.)
+    """
+    return StatsSpec(by=[ByKey("seg")] + list(spec.by),
+                     funcs=spec.funcs,
+                     value_fields=spec.value_fields,
+                     uniq_fields=spec.uniq_fields,
+                     quantile_fields=spec.quantile_fields)
 
 
 def _func_spec(fn) -> FuncSpec | None:
