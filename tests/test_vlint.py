@@ -515,3 +515,82 @@ def test_cli_exit_codes(tmp_path):
             cwd=REPO, env=env, capture_output=True, text=True)
         assert r.returncode == 1, f"{rel}: {r.stdout}{r.stderr}"
         p.unlink()
+
+
+# ---------------- span discipline (obs/tracing.py API) ----------------
+
+SPAN_BAD_CTOR = """
+    from victorialogs_tpu.obs.tracing import Span
+
+    def f():
+        sp = Span("query", {})
+        return sp
+"""
+
+SPAN_BAD_OPEN = """
+    from victorialogs_tpu.obs import tracing
+
+    def f():
+        sp = tracing.current_span().span("harvest")
+        return sp
+"""
+
+SPAN_GOOD = """
+    from victorialogs_tpu.obs import tracing
+
+    def f():
+        root = tracing.make_root("query")
+        with tracing.activate(root):
+            with tracing.current_span().span("harvest", unit=1) as h:
+                h.add("rows", 5)
+        return root.to_dict()
+"""
+
+
+def test_span_discipline_flags_direct_construction():
+    out = lint(SPAN_BAD_CTOR)
+    assert "span-discipline" in checkers(out)
+    assert any("Span(...)" in f.message for f in out)
+
+
+def test_span_discipline_flags_unclosed_open():
+    out = lint(SPAN_BAD_OPEN)
+    assert "span-discipline" in checkers(out)
+    assert any("never close" in f.message for f in out)
+
+
+def test_span_discipline_clean_and_annotated():
+    assert "span-discipline" not in checkers(lint(SPAN_GOOD))
+    annotated = """
+        from victorialogs_tpu.obs import tracing
+
+        def f():
+            # vlint: allow-span-discipline(closed manually in a handle)
+            sp = tracing.current_span().span("x")
+            return sp
+    """
+    assert "span-discipline" not in checkers(lint(annotated))
+
+
+def test_span_discipline_skips_tracing_module():
+    out = lint(SPAN_BAD_CTOR,
+               path="victorialogs_tpu/obs/tracing.py")
+    assert "span-discipline" not in checkers(out)
+
+
+def test_span_discipline_repo_instrumentation_is_clean():
+    """Every .span()/make_root call site the tracing wiring added must
+    honor the context-manager discipline across all instrumented
+    layers."""
+    from tools.vlint.core import SourceFile
+    from tools.vlint import spans
+    for rel in ("engine/searcher.py", "storage/filterbank.py",
+                "tpu/pipeline.py", "tpu/batch.py", "tpu/layout.py",
+                "parallel/distributed.py", "server/cluster.py",
+                "server/vlselect.py", "server/app.py"):
+        path = os.path.join(REPO, "victorialogs_tpu", rel)
+        sf = SourceFile.parse(path,
+                              display_path=f"victorialogs_tpu/{rel}")
+        found = [f for f in spans.check(sf)
+                 if not sf.allowed(f.checker, f.line)]
+        assert found == [], [f.render() for f in found]

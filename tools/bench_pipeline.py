@@ -118,6 +118,40 @@ def run_config(storage, ten, t0, inflight, pack, runs):
     return out
 
 
+def measure_trace_overhead(storage, ten, t0, runs):
+    """Tracing-off vs tracing-on p50 on the packed workload, plus the
+    structural zero-span check for the disabled path (obs/tracing.py:
+    the no-op singleton must absorb every instrumentation call)."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.obs import tracing
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    os.environ["VL_INFLIGHT"] = "4"
+    os.environ["VL_PACK_PARTS"] = "8"
+    runner = BatchRunner()
+    _name, qs = QUERIES[1]  # the rows shape: most spans per unit
+    run_query_collect(storage, [ten], qs, timestamp=t0, runner=runner)
+
+    def p50(traced: bool):
+        times = []
+        for _r in range(runs):
+            root = tracing.make_root("bench", query=qs) if traced \
+                else None
+            t0s = time.perf_counter()
+            with tracing.activate(root):
+                run_query_collect(storage, [ten], qs, timestamp=t0,
+                                  runner=runner)
+            times.append(time.perf_counter() - t0s)
+        return statistics.median(times) * 1e3
+
+    before = tracing.spans_created()
+    off_ms = p50(traced=False)
+    spans_off = tracing.spans_created() - before
+    on_ms = p50(traced=True)
+    spans_on = tracing.spans_created() - before
+    return {"off_p50_ms": off_ms, "on_p50_ms": on_ms,
+            "spans_disabled": spans_off, "spans_traced": spans_on}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--parts", type=int, default=32)
@@ -141,6 +175,9 @@ def main():
                   f"VL_PACK_PARTS={pack}) ...", flush=True)
             results[label] = run_config(storage, ten, t0, inflight,
                                         pack, args.runs)
+        print("measuring vltrace overhead (tracing off vs on) ...",
+              flush=True)
+        trace_oh = measure_trace_overhead(storage, ten, t0, args.runs)
         storage.close()
 
     print(f"\npipeline bench — {args.parts} parts x {args.rows} rows, "
@@ -173,10 +210,18 @@ def main():
         print(f"wall clock {name}: serial/packed = "
               f"{results['serial'][name]['p50_ms'] / max(packed[name]['p50_ms'], 1e-9):.2f}x")
 
+    print(f"vltrace overhead (rows query, packed config): "
+          f"off={trace_oh['off_p50_ms']:.1f} ms  "
+          f"on={trace_oh['on_p50_ms']:.1f} ms  "
+          f"({trace_oh['on_p50_ms'] / max(trace_oh['off_p50_ms'], 1e-9):.3f}x)  "
+          f"spans: disabled={trace_oh['spans_disabled']} "
+          f"traced={trace_oh['spans_traced']}")
+
     if args.json:
         with open(args.json, "w") as f:
             json.dump({"parts": args.parts, "rows": args.rows,
                        "cpu": {k: len(v) for k, v in cpu.items()},
+                       "trace_overhead": trace_oh,
                        "results": {k: {n: {kk: vv for kk, vv in r.items()
                                            if kk != "rows"}
                                        for n, r in v.items()}
@@ -190,7 +235,18 @@ def main():
         assert wall_ratio >= 1.5, \
             f"windowed+packed must beat serial >=1.5x, got " \
             f"{wall_ratio:.2f}x"
-        print("acceptance: >=4x fewer dispatches, >=1.5x wall clock OK")
+        # disabled-tracing overhead within noise: structurally zero
+        # spans, and the disabled path may not run slower than the
+        # traced one beyond measurement jitter
+        assert trace_oh["spans_disabled"] == 0, \
+            "tracing-disabled run created spans"
+        assert trace_oh["spans_traced"] > 0
+        assert trace_oh["off_p50_ms"] <= \
+            trace_oh["on_p50_ms"] * 1.10 + 2.0, \
+            f"disabled-tracing path slower than traced beyond noise: " \
+            f"{trace_oh['off_p50_ms']:.1f} vs {trace_oh['on_p50_ms']:.1f} ms"
+        print("acceptance: >=4x fewer dispatches, >=1.5x wall clock, "
+              "vltrace disabled-overhead within noise OK")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,76 @@
+"""Span-discipline checker (obs/tracing.py API hygiene).
+
+The tracing API is context-manager-only: the with-block is what
+guarantees every span closes on every exit path (QueryCancelled /
+QueryTimeoutError unwinds included), which the no-open-spans trace
+tests pin.  Two ways to break that discipline, both flagged:
+
+- span-discipline: direct ``Span(...)`` construction anywhere outside
+  victorialogs_tpu/obs/tracing.py — spans must come from
+  ``tracing.make_root()`` (closed by ``tracing.activate``) or
+  ``parent.span(...)`` (closed by its with-block);
+- span-discipline: a ``.span(...)`` / ``start_trace(...)`` call that is
+  not the context expression of a ``with`` item (assigned, passed,
+  returned, or bare) — such a span would never close.
+
+Deliberate sites carry ``# vlint: allow-span-discipline(<why>)``, same
+annotation + baseline discipline as every other checker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import _dotted
+
+# the module that owns the Span class plays by its own rules
+_TRACING_MODULE = "obs/tracing.py"
+
+# calls that OPEN a span and therefore must sit in a with-item
+_OPENERS = ("span", "start_trace")
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    if sf.path.replace("\\", "/").endswith(_TRACING_MODULE):
+        return []
+    findings: list[Finding] = []
+
+    # every Call node that is a with-item context expression
+    with_calls: set[int] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_calls.add(id(item.context_expr))
+
+    def walk(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, ast.Call):
+                # the receiver may itself be a call
+                # (tracing.current_span().span(...)), which _dotted
+                # can't render — the attribute name alone decides
+                if isinstance(child.func, ast.Attribute):
+                    last = child.func.attr
+                else:
+                    last = _dotted(child.func).split(".")[-1]
+                if last == "Span":
+                    findings.append(Finding(
+                        "span-discipline", sf.path, child.lineno, sym,
+                        "direct Span(...) construction — use "
+                        "tracing.make_root() or the context-manager "
+                        "parent.span(...) API"))
+                elif last in _OPENERS and id(child) not in with_calls:
+                    findings.append(Finding(
+                        "span-discipline", sf.path, child.lineno, sym,
+                        f"{last}(...) outside a with-statement — the "
+                        f"span would never close; open spans via "
+                        f"`with parent.{last}(...) as sp:`"))
+            walk(child, sym)
+
+    walk(sf.tree, "")
+    return findings

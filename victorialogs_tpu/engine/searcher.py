@@ -21,6 +21,7 @@ import numpy as np
 from ..logsql.filters import (Filter, FilterAnd, FilterIn, FilterContainsAll,
                               FilterContainsAny, FilterNone, FilterNoop,
                               FilterNot, FilterOr, FilterStream, FilterTime)
+from ..obs import tracing
 from ..logsql.parser import MAX_TS, MIN_TS, Query, parse_query
 from ..logsql.pipes import Processor, SinkProcessor
 from ..storage.log_rows import TenantID
@@ -207,16 +208,20 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         pool = ThreadPoolExecutor(max_workers=nworkers)
 
     def scan_partition(pt, sink_head):
-        ctx = SearchContext(partition=pt, tenants=tenants)
-        allowed_sids = None
-        if sfs:
-            allowed_sids = set.intersection(
-                *(f.resolve(pt, tenants) for f in sfs))
-            if not allowed_sids:
-                return
-        _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
-                    allowed_sids, min_ts, max_ts, ctx, needed,
-                    deadline, pool, stats_spec, sort_spec, token_leaves)
+        with tracing.current_span().span(
+                "partition", day=getattr(pt, "day", None)) as psp:
+            ctx = SearchContext(partition=pt, tenants=tenants)
+            allowed_sids = None
+            if sfs:
+                allowed_sids = set.intersection(
+                    *(f.resolve(pt, tenants) for f in sfs))
+                if not allowed_sids:
+                    psp.set("pruned_by_stream_filter", True)
+                    return
+            _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
+                        allowed_sids, min_ts, max_ts, ctx, needed,
+                        deadline, pool, stats_spec, sort_spec,
+                        token_leaves)
 
     try:
         pts = storage.select_partitions(min_ts, max_ts)
@@ -272,12 +277,16 @@ def _scan_partitions_parallel(pts, scan_partition, head, npw) -> None:
     stop = _threading.Event()
     sync_head = _SyncHead(head, lock, stop)
     errors: list = []
+    # contextvars don't cross thread spawns: re-enter the caller's span
+    # in each partition worker so their "partition" spans nest under it
+    parent_span = tracing.current_span()
 
     def run_one(pt):
         if stop.is_set():
             return
         try:
-            scan_partition(pt, sync_head)
+            with tracing.use_span(parent_span):
+                scan_partition(pt, sync_head)
         except QueryCancelled:
             stop.set()
         # vlint: allow-broad-except(fan-out error channel, re-raised)
@@ -347,11 +356,14 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                           token_leaves)
         return
 
+    sp = tracing.current_span()
+    sp.set("parts", len(parts))
     for part in parts:
         if deadline is not None and time.monotonic() > deadline:
             raise QueryTimeoutError(
                 "query exceeded -search.maxQueryDuration")
         part_bis = cand_block_idxs(part)
+        sp.add("blocks_candidate", len(part_bis))
         if token_leaves and part_bis:
             # part-level aggregate kill (filter-index subsystem): an
             # AND-path leaf's required token absent from EVERY block
@@ -380,8 +392,10 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 q.filter.apply_to_block(bs, bm)
             if not bm.any():
                 continue
-            head.write_block(
-                BlockResult.from_block_search(bs, bm, needed))
+            br = BlockResult.from_block_search(bs, bm, needed)
+            sp.add("blocks_out")
+            sp.add("rows_out", br.nrows)
+            head.write_block(br)
         if not cand:
             continue
         if head.is_done():
@@ -398,8 +412,10 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             bm = bms[bi]
             if not bm.any():
                 continue
-            head.write_block(
-                BlockResult.from_block_search(bs, bm, needed))
+            br = BlockResult.from_block_search(bs, bm, needed)
+            sp.add("blocks_out")
+            sp.add("rows_out", br.nrows)
+            head.write_block(br)
 
 
 def run_query_collect(storage, tenants, q: Query | str,

@@ -1,0 +1,13 @@
+"""Observability layer: per-query hierarchical tracing (tracing.py),
+fixed-bucket Prometheus histograms (hist.py), and the slow-query log
+(slowlog.py).
+
+The tracing design constraint is that the DISABLED path must cost
+nothing measurable on the hot query path: `tracing.current_span()`
+returns a shared no-op singleton whenever no trace is active, and every
+span operation on it (span()/set()/add()) is a constant-time no-op with
+no allocation — asserted by tests/test_obs.py.  Real spans only exist
+inside a `tracing.activate(root)` dynamic extent, which the query
+handlers enter when the request carries `?trace=1` (or the slow-query
+log is armed).
+"""

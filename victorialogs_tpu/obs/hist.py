@@ -1,0 +1,135 @@
+"""Fixed-bucket Prometheus histograms for the query path.
+
+Unlike tracing (opt-in per request), histograms are ALWAYS on: each
+observe() is a bisect over a small fixed bucket list under a lock, paid
+at per-dispatch / per-part granularity (never per row), so the cost is
+noise next to the work it measures.  server/app.py Metrics.render pulls
+`render_all()` into /metrics with `# HELP` / `# TYPE` annotations.
+
+The standard instruments are module attributes (QUERY_DURATION etc.) so
+call sites hold direct references — no registry lookup on the hot path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+
+class Histogram:
+    """One fixed-bucket histogram: cumulative `le` buckets + sum/count,
+    rendered in Prometheus text exposition format."""
+
+    def __init__(self, name: str, help_text: str, buckets):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._mu = threading.Lock()
+        # per-bucket increments (cumulated at render time) + +Inf slot
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._mu:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count)."""
+        with self._mu:
+            counts = list(self._counts)
+            s, c = self._sum, self._count
+        cum = []
+        acc = 0
+        for n in counts:
+            acc += n
+            cum.append(acc)
+        return cum, s, c
+
+    def render(self) -> list[str]:
+        cum, s, c = self.snapshot()
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for le, n in zip(self.buckets, cum):
+            le_s = format(le, "g")
+            out.append(f'{self.name}_bucket{{le="{le_s}"}} {n}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum[-1]}')
+        out.append(f"{self.name}_sum {format(s, 'g')}")
+        out.append(f"{self.name}_count {c}")
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+
+_registry: dict[str, Histogram] = {}
+_registry_mu = threading.Lock()
+
+
+def histogram(name: str, help_text: str, buckets) -> Histogram:
+    with _registry_mu:
+        h = _registry.get(name)
+        if h is None:
+            h = _registry[name] = Histogram(name, help_text, buckets)
+        return h
+
+
+def render_all() -> list[str]:
+    with _registry_mu:
+        hs = sorted(_registry.values(), key=lambda h: h.name)
+    out = []
+    for h in hs:
+        out.extend(h.render())
+    return out
+
+
+def names() -> set:
+    with _registry_mu:
+        return set(_registry)
+
+
+def reset_all() -> None:
+    with _registry_mu:
+        hs = list(_registry.values())
+    for h in hs:
+        h.reset()
+
+
+# ---- the standard query-path instruments ----
+
+QUERY_DURATION = histogram(
+    "vl_query_duration_seconds",
+    "end-to-end /select query execution time",
+    (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+     1.0, 2.5, 5.0, 10.0, 30.0))
+
+DISPATCH_RTT = histogram(
+    "vl_tpu_dispatch_rtt_seconds",
+    "device dispatch round trip: submit to harvested result "
+    "(async window units and per-leaf scans)",
+    (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+     0.05, 0.1, 0.25, 0.5, 1.0))
+
+HOST_SYNC_WAIT = histogram(
+    "vl_tpu_host_sync_wait_seconds",
+    "time blocked materializing one dispatch result on the host "
+    "(the window's single harvest sync point)",
+    (0.00001, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+     0.025, 0.05, 0.1, 0.5))
+
+PACK_SIZE = histogram(
+    "vl_tpu_pack_size_parts",
+    "parts per pipeline dispatch unit (1 = unpacked part)",
+    (1, 2, 3, 4, 6, 8, 12, 16, 32))
+
+PRUNE_RATIO = histogram(
+    "vl_tpu_bloom_prune_ratio",
+    "fraction of probed candidate blocks killed per bloom keep-mask "
+    "probe (the filter-index kill path)",
+    (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))

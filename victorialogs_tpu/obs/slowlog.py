@@ -1,0 +1,76 @@
+"""Slow-query log: one structured JSON line per query over the
+VL_SLOW_QUERY_MS threshold (default: off).
+
+When the threshold is armed, the query handlers force tracing on for
+every query (the no-op path costs nothing when the log is off, and a
+slow query without a trace is exactly the situation the log exists to
+avoid), so the emitted line carries the flattened per-stage summary:
+
+    {"msg": "slow query", "endpoint": "/select/logsql/query",
+     "duration_ms": 812.4, "threshold_ms": 500.0, "query": "...",
+     "trace": {"query": {"count": 1, "total_ms": 812.4},
+               "harvest": {"count": 9, "total_ms": 617.0}, ...},
+     "attrs": {...root span counters...}, "ts": "..."}
+
+Lines go to stderr by default (the single binary's log stream); tests
+inject their own sink via set_sink().
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_sink = None
+
+
+def set_sink(fn) -> None:
+    """Test hook: fn(line_str) replaces the stderr write (None resets)."""
+    global _sink
+    _sink = fn
+
+
+def threshold_ms() -> float | None:
+    """The armed threshold, or None when the log is off."""
+    v = os.environ.get("VL_SLOW_QUERY_MS", "")
+    if v == "":
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def enabled() -> bool:
+    return threshold_ms() is not None
+
+
+def maybe_log(endpoint: str, query: str, duration_s: float,
+              root=None) -> bool:
+    """Emit the slow-query line when duration exceeds the threshold.
+    Returns True when a line was emitted (test convenience)."""
+    thr = threshold_ms()
+    if thr is None or duration_s * 1e3 < thr:
+        return False
+    rec = {
+        "msg": "slow query",
+        "endpoint": endpoint,
+        "duration_ms": round(duration_s * 1e3, 3),
+        "threshold_ms": thr,
+        "query": query,
+        # vlint: allow-wall-clock(log-line timestamp is real wall time)
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if root is not None and getattr(root, "enabled", False):
+        rec["trace"] = root.flatten()
+        if root.attrs:
+            rec["attrs"] = root.attrs
+    line = json.dumps(rec, ensure_ascii=False, separators=(",", ":"))
+    sink = _sink
+    if sink is not None:
+        sink(line)
+    else:
+        sys.stderr.write(line + "\n")
+    return True

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import tracing
 from ..tpu import kernels as K
 from ..tpu.batch import BatchRunner
 
@@ -216,9 +217,20 @@ class MeshBatchRunner(BatchRunner):
         # non-row args
         return jax.device_put(arr, self._replicated)
 
+    def _trace_collective(self) -> None:
+        """Mesh attribution on the active trace: fused dispatches here
+        are ONE collective program over every device (psum/pmin/pmax
+        over ICI), which a trace reader must be able to tell apart from
+        the single-chip dispatch counts."""
+        sp = tracing.current_span()
+        if sp.enabled:
+            sp.add("mesh_collective_dispatches")
+            sp.set("mesh_devices", self.ndev)
+
     def _dispatch_fused(self, prog, strides, nb, n_values, nrows,
                         cand_packed, ids_tuple, values_tuple, args):
         from ..tpu.fused import _fused_dispatch_mesh
+        self._trace_collective()
         return _fused_dispatch_mesh(self.mesh, BLOCK_AXIS, prog, strides,
                                     nb, n_values, nrows, cand_packed,
                                     ids_tuple, values_tuple, args)
@@ -233,6 +245,7 @@ class MeshBatchRunner(BatchRunner):
         # exactly like the single-chip runner: submission issues the
         # collective dispatch, harvest materializes in order.
         from ..tpu.fused import _filter_dispatch_mesh
+        self._trace_collective()
         return _filter_dispatch_mesh(self.mesh, BLOCK_AXIS, prog, nrows,
                                      cand_packed, args)
 

@@ -14,6 +14,7 @@ import gzip
 import io
 import json
 import os
+import re
 import threading
 import time
 import urllib.parse
@@ -21,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 from ..engine.searcher import QueryTimeoutError
+from ..obs import hist
 from ..storage.storage import Storage
 from ..utils.memory import QueryMemoryError
 from .insertutil import (CommonParams, LocalLogRowsStorage,
@@ -33,8 +35,43 @@ from .vlselect import (HTTPError, handle_facets, handle_field_names,
                        handle_stream_ids, handle_streams, handle_tail)
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def metric_name(base: str, **labels) -> str:
+    """`base{k="escaped v",...}` — the ONE place sample names with
+    labels are built, so arbitrary request strings (paths, types) can
+    never corrupt the exposition format."""
+    if not labels:
+        return base
+    inner = ",".join(f'{k}="{escape_label_value(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return f"{base}{{{inner}}}"
+
+
+# full sample name -> (base, "{labels}" or "")
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?$")
+
+# endpoints whose wall time IS a query execution (vl_query_duration_
+# seconds); excludes /tail (connection lifetime) and introspection
+_QUERY_DURATION_PATHS = frozenset((
+    "/select/logsql/query", "/select/logsql/hits",
+    "/select/logsql/facets", "/select/logsql/stats_query",
+    "/select/logsql/stats_query_range"))
+
+
 class Metrics:
-    """Tiny Prometheus-text metrics registry."""
+    """Prometheus-text metrics registry.
+
+    render() emits VALID exposition text: every metric gets exactly one
+    `# TYPE` line with all its samples grouped directly under it,
+    label values ride escape_label_value, duplicate sample names merge
+    by summation (a registry counter colliding with a runner counter
+    must not emit the same series twice), and the obs.hist histograms
+    render with `# HELP`/`# TYPE histogram` + cumulative `le` buckets.
+    tests/test_obs.py validates the output with a small parser."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -44,36 +81,73 @@ class Metrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + delta
 
+    @staticmethod
+    def _split(name: str) -> tuple[str, str]:
+        m = _SAMPLE_RE.match(name)
+        if m is None:
+            # defensive: a malformed stored name becomes a label so the
+            # exposition stays parseable
+            return "vl_invalid_metric_name", \
+                "{name=\"" + escape_label_value(name) + "\"}"
+        return m.group(1), m.group(2) or ""
+
     def render(self, storage: Storage, runner=None) -> str:
-        out = []
+        # base name -> {labels_str -> value}; insertion-ordered so each
+        # metric's samples stay contiguous under its TYPE line
+        metrics: dict[str, dict[str, float]] = {}
+
+        def add(name: str, v) -> None:
+            base, labels = self._split(name)
+            series = metrics.setdefault(base, {})
+            series[labels] = series.get(labels, 0) + v
+
         with self._lock:
             for name in sorted(self.counters):
-                out.append(f"{name} {self.counters[name]}")
+                add(name, self.counters[name])
         if runner is not None and hasattr(runner, "stats"):
             # device-runner counters incl. the async pipeline's
             # (dispatches issued, packed parts, in-flight high-water
             # mark, host-sync wait — tpu/batch.py BatchRunner.stats)
             for name, v in sorted(runner.stats().items()):
-                out.append(f"vl_tpu_{name} {v}")
+                add(f"vl_tpu_{name}", v)
+        # filter-index host-plane budget occupancy (storage/filterbank)
+        from ..storage.filterbank import bank_stats
+        bs = bank_stats()
+        add("vl_tpu_bloom_bank_used_bytes", bs["used_bytes"])
+        add("vl_tpu_bloom_bank_max_bytes", bs["max_bytes"])
         s = storage.update_stats()
         gauges = {
             "vl_partitions": s["partitions"],
             "vl_streams_created_total": s["streams"],
-            "vl_storage_rows{type=\"inmemory\"}": s["inmemory_rows"],
-            "vl_storage_rows{type=\"file\"}": s["file_rows"],
-            "vl_storage_parts{type=\"inmemory\"}": s["inmemory_parts"],
-            "vl_storage_parts{type=\"small\"}": s["small_parts"],
-            "vl_storage_parts{type=\"big\"}": s["big_parts"],
+            metric_name("vl_storage_rows", type="inmemory"):
+                s["inmemory_rows"],
+            metric_name("vl_storage_rows", type="file"): s["file_rows"],
+            metric_name("vl_storage_parts", type="inmemory"):
+                s["inmemory_parts"],
+            metric_name("vl_storage_parts", type="small"):
+                s["small_parts"],
+            metric_name("vl_storage_parts", type="big"): s["big_parts"],
             "vl_data_size_bytes": s["compressed_size"],
             "vl_uncompressed_data_size_bytes": s["uncompressed_size"],
-            "vl_rows_dropped_total{reason=\"too_old\"}":
+            metric_name("vl_rows_dropped_total", reason="too_old"):
                 s["rows_dropped_too_old"],
-            "vl_rows_dropped_total{reason=\"too_new\"}":
+            metric_name("vl_rows_dropped_total", reason="too_new"):
                 s["rows_dropped_too_new"],
             "vl_storage_is_read_only": int(s["is_read_only"]),
         }
         for name, v in gauges.items():
-            out.append(f"{name} {v}")
+            add(name, v)
+
+        out = []
+        for base, series in metrics.items():
+            kind = "counter" if base.endswith("_total") else "gauge"
+            out.append(f"# TYPE {base} {kind}")
+            for labels, v in series.items():
+                # ints render exactly (byte budgets overflow %g), floats
+                # compactly
+                v_s = str(v) if isinstance(v, int) else format(v, ".9g")
+                out.append(f"{base}{labels} {v_s}")
+        out.extend(hist.render_all())
         return "\n".join(out) + "\n"
 
 
@@ -420,7 +494,7 @@ class VLServer(BaseHTTPApp):
     def handle_select(self, h, path, args, headers) -> None:
         s = self.query_storage
         m = self.metrics
-        m.inc("vl_http_requests_total{path=\"" + path + "\"}")
+        m.inc(metric_name("vl_http_requests_total", path=path))
         t0 = time.monotonic()
         if path == "/select/logsql/query":
             gen = handle_query(s, args, headers, runner=self.runner)
@@ -464,5 +538,11 @@ class VLServer(BaseHTTPApp):
                 stop["flag"] = True
         else:
             raise HTTPError(404, f"unknown select path {path}")
-        m.inc("vl_http_request_duration_ms_total{path=\"" + path + "\"}",
-              int((time.monotonic() - t0) * 1000))
+        dt = time.monotonic() - t0
+        m.inc(metric_name("vl_http_request_duration_ms_total", path=path),
+              int(dt * 1000))
+        if path in _QUERY_DURATION_PATHS:
+            # only query EXECUTION endpoints: a /tail connection's
+            # lifetime or a cheap introspection call would drown the
+            # distribution the histogram exists to show
+            hist.QUERY_DURATION.observe(dt)

@@ -32,6 +32,7 @@ import urllib.request
 
 from ..engine.block_result import BlockResult
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
+from ..obs import tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
@@ -211,12 +212,23 @@ def handle_internal_select(storage, args, runner=None):
         return write_frame({"cols": cols, "ts": br.timestamps})
 
     deadline = query_deadline(args)
+    # the frontend forwards ?trace=1: this node traces its own
+    # execution and ships the tree back as the stream's last frame,
+    # which the frontend attaches under its per-node span
+    root = tracing.make_root("storage_node_query", query=qs) \
+        if args.get("trace") == "1" else None
+
+    def run(sink):
+        # the query executes on streamwork's worker thread: activate the
+        # trace THERE (contextvars don't cross thread spawns)
+        with tracing.activate(root):
+            run_query(storage, tenants, q, write_block=sink,
+                      runner=runner, deadline=deadline)
 
     def gen():
-        yield from stream_blocks(
-            lambda sink: run_query(storage, tenants, q, write_block=sink,
-                                   runner=runner, deadline=deadline),
-            encode)
+        yield from stream_blocks(run, encode)
+        if root is not None:
+            yield write_frame({"trace": root.to_dict()})
         yield END_FRAME
     return gen()
 
@@ -377,6 +389,11 @@ class NetSelectStorage:
         remaining_s = None
         if deadline is not None:
             remaining_s = max(deadline - time.monotonic(), 0.001)
+        # scatter-gather tracing: each node fetch gets a child span under
+        # the caller's trace, and nodes ship their own span tree back as
+        # the stream's final frame, attached under that child — one
+        # merged tree for the whole cluster query
+        parent_span = tracing.current_span()
 
         def fetch(url: str):
             from urllib.parse import urlencode
@@ -393,6 +410,8 @@ class NetSelectStorage:
             }
             if remaining_s is not None:
                 form["timeout"] = f"{remaining_s:.3f}s"
+            if parent_span.enabled:
+                form["trace"] = "1"
             body = urlencode(form).encode("utf-8")
             req = urllib.request.Request(
                 f"{url}/internal/select/query", data=body, method="POST")
@@ -401,21 +420,35 @@ class NetSelectStorage:
             http_timeout = self.timeout if remaining_s is None else \
                 min(self.timeout, remaining_s + 5.0)
             try:
-                with urllib.request.urlopen(
-                        req, timeout=http_timeout) as resp:
-                    if resp.status != 200:
-                        raise IOError(f"{url}: HTTP {resp.status}")
-                    for frame in read_frames(resp):
-                        if stop.is_set():
-                            return
-                        br = BlockResult.from_columns(
-                            frame.get("cols") or {},
-                            timestamps=frame.get("ts"))
-                        with lock:
-                            head.write_block(br)
-                            if head.is_done():
-                                stop.set()
+                with tracing.use_span(parent_span), \
+                        tracing.current_span().span("storage_node",
+                                                    url=url) as nsp:
+                    with urllib.request.urlopen(
+                            req, timeout=http_timeout) as resp:
+                        if resp.status != 200:
+                            raise IOError(f"{url}: HTTP {resp.status}")
+                        for frame in read_frames(resp):
+                            if stop.is_set():
+                                # abandoning the stream also abandons
+                                # the node's trailing trace frame — the
+                                # cancellation (which aborts the node's
+                                # query) outranks trace completeness,
+                                # so the cut is marked instead
+                                nsp.set("trace_truncated", True)
                                 return
+                            if "trace" in frame:
+                                nsp.attach(frame["trace"])
+                                continue
+                            br = BlockResult.from_columns(
+                                frame.get("cols") or {},
+                                timestamps=frame.get("ts"))
+                            nsp.add("blocks_received")
+                            with lock:
+                                head.write_block(br)
+                                if head.is_done():
+                                    stop.set()
+                                    nsp.set("trace_truncated", True)
+                                    return
             # collected errors re-raise on the caller thread after join
             # vlint: allow-broad-except(fan-out error channel)
             except Exception as e:

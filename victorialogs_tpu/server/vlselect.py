@@ -15,6 +15,7 @@ import time
 from ..engine.block_result import format_rfc3339
 from ..engine.searcher import (get_field_names, get_field_values, run_query,
                                run_query_collect)
+from ..obs import slowlog, tracing
 from ..logsql.duration import parse_duration, ts_bounds
 from ..logsql.parser import (MAX_TS, MIN_TS, ParseError, Query, parse_query,
                              parse_filter_string)
@@ -125,10 +126,49 @@ def _int_arg(args, name, default=0) -> int:
         raise HTTPError(400, f"invalid {name} arg {v!r}")
 
 
+# ---------------- tracing plumbing (?trace=1 / slow-query log) ----------------
+
+def want_trace(args) -> bool:
+    return args.get("trace", "") in ("1", "true", "yes")
+
+
+def _trace_root(args, q: Query):
+    """A root span when the request asked for a trace OR the slow-query
+    log is armed (a slow query without a trace is exactly what the log
+    exists to avoid); None keeps the zero-cost no-op path."""
+    if want_trace(args) or slowlog.enabled():
+        return tracing.make_root("query", query=q.to_string())
+    return None
+
+
+def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
+    """run_query_collect under an optional trace; returns (rows, tree)
+    where tree is the span-tree dict only when the request asked for
+    it.  Emits the slow-query line either way."""
+    root = _trace_root(args, q)
+    t0 = time.monotonic()
+    try:
+        with tracing.activate(root):
+            rows = run_query_collect(storage, tenants, q, runner=runner,
+                                     deadline=query_deadline(args))
+    finally:
+        # in finally: the slowest queries are exactly the ones that die
+        # on the deadline — they must still produce their slow-log line
+        slowlog.maybe_log(endpoint, q.to_string(),
+                          time.monotonic() - t0, root)
+    tree = root.to_dict() if root is not None and want_trace(args) \
+        else None
+    return rows, tree
+
+
 # ---------------- /select/logsql/query ----------------
 
 def handle_query(storage, args, headers, runner=None):
-    """Returns an iterator of NDJSON chunks."""
+    """Returns an iterator of NDJSON chunks.
+
+    With ?trace=1 the row lines are bit-identical to the untraced
+    response; ONE extra final line carries the span tree as
+    {"_trace": {...}}."""
     q, tenants = parse_common_args(storage, args, headers)
     limit = _int_arg(args, "limit", 1000)
     offset = _int_arg(args, "offset", 0)
@@ -148,11 +188,33 @@ def handle_query(storage, args, headers, runner=None):
                                   separators=(",", ":")))
         return "\n".join(out) + "\n" if out else None
 
-    return stream_blocks(
-        lambda sink: run_query(storage, tenants, q, write_block=sink,
-                               runner=runner,
-                               deadline=query_deadline(args)),
-        encode)
+    root = _trace_root(args, q)
+    deadline = query_deadline(args)
+
+    def run(sink):
+        # the query executes on streamwork's worker thread: activate
+        # the trace THERE (contextvars don't cross thread spawns); the
+        # activation also closes the root on every exit path
+        with tracing.activate(root):
+            run_query(storage, tenants, q, write_block=sink,
+                      runner=runner, deadline=deadline)
+
+    def gen():
+        t0 = time.monotonic()
+        try:
+            yield from stream_blocks(run, encode)
+        finally:
+            # in finally: deadline kills (QueryTimeoutError re-raised
+            # from the worker) and client disconnects (GeneratorExit at
+            # the yield) are exactly the slow queries the log is for
+            slowlog.maybe_log("/select/logsql/query", q.to_string(),
+                              time.monotonic() - t0, root)
+        if root is not None and want_trace(args):
+            yield json.dumps({"_trace": root.to_dict()},
+                             ensure_ascii=False,
+                             separators=(",", ":")) + "\n"
+
+    return gen()
 
 
 # ---------------- /select/logsql/hits ----------------
@@ -172,8 +234,8 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
     fn = sf.StatsCount([])
     fn.out_name = "hits"
     q.pipes.append(PipeStats(by, [fn]))
-    rows = run_query_collect(storage, tenants, q, runner=runner,
-                             deadline=query_deadline(args))
+    rows, trace_tree = _run_collect_traced(storage, tenants, q, args,
+                                           runner, "/select/logsql/hits")
     groups: dict = {}
     for r in rows:
         key = tuple((f, r.get(f, "")) for f in fields)
@@ -183,8 +245,11 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
         hits = int(r.get("hits", "0"))
         g["values"].append(hits)
         g["total"] += hits
-    return {"hits": sorted(groups.values(),
-                           key=lambda g: -g["total"])}
+    out = {"hits": sorted(groups.values(),
+                          key=lambda g: -g["total"])}
+    if trace_tree is not None:
+        out["trace"] = trace_tree
+    return out
 
 
 # ---------------- /select/logsql/facets ----------------
@@ -197,14 +262,17 @@ def handle_facets(storage, args, headers, runner=None) -> dict:
         max_values_per_field=_int_arg(args, "max_values_per_field", 1000),
         max_value_len=_int_arg(args, "max_value_len", 1000),
         keep_const_fields=bool(args.get("keep_const_fields", ""))))
-    rows = run_query_collect(storage, tenants, q, runner=runner,
-                             deadline=query_deadline(args))
+    rows, trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner, "/select/logsql/facets")
     out: dict[str, list] = {}
     for r in rows:
         out.setdefault(r["field_name"], []).append(
             {"field_value": r["field_value"], "hits": int(r["hits"])})
-    return {"facets": [{"field_name": f, "values": v}
-                       for f, v in sorted(out.items())]}
+    res = {"facets": [{"field_name": f, "values": v}
+                      for f, v in sorted(out.items())]}
+    if trace_tree is not None:
+        res["trace"] = trace_tree
+    return res
 
 
 # ---------------- field/stream introspection ----------------
@@ -286,8 +354,8 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     sp = _require_stats_query(q)
     ts = _parse_time_arg(args.get("time", ""), time.time_ns(), end=True)
-    rows = run_query_collect(storage, tenants, q, runner=runner,
-                             deadline=query_deadline(args))
+    rows, trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner, "/select/logsql/stats_query")
     result = []
     by_names = [b.name for b in sp.by]
     for r in rows:
@@ -298,8 +366,11 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
                     metric[n] = r[n]
             result.append({"metric": metric,
                            "value": [ts / 1e9, r.get(fn.out_name, "")]})
-    return {"status": "success",
-            "data": {"resultType": "vector", "result": result}}
+    out = {"status": "success",
+           "data": {"resultType": "vector", "result": result}}
+    if trace_tree is not None:
+        out["trace"] = trace_tree
+    return out
 
 
 def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
@@ -310,8 +381,9 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
         raise HTTPError(400, f"invalid step {step!r}")
     if not any(b.name == "_time" for b in sp.by):
         sp.by.insert(0, ByField("_time", bucket=step))
-    rows = run_query_collect(storage, tenants, q, runner=runner,
-                             deadline=query_deadline(args))
+    rows, trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner,
+        "/select/logsql/stats_query_range")
     series: dict = {}
     by_names = [b.name for b in sp.by if b.name != "_time"]
     from ..engine.block_result import parse_rfc3339
@@ -327,9 +399,12 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
             s["values"].append([t / 1e9, r.get(fn.out_name, "")])
     for s in series.values():
         s["values"].sort()
-    return {"status": "success",
-            "data": {"resultType": "matrix",
-                     "result": list(series.values())}}
+    out = {"status": "success",
+           "data": {"resultType": "matrix",
+                    "result": list(series.values())}}
+    if trace_tree is not None:
+        out["trace"] = trace_tree
+    return out
 
 
 # ---------------- live tail ----------------

@@ -38,6 +38,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..obs import hist, tracing
 from ..utils.hashing import cached_token_hashes
 from .bloom import (BLOOM_HASHES, bloom_contains_all,
                     bloom_probe_positions_multi)
@@ -78,6 +79,13 @@ def _bank_release(charges: list) -> None:
     with _bank_mu:
         _bank_bytes -= sum(charges)
         charges.clear()
+
+
+def bank_stats() -> dict:
+    """Occupancy of the global host bloom-plane budget, for /metrics
+    (vl_tpu_bloom_bank_used_bytes / vl_tpu_bloom_bank_max_bytes)."""
+    with _bank_mu:
+        return {"used_bytes": _bank_bytes, "max_bytes": _BANK_MAX_BYTES}
 
 
 @dataclass
@@ -356,7 +364,7 @@ def _build_aggregate(part, field: str,
 # ---------------- query-path entry points ----------------
 
 def bloom_keep_mask(part, field: str, hashes: np.ndarray,
-                    bis=None) -> np.ndarray:
+                    bis=None, observe: bool = True) -> np.ndarray:
     """THE bloom kill-path: bool keep-mask over `bis` (or all blocks),
     True where the block may contain ALL tokens (or has no bloom).
 
@@ -370,14 +378,18 @@ def bloom_keep_mask(part, field: str, hashes: np.ndarray,
     header groups) and charges the bank budget, so it only pays when
     the probed candidate set covers a sizable fraction of the part —
     the same coverage gate the searcher applies to aggregate builds;
-    narrow probes ride an already-built plane or the per-block loop."""
+    narrow probes ride an already-built plane or the per-block loop.
+
+    observe=False skips the prune-ratio histogram and trace counters:
+    the prefetcher probes the same (part, field, bis) the evaluator
+    will re-probe at dispatch — only the dispatch probe counts."""
     fb = filter_bank(part)
     pl = fb.cached_plane(field)
     if pl is None and (bis is None
                        or len(bis) * 4 >= part.num_blocks):
         pl = fb.plane(part, field)
     if pl is not None:
-        return pl.keep_mask(hashes, bis)
+        return _observe_keep(pl.keep_mask(hashes, bis), observe)
     idxs = list(bis) if bis is not None else list(range(part.num_blocks))
     keep = np.ones(len(idxs), dtype=bool)
     if len(hashes) == 0:
@@ -387,6 +399,21 @@ def bloom_keep_mask(part, field: str, hashes: np.ndarray,
         if w is not None and w.shape[0] and \
                 not bloom_contains_all(w, hashes):
             keep[k] = False
+    return _observe_keep(keep, observe)
+
+
+def _observe_keep(keep: np.ndarray, observe: bool = True) -> np.ndarray:
+    """Per-probe prune accounting: the kill fraction feeds the
+    vl_tpu_bloom_prune_ratio histogram, and an active trace's ambient
+    span gets blocks_probed_bloom / blocks_killed_bloom counters."""
+    n = int(keep.shape[0])
+    if n and observe:
+        killed = n - int(keep.sum())
+        hist.PRUNE_RATIO.observe(killed / n)
+        sp = tracing.current_span()
+        if sp.enabled:
+            sp.add("blocks_probed_bloom", n)
+            sp.add("blocks_killed_bloom", killed)
     return keep
 
 
@@ -410,5 +437,9 @@ def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
             fb.cached_aggregate(field)
         if agg is not None and \
                 not agg.may_contain_all(cached_token_hashes(f, tokens)):
+            sp = tracing.current_span()
+            if sp.enabled:
+                sp.add("parts_pruned_aggregate")
+                sp.set("last_aggregate_prune_field", field)
             return True
     return False

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..engine.block_search import BlockSearch
 from ..logsql import filters as F
+from ..obs import hist
 from ..storage.filterbank import bloom_keep_mask
 from ..storage.values_encoder import VT_DICT, VT_STRING
 from ..utils.hashing import cached_token_hashes
@@ -919,6 +920,26 @@ class BatchRunner:
             }
         out.update({f"staging_cache_{k}": v
                     for k, v in self.cache.stats().items()})
+        with self._pack_mu:
+            out["pack_cache_entries"] = len(self._packs)
+        # cost-model calibration gauges (ROADMAP "RTT-aware auto depth"
+        # baseline signal): read raw fields, NEVER measured_rtt() — a
+        # /metrics scrape must not trigger the lazy RTT probe dispatch
+        out["cost_rtt_seconds"] = self.cost.rtt or 0.0
+        out["cost_dev_bytes_per_s"] = self.cost.dev_bytes_per_s or 0.0
+        if self.cost.rtt is not None:
+            from .pipeline import pack_rows_cap
+            cap = pack_rows_cap(self)
+        else:
+            # RTT not yet measured: report only an explicit VALID
+            # override (a malformed value would make pack_rows_cap fall
+            # through to measured_rtt and dispatch to the device from a
+            # /metrics scrape)
+            try:
+                cap = max(1, int(os.environ.get("VL_PACK_MAX_ROWS", "")))
+            except ValueError:
+                cap = 0
+        out["pack_rows_cap"] = cap
         return out
 
     def _prefetcher(self):
@@ -967,63 +988,18 @@ class BatchRunner:
         (layout-coordinate columns + timestamp planes — what the
         windowed pipeline dispatches, including packed super-parts)
         instead of the per-leaf string staging."""
+        from ..obs import tracing
+        # staging runs on the vl-prefetch worker: re-enter the caller's
+        # span there so staged_entries/staged_bytes attribution isn't
+        # silently dropped on the dominant (prefetched) path; attrs are
+        # lock-guarded, so adds racing the final to_dict are safe
+        caller_span = tracing.current_span()
 
         def work():
             try:
-                bis = list(cand_bis) if cand_bis is not None else \
-                    list(range(part.num_blocks))
-                cand_rows = sum(part.block_rows(bi) for bi in bis)
-                if self._gate_host_est(
-                        f, part, cand_rows,
-                        stats_rows=cand_rows if stats_spec else 0):
-                    return     # the evaluator will take the host path
-                layout = None
-                if fused:
-                    from .stats_device import MAX_STAT_ROWS
-                    layout = self._stats_layout(part)
-                    if layout.nrows > MAX_STAT_ROWS:
-                        layout = None
-                    elif _tree_has_time(f):
-                        self._stage_ts_planes(part, layout)
-                for plan in device_plans(f):
-                    surv = bis
-                    if plan.bloom_tokens:
-                        hashes = cached_token_hashes(plan.filter,
-                                                     plan.bloom_tokens)
-                        keep = bloom_keep_mask(part, plan.field, hashes,
-                                               bis)
-                        surv = [bi for bi, k in zip(bis, keep) if k]
-                    if not surv:
-                        continue
-                    cand_rows = sum(part.block_rows(bi) for bi in surv)
-                    if layout is not None:
-                        # fused staging key (#fl) mirrors _scan_leaf's
-                        # narrowness gate
-                        if self.cache.contains(
-                                (part.uid, "#fl", plan.field)) or \
-                                cand_rows * 8 >= part.num_rows:
-                            self._stage_fused_field(part, plan.field,
-                                                    layout)
-                        continue
-                    if not self.cache.contains((part.uid, plan.field)) \
-                            and cand_rows * 8 < part.num_rows:
-                        continue  # evaluator will take the host path
-                    self.stage_part(part, plan.field)
-                if stats_spec is not None:
-                    from .stats_device import MAX_ABS_TIMES_ROWS, \
-                        MAX_BUCKETS, MAX_STAT_ROWS
-                    layout = self._stats_layout(part)
-                    if layout.nrows > MAX_STAT_ROWS:
-                        return
-                    for fld in stats_spec.value_fields:
-                        self._stage_numeric(part, fld, layout,
-                                            MAX_ABS_TIMES_ROWS)
-                    for bk in stats_spec.by:
-                        if bk.kind == "time":
-                            self._stage_buckets(part, layout, bk.step,
-                                                bk.offset, MAX_BUCKETS)
-                        else:
-                            self._stage_dict(part, bk.name, layout)
+                with tracing.use_span(caller_span):
+                    self._prefetch_work(part, f, stats_spec, cand_bis,
+                                        fused)
             # vlint: allow-broad-except(prefetch is best-effort)
             except Exception:
                 pass  # prefetch is best-effort; the scan path re-stages
@@ -1031,6 +1007,67 @@ class BatchRunner:
             self._prefetcher().submit(work)
         except RuntimeError:
             pass  # pool closed between return and submit; best-effort
+
+    def _prefetch_work(self, part, f, stats_spec, cand_bis,
+                       fused) -> None:
+        bis = list(cand_bis) if cand_bis is not None else \
+            list(range(part.num_blocks))
+        cand_rows = sum(part.block_rows(bi) for bi in bis)
+        if self._gate_host_est(
+                f, part, cand_rows,
+                stats_rows=cand_rows if stats_spec else 0):
+            return     # the evaluator will take the host path
+        layout = None
+        if fused:
+            from .stats_device import MAX_STAT_ROWS
+            layout = self._stats_layout(part)
+            if layout.nrows > MAX_STAT_ROWS:
+                layout = None
+            elif _tree_has_time(f):
+                self._stage_ts_planes(part, layout)
+        for plan in device_plans(f):
+            surv = bis
+            if plan.bloom_tokens:
+                hashes = cached_token_hashes(plan.filter,
+                                             plan.bloom_tokens)
+                # observe=False: the evaluator/planner re-probes this
+                # exact (part, field, bis) at dispatch — counting the
+                # prefetch warm-up too would double every histogram
+                # sample and trace counter
+                keep = bloom_keep_mask(part, plan.field, hashes,
+                                       bis, observe=False)
+                surv = [bi for bi, k in zip(bis, keep) if k]
+            if not surv:
+                continue
+            cand_rows = sum(part.block_rows(bi) for bi in surv)
+            if layout is not None:
+                # fused staging key (#fl) mirrors _scan_leaf's
+                # narrowness gate
+                if self.cache.contains(
+                        (part.uid, "#fl", plan.field)) or \
+                        cand_rows * 8 >= part.num_rows:
+                    self._stage_fused_field(part, plan.field,
+                                            layout)
+                continue
+            if not self.cache.contains((part.uid, plan.field)) \
+                    and cand_rows * 8 < part.num_rows:
+                continue  # evaluator will take the host path
+            self.stage_part(part, plan.field)
+        if stats_spec is not None:
+            from .stats_device import MAX_ABS_TIMES_ROWS, \
+                MAX_BUCKETS, MAX_STAT_ROWS
+            layout = self._stats_layout(part)
+            if layout.nrows > MAX_STAT_ROWS:
+                return
+            for fld in stats_spec.value_fields:
+                self._stage_numeric(part, fld, layout,
+                                    MAX_ABS_TIMES_ROWS)
+            for bk in stats_spec.by:
+                if bk.kind == "time":
+                    self._stage_buckets(part, layout, bk.step,
+                                        bk.offset, MAX_BUCKETS)
+                else:
+                    self._stage_dict(part, bk.name, layout)
 
     # ---- device placement hook (MeshBatchRunner shards the row axis) ----
     def _put(self, arr, row_axis: int = 0):
@@ -1840,4 +1877,7 @@ class BatchRunner:
             self._scan_sigs.add(sig)
         if pre_compiled:
             self.cost.observe_device_scan(spc.nbytes, elapsed)
+            # per-leaf dispatches are full round trips too; compile-time
+            # samples are excluded for the same poisoning reason
+            hist.DISPATCH_RTT.observe(elapsed)
         return out

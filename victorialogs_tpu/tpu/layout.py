@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import tracing
 from .kernels import pad_bucket
 
 
@@ -163,14 +164,22 @@ class StagingCache:
         return col.device_bytes() if hasattr(col, "device_bytes") else 4096
 
     def put(self, key: tuple, col) -> None:
+        cost = self._cost(col)
         with self._mu:
             if key in self._lru:
                 return
             self._lru[key] = col
-            self._bytes += self._cost(col)
+            self._bytes += cost
             while self._bytes > self.max_bytes and self._lru:
                 _, old = self._lru.popitem(last=False)
                 self._bytes -= self._cost(old)
+        # staging attribution on the active trace (noop when off); the
+        # insert above returned early on a duplicate, so this counts
+        # each staged value exactly once
+        sp = tracing.current_span()
+        if sp.enabled:
+            sp.add("staged_entries")
+            sp.add("staged_bytes", cost)
 
     def put_small(self, key: tuple, marker) -> None:
         """Cache a marker (e.g. 'this column is unstageable')."""

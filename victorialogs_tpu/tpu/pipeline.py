@@ -53,6 +53,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..obs import hist, tracing
 from .kernels import pad_bucket
 
 # adaptive pack-size clamps: parts below the floor always pack (the
@@ -474,7 +475,10 @@ def _make_sync(runner):
         # materializing a completed dispatch in submission order IS the
         # pipeline's output step; everything upstream stays async)
         out = np.asarray(arr)
-        runner._bump("host_sync_wait_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        runner._bump("host_sync_wait_s", dt)
+        hist.HOST_SYNC_WAIT.observe(dt)
+        tracing.current_span().add("host_sync_wait_s", dt)
         return out
 
     return sync
@@ -509,8 +513,10 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
         spec_seg = with_segment_axis(stats_spec)
 
     def emit(members: list) -> None:
+        sp = tracing.current_span()
         for m in members:
             if stats_spec is not None and m.partials:
+                sp.add("stats_partials", len(m.partials))
                 _absorb_stats_partials(head, q, stats_spec, m.partials)
             for bi, bs in m.blocks:
                 if bi in m.handled:
@@ -520,8 +526,10 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                 bm = m.bms[bi]
                 if not bm.any():
                     continue
-                head.write_block(
-                    BlockResult.from_block_search(bs, bm, needed))
+                br = BlockResult.from_block_search(bs, bm, needed)
+                sp.add("blocks_out")
+                sp.add("rows_downloaded", br.nrows)
+                head.write_block(br)
 
     stream = _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
                           sort_spec, token_leaves, check_deadline)
@@ -536,48 +544,101 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
     fused_pf = stats_spec is not None or (
         sort_spec is None and fused_filter_enabled()
         and runner.fused_enabled)
+    psp = tracing.current_span()
+    seq = 0
 
     def refill() -> None:
         # plan only the window's lookahead ahead of execution: an early
         # exit (limit hit, deadline) stops the header walk right where
         # the serial loop would have
         nonlocal exhausted
-        while not exhausted and len(lookahead) < depth + 1:
-            try:
-                lookahead.append(next(stream))
-            except StopIteration:
-                exhausted = True
+        if exhausted or len(lookahead) >= depth + 1:
+            return
+        # the planning pull IS the prune stage: candidate selection +
+        # part-aggregate kills run inside _unit_stream, so filterbank's
+        # prune counters land on this span
+        with psp.span("prune") as prsp:
+            planned = 0
+            while not exhausted and len(lookahead) < depth + 1:
+                try:
+                    lookahead.append(next(stream))
+                    planned += 1
+                except StopIteration:
+                    exhausted = True
+            prsp.set("units_planned", planned)
+
+    def harvest_one() -> None:
+        hseq, hunit, t_submit, pending = window.popleft()
+        with psp.span("harvest", unit=hseq) as hsp:
+            members = pending.harvest(sync)
+            # _UnitReady units never dispatched (host gate / serial
+            # fallback): their submit-to-harvest time is pure window
+            # queue wait and must not pollute the device-RTT histogram
+            dispatched = not isinstance(pending, _UnitReady)
+            rtt = time.perf_counter() - t_submit
+            if dispatched:
+                hist.DISPATCH_RTT.observe(rtt)
+            if hsp.enabled:
+                if dispatched:
+                    hsp.set("dispatch_rtt_s", round(rtt, 6))
+                else:
+                    hsp.set("host_unit", True)
+                if hunit.pack:
+                    hsp.set("pack_members",
+                            [str(p.uid) for p, _b in hunit.members])
+            emit(members)
 
     try:
-        while True:
-            refill()
-            if not lookahead:
-                break
-            unit = lookahead.popleft()
-            check_deadline()
-            if head.is_done():
-                raise QueryCancelled()
-            # deepened prefetch: stage every unit inside the window's
-            # lookahead, so part N+k's host decode/upload overlaps the
-            # scans of N..N+k-1 (packs prefetch as the pack, hitting the
-            # same #fl/#num staging keys the super-dispatch will use)
-            for uj in lookahead:
-                if uj.part.uid in prefetched:
-                    continue
-                prefetched.add(uj.part.uid)
-                runner.submit_prefetch(uj.part, f, stats_spec,
-                                       cand_bis=list(uj.bss),
-                                       fused=fused_pf)
-            while len(window) >= depth:
+        with psp.span("pipeline", inflight_depth=depth) as plsp:
+            psp = plsp
+            while True:
+                refill()
+                if not lookahead:
+                    break
+                unit = lookahead.popleft()
                 check_deadline()
-                emit(window.popleft().harvest(sync))
-            runner._bump("pipeline_units")
-            window.append(_submit(runner, f, unit, stats_spec, sort_spec,
-                                  spec_seg))
-            runner._bump_max("inflight_hwm", len(window))
-        while window:
-            check_deadline()
-            emit(window.popleft().harvest(sync))
+                if head.is_done():
+                    raise QueryCancelled()
+                # deepened prefetch: stage every unit inside the
+                # window's lookahead, so part N+k's host decode/upload
+                # overlaps the scans of N..N+k-1 (packs prefetch as the
+                # pack, hitting the same #fl/#num staging keys the
+                # super-dispatch will use)
+                todo = [uj for uj in lookahead
+                        if uj.part.uid not in prefetched]
+                if todo:
+                    with psp.span("stage", units=len(todo)):
+                        for uj in todo:
+                            prefetched.add(uj.part.uid)
+                            runner.submit_prefetch(uj.part, f, stats_spec,
+                                                   cand_bis=list(uj.bss),
+                                                   fused=fused_pf)
+                while len(window) >= depth:
+                    check_deadline()
+                    harvest_one()
+                runner._bump("pipeline_units")
+                hist.PACK_SIZE.observe(len(unit.members))
+                with psp.span("submit", unit=seq,
+                              blocks=len(unit.bss)) as ssp:
+                    if ssp.enabled:
+                        ssp.set("rows", sum(bs.nrows
+                                            for bs in unit.bss.values()))
+                        if unit.pack:
+                            ssp.set("pack_size", len(unit.members))
+                            ssp.set("pack_members",
+                                    [str(p.uid)
+                                     for p, _b in unit.members])
+                        else:
+                            ssp.set("part", str(unit.part.uid))
+                    window.append((seq, unit, time.perf_counter(),
+                                   _submit(runner, f, unit, stats_spec,
+                                           sort_spec, spec_seg)))
+                seq += 1
+                runner._bump_max("inflight_hwm", len(window))
+            while window:
+                check_deadline()
+                harvest_one()
+            plsp.set("units", seq)
     finally:
         # cancellation/deadline drain: drop in-flight handles without
         # writing anything downstream.  jax releases the device buffers
@@ -585,3 +646,4 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
         # a complete, budget-accounted value (staged under its key lock),
         # so the cache stays balanced for the next query.
         window.clear()
+        stream.close()
