@@ -5,7 +5,7 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test lint bench bench-bloom bench-pipeline clean
+.PHONY: all native test lint bench bench-bloom bench-pipeline bench-emit clean
 
 all: native
 
@@ -35,6 +35,11 @@ bench-bloom:
 # jax-CPU backend (fails under 4x dispatch cut / 1.5x wall — PERF.md)
 bench-pipeline:
 	python tools/bench_pipeline.py --json BENCH_pipeline.json
+
+# emit phase: per-row dicts + json.dumps vs the columnar native NDJSON
+# path on the 32x2048 bench shape (fails under 2x — PERF.md)
+bench-emit:
+	python tools/bench_emit.py --json BENCH_emit.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

@@ -136,6 +136,40 @@ def test_window_counters(storage, monkeypatch):
     assert r2.inflight_hwm == 1             # serial window: one in flight
 
 
+def test_inflight_auto_depth(storage, monkeypatch):
+    """VL_INFLIGHT=auto: depth derives from the cost model's RTT/harvest
+    EWMAs, clamps to [2, 16], results stay bit-identical, and the chosen
+    depth is exposed as a counter."""
+    from victorialogs_tpu.tpu import pipeline
+    qs = 'error | fields _time, dur'
+    monkeypatch.setenv("VL_INFLIGHT", "4")
+    monkeypatch.setenv("VL_PACK_PARTS", "1")
+    runner = BatchRunner()
+    want = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                             runner=runner)
+    monkeypatch.setenv("VL_INFLIGHT", "auto")
+    # cold runner: calibration empty -> default depth, still valid
+    cold = BatchRunner()
+    assert pipeline.inflight_depth(cold) == 4
+    got = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                            runner=cold)
+    assert got == want
+    # warm: the first query fed the emit EWMA (wait-free host work ONLY
+    # — folding in the device_sync wait would contract the depth toward
+    # the clamp floor on high-RTT backends), so the derived depth is
+    # the clamped rtt/emit ratio and the counter exposes it
+    assert cold.cost.emit_ewma and cold.cost.emit_ewma > 0
+    depth = pipeline.inflight_depth(cold)
+    assert 2 <= depth <= 16
+    got2 = run_query_collect(storage, [TEN], qs, timestamp=T0,
+                             runner=cold)
+    assert got2 == want
+    assert 2 <= cold.stats()["inflight_auto_depth"] <= 16
+    # explicit integer always wins over auto-derivation
+    monkeypatch.setenv("VL_INFLIGHT", "3")
+    assert pipeline.inflight_depth(cold) == 3
+
+
 def test_packing_collapses_dispatches(storage, monkeypatch):
     """12 equal-sized small parts at VL_PACK_PARTS=8 -> 2 super-
     dispatches (8 + 4): >=4x fewer dispatches than the per-part walk,

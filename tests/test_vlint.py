@@ -441,6 +441,99 @@ def test_sanitizer_condition_wait_order():
     assert san._stack() == []
 
 
+# ---------------- per-row-emit (columnar emit discipline) ----------------
+
+EMIT_PATH = "victorialogs_tpu/server/mod.py"
+
+
+def test_per_row_emit_dumps_in_loop_flagged():
+    out = lint("""
+        import json
+        def encode(rows):
+            out = []
+            for r in rows:
+                out.append(json.dumps(r))
+            return out
+    """, path=EMIT_PATH)
+    assert "per-row-emit" in checkers(out)
+
+
+def test_per_row_emit_dumps_in_comprehension_flagged():
+    out = lint("""
+        import json
+        def encode(rows):
+            return "\\n".join(json.dumps(r) for r in rows)
+    """, path=EMIT_PATH)
+    assert "per-row-emit" in checkers(out)
+
+
+def test_per_row_emit_dict_comprehension_element_flagged():
+    # a dict per iteration with no .append() call at all
+    out = lint("""
+        def build(br, names):
+            return [{n: br.column(n)[i] for n in names}
+                    for i in range(br.nrows)]
+    """, path=EMIT_PATH)
+    assert "per-row-emit" in checkers(out)
+
+
+def test_per_row_emit_column_dict_clean():
+    # ONE dict of columns (dict comprehension not nested in a list
+    # comprehension) is the columnar shape — must not flag
+    out = lint("""
+        def build(br, names):
+            return {n: br.column(n) for n in names}
+    """, path=EMIT_PATH)
+    assert "per-row-emit" not in checkers(out)
+
+
+def test_per_row_emit_dict_append_flagged():
+    # incl. the `append = out.append` bound-method alias
+    out = lint("""
+        def build(br, names):
+            out = []
+            append = out.append
+            for i in range(br.nrows):
+                append({n: br.column(n)[i] for n in names})
+            return out
+    """, path=EMIT_PATH)
+    assert "per-row-emit" in checkers(out)
+
+
+def test_per_row_emit_single_dumps_clean():
+    out = lint("""
+        import json
+        def encode(obj):
+            return json.dumps(obj)
+    """, path=EMIT_PATH)
+    assert "per-row-emit" not in checkers(out)
+
+
+def test_per_row_emit_scope_excludes_other_dirs():
+    src = """
+        import json
+        def encode(rows):
+            return [json.dumps(r) for r in rows]
+    """
+    assert "per-row-emit" not in checkers(
+        lint(src, path="victorialogs_tpu/logsql/mod.py"))
+    assert "per-row-emit" in checkers(
+        lint(src, path="victorialogs_tpu/engine/mod.py"))
+
+
+def test_per_row_emit_annotated():
+    out = lint("""
+        import json
+        def encode(rows):
+            out = []
+            for r in rows:
+                # vlint: allow-per-row-emit(cold admin endpoint)
+                out.append(json.dumps(r))
+            return out
+    """, path=EMIT_PATH)
+    assert "per-row-emit" not in checkers(out)
+
+
 # ---------------- the tier-1 gate + CLI ----------------
 
 def test_hotpath_covers_pipeline_module():

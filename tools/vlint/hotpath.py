@@ -15,6 +15,10 @@ flag the statically detectable cases:
   int/str literals (or tuples thereof) — unstable or unhashable
   statics retrigger compilation per call (the EWMA-poisoning
   compile-timing class of bug from the cost-gate hardening).
+- per-row-emit (server/ and engine/ scope): json.dumps calls or
+  dict-literal .append()s inside a loop — the per-row emit shape the
+  columnar path (engine/emit.ndjson_block + BlockResult.emit_columns)
+  replaced; cold paths carry `# vlint: allow-per-row-emit(<why>)`.
 """
 
 from __future__ import annotations
@@ -26,6 +30,8 @@ from .core import Finding, SourceFile
 from .locks import _dotted, _module_jit_names
 
 SCOPE_RE = re.compile(r"(^|/)(tpu|engine)(/|$)")
+# the emit-shape rule runs where response/row materialization lives
+EMIT_SCOPE_RE = re.compile(r"(^|/)(server|engine)(/|$)")
 
 # module names whose call results live on device in this repo
 _DEVICE_MODULE_HINTS = ("kernels", "fused", "stats_device", "sort_device")
@@ -240,10 +246,72 @@ def _check_jit_closure(fnode, sf, symbol, module_mutables,
                 f"mutable '{node.id}'"))
 
 
+def _check_per_row_emit(sf: SourceFile, findings: list) -> None:
+    """Flag the per-row emit shape inside loops: a json.dumps call per
+    iteration, or a dict literal/comprehension materialized per
+    iteration via .append()/.extend() — the exact pattern the columnar
+    emit path (engine/emit.ndjson_block over BlockResult.emit_columns)
+    replaced on the query hot path.  One finding per site, attributed
+    to the innermost loop."""
+    seen: set = set()
+
+    def flag(node, msg: str, symbol: str) -> None:
+        key = (node.lineno, node.col_offset, msg)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding("per-row-emit", sf.path, node.lineno,
+                                symbol, msg))
+
+    def scan_loop(loop, symbol: str) -> None:
+        # a dict literal/comprehension AS the element of a comprehension
+        # is a dict per iteration with no .append() call to catch below
+        if isinstance(loop, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            for x in ast.walk(loop.elt):
+                if isinstance(x, (ast.Dict, ast.DictComp)):
+                    flag(x, "per-row dict materialization inside a "
+                            "comprehension — build columns instead "
+                            "(BlockResult.emit_columns)", symbol)
+                    break
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if d in ("json.dumps", "dumps"):
+                flag(sub, "per-row json.dumps inside a loop — serialize "
+                          "columnar (engine/emit.ndjson_block)", symbol)
+            elif ((isinstance(sub.func, ast.Attribute)
+                   and sub.func.attr in ("append", "extend"))
+                  or (isinstance(sub.func, ast.Name)      # append = l.append
+                      and sub.func.id in ("append", "extend"))) \
+                    and sub.args \
+                    and any(isinstance(x, (ast.Dict, ast.DictComp))
+                            for x in ast.walk(sub.args[0])):
+                flag(sub, "per-row dict materialization inside a loop — "
+                          "build columns instead "
+                          "(BlockResult.emit_columns)", symbol)
+
+    def visit(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, (ast.For, ast.While, ast.ListComp,
+                                  ast.SetComp, ast.GeneratorExp)):
+                scan_loop(child, sym)
+            visit(child, sym)
+
+    visit(sf.tree, "")
+
+
 def check(sf: SourceFile) -> list[Finding]:
-    if not SCOPE_RE.search(sf.path):
-        return []
     findings: list[Finding] = []
+    if EMIT_SCOPE_RE.search(sf.path):
+        _check_per_row_emit(sf, findings)
+    if not SCOPE_RE.search(sf.path):
+        return findings
     tree = sf.tree
     jit_names = _module_jit_names(tree)
     dev_modules = _device_module_aliases(tree)

@@ -74,6 +74,51 @@ def _parse_rfc3339_uncached(s: str) -> int | None:
     return ns
 
 
+# ---- columnar emit helpers ----
+#
+# An emit column is a kind-tagged tuple (native.emit_ndjson_native):
+#   (0, arena uint8[], offsets int64[n], lengths int64[n])  bytes
+#   (1, ts int64[n])            RFC3339Nano timestamps (_time)
+#   (2, ts int64[n], frac_w)    ISO8601, fixed fractional width
+#   (3, nums int64[n])          signed decimal
+#   (4, nums uint64[n])         unsigned decimal
+# Typed kinds hand the storage's native arrays straight to the C
+# serializer — timestamp/decimal FORMATTING happens there, so the
+# Python side does nothing per row.  Length 0 on kind 0 means "omit
+# the field on this row".
+
+def _const_emit_col(v: str, n: int):
+    b = v.encode("utf-8")
+    return (0, np.frombuffer(b, dtype=np.uint8),
+            np.zeros(n, dtype=np.int64),
+            np.full(n, len(b), dtype=np.int64))
+
+
+def _pack_str_column(vals: list):
+    """Pack a Python string list (pipe-produced columns, rare encodings)
+    into a kind-0 emit column."""
+    n = len(vals)
+    bvals = [v.encode("utf-8") for v in vals]
+    lengths = np.fromiter(map(len, bvals), dtype=np.int64, count=n)
+    offsets = np.zeros(n, dtype=np.int64)
+    if n > 1:
+        np.cumsum(lengths[:-1], out=offsets[1:])
+    return (0, np.frombuffer(b"".join(bvals), dtype=np.uint8), offsets,
+            lengths)
+
+
+def _fixed_emit_col(sb: np.ndarray):
+    """Kind-0 emit column over a fixed-width ASCII bytes array
+    (astype('S...') output: values left-aligned, NUL padded — the
+    canonical float strings never contain NUL)."""
+    n = sb.shape[0]
+    w = sb.dtype.itemsize
+    mat = sb.view(np.uint8).reshape(n, w)
+    lengths = (mat != 0).sum(axis=1).astype(np.int64)
+    return (0, np.ascontiguousarray(mat).reshape(-1),
+            np.arange(n, dtype=np.int64) * w, lengths)
+
+
 class BlockResult:
     """A batch of result rows with lazily-materialized string columns.
 
@@ -90,6 +135,13 @@ class BlockResult:
         self._bs: BlockSearch | None = None
         self._sel: np.ndarray | None = None   # selected row indices into bs
         self._needed: set | None = None       # needed-columns restriction
+        # fields-pipe projection (restrict_fields): ordered output names.
+        # Unlike _needed (a scan-side hint), this is a HARD projection:
+        # names outside it read as "" exactly like the materialized copy
+        # the fields pipe used to build, but the block stays attached so
+        # the emit path keeps its typed columnar access.
+        self._restrict: list[str] | None = None
+        self._restrict_set: frozenset | None = None
         self._ts_list: list[int] | None = None
         self._ts_np: np.ndarray | None = None
         # numeric views of produced columns (e.g. math results): maps
@@ -146,6 +198,10 @@ class BlockResult:
 
     # ---- access ----
     def column(self, name: str) -> list[str]:
+        if self._restrict_set is not None and \
+                name not in self._restrict_set:
+            # projected-out field: absent, like the materialized copy
+            return [""] * self.nrows
         vals = self._cols.get(name)
         if vals is not None:
             return vals
@@ -160,6 +216,8 @@ class BlockResult:
         return vals
 
     def has_column(self, name: str) -> bool:
+        if self._restrict_set is not None:
+            return name in self._restrict_set
         if name in self._cols:
             return True
         return self._bs is not None and self._bs.has_column(name)
@@ -169,6 +227,9 @@ class BlockResult:
         or None — lets stats skip per-row string parsing (the reference
         keeps blockResult columns type-encoded for the same reason —
         block_result.go:26-63)."""
+        if self._restrict_set is not None and \
+                name not in self._restrict_set:
+            return None
         got = self._num_cols.get(name)
         if got is not None and self._cols.get(name) is got[0]:
             return got[1]
@@ -194,7 +255,8 @@ class BlockResult:
         stored strings (round-trip encodings — values_encoder.py) without
         ever materializing a Python string list
         (block_result.go:2149-2199)."""
-        if self._bs is None:
+        if self._bs is None or (self._restrict_set is not None
+                                and name not in self._restrict_set):
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,
@@ -217,7 +279,9 @@ class BlockResult:
         """The single value of a column KNOWN constant across this block
         (const columns; _stream/_stream_id are per-block constants by
         construction), or None."""
-        if self._bs is None or self.nrows == 0:
+        if self._bs is None or self.nrows == 0 or \
+                (self._restrict_set is not None
+                 and name not in self._restrict_set):
             return None
         c = self._bs.consts().get(name)
         if c is not None:
@@ -246,7 +310,8 @@ class BlockResult:
         """(selected dict ids uint8, dict value strings) for a
         dict-encoded column, or None — lets group-by factorize through
         the stored codes without materializing a per-row string list."""
-        if self._bs is None:
+        if self._bs is None or (self._restrict_set is not None
+                                and name not in self._restrict_set):
             return None
         from ..storage.values_encoder import VT_DICT
         if name in self._bs.consts() or name in ("_time", "_stream",
@@ -261,7 +326,8 @@ class BlockResult:
         """(min, max) of a numeric column from the BLOCK HEADER — no
         column payload read/decode (reference per-column min/max skips,
         block_result.go:26-63).  None for non-numeric/absent columns."""
-        if self._bs is None:
+        if self._bs is None or (self._restrict_set is not None
+                                and name not in self._restrict_set):
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,
@@ -277,6 +343,8 @@ class BlockResult:
         return float(mn), float(mx)
 
     def column_names(self) -> list[str]:
+        if self._restrict is not None:
+            return list(self._restrict)
         names: dict[str, None] = {}
         if self._bs is not None:
             if self._needed is None:
@@ -311,10 +379,45 @@ class BlockResult:
         out.nrows = self.nrows
         return out
 
+    def restrict_fields(self, fields: list[str]) -> "BlockResult":
+        """Project to exactly `fields` (in order) WITHOUT detaching from
+        the block: the semantic twin of materialize(fields) — names
+        outside the projection read as "" — but typed columnar access
+        (emit_columns, dict/numeric fast paths) survives for the names
+        kept.  The fields/delete pipes use this so storage-backed rows
+        reach the NDJSON emit sink without a per-row materialization."""
+        # dedupe keeping first position: materialize's dict comprehension
+        # collapsed `fields a, a` the same way, and duplicate names must
+        # not become duplicate JSON keys on the emit path
+        fields = list(dict.fromkeys(fields))
+        if self._bs is None:
+            return self.materialize(fields)
+        br = BlockResult(self.nrows)
+        br._bs = self._bs
+        br._sel = self._sel
+        br._restrict = fields
+        # chained projections only ever narrow: a name re-added by a
+        # later `fields` pipe after being dropped still reads ""
+        br._restrict_set = frozenset(br._restrict) \
+            if self._restrict_set is None \
+            else frozenset(br._restrict) & self._restrict_set
+        br._ts_np = self._ts_np
+        br._ts_list = self._ts_list
+        for n in br._restrict:
+            vals = self._cols.get(n)
+            if vals is not None:       # cache fills only (class invariant)
+                br._cols[n] = vals
+                got = self._num_cols.get(n)
+                if got is not None and got[0] is vals:
+                    br._num_cols[n] = got
+        return br
+
     def filter_rows(self, mask: np.ndarray) -> "BlockResult":
         keep = np.nonzero(mask)[0]
         br = BlockResult(int(keep.shape[0]))
         br._needed = self._needed
+        br._restrict = self._restrict
+        br._restrict_set = self._restrict_set
         if self._bs is not None and not self._cols:
             br._bs = self._bs
             br._sel = self._sel[keep]
@@ -337,10 +440,89 @@ class BlockResult:
         return br
 
     def rows(self, fields: list[str] | None = None) -> list[dict]:
-        """Materialize as row dicts (empty values omitted, like the API)."""
+        """Materialize as row dicts (empty values omitted, like the API).
+
+        Bulk form: one zip pass over the column lists instead of a
+        per-row per-column index.  This is the dict-rows convenience /
+        oracle — hot NDJSON sinks bypass it entirely via emit_columns()
+        (engine/emit.py)."""
         names = fields if fields is not None else self.column_names()
-        cols = [(n, self.column(n)) for n in names]
+        if not names:
+            # vlint: allow-per-row-emit(zero-column edge: {} rows ARE the output)
+            return [{} for _ in range(self.nrows)]
+        cols = [self.column(n) for n in names]
         out = []
-        for i in range(self.nrows):
-            out.append({n: vals[i] for n, vals in cols if vals[i] != ""})
+        append = out.append
+        for tup in zip(*cols):
+            # vlint: allow-per-row-emit(dict-rows oracle; hot sinks use emit_columns)
+            append({n: v for n, v in zip(names, tup) if v != ""})
         return out
+
+    # ---- columnar emit (engine/emit.py consumes this) ----
+
+    def emit_columns(self, fields: list[str] | None = None):
+        """Bulk selected-row materialization for the NDJSON emit path:
+        (names, [kind-tagged emit column per name]) — per-column
+        vectorized gathers from the decoded arenas/offset arrays for
+        exactly the hit rows, no intermediate per-row Python objects
+        (the reference's lazy-column blockResult discipline).  Typed
+        columns (timestamps, ints) pass their native int arrays through
+        untouched; the C serializer formats them (see the emit-column
+        helpers above for the kind encoding)."""
+        names = fields if fields is not None else self.column_names()
+        return names, [self._emit_column(n) for n in names]
+
+    def _emit_column(self, name: str):
+        n = self.nrows
+        if n == 0 or (self._restrict_set is not None
+                      and name not in self._restrict_set):
+            return _const_emit_col("", n)
+        if self._bs is None:
+            return _pack_str_column(self._cols.get(name) or [""] * n)
+        if name == "_time":
+            if self._ts_np is not None:
+                return (1, self._ts_np)
+            return _pack_str_column(self.column(name))
+        cv = self.const_value(name)    # consts + _stream/_stream_id
+        if cv is not None:
+            return _const_emit_col(cv, n)
+        col = self._bs.column(name)
+        if col is None:
+            return _const_emit_col("", n)
+        from ..storage.values_encoder import (VT_CONST, VT_DICT,
+                                              VT_FLOAT64, VT_INT64,
+                                              VT_STRING,
+                                              VT_TIMESTAMP_ISO8601,
+                                              VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64,
+                                              _format_floats)
+        vt = col.vtype
+        if vt == VT_STRING:
+            # zero copy: the stored arena IS the emit arena; only the
+            # per-row offset/length vectors gather through the selection
+            return (0, col.arena, col.offsets[self._sel],
+                    col.lengths[self._sel])
+        if vt == VT_DICT:
+            # pack the (<=8) dict values once, gather through the codes
+            _k, arena, doffs, dlens = _pack_str_column(col.dict_values)
+            ids = col.ids[self._sel]
+            return 0, arena, doffs[ids], dlens[ids]
+        if vt == VT_CONST:
+            return _const_emit_col(col.const_value, n)
+        if vt == VT_INT64:
+            return (3, self._sel_nums(col))
+        if vt in (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64):
+            return (4, self._sel_nums(col).astype(np.uint64))
+        if vt == VT_FLOAT64:
+            # floats keep the numpy canonical-repr formatting: the C
+            # side can't cheaply reproduce Python's shortest round-trip
+            return _fixed_emit_col(
+                _format_floats(self._sel_nums(col)).astype("S32"))
+        if vt == VT_TIMESTAMP_ISO8601:
+            return (2, self._sel_nums(col), col.iso_frac_w)
+        # VT_IPV4 and anything future: decode cache + packed gather
+        full = col.to_strings(self._bs.nrows)
+        return _pack_str_column([full[i] for i in self._sel.tolist()])
+
+    def _sel_nums(self, col) -> np.ndarray:
+        return col.nums[self._sel]

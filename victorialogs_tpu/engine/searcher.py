@@ -70,8 +70,9 @@ def _run_single_column_subquery(storage, tenants, sub, runner=None
     col_name: list = [None]
 
     def sink(br: BlockResult):
-        if br._bs is not None:
-            # raw storage blocks: require an explicit `| fields x` pipe
+        if br._bs is not None and br._restrict is None:
+            # raw storage blocks (no fields projection): require an
+            # explicit `| fields x` pipe
             raise ValueError(
                 "in(<subquery>) must narrow its output to one column, "
                 "e.g. `... | fields x`")
@@ -125,6 +126,14 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
               timestamp: int | None = None, runner=None,
               deadline: float | None = None) -> None:
     """Execute a LogsQL query; write_block(BlockResult) receives results.
+
+    write_block is the COLUMNAR sink protocol: blocks arrive with their
+    storage backing attached whenever the pipe chain allows (the fields/
+    delete pipes project without materializing), so sinks that serialize
+    (server/vlselect.py NDJSON emit) go straight from the harvested
+    bitmaps to response bytes via BlockResult.emit_columns() /
+    engine.emit.ndjson_block() — rows never become per-row dicts on that
+    path.  Dict-rows consumers keep using br.rows().
 
     runner: optional TPU runner (tpu/batch.py BatchRunner) — when given,
     block filtering dispatches to the device, one dispatch per leaf per
@@ -448,6 +457,7 @@ def get_field_names(storage, tenants, q: Query | str,
             if cnt:
                 hits[n] = hits.get(n, 0) + cnt
     run_query(storage, tenants, q, write_block=sink, timestamp=timestamp)
+    # vlint: allow-per-row-emit(introspection OUTPUT: one dict per distinct name)
     return [{"value": k, "hits": str(hits[k])} for k in sorted(hits)]
 
 
@@ -464,6 +474,7 @@ def get_field_values(storage, tenants, q: Query | str, field: str,
             if v != "":
                 hits[v] = hits.get(v, 0) + 1
     run_query(storage, tenants, q, write_block=sink, timestamp=timestamp)
+    # vlint: allow-per-row-emit(introspection OUTPUT: one dict per distinct value)
     out = [{"value": k, "hits": str(hits[k])} for k in sorted(hits)]
     if limit and len(out) > limit:
         out = out[:limit]

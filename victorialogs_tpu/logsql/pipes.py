@@ -140,7 +140,10 @@ class PipeFields(Pipe):
             def write_block(self, br):
                 use = expand_field_patterns(fields, br.column_names()) \
                     if has_wildcard else fields
-                self.next_p.write_block(br.materialize(use))
+                # restrict, don't materialize: storage-backed blocks
+                # keep their typed columnar access so the NDJSON emit
+                # sink never sees per-row string lists (engine/emit.py)
+                self.next_p.write_block(br.restrict_fields(use))
         return P(next_p)
 
     def split_to_remote_and_local(self):
@@ -171,7 +174,7 @@ class PipeDelete(Pipe):
         class P(Processor):
             def write_block(self, br):
                 names = [n for n in br.column_names() if n not in drop]
-                self.next_p.write_block(br.materialize(names))
+                self.next_p.write_block(br.restrict_fields(names))
         return P(next_p)
 
 
@@ -342,7 +345,11 @@ class PipeWhere(Pipe):
         class P(Processor):
             def write_block(self, br):
                 bs = getattr(br, "_bs", None)
-                if bs is not None and not br._cols:
+                # restricted views never take the block path: the filter
+                # may reference a projected-out field, which must read
+                # "" (fields-pipe semantics), not the storage value
+                if bs is not None and not br._cols and \
+                        br._restrict is None:
                     # storage-backed rows: evaluate through the block path
                     # (bloom kill-path + native arena scans) and slice the
                     # full-block bitmap through the selection — identical
@@ -507,17 +514,21 @@ class PipeSort(Pipe):
         class P(Processor):
             def __init__(self, np_):
                 super().__init__(np_)
-                self.top: list = []   # (key_values, seq, row_dict)
+                # (key_values, seq, name->idx map shared per block,
+                #  value tuple) — typed columnar access without a dict
+                # per retained row
+                self.top: list = []
                 self.seq = 0
 
             def write_block(self, br):
                 cols = [br.column(f) for f, _ in pipe.by]
                 names = br.column_names()
-                all_cols = [(n, br.column(n)) for n in names]
+                all_cols = [br.column(n) for n in names]
+                idx = {n: j for j, n in enumerate(names)}
                 rows = []
                 for ri in range(br.nrows):
-                    rows.append(([c[ri] for c in cols], self.seq,
-                                 {n: v[ri] for n, v in all_cols}))
+                    rows.append(([c[ri] for c in cols], self.seq, idx,
+                                 [v[ri] for v in all_cols]))
                     self.seq += 1
                 self.top = heapq.nsmallest(
                     k, self.top + rows,
@@ -527,11 +538,13 @@ class PipeSort(Pipe):
                 rows = self.top[pipe.offset:]
                 rank0 = pipe.offset + 1
                 names: dict[str, None] = {}
-                for _kv, _s, rd in rows:
-                    for n in rd:
+                for _kv, _s, idx, _vals in rows:
+                    for n in idx:
                         names.setdefault(n, None)
-                out_cols = {n: [rd.get(n, "") for _kv, _s, rd in rows]
-                            for n in names}
+                out_cols = {
+                    n: [vals[idx[n]] if n in idx else ""
+                        for _kv, _s, idx, vals in rows]
+                    for n in names}
                 if pipe.rank_field:
                     out_cols[pipe.rank_field] = [
                         str(rank0 + i) for i in range(len(rows))]

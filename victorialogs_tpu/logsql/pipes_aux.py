@@ -510,7 +510,8 @@ class PipeBlockStats(Pipe):
     def make_processor(self, next_p):
         class P(Processor):
             def write_block(self, br):
-                bs = br._bs
+                # fields-restricted views report like materialized blocks
+                bs = br._bs if br._restrict is None else None
                 rows_out = []
                 if bs is not None:
                     part = bs.part

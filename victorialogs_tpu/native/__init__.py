@@ -83,6 +83,11 @@ def _load():
                                              p_i32, i64, p_i32, i64,
                                              p_i64, p_i64]
             lib.vl_jsonline_scan.restype = i64
+            p_pp = ctypes.POINTER(ctypes.c_void_p)
+            lib.vl_emit_ndjson.argtypes = [i64, i64, p_pp, p_i64,
+                                           p_pp, p_pp, p_pp, p_i64,
+                                           p_i64, p_u8, i64]
+            lib.vl_emit_ndjson.restype = i64
         except AttributeError:
             # a stale .so without the newer symbols (mtime tricked the
             # rebuild check): degrade to the Python paths instead of
@@ -222,6 +227,78 @@ def xxh64_native(data: bytes, seed: int = 0) -> int | None:
         buf = np.zeros(1, dtype=np.uint8)
         return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), 0, seed))
     return int(lib.vl_xxh64(_ptr(buf, ctypes.c_uint8), buf.size, seed))
+
+
+_EMIT_DUMMY_I64 = np.zeros(1, dtype=np.int64)
+
+
+def emit_ndjson_native(key_tokens: list, cols: list, nrows: int
+                       ) -> bytes | None:
+    """Columnar NDJSON serializer (the query emit hot path).
+
+    key_tokens: per column, the pre-quoted b'"key":' token (json.dumps
+    of the name + colon — key escaping is Python's own by construction);
+    cols: per column a kind-tagged tuple (BlockResult.emit_columns):
+      (0, arena uint8[], offsets int64[n], lengths int64[n]) — bytes,
+          length 0 meaning "omit this field";
+      (1, ts int64[n])           — RFC3339Nano timestamps (_time);
+      (2, ts int64[n], frac_w)   — ISO8601, fixed fractional width;
+      (3, nums int64[n])         — signed decimal;
+      (4, nums uint64[n])        — unsigned decimal.
+    Returns the response bytes, or None when the native lib is missing
+    or a value holds invalid UTF-8 (caller uses the per-row Python path,
+    whose errors='replace' decode that case would need)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ncols = len(cols)
+    keys = [np.frombuffer(t, dtype=np.uint8) for t in key_tokens]
+    arenas, offs, lens = [], [], []
+    kinds = np.empty(ncols, dtype=np.int64)
+    params = np.zeros(ncols, dtype=np.int64)
+    total_val = 0
+    total_typed = 0
+    total_key = 0
+    for ci, (col, k) in enumerate(zip(cols, keys)):
+        kind = col[0]
+        kinds[ci] = kind
+        if kind == 0:
+            _k, arena, o, ln = col
+            arenas.append(np.ascontiguousarray(arena, dtype=np.uint8))
+            offs.append(np.ascontiguousarray(o, dtype=np.int64))
+            lens.append(np.ascontiguousarray(ln, dtype=np.int64))
+            total_val += int(lens[-1].sum())
+        else:
+            dt = np.uint64 if kind == 4 else np.int64
+            arenas.append(np.ascontiguousarray(col[1], dtype=dt))
+            offs.append(_EMIT_DUMMY_I64)
+            lens.append(_EMIT_DUMMY_I64)
+            if kind == 2:
+                params[ci] = int(col[2])
+            total_typed += 34 * nrows    # ts/decimal upper bound, exact
+        total_key += k.size
+    pp = ctypes.c_void_p * ncols
+    key_ptrs = pp(*[k.ctypes.data for k in keys])
+    arena_ptrs = pp(*[a.ctypes.data for a in arenas])
+    off_ptrs = pp(*[o.ctypes.data for o in offs])
+    len_ptrs = pp(*[ln.ctypes.data for ln in lens])
+    key_lens = np.fromiter((k.size for k in keys), dtype=np.int64,
+                           count=ncols)
+    cap = 6 * total_val + total_typed \
+        + nrows * (total_key + 6 * ncols + 8) + 16
+    out = np.empty(cap, dtype=np.uint8)
+    n = lib.vl_emit_ndjson(
+        ncols, nrows,
+        ctypes.cast(key_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        _ptr(key_lens, ctypes.c_int64),
+        ctypes.cast(arena_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(off_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(len_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        _ptr(kinds, ctypes.c_int64), _ptr(params, ctypes.c_int64),
+        _ptr(out, ctypes.c_uint8), cap)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
 
 
 def jsonline_scan_native(body: bytes):

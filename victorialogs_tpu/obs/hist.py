@@ -123,6 +123,14 @@ HOST_SYNC_WAIT = histogram(
     (0.00001, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
      0.025, 0.05, 0.1, 0.5))
 
+EMIT_SECONDS = histogram(
+    "vl_tpu_emit_seconds",
+    "host-side emit phase of one harvested dispatch unit: block "
+    "materialization + downstream write (NDJSON bytes on streaming "
+    "sinks) — the columnar-emit counterpart of host_sync_wait",
+    (0.00001, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+     0.025, 0.05, 0.1, 0.5))
+
 PACK_SIZE = histogram(
     "vl_tpu_pack_size_parts",
     "parts per pipeline dispatch unit (1 = unpacked part)",

@@ -87,12 +87,14 @@ def _fields_from_json_obj(obj: dict, prefix: str = "") -> list:
         elif isinstance(v, bool):
             out.append((name, "true" if v else "false"))
         elif isinstance(v, (int, float)):
+            # vlint: allow-per-row-emit(ingest-side non-string value canonicalization)
             out.append((name, json.dumps(v)))
         elif v is None:
             continue
         elif isinstance(v, dict):
             out.extend(_fields_from_json_obj(v, prefix=f"{name}."))
         else:  # arrays stay JSON-encoded
+            # vlint: allow-per-row-emit(ingest-side non-string value canonicalization)
             out.append((name, json.dumps(v, separators=(",", ":"))))
     return out
 
@@ -285,6 +287,7 @@ def _ingest_line(st: _FastState, line) -> None:
         if t is bool:
             vals[p] = "true" if v else "false"
         elif t is int or t is float:
+            # vlint: allow-per-row-emit(ingest-side number canonicalization)
             vals[p] = json.dumps(v)
         else:
             ok = False    # nested object / array / null
@@ -359,6 +362,7 @@ def _scan_chunk_native(st: _FastState, chunk: bytes, scan) -> None:
                 out.append(arena_s[o:e] if arena_s is not None
                            else arena[o:e].decode("utf-8"))
             elif k == 2:
+                # vlint: allow-per-row-emit(float re-canonicalization, flagged values only)
                 out.append(dumps(float(
                     arena_s[o:e] if arena_s is not None
                     else arena[o:e].decode("utf-8"))))
@@ -812,6 +816,7 @@ def _otlp_any_value(buf: bytes) -> str:
         if fnum == 5:  # array
             vals = [_otlp_any_value(v) for f, _w, v in pb.iter_fields(val)
                     if f == 1]
+            # vlint: allow-per-row-emit(OTLP any-value array canonicalization)
             return json.dumps(vals, separators=(",", ":"))
         if fnum == 6:  # kvlist
             obj = {}
@@ -819,6 +824,7 @@ def _otlp_any_value(buf: bytes) -> str:
                 if f == 1:
                     k, vv = _otlp_kv(v)
                     obj[k] = vv
+            # vlint: allow-per-row-emit(OTLP kvlist canonicalization)
             return json.dumps(obj, separators=(",", ":"))
         if fnum == 7:
             return val.hex()
@@ -958,8 +964,9 @@ def handle_datadog(cp: CommonParams, body: bytes,
             continue
         fields = []
         msg = item.get("message", "")
-        fields.append(("_msg", msg if isinstance(msg, str)
-                       else json.dumps(msg)))
+        # vlint: allow-per-row-emit(datadog non-string message fallback)
+        msg_s = msg if isinstance(msg, str) else json.dumps(msg)
+        fields.append(("_msg", msg_s))
         for k in ("ddsource", "service", "hostname", "status"):
             if item.get(k):
                 fields.append((k, str(item[k])))

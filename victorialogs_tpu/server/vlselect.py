@@ -12,7 +12,7 @@ import json
 import math
 import time
 
-from ..engine.block_result import format_rfc3339
+from ..engine.block_result import format_rfc3339, parse_rfc3339
 from ..engine.searcher import (get_field_names, get_field_values, run_query,
                                run_query_collect)
 from ..obs import slowlog, tracing
@@ -179,14 +179,14 @@ def handle_query(storage, args, headers, runner=None):
 
     # stream results as blocks arrive; the shared worker protocol
     # (bounded queue + abandon-stream cancellation) lives in streamwork
+    from ..engine.emit import ndjson_block
     from .streamwork import stream_blocks
 
     def encode(br):
-        out = []
-        for row in br.rows():
-            out.append(json.dumps(row, ensure_ascii=False,
-                                  separators=(",", ":")))
-        return "\n".join(out) + "\n" if out else None
+        # columnar emit: harvested bitmaps -> response bytes without
+        # per-row dicts (engine/emit.py; VL_NATIVE_EMIT=0 kill-switch)
+        data = ndjson_block(br)
+        return data if data else None
 
     root = _trace_root(args, q)
     deadline = query_deadline(args)
@@ -266,8 +266,10 @@ def handle_facets(storage, args, headers, runner=None) -> dict:
         storage, tenants, q, args, runner, "/select/logsql/facets")
     out: dict[str, list] = {}
     for r in rows:
+        # vlint: allow-per-row-emit(facet OUTPUT groups, bounded by limit*fields)
         out.setdefault(r["field_name"], []).append(
             {"field_value": r["field_value"], "hits": int(r["hits"])})
+    # vlint: allow-per-row-emit(facet OUTPUT: one dict per faceted field)
     res = {"facets": [{"field_name": f, "values": v}
                       for f, v in sorted(out.items())]}
     if trace_tree is not None:
@@ -315,6 +317,7 @@ def handle_stream_field_names(storage, args, headers) -> dict:
             for name in parse_stream_tags(v):
                 hits[name] = hits.get(name, 0) + 1
     run_query(storage, tenants, q, write_block=sink)
+    # vlint: allow-per-row-emit(introspection OUTPUT: one dict per tag name)
     return {"values": [{"value": k, "hits": str(hits[k])}
                        for k in sorted(hits)]}
 
@@ -334,6 +337,7 @@ def handle_stream_field_values(storage, args, headers) -> dict:
             if field in tags:
                 hits[tags[field]] = hits.get(tags[field], 0) + 1
     run_query(storage, tenants, q, write_block=sink)
+    # vlint: allow-per-row-emit(introspection OUTPUT: one dict per tag value)
     out = [{"value": k, "hits": str(v)}
            for k, v in sorted(hits.items(), key=lambda kv: (-kv[1], kv[0]))]
     if limit:
@@ -364,6 +368,7 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
             for n in by_names:
                 if n in r:
                     metric[n] = r[n]
+            # vlint: allow-per-row-emit(stats OUTPUT groups, bounded by group count)
             result.append({"metric": metric,
                            "value": [ts / 1e9, r.get(fn.out_name, "")]})
     out = {"status": "success",
@@ -386,7 +391,6 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
         "/select/logsql/stats_query_range")
     series: dict = {}
     by_names = [b.name for b in sp.by if b.name != "_time"]
-    from ..engine.block_result import parse_rfc3339
     for r in rows:
         t = parse_rfc3339(r.get("_time", "")) or 0
         for fn in sp.funcs:
@@ -412,6 +416,7 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
 def handle_tail(storage, args, headers, stop_check=None, runner=None):
     """Generator yielding NDJSON chunks for new rows (poll loop, ~1s period
     with a lag offset — reference logsql.go:497-580)."""
+    from ..engine.emit import ndjson_block
     q, tenants = parse_common_args(storage, args, headers)
     if not q.can_live_tail():
         raise HTTPError(400, "query contains pipes that cannot live-tail")
@@ -423,14 +428,38 @@ def handle_tail(storage, args, headers, stop_check=None, runner=None):
         now_end = time.time_ns() - lag_ns
         qq = q.clone()
         qq.add_time_filter(last_ts + 1, now_end)
-        rows = run_query_collect(storage, tenants, qq, runner=runner)
-        rows.sort(key=lambda r: r.get("_time", ""))
-        out = []
-        for r in rows:
-            out.append(json.dumps(r, ensure_ascii=False,
-                                  separators=(",", ":")))
-        if out:
-            yield "\n".join(out) + "\n"
+        # columnar emit per block; the cross-block _time sort happens on
+        # (int64-ns, line-bytes) pairs, never on row dicts.  Typed keys
+        # also FIX the old lexical sort: trimmed RFC3339Nano misorders
+        # sub-second rows ("..00.5Z" < "..00Z" byte-wise); blocks come
+        # with their timestamps attached, so ns order is free.  Rows
+        # whose _time is projected out keep arrival order (key 0),
+        # like the old "" keys did.
+        pairs: list = []
+
+        def sink(br):
+            if br.nrows == 0:
+                return
+            lines = ndjson_block(br).split(b"\n")[:br.nrows]
+            names = br.column_names()
+            if "_time" not in names:
+                # projected out: arrival order, like the old "" keys
+                keys = [0] * br.nrows
+            elif br._bs is not None and br.timestamps_np() is not None:
+                # storage-backed: the displayed _time IS the rendered
+                # int64 array — sort on it directly
+                keys = br.timestamps_np().tolist()
+            else:
+                # a pipe may have rewritten _time (copy/rename/extract):
+                # the sort key must follow the DISPLAYED value, not the
+                # original ingestion timestamps the block still carries
+                keys = [parse_rfc3339(v) or 0
+                        for v in br.column("_time")]
+            pairs.extend(zip(keys, lines))
+        run_query(storage, tenants, qq, write_block=sink, runner=runner)
+        pairs.sort(key=lambda kv: kv[0])
+        if pairs:
+            yield b"\n".join(ln for _k, ln in pairs) + b"\n"
         else:
             yield ""  # keep-alive chunk
         last_ts = now_end

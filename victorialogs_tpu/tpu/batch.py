@@ -756,6 +756,14 @@ class CostModel:
         self.host_rows_per_s = float(v) * 1e6 if v else 12e6
         self.host_stats_rows_per_s = 30e6
         self.upload_bytes_per_s = 1e9
+        # per-unit host EMIT time EWMA (block materialization +
+        # downstream write, EXCLUDING the device_sync blocked wait) —
+        # the VL_INFLIGHT=auto depth signal (tpu/pipeline.py).  Folding
+        # the wait in would make the signal self-referential: at depth d
+        # each harvest blocks ~rtt/d, the EWMA converges toward rtt/d,
+        # and ceil(rtt/ewma) contracts to the clamp floor exactly on the
+        # high-RTT backends that need a deep window.
+        self.emit_ewma: float | None = None
         self.force = os.environ.get("VL_COST_FORCE", "")
 
     # vlint: allow-jax-host-sync(the blocking round trip IS the probe)
@@ -803,6 +811,17 @@ class CostModel:
             cur = self.dev_bytes_per_s
             self.dev_bytes_per_s = rate if cur is None else \
                 (1 - self._EWMA) * cur + self._EWMA * rate
+
+    def observe_emit(self, elapsed: float) -> None:
+        """One harvested unit's emit-phase time (wait-free host work).
+        Unlike the routing rates this records even under VL_COST_FORCE:
+        it calibrates the window depth, not a device-vs-host decision."""
+        if elapsed <= 0:
+            return
+        with self._mu:
+            cur = self.emit_ewma
+            self.emit_ewma = elapsed if cur is None else \
+                (1 - self._EWMA) * cur + self._EWMA * elapsed
 
     def observe_host_scan(self, rows: int, elapsed: float) -> None:
         if elapsed <= 0 or rows < 10000:
@@ -866,6 +885,7 @@ class BatchRunner:
         self.packed_parts = 0         # parts folded into super-dispatches
         self.inflight_hwm = 0          # in-flight window high-water mark
         self.host_sync_wait_s = 0.0    # time blocked materializing results
+        self.inflight_auto_depth = 0   # VL_INFLIGHT=auto chosen depth
         self.stats_shards = 1          # mesh runners stripe rows over >1
         # distinct dispatch shapes this runner has sent to the device —
         # the multichip dryrun asserts breadth here (verdict r4 weak #6)
@@ -895,6 +915,10 @@ class BatchRunner:
             if v > getattr(self, attr):
                 setattr(self, attr, v)
 
+    def _set(self, attr: str, v) -> None:
+        with self._counter_mu:
+            setattr(self, attr, v)
+
     def _kind(self, label: str) -> None:
         with self._counter_mu:
             self.dispatch_kinds.add(label)
@@ -917,6 +941,7 @@ class BatchRunner:
                 "packed_parts": self.packed_parts,
                 "inflight_hwm": self.inflight_hwm,
                 "host_sync_wait_s": self.host_sync_wait_s,
+                "inflight_auto_depth": self.inflight_auto_depth,
             }
         out.update({f"staging_cache_{k}": v
                     for k, v in self.cache.stats().items()})
@@ -927,6 +952,7 @@ class BatchRunner:
         # /metrics scrape must not trigger the lazy RTT probe dispatch
         out["cost_rtt_seconds"] = self.cost.rtt or 0.0
         out["cost_dev_bytes_per_s"] = self.cost.dev_bytes_per_s or 0.0
+        out["cost_emit_ewma_seconds"] = self.cost.emit_ewma or 0.0
         if self.cost.rtt is not None:
             from .pipeline import pack_rows_cap
             cap = pack_rows_cap(self)

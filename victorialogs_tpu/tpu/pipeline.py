@@ -63,12 +63,50 @@ _PACK_ROWS_FLOOR = 16384
 _PACK_ROWS_CEIL = 1 << 20
 
 
-def inflight_depth() -> int:
-    """VL_INFLIGHT: max units with outstanding dispatches (>=1)."""
+_AUTO_DEPTH_MIN = 2
+_AUTO_DEPTH_MAX = 16
+_AUTO_DEPTH_DEFAULT = 4
+
+
+def inflight_auto() -> bool:
+    return os.environ.get("VL_INFLIGHT", "").strip().lower() == "auto"
+
+
+def inflight_depth(runner=None) -> int:
+    """VL_INFLIGHT: max units with outstanding dispatches (>=1).
+
+    ``VL_INFLIGHT=auto`` derives the depth from the cost model's
+    calibration EWMAs (vl_tpu_cost_rtt_seconds and the per-unit emit
+    EWMA, both /metrics gauges): the window hides one dispatch RTT
+    behind wait-free host emit work, so the device never idles once
+    ``depth * emit_per_unit >= rtt`` — depth = ceil(rtt / emit_ewma),
+    clamped to [2, 16].  An explicit integer always wins; cold
+    calibration falls back to the default."""
+    v = os.environ.get("VL_INFLIGHT", "4")
+    if v.strip().lower() == "auto":
+        return _auto_depth(runner)
     try:
-        return max(1, int(os.environ.get("VL_INFLIGHT", "4")))
+        return max(1, int(v))
     except ValueError:
-        return 4
+        return _AUTO_DEPTH_DEFAULT
+
+
+def _auto_depth(runner) -> int:
+    if runner is None:
+        return _AUTO_DEPTH_DEFAULT
+    host = runner.cost.emit_ewma
+    if not host:
+        # calibration cold: no harvested unit observed yet (first query
+        # of this runner) — the default window, like VL_INFLIGHT unset
+        return _AUTO_DEPTH_DEFAULT
+    # we're on the query path already, so the lazy RTT probe is fair
+    # game here (unlike /metrics scrapes — see BatchRunner.stats)
+    rtt = runner.cost.measured_rtt()
+    if not rtt:
+        return _AUTO_DEPTH_DEFAULT
+    import math
+    return min(_AUTO_DEPTH_MAX,
+               max(_AUTO_DEPTH_MIN, math.ceil(rtt / host)))
 
 
 def pack_limit() -> int:
@@ -504,7 +542,9 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                 "query exceeded -search.maxQueryDuration")
 
     f = q.filter
-    depth = inflight_depth()
+    depth = inflight_depth(runner)
+    if inflight_auto():
+        runner._set("inflight_auto_depth", depth)
     sync = _make_sync(runner)
     window: deque = deque()
     spec_seg = None
@@ -570,7 +610,13 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
     def harvest_one() -> None:
         hseq, hunit, t_submit, pending = window.popleft()
         with psp.span("harvest", unit=hseq) as hsp:
-            members = pending.harvest(sync)
+            # device_sync: blocked materializing the dispatch result;
+            # emit: host-side block materialization + downstream write
+            # (for streaming sinks that includes NDJSON serialization).
+            # Split children make the emit cost attributable per query
+            # (?trace=1), not just in the bench.
+            with hsp.span("device_sync"):
+                members = pending.harvest(sync)
             # _UnitReady units never dispatched (host gate / serial
             # fallback): their submit-to-harvest time is pure window
             # queue wait and must not pollute the device-RTT histogram
@@ -586,7 +632,23 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                 if hunit.pack:
                     hsp.set("pack_members",
                             [str(p.uid) for p, _b in hunit.members])
-            emit(members)
+            t_e0 = time.perf_counter()
+            with hsp.span("emit"):
+                emit(members)
+            emit_dt = time.perf_counter() - t_e0
+            hist.EMIT_SECONDS.observe(emit_dt)
+            # ONLY the emit phase feeds the VL_INFLIGHT=auto
+            # calibration: including the device_sync wait would make
+            # the signal track rtt/depth and contract the window on
+            # exactly the high-RTT backends that need it deep.
+            # Known tradeoff: emit_dt still includes downstream SINK
+            # time — for a streaming response that can be a slow
+            # client's backpressure (streamwork's bounded queue), which
+            # shallows the derived depth.  That query is output-bound
+            # (a deeper device window buys it nothing), and the EWMA
+            # (alpha 0.3) recovers within a few units once a fast
+            # consumer runs on the shared runner.
+            runner.cost.observe_emit(emit_dt)
 
     try:
         with psp.span("pipeline", inflight_depth=depth) as plsp:
