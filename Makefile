@@ -5,7 +5,8 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test lint bench bench-bloom bench-pipeline bench-emit clean
+.PHONY: all native test lint bench bench-bloom bench-pipeline \
+	bench-concurrent bench-emit clean
 
 all: native
 
@@ -35,6 +36,12 @@ bench-bloom:
 # jax-CPU backend (fails under 4x dispatch cut / 1.5x wall — PERF.md)
 bench-pipeline:
 	python tools/bench_pipeline.py --json BENCH_pipeline.json
+
+# same bench + the concurrent-clients mode: 8 threaded clients, p50/p99
+# per-query wall + aggregate rows/s, vl_active_queries sampled mid-run
+# (the ROADMAP scheduler item's measurement harness — PERF.md)
+bench-concurrent:
+	python tools/bench_pipeline.py --clients 8 --json BENCH_pipeline.json
 
 # emit phase: per-row dicts + json.dumps vs the columnar native NDJSON
 # path on the 32x2048 bench shape (fails under 2x — PERF.md)

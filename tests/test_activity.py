@@ -1,0 +1,489 @@
+"""Active-query registry + per-tenant accounting (obs/activity.py):
+live snapshots mid-run, cancel_query drain semantics (no downstream
+writes), client-disconnect abandonment, register/deregister balance
+after limit/deadline/cancel/abandon unwinds, concurrent /metrics
+scrapes with untorn per-tenant counters, storage-side gauges, the
+top_queries ring buffer, and qid correlation across trace/slowlog."""
+
+import json
+import http.client
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from test_obs import parse_prometheus
+
+from victorialogs_tpu.engine.searcher import (QueryTimeoutError,
+                                              run_query,
+                                              run_query_collect)
+from victorialogs_tpu.obs import activity, hist, slowlog
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+N_PARTS = 12                    # < datadb.DEFAULT_PARTS_TO_MERGE (15)
+ROWS_PER_PART = 600
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    """Many SMALL parts in one partition — enough blocks that a cancel
+    lands mid-scan with plenty of walk left to drain."""
+    path = str(tmp_path_factory.mktemp("actstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    n = 0
+    for _pp in range(N_PARTS):
+        lr = LogRows(stream_fields=["app"])
+        for _i in range(ROWS_PER_PART):
+            g = n
+            n += 1
+            lr.add(TEN, T0 + g * 50_000_000, [
+                ("app", f"app{g % 4}"),
+                ("_msg", f"m {'error' if g % 3 == 0 else 'ok'} {g}"),
+                ("lvl", ["info", "warn", "error"][g % 3]),
+            ])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return BatchRunner()
+
+
+def my_active(qid):
+    return [a for a in activity.active_snapshot() if a["qid"] == qid]
+
+
+def my_completed(qid):
+    return [r for r in activity.completed_snapshot()
+            if r["qid"] == qid]
+
+
+# ---------------- live registry snapshots ----------------
+
+def test_live_snapshot_mid_run_and_empty_after(storage, runner):
+    seen = {}
+
+    with activity.track("/test/ep", "error | fields _time",
+                        TEN) as act:
+
+        def sink(br):
+            if "snap" not in seen:
+                got = my_active(act.qid)
+                assert got, "running query missing from the registry"
+                seen["snap"] = got[0]
+
+        run_query(storage, [TEN], "error | fields _time",
+                  write_block=sink, runner=runner)
+        qid = act.qid
+    snap = seen["snap"]
+    assert snap["endpoint"] == "/test/ep"
+    assert snap["tenant"] == "0:0"
+    assert snap["query"] == "error | fields _time"
+    assert snap["phase"] in activity.PHASES
+    prog = snap["progress"]
+    # live progress counters mid-run: the walk has planned/scanned
+    # parts and emitted at least the first block's rows
+    assert prog.get("parts_total", 0) > 0
+    assert prog.get("parts_scanned", 0) > 0
+    assert prog.get("bytes_scanned", 0) > 0
+    assert prog.get("rows_emitted", 0) > 0
+    # the record deregistered with the with-block
+    assert not my_active(qid)
+    rec = my_completed(qid)[0]
+    assert rec["status"] == "ok"
+    assert rec["rows_emitted"] > 0
+
+
+def test_run_query_collect_self_registers(storage, runner):
+    before = {r["qid"] for r in activity.completed_snapshot()}
+    rows = run_query_collect(storage, [TEN], "error | limit 5",
+                             runner=runner)
+    assert len(rows) == 5
+    new = [r for r in activity.completed_snapshot()
+           if r["qid"] not in before]
+    assert any(r["endpoint"] == "run_query_collect" for r in new)
+
+
+# ---------------- cancel_query drain semantics ----------------
+
+def test_cancel_mid_scan_stops_device_walk_no_downstream_writes(
+        storage, runner):
+    # baseline: how many blocks the uncancelled walk writes
+    baseline = []
+    with activity.track("/test/cancel", "error", TEN):
+        run_query(storage, [TEN], "error",
+                  write_block=lambda br: baseline.append(br.nrows),
+                  runner=runner)
+    assert len(baseline) > 2
+
+    blocks = []
+    with activity.track("/test/cancel", "error", TEN) as act:
+        qid = act.qid
+
+        def sink(br):
+            blocks.append(br.nrows)
+            if len(blocks) == 1:
+                # what POST /select/logsql/cancel_query does
+                assert activity.cancel(qid)
+
+        # returns WITHOUT error: the cancel drains the in-flight window
+        # (PR 3 semantics) and the scan stops at its next is_done check
+        run_query(storage, [TEN], "error", write_block=sink,
+                  runner=runner)
+    # no downstream writes after the cancel point
+    assert len(blocks) <= 2
+    assert len(blocks) < len(baseline)
+    rec = my_completed(qid)[0]
+    assert rec["status"] == "cancelled"
+    assert not my_active(qid)
+
+
+def test_cancel_unknown_qid_is_false():
+    assert activity.cancel("no-such-qid") is False
+
+
+# ---------------- register/deregister balance on unwinds ----------------
+
+def test_no_leaked_records_after_limit_deadline_cancel(storage, runner):
+    # limit early-exit
+    with activity.track("/t/limit", "ok | limit 3", TEN) as act:
+        rows = run_query_collect(storage, [TEN], "ok | limit 3",
+                                 runner=runner)
+        qid_limit = act.qid
+    assert len(rows) == 3
+
+    # deadline death
+    with pytest.raises(QueryTimeoutError):
+        with activity.track("/t/deadline", "*", TEN) as act:
+            qid_dl = act.qid
+            run_query_collect(storage, [TEN], "*", runner=runner,
+                              deadline=time.monotonic() - 1.0)
+    for qid, status in ((qid_limit, "ok"),
+                        (qid_dl, "QueryTimeoutError")):
+        assert not my_active(qid), qid
+        assert my_completed(qid)[0]["status"] == status
+
+
+def test_client_disconnect_marks_abandoned_and_cancels(storage, runner):
+    """Closing the response generator mid-stream (what a dead HTTP peer
+    does) must mark the record abandoned AND trip the cancel flag so
+    the worker's device walk stops instead of finishing a dead query."""
+    from victorialogs_tpu.server.vlselect import handle_query
+    before = {r["qid"] for r in activity.completed_snapshot()}
+    gen = handle_query(storage, {"query": "*", "limit": "100000"}, {},
+                       runner=runner)
+    first = next(gen)
+    assert first
+    live = [a for a in activity.active_snapshot()
+            if a["endpoint"] == "/select/logsql/query"
+            and a["qid"] not in before]
+    assert live, "streaming query not registered"
+    qid = live[0]["qid"]
+    gen.close()      # the disconnect
+    assert not my_active(qid)
+    rec = my_completed(qid)[0]
+    assert rec["status"] == "abandoned"
+    assert rec["progress"].get("rows_emitted", 0) < N_PARTS * \
+        ROWS_PER_PART
+
+
+# ---------------- HTTP surface ----------------
+
+def _req(srv, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _mk_server(tmp_path, runner, **kw):
+    from victorialogs_tpu.server.app import VLServer
+    storage = Storage(str(tmp_path / "data"), retention_days=100000,
+                      flush_interval=3600)
+    srv = VLServer(storage, listen_addr="127.0.0.1", port=0,
+                   runner=runner, **kw)
+    return srv, storage
+
+
+def _ingest(srv, n=60, account=0):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i * NS,
+        "_msg": f"hello {'error' if i % 2 else 'ok'} {i}",
+        "app": "web",
+    }) for i in range(n))
+    status, _ = _req(srv, "POST",
+                     "/insert/jsonline?_stream_fields=app",
+                     body=body.encode(),
+                     headers={"AccountID": str(account)})
+    assert status == 200
+    _req(srv, "GET", "/internal/force_flush")
+
+
+def test_http_tail_shows_live_then_cancel_query_kills_it(tmp_path,
+                                                         runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        _ingest(srv)
+        q = urllib.parse.quote("*")
+        result = {}
+
+        def tail_client():
+            url = (f"http://127.0.0.1:{srv.port}"
+                   f"/select/logsql/tail?query={q}")
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                result["data"] = resp.read()
+
+        t = threading.Thread(target=tail_client, daemon=True)
+        t.start()
+        qid = None
+        for _ in range(200):
+            _s, data = _req(srv, "GET", "/select/logsql/active_queries")
+            obj = json.loads(data)
+            tails = [e for e in obj["data"]
+                     if e["endpoint"] == "/select/logsql/tail"]
+            if tails:
+                qid = tails[0]["qid"]
+                assert tails[0]["query"] == "*"
+                break
+            time.sleep(0.05)
+        assert qid, "tail connection never appeared in active_queries"
+
+        # the gauge reflects the live tail, split by endpoint
+        _s, data = _req(srv, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples[
+            'vl_active_queries{endpoint="/select/logsql/tail"}'] >= 1
+        assert samples["vl_active_queries"] >= 1
+
+        # destructive endpoint: a GET (crawler/prefetch) must not kill
+        st, _ = _req(srv, "GET",
+                     f"/select/logsql/cancel_query?qid={qid}")
+        assert st == 405
+        assert my_active(qid), "GET cancel_query killed the query"
+        st, data = _req(srv, "POST",
+                        f"/select/logsql/cancel_query?qid={qid}")
+        assert st == 200
+        t.join(timeout=10)
+        assert not t.is_alive(), "cancel_query did not end the tail"
+        # registry empties after the kill
+        for _ in range(100):
+            _s, data = _req(srv, "GET", "/select/logsql/active_queries")
+            if not json.loads(data)["data"]:
+                break
+            time.sleep(0.05)
+        assert not json.loads(data)["data"]
+
+        # unknown qid -> 404; missing qid -> 400
+        st, _ = _req(srv, "POST",
+                     "/select/logsql/cancel_query?qid=999999")
+        assert st == 404
+        st, _ = _req(srv, "POST", "/select/logsql/cancel_query")
+        assert st == 400
+    finally:
+        srv.close()
+        storage.close()
+
+
+def test_top_queries_heavy_hitters(tmp_path, runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        _ingest(srv)
+        for lim in (1, 5, 10):
+            q = urllib.parse.quote("error")
+            _req(srv, "GET",
+                 f"/select/logsql/query?query={q}&limit={lim}")
+        st, data = _req(srv, "GET", "/select/logsql/top_queries?n=5")
+        assert st == 200
+        top = json.loads(data)["top_queries"]
+        assert top
+        durs = [r["duration_s"] for r in top]
+        assert durs == sorted(durs, reverse=True)
+        for r in top:
+            assert r["qid"] and r["endpoint"] and "status" in r
+        # by=bytes sorts on bytes_scanned
+        st, data = _req(srv, "GET",
+                        "/select/logsql/top_queries?n=5&by=bytes")
+        byb = json.loads(data)["top_queries"]
+        vals = [r["bytes_scanned"] for r in byb]
+        assert vals == sorted(vals, reverse=True)
+    finally:
+        srv.close()
+        storage.close()
+
+
+# ---------------- concurrent scrape / untorn tenant counters ----------------
+
+def test_concurrent_metrics_scrape_and_tenant_accounting(tmp_path,
+                                                         runner):
+    """8 registry-mutating query threads vs a scraping main thread:
+    every scrape parses as valid exposition, and the per-tenant
+    counters come out exact (no torn/lost updates)."""
+    srv, storage = _mk_server(tmp_path, runner)
+    ACCOUNT = 7
+    TENANT = f"{ACCOUNT}:0"
+    PER_THREAD = 5
+    THREADS = 8
+    try:
+        _ingest(srv, n=60, account=ACCOUNT)
+
+        def tenant_counter(samples, base):
+            return samples.get(base + '{tenant="' + TENANT + '"}', 0)
+
+        _s, data = _req(srv, "GET", "/metrics")
+        before = parse_prometheus(data.decode())
+        assert tenant_counter(before, "vl_tenant_rows_ingested_total") \
+            == 60
+
+        errors = []
+
+        def worker(wi):
+            try:
+                for r in range(PER_THREAD):
+                    q = urllib.parse.quote(
+                        ["error", "ok", "*"][(wi + r) % 3])
+                    st, _ = _req(srv, "GET",
+                                 f"/select/logsql/query?query={q}"
+                                 f"&limit=50",
+                                 headers={"AccountID": str(ACCOUNT)})
+                    assert st == 200
+            # vlint: allow-broad-except(test error channel)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        # hammer /metrics while the fleet mutates the registry: the
+        # exposition must parse every single time
+        scrapes = 0
+        while any(t.is_alive() for t in threads):
+            _s, data = _req(srv, "GET", "/metrics")
+            parse_prometheus(data.decode())
+            scrapes += 1
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert scrapes > 0
+
+        _s, data = _req(srv, "GET", "/metrics")
+        after = parse_prometheus(data.decode())
+        dq = tenant_counter(after, "vl_tenant_select_queries_total") - \
+            tenant_counter(before, "vl_tenant_select_queries_total")
+        assert dq == THREADS * PER_THREAD
+        assert tenant_counter(after, "vl_tenant_select_seconds_total") \
+            > tenant_counter(before, "vl_tenant_select_seconds_total")
+        assert tenant_counter(after, "vl_tenant_bytes_scanned_total") \
+            > tenant_counter(before, "vl_tenant_bytes_scanned_total")
+        # ingest accounting untouched by the select fleet
+        assert tenant_counter(after, "vl_tenant_rows_ingested_total") \
+            == 60
+    finally:
+        srv.close()
+        storage.close()
+
+
+# ---------------- storage/ingest metric families ----------------
+
+def test_storage_gauges_and_merge_histogram(tmp_path, runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        _ingest(srv)           # part 1
+        _ingest(srv)           # part 2
+        _s, data = _req(srv, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        for g in ("vl_storage_pending_merges",
+                  "vl_storage_flush_age_seconds",
+                  "vl_storage_merges_total",
+                  'vl_storage_rows{type="small"}',
+                  'vl_storage_rows{type="big"}'):
+            assert g in samples, g
+        assert samples['vl_storage_rows{type="small"}'] > 0
+        # force a merge: the duration histogram and the counter move
+        _req(srv, "GET", "/internal/force_merge")
+        _s, data = _req(srv, "GET", "/metrics")
+        text = data.decode()
+        samples = parse_prometheus(text)
+        assert "# TYPE vl_storage_merge_duration_seconds histogram" \
+            in text
+        assert samples["vl_storage_merge_duration_seconds_count"] >= 1
+        assert samples["vl_storage_merges_total"] >= 1
+        assert samples['vl_storage_rows{type="big"}'] > 0
+    finally:
+        srv.close()
+        storage.close()
+
+
+def test_ingest_bytes_and_parse_failure_counters(tmp_path, runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        _ingest(srv, n=10)
+        st, _ = _req(srv, "POST", "/insert/jsonline",
+                     body=b"{not json at all")
+        assert st == 400
+        _s, data = _req(srv, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples['vl_ingest_bytes_total{type="jsonline"}'] > 0
+        assert samples[
+            'vl_ingest_parse_failures_total{type="jsonline"}'] >= 1
+    finally:
+        srv.close()
+        storage.close()
+
+
+# ---------------- qid correlation (trace / slowlog / registry) --------------
+
+def test_qid_correlates_trace_slowlog_and_registry(tmp_path, runner,
+                                                   monkeypatch):
+    monkeypatch.setenv("VL_SLOW_QUERY_MS", "0")   # everything is slow
+    lines: list = []
+    slowlog.set_sink(lines.append)
+    try:
+        srv, storage = _mk_server(tmp_path, runner)
+        try:
+            _ingest(srv)
+            q = urllib.parse.quote("error")
+            _s, data = _req(
+                srv, "GET",
+                f"/select/logsql/query?query={q}&limit=10&trace=1")
+            tree = json.loads(data.decode().splitlines()[-1])["_trace"]
+            qid = tree["attrs"]["qid"]
+            assert qid
+            slow = json.loads(lines[-1])
+            assert slow["qid"] == qid
+            assert my_completed(qid)[0]["endpoint"] == \
+                "/select/logsql/query"
+        finally:
+            srv.close()
+            storage.close()
+    finally:
+        slowlog.set_sink(None)
+
+
+def test_tenant_cardinality_is_hard_capped(monkeypatch):
+    """Client-controlled tenant ids must not grow the accounting map
+    (and the /metrics exposition) without bound: past the cap, new
+    tenants aggregate into the "other" slot."""
+    cap = len(activity._tenant_totals) + 2
+    monkeypatch.setattr(activity, "_TENANT_MAX", cap)
+    activity.note_ingest("90001:0", 1, nbytes=10)
+    activity.note_ingest("90002:0", 2, nbytes=20)
+    for i in range(10):
+        activity.note_ingest(f"91000:{i}", 1, nbytes=5)
+    assert len(activity._tenant_totals) <= cap + 1   # + "other"
+    assert "90001:0" in activity._tenant_totals
+    other = activity._tenant_totals[activity._TENANT_OVERFLOW]
+    assert other["rows_ingested"] >= 10

@@ -687,3 +687,80 @@ def test_span_discipline_repo_instrumentation_is_clean():
         found = [f for f in spans.check(sf)
                  if not sf.allowed(f.checker, f.line)]
         assert found == [], [f.render() for f in found]
+
+
+# ---------------- accounting discipline (obs/activity.py API) ----------------
+
+ACCT_BAD_CTOR = """
+    from victorialogs_tpu.obs.activity import QueryActivity
+
+    def f():
+        act = QueryActivity("1", "/x", "*", "0:0")
+        return act
+"""
+
+ACCT_BAD_OPEN = """
+    from victorialogs_tpu.obs import activity
+
+    def f():
+        act = activity.track("/select/logsql/query", "*", None)
+        return act
+"""
+
+ACCT_GOOD = """
+    from victorialogs_tpu.obs import activity
+
+    def f(storage, run_query):
+        with activity.track("/select/logsql/query", "*", None) as act:
+            act.add("parts_scanned")
+            run_query(storage)
+        return activity.active_snapshot()
+"""
+
+
+def test_accounting_discipline_flags_direct_construction():
+    out = lint(ACCT_BAD_CTOR)
+    assert "accounting-discipline" in checkers(out)
+    assert any("QueryActivity(...)" in f.message for f in out)
+
+
+def test_accounting_discipline_flags_unclosed_track():
+    out = lint(ACCT_BAD_OPEN)
+    assert "accounting-discipline" in checkers(out)
+    assert any("never deregister" in f.message for f in out)
+
+
+def test_accounting_discipline_clean_and_annotated():
+    assert "accounting-discipline" not in checkers(lint(ACCT_GOOD))
+    annotated = """
+        from victorialogs_tpu.obs import activity
+
+        def f():
+            # vlint: allow-accounting-discipline(deregistered in a handle)
+            t = activity.track("/x", "*", None)
+            return t
+    """
+    assert "accounting-discipline" not in checkers(lint(annotated))
+
+
+def test_accounting_discipline_skips_activity_module():
+    out = lint(ACCT_BAD_CTOR,
+               path="victorialogs_tpu/obs/activity.py")
+    assert "accounting-discipline" not in checkers(out)
+
+
+def test_accounting_discipline_repo_instrumentation_is_clean():
+    """Every track()/QueryActivity site the registry wiring added must
+    honor the context-manager discipline across the registering
+    layers."""
+    from tools.vlint.core import SourceFile
+    from tools.vlint import accounting
+    for rel in ("engine/searcher.py", "server/vlselect.py",
+                "server/cluster.py", "server/app.py",
+                "server/vlagent.py", "tpu/pipeline.py"):
+        path = os.path.join(REPO, "victorialogs_tpu", rel)
+        sf = SourceFile.parse(path,
+                              display_path=f"victorialogs_tpu/{rel}")
+        found = [f for f in accounting.check(sf)
+                 if not sf.allowed(f.checker, f.line)]
+        assert found == [], [f.render() for f in found]

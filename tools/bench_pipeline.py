@@ -216,11 +216,82 @@ def measure_trace_overhead(storage, ten, t0, runs):
             "spans_disabled": spans_off, "spans_traced": spans_on}
 
 
+def run_concurrent(storage, ten, t0, clients, queries_per_client):
+    """Concurrent-clients mode: N same-process threads hammer the same
+    storage+runner through run_query_collect (each query registers in
+    the active-query registry), reporting per-query p50/p99 wall and
+    aggregate rows/s — the measurement the ROADMAP scheduler item asks
+    for, with vl_active_queries sampled mid-run as proof the registry
+    sees the concurrency."""
+    import statistics as st
+    import threading
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.obs import activity
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    os.environ["VL_INFLIGHT"] = "4"
+    os.environ["VL_PACK_PARTS"] = "8"
+    runner = BatchRunner()
+    for _name, qs in QUERIES:      # warm: XLA compiles + staging
+        run_query_collect(storage, [ten], qs, timestamp=t0,
+                          runner=runner)
+
+    lock = threading.Lock()
+    lat: list = []
+    rows_total = [0]
+    barrier = threading.Barrier(clients + 1)
+
+    def client(ci):
+        barrier.wait()
+        for r in range(queries_per_client):
+            _name, qs = QUERIES[(ci + r) % len(QUERIES)]
+            tq0 = time.perf_counter()
+            rows = run_query_collect(storage, [ten], qs, timestamp=t0,
+                                     runner=runner)
+            dt = time.perf_counter() - tq0
+            with lock:
+                lat.append(dt)
+                rows_total[0] += len(rows)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_all = time.perf_counter()
+    # sample the registry while the fleet runs: vl_active_queries is
+    # exactly what a scrape would see mid-load
+    max_active = 0
+    while any(t.is_alive() for t in threads):
+        max_active = max(max_active, len(activity.active_snapshot()))
+        time.sleep(0.005)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_all
+    lat.sort()
+
+    def q(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+    return {
+        "clients": clients,
+        "queries": len(lat),
+        "p50_ms": st.median(lat) * 1e3,
+        "p99_ms": q(0.99),
+        "wall_s": wall,
+        "agg_queries_per_s": len(lat) / wall,
+        "agg_rows_per_s": rows_total[0] / wall,
+        "max_active_queries": max_active,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--parts", type=int, default=32)
     ap.add_argument("--rows", type=int, default=2048)
     ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--clients", type=int, default=0,
+                    help="also run the concurrent-clients mode with "
+                         "this many threaded clients")
+    ap.add_argument("--queries-per-client", type=int, default=6)
     ap.add_argument("--json", default="")
     ap.add_argument("--no-assert", action="store_true")
     args = ap.parse_args()
@@ -245,6 +316,12 @@ def main():
         print("measuring harvest emit split (per-row vs columnar) ...",
               flush=True)
         emit_split = measure_emit_split(storage, ten, t0, args.runs)
+        concurrent = None
+        if args.clients > 0:
+            print(f"concurrent-clients mode: {args.clients} clients x "
+                  f"{args.queries_per_client} queries ...", flush=True)
+            concurrent = run_concurrent(storage, ten, t0, args.clients,
+                                        args.queries_per_client)
         storage.close()
 
     print(f"\npipeline bench — {args.parts} parts x {args.rows} rows, "
@@ -293,12 +370,30 @@ def main():
           f"({emit_ratio:.1f}x)  "
           f"device_sync={emit_split['columnar']['device_sync_ms']:.1f} ms")
 
+    if concurrent is not None:
+        print(f"concurrent clients ({concurrent['clients']} threads, "
+              f"{concurrent['queries']} queries): "
+              f"p50={concurrent['p50_ms']:.1f} ms  "
+              f"p99={concurrent['p99_ms']:.1f} ms  "
+              f"{concurrent['agg_rows_per_s']:.0f} rows/s  "
+              f"{concurrent['agg_queries_per_s']:.1f} q/s  "
+              f"max vl_active_queries={concurrent['max_active_queries']}")
+
     if args.json:
+        if concurrent is None:
+            # a default (no --clients) run must not clobber committed
+            # concurrent-clients results with null — carry them forward
+            try:
+                with open(args.json) as f:
+                    concurrent = json.load(f).get("concurrent")
+            except (OSError, ValueError):
+                pass
         with open(args.json, "w") as f:
             json.dump({"parts": args.parts, "rows": args.rows,
                        "cpu": {k: len(v) for k, v in cpu.items()},
                        "trace_overhead": trace_oh,
                        "emit_split": emit_split,
+                       "concurrent": concurrent,
                        "results": {k: {n: {kk: vv for kk, vv in r.items()
                                            if kk != "rows"}
                                        for n, r in v.items()}
@@ -327,6 +422,14 @@ def main():
         assert emit_ratio >= 1.3, \
             f"columnar emit must materially cut the harvest emit span, " \
             f"got {emit_ratio:.2f}x"
+        if args.clients > 0:
+            # the registry must actually see the concurrency it exists
+            # to expose (each client registers per query) — asserted
+            # only on THIS run's measurement, never on carried-forward
+            # JSON from a previous run
+            assert concurrent["max_active_queries"] >= 2, \
+                f"active-query registry never saw concurrent clients " \
+                f"({concurrent['max_active_queries']})"
         print("acceptance: >=4x fewer dispatches, >=1.5x wall clock, "
               "vltrace disabled-overhead within noise, "
               f"emit span cut {emit_ratio:.1f}x OK")

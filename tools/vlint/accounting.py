@@ -1,0 +1,78 @@
+"""Accounting-discipline checker (obs/activity.py API hygiene).
+
+The active-query registry API is context-manager-only: the with-block
+is what guarantees every registered QueryActivity deregisters (and
+rolls its per-tenant accounting) on every exit path — limit, deadline,
+cancel and client-disconnect unwinds included — which the
+register/deregister-balance tests pin.  Two ways to break that
+discipline, both flagged (the same enforcement pattern as the PR 4
+span-discipline checker):
+
+- accounting-discipline: direct ``QueryActivity(...)`` construction
+  anywhere outside victorialogs_tpu/obs/activity.py — records must
+  come from ``activity.track(...)``;
+- accounting-discipline: a ``track(...)`` call that is not the context
+  expression of a ``with`` item (assigned, passed, returned, or bare)
+  — such a record would register and never deregister, leaking into
+  /select/logsql/active_queries forever.
+
+Deliberate sites carry ``# vlint: allow-accounting-discipline(<why>)``,
+same annotation + baseline discipline as every other checker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import _dotted
+
+# the module that owns QueryActivity plays by its own rules
+_ACTIVITY_MODULE = "obs/activity.py"
+
+# calls that REGISTER a record and therefore must sit in a with-item
+_OPENERS = ("track",)
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    if sf.path.replace("\\", "/").endswith(_ACTIVITY_MODULE):
+        return []
+    findings: list[Finding] = []
+
+    # every Call node that is a with-item context expression
+    with_calls: set[int] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_calls.add(id(item.context_expr))
+
+    def walk(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, ast.Call):
+                if isinstance(child.func, ast.Attribute):
+                    last = child.func.attr
+                else:
+                    last = _dotted(child.func).split(".")[-1]
+                if last == "QueryActivity":
+                    findings.append(Finding(
+                        "accounting-discipline", sf.path, child.lineno,
+                        sym,
+                        "direct QueryActivity(...) construction — "
+                        "register records via the context-manager "
+                        "activity.track(...) API"))
+                elif last in _OPENERS and id(child) not in with_calls:
+                    findings.append(Finding(
+                        "accounting-discipline", sf.path, child.lineno,
+                        sym,
+                        f"{last}(...) outside a with-statement — the "
+                        f"record would never deregister; register via "
+                        f"`with activity.{last}(...) as act:`"))
+            walk(child, sym)
+
+    walk(sf.tree, "")
+    return findings

@@ -168,8 +168,9 @@ def iter_py_files(paths: list[str]) -> list[str]:
 
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import hotpath, hygiene, locks, spans
-    return [locks.check, hygiene.check, hotpath.check, spans.check]
+    from . import accounting, hotpath, hygiene, locks, spans
+    return [locks.check, hygiene.check, hotpath.check, spans.check,
+            accounting.check]
 
 
 def run_source(path: str, text: str, root: str = ".") -> list[Finding]:

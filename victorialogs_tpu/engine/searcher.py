@@ -13,6 +13,7 @@ this module stays the correctness oracle and the fallback path.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 
@@ -21,7 +22,7 @@ import numpy as np
 from ..logsql.filters import (Filter, FilterAnd, FilterIn, FilterContainsAll,
                               FilterContainsAny, FilterNone, FilterNoop,
                               FilterNot, FilterOr, FilterStream, FilterTime)
-from ..obs import tracing
+from ..obs import activity, tracing
 from ..logsql.parser import MAX_TS, MIN_TS, Query, parse_query
 from ..logsql.pipes import Processor, SinkProcessor
 from ..storage.log_rows import TenantID
@@ -42,6 +43,34 @@ class QueryCancelled(Exception):
 class QueryTimeoutError(Exception):
     """Raised when a query exceeds its deadline (reference
     -search.maxQueryDuration — app/vlselect/main.go:133-150)."""
+
+
+class _CancelAwareHead:
+    """Processor-chain head facade that folds the active-query
+    registry's cancel flag (cancel_query / client-disconnect abandon —
+    obs/activity.py) into is_done(): the scan loops already treat a
+    done head as QueryCancelled, so an external cancel drains the
+    device pipeline's in-flight window without downstream writes
+    (tpu/pipeline.py PR 3 semantics) and stops the serial walk at its
+    next block."""
+
+    __slots__ = ("_head", "_act")
+
+    def __init__(self, head, act):
+        self._head = head
+        self._act = act
+
+    def write_block(self, br) -> None:
+        self._head.write_block(br)
+
+    def absorb_partials(self, key, states) -> None:
+        self._head.absorb_partials(key, states)
+
+    def flush(self) -> None:
+        self._head.flush()
+
+    def is_done(self) -> bool:
+        return self._act.is_cancelled() or self._head.is_done()
 
 
 def build_processor_chain(pipes: list, write_fn) -> Processor:
@@ -172,7 +201,19 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
                     if hasattr(fn, "step_seconds"):
                         fn.step_seconds = step_seconds
 
+    act = activity.current_activity()
+    if act.enabled and write_block is not None:
+        # rows-emitted accounting at the FINAL sink (per block, never
+        # per row): what the client actually received, after every pipe
+        inner_sink = write_block
+
+        def write_block(br):
+            act.add("rows_emitted", br.nrows)
+            inner_sink(br)
+
     head = build_processor_chain(q.pipes, write_block or (lambda br: None))
+    if act.enabled:
+        head = _CancelAwareHead(head, act)
     from ..logsql.pipes import compute_needed_fields
     needed = compute_needed_fields(q.pipes)
 
@@ -287,14 +328,17 @@ def _scan_partitions_parallel(pts, scan_partition, head, npw) -> None:
     sync_head = _SyncHead(head, lock, stop)
     errors: list = []
     # contextvars don't cross thread spawns: re-enter the caller's span
-    # in each partition worker so their "partition" spans nest under it
+    # AND activity record in each partition worker so their "partition"
+    # spans nest under it and progress counters land on the registry
     parent_span = tracing.current_span()
+    parent_act = activity.current_activity()
 
     def run_one(pt):
         if stop.is_set():
             return
         try:
-            with tracing.use_span(parent_span):
+            with tracing.use_span(parent_span), \
+                    activity.use_activity(parent_act):
                 scan_partition(pt, sync_head)
         except QueryCancelled:
             stop.set()
@@ -367,6 +411,9 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
 
     sp = tracing.current_span()
     sp.set("parts", len(parts))
+    act = activity.current_activity()
+    act.add("parts_total", len(parts))
+    act.set_phase("scan")
     for part in parts:
         if deadline is not None and time.monotonic() > deadline:
             raise QueryTimeoutError(
@@ -385,6 +432,7 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                     part, token_leaves,
                     build=len(part_bis) * 4 >= part.num_blocks):
                 continue
+        activity.note_part_scanned(act, part, part_bis)
         cand: dict[int, BlockSearch] = {}
         for bi in part_bis:
             if head.is_done():
@@ -430,13 +478,28 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
 def run_query_collect(storage, tenants, q: Query | str,
                       timestamp: int | None = None, runner=None,
                       deadline: float | None = None) -> list[dict]:
-    """Execute and collect result rows as dicts (test/API convenience)."""
+    """Execute and collect result rows as dicts (test/API convenience).
+
+    Registers its own activity record when none is ambient (the
+    engine-level entry point CLI tools and benches drive directly) so
+    every query execution shows up in /select/logsql/active_queries;
+    the HTTP handlers register endpoint-specific records first, which
+    this inherits instead of double-registering."""
     rows: list[dict] = []
 
     def sink(br: BlockResult):
         rows.extend(br.rows())
-    run_query(storage, tenants, q, write_block=sink, timestamp=timestamp,
-              runner=runner, deadline=deadline)
+
+    if activity.current_activity().enabled:
+        ctx = contextlib.nullcontext()
+    else:
+        # vlint: allow-accounting-discipline(entered by the with below)
+        ctx = activity.track("run_query_collect",
+                             q if isinstance(q, str) else q.to_string(),
+                             tenants)
+    with ctx:
+        run_query(storage, tenants, q, write_block=sink,
+                  timestamp=timestamp, runner=runner, deadline=deadline)
     return rows
 
 

@@ -1,6 +1,8 @@
 """Observability layer: per-query hierarchical tracing (tracing.py),
-fixed-bucket Prometheus histograms (hist.py), and the slow-query log
-(slowlog.py).
+fixed-bucket Prometheus histograms (hist.py), the slow-query log
+(slowlog.py), and the active-query registry with per-tenant resource
+accounting (activity.py — /select/logsql/active_queries, cancel_query,
+top_queries, vl_tenant_* /metrics series).
 
 The tracing design constraint is that the DISABLED path must cost
 nothing measurable on the hot query path: `tracing.current_span()`

@@ -141,3 +141,10 @@ PRUNE_RATIO = histogram(
     "fraction of probed candidate blocks killed per bloom keep-mask "
     "probe (the filter-index kill path)",
     (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))
+
+MERGE_SECONDS = histogram(
+    "vl_storage_merge_duration_seconds",
+    "wall time of one background part merge (small/big tier "
+    "compactions and force merges, storage/datadb.py)",
+    (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+     30.0, 60.0))

@@ -48,9 +48,13 @@ def enabled() -> bool:
 
 
 def maybe_log(endpoint: str, query: str, duration_s: float,
-              root=None) -> bool:
+              root=None, qid: str | None = None) -> bool:
     """Emit the slow-query line when duration exceeds the threshold.
-    Returns True when a line was emitted (test convenience)."""
+    Returns True when a line was emitted (test convenience).
+
+    qid: the active-query registry id (obs/activity.py) — carried on
+    the line so slowlog records, ?trace=1 trees, and active_queries
+    snapshots correlate by id."""
     thr = threshold_ms()
     if thr is None or duration_s * 1e3 < thr:
         return False
@@ -63,6 +67,8 @@ def maybe_log(endpoint: str, query: str, duration_s: float,
         # vlint: allow-wall-clock(log-line timestamp is real wall time)
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if qid:
+        rec["qid"] = qid
     if root is not None and getattr(root, "enabled", False):
         rec["trace"] = root.flatten()
         if root.attrs:

@@ -31,6 +31,10 @@ class AgentServer(BaseHTTPApp):
                 out.append(f"{name} {self.metrics.counters[name]}")
             out.append(f"vlagent_pending_bytes "
                        f"{self.agent.pending_bytes()}")
+            out.append(f"vlagent_rows_forwarded_total "
+                       f"{self.agent.rows_forwarded}")
+            out.append(f"vlagent_bytes_forwarded_total "
+                       f"{self.agent.bytes_forwarded}")
             for c in self.agent.clients:
                 lbl = f'{{url="{c.url}"}}'
                 out.append(f"vlagent_delivered_blocks_total{lbl} "

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 from ..engine.searcher import QueryTimeoutError
-from ..obs import hist
+from ..obs import activity, hist
 from ..storage.storage import Storage
 from ..utils.memory import QueryMemoryError
 from .insertutil import (CommonParams, LocalLogRowsStorage,
@@ -115,6 +115,11 @@ class Metrics:
         bs = bank_stats()
         add("vl_tpu_bloom_bank_used_bytes", bs["used_bytes"])
         add("vl_tpu_bloom_bank_max_bytes", bs["max_bytes"])
+        # active-query registry: vl_active_queries by endpoint plus the
+        # per-tenant select/ingest accounting the scheduler's admission
+        # control will consume (obs/activity.py)
+        for base, labels, v in activity.metrics_samples():
+            add(metric_name(base, **labels), v)
         s = storage.update_stats()
         gauges = {
             "vl_partitions": s["partitions"],
@@ -122,6 +127,9 @@ class Metrics:
             metric_name("vl_storage_rows", type="inmemory"):
                 s["inmemory_rows"],
             metric_name("vl_storage_rows", type="file"): s["file_rows"],
+            metric_name("vl_storage_rows", type="small"):
+                s["small_rows"],
+            metric_name("vl_storage_rows", type="big"): s["big_rows"],
             metric_name("vl_storage_parts", type="inmemory"):
                 s["inmemory_parts"],
             metric_name("vl_storage_parts", type="small"):
@@ -134,6 +142,12 @@ class Metrics:
             metric_name("vl_rows_dropped_total", reason="too_new"):
                 s["rows_dropped_too_new"],
             "vl_storage_is_read_only": int(s["is_read_only"]),
+            # merge/flush health (storage/datadb.py stats): queued tier
+            # compactions, total merges, staleness of in-RAM rows
+            "vl_storage_pending_merges": s["pending_merges"],
+            "vl_storage_merges_total": s["merges_done"],
+            "vl_storage_flush_age_seconds":
+                round(s["flush_age_seconds"], 3),
         }
         for name, v in gauges.items():
             add(name, v)
@@ -271,17 +285,50 @@ class BaseHTTPApp:
             self.metrics.inc("vl_http_errors_total")
             self.respond(h, 500, "text/plain", str(e).encode("utf-8"))
 
+    @staticmethod
+    def _insert_proto(path: str) -> str:
+        """Protocol label for one insert path (ingest counters).
+
+        Deliberately a separate path->label table rather than
+        per-branch strings: the parse-failure counter in
+        handle_insert's except path needs the protocol before/without
+        a branch body running.  A new insert endpoint must add its row
+        here too, or its traffic lands as type="unknown"."""
+        if path == "/insert/jsonline":
+            return "jsonline"
+        if path.endswith("/_bulk"):
+            return "elasticsearch"
+        if path.startswith("/insert/loki/"):
+            return "loki"
+        if path.startswith("/insert/opentelemetry/"):
+            return "opentelemetry"
+        if path.startswith("/insert/datadog/"):
+            return "datadog"
+        if path.startswith("/insert/journald/"):
+            return "journald"
+        return "unknown"
+
     def handle_insert(self, h, path, args, body, ctype) -> None:
         m = self.metrics
         cp = CommonParams.from_request(h.headers, args)
         lmp = LogMessageProcessor(cp, self.sink)
+        proto = self._insert_proto(path)
+
+        def count(n: int) -> None:
+            # per-protocol rows + request bytes, per-tenant rows/bytes
+            # (the registry side feeds vl_tenant_* on /metrics)
+            m.inc(metric_name("vl_rows_ingested_total", type=proto), n)
+            m.inc(metric_name("vl_ingest_bytes_total", type=proto),
+                  len(body))
+            activity.note_ingest(cp.tenant, n, nbytes=len(body))
+
         try:
             if path == "/insert/jsonline":
                 n = vlinsert.handle_jsonline(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"jsonline\"}", n)
+                count(n)
             elif path.endswith("/_bulk"):
                 n, resp = vlinsert.handle_elasticsearch_bulk(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"elasticsearch\"}", n)
+                count(n)
                 lmp.flush()
                 self.respond_json(h, resp)
                 return
@@ -291,7 +338,7 @@ class BaseHTTPApp:
                     n = vlinsert.handle_loki_protobuf(cp, body, lmp)
                 else:
                     n = vlinsert.handle_loki_json(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"loki\"}", n)
+                count(n)
                 lmp.flush()
                 self.respond(h, 204, "text/plain", b"")
                 return
@@ -300,20 +347,20 @@ class BaseHTTPApp:
                     n = vlinsert.handle_otlp_json(cp, body, lmp)
                 else:
                     n = vlinsert.handle_otlp_protobuf(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"opentelemetry\"}", n)
+                count(n)
                 lmp.flush()
                 self.respond_json(h, {"partialSuccess": {}})
                 return
             elif path in ("/insert/datadog/api/v2/logs",
                           "/insert/datadog/api/v1/input"):
                 n = vlinsert.handle_datadog(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"datadog\"}", n)
+                count(n)
                 lmp.flush()
                 self.respond_json(h, {})
                 return
             elif path == "/insert/journald/upload":
                 n = vlinsert.handle_journald(cp, body, lmp)
-                m.inc("vl_rows_ingested_total{type=\"journald\"}", n)
+                count(n)
             elif path.startswith("/insert/elasticsearch"):
                 # ES-compat discovery endpoints
                 self.respond_json(h, {"version": {"number": "8.9.0"}})
@@ -321,6 +368,9 @@ class BaseHTTPApp:
             else:
                 raise HTTPError(404, f"unknown insert path {path}")
         except vlinsert.IngestError as e:
+            # parse failures land in the registry's per-protocol
+            # counter (vl_ingest_parse_failures_total on /metrics)
+            activity.note_parse_failure(proto)
             raise HTTPError(400, str(e))
         lmp.flush()
         self.respond_json(h, {"status": "ok", "ingested": n})
@@ -387,6 +437,38 @@ class VLServer(BaseHTTPApp):
         # ---- ingestion ----
         if path.startswith("/insert/"):
             self.handle_insert(h, path, args, body, ctype)
+            return
+
+        # ---- active-query registry (reference-parity introspection:
+        # /select/logsql/active_queries + cancel/top — obs/activity.py).
+        # Deliberately NOT behind the query semaphore: a saturated
+        # server is exactly when operators need to see and kill queries.
+        if path == "/select/logsql/active_queries":
+            self.respond_json(h, {"status": "ok",
+                                  "data": activity.active_snapshot()})
+            return
+        if path == "/select/logsql/cancel_query":
+            # destructive: POST only (a GET from a crawler/prefetcher
+            # must never kill a live query)
+            if h.command != "POST":
+                raise HTTPError(405, "cancel_query requires POST")
+            qid = args.get("qid", "")
+            if not qid:
+                raise HTTPError(400, "missing qid arg")
+            if not activity.cancel(qid):
+                raise HTTPError(404, f"no active query with qid {qid!r}")
+            m.inc("vl_queries_cancelled_total")
+            self.respond_json(h, {"status": "ok", "qid": qid})
+            return
+        if path == "/select/logsql/top_queries":
+            try:
+                n = int(args.get("n") or args.get("limit") or "10")
+            except ValueError:
+                raise HTTPError(400, "invalid n arg")
+            self.respond_json(h, {
+                "status": "ok",
+                "top_queries": activity.top_queries(
+                    n, by=args.get("by", "duration"))})
             return
 
         # ---- queries (concurrency-gated with queue-timeout shedding;

@@ -32,7 +32,7 @@ import urllib.request
 
 from ..engine.block_result import BlockResult
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
-from ..obs import tracing
+from ..obs import activity, tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
@@ -219,18 +219,32 @@ def handle_internal_select(storage, args, runner=None):
     root = tracing.make_root("storage_node_query", query=qs) \
         if args.get("trace") == "1" else None
 
-    def run(sink):
-        # the query executes on streamwork's worker thread: activate the
-        # trace THERE (contextvars don't cross thread spawns)
-        with tracing.activate(root):
-            run_query(storage, tenants, q, write_block=sink,
-                      runner=runner, deadline=deadline)
-
     def gen():
-        yield from stream_blocks(run, encode)
-        if root is not None:
-            yield write_frame({"trace": root.to_dict()})
-        yield END_FRAME
+        # internal sub-queries register in the active-query registry
+        # too: a storage node's active_queries shows the frontend fan-in
+        # it is serving, and cancel_query on the node kills a runaway
+        # sub-query with the same drain semantics
+        with activity.track("/internal/select/query", qs,
+                            tenants) as act:
+
+            def run(sink):
+                # the query executes on streamwork's worker thread:
+                # activate the trace and re-enter the registry record
+                # THERE (contextvars don't cross thread spawns)
+                with tracing.activate(root), activity.use_activity(act):
+                    run_query(storage, tenants, q, write_block=sink,
+                              runner=runner, deadline=deadline)
+
+            try:
+                yield from stream_blocks(run, encode)
+            except GeneratorExit:
+                # frontend hung up (first-error/early-done cancel):
+                # stop the device walk, don't finish a dead sub-query
+                act.abandon()
+                raise
+            if root is not None:
+                yield write_frame({"trace": root.to_dict()})
+            yield END_FRAME
     return gen()
 
 
@@ -243,6 +257,7 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
     data = _zstd.decompress(body, max_output_size=1 << 30)
     lr = LogRows()
     n = 0
+    per_tenant: dict = {}
     for line in data.splitlines():
         if not line:
             continue
@@ -255,9 +270,16 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
         lr.stream_ids.append(StreamID(tenant, hi, lo))
         lr.stream_tags_str.append(tags_str)
         lr.tenants.append(tenant)
+        per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
         n += 1
     if n:
         storage.must_add_rows(lr)
+        for tenant, rows in per_tenant.items():
+            # apportion DECOMPRESSED bytes so vl_tenant_ingest_bytes_
+            # total means the same thing on storage nodes as on
+            # frontends (uncompressed request payload)
+            activity.note_ingest(tenant, rows,
+                                 nbytes=len(data) * rows // n)
     return n
 
 
@@ -378,6 +400,10 @@ class NetSelectStorage:
 
         head = build_processor_chain(local_pipes,
                                      write_block or (lambda br: None))
+        # external cancellation (cancel_query / disconnect abandon):
+        # the frontend's registry record ends the scatter-gather the
+        # same way early-done does — fetch threads stop pulling frames
+        act = activity.current_activity()
         lock = threading.Lock()
         stop = threading.Event()
         errors: list = []
@@ -430,7 +456,7 @@ class NetSelectStorage:
                         if resp.status != 200:
                             raise IOError(f"{url}: HTTP {resp.status}")
                         for frame in read_frames(resp):
-                            if stop.is_set():
+                            if stop.is_set() or act.is_cancelled():
                                 # abandoning the stream also abandons
                                 # the node's trailing trace frame — the
                                 # cancellation (which aborts the node's

@@ -99,6 +99,9 @@ class VLAgent(LogRowsStorage):
         if not remote_urls:
             raise ValueError("vlagent needs at least one -remoteWrite.url")
         self.clients = []
+        self._stats_mu = threading.Lock()
+        self.rows_forwarded = 0
+        self.bytes_forwarded = 0
         for url in remote_urls:
             qdir = os.path.join(
                 queues_dir,
@@ -112,6 +115,15 @@ class VLAgent(LogRowsStorage):
         block = encode_rows(lr)
         for c in self.clients:
             c.queue.append(block)
+        # forwarded-traffic accounting: each batch counted ONCE (rows
+        # and encoded bytes), regardless of how many remotes replicate
+        # it — per-destination delivery is what the per-client
+        # delivered_blocks counters measure.  Per-tenant registry
+        # accounting already happened in the HTTP layer's
+        # handle_insert (note_ingest), so none here.
+        with self._stats_mu:
+            self.rows_forwarded += len(lr)
+            self.bytes_forwarded += len(block)
 
     def pending_bytes(self) -> int:
         return sum(c.queue.pending_bytes() for c in self.clients)

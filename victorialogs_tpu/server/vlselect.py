@@ -15,7 +15,7 @@ import time
 from ..engine.block_result import format_rfc3339, parse_rfc3339
 from ..engine.searcher import (get_field_names, get_field_values, run_query,
                                run_query_collect)
-from ..obs import slowlog, tracing
+from ..obs import activity, slowlog, tracing
 from ..logsql.duration import parse_duration, ts_bounds
 from ..logsql.parser import (MAX_TS, MIN_TS, ParseError, Query, parse_query,
                              parse_filter_string)
@@ -142,20 +142,26 @@ def _trace_root(args, q: Query):
 
 
 def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
-    """run_query_collect under an optional trace; returns (rows, tree)
-    where tree is the span-tree dict only when the request asked for
-    it.  Emits the slow-query line either way."""
+    """run_query_collect under an optional trace and an active-query
+    registry record; returns (rows, tree) where tree is the span-tree
+    dict only when the request asked for it.  Emits the slow-query line
+    either way, with the qid correlating it to active_queries/traces."""
     root = _trace_root(args, q)
     t0 = time.monotonic()
-    try:
-        with tracing.activate(root):
-            rows = run_query_collect(storage, tenants, q, runner=runner,
-                                     deadline=query_deadline(args))
-    finally:
-        # in finally: the slowest queries are exactly the ones that die
-        # on the deadline — they must still produce their slow-log line
-        slowlog.maybe_log(endpoint, q.to_string(),
-                          time.monotonic() - t0, root)
+    with activity.track(endpoint, q.to_string(), tenants[0]) as act:
+        if root is not None:
+            root.set("qid", act.qid)
+        try:
+            with tracing.activate(root):
+                rows = run_query_collect(storage, tenants, q,
+                                         runner=runner,
+                                         deadline=query_deadline(args))
+        finally:
+            # in finally: the slowest queries are exactly the ones that
+            # die on the deadline — they must still produce their
+            # slow-log line
+            slowlog.maybe_log(endpoint, q.to_string(),
+                              time.monotonic() - t0, root, qid=act.qid)
     tree = root.to_dict() if root is not None and want_trace(args) \
         else None
     return rows, tree
@@ -191,28 +197,46 @@ def handle_query(storage, args, headers, runner=None):
     root = _trace_root(args, q)
     deadline = query_deadline(args)
 
-    def run(sink):
-        # the query executes on streamwork's worker thread: activate
-        # the trace THERE (contextvars don't cross thread spawns); the
-        # activation also closes the root on every exit path
-        with tracing.activate(root):
-            run_query(storage, tenants, q, write_block=sink,
-                      runner=runner, deadline=deadline)
-
     def gen():
-        t0 = time.monotonic()
-        try:
-            yield from stream_blocks(run, encode)
-        finally:
-            # in finally: deadline kills (QueryTimeoutError re-raised
-            # from the worker) and client disconnects (GeneratorExit at
-            # the yield) are exactly the slow queries the log is for
-            slowlog.maybe_log("/select/logsql/query", q.to_string(),
-                              time.monotonic() - t0, root)
-        if root is not None and want_trace(args):
-            yield json.dumps({"_trace": root.to_dict()},
-                             ensure_ascii=False,
-                             separators=(",", ":")) + "\n"
+        # the registry record covers the whole response stream: it
+        # registers when the response starts iterating and deregisters
+        # on every exit path (done, deadline, disconnect)
+        with activity.track("/select/logsql/query", q.to_string(),
+                            tenants[0]) as act:
+            if root is not None:
+                root.set("qid", act.qid)
+
+            def run(sink):
+                # the query executes on streamwork's worker thread:
+                # activate the trace and re-enter the registry record
+                # THERE (contextvars don't cross thread spawns); the
+                # activation also closes the root on every exit path
+                with tracing.activate(root), activity.use_activity(act):
+                    run_query(storage, tenants, q, write_block=sink,
+                              runner=runner, deadline=deadline)
+
+            t0 = time.monotonic()
+            try:
+                yield from stream_blocks(run, encode)
+            except GeneratorExit:
+                # the HTTP peer went away mid-stream: mark the record
+                # abandoned and trip the cancel flag so the pipeline
+                # drain path stops the device walk instead of finishing
+                # a dead query
+                act.abandon()
+                raise
+            finally:
+                # in finally: deadline kills (QueryTimeoutError
+                # re-raised from the worker) and client disconnects
+                # (GeneratorExit at the yield) are exactly the slow
+                # queries the log is for
+                slowlog.maybe_log("/select/logsql/query", q.to_string(),
+                                  time.monotonic() - t0, root,
+                                  qid=act.qid)
+            if root is not None and want_trace(args):
+                yield json.dumps({"_trace": root.to_dict()},
+                                 ensure_ascii=False,
+                                 separators=(",", ":")) + "\n"
 
     return gen()
 
@@ -416,14 +440,32 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
 def handle_tail(storage, args, headers, stop_check=None, runner=None):
     """Generator yielding NDJSON chunks for new rows (poll loop, ~1s period
     with a lag offset — reference logsql.go:497-580)."""
-    from ..engine.emit import ndjson_block
     q, tenants = parse_common_args(storage, args, headers)
     if not q.can_live_tail():
         raise HTTPError(400, "query contains pipes that cannot live-tail")
     lag_ns = 2_500_000_000
     last_ts = time.time_ns() - lag_ns
+    # one registry record for the whole tail connection: cancel_query
+    # on its qid (or a client disconnect) ends the tail; the inner
+    # polls inherit the record ambiently, so a cancel also drains a
+    # poll that is mid-scan
+    with activity.track("/select/logsql/tail", q.to_string(),
+                        tenants[0]) as act:
+        try:
+            yield from _tail_loop(storage, tenants, q, act, lag_ns,
+                                  last_ts, stop_check, runner)
+        except GeneratorExit:
+            act.abandon()
+            raise
+
+
+def _tail_loop(storage, tenants, q, act, lag_ns, last_ts, stop_check,
+               runner):
+    from ..engine.emit import ndjson_block
     while True:
         if stop_check is not None and stop_check():
+            return
+        if act.is_cancelled():
             return
         now_end = time.time_ns() - lag_ns
         qq = q.clone()
@@ -463,4 +505,7 @@ def handle_tail(storage, args, headers, stop_check=None, runner=None):
         else:
             yield ""  # keep-alive chunk
         last_ts = now_end
-        time.sleep(1.0)
+        # sleep on the cancel flag so cancel_query wakes the tail
+        # immediately instead of after the poll period
+        if act.wait_cancelled(1.0):
+            return

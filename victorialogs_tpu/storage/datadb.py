@@ -459,6 +459,15 @@ class DataDB:
     # long I/O under _merge_lock is its purpose: it serializes merges
     # vlint: allow-lock-blocking-call(coarse merge serialization lock)
     def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
+        t0 = time.perf_counter()
+        self._merge_parts_timed(to_merge, big)
+        # storage-side observability: merge wall time feeds the
+        # vl_storage_merge_duration_seconds histogram on /metrics
+        from ..obs import hist
+        hist.MERGE_SECONDS.observe(time.perf_counter() - t0)
+
+    # vlint: allow-lock-blocking-call(coarse merge serialization lock)
+    def _merge_parts_timed(self, to_merge: list[Part], big: bool) -> None:
         # disk-space reservation: skip the merge when the output could not
         # fit (reference reserves before merging — datadb.go:478-493)
         need = int(sum(p.meta.get("compressed_size", 0)
@@ -517,6 +526,10 @@ class DataDB:
     # ---- stats / lifecycle ----
     def stats(self) -> dict:
         with self._lock:
+            # flushing_parts included: a stalled flush is exactly the
+            # staleness this gauge exists to surface
+            oldest = min((p.created_at for p in self.inmemory_parts
+                          + self.flushing_parts), default=None)
             return {
                 "inmemory_parts": len(self.inmemory_parts)
                 + len(self.flushing_parts),
@@ -526,12 +539,24 @@ class DataDB:
                                      + self.flushing_parts),
                 "file_rows": sum(p.num_rows
                                  for p in self.small_parts + self.big_parts),
+                "small_rows": sum(p.num_rows for p in self.small_parts),
+                "big_rows": sum(p.num_rows for p in self.big_parts),
                 "compressed_size": sum(p.meta["compressed_size"]
                                        for p in self.small_parts
                                        + self.big_parts),
                 "uncompressed_size": sum(p.meta["uncompressed_size"]
                                          for p in self.small_parts
                                          + self.big_parts),
+                # merge/flush health: how many tier compactions the
+                # merge worker has queued up, everything it has done,
+                # and how stale the oldest not-yet-durable rows are
+                "pending_merges":
+                    int(len(self.small_parts) >= DEFAULT_PARTS_TO_MERGE)
+                    + int(len(self.big_parts) >= DEFAULT_PARTS_TO_MERGE),
+                "merges_done": self.merges_done,
+                "flush_age_seconds":
+                    0.0 if oldest is None
+                    else time.monotonic() - oldest,
             }
 
     def close(self) -> None:

@@ -38,7 +38,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..obs import hist, tracing
+from ..obs import activity, hist, tracing
 from ..utils.hashing import cached_token_hashes
 from .bloom import (BLOOM_HASHES, bloom_contains_all,
                     bloom_probe_positions_multi)
@@ -414,6 +414,11 @@ def _observe_keep(keep: np.ndarray, observe: bool = True) -> np.ndarray:
         if sp.enabled:
             sp.add("blocks_probed_bloom", n)
             sp.add("blocks_killed_bloom", killed)
+        if killed:
+            # live-progress twin of the span counter: the active-query
+            # registry record (no-op when the query isn't tracked)
+            activity.current_activity().add("blocks_killed_bloom",
+                                            killed)
     return keep
 
 
@@ -441,5 +446,6 @@ def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
             if sp.enabled:
                 sp.add("parts_pruned_aggregate")
                 sp.set("last_aggregate_prune_field", field)
+            activity.current_activity().add("parts_pruned")
             return True
     return False

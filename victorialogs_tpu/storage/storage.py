@@ -224,8 +224,11 @@ class Storage:
             parts = list(self.partitions.values())
         agg = {
             "partitions": len(parts), "streams": 0, "inmemory_rows": 0,
-            "file_rows": 0, "inmemory_parts": 0, "small_parts": 0,
+            "file_rows": 0, "small_rows": 0, "big_rows": 0,
+            "inmemory_parts": 0, "small_parts": 0,
             "big_parts": 0, "compressed_size": 0, "uncompressed_size": 0,
+            "pending_merges": 0, "merges_done": 0,
+            "flush_age_seconds": 0.0,
             "rows_dropped_too_old": self.rows_dropped_too_old,
             "rows_dropped_too_new": self.rows_dropped_too_new,
             "is_read_only": self.is_read_only,
@@ -233,9 +236,14 @@ class Storage:
         for p in parts:
             s = p.stats()
             for k in ("streams", "inmemory_rows", "file_rows",
+                      "small_rows", "big_rows",
                       "inmemory_parts", "small_parts", "big_parts",
-                      "compressed_size", "uncompressed_size"):
+                      "compressed_size", "uncompressed_size",
+                      "pending_merges", "merges_done"):
                 agg[k] += s[k]
+            # the staleness signal is the WORST partition's flush age
+            agg["flush_age_seconds"] = max(agg["flush_age_seconds"],
+                                           s["flush_age_seconds"])
         return agg
 
     def close(self) -> None:

@@ -1014,16 +1014,19 @@ class BatchRunner:
         (layout-coordinate columns + timestamp planes — what the
         windowed pipeline dispatches, including packed super-parts)
         instead of the per-leaf string staging."""
-        from ..obs import tracing
+        from ..obs import activity, tracing
         # staging runs on the vl-prefetch worker: re-enter the caller's
-        # span there so staged_entries/staged_bytes attribution isn't
-        # silently dropped on the dominant (prefetched) path; attrs are
-        # lock-guarded, so adds racing the final to_dict are safe
+        # span AND activity record there so staged_entries/staged_bytes
+        # attribution isn't silently dropped on the dominant
+        # (prefetched) path; attrs/counters are lock-guarded, so adds
+        # racing the final to_dict/snapshot are safe
         caller_span = tracing.current_span()
+        caller_act = activity.current_activity()
 
         def work():
             try:
-                with tracing.use_span(caller_span):
+                with tracing.use_span(caller_span), \
+                        activity.use_activity(caller_act):
                     self._prefetch_work(part, f, stats_spec, cand_bis,
                                         fused)
             # vlint: allow-broad-except(prefetch is best-effort)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import tracing
+from ..obs import activity, tracing
 from .kernels import pad_bucket
 
 
@@ -180,6 +180,7 @@ class StagingCache:
         if sp.enabled:
             sp.add("staged_entries")
             sp.add("staged_bytes", cost)
+        activity.current_activity().add("bytes_staged", cost)
 
     def put_small(self, key: tuple, marker) -> None:
         """Cache a marker (e.g. 'this column is unstageable')."""

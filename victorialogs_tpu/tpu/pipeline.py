@@ -53,7 +53,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..obs import hist, tracing
+from ..obs import activity, hist, tracing
 from .kernels import pad_bucket
 
 # adaptive pack-size clamps: parts below the floor always pack (the
@@ -381,6 +381,7 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
             members.append((p, blocks))
         return _Unit(pack, bss, members, pack=True)
 
+    act = activity.current_activity()
     group: list = []        # packable run sharing one row bucket
     for part in parts:
         check_deadline()
@@ -394,6 +395,9 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
                 build=len(bis) * 4 >= part.num_blocks):
             runner._bump("agg_pruned_parts")
             continue
+        # registry progress at part granularity (the planning pull IS
+        # the prune stage, so these land as the walk advances)
+        activity.note_part_scanned(act, part, bis)
         small = packable and part.num_rows <= rows_cap
         if not small:
             if group:
@@ -546,6 +550,8 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
     if inflight_auto():
         runner._set("inflight_auto_depth", depth)
     sync = _make_sync(runner)
+    act = activity.current_activity()
+    act.add("parts_total", len(parts))
     window: deque = deque()
     spec_seg = None
     if stats_spec is not None and pack_limit() > 1 and sort_spec is None:
@@ -594,6 +600,7 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
         nonlocal exhausted
         if exhausted or len(lookahead) >= depth + 1:
             return
+        act.set_phase("prune")
         # the planning pull IS the prune stage: candidate selection +
         # part-aggregate kills run inside _unit_stream, so filterbank's
         # prune counters land on this span
@@ -609,6 +616,8 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
 
     def harvest_one() -> None:
         hseq, hunit, t_submit, pending = window.popleft()
+        act.set_phase("harvest")
+        act.set("dispatches_in_flight", len(window))
         with psp.span("harvest", unit=hseq) as hsp:
             # device_sync: blocked materializing the dispatch result;
             # emit: host-side block materialization + downstream write
@@ -633,6 +642,7 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                     hsp.set("pack_members",
                             [str(p.uid) for p, _b in hunit.members])
             t_e0 = time.perf_counter()
+            act.set_phase("emit")
             with hsp.span("emit"):
                 emit(members)
             emit_dt = time.perf_counter() - t_e0
@@ -692,11 +702,15 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                                      for p, _b in unit.members])
                         else:
                             ssp.set("part", str(unit.part.uid))
+                    act.set_phase("scan")
                     window.append((seq, unit, time.perf_counter(),
                                    _submit(runner, f, unit, stats_spec,
                                            sort_spec, spec_seg)))
                 seq += 1
                 runner._bump_max("inflight_hwm", len(window))
+                if act.enabled:
+                    act.add("dispatches_submitted")
+                    act.set("dispatches_in_flight", len(window))
             while window:
                 check_deadline()
                 harvest_one()
@@ -708,4 +722,5 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
         # a complete, budget-accounted value (staged under its key lock),
         # so the cache stays balanced for the next query.
         window.clear()
+        act.set("dispatches_in_flight", 0)
         stream.close()
