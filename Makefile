@@ -37,9 +37,12 @@ bench-bloom:
 bench-pipeline:
 	python tools/bench_pipeline.py --json BENCH_pipeline.json
 
-# same bench + the concurrent-clients mode: 8 threaded clients, p50/p99
-# per-query wall + aggregate rows/s, vl_active_queries sampled mid-run
-# (the ROADMAP scheduler item's measurement harness — PERF.md)
+# same bench + the concurrent-clients mode (8 threaded clients, p50/p99
+# + aggregate rows/s, vl_active_queries sampled mid-run), the tenant-mix
+# fairness round (2 heavy + 4 light clients, unmanaged VL_SCHED=0 vs
+# managed: light p99 must not regress, aggregate within bounds) and the
+# HTTP shed probe (capped tenant sheds 429 + Retry-After + counters) —
+# PERF.md round 8
 bench-concurrent:
 	python tools/bench_pipeline.py --clients 8 --json BENCH_pipeline.json
 

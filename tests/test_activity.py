@@ -296,6 +296,128 @@ def test_http_tail_shows_live_then_cancel_query_kills_it(tmp_path,
         storage.close()
 
 
+def test_cancel_and_disconnect_of_queued_query_do_zero_device_work(
+        tmp_path, runner):
+    """A queued-but-not-yet-admitted query is cancellable: cancel_query
+    (and a client disconnect) remove the entry from the admission queue
+    BEFORE any device work starts — zero device dispatches for the
+    killed query (the PR 6 cancel flag only took effect once the
+    pipeline was running)."""
+    import socket
+    srv, storage = _mk_server(tmp_path, runner, max_concurrent=1)
+    try:
+        _ingest(srv)   # data is ~2025: the tail window never scans it
+
+        # occupy the ONLY admission slot with a tail under another
+        # tenant (so the queued 0:0 queries pass their per-tenant cap);
+        # its polls match no partitions, so it does no device work
+        def tail_client():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}"
+                    f"/select/logsql/tail?query=*",
+                    headers={"AccountID": "3"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+            except (OSError, ValueError):
+                pass
+
+
+        t_tail = threading.Thread(target=tail_client, daemon=True)
+        t_tail.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(a["endpoint"] == "/select/logsql/tail"
+                   for a in activity.active_snapshot()):
+                break
+            time.sleep(0.02)
+
+        d0 = runner.device_calls
+        q = urllib.parse.quote("error")
+
+        # --- cancel_query while queued ---
+        result = {}
+
+        def queued_client():
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}"
+                    f"/select/logsql/query?query={q}", timeout=30)
+                result["status"] = 200
+            except urllib.error.HTTPError as e:
+                result["status"] = e.code
+                result["body"] = json.loads(e.read() or b"{}")
+
+        t_q = threading.Thread(target=queued_client, daemon=True)
+        t_q.start()
+        qid = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            queued = [a for a in activity.active_snapshot()
+                      if a["endpoint"] == "/select/logsql/query"
+                      and a["phase"] == "queued"]
+            if queued:
+                qid = queued[0]["qid"]
+                break
+            time.sleep(0.02)
+        assert qid, "query never appeared queued in active_queries"
+        st, _ = _req(srv, "POST",
+                     f"/select/logsql/cancel_query?qid={qid}")
+        assert st == 200
+        t_q.join(10)
+        assert not t_q.is_alive()
+        assert result["status"] == 499
+        assert result["body"]["reason"] == "cancelled"
+        # the client may see the 499 a beat before the server thread
+        # exits its registry scope — poll the deregistration
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and my_active(qid):
+            time.sleep(0.02)
+        assert not my_active(qid)
+        assert my_completed(qid)[0]["status"] == "cancelled"
+
+        # --- client disconnect while queued ---
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        sock.sendall(f"GET /select/logsql/query?query={q} "
+                     f"HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        qid2 = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            queued = [a for a in activity.active_snapshot()
+                      if a["endpoint"] == "/select/logsql/query"
+                      and a["phase"] == "queued"]
+            if queued:
+                qid2 = queued[0]["qid"]
+                break
+            time.sleep(0.02)
+        assert qid2, "second query never appeared queued"
+        sock.close()       # the disconnect
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not my_active(qid2):
+                break
+            time.sleep(0.02)
+        assert not my_active(qid2), \
+            "disconnected queued query stayed in the registry"
+        assert my_completed(qid2)[0]["status"] == "abandoned"
+
+        # the whole point: neither queued query reached the device
+        assert runner.device_calls == d0, \
+            f"queued queries dispatched to the device " \
+            f"({runner.device_calls - d0} calls)"
+
+        # cleanup: kill the tail
+        for a in activity.active_snapshot():
+            if a["endpoint"] == "/select/logsql/tail":
+                _req(srv, "POST",
+                     f"/select/logsql/cancel_query?qid={a['qid']}")
+        t_tail.join(10)
+    finally:
+        srv.close()
+        storage.close()
+
+
 def test_top_queries_heavy_hitters(tmp_path, runner):
     srv, storage = _mk_server(tmp_path, runner)
     try:
@@ -464,6 +586,11 @@ def test_qid_correlates_trace_slowlog_and_registry(tmp_path, runner,
             assert qid
             slow = json.loads(lines[-1])
             assert slow["qid"] == qid
+            # the route-level record deregisters a beat after the
+            # terminal chunk reaches the client — poll for it
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not my_completed(qid):
+                time.sleep(0.02)
             assert my_completed(qid)[0]["endpoint"] == \
                 "/select/logsql/query"
         finally:

@@ -245,15 +245,18 @@ def test_select_queue_shedding_429(tmp_path):
     s = Storage(str(tmp_path / "shed"), retention_days=100000,
                 flush_interval=3600)
     node = VLServer(s, port=0, max_concurrent=1, max_queue_duration=0.2)
-    node._sem.acquire()  # exhaust the only slot
     try:
-        with pytest.raises(urllib.error.HTTPError) as ei:
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{node.port}/select/logsql/query?query=x",
-                timeout=10)
-        assert ei.value.code == 429
+        # exhaust the only slot through the admission controller (the
+        # raw semaphore this test used to pin is now sched/admission)
+        with node.admission.admit("0:0", endpoint="/test"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{node.port}"
+                    f"/select/logsql/query?query=x",
+                    timeout=10)
+            assert ei.value.code == 429
+            assert ei.value.headers.get("Retry-After")
     finally:
-        node._sem.release()
         node.close()
         s.close()
 

@@ -1,0 +1,672 @@
+"""Overload-safe query scheduling (victorialogs_tpu/sched): shared
+dispatch-budget fair queuing, per-tenant admission control with
+429-reason shedding, deadline-aware rejection, fault-injection drain
+paths (every scheduler lease balanced on every exit), and the HTTP
+surface (sched_config POST discipline, scheduler state on
+active_queries, rejection counters on /metrics)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from test_obs import parse_prometheus
+
+from victorialogs_tpu import sched
+from victorialogs_tpu.engine.searcher import run_query, run_query_collect
+from victorialogs_tpu.obs import activity
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+N_PARTS = 10                    # < datadb.DEFAULT_PARTS_TO_MERGE (15)
+ROWS_PER_PART = 400
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("schedstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    n = 0
+    for _pp in range(N_PARTS):
+        lr = LogRows(stream_fields=["app"])
+        for _i in range(ROWS_PER_PART):
+            g = n
+            n += 1
+            lr.add(TEN, T0 + g * 50_000_000, [
+                ("app", f"app{g % 4}"),
+                ("_msg", f"m {'error' if g % 3 == 0 else 'ok'} {g}"),
+                ("lvl", ["info", "warn", "error"][g % 3]),
+            ])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return BatchRunner()
+
+
+# ---------------- dispatch scheduler: fair queuing ----------------
+
+def test_global_budget_and_fair_grant(monkeypatch):
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "2")
+    s = sched.DispatchScheduler()
+    with s.device_slots(None, tenant="0:0") as a:
+        assert a.try_acquire() and a.try_acquire()
+        assert not a.try_acquire()          # budget exhausted
+        with s.device_slots(None, tenant="1:0") as b:
+            assert not b.try_acquire()
+            a.release()
+            # the freed slot goes to the flow furthest below its
+            # share: b (0 held) beats a (1 held)
+            assert b.try_acquire()
+            assert not a.try_acquire()
+        # b's scope exit released its lease
+        assert a.try_acquire()
+    assert s.check_balanced()
+
+
+def test_blocking_acquire_wakes_on_release(monkeypatch):
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "1")
+    s = sched.DispatchScheduler()
+    got = threading.Event()
+
+    def waiter():
+        with s.device_slots(None, tenant="1:0") as b:
+            b.acquire()
+            got.set()
+            b.release()
+
+    with s.device_slots(None, tenant="0:0") as a:
+        assert a.try_acquire()
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not got.is_set(), "waiter got a slot past the budget"
+        a.release()
+        t.join(timeout=5)
+        assert got.is_set(), "release did not wake the fair queue"
+    assert s.check_balanced()
+
+
+def test_weighted_shares(monkeypatch):
+    """A weight-2 tenant may hold 2 slots while a weight-1 waiter holds
+    1: grants equalize held/weight, not raw held."""
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "3")
+    sched.set_tenant_weight("7:0", 2.0)
+    try:
+        s = sched.DispatchScheduler()
+        with s.device_slots(None, tenant="7:0") as heavy, \
+                s.device_slots(None, tenant="8:0") as light:
+            assert heavy.try_acquire() and light.try_acquire()
+            # heavy at 1/2=0.5 normalized vs light 1/1=1.0: heavy is
+            # entitled to the next slot even with light present
+            assert heavy.try_acquire()
+            assert not light.try_acquire()  # budget (3) exhausted
+            # contended handoff: block light in the fair queue, then
+            # free one heavy slot — light (1/1) vs heavy (1/2): the
+            # slot must go to the waiting light flow
+            got = threading.Event()
+
+            def wait_light():
+                light.acquire()
+                got.set()
+
+            t = threading.Thread(target=wait_light, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            assert not got.is_set()
+            heavy.release()
+            t.join(5)
+            assert got.is_set()
+            heavy.release()
+            light.release()
+            light.release()
+        assert s.check_balanced()
+    finally:
+        sched.set_tenant_weight("7:0", 1.0)
+
+
+def test_scope_exit_drains_held_slots(monkeypatch):
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "4")
+    s = sched.DispatchScheduler()
+    with s.device_slots(None, tenant="0:0") as a:
+        assert a.try_acquire() and a.try_acquire() and a.try_acquire()
+        # no releases: the scope exit IS the drain path
+    assert s.check_balanced()
+    assert s.snapshot()["in_flight"] == 0
+
+
+def test_disabled_scheduler_grants_unconditionally(monkeypatch):
+    monkeypatch.setenv("VL_SCHED", "0")
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "1")
+    s = sched.DispatchScheduler()
+    with s.device_slots(None, tenant="0:0") as a:
+        for _ in range(8):                  # way past the budget
+            assert a.try_acquire()
+    assert s.check_balanced()
+
+
+# ---------------- admission control ----------------
+
+def test_tenant_limit_sheds_immediately():
+    c = sched.AdmissionController(max_concurrent=4, queue_timeout_s=5.0,
+                                  pool="t1")
+    c.set_tenant_limit("9:0", 1)
+    with c.admit("9:0", "/q"):
+        with pytest.raises(sched.AdmissionShed) as ei:
+            with c.admit("9:0", "/q"):
+                pass
+        assert ei.value.reason == "tenant_limit"
+        assert ei.value.status == 429
+        assert ei.value.retry_after >= 1.0
+    # other tenants unaffected
+    with c.admit("0:0", "/q"):
+        pass
+    assert c.snapshot()["active"] == 0
+
+
+def test_queue_full_sheds(monkeypatch):
+    monkeypatch.setenv("VL_QUEUE_MAX", "0")
+    c = sched.AdmissionController(max_concurrent=1, queue_timeout_s=5.0,
+                                  pool="t2")
+    with c.admit("0:0", "/q"):
+        with pytest.raises(sched.AdmissionShed) as ei:
+            with c.admit("1:0", "/q"):
+                pass
+        assert ei.value.reason == "queue_full"
+
+
+def test_queue_timeout_sheds():
+    c = sched.AdmissionController(max_concurrent=1,
+                                  queue_timeout_s=0.2, pool="t3")
+    with c.admit("0:0", "/q"):
+        t0 = time.monotonic()
+        with pytest.raises(sched.AdmissionShed) as ei:
+            with c.admit("1:0", "/q"):
+                pass
+        assert ei.value.reason == "queue_full"
+        assert 0.1 < time.monotonic() - t0 < 3.0
+    assert c.snapshot()["queued"] == 0
+
+
+def test_deadline_infeasible_sheds_up_front():
+    c = sched.AdmissionController(max_concurrent=1, queue_timeout_s=5.0,
+                                  pool="t4")
+    with c._cond:
+        c._note_done("/q", 5.0, 0)      # prime the duration EWMA
+    with c.admit("0:0", "/q"):
+        t0 = time.monotonic()
+        with pytest.raises(sched.AdmissionShed) as ei:
+            with c.admit("1:0", "/q", deadline_s=1.0):
+                pass
+        assert ei.value.reason == "deadline"
+        # rejected EARLY, not after queuing toward the deadline
+        assert time.monotonic() - t0 < 0.5
+        # an arrival whose deadline already passed sheds even cold
+        with pytest.raises(sched.AdmissionShed) as ei2:
+            with c.admit("1:0", "/other", deadline_s=0.0):
+                pass
+        assert ei2.value.reason == "deadline"
+
+
+def test_queued_entry_granted_fifo():
+    c = sched.AdmissionController(max_concurrent=1,
+                                  queue_timeout_s=5.0, pool="t5")
+    order = []
+    release = threading.Event()
+
+    def first():
+        with c.admit("0:0", "/q"):
+            order.append("first")
+            release.wait(5)
+
+    def second():
+        with c.admit("1:0", "/q"):
+            order.append("second")
+
+    t1 = threading.Thread(target=first, daemon=True)
+    t1.start()
+    while c.snapshot()["active"] < 1:
+        time.sleep(0.01)
+    t2 = threading.Thread(target=second, daemon=True)
+    t2.start()
+    while c.snapshot()["queued"] < 1:
+        time.sleep(0.01)
+    assert order == ["first"]
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert order == ["first", "second"]
+    assert c.snapshot()["active"] == 0
+
+
+def test_cancelled_while_queued_leaves_queue(storage):
+    """cancel_query on a QUEUED record removes it from the admission
+    queue before any work starts (the satellite regression is in
+    test_activity.py end-to-end; this is the controller-level pin)."""
+    c = sched.AdmissionController(max_concurrent=1,
+                                  queue_timeout_s=10.0, pool="t6")
+    results = {}
+
+    def queued():
+        with activity.track("/t/queued", "error", TEN) as act:
+            results["qid"] = act.qid
+            try:
+                with c.admit(act.tenant, "/q", act=act):
+                    results["admitted"] = True
+            except sched.AdmissionShed as e:
+                results["shed"] = e.reason
+                results["status"] = e.status
+
+    # occupy the only slot as a DIFFERENT tenant, so the queued 0:0
+    # query passes its per-tenant cap and genuinely queues
+    with c.admit("5:0", "/q"):
+        t = threading.Thread(target=queued, daemon=True)
+        t.start()
+        while c.snapshot()["queued"] < 1:
+            time.sleep(0.01)
+        assert activity.cancel(results["qid"])
+        t.join(5)
+    assert results.get("shed") == "cancelled"
+    assert results.get("status") == 499
+    assert "admitted" not in results
+    assert c.snapshot()["queued"] == 0
+
+
+def test_tail_lifetime_never_feeds_the_deadline_gate():
+    """A long /tail connection must not poison the duration EWMA: the
+    deadline-feasibility gate would otherwise shed every tail that has
+    to queue (connection lifetime != query run time)."""
+    from victorialogs_tpu.sched import admission as adm
+    c = sched.AdmissionController(max_concurrent=1, queue_timeout_s=0.3,
+                                  pool="t7")
+    with c._cond:
+        c._note_done("/select/logsql/tail", 600.0, 0)
+        assert c._run_estimate("/select/logsql/tail") == 0.0
+    # a queued tail with the default 30s budget sheds on queue timeout
+    # (queue_full), never on a bogus 600s "estimate" (deadline)
+    with c.admit("5:0", "/select/logsql/tail"):
+        with pytest.raises(sched.AdmissionShed) as ei:
+            with c.admit("0:0", "/select/logsql/tail", deadline_s=30.0):
+                pass
+    assert ei.value.reason == "queue_full"
+    # endpoint keyspace is hard-capped: path cycling lands in "other"
+    with c._cond:
+        for i in range(200):
+            c._note_done(f"/select/bogus-{i}", 0.01, 1)
+        assert len(c._dur_ewma) <= adm._ENDPOINT_MAX + 1
+
+
+def test_tenant_counter_cardinality_is_hard_capped(monkeypatch):
+    """Client-cycled tenant ids must not grow the admitted/rejected
+    maps (and /metrics) without bound."""
+    from victorialogs_tpu.sched import admission as adm
+    monkeypatch.setattr(adm, "_TENANT_MAX",
+                        max(len(adm._admitted_tenants),
+                            len(adm._rejected_tenants)) + 4)
+    for i in range(50):
+        adm._note_admitted(f"77{i}:0", pool="tcap")
+        adm.note_rejected(f"77{i}:0", "tenant_limit", pool="tcap")
+    assert len(adm._admitted_tenants) <= adm._TENANT_MAX + 1
+    assert len(adm._rejected_tenants) <= adm._TENANT_MAX + 1
+    assert adm._admitted.get(("tcap", adm._OVERFLOW), 0) >= 45
+
+
+def test_unwind_while_granted_releases_the_slot(monkeypatch):
+    """A BaseException landing between a concurrent grant and the
+    waiter's next poll must fold the slot back (otherwise the pool
+    shrinks permanently)."""
+    from victorialogs_tpu.sched import admission as adm
+    c = sched.AdmissionController(max_concurrent=1, queue_timeout_s=5.0,
+                                  pool="t8")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def occupant():
+        with c.admit("5:0", "/q"):
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=occupant, daemon=True)
+    t.start()
+    entered.wait(5)
+
+    class _Boom(BaseException):
+        pass
+
+    def wait_then_boom(self, w, t0):
+        # simulate: the grant lands, then the waiter's unwind begins
+        # before it can return (e.g. KeyboardInterrupt)
+        release.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not w.granted:
+            with c._cond:
+                c._grant_waiters()
+            time.sleep(0.01)
+        assert w.granted
+        raise _Boom()
+
+    monkeypatch.setattr(adm._Admission, "_wait", wait_then_boom)
+    with pytest.raises(_Boom):
+        with c.admit("0:0", "/q"):
+            pass
+    monkeypatch.undo()
+    t.join(5)
+    snap = c.snapshot()
+    assert snap["active"] == 0, snap
+    # capacity intact: the pool still admits
+    with c.admit("1:0", "/q"):
+        pass
+
+
+# ---------------- fault injection: drain + lease balance ----------------
+
+def test_injected_fault_errors_cleanly_and_balances(storage, runner):
+    baseline = run_query_collect(storage, [TEN], "error | fields _time",
+                                 runner=runner)
+    assert baseline
+    assert sched.check_balanced()
+
+    blocks = []
+    sched.inject_fault(0)
+    try:
+        with pytest.raises(sched.InjectedFaultError):
+            run_query(storage, [TEN], "error | fields _time",
+                      write_block=lambda br: blocks.append(br.nrows),
+                      runner=runner)
+    finally:
+        sched.clear_faults()
+    # the failed unit drained the window without downstream writes:
+    # strictly fewer blocks than the full walk produced
+    full_blocks = []
+    run_query(storage, [TEN], "error | fields _time",
+              write_block=lambda br: full_blocks.append(br.nrows),
+              runner=runner)
+    assert len(blocks) < len(full_blocks)
+    # every scheduler lease released on the error path, staging intact
+    assert sched.check_balanced(), sched.scheduler().snapshot()
+    assert runner.cache.check_balanced()
+    # and the query path is fully healthy afterwards: identical results
+    again = run_query_collect(storage, [TEN], "error | fields _time",
+                              runner=runner)
+    assert sorted(map(str, again)) == sorted(map(str, baseline))
+
+
+def test_fault_env_knob(storage, runner, monkeypatch):
+    monkeypatch.setenv("VL_FAULT_SUBMIT", "1")
+    with pytest.raises(sched.InjectedFaultError):
+        run_query_collect(storage, [TEN], "error | fields _time",
+                          runner=runner)
+    assert sched.check_balanced()
+    monkeypatch.setenv("VL_FAULT_SUBMIT", "0")
+    rows = run_query_collect(storage, [TEN], "error | fields _time",
+                             runner=runner)
+    assert rows
+    assert sched.check_balanced()
+
+
+def test_fault_in_registry_record_status(storage, runner):
+    sched.inject_fault(0)
+    try:
+        with pytest.raises(sched.InjectedFaultError):
+            with activity.track("/t/fault", "error", TEN) as act:
+                qid = act.qid
+                run_query_collect(storage, [TEN], "error",
+                                  runner=runner)
+    finally:
+        sched.clear_faults()
+    rec = [r for r in activity.completed_snapshot()
+           if r["qid"] == qid][0]
+    assert rec["status"] == "InjectedFaultError"
+    assert sched.check_balanced()
+
+
+# ---------------- concurrent queries: budget invariant ----------------
+
+def test_concurrent_queries_respect_global_budget(storage, runner,
+                                                  monkeypatch):
+    """4 concurrent device walks over the shared budget: the scheduler
+    never grants past VL_INFLIGHT_GLOBAL, everyone finishes, the pool
+    balances, and results stay bit-identical to solo."""
+    monkeypatch.setenv("VL_INFLIGHT_GLOBAL", "3")
+    monkeypatch.setenv("VL_INFLIGHT", "4")
+    qs = "error | stats by (app) count() c"
+    solo = sorted(map(str, run_query_collect(storage, [TEN], qs,
+                                             runner=runner)))
+    hwm = [0]
+    done = threading.Event()
+
+    def sampler():
+        while not done.is_set():
+            snap = sched.scheduler().snapshot()
+            hwm[0] = max(hwm[0], snap["in_flight"])
+            assert snap["in_flight"] <= snap["budget"]
+            time.sleep(0.002)
+
+    results: list = []
+    errors: list = []
+
+    def client(ci):
+        try:
+            with activity.track("/t/conc", qs, f"{ci % 2}:0"):
+                rows = run_query_collect(storage, [TEN], qs,
+                                         runner=runner)
+            results.append(sorted(map(str, rows)))
+        # vlint: allow-broad-except(test error channel)
+        except Exception as e:
+            errors.append(e)
+
+    st = threading.Thread(target=sampler, daemon=True)
+    st.start()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    done.set()
+    st.join(5)
+    assert not errors, errors
+    assert len(results) == 4
+    for got in results:
+        assert got == solo
+    assert sched.check_balanced(), sched.scheduler().snapshot()
+    assert 0 < hwm[0] <= 3
+
+
+# ---------------- HTTP surface ----------------
+
+def _req(srv, method, path, body=None, headers=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def _mk_server(tmp_path, runner, **kw):
+    from victorialogs_tpu.server.app import VLServer
+    storage = Storage(str(tmp_path / "data"), retention_days=100000,
+                      flush_interval=3600)
+    srv = VLServer(storage, listen_addr="127.0.0.1", port=0,
+                   runner=runner, **kw)
+    return srv, storage
+
+
+def _ingest(srv, n=60, account=0):
+    body = "\n".join(json.dumps({
+        "_time": T0 + i * NS,
+        "_msg": f"hello {'error' if i % 2 else 'ok'} {i}",
+        "app": "web",
+    }) for i in range(n))
+    status, _d, _h = _req(srv, "POST",
+                          "/insert/jsonline?_stream_fields=app",
+                          body=body.encode(),
+                          headers={"AccountID": str(account)})
+    assert status == 200
+    _req(srv, "GET", "/internal/force_flush")
+
+
+def test_http_shed_carries_reason_retry_after_and_counters(tmp_path,
+                                                           runner):
+    srv, storage = _mk_server(tmp_path, runner, max_concurrent=4)
+    try:
+        _ingest(srv)
+        # cap tenant 11:0 at 1 concurrent query via the runtime knob
+        st, _d, _h = _req(
+            srv, "POST",
+            "/select/logsql/sched_config?tenant=11:0&max_concurrent=1",
+            body=b"")
+        assert st == 200
+        # occupy the tenant's slot with a live tail
+        stop = threading.Event()
+
+        def tail():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}"
+                    f"/select/logsql/tail?query=*",
+                    headers={"AccountID": "11"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    while not stop.is_set():
+                        resp.fp.read1(1)
+            except (OSError, ValueError):
+                pass
+
+        t = threading.Thread(target=tail, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            _s, data, _h = _req(srv, "GET",
+                                "/select/logsql/active_queries")
+            if any(a["endpoint"] == "/select/logsql/tail"
+                   for a in json.loads(data)["data"]):
+                break
+            time.sleep(0.05)
+        q = urllib.parse.quote("error")
+        st, data, hdrs = _req(srv, "GET",
+                              f"/select/logsql/query?query={q}",
+                              headers={"AccountID": "11"})
+        assert st == 429
+        shed = json.loads(data)
+        assert shed["reason"] == "tenant_limit"
+        assert "error" in shed
+        assert int(hdrs["Retry-After"]) >= 1
+        # other tenants keep flowing
+        st, _d, _h = _req(srv, "GET",
+                          f"/select/logsql/query?query={q}&limit=5")
+        assert st == 200
+        # per-tenant rejection counter on /metrics
+        _s, data, _h = _req(srv, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples[
+            'vl_select_rejected_total{pool="select",'
+            'reason="tenant_limit",tenant="11:0"}'] >= 1
+        assert samples["vl_sched_dispatch_budget"] >= 1
+        assert 'vl_sched_queue_depth{pool="select"}' in samples
+        stop.set()
+        # end the tail so close() doesn't wait on it
+        for a in json.loads(
+                _req(srv, "GET",
+                     "/select/logsql/active_queries")[1])["data"]:
+            if a["endpoint"] == "/select/logsql/tail":
+                _req(srv, "POST",
+                     f"/select/logsql/cancel_query?qid={a['qid']}",
+                     body=b"")
+        t.join(10)
+    finally:
+        srv.close()
+        storage.close()
+
+
+def test_sched_config_post_only_and_validates(tmp_path, runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        st, _d, _h = _req(srv, "GET",
+                          "/select/logsql/sched_config?tenant=1:0")
+        assert st == 405
+        st, _d, _h = _req(srv, "POST", "/select/logsql/sched_config",
+                          body=b"")
+        assert st == 400
+        st, _d, _h = _req(
+            srv, "POST",
+            "/select/logsql/sched_config?tenant=1:0&weight=nope",
+            body=b"")
+        assert st == 400
+        st, data, _h = _req(
+            srv, "POST",
+            "/select/logsql/sched_config?tenant=1:0&weight=2.5"
+            "&max_concurrent=3", body=b"")
+        assert st == 200
+        obj = json.loads(data)
+        assert obj["weight"] == 2.5
+        assert obj["admission"]["tenant_limits"]["1:0"] == 3
+    finally:
+        srv.close()
+        storage.close()
+
+
+def test_storage_node_shed_propagates_as_429(tmp_path, runner):
+    """A storage node shedding a cluster sub-query must surface at the
+    frontend as AdmissionShed (-> HTTP 429 + Retry-After), not as a
+    generic IOError/500: overload propagates as overload."""
+    from victorialogs_tpu.server.cluster import NetSelectStorage
+    srv, storage = _mk_server(tmp_path, runner, max_concurrent=1,
+                              max_queue_duration=0.2)
+    try:
+        _ingest(srv)
+        net = NetSelectStorage([f"http://127.0.0.1:{srv.port}"])
+        # healthy path first
+        got = []
+        net.net_run_query([TEN], "error | limit 3",
+                          write_block=lambda br: got.append(br.nrows))
+        assert sum(got) == 3
+        # wait for the healthy sub-query's admission to fully drain
+        # (the node's handler thread may outlive the response briefly)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                srv.internal_admission.snapshot()["active"]:
+            time.sleep(0.02)
+        # saturate the node's internal pool AS ANOTHER TENANT (so the
+        # 0:0 sub-query passes its per-tenant cap and genuinely
+        # queues), then fan out: the sub-query queues past
+        # maxQueueDuration and sheds
+        with srv.internal_admission.admit("9:9", "/hold"):
+            with pytest.raises(sched.AdmissionShed) as ei:
+                net.net_run_query([TEN], "error | limit 3",
+                                  write_block=lambda br: None)
+        assert ei.value.reason in ("queue_full", "deadline")
+        assert ei.value.retry_after is not None
+    finally:
+        srv.close()
+        storage.close()
+
+
+def test_active_queries_exposes_scheduler_state(tmp_path, runner):
+    srv, storage = _mk_server(tmp_path, runner)
+    try:
+        _s, data, _h = _req(srv, "GET",
+                            "/select/logsql/active_queries")
+        obj = json.loads(data)
+        dispatch = obj["scheduler"]["dispatch"]
+        assert dispatch["budget"] >= 1
+        assert dispatch["in_flight"] == 0
+        pools = {a["pool"] for a in obj["scheduler"]["admission"]}
+        assert {"select", "internal"} <= pools
+    finally:
+        srv.close()
+        storage.close()

@@ -764,3 +764,100 @@ def test_accounting_discipline_repo_instrumentation_is_clean():
         found = [f for f in accounting.check(sf)
                  if not sf.allowed(f.checker, f.line)]
         assert found == [], [f.render() for f in found]
+
+
+def test_accounting_discipline_flags_unclosed_reuse():
+    out = lint("""
+        from victorialogs_tpu.obs import activity
+
+        def f():
+            t = activity.reuse_or_track("/x", "*", None)
+            return t
+    """)
+    assert "accounting-discipline" in checkers(out)
+
+
+# ---------------- lease discipline (victorialogs_tpu/sched API) -------------
+
+LEASE_BAD_CTOR = """
+    from victorialogs_tpu.sched.scheduler import _SlotScope
+
+    def f(s):
+        scope = _SlotScope(s, None, "0:0")
+        return scope
+"""
+
+LEASE_BAD_OPEN = """
+    from victorialogs_tpu import sched
+
+    def f():
+        slots = sched.device_slots(None)
+        slots.try_acquire()
+        return slots
+"""
+
+LEASE_GOOD = """
+    from victorialogs_tpu import sched
+
+    def f(run_unit):
+        with sched.device_slots(None) as slots:
+            slots.acquire()
+            try:
+                run_unit()
+            finally:
+                slots.release()
+"""
+
+
+def test_lease_discipline_flags_direct_construction():
+    out = lint(LEASE_BAD_CTOR)
+    assert "lease-discipline" in checkers(out)
+    assert any("_SlotScope(...)" in f.message for f in out)
+
+
+def test_lease_discipline_flags_unclosed_scope():
+    out = lint(LEASE_BAD_OPEN)
+    assert "lease-discipline" in checkers(out)
+    assert any("never drain" in f.message for f in out)
+
+
+def test_lease_discipline_clean_and_annotated():
+    assert "lease-discipline" not in checkers(lint(LEASE_GOOD))
+    annotated = """
+        from victorialogs_tpu import sched
+
+        def f():
+            # vlint: allow-lease-discipline(drained in a handle)
+            slots = sched.device_slots(None)
+            return slots
+    """
+    assert "lease-discipline" not in checkers(lint(annotated))
+
+
+def test_lease_discipline_skips_sched_package():
+    out = lint(LEASE_BAD_CTOR,
+               path="victorialogs_tpu/sched/scheduler.py")
+    assert "lease-discipline" not in checkers(out)
+
+
+def test_lease_discipline_repo_instrumentation_is_clean():
+    """The pipeline's slot leasing (the ONE consumer of device_slots)
+    must honor the context-manager scope discipline, and the sched
+    package itself must pass the lock-discipline pass."""
+    from tools.vlint.core import SourceFile
+    from tools.vlint import leases, locks
+    for rel in ("tpu/pipeline.py", "engine/searcher.py",
+                "server/app.py"):
+        path = os.path.join(REPO, "victorialogs_tpu", rel)
+        sf = SourceFile.parse(path,
+                              display_path=f"victorialogs_tpu/{rel}")
+        found = [f for f in leases.check(sf)
+                 if not sf.allowed(f.checker, f.line)]
+        assert found == [], [f.render() for f in found]
+    for rel in ("sched/scheduler.py", "sched/admission.py"):
+        path = os.path.join(REPO, "victorialogs_tpu", rel)
+        sf = SourceFile.parse(path,
+                              display_path=f"victorialogs_tpu/{rel}")
+        found = [f for f in locks.check(sf)
+                 if not sf.allowed(f.checker, f.line)]
+        assert found == [], [f.render() for f in found]

@@ -22,57 +22,30 @@ same annotation + baseline discipline as every other checker.
 
 from __future__ import annotations
 
-import ast
-
-from .core import Finding, SourceFile
-from .locks import _dotted
+from .core import Finding, SourceFile, check_ctx_discipline
 
 # the module that owns QueryActivity plays by its own rules
 _ACTIVITY_MODULE = "obs/activity.py"
 
-# calls that REGISTER a record and therefore must sit in a with-item
-_OPENERS = ("track",)
+_CTORS = {
+    "QueryActivity": "direct QueryActivity(...) construction — "
+                     "register records via the context-manager "
+                     "activity.track(...) API",
+}
+
+# calls that REGISTER (or adopt) a record and therefore must sit in a
+# with-item; reuse_or_track falls back to a fresh registration when no
+# ambient record exists, so it carries the same leak potential
+_OPENERS = {
+    name: "{name}(...) outside a with-statement — the record would "
+          "never deregister; register via `with activity.{name}(...) "
+          "as act:`"
+    for name in ("track", "reuse_or_track")
+}
 
 
 def check(sf: SourceFile) -> list[Finding]:
     if sf.path.replace("\\", "/").endswith(_ACTIVITY_MODULE):
         return []
-    findings: list[Finding] = []
-
-    # every Call node that is a with-item context expression
-    with_calls: set[int] = set()
-    for node in ast.walk(sf.tree):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                if isinstance(item.context_expr, ast.Call):
-                    with_calls.add(id(item.context_expr))
-
-    def walk(node, symbol: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            sym = symbol
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                sym = f"{symbol}.{child.name}" if symbol else child.name
-            if isinstance(child, ast.Call):
-                if isinstance(child.func, ast.Attribute):
-                    last = child.func.attr
-                else:
-                    last = _dotted(child.func).split(".")[-1]
-                if last == "QueryActivity":
-                    findings.append(Finding(
-                        "accounting-discipline", sf.path, child.lineno,
-                        sym,
-                        "direct QueryActivity(...) construction — "
-                        "register records via the context-manager "
-                        "activity.track(...) API"))
-                elif last in _OPENERS and id(child) not in with_calls:
-                    findings.append(Finding(
-                        "accounting-discipline", sf.path, child.lineno,
-                        sym,
-                        f"{last}(...) outside a with-statement — the "
-                        f"record would never deregister; register via "
-                        f"`with activity.{last}(...) as act:`"))
-            walk(child, sym)
-
-    walk(sf.tree, "")
-    return findings
+    return check_ctx_discipline(sf, "accounting-discipline", _CTORS,
+                                _OPENERS)

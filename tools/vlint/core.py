@@ -166,11 +166,58 @@ def iter_py_files(paths: list[str]) -> list[str]:
     return out
 
 
+def check_ctx_discipline(sf: "SourceFile", checker: str, ctors: dict,
+                         openers: dict) -> list[Finding]:
+    """Shared walker for the context-manager-only API checkers
+    (span- / accounting- / lease-discipline): flag direct constructor
+    calls (``ctors``: name -> message) and opener calls that are not
+    the context expression of a ``with`` item (``openers``: name ->
+    message template, formatted with ``{name}``).  One implementation
+    so a fix to the with-item detection applies to every discipline."""
+    from .locks import _dotted
+    findings: list[Finding] = []
+
+    # every Call node that is a with-item context expression
+    with_calls: set[int] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_calls.add(id(item.context_expr))
+
+    def walk(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, ast.Call):
+                # the receiver may itself be a call
+                # (tracing.current_span().span(...)), which _dotted
+                # can't render — the attribute name alone decides
+                if isinstance(child.func, ast.Attribute):
+                    last = child.func.attr
+                else:
+                    last = _dotted(child.func).split(".")[-1]
+                if last in ctors:
+                    findings.append(Finding(checker, sf.path,
+                                            child.lineno, sym,
+                                            ctors[last]))
+                elif last in openers and id(child) not in with_calls:
+                    findings.append(Finding(
+                        checker, sf.path, child.lineno, sym,
+                        openers[last].format(name=last)))
+            walk(child, sym)
+
+    walk(sf.tree, "")
+    return findings
+
+
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import accounting, hotpath, hygiene, locks, spans
+    from . import accounting, hotpath, hygiene, leases, locks, spans
     return [locks.check, hygiene.check, hotpath.check, spans.check,
-            accounting.check]
+            accounting.check, leases.check]
 
 
 def run_source(path: str, text: str, root: str = ".") -> list[Finding]:

@@ -1,0 +1,53 @@
+"""Lease-discipline checker (victorialogs_tpu/sched API hygiene).
+
+Scheduler slot leases follow the same context-manager-only contract as
+spans (obs/tracing.py) and activity records (obs/activity.py): the
+``device_slots(...)`` scope's with-block is what guarantees every
+dispatch-slot lease releases on every exit path (limit, deadline,
+cancel, abandon and fault-injection unwinds) — the global in-flight
+budget must stay balanced (``sched.check_balanced()``, mirrored by the
+fault-injection suite).  Two ways to break that, both flagged:
+
+- lease-discipline: direct ``_SlotScope(...)`` construction anywhere
+  outside victorialogs_tpu/sched/ — scopes must come from
+  ``sched.device_slots(...)``;
+- lease-discipline: a ``device_slots(...)`` call that is not the
+  context expression of a ``with`` item (assigned, passed, returned,
+  or bare) — such a scope's leases would survive a drain unwind and
+  wedge the shared budget.
+
+The raw ``acquire``/``release`` pair stays legal only INSIDE an open
+scope (the pipeline window holds leases across loop iterations —
+that's what the scope's exit-time drain exists for), so the checker
+polices scope creation, not the per-slot calls.
+
+Deliberate sites carry ``# vlint: allow-lease-discipline(<why>)``,
+same annotation + baseline discipline as every other checker.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, SourceFile, check_ctx_discipline
+
+# the package that owns the scope type plays by its own rules
+_SCHED_PKG = "victorialogs_tpu/sched/"
+
+_CTORS = {
+    "_SlotScope": "direct _SlotScope(...) construction — lease scopes "
+                  "come from the context-manager "
+                  "sched.device_slots(...) API",
+}
+
+# calls that OPEN a lease scope and therefore must sit in a with-item
+_OPENERS = {
+    "device_slots": "{name}(...) outside a with-statement — the "
+                    "scope's slot leases would never drain; open "
+                    "scopes via `with sched.{name}(...) as slots:`",
+}
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    if _SCHED_PKG in sf.path.replace("\\", "/"):
+        return []
+    return check_ctx_discipline(sf, "lease-discipline", _CTORS,
+                                _OPENERS)

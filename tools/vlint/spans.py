@@ -19,58 +19,27 @@ annotation + baseline discipline as every other checker.
 
 from __future__ import annotations
 
-import ast
-
-from .core import Finding, SourceFile
-from .locks import _dotted
+from .core import Finding, SourceFile, check_ctx_discipline
 
 # the module that owns the Span class plays by its own rules
 _TRACING_MODULE = "obs/tracing.py"
 
+_CTORS = {
+    "Span": "direct Span(...) construction — use tracing.make_root() "
+            "or the context-manager parent.span(...) API",
+}
+
 # calls that OPEN a span and therefore must sit in a with-item
-_OPENERS = ("span", "start_trace")
+_OPENERS = {
+    name: "{name}(...) outside a with-statement — the span would "
+          "never close; open spans via `with parent.{name}(...) as "
+          "sp:`"
+    for name in ("span", "start_trace")
+}
 
 
 def check(sf: SourceFile) -> list[Finding]:
     if sf.path.replace("\\", "/").endswith(_TRACING_MODULE):
         return []
-    findings: list[Finding] = []
-
-    # every Call node that is a with-item context expression
-    with_calls: set[int] = set()
-    for node in ast.walk(sf.tree):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                if isinstance(item.context_expr, ast.Call):
-                    with_calls.add(id(item.context_expr))
-
-    def walk(node, symbol: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            sym = symbol
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                sym = f"{symbol}.{child.name}" if symbol else child.name
-            if isinstance(child, ast.Call):
-                # the receiver may itself be a call
-                # (tracing.current_span().span(...)), which _dotted
-                # can't render — the attribute name alone decides
-                if isinstance(child.func, ast.Attribute):
-                    last = child.func.attr
-                else:
-                    last = _dotted(child.func).split(".")[-1]
-                if last == "Span":
-                    findings.append(Finding(
-                        "span-discipline", sf.path, child.lineno, sym,
-                        "direct Span(...) construction — use "
-                        "tracing.make_root() or the context-manager "
-                        "parent.span(...) API"))
-                elif last in _OPENERS and id(child) not in with_calls:
-                    findings.append(Finding(
-                        "span-discipline", sf.path, child.lineno, sym,
-                        f"{last}(...) outside a with-statement — the "
-                        f"span would never close; open spans via "
-                        f"`with parent.{last}(...) as sp:`"))
-            walk(child, sym)
-
-    walk(sf.tree, "")
-    return findings
+    return check_ctx_discipline(sf, "span-discipline", _CTORS,
+                                _OPENERS)

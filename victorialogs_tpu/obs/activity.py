@@ -52,7 +52,7 @@ from collections import deque
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "vl_query_activity", default=None)
 
-PHASES = ("plan", "prune", "scan", "harvest", "emit")
+PHASES = ("queued", "plan", "prune", "scan", "harvest", "emit")
 
 _COMPLETED_MAX = 256
 
@@ -109,6 +109,16 @@ class QueryActivity:
     def set_phase(self, phase: str) -> None:
         with self._mu:
             self.phase = phase
+
+    def relabel(self, endpoint: str = "", query: str = "") -> None:
+        """Refine the record's labels once the handler has canonical
+        values (the route-level admission layer registers with the raw
+        request strings before parsing — see reuse_or_track)."""
+        with self._mu:
+            if endpoint:
+                self.endpoint = endpoint
+            if query:
+                self.query = query
 
     def counter(self, key: str):
         with self._mu:
@@ -178,6 +188,9 @@ class _NoopActivity:
         pass
 
     def set_phase(self, phase) -> None:
+        pass
+
+    def relabel(self, endpoint="", query="") -> None:
         pass
 
     def counter(self, key):
@@ -313,6 +326,48 @@ def track(endpoint: str, query: str, tenant=None) -> _Track:
     way to mint a QueryActivity (context-manager-only, enforced by the
     vlint accounting-discipline checker)."""
     return _Track(endpoint, query, tenant)
+
+
+class _ReuseOrTrack:
+    """Reuse the ambient record (relabeling it with the handler's
+    canonical endpoint/query) or fall back to registering a new one.
+
+    The admission layer (server/app.py) registers the record at the
+    HTTP route — BEFORE query parsing, so a QUEUED query is already
+    visible in active_queries and cancellable by qid — and the handler
+    then enters its own tracking scope on the same thread.  Reusing
+    the ambient record keeps it ONE query = ONE record (per-tenant
+    select counters stay exact); handlers called without the route
+    layer (tests, embedded use) still self-register."""
+
+    __slots__ = ("_endpoint", "_query", "_tenant", "_inner")
+
+    def __init__(self, endpoint: str, query: str, tenant):
+        self._endpoint = endpoint
+        self._query = query
+        self._tenant = tenant
+        self._inner = None
+
+    def __enter__(self) -> QueryActivity:
+        act = _current.get()
+        if act is not None and act.enabled:
+            act.relabel(self._endpoint, self._query)
+            return act
+        self._inner = _Track(self._endpoint, self._query, self._tenant)
+        return self._inner.__enter__()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._inner is not None:
+            return self._inner.__exit__(exc_type, exc, tb)
+        return False
+
+
+def reuse_or_track(endpoint: str, query: str,
+                   tenant=None) -> _ReuseOrTrack:
+    """Handler-level tracking scope: reuse the route-registered ambient
+    record or register one (context-manager-only, enforced like
+    track)."""
+    return _ReuseOrTrack(endpoint, query, tenant)
 
 
 class _UseActivity:

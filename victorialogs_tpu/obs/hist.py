@@ -142,6 +142,21 @@ PRUNE_RATIO = histogram(
     "probe (the filter-index kill path)",
     (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))
 
+SCHED_QUEUE_WAIT = histogram(
+    "vl_sched_queue_wait_seconds",
+    "admission-queue wait before a query starts executing (0 = "
+    "admitted immediately; sched/admission.py)",
+    (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+     5.0, 10.0, 30.0))
+
+SLOT_WAIT = histogram(
+    "vl_sched_slot_wait_seconds",
+    "wait for a device dispatch submit slot from the shared "
+    "scheduler, incl. harvesting own units under contention "
+    "(sched/scheduler.py, leased per pipeline dispatch unit)",
+    (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+     0.05, 0.1, 0.25, 0.5, 1.0))
+
 MERGE_SECONDS = histogram(
     "vl_storage_merge_duration_seconds",
     "wall time of one background part merge (small/big tier "

@@ -25,14 +25,16 @@ from ..engine.searcher import QueryTimeoutError
 from ..obs import activity, hist
 from ..storage.storage import Storage
 from ..utils.memory import QueryMemoryError
+from .. import sched
 from .insertutil import (CommonParams, LocalLogRowsStorage,
-                         LogMessageProcessor)
+                         LogMessageProcessor, get_tenant_id)
 from . import vlinsert
 from .vlselect import (HTTPError, handle_facets, handle_field_names,
                        handle_field_values, handle_hits, handle_query,
                        handle_stats_query, handle_stats_query_range,
                        handle_stream_field_names, handle_stream_field_values,
-                       handle_stream_ids, handle_streams, handle_tail)
+                       handle_stream_ids, handle_streams, handle_tail,
+                       query_timeout_s)
 
 
 def escape_label_value(v: str) -> str:
@@ -117,8 +119,13 @@ class Metrics:
         add("vl_tpu_bloom_bank_max_bytes", bs["max_bytes"])
         # active-query registry: vl_active_queries by endpoint plus the
         # per-tenant select/ingest accounting the scheduler's admission
-        # control will consume (obs/activity.py)
+        # control consumes (obs/activity.py)
         for base, labels, v in activity.metrics_samples():
+            add(metric_name(base, **labels), v)
+        # scheduler surface: dispatch budget/in-flight gauges plus the
+        # per-tenant admitted/shed counters and admission-queue depth
+        # (victorialogs_tpu/sched)
+        for base, labels, v in sched.metrics_samples():
             add(metric_name(base, **labels), v)
         s = storage.update_stats()
         gauges = {
@@ -235,6 +242,10 @@ class BaseHTTPApp:
 
     def respond_stream(self, h, gen, ctype="application/x-ndjson") -> None:
         try:
+            # error paths that fire after this point (e.g. a storage
+            # node shedding mid-stream) must not write a second status
+            # line into the chunked body — see respond_shed
+            h._vl_streamed = True
             h.send_response(200)
             h.send_header("Content-Type", ctype)
             h.send_header("Transfer-Encoding", "chunked")
@@ -254,6 +265,9 @@ class BaseHTTPApp:
 
     # ---- routing ----
     def dispatch(self, h, body: bytes) -> None:
+        # per-request state: the handler object is reused across
+        # keep-alive requests on one connection
+        h._vl_streamed = False
         parsed = urllib.parse.urlparse(h.path)
         path = parsed.path
         args = {k: v[0] for k, v in
@@ -270,6 +284,11 @@ class BaseHTTPApp:
             self.metrics.inc("vl_http_errors_total")
             self.respond(h, e.status, "text/plain",
                          e.message.encode("utf-8"))
+        except sched.AdmissionShed as e:
+            # a storage node shed our sub-query (cluster.py surfaces
+            # its 429 as AdmissionShed): propagate overload AS
+            # overload, with the node's reason and Retry-After
+            self.respond_shed(h, e)
         except QueryTimeoutError as e:
             self.metrics.inc("vl_http_errors_total")
             self.respond(h, 503, "text/plain", str(e).encode("utf-8"))
@@ -375,6 +394,56 @@ class BaseHTTPApp:
         lmp.flush()
         self.respond_json(h, {"status": "ok", "ingested": n})
 
+    def respond_shed(self, h, e) -> None:
+        """429 (or 499 for cancelled-while-queued) with Retry-After and
+        the machine-readable reason body — the shed response contract
+        (sched/admission.py)."""
+        self.metrics.inc("vl_http_errors_total")
+        if e.reason == "queue_full":
+            # continuity with the pre-scheduler queue-timeout counter
+            self.metrics.inc("vl_http_request_queue_timeouts_total")
+        if getattr(h, "_vl_streamed", False):
+            # the 200 chunked headers are already on the wire (a
+            # storage node shed mid-stream): writing a 429 status line
+            # now would corrupt the chunked body — cut the connection
+            # so the client sees a truncated response, not garbage
+            h.close_connection = True
+            return
+        body = json.dumps({"error": e.message, "reason": e.reason},
+                          ensure_ascii=False).encode("utf-8")
+        try:
+            h.send_response(e.status)
+            h.send_header("Content-Type", "application/json")
+            if e.retry_after is not None:
+                h.send_header("Retry-After",
+                              str(max(1, int(e.retry_after))))
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            if h.command != "HEAD":
+                h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    @staticmethod
+    def _peer_gone(h):
+        """A zero-cost probe for 'the HTTP peer hung up': readable
+        socket + EOF on a peek.  Lets the admission queue drop entries
+        whose client is gone before any device work starts (pipelined
+        request bytes read as alive, which is correct)."""
+        import select as _select
+        import socket as _socket
+        sock = h.connection
+
+        def gone() -> bool:
+            try:
+                r, _w, _x = _select.select([sock], [], [], 0)
+                if not r:
+                    return False
+                return sock.recv(1, _socket.MSG_PEEK) == b""
+            except (OSError, ValueError):
+                return True
+        return gone
+
     def close(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -391,11 +460,18 @@ class VLServer(BaseHTTPApp):
         self.metrics = Metrics()
         self.runner = runner
         self.start_time = time.monotonic()
-        self._sem = threading.Semaphore(max_concurrent)
-        # internal (cluster) sub-queries get their own gate: a node acting
-        # as both frontend and storage node must not have frontend queries
-        # starve the sub-queries they themselves fan out
-        self._internal_sem = threading.Semaphore(max_concurrent)
+        # admission control (sched/admission.py) replaces the old raw
+        # FIFO semaphores: per-tenant concurrency/bytes limits, a
+        # bounded wait queue, deadline-aware shedding.  Internal
+        # (cluster) sub-queries get their own pool: a node acting as
+        # both frontend and storage node must not have frontend queries
+        # starve the sub-queries they themselves fan out.
+        self.admission = sched.AdmissionController(
+            max_concurrent=max_concurrent,
+            queue_timeout_s=max_queue_duration, pool="select")
+        self.internal_admission = sched.AdmissionController(
+            max_concurrent=max_concurrent,
+            queue_timeout_s=max_queue_duration, pool="internal")
         self.max_queue_duration = max_queue_duration
         if storage_nodes:
             # cluster mode: ingest shards to the nodes, queries
@@ -444,8 +520,35 @@ class VLServer(BaseHTTPApp):
         # Deliberately NOT behind the query semaphore: a saturated
         # server is exactly when operators need to see and kill queries.
         if path == "/select/logsql/active_queries":
+            # queued-but-not-admitted queries show up here too (phase
+            # "queued") — that is what makes them cancellable by qid —
+            # alongside the live scheduler state (budget, in-flight
+            # leases, admission pools)
             self.respond_json(h, {"status": "ok",
-                                  "data": activity.active_snapshot()})
+                                  "data": activity.active_snapshot(),
+                                  "scheduler": sched.snapshot()})
+            return
+        if path == "/select/logsql/sched_config":
+            # mutating (per-tenant QoS knobs): POST only, same
+            # discipline as cancel_query
+            if h.command != "POST":
+                raise HTTPError(405, "sched_config requires POST")
+            tenant = args.get("tenant", "")
+            if not tenant:
+                raise HTTPError(400, "missing tenant arg")
+            try:
+                if "weight" in args:
+                    sched.set_tenant_weight(tenant,
+                                            float(args["weight"]))
+                if "max_concurrent" in args:
+                    self.admission.set_tenant_limit(
+                        tenant, int(args["max_concurrent"]))
+            except ValueError as e:
+                raise HTTPError(400, f"invalid sched_config arg: {e}")
+            self.respond_json(h, {
+                "status": "ok", "tenant": tenant,
+                "weight": sched.tenant_weight(tenant),
+                "admission": self.admission.snapshot()})
             return
         if path == "/select/logsql/cancel_query":
             # destructive: POST only (a GET from a crawler/prefetcher
@@ -471,19 +574,28 @@ class VLServer(BaseHTTPApp):
                     n, by=args.get("by", "duration"))})
             return
 
-        # ---- queries (concurrency-gated with queue-timeout shedding;
-        # reference -search.maxQueueDuration — main.go:34-46) ----
+        # ---- queries (admission-controlled: per-tenant limits, a
+        # bounded queue with deadline-aware shedding — sched/admission;
+        # replaces the raw FIFO semaphore + -search.maxQueueDuration
+        # timeout of the reference main.go:34-46) ----
         if path.startswith("/select/"):
-            if not self._sem.acquire(timeout=self.max_queue_duration):
-                self.metrics.inc("vl_http_request_queue_timeouts_total")
-                raise HTTPError(
-                    429, f"query queued longer than "
-                    f"-search.maxQueueDuration={self.max_queue_duration}s; "
-                    f"too many concurrent queries")
-            try:
-                self.handle_select(h, path, args, headers)
-            finally:
-                self._sem.release()
+            # register the record BEFORE admission: a queued query is
+            # already visible in active_queries (phase "queued") and
+            # cancellable by qid; the handler reuses this record via
+            # activity.reuse_or_track, so counters stay one-per-query
+            tenant = get_tenant_id(headers, args)
+            with activity.track(path, args.get("query", ""),
+                                tenant) as act:
+                act.set_phase("queued")
+                try:
+                    with self.admission.admit(
+                            tenant=act.tenant, endpoint=path,
+                            deadline_s=query_timeout_s(args), act=act,
+                            disconnected=self._peer_gone(h)):
+                        act.set_phase("plan")
+                        self.handle_select(h, path, args, headers)
+                except sched.AdmissionShed as e:
+                    self.respond_shed(h, e)
             return
 
         # ---- cluster-internal endpoints ----
@@ -497,23 +609,26 @@ class VLServer(BaseHTTPApp):
             self.respond_json(h, {"status": "ok", "ingested": n})
             return
         if path == "/internal/select/query":
-            # same concurrency gate + shedding as /select/ — a storage node
-            # hammered by N frontends must shed, not pile up threads
+            # same admission gate + shedding as /select/ — a storage
+            # node hammered by N frontends must shed, not pile up
+            # threads; the shed 429 carries the reason body the
+            # frontend re-raises as AdmissionShed (cluster.py)
             from . import cluster
-            if not self._internal_sem.acquire(
-                    timeout=self.max_queue_duration):
-                self.metrics.inc("vl_http_request_queue_timeouts_total")
-                raise HTTPError(429, "too many concurrent queries")
+            tenant_lbl = (args.get("tenant") or "0:0").split(",")[0]
             try:
-                try:
-                    gen = cluster.handle_internal_select(
-                        self.storage, args, runner=self.runner)
-                except ValueError as e:
-                    raise HTTPError(400, str(e))
-                self.respond_stream(h, gen,
-                                    ctype="application/octet-stream")
-            finally:
-                self._internal_sem.release()
+                with self.internal_admission.admit(
+                        tenant=tenant_lbl, endpoint=path,
+                        deadline_s=query_timeout_s(args),
+                        disconnected=self._peer_gone(h)):
+                    try:
+                        gen = cluster.handle_internal_select(
+                            self.storage, args, runner=self.runner)
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                    self.respond_stream(h, gen,
+                                        ctype="application/octet-stream")
+            except sched.AdmissionShed as e:
+                self.respond_shed(h, e)
             return
 
         # ---- profiling (reference exposes net/http/pprof; we expose the

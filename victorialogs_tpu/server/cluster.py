@@ -28,8 +28,10 @@ import json
 import struct
 import threading
 import time
+import urllib.error
 import urllib.request
 
+from .. import sched
 from ..engine.block_result import BlockResult
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
 from ..obs import activity, tracing
@@ -477,6 +479,29 @@ class NetSelectStorage:
                                     stop.set()
                                     nsp.set("trace_truncated", True)
                                     return
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # the node's admission control shed this sub-query:
+                    # surface it as AdmissionShed so the frontend
+                    # responds 429 + Retry-After (overload propagates
+                    # as overload, not as an internal error)
+                    try:
+                        info = json.loads(
+                            e.read().decode("utf-8", "replace"))
+                    except (ValueError, OSError):
+                        info = {}
+                    try:
+                        retry = float(e.headers.get("Retry-After") or 1)
+                    except ValueError:
+                        retry = 1.0
+                    errors.append(sched.AdmissionShed(
+                        info.get("reason", "queue_full"),
+                        f"storage node {url} shed the sub-query: "
+                        f"{info.get('error', 'overloaded')}",
+                        retry_after=retry))
+                else:
+                    errors.append(IOError(f"{url}: HTTP {e.code}"))
+                stop.set()
             # collected errors re-raise on the caller thread after join
             # vlint: allow-broad-except(fan-out error channel)
             except Exception as e:
@@ -494,8 +519,13 @@ class NetSelectStorage:
             # Local typed errors (memory budget, deadline) raised by
             # head.write_block re-raise unwrapped so the HTTP layer maps
             # them to 422/503 exactly as in single-node mode; only genuine
-            # transport failures become IOError.
-            err = errors[0]
+            # transport failures become IOError.  A shed outranks other
+            # failures deterministically: the client must see 429 +
+            # Retry-After whenever ANY node shed, not only when that
+            # node's fetch thread happened to error first.
+            err = next((e for e in errors
+                        if isinstance(e, sched.AdmissionShed)),
+                       errors[0])
             if isinstance(err, (IOError, OSError)):
                 raise IOError(f"cluster query failed: {err}")
             raise err

@@ -103,17 +103,25 @@ def _apply_extra_filters(q: Query, ef: str) -> None:
 DEFAULT_MAX_QUERY_DURATION_S = 30.0
 
 
-def query_deadline(args) -> float:
-    """Monotonic deadline for one query: per-request `timeout` arg capped
-    by the -search.maxQueryDuration default (reference
-    app/vlselect/main.go:133-150, 277-287)."""
+def query_timeout_s(args) -> float:
+    """Seconds of time budget for one request: per-request `timeout`
+    arg capped by the -search.maxQueryDuration default.  Shared by the
+    execution deadline (query_deadline) and the admission controller's
+    deadline-aware shedding (server/app.py)."""
     t = args.get("timeout", "")
     secs = DEFAULT_MAX_QUERY_DURATION_S
     if t:
         d = parse_duration(t)
         if d is not None and d > 0:
             secs = min(d / 1e9, DEFAULT_MAX_QUERY_DURATION_S * 10)
-    return time.monotonic() + secs
+    return secs
+
+
+def query_deadline(args) -> float:
+    """Monotonic deadline for one query: per-request `timeout` arg capped
+    by the -search.maxQueryDuration default (reference
+    app/vlselect/main.go:133-150, 277-287)."""
+    return time.monotonic() + query_timeout_s(args)
 
 
 def _int_arg(args, name, default=0) -> int:
@@ -148,7 +156,10 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
     either way, with the qid correlating it to active_queries/traces."""
     root = _trace_root(args, q)
     t0 = time.monotonic()
-    with activity.track(endpoint, q.to_string(), tenants[0]) as act:
+    # reuse the record the admission layer registered (server/app.py);
+    # self-register when called without it (tests, embedded use)
+    with activity.reuse_or_track(endpoint, q.to_string(),
+                                 tenants[0]) as act:
         if root is not None:
             root.set("qid", act.qid)
         try:
@@ -198,11 +209,12 @@ def handle_query(storage, args, headers, runner=None):
     deadline = query_deadline(args)
 
     def gen():
-        # the registry record covers the whole response stream: it
-        # registers when the response starts iterating and deregisters
-        # on every exit path (done, deadline, disconnect)
-        with activity.track("/select/logsql/query", q.to_string(),
-                            tenants[0]) as act:
+        # the registry record covers the whole response stream: the
+        # admission layer's record is reused (or one registers when the
+        # response starts iterating) and deregisters on every exit
+        # path (done, deadline, disconnect)
+        with activity.reuse_or_track("/select/logsql/query",
+                                     q.to_string(), tenants[0]) as act:
             if root is not None:
                 root.set("qid", act.qid)
 
@@ -449,8 +461,8 @@ def handle_tail(storage, args, headers, stop_check=None, runner=None):
     # on its qid (or a client disconnect) ends the tail; the inner
     # polls inherit the record ambiently, so a cancel also drains a
     # poll that is mid-scan
-    with activity.track("/select/logsql/tail", q.to_string(),
-                        tenants[0]) as act:
+    with activity.reuse_or_track("/select/logsql/tail", q.to_string(),
+                                 tenants[0]) as act:
         try:
             yield from _tail_loop(storage, tenants, q, act, lag_ns,
                                   last_ts, stop_check, runner)

@@ -885,6 +885,8 @@ class BatchRunner:
         self.packed_parts = 0         # parts folded into super-dispatches
         self.inflight_hwm = 0          # in-flight window high-water mark
         self.host_sync_wait_s = 0.0    # time blocked materializing results
+        self.sched_slot_wait_s = 0.0   # time leasing dispatch slots from
+        #                                the shared scheduler (sched/)
         self.inflight_auto_depth = 0   # VL_INFLIGHT=auto chosen depth
         self.stats_shards = 1          # mesh runners stripe rows over >1
         # distinct dispatch shapes this runner has sent to the device —
@@ -941,6 +943,7 @@ class BatchRunner:
                 "packed_parts": self.packed_parts,
                 "inflight_hwm": self.inflight_hwm,
                 "host_sync_wait_s": self.host_sync_wait_s,
+                "sched_slot_wait_s": self.sched_slot_wait_s,
                 "inflight_auto_depth": self.inflight_auto_depth,
             }
         out.update({f"staging_cache_{k}": v

@@ -54,6 +54,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..obs import activity, hist, tracing
+from .. import sched
 from .kernels import pad_bucket
 
 # adaptive pack-size clamps: parts below the floor always pack (the
@@ -545,6 +546,13 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
             raise QueryTimeoutError(
                 "query exceeded -search.maxQueryDuration")
 
+    def _slot_check():
+        # runs on every fair-queue wait tick: a cancelled or
+        # over-deadline query must leave the queue, not hold its place
+        check_deadline()
+        if head.is_done():
+            raise QueryCancelled()
+
     f = q.filter
     depth = inflight_depth(runner)
     if inflight_auto():
@@ -626,6 +634,17 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
             # (?trace=1), not just in the bench.
             with hsp.span("device_sync"):
                 members = pending.harvest(sync)
+            # the dispatch is off the device: return the leased slot
+            # BEFORE the host-side emit so contending queries overlap
+            # their device work with our emit phase.  Known tradeoff:
+            # the OTHER window entries' leases stay held while emit
+            # runs, and a stalled streaming client (streamwork's
+            # bounded queue) can block emit — pinning up to depth-1
+            # slots per stalled query until its deadline/disconnect
+            # drain fires.  Bounded and self-healing, but a
+            # completion-driven release (harvest on dispatch-done
+            # callbacks) would free them earlier — ROADMAP follow-on.
+            slots.release()
             # _UnitReady units never dispatched (host gate / serial
             # fallback): their submit-to-harvest time is pure window
             # queue wait and must not pollute the device-RTT histogram
@@ -660,67 +679,102 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
             # consumer runs on the shared runner.
             runner.cost.observe_emit(emit_dt)
 
-    try:
-        with psp.span("pipeline", inflight_depth=depth) as plsp:
-            psp = plsp
-            while True:
-                refill()
-                if not lookahead:
-                    break
-                unit = lookahead.popleft()
-                check_deadline()
-                if head.is_done():
-                    raise QueryCancelled()
-                # deepened prefetch: stage every unit inside the
-                # window's lookahead, so part N+k's host decode/upload
-                # overlaps the scans of N..N+k-1 (packs prefetch as the
-                # pack, hitting the same #fl/#num staging keys the
-                # super-dispatch will use)
-                todo = [uj for uj in lookahead
-                        if uj.part.uid not in prefetched]
-                if todo:
-                    with psp.span("stage", units=len(todo)):
-                        for uj in todo:
-                            prefetched.add(uj.part.uid)
-                            runner.submit_prefetch(uj.part, f, stats_spec,
-                                                   cand_bis=list(uj.bss),
-                                                   fused=fused_pf)
-                while len(window) >= depth:
+    with sched.device_slots(act) as slots:
+        try:
+            with psp.span("pipeline", inflight_depth=depth) as plsp:
+                psp = plsp
+                while True:
+                    refill()
+                    if not lookahead:
+                        break
+                    unit = lookahead.popleft()
+                    check_deadline()
+                    if head.is_done():
+                        raise QueryCancelled()
+                    # deepened prefetch: stage every unit inside the
+                    # window's lookahead, so part N+k's host decode/
+                    # upload overlaps the scans of N..N+k-1 (packs
+                    # prefetch as the pack, hitting the same #fl/#num
+                    # staging keys the super-dispatch will use)
+                    todo = [uj for uj in lookahead
+                            if uj.part.uid not in prefetched]
+                    if todo:
+                        with psp.span("stage", units=len(todo)):
+                            for uj in todo:
+                                prefetched.add(uj.part.uid)
+                                runner.submit_prefetch(
+                                    uj.part, f, stats_spec,
+                                    cand_bis=list(uj.bss),
+                                    fused=fused_pf)
+                    # our own window's depth backpressure is NOT
+                    # scheduler wait: drain it untimed first, so the
+                    # slot-wait metric means what it says
+                    while len(window) >= depth:
+                        check_deadline()
+                        harvest_one()
+                    # lease the submit slot from the shared scheduler:
+                    # fast-path non-blocking grant (uncontended budget
+                    # behaves exactly like the per-query window); under
+                    # contention harvest our own oldest unit — freeing
+                    # a slot the fair queue hands to whoever is
+                    # furthest below their share — and block in the
+                    # queue only once nothing of ours is in flight
+                    t_w0 = time.perf_counter()
+                    while not slots.try_acquire():
+                        if window:
+                            check_deadline()
+                            harvest_one()
+                        else:
+                            with psp.span("sched_wait"):
+                                slots.acquire(check=_slot_check)
+                            break
+                    slot_wait_s = time.perf_counter() - t_w0
+                    hist.SLOT_WAIT.observe(slot_wait_s)
+                    runner._bump("sched_slot_wait_s", slot_wait_s)
+                    runner._bump("pipeline_units")
+                    hist.PACK_SIZE.observe(len(unit.members))
+                    with psp.span("submit", unit=seq,
+                                  blocks=len(unit.bss)) as ssp:
+                        if ssp.enabled:
+                            ssp.set("rows",
+                                    sum(bs.nrows
+                                        for bs in unit.bss.values()))
+                            ssp.set("slot_wait_s",
+                                    round(slot_wait_s, 6))
+                            if unit.pack:
+                                ssp.set("pack_size", len(unit.members))
+                                ssp.set("pack_members",
+                                        [str(p.uid)
+                                         for p, _b in unit.members])
+                            else:
+                                ssp.set("part", str(unit.part.uid))
+                        act.set_phase("scan")
+                        # test-only drain-path hook (inject_fault /
+                        # VL_FAULT_SUBMIT): raises AFTER the lease was
+                        # taken, pinning release-on-error
+                        sched.maybe_fail_submit()
+                        window.append((seq, unit, time.perf_counter(),
+                                       _submit(runner, f, unit,
+                                               stats_spec, sort_spec,
+                                               spec_seg)))
+                    seq += 1
+                    runner._bump_max("inflight_hwm", len(window))
+                    if act.enabled:
+                        act.add("dispatches_submitted")
+                        act.set("dispatches_in_flight", len(window))
+                while window:
                     check_deadline()
                     harvest_one()
-                runner._bump("pipeline_units")
-                hist.PACK_SIZE.observe(len(unit.members))
-                with psp.span("submit", unit=seq,
-                              blocks=len(unit.bss)) as ssp:
-                    if ssp.enabled:
-                        ssp.set("rows", sum(bs.nrows
-                                            for bs in unit.bss.values()))
-                        if unit.pack:
-                            ssp.set("pack_size", len(unit.members))
-                            ssp.set("pack_members",
-                                    [str(p.uid)
-                                     for p, _b in unit.members])
-                        else:
-                            ssp.set("part", str(unit.part.uid))
-                    act.set_phase("scan")
-                    window.append((seq, unit, time.perf_counter(),
-                                   _submit(runner, f, unit, stats_spec,
-                                           sort_spec, spec_seg)))
-                seq += 1
-                runner._bump_max("inflight_hwm", len(window))
-                if act.enabled:
-                    act.add("dispatches_submitted")
-                    act.set("dispatches_in_flight", len(window))
-            while window:
-                check_deadline()
-                harvest_one()
-            plsp.set("units", seq)
-    finally:
-        # cancellation/deadline drain: drop in-flight handles without
-        # writing anything downstream.  jax releases the device buffers
-        # when the dispatches complete, and every StagingCache entry is
-        # a complete, budget-accounted value (staged under its key lock),
-        # so the cache stays balanced for the next query.
-        window.clear()
-        act.set("dispatches_in_flight", 0)
-        stream.close()
+                plsp.set("units", seq)
+        finally:
+            # cancellation/deadline/fault drain: drop in-flight handles
+            # without writing anything downstream.  jax releases the
+            # device buffers when the dispatches complete, and every
+            # StagingCache entry is a complete, budget-accounted value
+            # (staged under its key lock), so the cache stays balanced
+            # for the next query; the device_slots scope releases every
+            # slot the dropped window still held, so the scheduler's
+            # global budget stays balanced too.
+            window.clear()
+            act.set("dispatches_in_flight", 0)
+            stream.close()
