@@ -6,7 +6,7 @@
 NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test lint bench bench-bloom bench-pipeline \
-	bench-concurrent bench-emit clean
+	bench-concurrent bench-emit bench-journal clean
 
 all: native
 
@@ -50,6 +50,13 @@ bench-concurrent:
 # path on the 32x2048 bench shape (fails under 2x — PERF.md)
 bench-emit:
 	python tools/bench_emit.py --json BENCH_emit.json
+
+# self-telemetry journal overhead: bench-pipeline rows workload with
+# the journal off (structurally zero, asserted) vs on (one query_done
+# event per query, ingested into the same storage); fails past the
+# PR 4 trace-overhead bound (10% + 2 ms) — PERF.md
+bench-journal:
+	python tools/bench_journal.py --json BENCH_journal.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

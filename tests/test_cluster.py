@@ -101,12 +101,17 @@ def cluster():
     try:
         storage_ports = []
         for k in range(2):
+            # 100y retention: the fixture's absolute 2026-07-28
+            # timestamps must never age past the default 7d window
+            # (they did — a wall-clock rollover flake)
             proc, port = _start_bound(
-                ["-storageDataPath", f"{tmp}/node{k}"])
+                ["-storageDataPath", f"{tmp}/node{k}",
+                 "-retentionPeriod", "100y"])
             procs.append(proc)
             storage_ports.append(port)
         front, front_port = _start_bound(
-            ["-storageDataPath", f"{tmp}/front"]
+            ["-storageDataPath", f"{tmp}/front",
+             "-retentionPeriod", "100y"]
             + sum((["-storageNode", f"http://127.0.0.1:{p}"]
                    for p in storage_ports), []))
         procs.append(front)
@@ -278,7 +283,8 @@ def test_cluster_matches_single_node(ingested, tmp_path_factory):
     import subprocess
 
     tmp = tempfile.mkdtemp(prefix="vlsingle")
-    single, port = _start_bound(["-storageDataPath", tmp])
+    single, port = _start_bound(["-storageDataPath", tmp,
+                                 "-retentionPeriod", "100y"])
     try:
         rows = []
         for i in range(N_ROWS):

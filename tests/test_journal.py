@@ -1,0 +1,492 @@
+"""Self-telemetry journal (obs/events.py + obs/journal.py): the event
+bus ingesting the database's own operational events back into storage
+under the reserved system tenant, queryable with LogsQL.
+
+Safety pins (the point of the subsystem):
+- end-to-end: an instrumented admission shed becomes a journal row with
+  correct {app, event} _stream fields, retrievable via a LogsQL
+  stats-pipe over the system tenant (engine-level AND over HTTP);
+- recursion guard: querying the system tenant emits NO new journal
+  rows (ambient-activity suppression + the bare-engine guard), and a
+  flush/merge of journal-only parts reports suppressed, not journaled;
+- bounded drop: a wedged flush (inject_flush_stall, the
+  sched.inject_fault-style hook) fills the queue; everything past
+  VL_JOURNAL_MAX_QUEUE drops with vl_journal_dropped_total EXACT and
+  the emitter never blocks;
+- clean shutdown: close() drains every accepted (non-dropped) event
+  into storage;
+- VL_JOURNAL=0: no subscriber, emit structurally free;
+- 429 sheds carry X-VL-Concurrency-Limit/-Current and vlagent's
+  retry hint honors them.
+"""
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from test_obs import parse_prometheus
+
+from victorialogs_tpu.engine.searcher import run_query_collect
+from victorialogs_tpu.obs import activity, events, journal
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+SYS_TEN = journal.SYSTEM_TENANT_ID
+
+
+def _mk_storage(tmp_path, name="jstore"):
+    return Storage(str(tmp_path / name), retention_days=100000,
+                   flush_interval=3600)
+
+
+def _journal_rows(storage, query, app):
+    """LogsQL over the system tenant, scoped to this test's app label
+    (each test journals under its own app so the process-global bus
+    can't bleed rows across tests)."""
+    return run_query_collect(
+        storage, [SYS_TEN],
+        query.replace("APP", app), timestamp=time.time_ns())
+
+
+# ---------------- end-to-end round trip ----------------
+
+def test_event_roundtrip_stream_fields_and_stats_pipe(tmp_path):
+    s = _mk_storage(tmp_path)
+    jw = journal.JournalWriter(s, flush_ms=50, app="test-rt")
+    try:
+        for i in range(6):
+            events.emit("admission_shed", tenant=f"{i % 2 + 7}:0",
+                        reason="tenant_limit" if i % 3 else "queue_full",
+                        endpoint="/select/logsql/query", pool="select",
+                        limit=4, current=4 + i)
+        jw.flush()
+        rows = _journal_rows(
+            s, '{app="APP",event="admission_shed"}', "test-rt")
+        assert len(rows) == 6
+        r = rows[0]
+        # _stream fields work naturally: {app, event} IS the stream
+        assert r["_stream"] == \
+            '{app="test-rt",event="admission_shed"}'
+        assert r["app"] == "test-rt"
+        assert r["event"] == "admission_shed"
+        assert r["reason"] in ("tenant_limit", "queue_full")
+        assert r["endpoint"] == "/select/logsql/query"
+        assert r["_msg"].startswith("admission_shed ")
+        # the engine we already built does the analytics: stats-pipe
+        # aggregation over the journal
+        agg = _journal_rows(
+            s, '{app="APP",event="admission_shed"} '
+               '| stats by (reason) count() hits', "test-rt")
+        by_reason = {r["reason"]: int(r["hits"]) for r in agg}
+        assert by_reason == {"tenant_limit": 4, "queue_full": 2}
+    finally:
+        jw.close()
+        s.close()
+
+
+def test_query_done_journaled_with_phase_timings(tmp_path):
+    s = _mk_storage(tmp_path)
+    # some real data for the query to scan
+    lr = LogRows(stream_fields=["app"])
+    for i in range(200):
+        lr.add(TenantID(0, 0), T0 + i * NS, [
+            ("app", "web"), ("_msg", f"msg error {i}")])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    jw = journal.JournalWriter(s, flush_ms=50, app="test-qd")
+    try:
+        with activity.track("/select/logsql/query", "error",
+                            TenantID(0, 0)) as act:
+            qid = act.qid
+            run_query_collect(s, [TenantID(0, 0)], "error | fields _time",
+                              timestamp=T0)
+        jw.flush()
+        rows = _journal_rows(
+            s, '{app="APP",event="query_done"}', "test-qd")
+        mine = [r for r in rows if r.get("qid") == qid]
+        assert len(mine) == 1, rows
+        r = mine[0]
+        assert r["endpoint"] == "/select/logsql/query"
+        assert r["status"] == "ok"
+        assert r["tenant"] == "0:0"
+        assert float(r["duration_ms"]) > 0
+        assert int(r["rows_scanned"]) > 0
+        assert int(r["bytes_scanned"]) > 0
+        # phase timings folded into the completion record: every
+        # phase the query visited carries its wall share
+        phase_keys = [k for k in r if k.startswith("phase_s_")]
+        assert phase_keys, r
+        assert sum(float(r[k]) for k in phase_keys) >= 0
+    finally:
+        jw.close()
+        s.close()
+
+
+# ---------------- recursion guard ----------------
+
+def test_querying_system_tenant_emits_no_journal_rows(tmp_path):
+    s = _mk_storage(tmp_path)
+    jw = journal.JournalWriter(s, flush_ms=50, app="test-guard")
+    try:
+        events.emit("http_error", path="/x", status=500, error="boom")
+        jw.flush()
+        assert len(_journal_rows(s, '{app="APP"}', "test-guard")) == 1
+        sup0 = events.counters()["suppressed"]
+        acc0 = jw.accepted
+        # bare engine entry (self-registers an activity record)
+        run_query_collect(s, [SYS_TEN], "*", timestamp=time.time_ns())
+        # registered route-style entry
+        with activity.track("/select/logsql/query", "*", SYS_TEN):
+            run_query_collect(s, [SYS_TEN], "*",
+                              timestamp=time.time_ns())
+        time.sleep(0.15)
+        assert jw.accepted == acc0, \
+            "a system-tenant query journaled its own completion"
+        assert events.counters()["suppressed"] > sup0, \
+            "suppression must be counted, not silent"
+        jw.flush()
+        assert len(_journal_rows(s, '{app="APP"}', "test-guard")) == 1
+    finally:
+        jw.close()
+        s.close()
+
+
+def test_journal_only_flush_and_merge_report_suppressed(tmp_path):
+    """A storage flush/merge triggered purely by journal rows is
+    counted, never re-journaled — the self-amplification breaker."""
+    s = _mk_storage(tmp_path)
+    jw = journal.JournalWriter(s, flush_ms=50, app="test-noamp")
+    try:
+        events.emit("fault_injected", kind="submit", submit_no=1,
+                    source="test")
+        jw.flush()
+        sup0 = events.counters()["suppressed"]
+        acc0 = jw.accepted
+        s.debug_flush()     # flushes ONLY journal rows
+        time.sleep(0.1)
+        assert events.counters()["suppressed"] > sup0, \
+            "journal-only flush event was not suppressed"
+        assert jw.accepted == acc0, \
+            "journal-only flush re-journaled itself"
+        # a flush with real-tenant rows in it IS journaled
+        lr = LogRows(stream_fields=["app"])
+        lr.add(TenantID(0, 0), time.time_ns(), [("app", "web"),
+                                                ("_msg", "hello")])
+        s.must_add_rows(lr)
+        s.debug_flush()
+        deadline = time.monotonic() + 5
+        while jw.accepted == acc0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert jw.accepted > acc0, "mixed flush should journal"
+        jw.flush()
+        rows = _journal_rows(
+            s, '{app="APP",event="storage_flush"}', "test-noamp")
+        assert rows and int(rows[-1]["rows"]) >= 1
+    finally:
+        jw.close()
+        s.close()
+
+
+def test_force_merge_journals_merge_and_part_gc(tmp_path):
+    s = _mk_storage(tmp_path)
+    ten = TenantID(0, 0)
+    for p in range(3):
+        lr = LogRows(stream_fields=["app"])
+        for i in range(50):
+            lr.add(ten, T0 + (p * 50 + i) * NS,
+                   [("app", "web"), ("_msg", f"m {i}")])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    jw = journal.JournalWriter(s, flush_ms=50, app="test-merge")
+    try:
+        s.must_force_merge()
+        jw.flush()
+        merges = _journal_rows(
+            s, '{app="APP",event="storage_merge"}', "test-merge")
+        assert merges, "force merge did not journal a storage_merge"
+        m = merges[-1]
+        assert m["level"] in ("small", "big")
+        assert int(m["parts"]) >= 2
+        assert float(m["duration_ms"]) >= 0
+        gcs = _journal_rows(
+            s, '{app="APP",event="part_gc"}', "test-merge")
+        assert gcs and int(gcs[-1]["parts"]) >= 2
+    finally:
+        jw.close()
+        s.close()
+
+
+# ---------------- bounded queue / wedged flush ----------------
+
+def test_bounded_drop_under_wedged_flush_exact(tmp_path):
+    s = _mk_storage(tmp_path)
+    jw = journal.JournalWriter(s, max_queue=32, flush_ms=10_000,
+                               app="test-drop")
+    try:
+        gate = threading.Event()
+        jw.inject_flush_stall(gate)
+        # wedge the flush thread mid-flush: it pops a first batch and
+        # blocks on the gate before touching storage
+        events.emit("http_error", path="/w", status=500, error="wedge")
+        jw._wake.set()
+        deadline = time.monotonic() + 5
+        while jw.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert jw.queue_depth() == 0, "flush thread never picked up"
+        # now fill the (empty) queue past its bound: exactly max_queue
+        # accepted, the remaining 20 dropped, and emit NEVER blocks
+        t0 = time.monotonic()
+        for i in range(32 + 20):
+            events.emit("http_error", path=f"/p{i}", status=500,
+                        error="x")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, "emit blocked on journal backpressure"
+        assert jw.dropped == 20, jw.stats()
+        assert jw.queue_depth() == 32
+        # /metrics sees the exact drop counter
+        samples = dict((base + (("{" + ",".join(
+            f'{k}="{v}"' for k, v in sorted(lbl.items())) + "}")
+            if lbl else ""), v)
+            for base, lbl, v in journal.metrics_samples())
+        assert samples["vl_journal_dropped_total"] >= 20
+        # un-wedge: accepted events all land; drops stay dropped
+        jw.inject_flush_stall(None)
+        gate.set()
+        jw.close()
+        rows = _journal_rows(s, '{app="APP"}', "test-drop")
+        assert len(rows) == jw.accepted == 1 + 32
+    finally:
+        s.close()
+
+
+def test_flush_failure_requeues_in_order_then_recovers(tmp_path):
+    """A failed sink write must not void accepted events: the batch
+    requeues at the front (exact accounting), the next flush retries,
+    and order is preserved end to end."""
+    s = _mk_storage(tmp_path)
+
+    class FlakySink:
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = True
+
+        def must_add_rows(self, lr):
+            if self.fail:
+                raise RuntimeError("sink down")
+            self.inner.must_add_rows(lr)
+
+    sink = FlakySink(s)
+    jw = journal.JournalWriter(sink, flush_ms=30, app="test-flaky")
+    try:
+        for i in range(5):
+            events.emit("http_error", path=f"/f{i}", status=500,
+                        error="x")
+        deadline = time.monotonic() + 5
+        while jw.flush_errors == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert jw.flush_errors >= 1
+        assert jw.rows_written == 0
+        assert jw.dropped == 0
+        assert jw.queue_depth() == 5, jw.stats()
+        sink.fail = False
+        deadline = time.monotonic() + 5
+        while jw.rows_written < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        jw.close()
+        rows = _journal_rows(s, '{app="APP"}', "test-flaky")
+        assert [r["path"] for r in rows] == [f"/f{i}" for i in range(5)]
+    finally:
+        s.close()
+
+
+def test_close_against_dead_sink_counts_dropped_exact(tmp_path):
+    class DeadSink:
+        def must_add_rows(self, lr):
+            raise RuntimeError("sink is gone")
+
+    jw = journal.JournalWriter(DeadSink(), flush_ms=60_000,
+                               app="test-dead")
+    for i in range(3):
+        events.emit("http_error", path=f"/d{i}", status=500, error="x")
+    jw.close()
+    # accepted == written + dropped: nothing silently voided
+    assert jw.accepted == 3
+    assert jw.rows_written == 0
+    assert jw.dropped == 3
+    assert jw.queue_depth() == 0
+
+
+def test_clean_shutdown_drains_accepted_events(tmp_path):
+    s = _mk_storage(tmp_path)
+    jw = journal.JournalWriter(s, flush_ms=60_000, app="test-shut")
+    # a flush interval of a minute: nothing flushes on its own — every
+    # row below must come from close()'s drain
+    for i in range(25):
+        events.emit("http_error", path=f"/s{i}", status=500, error="x")
+    assert jw.rows_written == 0
+    jw.close()
+    assert jw.dropped == 0
+    rows = _journal_rows(s, '{app="APP"}', "test-shut")
+    assert len(rows) == 25
+    s.close()
+
+
+# ---------------- kill-switch ----------------
+
+def test_vl_journal_0_disables_and_emit_is_free(tmp_path, monkeypatch):
+    monkeypatch.setenv("VL_JOURNAL", "0")
+    assert journal.maybe_start(None) is None
+    # every earlier writer close()d must have actually unsubscribed
+    # (bound-method equality — a leaked subscriber here means the
+    # journal-off path is never structurally free again)
+    assert events.subscriber_count() == 0
+    c0 = events.counters()
+    events.emit("http_error", path="/x", status=500, error="x")
+    # structurally zero: with no subscriber emit returns before
+    # counting, locking, or reading a clock
+    assert events.counters() == c0
+
+
+# ---------------- HTTP surface ----------------
+
+def _req(srv, method, path, body=None, headers=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def test_http_shed_journaled_with_concurrency_hints(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("VL_JOURNAL_FLUSH_MS", "50")
+    from victorialogs_tpu.server.app import VLServer
+    storage = _mk_storage(tmp_path, "httpstore")
+    srv = VLServer(storage, listen_addr="127.0.0.1", port=0)
+    try:
+        assert srv.journal is not None, "journal must default on"
+        body = "\n".join(json.dumps({
+            "_time": T0 + i * NS, "_msg": f"hello {i}", "app": "web",
+        }) for i in range(40))
+        st, _d, _h = _req(srv, "POST",
+                          "/insert/jsonline?_stream_fields=app",
+                          body=body.encode())
+        assert st == 200
+        # probes answer outside the admission gate
+        st, _d, _h = _req(srv, "GET", "/ready")
+        assert st == 200
+        st, _d, _h = _req(srv, "GET", "/health")
+        assert st == 200
+        # cap tenant 21:0 at 1, occupy it with a tail, then shed
+        st, _d, _h = _req(
+            srv, "POST",
+            "/select/logsql/sched_config?tenant=21:0&max_concurrent=1",
+            body=b"")
+        assert st == 200
+        stop = threading.Event()
+
+        def tail():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}"
+                    f"/select/logsql/tail?query=*",
+                    headers={"AccountID": "21"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    while not stop.is_set():
+                        resp.fp.read1(1)
+            except (OSError, ValueError):
+                pass
+
+        t = threading.Thread(target=tail, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            _s, data, _h = _req(srv, "GET",
+                                "/select/logsql/active_queries")
+            if any(a["endpoint"] == "/select/logsql/tail"
+                   for a in json.loads(data)["data"]):
+                break
+            time.sleep(0.05)
+        q = urllib.parse.quote("hello")
+        st, data, hdrs = _req(srv, "GET",
+                              f"/select/logsql/query?query={q}",
+                              headers={"AccountID": "21"})
+        assert st == 429
+        shed = json.loads(data)
+        assert shed["reason"] == "tenant_limit"
+        # the adaptive-backoff hints (satellite pin)
+        assert int(hdrs["X-VL-Concurrency-Limit"]) == 1
+        assert int(hdrs["X-VL-Concurrency-Current"]) >= 1
+        assert int(hdrs["Retry-After"]) >= 1
+        # the shed is in the journal, queryable over HTTP with the
+        # system tenant — by the engine that just shed
+        deadline = time.monotonic() + 10
+        found = []
+        while time.monotonic() < deadline and not found:
+            jq = urllib.parse.quote(
+                '{app="victorialogs-tpu",event="admission_shed"}')
+            st, data, _h = _req(
+                srv, "GET",
+                f"/select/logsql/query?query={jq}&limit=50",
+                headers={"AccountID": "0", "ProjectID": "4294967294"})
+            assert st == 200
+            found = [rec for ln in data.decode().splitlines() if ln
+                     for rec in [json.loads(ln)]
+                     if rec.get("tenant") == "21:0"]
+            if not found:
+                time.sleep(0.1)
+        assert found, "shed never appeared in the journal"
+        rec = found[0]
+        assert rec["reason"] == "tenant_limit"
+        assert rec["tenant"] == "21:0"
+        assert rec["event"] == "admission_shed"
+        # /metrics: journal counters + build info + uptime
+        _s, data, _h = _req(srv, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples["vl_journal_rows_written_total"] >= 1
+        assert samples["vl_journal_events_total"] >= 1
+        assert "vl_journal_dropped_total" in samples
+        assert "vl_journal_suppressed_total" in samples
+        assert "vl_trace_children_dropped_total" in samples
+        assert "vl_slowlog_emit_failures_total" in samples
+        assert "vl_top_queries_evicted_total" in samples
+        assert samples["vl_uptime_seconds"] > 0
+        build = [k for k in samples if k.startswith("vl_build_info{")]
+        assert build and samples[build[0]] == 1
+        stop.set()
+        for a in json.loads(
+                _req(srv, "GET",
+                     "/select/logsql/active_queries")[1])["data"]:
+            _req(srv, "POST",
+                 f"/select/logsql/cancel_query?qid={a['qid']}")
+        t.join(timeout=10)
+    finally:
+        srv.close()
+        storage.close()
+
+
+# ---------------- vlagent adaptive backoff ----------------
+
+def test_vlagent_honors_concurrency_hints():
+    from victorialogs_tpu.server.vlagent import RemoteWriteClient
+    hint = RemoteWriteClient._shed_hint(
+        {"Retry-After": "2", "X-VL-Concurrency-Limit": "4",
+         "X-VL-Concurrency-Current": "8"})
+    assert hint == pytest.approx(4.0)   # 2s scaled by 8/4 over-capacity
+    hint = RemoteWriteClient._shed_hint(
+        {"Retry-After": "2", "X-VL-Concurrency-Limit": "8",
+         "X-VL-Concurrency-Current": "2"})
+    assert hint == pytest.approx(1.0)   # freeing up: halves, never less
+    hint = RemoteWriteClient._shed_hint({"Retry-After": "3"})
+    assert hint == pytest.approx(3.0)   # no hints: plain Retry-After
+    hint = RemoteWriteClient._shed_hint({})
+    assert hint == pytest.approx(1.0)

@@ -22,7 +22,7 @@ import numpy as np
 from ..logsql.filters import (Filter, FilterAnd, FilterIn, FilterContainsAll,
                               FilterContainsAny, FilterNone, FilterNoop,
                               FilterNot, FilterOr, FilterStream, FilterTime)
-from ..obs import activity, tracing
+from ..obs import activity, events, tracing
 from ..logsql.parser import MAX_TS, MIN_TS, Query, parse_query
 from ..logsql.pipes import Processor, SinkProcessor
 from ..storage.log_rows import TenantID
@@ -176,6 +176,32 @@ def run_query(storage, tenants, q: Query | str, write_block=None,
         tenants = [tenants]
     tenants = tuple(tenants)
 
+    # self-telemetry recursion guard: a query AGAINST the reserved
+    # system tenant must not feed the journal it is reading.  Queries
+    # registered in the activity registry are suppressed ambiently
+    # (events.emit checks the record's tenant on EVERY worker thread —
+    # the record propagates into partition/pool workers via
+    # use_activity).  A bare engine-level entry with no record gets
+    # both halves here: a thread-local guard for this thread's extent
+    # AND a registered system-tenant record, so fan-out workers —
+    # which re-enter the record but not the thread-local — are
+    # suppressed too.
+    if not events.in_guard() and \
+            not activity.current_activity().enabled and \
+            any(activity.tenant_str(t) == events.SYSTEM_TENANT
+                for t in tenants):
+        with events.guarded(), \
+                activity.track("run_query", q.to_string(), tenants):
+            _run_query_guarded(storage, tenants, q, write_block,
+                               timestamp, runner, deadline)
+        return
+
+    _run_query_guarded(storage, tenants, q, write_block, timestamp,
+                       runner, deadline)
+
+
+def _run_query_guarded(storage, tenants, q, write_block, timestamp,
+                       runner, deadline) -> None:
     if hasattr(storage, "net_run_query"):
         # cluster mode: storage is a NetSelectStorage — scatter-gather the
         # query over the storage nodes (server/cluster.py)

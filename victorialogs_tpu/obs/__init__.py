@@ -1,8 +1,13 @@
 """Observability layer: per-query hierarchical tracing (tracing.py),
 fixed-bucket Prometheus histograms (hist.py), the slow-query log
-(slowlog.py), and the active-query registry with per-tenant resource
+(slowlog.py), the active-query registry with per-tenant resource
 accounting (activity.py — /select/logsql/active_queries, cancel_query,
-top_queries, vl_tenant_* /metrics series).
+top_queries, vl_tenant_* /metrics series), and the self-telemetry
+journal: a process-wide structured event bus (events.py) whose
+subscriber (journal.py) batches operational events — query
+completions, admission sheds, merges/flushes, faults, slow queries —
+into LogRows under the reserved system tenant (0, 0xFFFFFFFE), so the
+database's own behavior is LogsQL-queryable with the engine it ships.
 
 The tracing design constraint is that the DISABLED path must cost
 nothing measurable on the hot query path: `tracing.current_span()`

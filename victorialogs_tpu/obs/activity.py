@@ -49,6 +49,8 @@ import threading
 import time
 from collections import deque
 
+from . import events
+
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "vl_query_activity", default=None)
 
@@ -79,7 +81,7 @@ class QueryActivity:
 
     __slots__ = ("qid", "tenant", "endpoint", "query", "start_unix",
                  "start_mono", "phase", "abandoned", "_mu", "_c",
-                 "_cancel")
+                 "_cancel", "_phase_t0")
 
     enabled = True
 
@@ -96,6 +98,7 @@ class QueryActivity:
         self._mu = threading.Lock()
         self._c: dict = {}
         self._cancel = threading.Event()
+        self._phase_t0 = self.start_mono
 
     # -- progress counters (amortized: per unit/part/block, never per row) --
     def add(self, key: str, n=1) -> None:
@@ -107,8 +110,22 @@ class QueryActivity:
             self._c[key] = value
 
     def set_phase(self, phase: str) -> None:
+        # phase timings accumulate into the progress counters
+        # (phase_s_<name>) so the completion record — and its journal
+        # event — shows where the query's wall time went
+        now = time.monotonic()
         with self._mu:
-            self.phase = phase
+            if phase != self.phase:
+                self._fold_phase_locked(now)
+                self.phase = phase
+
+    def _fold_phase_locked(self, now: float) -> None:
+        """Close the running phase's timer into the counters (caller
+        holds _mu; deregistration path)."""
+        key = "phase_s_" + self.phase
+        self._c[key] = round(
+            self._c.get(key, 0.0) + (now - self._phase_t0), 6)
+        self._phase_t0 = now
 
     def relabel(self, endpoint: str = "", query: str = "") -> None:
         """Refine the record's labels once the handler has canonical
@@ -300,6 +317,7 @@ class _Track:
         else:
             status = "ok"
         with act._mu:
+            act._fold_phase_locked(time.monotonic())
             progress = dict(act._c)
         rec = {
             "qid": act.qid, "endpoint": act.endpoint,
@@ -313,11 +331,23 @@ class _Track:
         }
         with _reg_mu:
             _active.pop(act.qid, None)
+            if len(_completed) == _COMPLETED_MAX:
+                # the ring is full: this append evicts the oldest
+                # record — previously a silent truncation
+                events.note("top_queries_evicted")
             _completed.append(rec)
             slot = _tenant_slot(act.tenant)
             slot["select_queries"] += 1
             slot["select_seconds"] += duration
             slot["bytes_scanned"] += progress.get("bytes_scanned", 0)
+        # query-lifecycle completion onto the event bus (outside every
+        # lock; system-tenant completions are suppressed there — the
+        # journal must not journal queries against itself)
+        events.emit("query_done", tenant=act.tenant, qid=act.qid,
+                    endpoint=act.endpoint, status=status,
+                    duration_ms=round(duration * 1e3, 3),
+                    **{k: v for k, v in sorted(progress.items())
+                       if isinstance(v, (int, float))})
         return False
 
 

@@ -1,0 +1,205 @@
+"""Process-wide structured event bus: the database's own operational
+events as data.
+
+Every layer reports what it does through ``emit(event, **fields)`` —
+query completions (obs/activity.py deregister), admission sheds and
+sched_config changes (sched/admission.py), scheduler fault injections
+(sched/scheduler.py), storage merges/flushes/part GC (storage/
+datadb.py), bloom-bank budget declines (storage/filterbank.py), slow-
+query lines (obs/slowlog.py), pipeline window drains (tpu/pipeline.py)
+and HTTP server errors (server/app.py).  Subscribers (obs/journal.py's
+JournalWriter) turn those events into LogRows under the reserved
+system tenant so the database logs itself into itself, queryable with
+LogsQL — the VictoriaMetrics ecosystem's self-monitoring practice
+(PAPER.md L1 vendored logger/metrics) closed into a loop.
+
+Design constraints (the point of the subsystem):
+
+- **structurally zero-cost when off** — ``emit()``'s first action is a
+  single read of the subscriber tuple; with no subscriber (VL_JOURNAL=0
+  or simply no journal constructed) it returns before building
+  anything, taking a lock, or reading a clock.  Call-site kwargs are
+  the only residue, and every instrumented site fires at most once per
+  query / merge / shed — never per row or block;
+- **never block the caller** — subscribers must enqueue-or-drop;
+  a subscriber that raises is counted (``subscriber_errors``) and the
+  event is still delivered to the rest;
+- **recursion guard** — events produced while *handling* journal work
+  must not re-enter the journal: ``guarded()`` marks the current
+  thread (the journal's flush extent), and any event attributed to the
+  reserved system tenant — explicitly via ``tenant=`` or ambiently via
+  the active query record — is counted in ``suppressed`` instead of
+  delivered, so queries against the journal and journal-triggered
+  storage work cannot self-amplify.
+
+The bus also hosts the small process-wide truncation counters that
+previously vanished silently (``note()``): trace children dropped at
+MAX_CHILDREN, slow-query lines whose sink write failed, top_queries
+ring evictions.  ``metrics_samples()`` renders them (plus the bus's own
+emitted/suppressed totals) for server/app.py Metrics.render.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# reserved self-telemetry tenant: (AccountID 0, ProjectID 0xFFFFFFFE).
+# The project id sits at the top of the uint32 space where no real
+# client tenant lives; journal rows are invisible to every normal-
+# tenant query because block scans filter on the stream's TenantID
+# (engine/searcher.py tenant_set).
+SYSTEM_ACCOUNT_ID = 0
+SYSTEM_PROJECT_ID = 0xFFFFFFFE
+SYSTEM_TENANT = f"{SYSTEM_ACCOUNT_ID}:{SYSTEM_PROJECT_ID}"
+
+
+def journal_enabled() -> bool:
+    """VL_JOURNAL=0 is the kill-switch: server/app.py then never
+    constructs a JournalWriter, so the bus has no subscriber and every
+    emit() returns at its first instruction."""
+    return os.environ.get("VL_JOURNAL", "1") != "0"
+
+
+# subscribers are kept in an immutable tuple swapped under _subs_mu so
+# the emit hot path reads ONE global with no lock
+_subs_mu = threading.Lock()
+_subs: tuple = ()
+
+_tl = threading.local()
+
+_counts_mu = threading.Lock()
+# pre-seeded so /metrics always renders the full counter set (a scrape
+# of an idle server shows explicit zeros, not absent series)
+_counts: dict[str, int] = {
+    "emitted": 0,
+    "suppressed": 0,
+    "subscriber_errors": 0,
+    "trace_children_dropped": 0,
+    "slowlog_emit_failures": 0,
+    "top_queries_evicted": 0,
+}
+
+
+def subscribe(fn) -> None:
+    """Register fn(ts_ns, event, fields) — it runs on the EMITTER's
+    thread and must enqueue-or-drop, never block."""
+    global _subs
+    with _subs_mu:
+        if fn not in _subs:
+            _subs = _subs + (fn,)
+
+
+def unsubscribe(fn) -> None:
+    global _subs
+    with _subs_mu:
+        # equality, NOT identity: a bound method is a fresh object on
+        # every attribute access, so `is` would never match the one
+        # subscribe() stored (subscribe's dedup already relies on ==)
+        _subs = tuple(s for s in _subs if s != fn)
+
+
+def subscriber_count() -> int:
+    return len(_subs)
+
+
+class _Guard:
+    """Dynamic extent of journal-handling work on this thread: events
+    emitted inside are counted, not delivered (see module docstring)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_Guard":
+        _tl.depth = getattr(_tl, "depth", 0) + 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _tl.depth -= 1
+        return False
+
+
+def guarded() -> _Guard:
+    return _Guard()
+
+
+def in_guard() -> bool:
+    return getattr(_tl, "depth", 0) > 0
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _counts_mu:
+        _counts[key] = _counts.get(key, 0) + n
+
+
+def note(key: str, n: int = 1) -> None:
+    """Bump one of the process-wide truncation counters (they render as
+    vl_<key>_total on /metrics)."""
+    _count(key, n)
+
+
+def counters() -> dict:
+    with _counts_mu:
+        return dict(_counts)
+
+
+def emit(event: str, tenant=None, **fields) -> None:
+    """Report one operational event.  ``tenant`` (an 'a:p' string or
+    anything obs.activity.tenant_str accepts) attributes the event; the
+    system tenant's own events are suppressed (recursion guard).  The
+    remaining kwargs become the event's journal fields."""
+    subs = _subs
+    if not subs:
+        return
+    if getattr(_tl, "depth", 0):
+        _count("suppressed")
+        return
+    if tenant is not None:
+        tenant = tenant if isinstance(tenant, str) else _tenant_str(tenant)
+        if tenant == SYSTEM_TENANT:
+            _count("suppressed")
+            return
+        fields.setdefault("tenant", tenant)
+    else:
+        # ambient attribution: an event fired while executing a query
+        # against the system tenant (any worker thread — the activity
+        # record propagates via use_activity) must not re-journal
+        act = _ambient_activity()
+        if act is not None and act.enabled and \
+                act.tenant == SYSTEM_TENANT:
+            _count("suppressed")
+            return
+    # vlint: allow-wall-clock(journal rows carry real ingestion timestamps)
+    ts_ns = time.time_ns()
+    _count("emitted")
+    for fn in subs:
+        try:
+            fn(ts_ns, event, fields)
+        # vlint: allow-broad-except(a broken subscriber must never fail the emitting layer)
+        except Exception:
+            _count("subscriber_errors")
+
+
+def _tenant_str(tenant) -> str:
+    from . import activity
+    return activity.tenant_str(tenant)
+
+
+def _ambient_activity():
+    from . import activity
+    return activity.current_activity()
+
+
+def metrics_samples() -> list[tuple[str, dict, float]]:
+    """(base, labels, value) samples for Metrics.render: the bus totals
+    plus the previously-silent truncation counters."""
+    c = counters()
+    out = [
+        ("vl_journal_events_total", {}, c.pop("emitted", 0)),
+        ("vl_journal_suppressed_total", {}, c.pop("suppressed", 0)),
+        ("vl_journal_subscriber_errors_total", {},
+         c.pop("subscriber_errors", 0)),
+    ]
+    for key in sorted(c):
+        out.append((f"vl_{key}_total", {}, c[key]))
+    return out

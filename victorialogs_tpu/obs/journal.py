@@ -1,0 +1,301 @@
+"""Self-telemetry journal: bus events batched into LogRows and ingested
+through the normal storage path under the reserved system tenant.
+
+A :class:`JournalWriter` subscribes to obs/events.py and turns every
+delivered event into one log row:
+
+- tenant ``(0, 0xFFFFFFFE)`` (``events.SYSTEM_TENANT``) — invisible to
+  normal-tenant queries, queryable by setting AccountID/ProjectID;
+- ``_stream`` fields ``{app, event}`` so LogsQL stream filters work
+  naturally: ``{app="victorialogs-tpu",event="admission_shed"} | ...``;
+- every event field as a first-class log field (stats-pipe-able:
+  ``_time:1h {app="victorialogs-tpu",event="query_done"}
+  | stats by (endpoint) quantile(0.99, duration_ms)``);
+- ``_msg`` as a compact ``event k=v ...`` line for full-text search.
+
+Safety properties (the point of the subsystem — test-pinned in
+tests/test_journal.py):
+
+- **bounded queue, never block** — ``_on_event`` appends under a lock
+  or drops; ``dropped`` is the exact count (vl_journal_dropped_total).
+  A wedged flush (storage stall) fills the queue and everything past
+  VL_JOURNAL_MAX_QUEUE drops — the emitting query never waits;
+- **its own flush thread with its own deadline** — batches drain every
+  VL_JOURNAL_FLUSH_MS; a single flush that outlives
+  VL_JOURNAL_FLUSH_DEADLINE_MS is counted (``flushes_slow``) so a
+  stalling storage is visible on /metrics instead of silent;
+- **exempt from admission control** — rows go straight into the
+  configured sink's ``must_add_rows`` (the local Storage, or the
+  cluster NetInsertStorage on a frontend), never through the HTTP
+  admission gate: the journal must not be shed by the very overload it
+  is recording;
+- **recursion guard** — the flush extent runs under
+  ``events.guarded()``, so anything the ingest triggers synchronously
+  is counted, not re-journaled (suppression of system-tenant query
+  events lives in events.emit);
+- **clean shutdown** — ``close()`` unsubscribes, stops the thread and
+  drains every accepted (non-dropped) event into storage; a dead sink
+  at shutdown counts the remainder dropped instead of voiding it.
+
+Topology: the event bus is PROCESS-global, so the intended deployment
+is one JournalWriter per process (the server's).  Multiple servers in
+one process (in-process cluster tests) each journal every process-wide
+event into their own sink — harmless duplication in tests, not a
+production topology.  A writer owns a flush thread and a bus
+subscription: it must be ``close()``d (VLServer.close does).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+
+from . import events
+from ..storage.log_rows import LogRows, TenantID
+
+APP_NAME = "victorialogs-tpu"
+
+SYSTEM_TENANT_ID = TenantID(events.SYSTEM_ACCOUNT_ID,
+                            events.SYSTEM_PROJECT_ID)
+
+# field names the event schema owns; an event field colliding with one
+# is prefixed so it cannot corrupt the stream identity or timestamps
+_RESERVED = frozenset(("app", "event", "_time", "_msg", "_stream",
+                       "_stream_id"))
+
+_writers_mu = threading.Lock()
+_writers: "weakref.WeakSet[JournalWriter]" = weakref.WeakSet()
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class JournalWriter:
+    """One journal: bus subscription + bounded queue + flush thread
+    writing LogRows into a sink with ``must_add_rows``.
+
+    Construct via :func:`maybe_start` on servers (honors VL_JOURNAL);
+    tests construct directly against a bare Storage."""
+
+    def __init__(self, sink, max_queue: int | None = None,
+                 flush_ms: float | None = None, app: str = APP_NAME):
+        self.sink = sink
+        self.app = app
+        self.max_queue = max_queue if max_queue is not None else \
+            _env_int("VL_JOURNAL_MAX_QUEUE", 4096)
+        if flush_ms is None:
+            flush_ms = _env_int("VL_JOURNAL_FLUSH_MS", 500)
+        self.flush_s = max(0.01, flush_ms / 1e3)
+        self.flush_deadline_s = max(
+            self.flush_s,
+            _env_int("VL_JOURNAL_FLUSH_DEADLINE_MS", 5000) / 1e3)
+        self._mu = threading.Lock()
+        self._q: deque = deque()
+        # exact accounting (test-pinned): everything emitted to this
+        # writer is either accepted (and eventually written) or dropped
+        self.dropped = 0
+        self.accepted = 0
+        self.rows_written = 0
+        self.flushes = 0
+        self.flushes_slow = 0
+        self.flush_errors = 0
+        self._inflight = 0   # batch popped by the flush thread, mid-write
+        # test hook: a threading.Event the flush thread waits on before
+        # touching storage — simulates a wedged flush (sink stall) the
+        # same way sched.inject_fault simulates a failed submit
+        self._stall_gate = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="vl-journal", daemon=True)
+        events.subscribe(self._on_event)
+        self._thread.start()
+        with _writers_mu:
+            _writers.add(self)
+
+    # -- the bus subscriber (emitter's thread: enqueue-or-drop only) --
+
+    def _on_event(self, ts_ns: int, event: str, fields: dict) -> None:
+        with self._mu:
+            if len(self._q) >= self.max_queue:
+                self.dropped += 1
+                return
+            self._q.append((ts_ns, event, fields))
+            self.accepted += 1
+            depth = len(self._q)
+        if depth * 2 >= self.max_queue:
+            # early wake under pressure; the periodic tick handles the
+            # common trickle
+            self._wake.set()
+
+    # -- the flush thread --
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._flush_once()
+            # vlint: allow-broad-except(journal flusher must survive; errors counted)
+            except Exception:
+                self.flush_errors += 1
+
+    def inject_flush_stall(self, gate) -> None:
+        """Arm the wedged-flush hook: the next flush blocks on
+        ``gate.wait()`` before writing (None disarms)."""
+        self._stall_gate = gate
+
+    def _flush_once(self) -> None:
+        with self._mu:
+            if not self._q:
+                return
+            batch = list(self._q)
+            self._q.clear()
+            # visible to close(): a join-timeout must account for the
+            # batch this thread is holding mid-write
+            self._inflight = len(batch)
+        gate = self._stall_gate
+        if gate is not None:
+            gate.wait()
+        t0 = time.monotonic()
+        lr = LogRows(stream_fields=["app", "event"])
+        for ts_ns, event, fields in batch:
+            lr.add(SYSTEM_TENANT_ID, ts_ns, self._row_fields(event,
+                                                             fields))
+        try:
+            # the recursion guard: ingest work on THIS thread (datadb
+            # backpressure, inline drops, anything storage emits
+            # synchronously) is counted, never re-journaled
+            with events.guarded():
+                self.sink.must_add_rows(lr)
+        except BaseException:
+            # a failed write (read-only storage, cluster nodes down)
+            # must not silently void accepted events: requeue them at
+            # the FRONT so the next flush retries in order; whatever
+            # the bound can't take back is counted dropped — the
+            # accepted == written + dropped + queued invariant holds
+            with self._mu:
+                room = self.max_queue - len(self._q)
+                keep = batch[:max(room, 0)]
+                self.dropped += len(batch) - len(keep)
+                self._q.extendleft(reversed(keep))
+                self._inflight = 0
+            raise
+        took = time.monotonic() - t0
+        with self._mu:
+            self._inflight = 0
+        self.flushes += 1
+        if took > self.flush_deadline_s:
+            # a stalling storage must be visible, not silent: the
+            # flush deadline is observability, the bounded queue is
+            # the actual protection
+            self.flushes_slow += 1
+        self.rows_written += len(batch)
+
+    def _row_fields(self, event: str, fields: dict) -> list:
+        out = [("app", self.app), ("event", event)]
+        msg = [event]
+        for k in sorted(fields):
+            v = fields[k]
+            if isinstance(v, float):
+                v = format(v, ".6f").rstrip("0").rstrip(".") or "0"
+            elif not isinstance(v, str):
+                v = str(v)
+            if k in _RESERVED:
+                k = "f_" + k
+            out.append((k, v))
+            msg.append(f"{k}={v}")
+        out.append(("_msg", " ".join(msg)))
+        return out
+
+    # -- introspection / lifecycle --
+
+    def queue_depth(self) -> int:
+        with self._mu:
+            return len(self._q)
+
+    def stats(self) -> dict:
+        with self._mu:
+            depth = len(self._q)
+        return {
+            "queue_depth": depth, "max_queue": self.max_queue,
+            "accepted": self.accepted, "dropped": self.dropped,
+            "rows_written": self.rows_written, "flushes": self.flushes,
+            "flushes_slow": self.flushes_slow,
+            "flush_errors": self.flush_errors,
+        }
+
+    def flush(self) -> None:
+        """Synchronous drain (tests / shutdown): write everything
+        currently queued."""
+        self._flush_once()
+
+    def close(self) -> None:
+        """Unsubscribe, stop the thread, drain the queue.  Every event
+        accepted (not dropped) before close is in storage afterwards —
+        or, when the sink is already dead, counted dropped so the
+        accounting stays exact (never silently void)."""
+        events.unsubscribe(self._on_event)
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # a wedged flush outlived the join: the batch it holds is
+            # neither written nor queued — count it dropped so the
+            # accounting never silently under-reports.  (If the stuck
+            # write later lands, dropped over-counts by that batch —
+            # preferred over pretending nothing was lost.)
+            with self._mu:
+                self.dropped += self._inflight
+                self._inflight = 0
+        try:
+            self._flush_once()
+        # vlint: allow-broad-except(shutdown drain against an already-closed sink must not fail close; counted)
+        except Exception:
+            self.flush_errors += 1
+            # nothing will ever retry these: the requeued remainder is
+            # lost — say so in the drop counter
+            with self._mu:
+                self.dropped += len(self._q)
+                self._q.clear()
+        with _writers_mu:
+            _writers.discard(self)
+
+
+def maybe_start(sink) -> JournalWriter | None:
+    """The server-side constructor: a JournalWriter when VL_JOURNAL is
+    enabled (default), None when killed — the disabled path then has no
+    bus subscriber and emit() is structurally free."""
+    if not events.journal_enabled():
+        return None
+    return JournalWriter(sink)
+
+
+def metrics_samples() -> list[tuple[str, dict, float]]:
+    """Aggregate journal samples for Metrics.render (summed over live
+    writers — normally exactly one per process)."""
+    with _writers_mu:
+        writers = list(_writers)
+    agg = {"queue_depth": 0, "dropped": 0, "rows_written": 0,
+           "flushes": 0, "flushes_slow": 0, "flush_errors": 0}
+    for w in writers:
+        s = w.stats()
+        for k in agg:
+            agg[k] += s[k]
+    return [
+        ("vl_journal_queue_depth", {}, agg["queue_depth"]),
+        ("vl_journal_dropped_total", {}, agg["dropped"]),
+        ("vl_journal_rows_written_total", {}, agg["rows_written"]),
+        ("vl_journal_flushes_total", {}, agg["flushes"]),
+        ("vl_journal_flushes_slow_total", {}, agg["flushes_slow"]),
+        ("vl_journal_flush_errors_total", {}, agg["flush_errors"]),
+    ]

@@ -23,6 +23,8 @@ import os
 import sys
 import time
 
+from . import events
+
 _sink = None
 
 
@@ -74,9 +76,22 @@ def maybe_log(endpoint: str, query: str, duration_s: float,
         if root.attrs:
             rec["attrs"] = root.attrs
     line = json.dumps(rec, ensure_ascii=False, separators=(",", ":"))
+    # the same record rides the event bus into the self-telemetry
+    # journal (obs/journal.py), so slow queries are LogsQL-queryable
+    # over hours instead of scrolling off stderr; the bus suppresses
+    # system-tenant queries (recursion guard) via the ambient record
+    events.emit("slow_query", endpoint=endpoint, qid=qid or "",
+                duration_ms=rec["duration_ms"],
+                threshold_ms=thr, query=query)
     sink = _sink
-    if sink is not None:
-        sink(line)
-    else:
-        sys.stderr.write(line + "\n")
+    try:
+        if sink is not None:
+            sink(line)
+        else:
+            sys.stderr.write(line + "\n")
+    # vlint: allow-broad-except(a dead sink must not fail the query; counted)
+    except Exception:
+        # previously silent: a failing sink write now shows up as
+        # vl_slowlog_emit_failures_total on /metrics
+        events.note("slowlog_emit_failures")
     return True

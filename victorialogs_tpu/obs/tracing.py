@@ -38,6 +38,8 @@ import contextvars
 import threading
 import time
 
+from . import events
+
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "vl_trace_span", default=None)
 
@@ -107,6 +109,7 @@ class Span:
             self.children.append(tree)
         else:
             self.add("children_dropped")
+            events.note("trace_children_dropped")
 
     # -- lifecycle --
     def close(self) -> None:
@@ -192,6 +195,7 @@ class _SpanCtx:
             parent.children.append(sp)
         else:
             parent.add("children_dropped")
+            events.note("trace_children_dropped")
         self._span = sp
         self._token = _current.set(sp)
         return sp

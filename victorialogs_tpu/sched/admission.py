@@ -45,7 +45,7 @@ import threading
 import time
 import weakref
 
-from ..obs import hist
+from ..obs import events, hist
 
 REASONS = ("tenant_limit", "queue_full", "deadline", "cancelled")
 
@@ -77,15 +77,21 @@ class AdmissionShed(Exception):
     """A query was refused admission.  ``reason`` is machine-readable
     (tenant_limit | queue_full | deadline, plus cancelled for a queued
     entry killed before it started); ``retry_after`` feeds the
-    Retry-After response header."""
+    Retry-After response header.  ``limit``/``current`` (when known)
+    feed the X-VL-Concurrency-Limit/-Current response headers so
+    clients (vlagent) can back off adaptively instead of sleeping a
+    fixed Retry-After — the reference's X-Concurrency hint style."""
 
     def __init__(self, reason: str, message: str,
-                 retry_after: float | None = 1.0, status: int = 429):
+                 retry_after: float | None = 1.0, status: int = 429,
+                 limit: int | None = None, current: int | None = None):
         super().__init__(message)
         self.reason = reason
         self.message = message
         self.retry_after = retry_after
         self.status = status
+        self.limit = limit
+        self.current = current
 
 
 def _env_int(name: str, default: int) -> int:
@@ -217,6 +223,11 @@ class AdmissionController:
                 self._tenant_limits.pop(tenant, None)
             else:
                 self._tenant_limits[tenant] = max_concurrent
+        # config changes are audit events: who got capped to what,
+        # queryable from the journal long after the fact
+        events.emit("sched_config", pool=self.pool,
+                    config_tenant=str(tenant),
+                    max_concurrent=max_concurrent)
 
     def _tenant_cap(self, tenant: str) -> int:
         return self._tenant_limits.get(tenant, self._tenant_max_default)
@@ -329,10 +340,25 @@ class _Admission:
         self._t_admit = 0.0
         self._est_bytes = 0
 
-    def _shed(self, reason: str, message: str,
-              retry_after: float) -> AdmissionShed:
-        note_rejected(self._tenant, reason, pool=self._c.pool)
-        return AdmissionShed(reason, message, retry_after=retry_after)
+    def _shed(self, reason: str, message: str, retry_after: float,
+              limit: int | None = None,
+              current: int | None = None) -> AdmissionShed:
+        c = self._c
+        if limit is None:
+            limit = c._max
+        if current is None:
+            current = c._active
+        note_rejected(self._tenant, reason, pool=c.pool)
+        # sheds are exactly what the self-telemetry journal exists to
+        # record: `tail` them live, stats-pipe them by tenant/reason
+        # over hours.  Journal ingest bypasses admission entirely, so
+        # this event survives the very overload it reports.
+        events.emit("admission_shed", tenant=self._tenant,
+                    reason=reason, endpoint=self._endpoint, pool=c.pool,
+                    limit=limit, current=current,
+                    retry_after_s=round(retry_after or 0.0, 3))
+        return AdmissionShed(reason, message, retry_after=retry_after,
+                             limit=limit, current=current)
 
     def _cancel_probe(self) -> str | None:
         """'cancelled' / 'abandoned' when the queued entry should leave
@@ -359,7 +385,9 @@ class _Admission:
                     f"tenant {self._tenant} at its concurrency limit "
                     f"({cap}); adjust VL_TENANT_MAX_CONCURRENT or the "
                     f"sched_config override",
-                    retry_after=max(1.0, c._run_estimate(self._endpoint)))
+                    retry_after=max(1.0, c._run_estimate(self._endpoint)),
+                    limit=cap,
+                    current=c._tenant_active.get(self._tenant, 0))
             if c._tenant_max_bytes > 0:
                 est = c._bytes_estimate(self._endpoint)
                 if c._tenant_bytes.get(self._tenant, 0) + est > \
@@ -491,6 +519,9 @@ class _Admission:
                         act.abandon()
                 note_rejected(self._tenant, "cancelled",
                               pool=c.pool)
+                events.emit("admission_shed", tenant=self._tenant,
+                            reason="cancelled",
+                            endpoint=self._endpoint, pool=c.pool)
                 raise AdmissionShed(
                     "cancelled",
                     "query cancelled while queued for admission",

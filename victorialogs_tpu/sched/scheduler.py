@@ -49,6 +49,8 @@ import os
 import threading
 import time
 
+from ..obs import events
+
 
 class InjectedFaultError(RuntimeError):
     """A dispatch submit failed via the fault-injection hook."""
@@ -82,6 +84,7 @@ def set_tenant_weight(tenant: str, weight: float) -> None:
     w = max(0.01, float(weight))
     with _weights_mu:
         _weight_overrides[str(tenant)] = w
+    events.emit("sched_config", config_tenant=str(tenant), weight=w)
 
 
 def tenant_weight(tenant: str) -> float:
@@ -352,6 +355,11 @@ def maybe_fail_submit() -> None:
         if hit:
             _fault_targets.remove(n)
     if hit:
+        # fault injections are journal events too: a chaos run's
+        # injected failures correlate with the query_done error
+        # records they caused, by qid/time
+        events.emit("fault_injected", kind="submit", submit_no=n,
+                    source="inject_fault")
         raise InjectedFaultError(
             f"injected dispatch submit fault (submit #{n})")
     p = os.environ.get("VL_FAULT_SUBMIT", "")
@@ -363,6 +371,8 @@ def maybe_fail_submit() -> None:
         if prob > 0:
             import random
             if prob >= 1.0 or random.random() < prob:
+                events.emit("fault_injected", kind="submit",
+                            submit_no=n, source="VL_FAULT_SUBMIT")
                 raise InjectedFaultError(
                     f"injected dispatch submit fault "
                     f"(VL_FAULT_SUBMIT={prob})")

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 from ..engine.searcher import QueryTimeoutError
-from ..obs import activity, hist
+from ..obs import activity, events, hist, journal
 from ..storage.storage import Storage
 from ..utils.memory import QueryMemoryError
 from .. import sched
@@ -93,7 +93,7 @@ class Metrics:
                 "{name=\"" + escape_label_value(name) + "\"}"
         return m.group(1), m.group(2) or ""
 
-    def render(self, storage: Storage, runner=None) -> str:
+    def render(self, storage: Storage, runner=None, server=None) -> str:
         # base name -> {labels_str -> value}; insertion-ordered so each
         # metric's samples stay contiguous under its TYPE line
         metrics: dict[str, dict[str, float]] = {}
@@ -127,6 +127,19 @@ class Metrics:
         # (victorialogs_tpu/sched)
         for base, labels, v in sched.metrics_samples():
             add(metric_name(base, **labels), v)
+        # self-telemetry: event-bus totals + the previously-silent
+        # truncation counters (obs/events.py) and the journal writer's
+        # queue/drop/write accounting (obs/journal.py)
+        for base, labels, v in events.metrics_samples():
+            add(metric_name(base, **labels), v)
+        for base, labels, v in journal.metrics_samples():
+            add(metric_name(base, **labels), v)
+        if server is not None:
+            from .. import __version__
+            add(metric_name("vl_build_info", version=__version__,
+                            app="victorialogs-tpu"), 1)
+            add("vl_uptime_seconds",
+                round(time.monotonic() - server.start_time, 3))
         s = storage.update_stats()
         gauges = {
             "vl_partitions": s["partitions"],
@@ -282,6 +295,8 @@ class BaseHTTPApp:
             self.route(h, path, args, body, ctype)
         except HTTPError as e:
             self.metrics.inc("vl_http_errors_total")
+            events.emit("http_error", path=path, status=e.status,
+                        error=e.message)
             self.respond(h, e.status, "text/plain",
                          e.message.encode("utf-8"))
         except sched.AdmissionShed as e:
@@ -291,9 +306,13 @@ class BaseHTTPApp:
             self.respond_shed(h, e)
         except QueryTimeoutError as e:
             self.metrics.inc("vl_http_errors_total")
+            events.emit("http_error", path=path, status=503,
+                        error=str(e))
             self.respond(h, 503, "text/plain", str(e).encode("utf-8"))
         except QueryMemoryError as e:
             self.metrics.inc("vl_http_errors_total")
+            events.emit("http_error", path=path, status=422,
+                        error=str(e))
             self.respond(h, 422, "text/plain", str(e).encode("utf-8"))
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -302,6 +321,8 @@ class BaseHTTPApp:
             import traceback
             traceback.print_exc()
             self.metrics.inc("vl_http_errors_total")
+            events.emit("http_error", path=path, status=500,
+                        error=f"{type(e).__name__}: {e}")
             self.respond(h, 500, "text/plain", str(e).encode("utf-8"))
 
     @staticmethod
@@ -409,14 +430,28 @@ class BaseHTTPApp:
             # so the client sees a truncated response, not garbage
             h.close_connection = True
             return
-        body = json.dumps({"error": e.message, "reason": e.reason},
-                          ensure_ascii=False).encode("utf-8")
+        obj = {"error": e.message, "reason": e.reason}
+        limit = getattr(e, "limit", None)
+        current = getattr(e, "current", None)
+        if limit is not None:
+            obj["limit"] = limit
+        if current is not None:
+            obj["current"] = current
+        body = json.dumps(obj, ensure_ascii=False).encode("utf-8")
         try:
             h.send_response(e.status)
             h.send_header("Content-Type", "application/json")
             if e.retry_after is not None:
                 h.send_header("Retry-After",
                               str(max(1, int(e.retry_after))))
+            # adaptive-backoff hints (reference X-Concurrency style):
+            # clients like vlagent scale their retry delay by how far
+            # over capacity the server is, instead of sleeping the
+            # fixed Retry-After (server/vlagent.py honors these)
+            if limit is not None:
+                h.send_header("X-VL-Concurrency-Limit", str(limit))
+            if current is not None:
+                h.send_header("X-VL-Concurrency-Current", str(current))
             h.send_header("Content-Length", str(len(body)))
             h.end_headers()
             if h.command != "HEAD":
@@ -483,19 +518,46 @@ class VLServer(BaseHTTPApp):
         else:
             self.sink = LocalLogRowsStorage(storage)
             self.query_storage = storage
-        self._start_http(listen_addr, port)
+        # self-telemetry journal (obs/journal.py): the event bus's
+        # subscriber, writing operational events through the NORMAL
+        # ingest path (self.sink — local storage, or the cluster
+        # sharder on a frontend) under the reserved system tenant.
+        # VL_JOURNAL=0 returns None and leaves the bus subscriber-free
+        # (emit() structurally zero-cost).  Never behind admission: the
+        # journal must not be shed by the overload it records.
+        self.journal = journal.maybe_start(self.sink)
+        try:
+            self._start_http(listen_addr, port)
+        except BaseException:
+            # a failed bind must not leak the journal's bus
+            # subscription + flush thread
+            if self.journal is not None:
+                self.journal.close()
+            raise
 
     def route(self, h, path, args, body, ctype) -> None:
         m = self.metrics
         headers = h.headers
-        # ---- health / misc ----
-        if path in ("/health", "/-/healthy", "/ping", "/insert/ready"):
+        # ---- health / misc (deliberately OUTSIDE the admission gate:
+        # a server shedding 429s must still answer its liveness and
+        # readiness probes, or the orchestrator kills exactly the node
+        # that is correctly protecting itself) ----
+        if path in ("/health", "/-/healthy", "/ping"):
             self.respond(h, 200, "text/plain", b"OK")
+            return
+        if path in ("/ready", "/-/ready", "/insert/ready"):
+            # readiness = the storage accepts writes; a read-only
+            # storage (disk limit) should be rotated out of ingest LBs
+            if self.storage.is_read_only:
+                self.respond(h, 503, "text/plain",
+                             b"storage is read-only")
+            else:
+                self.respond(h, 200, "text/plain", b"OK")
             return
         if path == "/metrics":
             self.respond(h, 200, "text/plain",
-                         m.render(self.storage,
-                                  runner=self.runner).encode())
+                         m.render(self.storage, runner=self.runner,
+                                  server=self).encode())
             return
         if path == "/":
             self.respond_json(h, {
@@ -688,6 +750,12 @@ class VLServer(BaseHTTPApp):
         self.respond(h, 404, "text/plain",
                      f"unknown path {path}".encode())
 
+    def close(self) -> None:
+        # drain the journal FIRST (its flush writes through self.sink)
+        if self.journal is not None:
+            self.journal.close()
+        super().close()
+
     def handle_select(self, h, path, args, headers) -> None:
         s = self.query_storage
         m = self.metrics
@@ -724,9 +792,15 @@ class VLServer(BaseHTTPApp):
                 s, args, headers, runner=self.runner))
         elif path == "/select/logsql/tail":
             stop = {"flag": False}
+            # empty keep-alive chunks are never written (a zero-length
+            # chunk would TERMINATE the chunked stream), so an idle
+            # tail has no write to fail on when the client goes away —
+            # probe the socket instead, or the tail (and its registry
+            # record) lingers until the next matching row
+            gone = self._peer_gone(h)
 
             def stop_check():
-                return stop["flag"]
+                return stop["flag"] or gone()
             gen = handle_tail(s, args, headers, stop_check=stop_check,
                               runner=self.runner)
             try:

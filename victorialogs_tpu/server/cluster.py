@@ -498,7 +498,12 @@ class NetSelectStorage:
                         info.get("reason", "queue_full"),
                         f"storage node {url} shed the sub-query: "
                         f"{info.get('error', 'overloaded')}",
-                        retry_after=retry))
+                        retry_after=retry,
+                        # forward the node's concurrency hints so the
+                        # frontend's 429 carries X-VL-Concurrency-*
+                        # end to end
+                        limit=info.get("limit"),
+                        current=info.get("current")))
                 else:
                     errors.append(IOError(f"{url}: HTTP {e.code}"))
                 stop.set()

@@ -22,6 +22,7 @@ import signal
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from ..storage.log_rows import LogRows
@@ -56,6 +57,7 @@ class RemoteWriteClient:
         self.timeout = timeout
         self.delivered_blocks = 0
         self.errors = 0
+        self.retry_after_honored = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -66,25 +68,63 @@ class RemoteWriteClient:
             data = self.queue.read(timeout=0.5)
             if data is None:
                 continue
-            if self._send(data):
+            ok, hint = self._send(data)
+            if ok:
                 self.queue.ack(len(data))
                 self.delivered_blocks += 1
+                backoff = 0.5
+            elif hint is not None:
+                # the remote SAID how loaded it is (429 + Retry-After +
+                # X-VL-Concurrency hints): honor its guidance instead
+                # of blind exponential backoff, and restart the
+                # exponential ladder — the next failure without a hint
+                # starts cheap again
+                self.errors += 1
+                self.retry_after_honored += 1
+                self._stop.wait(min(hint, 60.0))
                 backoff = 0.5
             else:
                 self.errors += 1
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 30.0)
 
-    def _send(self, body: bytes) -> bool:
+    @staticmethod
+    def _shed_hint(headers) -> float:
+        """Retry delay from a 429's response headers: Retry-After,
+        scaled up by how far over its concurrency limit the server
+        reports itself (X-VL-Concurrency-Current/-Limit — the
+        server-side adaptive-backoff contract in app.respond_shed)."""
+        try:
+            wait = float(headers.get("Retry-After") or 1.0)
+        except ValueError:
+            wait = 1.0
+        try:
+            limit = int(headers.get("X-VL-Concurrency-Limit") or 0)
+            current = int(headers.get("X-VL-Concurrency-Current") or 0)
+        except ValueError:
+            limit = current = 0
+        if limit > 0 and current > 0:
+            # at/over capacity -> stretch; freeing up -> never below
+            # half the advertised Retry-After
+            wait *= min(4.0, max(0.5, current / limit))
+        return max(0.1, wait)
+
+    def _send(self, body: bytes) -> tuple[bool, float | None]:
+        """(delivered, retry_hint_s) — the hint is non-None only for an
+        explicit overload shed (HTTP 429)."""
         req = urllib.request.Request(
             f"{self.url}/internal/insert?version={PROTOCOL_VERSION}",
             data=body, method="POST")
         req.add_header("Content-Type", "application/octet-stream")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return 200 <= resp.status < 300
+                return 200 <= resp.status < 300, None
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                return False, self._shed_hint(e.headers)
+            return False, None
         except (OSError, http.client.HTTPException):
-            return False
+            return False, None
 
     def close(self) -> None:
         self._stop.set()

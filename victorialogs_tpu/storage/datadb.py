@@ -27,6 +27,24 @@ import numpy as np
 from .block import BlockData, blocks_from_log_rows, build_blocks
 from .part import Part, write_part
 from .values_encoder import decode_values
+from ..obs import events as _events
+
+
+def _all_system_tenant(parts) -> bool:
+    """True when every block in `parts` belongs to the self-telemetry
+    system tenant — the flush/merge was triggered purely by journal
+    ingest, so its event must be counted, not re-journaled (the
+    recursion guard's storage half; early-exits on the first real
+    row, which for any mixed workload is block 0)."""
+    from ..obs.journal import SYSTEM_TENANT_ID
+    saw_any = False
+    for p in parts:
+        nb = getattr(p, "num_blocks", 0)
+        for i in range(nb):
+            saw_any = True
+            if p.block_stream_id(i).tenant != SYSTEM_TENANT_ID:
+                return False
+    return saw_any
 
 DEFAULT_PARTS_TO_MERGE = 15          # reference datadb.go:33-45
 MIN_MERGE_MULTIPLIER = 1.7
@@ -406,6 +424,7 @@ class DataDB:
             # keep the flushing parts query-visible until the file part is
             # registered, then drop both in one locked swap
             self.flushing_parts.extend(imps)
+        t0 = time.perf_counter()
         try:
             if len(imps) == 1:
                 merged = imps[0].blocks
@@ -423,6 +442,19 @@ class DataDB:
                 self.small_parts.append(p)
                 self._write_manifest_locked()
                 self._buffer_drained.notify_all()
+            # a flush of journal-only rows reports AS journal work
+            # (suppressed+counted) so the journal's own ingest cannot
+            # tick the storage into a perpetual flush-event loop; the
+            # subscriber check keeps the tenant scan off the
+            # journal-disabled path entirely
+            if _events.subscriber_count():
+                _events.emit(
+                    "storage_flush",
+                    tenant=_events.SYSTEM_TENANT
+                    if _all_system_tenant(imps) else None,
+                    parts=len(imps), rows=p.num_rows, out_part=name,
+                    duration_ms=round(
+                        (time.perf_counter() - t0) * 1e3, 3))
         except BaseException:
             # put the in-memory parts back so their rows stay visible
             with self._lock:
@@ -460,14 +492,29 @@ class DataDB:
     # vlint: allow-lock-blocking-call(coarse merge serialization lock)
     def _merge_parts(self, to_merge: list[Part], big: bool) -> None:
         t0 = time.perf_counter()
-        self._merge_parts_timed(to_merge, big)
+        # attribute BEFORE the merge runs: afterwards the source parts'
+        # dirs are gone (journal-triggered merges report suppressed —
+        # the recursion guard's merge half)
+        system_only = bool(_events.subscriber_count()) and \
+            _all_system_tenant(to_merge)
+        merged = self._merge_parts_timed(to_merge, big,
+                                         system_only=system_only)
         # storage-side observability: merge wall time feeds the
         # vl_storage_merge_duration_seconds histogram on /metrics
         from ..obs import hist
-        hist.MERGE_SECONDS.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        hist.MERGE_SECONDS.observe(dt)
+        if merged:
+            _events.emit(
+                "storage_merge",
+                tenant=_events.SYSTEM_TENANT if system_only else None,
+                level="big" if big else "small", parts=len(to_merge),
+                rows=sum(p.num_rows for p in to_merge),
+                duration_ms=round(dt * 1e3, 3))
 
     # vlint: allow-lock-blocking-call(coarse merge serialization lock)
-    def _merge_parts_timed(self, to_merge: list[Part], big: bool) -> None:
+    def _merge_parts_timed(self, to_merge: list[Part], big: bool,
+                           system_only: bool = False) -> bool:
         # disk-space reservation: skip the merge when the output could not
         # fit (reference reserves before merging — datadb.go:478-493)
         need = int(sum(p.meta.get("compressed_size", 0)
@@ -477,7 +524,7 @@ class DataDB:
         except OSError:
             free = None
         if free is not None and free < need:
-            return  # not enough space: keep the source parts
+            return False  # not enough space: keep the source parts
         # streaming k-way merge: blocks are read lazily per part and flow
         # straight into the part writer — bounded memory, no row decode for
         # non-overlapping ranges
@@ -513,8 +560,18 @@ class DataDB:
         # readable on POSIX, and Python closes the files when the last snapshot
         # reference dies (the reference gets the same effect via refcounted
         # partWrappers — datadb.go:100-149).
+        reclaimed = 0
         for p in to_merge:
+            reclaimed += p.meta.get("compressed_size", 0)
             shutil.rmtree(p.path, ignore_errors=True)
+        # merged-away part dirs unlinked (fds of concurrent snapshot
+        # holders stay readable; bytes return to the OS when the last
+        # reference dies)
+        _events.emit(
+            "part_gc",
+            tenant=_events.SYSTEM_TENANT if system_only else None,
+            parts=len(to_merge), reclaimed_bytes=reclaimed)
+        return True
 
     # ---- read path ----
     def snapshot_parts(self) -> list:

@@ -38,7 +38,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..obs import activity, hist, tracing
+from ..obs import activity, events, hist, tracing
 from ..utils.hashing import cached_token_hashes
 from .bloom import (BLOOM_HASHES, bloom_contains_all,
                     bloom_probe_positions_multi)
@@ -216,6 +216,12 @@ class FilterBank:
             return got
         built = _build_plane(part, field)
         if built is not None and not _bank_try_charge(built.nbytes):
+            # budget exhausted — the would-be plane is evicted before
+            # it ever lands (per-block path instead).  Previously
+            # invisible; now a journal event AND the decline counter.
+            events.emit("bloom_bank_evict", field=field,
+                        nbytes=built.nbytes,
+                        part=str(getattr(part, "uid", "?")))
             built = None               # budget exhausted: per-block path
         with self._mu:
             got = self._planes.setdefault(field, built)

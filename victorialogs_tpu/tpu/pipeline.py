@@ -53,7 +53,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..obs import activity, hist, tracing
+from ..obs import activity, events, hist, tracing
 from .. import sched
 from .kernels import pad_bucket
 
@@ -775,6 +775,15 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
             # for the next query; the device_slots scope releases every
             # slot the dropped window still held, so the scheduler's
             # global budget stays balanced too.
+            if window:
+                # abnormal drain (a clean completion harvested the
+                # window empty): journal it so cancelled/faulted scans
+                # correlate with their query_done record by qid
+                events.emit(
+                    "pipeline_drain",
+                    tenant=act.tenant if act.enabled else None,
+                    qid=act.qid if act.enabled else "",
+                    units_dropped=len(window))
             window.clear()
             act.set("dispatches_in_flight", 0)
             stream.close()
