@@ -6,7 +6,7 @@
 NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test lint bench bench-bloom bench-pipeline \
-	bench-concurrent bench-emit bench-journal clean
+	bench-concurrent bench-emit bench-journal bench-wire clean
 
 all: native
 
@@ -57,6 +57,13 @@ bench-emit:
 # PR 4 trace-overhead bound (10% + 2 ms) — PERF.md
 bench-journal:
 	python tools/bench_journal.py --json BENCH_journal.json
+
+# cluster wire protocol: typed columnar frames vs legacy JSON frames on
+# a real 2-node scatter-gather; asserts bit-identical hit sets, >=2x
+# frontend rows/s, and zero typed frames under VL_WIRE_TYPED=0 —
+# PERF.md round 10
+bench-wire:
+	python tools/bench_wire.py --json BENCH_wire.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

@@ -1,0 +1,528 @@
+"""Typed-column cluster wire protocol (server/cluster.py wire format
+"t1"): differential typed-vs-legacy frame suite (byte-identical final
+NDJSON across query shapes incl. dict/const/_time/float columns,
+restricted-field views, multibyte values), codec round trips incl.
+invalid UTF-8 arenas, truncated/corrupted-frame IOError paths, and
+mixed-version negotiation fallback (typed node + legacy frontend and
+vice versa)."""
+
+import http.client
+import json
+import os
+import struct
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.engine.block_result import (WIRE_CONST, WIRE_DICT,
+                                                  WIRE_STR, WIRE_TIME,
+                                                  BlockResult)
+from victorialogs_tpu.engine.emit import ndjson_block, ndjson_block_py
+from victorialogs_tpu.server import cluster
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.utils import zstd as _zstd
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+# ---------------- helpers ----------------
+
+def _roundtrip(br: BlockResult) -> BlockResult:
+    """Encode one block as a typed frame and decode it back."""
+    f = cluster.write_typed_frame(br)
+    n = struct.unpack(">I", f[:4])[0]
+    payload = _zstd.decompress(f[4:4 + n], max_output_size=1 << 30)
+    assert payload.startswith(cluster.TYPED_MAGIC)
+    return cluster.decode_typed_frame(payload)
+
+
+def _req(srv, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _mk_server(path, **kw):
+    from victorialogs_tpu.server.app import VLServer
+    storage = Storage(str(path), retention_days=100000,
+                      flush_interval=3600)
+    return VLServer(storage, listen_addr="127.0.0.1", port=0, **kw)
+
+
+@pytest.fixture(scope="module")
+def cluster2(tmp_path_factory):
+    """2 storage nodes + a scatter-gather frontend, seeded with every
+    storage column encoding the wire must carry: string (multibyte,
+    quotes, controls), dict, const (per-stream), uint, int64
+    (negative), float, ISO8601, native _time."""
+    base = tmp_path_factory.mktemp("wire")
+    n1 = _mk_server(base / "n1")
+    n2 = _mk_server(base / "n2")
+    front = _mk_server(
+        base / "front",
+        storage_nodes=[f"http://127.0.0.1:{n1.port}",
+                       f"http://127.0.0.1:{n2.port}"])
+    rows = []
+    for i in range(400):
+        rows.append(json.dumps({
+            "_time": T0 + i * 250_000_000,
+            "_msg": f"msg {i} {'error' if i % 3 == 0 else 'ok'} "
+                    f"é✓ \"q\" \t x{i % 11}",
+            "app": f"app{i % 5}",                      # 5 streams
+            "lvl": ["info", "warn", "error"][i % 3],   # dict column
+            "dur": str(i % 97),                        # uint column
+            "neg": str(-3 - i),                        # int64 column
+            "score": str((i % 50) / 4),                # float column
+            "iso": f"2025-07-28T00:00:{i % 60:02d}.250Z",  # iso8601
+            "const_f": "same-everywhere",              # const column
+        }, ensure_ascii=False))
+    status, _ = _req(front, "POST",
+                     "/insert/jsonline?_stream_fields=app",
+                     body="\n".join(rows).encode())
+    assert status == 200
+    for n in (n1, n2):
+        _req(n, "GET", "/internal/force_flush")
+    yield front, n1, n2
+    for s in (front, n1, n2):
+        s.close()
+        s.storage.close()
+
+
+QUERY_SHAPES = [
+    # rows incl. every typed column kind
+    "*",
+    "error",
+    # dict/const/uint/int/float/iso columns under projection
+    "* | fields _time, lvl, const_f, dur",
+    "* | fields _msg, score, neg, iso",
+    # restricted-field view with the block detached fields dropped
+    "* | delete _stream, _stream_id",
+    # pushed-down row-local transforms
+    "* | copy lvl as level | fields _time, level",
+    'lvl:error | extract " x<xn>" from _msg | fields _time, xn',
+    # stats split (export/import state frames over the wire)
+    "* | stats by (lvl) count() c, sum(dur) s",
+    "* | stats by (app, lvl) count() c",
+    "* | stats quantile(0.9, dur) p90, avg(score) m",
+    # local sort + limit on the frontend over wire views
+    "error | sort by (_time desc) | limit 17",
+    # time-bucketed stats (hits shape)
+    "* | stats by (_time:1m) count() hits",
+]
+
+
+def _fmt_frames(c: dict, fmt: str) -> int:
+    """tx+rx frames of one format (in-process clusters count both
+    directions in the same process-global registry)."""
+    return c.get(f"tx_frames_{fmt}", 0) + c.get(f"rx_frames_{fmt}", 0)
+
+
+def _query_front(front, qs, limit=0, extra=""):
+    q = urllib.parse.quote(qs)
+    status, data = _req(front, "GET",
+                        f"/select/logsql/query?query={q}&limit={limit}"
+                        f"{extra}")
+    assert status == 200, data[:200]
+    return data
+
+
+# ---------------- differential: typed vs legacy, byte-identical -----
+
+def test_differential_typed_vs_legacy(cluster2, monkeypatch):
+    front, _n1, _n2 = cluster2
+    for qs in QUERY_SHAPES:
+        c0 = cluster.wire_counters()
+        typed = _query_front(front, qs)
+        c1 = cluster.wire_counters()
+        # typed frames actually on the wire for this query
+        assert _fmt_frames(c1, "typed") > _fmt_frames(c0, "typed"), qs
+
+        monkeypatch.setenv("VL_WIRE_TYPED", "0")
+        front.query_storage.wire_typed = cluster.wire_typed_enabled()
+        try:
+            legacy = _query_front(front, qs)
+            c2 = cluster.wire_counters()
+        finally:
+            monkeypatch.delenv("VL_WIRE_TYPED")
+            front.query_storage.wire_typed = cluster.wire_typed_enabled()
+        # kill-switch restores legacy frames exactly: zero typed frames
+        assert _fmt_frames(c2, "typed") == _fmt_frames(c1, "typed"), qs
+        assert _fmt_frames(c2, "json") > _fmt_frames(c1, "json"), qs
+        if "| sort" in qs:
+            # frontend-local sort pins a total order: byte-identical
+            assert typed == legacy, qs
+        else:
+            # scatter-gather interleaving across the two fetch threads
+            # is nondeterministic run to run — the LINES must match
+            # bit-exactly, their order may not (PR 3's hit-set
+            # discipline)
+            assert sorted(typed.splitlines()) == \
+                sorted(legacy.splitlines()), qs
+        assert typed.strip(), f"no rows for {qs!r}"
+
+
+def test_differential_hits_facets_tail(cluster2, monkeypatch):
+    """The dict-row consumers that moved onto columns (hits/facets)
+    agree between wire formats too."""
+    front, _n1, _n2 = cluster2
+    q = urllib.parse.quote("*")
+    paths = [
+        f"/select/logsql/hits?query={q}&step=1m&field=lvl",
+        f"/select/logsql/facets?query={q}&limit=5",
+        f"/select/logsql/stats_query?query="
+        f"{urllib.parse.quote('* | stats by (lvl) count() c')}"
+        f"&time=2025-07-29T00:00:00Z",
+    ]
+    got_typed = [_req(front, "GET", p) for p in paths]
+    monkeypatch.setenv("VL_WIRE_TYPED", "0")
+    front.query_storage.wire_typed = cluster.wire_typed_enabled()
+    try:
+        got_legacy = [_req(front, "GET", p) for p in paths]
+    finally:
+        monkeypatch.delenv("VL_WIRE_TYPED")
+        front.query_storage.wire_typed = cluster.wire_typed_enabled()
+    for (st_t, d_t), (st_l, d_l), p in zip(got_typed, got_legacy, paths):
+        assert st_t == st_l == 200, p
+        assert _norm(json.loads(d_t)) == _norm(json.loads(d_l)), p
+
+
+def _norm(obj):
+    """Order-insensitive JSON view: scatter-gather arrival order (group
+    emission, per-group timestamp appends) is nondeterministic run to
+    run independently of the wire format — sort dict-lists and
+    timestamp/value pairs so only CONTENT is compared."""
+    if isinstance(obj, dict):
+        o = {k: _norm(v) for k, v in obj.items()}
+        if isinstance(o.get("timestamps"), list) and \
+                isinstance(o.get("values"), list):
+            pairs = sorted(zip(o["timestamps"], o["values"]))
+            o["timestamps"] = [p[0] for p in pairs]
+            o["values"] = [p[1] for p in pairs]
+        return o
+    if isinstance(obj, list):
+        items = [_norm(x) for x in obj]
+        if items and all(isinstance(x, dict) for x in items):
+            return sorted(items,
+                          key=lambda x: json.dumps(x, sort_keys=True))
+        return items
+    return obj
+
+
+# ---------------- mixed-version negotiation ----------------
+
+def test_legacy_frontend_typed_node(cluster2):
+    """Old frontend (never sends wire=t1) against new nodes: nodes
+    answer legacy JSON frames and the query completes."""
+    front, _n1, _n2 = cluster2
+    front.query_storage.wire_typed = False       # simulate old frontend
+    try:
+        c0 = cluster.wire_counters()
+        data = _query_front(front, "error")
+        c1 = cluster.wire_counters()
+    finally:
+        front.query_storage.wire_typed = cluster.wire_typed_enabled()
+    assert data.strip()
+    assert _fmt_frames(c1, "typed") == _fmt_frames(c0, "typed")
+    assert _fmt_frames(c1, "json") > _fmt_frames(c0, "json")
+    ref = _query_front(front, "error")
+    assert sorted(data.splitlines()) == sorted(ref.splitlines())
+
+
+def test_typed_frontend_legacy_node(cluster2, monkeypatch):
+    """New frontend asking for typed frames against nodes that answer
+    legacy JSON (simulated via the node-side kill-switch): per-frame
+    format detection falls back, emits the journal wire_fallback event,
+    and results stay identical."""
+    from victorialogs_tpu.obs import events
+    front, _n1, _n2 = cluster2
+    ref = _query_front(front, "error")
+    seen = []
+
+    def sub(ts_ns, event, fields):
+        if event == "wire_fallback":
+            seen.append(dict(fields))
+    events.subscribe(sub)
+    # node side refuses typed (wire_typed_enabled() checked per request)
+    # while the frontend keeps requesting it
+    monkeypatch.setenv("VL_WIRE_TYPED", "0")
+    assert front.query_storage.wire_typed     # frontend still asks
+    try:
+        c0 = cluster.wire_counters()
+        data = _query_front(front, "error")
+        c1 = cluster.wire_counters()
+    finally:
+        monkeypatch.delenv("VL_WIRE_TYPED")
+        events.unsubscribe(sub)
+    assert sorted(data.splitlines()) == sorted(ref.splitlines())
+    assert _fmt_frames(c1, "typed") == _fmt_frames(c0, "typed")
+    assert c1.get("fallbacks", 0) > c0.get("fallbacks", 0)
+    assert seen and seen[0]["requested"] == cluster.WIRE_FORMAT
+
+
+# ---------------- codec round trips ----------------
+
+def test_codec_plain_columns_roundtrip():
+    cols = {"_msg": ["héllo", "", 'q"uote', "x" * 300, "\x00\x1f tab\t"],
+            "k": ["a", "b", "a", "", "c"]}
+    br = BlockResult.from_columns(cols, timestamps=[5, 4, 3, 2, 1])
+    br2 = _roundtrip(br)
+    assert br2.nrows == 5
+    assert br2.column_names() == ["_msg", "k"]
+    assert br2.column("_msg") == cols["_msg"]
+    assert br2.column("k") == cols["k"]
+    assert br2.timestamps == [5, 4, 3, 2, 1]
+    assert ndjson_block(br2) == ndjson_block_py(br)
+
+
+def test_codec_storage_backed_typed_columns(tmp_path):
+    """Every storage encoding crosses the wire in its typed shape and
+    re-renders identically (dict codes, consts, int/uint/float, iso,
+    native _time)."""
+    s = Storage(str(tmp_path / "d"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(64):
+        lr.add(TEN, T0 + i * NS, [
+            ("app", "web"),
+            ("_msg", f"m{i} ünïcode ✓"),
+            ("lvl", ["a", "b"][i % 2]),
+            ("dur", str(i)),
+            ("neg", str(-i - 1)),
+            ("score", str(i / 4)),
+            ("iso", f"2025-07-28T00:00:{i % 60:02d}.500Z"),
+        ])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    from victorialogs_tpu.engine.searcher import run_query
+    blocks = []
+    run_query(s, [TEN], "*", write_block=blocks.append,
+              timestamp=T0 + 3600 * NS)
+    assert blocks
+    try:
+        for br in blocks:
+            br2 = _roundtrip(br)
+            # typed access survives the wire for the pipe fast paths
+            dc = br2.dict_column("lvl")
+            assert dc is not None and sorted(dc[1]) == ["a", "b"]
+            assert br2.const_value("app") == "web"
+            nums, is_int = br2.typed_numeric("dur")
+            assert is_int and int(nums[0]) == 0
+            assert br2.numeric_column("score") is not None
+            assert br2.native_time_keys() is not None
+            # and the rendered bytes are bit-identical to the local oracle
+            assert ndjson_block(br2) == ndjson_block_py(br)
+            assert br2.column("neg") == br.column("neg")
+            assert br2.column("iso") == br.column("iso")
+    finally:
+        s.close()
+
+
+def test_codec_restricted_view_and_filter_rows(tmp_path):
+    s = Storage(str(tmp_path / "d"), retention_days=100000,
+                flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(32):
+        lr.add(TEN, T0 + i * NS, [("app", "w"), ("_msg", f"m{i}"),
+                                  ("dur", str(i))])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    from victorialogs_tpu.engine.searcher import run_query
+    blocks = []
+    run_query(s, [TEN], "* | fields _msg, dur",
+              write_block=blocks.append, timestamp=T0 + 3600 * NS)
+    try:
+        for br in blocks:
+            assert br._restrict is not None   # fields pipe kept the view
+            br2 = _roundtrip(br)
+            assert br2.column_names() == ["_msg", "dur"]
+            assert ndjson_block(br2) == ndjson_block_py(br)
+            # filter_rows on the wire view (frontend-local limit pipe)
+            mask = np.zeros(br2.nrows, dtype=bool)
+            mask[:5] = True
+            small = br2.filter_rows(mask)
+            assert small.nrows == 5
+            assert ndjson_block(small) == \
+                ndjson_block(br.filter_rows(mask))
+            # restrict_fields on the wire view keeps typed backing
+            proj = br2.restrict_fields(["dur"])
+            assert proj._wire is not None
+            assert proj.column_names() == ["dur"]
+    finally:
+        s.close()
+
+
+def test_codec_invalid_utf8_arena_falls_back_identically():
+    """A wire arena carrying invalid UTF-8 reaches the frontend as raw
+    bytes; the native emit rejects it on BOTH sides, and the python
+    fallback renders the same replacement chars the storage node's own
+    decode would."""
+    bad = b"ok \xff\xfe end"
+    arena = np.frombuffer(bad, dtype=np.uint8)
+    wcols = {"_msg": (WIRE_STR, arena,
+                      np.array([0], dtype=np.int64),
+                      np.array([len(bad)], dtype=np.int64))}
+    br = BlockResult.from_wire(["_msg"], wcols, 1)
+    out = ndjson_block(br)
+    assert json.loads(out.decode()) == \
+        {"_msg": bad.decode("utf-8", "replace")}
+    # and the frame codec round-trips the raw bytes untouched
+    br2 = _roundtrip(br)
+    assert br2._wire["_msg"][1].tobytes() == bad
+
+
+def test_codec_empty_block_and_empty_values():
+    br = BlockResult(0)
+    br2 = _roundtrip(br)
+    assert br2.nrows == 0 and br2.column_names() == []
+    br = BlockResult.from_columns({"a": ["", "", ""]})
+    br2 = _roundtrip(br)
+    assert br2.column("a") == ["", "", ""]
+    assert ndjson_block(br2) == b"{}\n{}\n{}\n"
+
+
+# ---------------- corrupted / truncated frames ----------------
+
+def _typed_payload(br) -> bytes:
+    f = cluster.write_typed_frame(br)
+    n = struct.unpack(">I", f[:4])[0]
+    return _zstd.decompress(f[4:4 + n], max_output_size=1 << 30)
+
+
+def test_corrupted_frames_raise_ioerror():
+    br = BlockResult.from_columns(
+        {"a": ["xx", "yyy"], "b": ["1", "2"]}, timestamps=[1, 2])
+    payload = _typed_payload(br)
+    # truncation at every prefix length must raise IOError, never
+    # crash with an unrelated exception or silently succeed
+    for cut in range(len(cluster.TYPED_MAGIC), len(payload)):
+        with pytest.raises(IOError):
+            cluster.decode_typed_frame(payload[:cut])
+    # trailing garbage
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(payload + b"junk")
+    # unknown column kind
+    mutated = bytearray(payload)
+    # header: magic(5) + nrows(4) + ncols(2) + flags(1) + ts(16); the
+    # first column record starts right after: namelen(2) + kind(1)
+    kind_off = 5 + 7 + 16 + 2
+    mutated[kind_off] = 250
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(bytes(mutated))
+
+
+def test_str_slice_out_of_arena_bounds_raises():
+    """Offsets/lengths pointing past the shipped arena must be
+    rejected at decode — they would otherwise reach the native
+    emitter's unchecked arena reads."""
+    arena = np.frombuffer(b"tiny", dtype=np.uint8)
+    br = BlockResult.from_wire(
+        ["s"], {"s": (WIRE_STR, arena,
+                      np.array([0x7fffffff], dtype=np.int64),
+                      np.array([8], dtype=np.int64))}, 1)
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(_typed_payload_raw(br))
+    # length overruns too, not just offsets
+    br = BlockResult.from_wire(
+        ["s"], {"s": (WIRE_STR, arena,
+                      np.array([2], dtype=np.int64),
+                      np.array([3], dtype=np.int64))}, 1)
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(_typed_payload_raw(br))
+
+
+def _typed_payload_raw(br) -> bytes:
+    """Encode WITHOUT the densify pass (write the wire tuples as-is)
+    so corrupt offset/length vectors survive to the decoder."""
+    import victorialogs_tpu.engine.block_result as brm
+    orig = brm._dense_str_triple
+    brm._dense_str_triple = lambda a, o, ln: (a, o, ln)
+    try:
+        return _typed_payload(br)
+    finally:
+        brm._dense_str_triple = orig
+
+
+def test_iso_frac_width_out_of_range_raises():
+    payload = bytearray(_typed_payload(BlockResult.from_wire(
+        ["i"], {"i": (2, np.array([T0], dtype=np.int64), 3)}, 1)))
+    # header(12) + namelen(2) + kind(1) + name("i",1) -> frac_w byte
+    frac_off = 12 + 2 + 1 + 1
+    assert payload[frac_off] == 3
+    payload[frac_off] = 200
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(bytes(payload))
+
+
+def test_dict_code_out_of_range_raises():
+    codes = np.array([0, 5], dtype=np.uint8)   # 5 >= nvals
+    br = BlockResult.from_wire(
+        ["d"], {"d": (WIRE_DICT, codes, ["only"])}, 2)
+    payload = _typed_payload(br)
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(payload)
+
+
+def test_time_column_without_frame_ts_raises():
+    br = BlockResult.from_wire(
+        ["_time"], {"_time": (WIRE_TIME, np.array([1], dtype=np.int64))},
+        1)                                     # no ts_np on purpose
+    br._ts_np = None
+    f = cluster.write_typed_frame(br)
+    n = struct.unpack(">I", f[:4])[0]
+    payload = _zstd.decompress(f[4:4 + n], max_output_size=1 << 30)
+    with pytest.raises(IOError):
+        cluster.decode_typed_frame(payload)
+
+
+def test_truncated_stream_raises_ioerror(cluster2):
+    """A storage node dying mid-stream surfaces as IOError (whole-query
+    failure), for typed exactly like legacy."""
+    import io
+    br = BlockResult.from_columns({"a": ["x"]})
+    frame = cluster.write_typed_frame(br)
+    # frame announces more bytes than the stream holds
+    stream = io.BytesIO(frame[:len(frame) - 3])
+    with pytest.raises(IOError):
+        list(cluster.read_frame_payloads(stream))
+
+
+# ---------------- trace + metrics surface ----------------
+
+def test_trace_carries_wire_attribution(cluster2):
+    front, _n1, _n2 = cluster2
+    data = _query_front(front, "error", extra="&trace=1")
+    tree = json.loads(data.splitlines()[-1])["_trace"]
+
+    def find(n, name, out):
+        if n.get("name") == name:
+            out.append(n)
+        for c in n.get("children", ()):
+            find(c, name, out)
+    nodes: list = []
+    find(tree, "storage_node", nodes)
+    assert len(nodes) == 2
+    for n in nodes:
+        attrs = n["attrs"]
+        assert attrs.get("typed_frames", 0) > 0
+        assert attrs.get("wire_rx_bytes", 0) > 0
+        assert "wire_decode_s" in attrs
+
+
+def test_wire_metrics_on_metrics_endpoint(cluster2):
+    front, n1, _n2 = cluster2
+    _query_front(front, "error")
+    _s, text = _req(front, "GET", "/metrics")
+    body = text.decode()
+    assert 'vl_wire_frames_total{dir="rx",fmt="typed"}' in body
+    assert 'vl_wire_bytes_total{dir="rx",fmt="typed"}' in body
+    assert 'vl_wire_bytes_total{dir="tx",fmt="json"}' in body
+    m = [ln for ln in body.splitlines()
+         if ln.startswith('vl_wire_frames_total{dir="rx",fmt="typed"}')]
+    assert m and float(m[0].split()[-1]) > 0

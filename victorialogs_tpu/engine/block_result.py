@@ -119,6 +119,72 @@ def _fixed_emit_col(sb: np.ndarray):
             np.arange(n, dtype=np.int64) * w, lengths)
 
 
+# ---- wire columns (cluster typed frames) ----
+#
+# A wire column is a kind-tagged tuple shipped between cluster nodes
+# (server/cluster.py owns the binary framing + negotiation).  It is the
+# emit-column contract extended with the two shapes that compress
+# better on the wire than their flattened emit form (dict codes + tiny
+# value arenas, single-copy consts):
+#   (WIRE_STR, arena uint8[], offsets int[n], lengths int[n])  dense
+#   (WIRE_TIME, ts int64[n])        native _time nanos
+#   (WIRE_ISO, ts int64[n], frac_w) ISO8601, fixed fractional width
+#   (WIRE_INT, nums int64[n])
+#   (WIRE_UINT, nums uint64[n])
+#   (WIRE_DICT, codes uint8[n], values list[str])
+#   (WIRE_CONST, value str)
+#   (WIRE_FLOAT, nums float64[n])
+# WIRE_STR arenas are DENSE (offsets are the cumsum of lengths): the
+# encoder never ships unselected bytes of a storage arena.
+
+WIRE_STR = 0
+WIRE_TIME = 1
+WIRE_ISO = 2
+WIRE_INT = 3
+WIRE_UINT = 4
+WIRE_DICT = 5
+WIRE_CONST = 6
+WIRE_FLOAT = 7
+
+
+def _dense_str_triple(arena: np.ndarray, offsets: np.ndarray,
+                      lengths: np.ndarray):
+    """Repack a (possibly selection-gathered) string triple into a
+    dense arena: offsets become the cumsum of lengths and the arena
+    holds exactly the selected bytes, in row order."""
+    n = int(lengths.shape[0])
+    lengths = lengths.astype(np.int64, copy=False)
+    total = int(lengths.sum())
+    new_off = np.zeros(n, dtype=np.int64)
+    if n > 1:
+        np.cumsum(lengths[:-1], out=new_off[1:])
+    if int(arena.shape[0]) == total and (n == 0 or
+                                         np.array_equal(offsets, new_off)):
+        return arena, new_off, lengths
+    if total == 0:
+        return np.empty(0, dtype=np.uint8), new_off, lengths
+    idx = np.repeat(offsets.astype(np.int64, copy=False) - new_off,
+                    lengths) + np.arange(total, dtype=np.int64)
+    return arena[idx], new_off, lengths
+
+
+def _wire_take(wc, keep: np.ndarray):
+    """Row-select one wire column (filter_rows for wire views).  The
+    arena of a WIRE_STR column stays whole — only offsets/lengths
+    gather — so selection is O(kept rows); re-encoding for the wire
+    densifies again."""
+    kind = wc[0]
+    if kind == WIRE_STR:
+        return (kind, wc[1], wc[2][keep], wc[3][keep])
+    if kind == WIRE_ISO:
+        return (kind, wc[1][keep], wc[2])
+    if kind == WIRE_DICT:
+        return (kind, wc[1][keep], wc[2])
+    if kind == WIRE_CONST:
+        return wc
+    return (kind, wc[1][keep])
+
+
 class BlockResult:
     """A batch of result rows with lazily-materialized string columns.
 
@@ -142,6 +208,11 @@ class BlockResult:
         # the emit path keeps its typed columnar access.
         self._restrict: list[str] | None = None
         self._restrict_set: frozenset | None = None
+        # cluster wire view (from_wire): name -> wire column tuple.
+        # Like _bs, this is a typed backing store — _cols only ever
+        # holds cache fills of the same decode.
+        self._wire: dict | None = None
+        self._wire_names: list[str] | None = None
         self._ts_list: list[int] | None = None
         self._ts_np: np.ndarray | None = None
         # numeric views of produced columns (e.g. math results): maps
@@ -170,6 +241,24 @@ class BlockResult:
             self._ts_np = np.asarray(self._ts_list, dtype=np.int64)
         return self._ts_np
 
+    def native_time_keys(self) -> np.ndarray | None:
+        """int64 nanos of the DISPLAYED `_time` column, or None when a
+        pipe may have rewritten it (sinks that sort on _time — the tail
+        loop — use this instead of re-parsing rendered strings).  Valid
+        for block-backed results (displayed _time IS the storage
+        timestamps unless materialized) and wire views carrying a
+        native WIRE_TIME column."""
+        if self._restrict_set is not None and \
+                "_time" not in self._restrict_set:
+            return None
+        if self._bs is not None:
+            return self._ts_np
+        if self._wire is not None:
+            wc = self._wire.get("_time")
+            if wc is not None and wc[0] == WIRE_TIME:
+                return wc[1]
+        return None
+
     # ---- constructors ----
     @staticmethod
     def from_block_search(bs: BlockSearch, bm: np.ndarray,
@@ -196,6 +285,21 @@ class BlockResult:
         br.timestamps = timestamps
         return br
 
+    @staticmethod
+    def from_wire(names: list[str], wcols: dict, nrows: int,
+                  ts_np: np.ndarray | None = None) -> "BlockResult":
+        """Arena-backed view over decoded wire columns (cluster typed
+        frames): string columns stay packed arenas, typed columns stay
+        native arrays, so the frontend's emit path feeds
+        vl_emit_ndjson without ever materializing per-row strings.
+        Pipe consumers that DO want strings decode lazily per column
+        through column(), exactly like block-backed results."""
+        br = BlockResult(nrows)
+        br._wire = wcols
+        br._wire_names = list(names)
+        br._ts_np = ts_np
+        return br
+
     # ---- access ----
     def column(self, name: str) -> list[str]:
         if self._restrict_set is not None and \
@@ -205,9 +309,13 @@ class BlockResult:
         vals = self._cols.get(name)
         if vals is not None:
             return vals
-        if self._bs is not None and (name in ("_time", "_stream",
-                                              "_stream_id")
-                                     or self._bs.has_column(name)):
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            vals = self._wire_strings(wc) if wc is not None \
+                else [""] * self.nrows
+        elif self._bs is not None and (name in ("_time", "_stream",
+                                                "_stream_id")
+                                       or self._bs.has_column(name)):
             full = self._bs.values(name)
             vals = [full[i] for i in self._sel.tolist()]
         else:
@@ -215,11 +323,40 @@ class BlockResult:
         self._cols[name] = vals
         return vals
 
+    def _wire_strings(self, wc) -> list[str]:
+        """Decode one wire column to per-row strings — the SAME decodes
+        the storage node's own column() would have produced
+        (values_encoder.decode_values / block_search.values), so local
+        pipes see identical values on both sides of the wire."""
+        kind = wc[0]
+        if kind == WIRE_STR:
+            buf = wc[1].tobytes()
+            return [buf[o:o + l].decode("utf-8", "replace")
+                    for o, l in zip(wc[2].tolist(), wc[3].tolist())]
+        if kind == WIRE_TIME:
+            return [format_rfc3339(t) for t in wc[1].tolist()]
+        if kind == WIRE_ISO:
+            from ..storage.values_encoder import format_iso8601
+            return [format_iso8601(t, wc[2]) for t in wc[1].tolist()]
+        if kind in (WIRE_INT, WIRE_UINT):
+            return wc[1].astype("U20").tolist()
+        if kind == WIRE_DICT:
+            dv = wc[2]
+            return [dv[i] for i in wc[1].tolist()]
+        if kind == WIRE_CONST:
+            return [wc[1]] * self.nrows
+        if kind == WIRE_FLOAT:
+            from ..storage.values_encoder import _format_floats
+            return _format_floats(wc[1]).tolist()
+        raise ValueError(f"unknown wire column kind {kind}")
+
     def has_column(self, name: str) -> bool:
         if self._restrict_set is not None:
             return name in self._restrict_set
         if name in self._cols:
             return True
+        if self._wire is not None:
+            return name in self._wire
         return self._bs is not None and self._bs.has_column(name)
 
     def numeric_column(self, name: str):
@@ -233,6 +370,12 @@ class BlockResult:
         got = self._num_cols.get(name)
         if got is not None and self._cols.get(name) is got[0]:
             return got[1]
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is not None and wc[0] in (WIRE_INT, WIRE_UINT,
+                                            WIRE_FLOAT):
+                return wc[1].astype(np.float64)
+            return None
         if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
@@ -255,8 +398,19 @@ class BlockResult:
         stored strings (round-trip encodings — values_encoder.py) without
         ever materializing a Python string list
         (block_result.go:2149-2199)."""
-        if self._bs is None or (self._restrict_set is not None
-                                and name not in self._restrict_set):
+        if self._restrict_set is not None and \
+                name not in self._restrict_set:
+            return None
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is None:
+                return None
+            if wc[0] in (WIRE_INT, WIRE_UINT):
+                return wc[1], True
+            if wc[0] == WIRE_FLOAT:
+                return wc[1], False
+            return None
+        if self._bs is None:
             return None
         from ..storage.values_encoder import (VT_FLOAT64, VT_INT64,
                                               VT_UINT8, VT_UINT16,
@@ -279,9 +433,15 @@ class BlockResult:
         """The single value of a column KNOWN constant across this block
         (const columns; _stream/_stream_id are per-block constants by
         construction), or None."""
-        if self._bs is None or self.nrows == 0 or \
-                (self._restrict_set is not None
-                 and name not in self._restrict_set):
+        if self.nrows == 0 or (self._restrict_set is not None
+                               and name not in self._restrict_set):
+            return None
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is not None and wc[0] == WIRE_CONST:
+                return wc[1]
+            return None
+        if self._bs is None:
             return None
         c = self._bs.consts().get(name)
         if c is not None:
@@ -310,8 +470,15 @@ class BlockResult:
         """(selected dict ids uint8, dict value strings) for a
         dict-encoded column, or None — lets group-by factorize through
         the stored codes without materializing a per-row string list."""
-        if self._bs is None or (self._restrict_set is not None
-                                and name not in self._restrict_set):
+        if self._restrict_set is not None and \
+                name not in self._restrict_set:
+            return None
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is not None and wc[0] == WIRE_DICT:
+                return wc[1], wc[2]
+            return None
+        if self._bs is None:
             return None
         from ..storage.values_encoder import VT_DICT
         if name in self._bs.consts() or name in ("_time", "_stream",
@@ -346,7 +513,10 @@ class BlockResult:
         if self._restrict is not None:
             return list(self._restrict)
         names: dict[str, None] = {}
-        if self._bs is not None:
+        if self._wire is not None:
+            for n in self._wire_names:
+                names[n] = None
+        elif self._bs is not None:
             if self._needed is None:
                 names["_time"] = None
                 names["_stream"] = None
@@ -390,11 +560,13 @@ class BlockResult:
         # collapsed `fields a, a` the same way, and duplicate names must
         # not become duplicate JSON keys on the emit path
         fields = list(dict.fromkeys(fields))
-        if self._bs is None:
+        if self._bs is None and self._wire is None:
             return self.materialize(fields)
         br = BlockResult(self.nrows)
         br._bs = self._bs
         br._sel = self._sel
+        br._wire = self._wire
+        br._wire_names = self._wire_names
         br._restrict = fields
         # chained projections only ever narrow: a name re-added by a
         # later `fields` pipe after being dropped still reads ""
@@ -418,7 +590,14 @@ class BlockResult:
         br._needed = self._needed
         br._restrict = self._restrict
         br._restrict_set = self._restrict_set
-        if self._bs is not None and not self._cols:
+        if self._wire is not None:
+            br._wire = {n: _wire_take(wc, keep)
+                        for n, wc in self._wire.items()}
+            br._wire_names = list(self._wire_names)
+            kl = keep.tolist()
+            for n, vals in self._cols.items():
+                br._cols[n] = [vals[i] for i in kl]
+        elif self._bs is not None and not self._cols:
             br._bs = self._bs
             br._sel = self._sel[keep]
         else:
@@ -477,6 +656,11 @@ class BlockResult:
         if n == 0 or (self._restrict_set is not None
                       and name not in self._restrict_set):
             return _const_emit_col("", n)
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is None:
+                return _const_emit_col("", n)
+            return self._wire_emit_col(wc)
         if self._bs is None:
             return _pack_str_column(self._cols.get(name) or [""] * n)
         if name == "_time":
@@ -526,3 +710,102 @@ class BlockResult:
 
     def _sel_nums(self, col) -> np.ndarray:
         return col.nums[self._sel]
+
+    def _wire_emit_col(self, wc):
+        """One decoded wire column as an emit column: typed kinds map
+        1:1 (the C serializer formats them), string arenas pass through
+        with int64 offset views, dict codes gather through their packed
+        value arena — the same shapes the local emit path produces, so
+        the scatter-gather sink is arena-copy + native emit end to
+        end."""
+        kind = wc[0]
+        if kind == WIRE_STR:
+            return (0, wc[1], wc[2].astype(np.int64, copy=False),
+                    wc[3].astype(np.int64, copy=False))
+        if kind == WIRE_TIME:
+            return (1, wc[1])
+        if kind == WIRE_ISO:
+            return (2, wc[1], wc[2])
+        if kind == WIRE_INT:
+            return (3, wc[1])
+        if kind == WIRE_UINT:
+            return (4, wc[1])
+        if kind == WIRE_DICT:
+            _k, arena, doffs, dlens = _pack_str_column(wc[2])
+            ids = wc[1]
+            return 0, arena, doffs[ids], dlens[ids]
+        if kind == WIRE_CONST:
+            return _const_emit_col(wc[1], self.nrows)
+        if kind == WIRE_FLOAT:
+            from ..storage.values_encoder import _format_floats
+            return _fixed_emit_col(_format_floats(wc[1]).astype("S32"))
+        raise ValueError(f"unknown wire column kind {kind}")
+
+    # ---- columnar wire encode (server/cluster.py consumes this) ----
+
+    def wire_columns(self, fields: list[str] | None = None):
+        """Bulk selected-row materialization for the cluster wire path:
+        (names, [wire column per name]) — the emit-column discipline
+        with dict/const columns kept in their compact stored shapes.
+        Storage nodes serialize internal-select results straight from
+        this with zero row materialization; BlockResult.from_wire is
+        the decode-side twin."""
+        names = fields if fields is not None else self.column_names()
+        return names, [self._wire_column(n) for n in names]
+
+    def _wire_column(self, name: str):
+        n = self.nrows
+        if n == 0 or (self._restrict_set is not None
+                      and name not in self._restrict_set):
+            return (WIRE_CONST, "")
+        if self._wire is not None:
+            wc = self._wire.get(name)
+            if wc is None:
+                return (WIRE_CONST, "")
+            if wc[0] == WIRE_STR:
+                return (WIRE_STR,) + _dense_str_triple(wc[1], wc[2],
+                                                       wc[3])
+            return wc
+        if self._bs is None:
+            vals = self._cols.get(name)
+            if vals is None:
+                return (WIRE_CONST, "")
+            return (WIRE_STR,) + _pack_str_column(vals)[1:]
+        if name == "_time" and self._ts_np is not None:
+            return (WIRE_TIME, self._ts_np)
+        cv = self.const_value(name)    # consts + _stream/_stream_id
+        if cv is not None:
+            return (WIRE_CONST, cv)
+        col = self._bs.column(name)
+        if col is None:
+            return (WIRE_CONST, "")
+        from ..storage.values_encoder import (VT_CONST, VT_DICT,
+                                              VT_FLOAT64, VT_INT64,
+                                              VT_STRING,
+                                              VT_TIMESTAMP_ISO8601,
+                                              VT_UINT8, VT_UINT16,
+                                              VT_UINT32, VT_UINT64)
+        vt = col.vtype
+        if vt == VT_STRING:
+            return (WIRE_STR,) + _dense_str_triple(
+                col.arena, col.offsets[self._sel],
+                col.lengths[self._sel])
+        if vt == VT_DICT:
+            return (WIRE_DICT, col.ids[self._sel], col.dict_values)
+        if vt == VT_CONST:
+            return (WIRE_CONST, col.const_value)
+        if vt == VT_INT64:
+            return (WIRE_INT, self._sel_nums(col))
+        if vt in (VT_UINT8, VT_UINT16, VT_UINT32, VT_UINT64):
+            return (WIRE_UINT, self._sel_nums(col).astype(np.uint64))
+        if vt == VT_FLOAT64:
+            # floats ship native f64: the decoder re-renders via the
+            # same numpy canonical-repr helper, so strings round-trip
+            return (WIRE_FLOAT,
+                    self._sel_nums(col).astype(np.float64, copy=False))
+        if vt == VT_TIMESTAMP_ISO8601:
+            return (WIRE_ISO, self._sel_nums(col), col.iso_frac_w)
+        # VT_IPV4 and anything future: decode cache + packed gather
+        full = col.to_strings(self._bs.nrows)
+        return (WIRE_STR,) + _pack_str_column(
+            [full[i] for i in self._sel.tolist()])[1:]

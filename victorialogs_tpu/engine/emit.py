@@ -17,6 +17,13 @@ rows() dicts): same key order (column order), same escapes, empty
 values omitted, "{}" for all-empty rows.  tests/test_emit.py is the
 differential suite; `VL_NATIVE_EMIT=0` is the kill-switch that forces
 the per-row fallback (which is also the parity oracle).
+
+The same columnar contract now crosses the cluster seam: storage nodes
+ship typed wire frames (BlockResult.wire_columns — server/cluster.py)
+and frontends decode them into arena-backed views (from_wire) whose
+emit_columns() feeds this module directly, so scatter-gather NDJSON is
+arena-copy + native emit end to end.  tests/test_wire.py is that
+path's differential suite.
 """
 
 from __future__ import annotations

@@ -516,17 +516,56 @@ def run_query_collect(storage, tenants, q: Query | str,
     def sink(br: BlockResult):
         rows.extend(br.rows())
 
-    if activity.current_activity().enabled:
-        ctx = contextlib.nullcontext()
-    else:
-        # vlint: allow-accounting-discipline(entered by the with below)
-        ctx = activity.track("run_query_collect",
-                             q if isinstance(q, str) else q.to_string(),
-                             tenants)
-    with ctx:
+    with _collect_ctx(q, tenants):
         run_query(storage, tenants, q, write_block=sink,
                   timestamp=timestamp, runner=runner, deadline=deadline)
     return rows
+
+
+def _collect_ctx(q, tenants):
+    """Activity registration shared by the collect entry points:
+    inherit an ambient record (HTTP handlers register endpoint-specific
+    ones first) or self-register."""
+    if activity.current_activity().enabled:
+        return contextlib.nullcontext()
+    # vlint: allow-accounting-discipline(entered by the caller's with)
+    return activity.track("run_query_collect",
+                          q if isinstance(q, str) else q.to_string(),
+                          tenants)
+
+
+def run_query_collect_columns(storage, tenants, q: Query | str,
+                              timestamp: int | None = None, runner=None,
+                              deadline: float | None = None
+                              ) -> tuple[dict, int]:
+    """Columnar twin of run_query_collect: (cols, nrows) where cols is
+    an insertion-ordered {name: [str, ...]} with every list nrows long
+    (values absent in a block read "").
+
+    Consumers that aggregate result rows (hits/facets/stats endpoints,
+    the storage-backed aux pipes) ride this instead of rows() so the
+    local and cluster paths share one columnar contract — per-column
+    bulk lists, no per-row dict materialization."""
+    blocks: list = []            # (names, {name: list}, nrows)
+    order: dict[str, None] = {}
+
+    def sink(br: BlockResult):
+        names = br.column_names()
+        blocks.append((names, {n: br.column(n) for n in names},
+                       br.nrows))
+        for n in names:
+            order.setdefault(n, None)
+
+    with _collect_ctx(q, tenants):
+        run_query(storage, tenants, q, write_block=sink,
+                  timestamp=timestamp, runner=runner, deadline=deadline)
+    total = sum(b[2] for b in blocks)
+    cols: dict[str, list] = {n: [] for n in order}
+    for _names, bc, n in blocks:
+        for name, out in cols.items():
+            vals = bc.get(name)
+            out.extend(vals if vals is not None else [""] * n)
+    return cols, total
 
 
 # ---- field/value introspection (vlselect support) ----

@@ -54,13 +54,16 @@ class _StorageBackedPipe(Pipe):
         self._tenants = list(tenants)
         self._runner = runner
 
-    def _collect(self, q):
-        from ..engine.searcher import run_query_collect
+    def _collect_columns(self, q):
+        """(cols, nrows) — the columnar collect contract
+        (engine.searcher.run_query_collect_columns): bulk column
+        lists, no per-row dicts, shared by local and cluster paths."""
+        from ..engine.searcher import run_query_collect_columns
         if self._storage is None:
             raise ParseError(
                 f"{self.name} requires storage-backed execution")
-        return run_query_collect(self._storage, self._tenants, q,
-                                 runner=self._runner)
+        return run_query_collect_columns(self._storage, self._tenants,
+                                         q, runner=self._runner)
 
 
 # ---------------- join ----------------
@@ -92,14 +95,18 @@ class PipeJoin(_StorageBackedPipe):
     def make_processor(self, next_p):
         pipe = self
         # hash-join map built from the subquery once (reference builds it in
-        # storage_search.go:212-272)
-        rows = pipe._collect(pipe.query)
+        # storage_search.go:212-272); the subquery result arrives as
+        # bulk columns and only the per-GROUP extras become dicts
+        cols, nr = pipe._collect_columns(pipe.query)
         by = pipe.by
+        by_cols = [cols.get(f) or [""] * nr for f in by]
+        extra_items = [(pipe.prefix + k, v) for k, v in cols.items()
+                       if k not in by]
         jmap: dict[tuple, list[dict]] = {}
-        for r in rows:
-            key = tuple(r.get(f, "") for f in by)
-            extra = {pipe.prefix + k: v for k, v in r.items()
-                     if k not in by}
+        for i in range(nr):
+            key = tuple(bc[i] for bc in by_cols)
+            extra = {k: vc[i] for k, vc in extra_items
+                     if vc[i] != ""}
             jmap.setdefault(key, []).append(extra)
 
         class P(Processor):
@@ -155,14 +162,10 @@ class PipeUnion(_StorageBackedPipe):
 
             def flush(self):
                 # the union'd query runs after the main one finishes
-                # (reference pipe_union.go)
-                rows = pipe._collect(pipe.query)
-                if rows:
-                    names: dict[str, None] = {}
-                    for r in rows:
-                        for k in r:
-                            names.setdefault(k, None)
-                    cols = {n: [r.get(n, "") for r in rows] for n in names}
+                # (reference pipe_union.go); its columns pass straight
+                # through — no row-dict round trip
+                cols, nr = pipe._collect_columns(pipe.query)
+                if nr and cols:
                     self.next_p.write_block(BlockResult.from_columns(cols))
                 self.next_p.flush()
         return P(next_p)
@@ -223,10 +226,10 @@ class PipeStreamContext(_StorageBackedPipe):
                     hi = format_rfc3339(times[-1] + w)
                     qs = (f"_stream_id:{sid} "
                           f"_time:[{lo}, {hi}] | sort by (_time)")
-                    rows = pipe._collect(qs)
+                    cols, nr = pipe._collect_columns(qs)
                     keep_idx: set[int] = set()
-                    row_ts = [parse_rfc3339(r.get("_time", "")) or 0
-                              for r in rows]
+                    row_ts = [parse_rfc3339(v) or 0
+                              for v in cols.get("_time") or [""] * nr]
                     import bisect
                     for t in times:
                         # locate matched rows by bisect (row_ts is sorted)
@@ -235,20 +238,16 @@ class PipeStreamContext(_StorageBackedPipe):
                         i = bisect.bisect_left(row_ts, t)
                         while i < len(row_ts) and row_ts[i] == t:
                             a = max(0, i - pipe.before)
-                            b = min(len(rows), i + pipe.after + 1)
+                            b = min(nr, i + pipe.after + 1)
                             keep_idx.update(range(a, b))
                             i += 1
                     keep = sorted(keep_idx)
                     if not keep:
                         continue
-                    out_rows = [rows[i] for i in keep]
-                    names: dict[str, None] = {}
-                    for r in out_rows:
-                        for k in r:
-                            names.setdefault(k, None)
-                    cols = {n: [r.get(n, "") for r in out_rows]
-                            for n in names}
-                    self.next_p.write_block(BlockResult.from_columns(cols))
+                    out_cols = {n: [vals[i] for i in keep]
+                                for n, vals in cols.items()}
+                    self.next_p.write_block(
+                        BlockResult.from_columns(out_cols))
                 self.next_p.flush()
         return P(next_p)
 

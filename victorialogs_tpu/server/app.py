@@ -134,6 +134,12 @@ class Metrics:
             add(metric_name(base, **labels), v)
         for base, labels, v in journal.metrics_samples():
             add(metric_name(base, **labels), v)
+        # cluster wire-protocol accounting: typed vs legacy frame
+        # counts and raw tx/rx bytes (server/cluster.py; lazy import —
+        # cluster pulls in the whole select stack)
+        from . import cluster as _cluster
+        for base, labels, v in _cluster.wire_metrics_samples():
+            add(metric_name(base, **labels), v)
         if server is not None:
             from .. import __version__
             add(metric_name("vl_build_info", version=__version__,

@@ -16,25 +16,32 @@ internalinsert/internalselect stack:
 - failure semantics: any node error fails the whole query (the reference's
   explicit no-partial-results design).
 
-Wire formats are this repo's own (JSON + zstd frames): versioned via the
-`version` arg like the reference's per-endpoint protocol versions
-(netselect.go:28-63).
+Wire formats are this repo's own: versioned via the `version` arg like
+the reference's per-endpoint protocol versions (netselect.go:28-63).
+Since wire format "t1", internal-select results ship as TYPED COLUMNAR
+frames (string arenas + offsets, dict codes, native int64 _time —
+BlockResult.wire_columns() on the wire) negotiated per request with the
+legacy JSON frame as the mandatory fallback; see the framing section.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import os
 import struct
 import threading
 import time
 import urllib.error
 import urllib.request
 
+import numpy as np
+
 from .. import sched
-from ..engine.block_result import BlockResult
+from ..engine.block_result import (WIRE_CONST, WIRE_DICT, WIRE_ISO,
+                                   WIRE_STR, WIRE_TIME, BlockResult)
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
-from ..obs import activity, tracing
+from ..obs import activity, events, tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
@@ -142,18 +149,240 @@ def split_query(q):
 
 
 # ---------------- framing ----------------
+#
+# Two frame payload formats share the outer framing (4-byte BE length +
+# zstd payload):
+#   - legacy JSON frames: {"cols": {name: [str,...]}, "ts": [...]} —
+#     the mandatory fallback every version speaks;
+#   - typed columnar frames (PROTOCOL since wire format "t1"): a binary
+#     encoding of BlockResult.wire_columns() — string value arenas +
+#     uint32 offsets/lengths, dict codes + tiny value arenas, native
+#     int64 _time, consts — so the columnar representation survives the
+#     network seam instead of being destroyed into row strings and
+#     rebuilt on the frontend.
+# Frames are self-describing: typed payloads start with a magic prefix
+# no JSON document can (b"\x00VLT1"), so a reader handles a mixed
+# stream (trace frames stay JSON) and a frontend that REQUESTED typed
+# frames still decodes a legacy node's JSON replies — negotiation needs
+# no handshake round-trip.  Storage nodes only ever send typed frames
+# when the request carried `wire=t1`, so legacy frontends never see
+# them.  VL_WIRE_TYPED=0 kills both sides (request and serve).
+
+WIRE_FORMAT = "t1"
+TYPED_MAGIC = b"\x00VLT1"
+
+# wire-kind payload scalar dtypes (little-endian on the wire)
+_W_NUM_DTYPES = {1: "<i8", 2: "<i8", 3: "<i8", 4: "<u8", 7: "<f8"}
+
+
+def wire_typed_enabled() -> bool:
+    """VL_WIRE_TYPED=0 kill-switch: restores legacy JSON frames exactly
+    (this process neither requests nor serves typed frames)."""
+    return os.environ.get("VL_WIRE_TYPED", "1") != "0"
+
+
+# ---- wire-protocol observability (vl_wire_* on /metrics) ----
+
+_wire_mu = threading.Lock()
+_wire_counts: dict[str, int] = {}
+
+
+def _wire_note(key: str, delta: int = 1) -> None:
+    with _wire_mu:
+        _wire_counts[key] = _wire_counts.get(key, 0) + delta
+
+
+def wire_counters() -> dict:
+    with _wire_mu:
+        return dict(_wire_counts)
+
+
+def wire_metrics_samples() -> list:
+    """(base, labels, value) samples for Metrics.render: frame counts
+    and raw wire bytes (compressed, incl. frame headers), both labeled
+    by direction and format — a combined frontend+storage node sends
+    AND receives, so the two must not fold into one series.  Data and
+    stats frames follow the negotiated format; trace frames always
+    ride fmt="json"."""
+    c = wire_counters()
+    out = []
+    for fmt in ("typed", "json"):
+        for d in ("tx", "rx"):
+            # vlint: allow-per-row-emit(metric label dicts, bounded constant set)
+            out.append(("vl_wire_frames_total", {"dir": d, "fmt": fmt},
+                        c.get(f"{d}_frames_{fmt}", 0)))
+            # vlint: allow-per-row-emit(metric label dicts, bounded constant set)
+            out.append(("vl_wire_bytes_total", {"dir": d, "fmt": fmt},
+                        c.get(f"{d}_bytes_{fmt}", 0)))
+    out.append(("vl_wire_fallbacks_total", {},
+                c.get("fallbacks", 0)))
+    return out
+
 
 def write_frame(obj) -> bytes:
     payload = _zstd.compress(json.dumps(obj, ensure_ascii=False,
                                       separators=(",", ":")).encode("utf-8"))
+    _wire_note("tx_frames_json")
+    _wire_note("tx_bytes_json", len(payload) + 4)
     return struct.pack(">I", len(payload)) + payload
 
 
 END_FRAME = struct.pack(">I", 0)
 
 
-def read_frames(fp):
-    """Yield decoded frame objects from a stream until the end frame."""
+def write_typed_frame(br: BlockResult) -> bytes:
+    """One result block as a typed columnar frame, serialized straight
+    from BlockResult.wire_columns() — no per-row Python objects."""
+    names, wcols = br.wire_columns()
+    ts = br.timestamps_np()
+    parts = [TYPED_MAGIC,
+             struct.pack("<IHB", br.nrows, len(names),
+                         1 if ts is not None else 0)]
+    if ts is not None:
+        parts.append(ts.astype("<i8", copy=False).tobytes())
+    for name, wc in zip(names, wcols):
+        nb = name.encode("utf-8")
+        kind = wc[0]
+        parts.append(struct.pack("<HB", len(nb), kind))
+        parts.append(nb)
+        if kind == WIRE_STR:
+            arena, offs, lens = wc[1], wc[2], wc[3]
+            if int(arena.shape[0]) >= 1 << 32:
+                # uint32 offsets can't address it (never happens for
+                # block-sized results) — caller falls back to JSON
+                raise ValueError("typed frame arena overflow")
+            parts.append(struct.pack("<I", int(arena.shape[0])))
+            parts.append(arena.tobytes())
+            parts.append(offs.astype("<u4").tobytes())
+            parts.append(lens.astype("<u4").tobytes())
+        elif kind == WIRE_TIME:
+            pass            # value array IS the frame timestamps
+        elif kind == WIRE_ISO:
+            parts.append(struct.pack("<B", wc[2]))
+            parts.append(wc[1].astype("<i8", copy=False).tobytes())
+        elif kind == WIRE_DICT:
+            codes, dvals = wc[1], wc[2]
+            parts.append(struct.pack("<B", len(dvals)))
+            for v in dvals:
+                vb = v.encode("utf-8")
+                parts.append(struct.pack("<H", len(vb)))
+                parts.append(vb)
+            parts.append(codes.astype(np.uint8, copy=False).tobytes())
+        elif kind == WIRE_CONST:
+            vb = wc[1].encode("utf-8")
+            parts.append(struct.pack("<I", len(vb)))
+            parts.append(vb)
+        else:                # WIRE_INT / WIRE_UINT / WIRE_FLOAT
+            parts.append(wc[1].astype(_W_NUM_DTYPES[kind],
+                                      copy=False).tobytes())
+    payload = _zstd.compress(b"".join(parts))
+    _wire_note("tx_frames_typed")
+    _wire_note("tx_bytes_typed", len(payload) + 4)
+    return struct.pack(">I", len(payload)) + payload
+
+
+class _FrameReader:
+    """Bounds-checked cursor over one decompressed typed payload."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int):
+        self.buf = buf
+        self.pos = pos
+
+    def take(self, n: int) -> bytes:
+        end = self.pos + n
+        if n < 0 or end > len(self.buf):
+            raise IOError("corrupted typed frame: truncated payload")
+        out = self.buf[self.pos:end]
+        self.pos = end
+        return out
+
+    def array(self, dtype, count: int) -> np.ndarray:
+        it = np.dtype(dtype).itemsize
+        end = self.pos + it * count
+        if end > len(self.buf):
+            raise IOError("corrupted typed frame: truncated array")
+        a = np.frombuffer(self.buf, dtype=dtype, count=count,
+                          offset=self.pos)
+        self.pos = end
+        return a
+
+
+def decode_typed_frame(payload: bytes) -> BlockResult:
+    """Typed frame payload -> arena-backed BlockResult view.  Raises
+    IOError on any structural corruption (the scatter-gather fan-out
+    fails the whole query, like any other node transport error)."""
+    r = _FrameReader(payload, len(TYPED_MAGIC))
+    nrows, ncols, flags = struct.unpack("<IHB", r.take(7))
+    ts = None
+    if flags & 1:
+        ts = r.array("<i8", nrows)
+    names: list[str] = []
+    wcols: dict = {}
+    for _ in range(ncols):
+        nlen, kind = struct.unpack("<HB", r.take(3))
+        name = r.take(nlen).decode("utf-8", "replace")
+        if kind == WIRE_STR:
+            alen = struct.unpack("<I", r.take(4))[0]
+            arena = np.frombuffer(r.take(alen), dtype=np.uint8)
+            offs = r.array("<u4", nrows)
+            lens = r.array("<u4", nrows)
+            # bounds-check BEFORE these arrays can reach the native
+            # emitter (which reads arena+offset unchecked): every
+            # row's slice must lie inside the shipped arena
+            if nrows and int((offs.astype(np.int64)
+                              + lens.astype(np.int64)).max()) > alen:
+                raise IOError("corrupted typed frame: string slice "
+                              "out of arena bounds")
+            wc = (WIRE_STR, arena, offs, lens)
+        elif kind == WIRE_TIME:
+            if ts is None:
+                raise IOError("corrupted typed frame: _time column "
+                              "without frame timestamps")
+            wc = (WIRE_TIME, ts)
+        elif kind == WIRE_ISO:
+            frac_w = r.take(1)[0]
+            if frac_w > 9:
+                # encoders only produce 0-9 fractional digits; larger
+                # values would overflow the native formatter's
+                # fixed per-value output reservation
+                raise IOError("corrupted typed frame: ISO8601 "
+                              f"fractional width {frac_w}")
+            wc = (WIRE_ISO, r.array("<i8", nrows), frac_w)
+        elif kind == WIRE_DICT:
+            nvals = r.take(1)[0]
+            dvals = []
+            for _j in range(nvals):
+                vlen = struct.unpack("<H", r.take(2))[0]
+                dvals.append(r.take(vlen).decode("utf-8", "replace"))
+            codes = r.array(np.uint8, nrows)
+            # nvals == 0 with rows present is out of range too (every
+            # code must index a shipped value)
+            if codes.size and (nvals == 0
+                               or int(codes.max()) >= nvals):
+                raise IOError("corrupted typed frame: dict code out "
+                              "of range")
+            wc = (WIRE_DICT, codes, dvals)
+        elif kind == WIRE_CONST:
+            vlen = struct.unpack("<I", r.take(4))[0]
+            wc = (WIRE_CONST, r.take(vlen).decode("utf-8", "replace"))
+        elif kind in _W_NUM_DTYPES:
+            wc = (kind, r.array(_W_NUM_DTYPES[kind], nrows))
+        else:
+            raise IOError(f"corrupted typed frame: unknown column "
+                          f"kind {kind}")
+        names.append(name)
+        wcols[name] = wc
+    if r.pos != len(payload):
+        raise IOError("corrupted typed frame: trailing garbage")
+    return BlockResult.from_wire(names, wcols, nrows, ts_np=ts)
+
+
+def read_frame_payloads(fp):
+    """Yield (decompressed payload bytes, wire length) per frame until
+    the end frame.  The payload's leading bytes identify its format
+    (TYPED_MAGIC vs JSON) — see decode_typed_frame / json.loads."""
     while True:
         hdr = fp.read(4)
         if len(hdr) < 4:
@@ -167,7 +396,8 @@ def read_frames(fp):
             if not chunk:
                 raise IOError("truncated frame payload")
             payload += chunk
-        yield json.loads(_zstd.decompress(payload, max_output_size=1 << 30))
+        yield (_zstd.decompress(payload, max_output_size=1 << 30),
+               n + 4)
 
 
 # ---------------- server side: /internal/select/query ----------------
@@ -210,7 +440,19 @@ def handle_internal_select(storage, args, runner=None):
     # (bounded queue + abandon-stream cancellation) lives in streamwork
     from .streamwork import stream_blocks
 
+    # wire negotiation: typed frames only when the frontend asked for
+    # them AND this node's kill-switch allows (old frontends never ask,
+    # so they only ever see legacy JSON frames)
+    typed_wire = args.get("wire") == WIRE_FORMAT and wire_typed_enabled()
+
     def encode(br):
+        if typed_wire:
+            try:
+                return write_typed_frame(br)
+            except ValueError:
+                pass        # arena overflow: this block rides JSON
+        # legacy frames materialize per-row strings — the fallback
+        # every protocol version speaks
         cols = {n: br.column(n) for n in br.column_names()}
         return write_frame({"cols": cols, "ts": br.timestamps})
 
@@ -312,20 +554,25 @@ class NetInsertStorage:
 
     def must_add_rows(self, lr: LogRows) -> None:
         n_nodes = len(self.urls)
+        # each row's wire bytes are built EXACTLY ONCE, before any node
+        # grouping: routing, re-routing and the compressed per-node
+        # bodies all reuse the same serialized lines instead of
+        # re-paying json.dumps per send target
+        # vlint: allow-per-row-emit(ingest wire format is per-row framed JSON; ONE dumps per row total, reused across targets)
+        lines = [json.dumps(
+            # vlint: allow-per-row-emit(ingest wire format is per-row framed JSON; ONE dumps per row total, reused across targets)
+            {"t": lr.timestamps[i], "a": lr.tenants[i].account_id,
+             "p": lr.tenants[i].project_id,
+             "s": lr.stream_tags_str[i], "f": lr.rows[i]},
+            ensure_ascii=False, separators=(",", ":")).encode("utf-8")
+            for i in range(len(lr))]
         batches: dict[int, list] = {}
-        for i in range(len(lr)):
-            sid = lr.stream_ids[i]
-            node = (sid.hi ^ sid.lo) % n_nodes
-            ten = lr.tenants[i]
-            # vlint: allow-per-row-emit(replication wire protocol is per-row framed JSON)
-            batches.setdefault(node, []).append(json.dumps({
-                "t": lr.timestamps[i], "a": ten.account_id,
-                "p": ten.project_id, "s": lr.stream_tags_str[i],
-                "f": lr.rows[i]}, ensure_ascii=False,
-                separators=(",", ":")))
+        for i, sid in enumerate(lr.stream_ids):
+            batches.setdefault((sid.hi ^ sid.lo) % n_nodes,
+                               []).append(lines[i])
         errors = []
-        for node, lines in batches.items():
-            body = _zstd.compress(("\n".join(lines)).encode("utf-8"))
+        for node, blines in batches.items():
+            body = _zstd.compress(b"\n".join(blines))
             if not self._send(node, body):
                 # re-route to any healthy node (data locality is a
                 # preference, not a correctness requirement)
@@ -366,6 +613,10 @@ class NetSelectStorage:
             raise ValueError("no storage nodes configured")
         self.urls = [u.rstrip("/") for u in node_urls]
         self.timeout = timeout
+        # request typed columnar frames from storage nodes (nodes that
+        # predate the format, or run VL_WIRE_TYPED=0, ignore the arg
+        # and answer with legacy JSON frames — handled per frame)
+        self.wire_typed = wire_typed_enabled()
 
     def net_run_query(self, tenants, q, write_block=None,
                       timestamp: int | None = None,
@@ -442,6 +693,8 @@ class NetSelectStorage:
                 form["timeout"] = f"{remaining_s:.3f}s"
             if parent_span.enabled:
                 form["trace"] = "1"
+            if self.wire_typed:
+                form["wire"] = WIRE_FORMAT
             body = urlencode(form).encode("utf-8")
             req = urllib.request.Request(
                 f"{url}/internal/select/query", data=body, method="POST")
@@ -450,6 +703,7 @@ class NetSelectStorage:
             http_timeout = self.timeout if remaining_s is None else \
                 min(self.timeout, remaining_s + 5.0)
             try:
+                saw_json_data = False
                 with tracing.use_span(parent_span), \
                         tracing.current_span().span("storage_node",
                                                     url=url) as nsp:
@@ -457,7 +711,8 @@ class NetSelectStorage:
                             req, timeout=http_timeout) as resp:
                         if resp.status != 200:
                             raise IOError(f"{url}: HTTP {resp.status}")
-                        for frame in read_frames(resp):
+                        for payload, wire_n in \
+                                read_frame_payloads(resp):
                             if stop.is_set() or act.is_cancelled():
                                 # abandoning the stream also abandons
                                 # the node's trailing trace frame — the
@@ -466,12 +721,37 @@ class NetSelectStorage:
                                 # so the cut is marked instead
                                 nsp.set("trace_truncated", True)
                                 return
-                            if "trace" in frame:
-                                nsp.attach(frame["trace"])
-                                continue
-                            br = BlockResult.from_columns(
-                                frame.get("cols") or {},
-                                timestamps=frame.get("ts"))
+                            t_dec = time.monotonic()
+                            if payload.startswith(TYPED_MAGIC):
+                                br = decode_typed_frame(payload)
+                                _wire_note("rx_frames_typed")
+                                _wire_note("rx_bytes_typed", wire_n)
+                                nsp.add("typed_frames")
+                            else:
+                                frame = json.loads(payload)
+                                _wire_note("rx_frames_json")
+                                _wire_note("rx_bytes_json", wire_n)
+                                if "trace" in frame:
+                                    nsp.attach(frame["trace"])
+                                    continue
+                                if self.wire_typed and \
+                                        not saw_json_data:
+                                    # we asked for typed frames; the
+                                    # node answered legacy — a
+                                    # mixed-version cluster running on
+                                    # the fallback is worth an
+                                    # operator-visible journal event
+                                    saw_json_data = True
+                                    _wire_note("fallbacks")
+                                    events.emit("wire_fallback",
+                                                url=url,
+                                                requested=WIRE_FORMAT)
+                                br = BlockResult.from_columns(
+                                    frame.get("cols") or {},
+                                    timestamps=frame.get("ts"))
+                            nsp.add("wire_decode_s",
+                                    time.monotonic() - t_dec)
+                            nsp.add("wire_rx_bytes", wire_n)
                             nsp.add("blocks_received")
                             with lock:
                                 head.write_block(br)

@@ -14,7 +14,8 @@ import time
 
 from ..engine.block_result import format_rfc3339, parse_rfc3339
 from ..engine.searcher import (get_field_names, get_field_values, run_query,
-                               run_query_collect)
+                               run_query_collect,
+                               run_query_collect_columns)
 from ..obs import activity, slowlog, tracing
 from ..logsql.duration import parse_duration, ts_bounds
 from ..logsql.parser import (MAX_TS, MIN_TS, ParseError, Query, parse_query,
@@ -149,11 +150,14 @@ def _trace_root(args, q: Query):
     return None
 
 
-def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
-    """run_query_collect under an optional trace and an active-query
-    registry record; returns (rows, tree) where tree is the span-tree
-    dict only when the request asked for it.  Emits the slow-query line
-    either way, with the qid correlating it to active_queries/traces."""
+def _run_collect_traced(storage, tenants, q, args, runner, endpoint,
+                        collect=run_query_collect):
+    """A collect entry point (run_query_collect or its columnar twin
+    run_query_collect_columns) under an optional trace and an
+    active-query registry record; returns (result, tree) where tree is
+    the span-tree dict only when the request asked for it.  Emits the
+    slow-query line either way, with the qid correlating it to
+    active_queries/traces."""
     root = _trace_root(args, q)
     t0 = time.monotonic()
     # reuse the record the admission layer registered (server/app.py);
@@ -164,9 +168,8 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
             root.set("qid", act.qid)
         try:
             with tracing.activate(root):
-                rows = run_query_collect(storage, tenants, q,
-                                         runner=runner,
-                                         deadline=query_deadline(args))
+                result = collect(storage, tenants, q, runner=runner,
+                                 deadline=query_deadline(args))
         finally:
             # in finally: the slowest queries are exactly the ones that
             # die on the deadline — they must still produce their
@@ -175,7 +178,7 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint):
                               time.monotonic() - t0, root, qid=act.qid)
     tree = root.to_dict() if root is not None and want_trace(args) \
         else None
-    return rows, tree
+    return result, tree
 
 
 # ---------------- /select/logsql/query ----------------
@@ -270,15 +273,22 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
     fn = sf.StatsCount([])
     fn.out_name = "hits"
     q.pipes.append(PipeStats(by, [fn]))
-    rows, trace_tree = _run_collect_traced(storage, tenants, q, args,
-                                           runner, "/select/logsql/hits")
+    # columnar collect: the stats output arrives as bulk columns (one
+    # contract for local and cluster paths) — group rows are zipped
+    # from the lists, never materialized as dicts
+    (cols, n), trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner, "/select/logsql/hits",
+        collect=run_query_collect_columns)
+    tcol = cols.get("_time") or [""] * n
+    hcol = cols.get("hits") or [""] * n
+    fcols = [cols.get(f) or [""] * n for f in fields]
     groups: dict = {}
-    for r in rows:
-        key = tuple((f, r.get(f, "")) for f in fields)
+    for i in range(n):
+        key = tuple((f, fc[i]) for f, fc in zip(fields, fcols))
         g = groups.setdefault(key, {"fields": dict(key), "timestamps": [],
                                     "values": [], "total": 0})
-        g["timestamps"].append(r.get("_time", ""))
-        hits = int(r.get("hits", "0"))
+        g["timestamps"].append(tcol[i])
+        hits = int(hcol[i] or "0")
         g["values"].append(hits)
         g["total"] += hits
     out = {"hits": sorted(groups.values(),
@@ -298,13 +308,16 @@ def handle_facets(storage, args, headers, runner=None) -> dict:
         max_values_per_field=_int_arg(args, "max_values_per_field", 1000),
         max_value_len=_int_arg(args, "max_value_len", 1000),
         keep_const_fields=bool(args.get("keep_const_fields", ""))))
-    rows, trace_tree = _run_collect_traced(
-        storage, tenants, q, args, runner, "/select/logsql/facets")
+    (cols, n), trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner, "/select/logsql/facets",
+        collect=run_query_collect_columns)
     out: dict[str, list] = {}
-    for r in rows:
+    for fname, fval, hits in zip(cols.get("field_name") or [],
+                                 cols.get("field_value") or [],
+                                 cols.get("hits") or []):
         # vlint: allow-per-row-emit(facet OUTPUT groups, bounded by limit*fields)
-        out.setdefault(r["field_name"], []).append(
-            {"field_value": r["field_value"], "hits": int(r["hits"])})
+        out.setdefault(fname, []).append(
+            {"field_value": fval, "hits": int(hits)})
     # vlint: allow-per-row-emit(facet OUTPUT: one dict per faceted field)
     res = {"facets": [{"field_name": f, "values": v}
                       for f, v in sorted(out.items())]}
@@ -394,19 +407,23 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     sp = _require_stats_query(q)
     ts = _parse_time_arg(args.get("time", ""), time.time_ns(), end=True)
-    rows, trace_tree = _run_collect_traced(
-        storage, tenants, q, args, runner, "/select/logsql/stats_query")
+    (cols, nrows), trace_tree = _run_collect_traced(
+        storage, tenants, q, args, runner, "/select/logsql/stats_query",
+        collect=run_query_collect_columns)
     result = []
     by_names = [b.name for b in sp.by]
-    for r in rows:
-        for fn in sp.funcs:
+    by_cols = [cols.get(n) or [""] * nrows for n in by_names]
+    fn_cols = [cols.get(fn.out_name) or [""] * nrows
+               for fn in sp.funcs]
+    for i in range(nrows):
+        for fn, vc in zip(sp.funcs, fn_cols):
             metric = {"__name__": fn.out_name}
-            for n in by_names:
-                if n in r:
-                    metric[n] = r[n]
+            for n, bc in zip(by_names, by_cols):
+                if bc[i] != "":
+                    metric[n] = bc[i]
             # vlint: allow-per-row-emit(stats OUTPUT groups, bounded by group count)
             result.append({"metric": metric,
-                           "value": [ts / 1e9, r.get(fn.out_name, "")]})
+                           "value": [ts / 1e9, vc[i]]})
     out = {"status": "success",
            "data": {"resultType": "vector", "result": result}}
     if trace_tree is not None:
@@ -422,21 +439,28 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
         raise HTTPError(400, f"invalid step {step!r}")
     if not any(b.name == "_time" for b in sp.by):
         sp.by.insert(0, ByField("_time", bucket=step))
-    rows, trace_tree = _run_collect_traced(
+    (cols, nrows), trace_tree = _run_collect_traced(
         storage, tenants, q, args, runner,
-        "/select/logsql/stats_query_range")
+        "/select/logsql/stats_query_range",
+        collect=run_query_collect_columns)
     series: dict = {}
     by_names = [b.name for b in sp.by if b.name != "_time"]
-    for r in rows:
-        t = parse_rfc3339(r.get("_time", "")) or 0
-        for fn in sp.funcs:
-            key = (fn.out_name,) + tuple((n, r.get(n, ""))
-                                         for n in by_names)
+    tcol = cols.get("_time") or [""] * nrows
+    by_cols = [cols.get(n) or [""] * nrows for n in by_names]
+    fn_cols = [cols.get(fn.out_name) or [""] * nrows
+               for fn in sp.funcs]
+    for i in range(nrows):
+        t = parse_rfc3339(tcol[i]) or 0
+        for fn, vc in zip(sp.funcs, fn_cols):
+            key = (fn.out_name,) + tuple((n, bc[i])
+                                         for n, bc in zip(by_names,
+                                                          by_cols))
             s = series.setdefault(key, {"metric": dict(
-                [("__name__", fn.out_name)] + [(n, r.get(n, ""))
-                                               for n in by_names if n in r]),
+                [("__name__", fn.out_name)] +
+                [(n, bc[i]) for n, bc in zip(by_names, by_cols)
+                 if bc[i] != ""]),
                 "values": []})
-            s["values"].append([t / 1e9, r.get(fn.out_name, "")])
+            s["values"].append([t / 1e9, vc[i]])
     for s in series.values():
         s["values"].sort()
     out = {"status": "success",
@@ -496,13 +520,14 @@ def _tail_loop(storage, tenants, q, act, lag_ns, last_ts, stop_check,
                 return
             lines = ndjson_block(br).split(b"\n")[:br.nrows]
             names = br.column_names()
+            native_keys = br.native_time_keys()
             if "_time" not in names:
                 # projected out: arrival order, like the old "" keys
                 keys = [0] * br.nrows
-            elif br._bs is not None and br.timestamps_np() is not None:
-                # storage-backed: the displayed _time IS the rendered
-                # int64 array — sort on it directly
-                keys = br.timestamps_np().tolist()
+            elif native_keys is not None:
+                # storage-backed or cluster wire view: the displayed
+                # _time IS the native int64 array — sort on it directly
+                keys = native_keys.tolist()
             else:
                 # a pipe may have rewritten _time (copy/rename/extract):
                 # the sort key must follow the DISPLAYED value, not the
