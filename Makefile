@@ -6,7 +6,8 @@
 NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test lint bench bench-bloom bench-pipeline \
-	bench-concurrent bench-emit bench-journal bench-wire clean
+	bench-concurrent bench-emit bench-explain bench-journal \
+	bench-wire clean
 
 all: native
 
@@ -57,6 +58,15 @@ bench-emit:
 # PR 4 trace-overhead bound (10% + 2 ms) — PERF.md
 bench-journal:
 	python tools/bench_journal.py --json BENCH_journal.json
+
+# query EXPLAIN + cost-model accountability: the continuous plan-time
+# pricing pass must stay within the PR 4 trace-overhead bound
+# (10% + 2 ms), explain=1 must be O(headers) (>=20x faster than
+# execution, zero device dispatches), and the median cost-model
+# relative error (duration/bytes) must stay under the recorded bounds
+# — PERF.md round 11
+bench-explain:
+	python tools/bench_explain.py --json BENCH_explain.json
 
 # cluster wire protocol: typed columnar frames vs legacy JSON frames on
 # a real 2-node scatter-gather; asserts bit-identical hit sets, >=2x

@@ -1,0 +1,554 @@
+"""Query EXPLAIN differential suite (obs/explain.py).
+
+Pins the PR's acceptance contract:
+
+- `?explain=1` builds the priced physical plan with ZERO device
+  dispatches and ZERO storage-block data reads beyond headers/blooms;
+- `?explain=analyze` actuals are byte-consistent with what `?trace=1`
+  and /metrics report for the same query — packed, serial and cluster
+  paths;
+- kill reasons cite the responsible stage (time range / aggregate
+  bloom with the killing filter leaf);
+- continuous pricing: predicted_* vs actuals ride the completion
+  record and the query_done event (exec_s/drain_s split included),
+  `vl_cost_model_rel_error_*` histograms render, and
+  `top_queries?by=cost_error` sorts on the worst-priced queries;
+- `top_queries` input hardening: unknown `by=` is a 400 with the
+  allowed set, `n=` is validated and clamped.
+"""
+
+import json
+import http.client
+import urllib.parse
+
+import pytest
+
+from test_obs import parse_prometheus
+
+from victorialogs_tpu.obs import activity, events
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return BatchRunner()
+
+
+def _req(srv, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _mk_server(path, runner=None, **kw):
+    """Journal OFF: the differential assertions need the storage
+    byte-identical between the reference run and the analyze run, and
+    the self-telemetry journal ingests into the same storage."""
+    import os
+    from victorialogs_tpu.server.app import VLServer
+    storage = Storage(str(path), retention_days=100000,
+                      flush_interval=3600)
+    os.environ["VL_JOURNAL"] = "0"
+    try:
+        return VLServer(storage, listen_addr="127.0.0.1", port=0,
+                        runner=runner, **kw)
+    finally:
+        os.environ.pop("VL_JOURNAL", None)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory, runner):
+    """Many small parts (they pack) + distinct token vocabularies per
+    half so aggregate-bloom part kills have something to kill."""
+    srv = _mk_server(tmp_path_factory.mktemp("explain"), runner)
+    n = 0
+    for pp in range(6):
+        word = "alpha" if pp < 3 else "beta"
+        rows = []
+        for _i in range(400):
+            g = n
+            n += 1
+            # several unique tokens per row keep the per-block blooms
+            # big enough that the aggregate kill has no false positives
+            # on this corpus (a FP would only soften prune counts, but
+            # the test pins exact part-kill numbers)
+            rows.append(json.dumps({
+                "_time": T0 + g * 50_000_000,
+                "_msg": f"m {word} u{g} v{(g * 31) % 9973} "
+                        f"w{(g * 131) % 9973} "
+                        f"{'error' if g % 3 == 0 else 'ok'} {g}",
+                "app": f"app{g % 3}",
+                "lvl": ["info", "warn", "error"][g % 3],
+            }))
+        st, _ = _req(srv, "POST", "/insert/jsonline?_stream_fields=app",
+                     body="\n".join(rows).encode())
+        assert st == 200
+        _req(srv, "GET", "/internal/force_flush")
+    yield srv
+    srv.close()
+    srv.storage.close()
+
+
+def _explain(srv, query, mode="1", extra=""):
+    q = urllib.parse.quote(query)
+    st, data = _req(srv, "GET", f"/select/logsql/query?query={q}"
+                                f"&explain={mode}{extra}")
+    assert st == 200, data
+    out = json.loads(data)
+    assert out["status"] == "ok"
+    return out["explain"]
+
+
+def _run(srv, query, extra=""):
+    q = urllib.parse.quote(query)
+    st, data = _req(srv, "GET",
+                    f"/select/logsql/query?query={q}{extra}")
+    assert st == 200, data
+    return [json.loads(line) for line in data.decode().splitlines()
+            if line]
+
+
+def _metric(srv, name):
+    st, data = _req(srv, "GET", "/metrics")
+    assert st == 200
+    return parse_prometheus(data.decode()).get(name, 0)
+
+
+def _ring_mark():
+    """Identity of the newest completed record (the ring is a capped
+    deque, so LENGTH stops growing once full — watch the head qid)."""
+    recs = activity.completed_snapshot()
+    return recs[-1]["qid"] if recs else None
+
+
+def _settle(mark, timeout=10.0):
+    """Wait until a new completed record lands past `mark`: per-tenant
+    totals and the query_done event fire at deregistration, which
+    happens AFTER the response bytes are on the wire — a /metrics
+    scrape can otherwise race it."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _ring_mark() != mark:
+            return
+        time.sleep(0.01)
+    raise AssertionError("query record never deregistered")
+
+
+def _last_completed(query_frag):
+    recs = [r for r in activity.completed_snapshot()
+            if query_frag in r["query"]
+            and r["endpoint"] == "/select/logsql/query"]
+    assert recs, f"no completed record matching {query_frag!r}"
+    return recs[-1]
+
+
+# ---------------- explain=1: the plan, without execution ----------------
+
+def test_explain_plan_zero_dispatch_zero_block_reads(server, runner,
+                                                     monkeypatch):
+    _run(server, "alpha error | fields _time")   # warm staging/EWMAs
+
+    from victorialogs_tpu.storage import datadb
+    from victorialogs_tpu.storage.part import Part
+    reads = {"n": 0}
+
+    def count_reads(fn):
+        def wrapped(self, *a, **kw):
+            reads["n"] += 1
+            return fn(self, *a, **kw)
+        return wrapped
+    for cls in (Part, datadb.InmemoryPart):
+        monkeypatch.setattr(cls, "block_column",
+                            count_reads(cls.block_column))
+        monkeypatch.setattr(cls, "block_timestamps",
+                            count_reads(cls.block_timestamps))
+
+    d0 = runner.stats()["device_calls"]
+    tree = _explain(server, "alpha error | fields _time")
+    assert runner.stats()["device_calls"] == d0, \
+        "explain=1 dispatched to the device"
+    assert reads["n"] == 0, \
+        f"explain=1 read {reads['n']} storage block columns"
+
+    assert tree["mode"] == "plan"
+    assert tree["endpoint"] == "/select/logsql/query"
+    assert tree["shape"] == "rows"
+    pred = tree["predicted"]
+    assert pred["parts_total"] == 6
+    # "beta" parts die on the aggregate bloom for token "alpha"
+    assert pred["parts_retained"] == 3
+    assert pred["parts_killed"] == 3
+    assert pred["rows_scanned"] == 1200
+    assert pred["bytes_scanned"] > 0
+    assert pred["dispatches"] >= 1
+    assert pred["duration_s"] > 0
+    # the filter annotation marks the prunable leaf
+    assert "alpha" in json.dumps(tree["filter"])
+
+
+def test_explain_kill_reasons(server):
+    tree = _explain(server, "alpha | fields _time")
+    parts = [p for pt in tree["partitions"] for p in pt["parts"]]
+    killed = [p for p in parts if p["status"] == "killed"]
+    retained = [p for p in parts if p["status"] == "retained"]
+    assert len(retained) == 3 and len(killed) == 3
+    for p in killed:
+        assert p["reason"] == "aggregate_bloom"
+        assert p["killed_by"]["field"] == "_msg"
+        assert "alpha" in p["killed_by"]["tokens"]
+        assert "alpha" in p["killed_by"]["filter"]
+    for p in retained:
+        assert p["blocks_candidate"] > 0
+        assert p["rows_candidate"] > 0
+
+    # a time range past the data kills every part with reason
+    # time_range before any header group decodes
+    end_ns = T0 - 1
+    tree = _explain(server, "* | fields _time",
+                    extra=f"&start=0&end={end_ns}")
+    parts = [p for pt in tree["partitions"] for p in pt["parts"]]
+    # partitions outside the range may not be selected at all; when
+    # parts are listed they must all cite time_range
+    for p in parts:
+        assert p["status"] == "killed" and p["reason"] == "time_range"
+    assert tree["predicted"]["parts_retained"] == 0
+
+
+def test_explain_pack_membership_matches_dispatch(server, runner):
+    tree = _explain(server, "alpha error | fields _time")
+    units = [u for pt in tree["partitions"] for u in pt["units"]]
+    assert units
+    # 3 small retained parts share a pad bucket: ONE packed unit
+    assert len(units) == 1
+    u = units[0]
+    assert u["pack"] is True and len(u["members"]) == 3
+    assert u["kind"] == "fused_filter"
+    assert u["pad_bucket"] > 0
+
+    # the dispatch agrees: analyze submits exactly the planned units
+    tree = _explain(server, "alpha error | fields _time",
+                    mode="analyze")
+    assert tree["mode"] == "analyze"
+    assert tree["actual"]["dispatches_submitted"] == len(units)
+
+
+# ---------------- explain=analyze vs ?trace=1 vs /metrics ----------------
+
+QUERY = "alpha error | fields _time"
+
+
+def _assert_analyze_consistent(srv, query):
+    """The differential core: a traced run, a /metrics-delta'd plain
+    run and an explain=analyze run of the same query must agree on the
+    scan actuals (storage is immutable between runs)."""
+    mark = _ring_mark()
+    rows_traced = _run(srv, query, extra="&trace=1")
+    trace = rows_traced[-1]["_trace"]
+    _settle(mark)
+    rec_traced = _last_completed(query.split(" ", 1)[0])
+
+    b0 = _metric(srv, 'vl_tenant_bytes_scanned_total{tenant="0:0"}')
+    mark = _ring_mark()
+    tree = _explain(srv, query, mode="analyze", extra="&trace=1")
+    _settle(mark)
+    b1 = _metric(srv, 'vl_tenant_bytes_scanned_total{tenant="0:0"}')
+
+    actual = tree["actual"]
+    # vs the /metrics delta of ITS OWN run
+    assert b1 - b0 == actual["bytes_scanned"]
+    # vs the traced run's activity record (deterministic re-execution)
+    assert actual["bytes_scanned"] == \
+        rec_traced["progress"]["bytes_scanned"]
+    assert actual["rows_scanned"] == \
+        rec_traced["progress"]["rows_scanned"]
+    assert actual["parts_scanned"] == \
+        rec_traced["progress"].get("parts_scanned", 0)
+
+    # vs the span tree shipped with the SAME analyze run: per-unit
+    # actuals are sourced from harvest/submit spans, so unit counts and
+    # killed-block counters must line up
+    from victorialogs_tpu.obs.tracing import flatten_tree
+    own = tree["trace"]
+    flat = flatten_tree(own)
+    if "submit" in flat:
+        assert flat["submit"]["count"] == actual["dispatches_submitted"]
+    assert _sum_attr(own, "blocks_killed_bloom") == \
+        actual.get("blocks_killed_bloom", 0)
+    # the traced REFERENCE run agrees too (cross-run determinism)
+    assert _sum_attr(trace, "blocks_killed_bloom") == \
+        actual.get("blocks_killed_bloom", 0)
+    return tree
+
+
+def _sum_attr(tree, key):
+    """Sum one counter attribute over every span of a trace dict (the
+    bloom kill-path lands it wherever the probe ran: prune spans for
+    aggregate walks, submit spans for fused dispatch probes)."""
+    stack, total = [tree], 0
+    while stack:
+        node = stack.pop()
+        total += (node.get("attrs") or {}).get(key, 0)
+        stack.extend(node.get("children", ()))
+    return total
+
+
+def test_analyze_consistency_packed(server):
+    tree = _assert_analyze_consistent(server, QUERY)
+    # packed path: per-unit actuals grafted from the span tree
+    units = [u for pt in tree["partitions"] for u in pt["units"]]
+    assert any("actual" in u for u in units)
+    u = next(u for u in units if "actual" in u)
+    assert u["actual"]["rows"] == u["rows"]
+    assert u["actual"]["blocks"] == u["blocks"]
+    assert "dispatch_rtt_s" in u["actual"]
+    assert "emit_s" in u["actual"]
+
+
+def test_analyze_consistency_serial(server, monkeypatch):
+    # serial path: no packing, depth-1 window — one unit per part
+    monkeypatch.setenv("VL_PACK_PARTS", "1")
+    monkeypatch.setenv("VL_INFLIGHT", "1")
+    tree = _assert_analyze_consistent(server, QUERY)
+    units = [u for pt in tree["partitions"] for u in pt["units"]]
+    assert len(units) == 3
+    assert all(not u["pack"] for u in units)
+    assert tree["actual"]["dispatches_submitted"] == 3
+
+
+def test_analyze_consistency_cpu_fallback(server, monkeypatch):
+    # the host-executor shape still explains/analyzes (no unit spans to
+    # graft, but query-level actuals stay consistent)
+    monkeypatch.setenv("VL_COST_FORCE", "host")
+    tree = _assert_analyze_consistent(server, QUERY)
+    assert tree["actual"]["bytes_scanned"] > 0
+
+
+# ---------------- other endpoints ----------------
+
+def test_explain_endpoints(server, runner):
+    d0 = runner.stats()["device_calls"]
+    for path, extra in (
+            ("hits", "&step=1h"),
+            ("facets", ""),
+            ("stats_query", ""),
+    ):
+        if path == "stats_query":
+            q = urllib.parse.quote("alpha | stats count() n")
+        else:
+            q = urllib.parse.quote("alpha")
+        st, data = _req(server, "GET",
+                        f"/select/logsql/{path}?query={q}"
+                        f"&explain=1{extra}")
+        assert st == 200, (path, data)
+        tree = json.loads(data)["explain"]
+        assert tree["endpoint"] == f"/select/logsql/{path}"
+        assert tree["predicted"]["parts_retained"] == 3
+    # hits/stats explain plans the INJECTED stats pipe: device stats
+    # shape, still zero dispatches
+    assert runner.stats()["device_calls"] == d0
+    st, data = _req(server, "GET",
+                    "/select/logsql/stats_query_range?query="
+                    + urllib.parse.quote("alpha | stats count() n")
+                    + "&step=1h&explain=1")
+    assert st == 200
+    assert json.loads(data)["explain"]["shape"] == "stats"
+
+    # bad explain values are client errors
+    st, _ = _req(server, "GET",
+                 "/select/logsql/query?query=%2A&explain=bogus")
+    assert st == 400
+
+
+# ---------------- continuous pricing + exec/drain split ----------------
+
+def test_query_done_carries_predictions_and_exec_drain(server):
+    seen = []
+
+    def capture(ts_ns, event, fields):
+        if event == "query_done":
+            seen.append(dict(fields))
+    mark = _ring_mark()
+    events.subscribe(capture)
+    try:
+        _run(server, "alpha error | fields _time")
+        _settle(mark)    # query_done emits at deregistration
+    finally:
+        events.unsubscribe(capture)
+    qd = [f for f in seen
+          if f.get("endpoint") == "/select/logsql/query"]
+    assert qd, "no query_done event captured"
+    f = qd[-1]
+    for key in ("predicted_duration_s", "predicted_bytes",
+                "predicted_dispatches", "exec_s", "drain_s",
+                "cost_err_duration", "cost_err_bytes",
+                "cost_err_dispatches"):
+        assert key in f, f"query_done missing {key}: {sorted(f)}"
+    assert f["exec_s"] <= f["duration_ms"] / 1e3 + 1e-6
+    # predictions are exact on bytes for an already-priced walk
+    assert f["cost_err_bytes"] == 0.0
+    rec = _last_completed("alpha")
+    assert rec["cost_error"] is not None
+
+
+def test_cost_error_histograms_render(server):
+    st, data = _req(server, "GET", "/metrics")
+    samples = parse_prometheus(data.decode())
+    assert samples.get("vl_cost_model_rel_error_duration_count", 0) > 0
+    assert samples.get("vl_cost_model_rel_error_bytes_count", 0) > 0
+    assert samples.get("vl_cost_model_rel_error_dispatches_count",
+                       0) > 0
+
+
+def test_pricing_kill_switch(server, monkeypatch):
+    monkeypatch.setenv("VL_QUERY_PRICING", "0")
+    mark = _ring_mark()
+    _run(server, "alpha ok | fields _time")
+    _settle(mark)
+    rec = _last_completed('"ok"')
+    assert "predicted_duration_s" not in rec["progress"]
+    assert "cost_error" not in rec
+
+
+# ---------------- top_queries hardening ----------------
+
+def test_top_queries_input_hardening(server):
+    _run(server, "alpha error | fields _time")
+    st, data = _req(server, "GET",
+                    "/select/logsql/top_queries?by=bogus")
+    assert st == 400
+    body = data.decode()
+    for allowed in activity.TOP_QUERIES_BY:
+        assert allowed in body
+    st, _ = _req(server, "GET", "/select/logsql/top_queries?n=abc")
+    assert st == 400
+    # clamped, not erroring
+    st, data = _req(server, "GET", "/select/logsql/top_queries?n=-5")
+    assert st == 200
+    assert len(json.loads(data)["top_queries"]) == 1
+    st, data = _req(server, "GET",
+                    "/select/logsql/top_queries?n=5&by=cost_error")
+    assert st == 200
+    top = json.loads(data)["top_queries"]
+    errs = [r.get("cost_error") for r in top]
+    priced = [e for e in errs if e is not None]
+    assert priced == sorted(priced, reverse=True)
+    # unpriced records sort after priced ones
+    if None in errs:
+        assert errs.index(None) >= len(priced)
+
+
+# ---------------- cluster ----------------
+
+@pytest.fixture(scope="module")
+def cluster2(tmp_path_factory, runner):
+    n1 = _mk_server(tmp_path_factory.mktemp("exn1"), runner)
+    n2 = _mk_server(tmp_path_factory.mktemp("exn2"), runner)
+    front = _mk_server(
+        tmp_path_factory.mktemp("exfront"),
+        storage_nodes=[f"http://127.0.0.1:{n1.port}",
+                       f"http://127.0.0.1:{n2.port}"])
+    rows = []
+    for i in range(500):
+        rows.append(json.dumps({
+            "_time": T0 + i * 250_000_000,
+            "_msg": f"gamma {'error' if i % 3 == 0 else 'ok'} {i}",
+            "app": f"app{i % 5}",
+        }))
+    st, _ = _req(front, "POST", "/insert/jsonline?_stream_fields=app",
+                 body="\n".join(rows).encode())
+    assert st == 200
+    for node in (n1, n2):
+        _req(node, "GET", "/internal/force_flush")
+    yield front, n1, n2
+    for s in (front, n1, n2):
+        s.close()
+        s.storage.close()
+
+
+def test_cluster_explain_merges_node_trees(cluster2, runner):
+    front, n1, n2 = cluster2
+    d0 = runner.stats()["device_calls"]
+    tree = _explain(front, "gamma error | fields _time")
+    assert runner.stats()["device_calls"] == d0, \
+        "cluster explain=1 dispatched on a storage node"
+    assert tree["cluster"] is True
+    nodes = tree["storage_nodes"]
+    assert len(nodes) == 2
+    assert {n["name"] for n in nodes} == {"storage_node"}
+    total = 0
+    for node in nodes:
+        sub = node["explain"]
+        assert sub["mode"] == "plan"
+        total += sub["predicted"]["parts_retained"]
+    assert total >= 2
+    assert tree["predicted"]["parts_retained"] == total
+
+
+def test_cluster_explain_analyze(cluster2):
+    front, n1, n2 = cluster2
+    plain = _run(front, "gamma error | fields _time", extra="&limit=0")
+    tree = _explain(front, "gamma error | fields _time",
+                    mode="analyze")
+    rows = bytes_ = 0
+    for node in tree["storage_nodes"]:
+        sub = node["explain"]
+        assert sub["mode"] == "analyze"
+        assert "trace" not in sub       # only shipped when asked
+        rows += sub["actual"]["rows_scanned"]
+        bytes_ += sub["actual"]["bytes_scanned"]
+    assert rows == 500
+    assert bytes_ > 0
+    assert len(plain) > 0
+
+    # trace parity with the single-node path: analyze + trace=1 ships
+    # each node's span tree inside its explain tree
+    tree = _explain(front, "gamma error | fields _time",
+                    mode="analyze", extra="&trace=1")
+    for node in tree["storage_nodes"]:
+        trace = node["explain"]["trace"]
+        assert trace["name"] == "query"
+
+
+def test_cluster_explain_limit_pushdown(cluster2):
+    """net_explain ships the same pushed-down limit net_run_query would,
+    so each node's tree describes the sub-query the real scatter path
+    runs (PipeLimit appended node-side), not an unbounded scan."""
+    front, _n1, _n2 = cluster2
+    tree = _explain(front, "gamma | limit 10")
+    for node in tree["storage_nodes"]:
+        assert "limit 10" in node["explain"]["query"], \
+            node["explain"]["query"]
+
+
+def test_cluster_explain_node_shed_is_429(tmp_path, runner):
+    """A storage node's admission control shedding the explain
+    sub-request surfaces at the frontend as 429 + Retry-After, exactly
+    like net_run_query sheds — not as an internal error."""
+    node = _mk_server(tmp_path / "node", runner, max_concurrent=1,
+                      max_queue_duration=0.2)
+    front = _mk_server(
+        tmp_path / "front",
+        storage_nodes=[f"http://127.0.0.1:{node.port}"])
+    try:
+        # saturate the node's internal pool as another tenant so the
+        # 0:0 explain sub-request genuinely queues, then sheds
+        with node.internal_admission.admit("9:9", "/hold"):
+            q = urllib.parse.quote("gamma")
+            st, data = _req(front, "GET",
+                            f"/select/logsql/query?query={q}&explain=1")
+        assert st == 429, (st, data)
+        assert json.loads(data)["reason"] in ("queue_full", "deadline")
+    finally:
+        for s in (front, node):
+            s.close()
+            s.storage.close()

@@ -29,7 +29,10 @@ import re
 from .core import Finding, SourceFile
 from .locks import _dotted, _module_jit_names
 
-SCOPE_RE = re.compile(r"(^|/)(tpu|engine)(/|$)")
+# obs/explain.py rides the same scope: the pricing pass runs at plan
+# time on EVERY query (and explain=1 must stay zero-dispatch), so a
+# hidden host sync or jit-closure there is a query-path regression
+SCOPE_RE = re.compile(r"(^|/)(tpu|engine)(/|$)|(^|/)obs/explain\.py$")
 # the emit-shape rule runs where response/row materialization lives
 EMIT_SCOPE_RE = re.compile(r"(^|/)(server|engine)(/|$)")
 

@@ -209,11 +209,30 @@ def _run_query_guarded(storage, tenants, q, write_block, timestamp,
                               timestamp=timestamp, deadline=deadline)
         return
 
+    # continuous plan-time pricing (obs/explain.py): claim the record's
+    # priced slot BEFORE subqueries materialize — an in(<subquery>)
+    # executes through this same record and must not publish ITS
+    # prediction as the outer query's
+    from ..obs import explain
+    act0 = activity.current_activity()
+    price = runner is not None and act0.enabled and \
+        explain.pricing_enabled() and not act0.counter("priced")
+    if price:
+        act0.set("priced", 1)
+
     init_subqueries(storage, tenants, q, runner=runner)
     # storage-backed pipes (join/union/stream_context) get their query hook
     for p in q.pipes:
         if hasattr(p, "init_with_storage"):
             p.init_with_storage(storage, tenants, runner)
+
+    if price:
+        # the same header walk _scan_parts repeats in a moment, priced
+        # against the live cost-model EWMAs: predicted_* land next to
+        # the actuals in the query_done journal event, and
+        # sched/admission can weigh predicted_duration_s against a
+        # request deadline in a follow-up
+        explain.price_into_activity(storage, tenants, q, runner, act0)
     min_ts, max_ts = q.get_time_range()
 
     # rate()/rate_sum() divide by the time-filter range (reference

@@ -107,6 +107,36 @@ def iter_and_path_token_leaves(f):
             yield canonical_field(f.field), toks, f
 
 
+def filter_plan_tree(f) -> dict:
+    """Compact JSON-ready view of a filter tree for the EXPLAIN plan
+    (obs/explain.py): operator kind, target field, and — on
+    bloom-prunable leaves — the required word tokens the part-aggregate
+    kill path (storage/filterbank.part_aggregate_prunes) can cite when
+    it kills a part.  Purely descriptive: no evaluation, no token
+    hashing."""
+    kind = type(f).__name__.removeprefix("Filter").lower() or "filter"
+    if isinstance(f, (FilterAnd, FilterOr)):
+        return {"op": kind,
+                "children": [filter_plan_tree(s) for s in f.filters]}
+    if isinstance(f, FilterNot):
+        return {"op": "not", "children": [filter_plan_tree(f.inner)]}
+    node: dict = {"op": kind, "filter": f.to_string()}
+    if isinstance(f, FilterTime):
+        node["min_ts"] = f.min_ts
+        node["max_ts"] = f.max_ts
+        return node
+    fld = getattr(f, "field", None)
+    if fld is not None:
+        node["field"] = canonical_field(fld)
+    if isinstance(f, _ValuePredFilter):
+        toks = f._tokens()
+        if toks:
+            # the tokens whose provable absence kills blocks (bloom
+            # plane) and whole parts (Bloofi-style aggregate)
+            node["prune_tokens"] = list(toks)
+    return node
+
+
 def _native_scan_ops(col, ops, combine: str):
     """AND/OR native scans over one column; None if any scan unavailable
     (caller falls back to the per-row Python path)."""

@@ -2,7 +2,10 @@
 fixed-bucket Prometheus histograms (hist.py), the slow-query log
 (slowlog.py), the active-query registry with per-tenant resource
 accounting (activity.py — /select/logsql/active_queries, cancel_query,
-top_queries, vl_tenant_* /metrics series), and the self-telemetry
+top_queries, vl_tenant_* /metrics series), query EXPLAIN with priced
+physical plans and continuous cost-model error tracking (explain.py —
+?explain=1/analyze, predicted_* on every query,
+vl_cost_model_rel_error_* histograms), and the self-telemetry
 journal: a process-wide structured event bus (events.py) whose
 subscriber (journal.py) batches operational events — query
 completions, admission sheds, merges/flushes, faults, slow queries —

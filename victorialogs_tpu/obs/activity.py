@@ -49,7 +49,7 @@ import threading
 import time
 from collections import deque
 
-from . import events
+from . import events, hist
 
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "vl_query_activity", default=None)
@@ -80,8 +80,8 @@ class QueryActivity:
     accounting-discipline)."""
 
     __slots__ = ("qid", "tenant", "endpoint", "query", "start_unix",
-                 "start_mono", "phase", "abandoned", "_mu", "_c",
-                 "_cancel", "_phase_t0")
+                 "start_mono", "exec_mono", "phase", "abandoned", "_mu",
+                 "_c", "_cancel", "_phase_t0")
 
     enabled = True
 
@@ -93,6 +93,7 @@ class QueryActivity:
         # vlint: allow-wall-clock(start timestamp shown to operators is real wall time)
         self.start_unix = time.time()
         self.start_mono = time.monotonic()
+        self.exec_mono: float | None = None
         self.phase = "plan"
         self.abandoned = False
         self._mu = threading.Lock()
@@ -136,6 +137,22 @@ class QueryActivity:
                 self.endpoint = endpoint
             if query:
                 self.query = query
+
+    def mark_exec_done(self) -> None:
+        """Stamp EXECUTION completion — the last dispatch unit
+        harvested and the final sink write made — separately from
+        response-drain completion (the _Track exit).  The sink side of
+        the ROADMAP's exec/drain split: admission's duration EWMA feeds
+        on execution time only (sched/admission.py reads exec_mono), so
+        a stalled streaming client no longer poisons deadline
+        feasibility; query_done journals both exec_s and drain_s.
+        First call wins (a tail's repeated polls keep the first)."""
+        if self.exec_mono is not None:
+            return
+        now = time.monotonic()
+        self.exec_mono = now
+        with self._mu:
+            self._c["exec_s"] = round(now - self.start_mono, 6)
 
     def counter(self, key: str):
         with self._mu:
@@ -197,6 +214,7 @@ class _NoopActivity:
     query = ""
     phase = ""
     abandoned = False
+    exec_mono = None
 
     def add(self, key, n=1) -> None:
         pass
@@ -208,6 +226,9 @@ class _NoopActivity:
         pass
 
     def relabel(self, endpoint="", query="") -> None:
+        pass
+
+    def mark_exec_done(self) -> None:
         pass
 
     def counter(self, key):
@@ -319,6 +340,13 @@ class _Track:
         with act._mu:
             act._fold_phase_locked(time.monotonic())
             progress = dict(act._c)
+        if act.exec_mono is not None:
+            # exec/drain split: exec_s was stamped at the last harvest
+            # (mark_exec_done); everything after is response drain —
+            # the part a slow client owns, not the engine
+            progress["drain_s"] = round(
+                max(duration - progress.get("exec_s", 0.0), 0.0), 6)
+        cost_error = _fold_cost_errors(progress, status, duration)
         rec = {
             "qid": act.qid, "endpoint": act.endpoint,
             "tenant": act.tenant, "query": act.query,
@@ -329,6 +357,10 @@ class _Track:
             "rows_emitted": progress.get("rows_emitted", 0),
             "progress": progress,
         }
+        if cost_error is not None:
+            # what top_queries?by=cost_error sorts on: the dimension
+            # the plan-time pricing got MOST wrong for this query
+            rec["cost_error"] = cost_error
         with _reg_mu:
             _active.pop(act.qid, None)
             if len(_completed) == _COMPLETED_MAX:
@@ -349,6 +381,47 @@ class _Track:
                     **{k: v for k, v in sorted(progress.items())
                        if isinstance(v, (int, float))})
         return False
+
+
+def _fold_cost_errors(progress: dict, status: str,
+                      duration: float) -> float | None:
+    """Predicted-vs-actual accountability at deregister: fold the
+    plan-time predicted_* counters (obs/explain.price_into_activity)
+    against this run's actuals into per-dimension relative errors —
+    cost_err_* fields on the completion record / query_done event, and
+    the vl_cost_model_rel_error_* histograms so EWMA drift is
+    alarmable.  Returns the worst dimension's error (the
+    top_queries?by=cost_error sort key), or None for unpriced or
+    abnormally-ended queries (a cancelled walk's actuals measure the
+    cancel point, not the model)."""
+    if status != "ok" or "predicted_duration_s" not in progress:
+        return None
+    # the prediction prices the planned EXECUTION (prune/scan/harvest/
+    # emit): drain belongs to the client, and the queued/plan phases
+    # (admission wait, parse, the pricing walk itself) precede the plan
+    # being priced — both come off the actual before comparing
+    actual_d = progress.get("exec_s") or duration
+    actual_d = max(actual_d - progress.get("phase_s_queued", 0.0)
+                   - progress.get("phase_s_plan", 0.0), 1e-6)
+    errs = {}
+    pd = progress["predicted_duration_s"]
+    errs["duration"] = abs(actual_d - pd) / max(actual_d, 1e-6)
+    hist.COST_ERR_DURATION.observe(errs["duration"])
+    pb = progress.get("predicted_bytes")
+    if pb is not None:
+        ab = progress.get("bytes_scanned", 0)
+        errs["bytes"] = abs(ab - pb) / max(ab, 1.0) if (ab or pb) \
+            else 0.0
+        hist.COST_ERR_BYTES.observe(errs["bytes"])
+    pn = progress.get("predicted_dispatches")
+    if pn is not None:
+        an = progress.get("dispatches_submitted", 0)
+        errs["dispatches"] = abs(an - pn) / max(an, 1.0) if (an or pn) \
+            else 0.0
+        hist.COST_ERR_DISPATCHES.observe(errs["dispatches"])
+    for k, v in errs.items():
+        progress[f"cost_err_{k}"] = round(v, 6)
+    return round(max(errs.values()), 6)
 
 
 def track(endpoint: str, query: str, tenant=None) -> _Track:
@@ -447,14 +520,31 @@ def cancel(qid: str) -> bool:
     return True
 
 
+# the top_queries sort dimensions (a request with anything else is a
+# client error — server/app.py maps the ValueError to HTTP 400)
+TOP_QUERIES_BY = ("duration", "bytes", "bytes_scanned", "cost_error")
+
+
 def top_queries(n: int = 10, by: str = "duration") -> list[dict]:
     """Heavy hitters from the completed-query ring buffer, most
-    expensive first (by='duration' or 'bytes')."""
-    key = "bytes_scanned" if by in ("bytes", "bytes_scanned") \
-        else "duration_s"
+    expensive first.  by='duration' | 'bytes' — or 'cost_error' for
+    the queries the plan-time cost model priced WORST (unpriced
+    records sort last); anything else raises ValueError."""
+    if by not in TOP_QUERIES_BY:
+        raise ValueError(
+            f"invalid by={by!r}; allowed: {', '.join(TOP_QUERIES_BY)}")
+    if by == "cost_error":
+        key = "cost_error"
+        default = -1.0
+    elif by in ("bytes", "bytes_scanned"):
+        key = "bytes_scanned"
+        default = 0
+    else:
+        key = "duration_s"
+        default = 0
     with _reg_mu:
         recs = list(_completed)
-    recs.sort(key=lambda r: r.get(key, 0), reverse=True)
+    recs.sort(key=lambda r: r.get(key, default), reverse=True)
     return recs[:max(n, 0)]
 
 

@@ -1,0 +1,546 @@
+"""Query EXPLAIN: priced physical plans and predicted-vs-actual cost
+accountability.
+
+Two consumers share one header-only plan walk:
+
+- **`?explain=1`** (server/vlselect.handle_explain): the physical plan
+  tree WITHOUT executing — partitions → parts (retained vs killed, with
+  the reason: time range, tenant, stream filter, or the aggregate-bloom
+  kill citing the filter leaf whose tokens are provably absent) →
+  planned dispatch units (pack membership, pad bucket, fused program
+  kind), each node annotated with cost-model predictions from the live
+  calibration EWMAs (tpu/batch.CostModel.peek — never the lazy RTT
+  probe, so a plain explain performs ZERO device dispatches and reads
+  nothing past part headers, stream indexes and bloom sidecars).
+  `?explain=analyze` executes the query and grafts actuals onto the
+  same tree — per-unit dispatch_rtt_s/emit_s from the PR 4 span tree,
+  query-level counters from the PR 6 activity record — sourced, never
+  recomputed.  Cluster frontends merge per-node trees under
+  `storage_node` nodes exactly like `?trace=1`
+  (server/cluster.NetSelectStorage.net_explain).
+
+- **continuous pricing** (engine/searcher hooks `predict_query` at plan
+  time for every device-path query): the same walk at part granularity
+  writes `predicted_duration_s` / `predicted_bytes` /
+  `predicted_dispatches` onto the activity record, so `query_done`
+  journal events carry predicted-vs-actual pairs, /metrics grows
+  `vl_cost_model_rel_error_*` histograms (obs/activity computes the
+  errors at deregister), and `top_queries?by=cost_error` surfaces the
+  queries the model prices worst.  `predicted_duration_s` is shaped for
+  sched/admission.py's deadline-feasibility gate to consume in a
+  follow-up (a per-QUERY run estimate instead of the per-endpoint
+  EWMA).  `VL_QUERY_PRICING=0` kills the continuous pass.
+
+The plan walk deliberately REUSES the execution planner's own pieces —
+`candidate_blocks` header selection, `filterbank.aggregate_kill_leaf`,
+`pipeline.iter_pack_groups` pack membership, `CostModel` rates — so the
+displayed plan cannot diverge from what a real run would dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import activity, tracing
+
+# cold-model host rates (CostModel defaults) for runner-less plans
+_HOST_ONLY_PEEK = {
+    "rtt_s": 0.0, "unit_rtt_s": 0.0, "dev_bytes_per_s": 1.0,
+    "emit_unit_s": 0.0, "host_rows_per_s": 12e6,
+    "host_stats_rows_per_s": 30e6, "upload_bytes_per_s": 1e9,
+    "calibrated": False, "force": "host",
+}
+
+# the cost model's whole-query byte-per-row figure for device scan
+# traffic (tpu/batch._gate_host_est's W estimate)
+_SCAN_BYTES_PER_ROW = 128
+
+
+def pricing_enabled() -> bool:
+    """VL_QUERY_PRICING=0 kills the continuous plan-time pricing pass
+    (the explain endpoints stay available either way)."""
+    return os.environ.get("VL_QUERY_PRICING", "1") != "0"
+
+
+# ---------------- the plan walk ----------------
+
+def build_plan(storage, tenants, q, runner=None) -> dict:
+    """The priced physical plan tree without executing (?explain=1)."""
+    return _walk(storage, tenants, q, runner, detail=True)
+
+
+def predict_query(storage, tenants, q, runner=None) -> dict:
+    """The cheap continuous pricing pass: predicted summary only (no
+    per-part nodes, no cold aggregate builds — only aggregates a prior
+    query already folded are probed, the execution walk that follows
+    pays for new ones itself)."""
+    return _walk(storage, tenants, q, runner, detail=False)["predicted"]
+
+
+def _walk(storage, tenants, q, runner, detail: bool) -> dict:
+    from ..logsql.filters import (filter_plan_tree,
+                                  iter_and_path_token_leaves)
+    from ..logsql.parser import MAX_TS, MIN_TS
+    from ..storage.log_rows import TenantID
+    from ..engine.searcher import _collect_stream_filters
+
+    if isinstance(tenants, TenantID):
+        tenants = [tenants]
+    tenants = tuple(tenants)
+    tenant_set = set(tenants)
+    min_ts, max_ts = q.get_time_range()
+
+    batch = runner is not None and hasattr(runner, "run_part")
+    peek = runner.cost.peek() if batch else dict(_HOST_ONLY_PEEK)
+    stats_spec = sort_spec = None
+    plans = []
+    fused = False
+    if batch:
+        from ..tpu.batch import device_plans
+        from ..tpu.fused import fused_filter_enabled
+        plans = device_plans(q.filter)
+        fused = fused_filter_enabled() and runner.fused_enabled
+        if hasattr(runner, "run_part_stats"):
+            from ..tpu.stats_device import device_stats_spec
+            stats_spec = device_stats_spec(q)
+        if stats_spec is None and hasattr(runner, "run_part_topk"):
+            from ..tpu.sort_device import device_sort_spec
+            sort_spec = device_sort_spec(q)
+    shape = "stats" if stats_spec is not None else \
+        "topk" if sort_spec is not None else "rows"
+
+    sfs: list = []
+    _collect_stream_filters(q.filter, sfs)
+    token_leaves = list(iter_and_path_token_leaves(q.filter))
+    if batch:
+        # the SAME depth derivation the window dispatches with, minus
+        # the lazy RTT probe (explain must stay zero-dispatch)
+        from ..tpu.pipeline import inflight_depth
+        depth = inflight_depth(runner, probe=False)
+    else:
+        depth = 1
+
+    tree: dict = {
+        "name": "explain",
+        "mode": "plan",
+        "query": q.to_string(),
+        "shape": shape,
+        "executor": "device" if batch else "host",
+        "fused_filter": bool(fused),
+        "inflight_depth": depth,
+        "time_range": {
+            "min_ts": None if min_ts == MIN_TS else min_ts,
+            "max_ts": None if max_ts == MAX_TS else max_ts,
+        },
+        "partitions": [],
+    }
+    if detail:
+        tree["filter"] = filter_plan_tree(q.filter)
+
+    tot = {"parts_total": 0, "parts_retained": 0, "parts_killed": 0,
+           "blocks_candidate": 0, "rows_scanned": 0, "bytes_scanned": 0,
+           "dispatches": 0, "bytes_staged": 0}
+    cost = {"rtt_s": 0.0, "device_scan_s": 0.0, "upload_s": 0.0,
+            "emit_s": 0.0, "host_s": 0.0}
+
+    active_pts = 0
+    for pt in storage.select_partitions(min_ts, max_ts):
+        pnode = _walk_partition(
+            pt, tenants, tenant_set, min_ts, max_ts, sfs,
+            token_leaves, runner, batch, peek, plans, shape, fused,
+            sort_spec, depth, detail, tot, cost)
+        if pnode.pop("_active", False):
+            active_pts += 1
+        if detail:
+            tree["partitions"].append(pnode)
+    if not detail:
+        tree.pop("partitions")
+
+    # per-day partitions scan concurrently under the worker cap
+    # (engine/searcher._scan_partitions_parallel), so wall time divides
+    # by the effective partition parallelism; within one partition the
+    # window already overlaps round trips (depth folded above)
+    npw = max(1, min(active_pts, q.get_concurrency()))
+    duration = sum(cost.values()) / npw
+    tree["predicted"] = dict(tot)
+    tree["predicted"].update({k: round(v, 6) for k, v in cost.items()})
+    tree["predicted"]["duration_s"] = round(duration, 6)
+    tree["predicted"]["calibrated"] = peek["calibrated"]
+    return tree
+
+
+def _part_header_table(part) -> dict:
+    """Per-part header summary cached on the (immutable) part object —
+    the pricing walk runs on EVERY query, so the per-block header
+    object churn (stream ids, row counts) is paid once per part
+    lifetime instead of once per query.  Same attach idiom as
+    storage/filterbank.filter_bank."""
+    t = getattr(part, "_explain_htab", None)
+    if t is None:
+        nb = part.num_blocks
+        sids = [part.block_stream_id(bi) for bi in range(nb)]
+        rows = [part.block_rows(bi) for bi in range(nb)]
+        tset = {s.tenant for s in sids}
+        t = {
+            "sids": sids, "rows": rows, "rows_total": sum(rows),
+            "uniform_tenant": next(iter(tset)) if len(tset) == 1
+            else None,
+        }
+        part._explain_htab = t
+    return t
+
+
+def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
+                    token_leaves, runner, batch, peek, plans, shape,
+                    fused, sort_spec, depth, detail, tot, cost) -> dict:
+    from ..storage.filterbank import aggregate_kill_leaf
+    from ..tpu import pipeline
+
+    pnode: dict = {"name": "partition",
+                   "day": getattr(pt, "day", None),
+                   "parts": [], "units": []}
+    allowed_sids = None
+    if sfs:
+        allowed_sids = set.intersection(
+            *(f.resolve(pt, tenants) for f in sfs))
+        if not allowed_sids:
+            pnode["pruned_by_stream_filter"] = True
+            return pnode
+
+    retained: list = []      # (part, bis, rows_cand, bytes_est)
+    for part in pt.ddb.snapshot_parts():
+        if not part.num_rows:
+            continue
+        tot["parts_total"] += 1
+        # per-part detail nodes only exist on the explain endpoint; the
+        # continuous pricing pass (detail=False, every query) must not
+        # allocate throwaway dicts per part
+        node: dict = {"part": str(part.uid), "rows": part.num_rows,
+                      "blocks": part.num_blocks} if detail else {}
+        if part.min_ts > max_ts or part.max_ts < min_ts:
+            tot["parts_killed"] += 1
+            if detail:
+                node.update(status="killed", reason="time_range")
+                pnode["parts"].append(node)
+            continue
+        bis: list = []
+        rows_cand = 0
+        n_time = n_tenant = 0
+        if part.min_ts >= min_ts and part.max_ts <= max_ts:
+            # part fully inside the range: every block is a time
+            # candidate — the cached header table answers the tenant/
+            # stream filtering without touching header groups
+            htab = _part_header_table(part)
+            sids, rows = htab["sids"], htab["rows"]
+            n_time = len(sids)
+            if htab["uniform_tenant"] is not None and \
+                    htab["uniform_tenant"] not in tenant_set:
+                pass                       # n_tenant stays 0: killed
+            elif htab["uniform_tenant"] is not None and \
+                    allowed_sids is None:
+                n_tenant = n_time
+                bis = list(range(n_time))
+                rows_cand = htab["rows_total"]
+            else:
+                for bi, sid in enumerate(sids):
+                    if sid.tenant not in tenant_set:
+                        continue
+                    n_tenant += 1
+                    if allowed_sids is not None and \
+                            sid not in allowed_sids:
+                        continue
+                    bis.append(bi)
+                    rows_cand += rows[bi]
+        else:
+            block_sid = part.block_stream_id
+            block_rows = part.block_rows
+            for bi in part.candidate_blocks(min_ts, max_ts):
+                n_time += 1
+                sid = block_sid(bi)
+                if sid.tenant not in tenant_set:
+                    continue
+                n_tenant += 1
+                if allowed_sids is not None and sid not in allowed_sids:
+                    continue
+                bis.append(bi)
+                rows_cand += block_rows(bi)
+        if not bis:
+            tot["parts_killed"] += 1
+            if detail:
+                node.update(status="killed",
+                            reason="time_range" if n_time == 0 else
+                            "tenant" if n_tenant == 0 else
+                            "stream_filter")
+                pnode["parts"].append(node)
+            continue
+        if token_leaves:
+            # detailed plans apply the execution walk's own build gate;
+            # the cheap continuous pass probes CACHED aggregates only
+            # (build=False) — with the result memo those repeats are
+            # dict lookups, and a cold part the execution would build+
+            # kill shows up as prediction error instead of a second
+            # cold fold per query
+            killed = aggregate_kill_leaf(
+                part, token_leaves,
+                build=detail and len(bis) * 4 >= part.num_blocks)
+            if killed is not None:
+                field, tokens, f = killed
+                tot["parts_killed"] += 1
+                if detail:
+                    node.update(status="killed",
+                                reason="aggregate_bloom",
+                                killed_by={"field": field,
+                                           "tokens": list(tokens),
+                                           "filter": f.to_string()})
+                    pnode["parts"].append(node)
+                continue
+        bytes_est = int(rows_cand * activity.part_bytes_per_row(part))
+        tot["parts_retained"] += 1
+        tot["blocks_candidate"] += len(bis)
+        tot["rows_scanned"] += rows_cand
+        tot["bytes_scanned"] += bytes_est
+        if detail:
+            node.update(status="retained", blocks_candidate=len(bis),
+                        rows_candidate=rows_cand, bytes_est=bytes_est)
+            pnode["parts"].append(node)
+        retained.append((part, bis, rows_cand, bytes_est))
+
+    if not retained:
+        return pnode
+    pnode["_active"] = True
+
+    # planned dispatch units: THE pack-membership rules the window
+    # dispatches with (pipeline.iter_pack_groups), priced per unit
+    by_part = {p.uid: (rc, be) for p, _b, rc, be in retained}
+    if batch:
+        pack_max = pipeline.pack_limit()
+        packable = pack_max > 1 and sort_spec is None
+        rows_cap = pipeline.pack_rows_cap(runner, probe=False) \
+            if packable else 0
+        groups = pipeline.iter_pack_groups(
+            ((p, b) for p, b, _rc, _be in retained), packable,
+            pack_max, rows_cap)
+    else:
+        groups = ([(p, b)] for p, b, _rc, _be in retained)
+
+    for seq, group in enumerate(groups):
+        unode = _price_unit(seq, group, by_part, runner, batch,
+                            peek, plans, shape, fused, depth, cost,
+                            tot, detail)
+        if detail:
+            pnode["units"].append(unode)
+    return pnode
+
+
+def _price_unit(seq, group, by_part, runner, batch, peek, plans,
+                shape, fused, depth, cost, tot,
+                detail: bool) -> dict | None:
+    from ..tpu import pipeline
+
+    rows = sum(by_part[p.uid][0] for p, _b in group)
+    nbytes = sum(by_part[p.uid][1] for p, _b in group)
+    blocks = sum(len(b) for _p, b in group)
+    scan_bytes = rows * _SCAN_BYTES_PER_ROW
+    stats_rows = rows if shape == "stats" else 0
+
+    cold = 0
+    n_dispatch = 0
+    if batch and plans:
+        # staging keys are per DISPATCH TARGET: a packed unit stages
+        # under the pack's uid (tpu/pipeline PackedPart), not its
+        # members' — the cold-bytes estimate must probe the same keys
+        uid = ("pack",) + tuple(p.uid for p, _b in group) \
+            if len(group) > 1 else group[0][0].uid
+        for plan in plans:
+            key = (uid, "#fl", plan.field) if fused \
+                else (uid, plan.field)
+            if not runner.cache.contains(key):
+                cold += scan_bytes
+        n_dispatch = 1 if stats_rows or fused else \
+            sum(max(len(p.ops), 1) for p in plans)
+    elif batch and stats_rows:
+        n_dispatch = 1
+
+    host = _prefers_host(peek, rows, scan_bytes, n_dispatch, cold,
+                         stats_rows)
+    kind = "host" if host else (
+        "stats" if shape == "stats" else
+        "topk" if shape == "topk" else
+        "fused_filter" if fused else "leaf_filter")
+
+    # the unit detail node exists only for the explain endpoint; the
+    # continuous pricing pass keeps the accounting without the dicts
+    unode: dict | None = None
+    if detail:
+        unode = {
+            "name": "unit", "seq": seq, "kind": kind,
+            "pack": len(group) > 1,
+            "members": [str(p.uid) for p, _b in group],
+            "pad_bucket": pipeline.pack_bucket(group[0][0]),
+            "blocks": blocks, "rows": rows, "bytes_est": nbytes,
+        }
+    # every planned unit is one pipeline submission (host-gated units
+    # included — dispatches_submitted counts them the same way)
+    tot["dispatches"] += 1
+    if host:
+        host_s = rows / peek["host_rows_per_s"] \
+            + stats_rows / peek["host_stats_rows_per_s"]
+        cost["host_s"] += host_s
+        if unode is not None:
+            unode["predicted"] = {"host_s": round(host_s, 6)}
+        return unode
+
+    tot["bytes_staged"] += cold
+    # window-overlapped REAL unit round trip (CostModel.unit_rtt_ewma):
+    # at steady state the window amortizes each submit-to-harvest
+    # across depth outstanding units
+    rtt_s = peek["unit_rtt_s"] / depth
+    scan_s = scan_bytes / peek["dev_bytes_per_s"]
+    upload_s = 0.25 * cold / peek["upload_bytes_per_s"]
+    emit_s = peek["emit_unit_s"]
+    cost["rtt_s"] += rtt_s
+    cost["device_scan_s"] += scan_s
+    cost["upload_s"] += upload_s
+    cost["emit_s"] += emit_s
+    if unode is not None:
+        unode["predicted"] = {
+            "bytes_staged_cold": cold,
+            "scan_bytes_device": scan_bytes,
+            "rtt_s": round(rtt_s, 6),
+            "device_scan_s": round(scan_s, 6),
+            "emit_s": round(emit_s, 6),
+            "duration_s": round(rtt_s + scan_s + upload_s + emit_s,
+                                6),
+        }
+    return unode
+
+
+def _prefers_host(peek, cand_rows, scan_bytes, n_dispatch, cold_bytes,
+                  stats_rows) -> bool:
+    """CostModel.prefer_host on peeked rates (no RTT probe)."""
+    if peek["force"] == "device":
+        return False
+    if peek["force"] == "host":
+        return True
+    if n_dispatch <= 0:
+        return True
+    est_host = cand_rows / peek["host_rows_per_s"] \
+        + stats_rows / peek["host_stats_rows_per_s"]
+    est_dev = n_dispatch * peek["rtt_s"] \
+        + n_dispatch * scan_bytes / peek["dev_bytes_per_s"] \
+        + 0.25 * cold_bytes / peek["upload_bytes_per_s"]
+    return est_host < est_dev
+
+
+# ---------------- continuous pricing (engine hook) ----------------
+
+def price_into_activity(storage, tenants, q, runner, act) -> None:
+    """Plan-time pricing for ONE query: predicted summary onto the
+    activity record (counters named predicted_* so they ride the
+    query_done journal event next to the actuals; obs/activity folds
+    the pair into vl_cost_model_rel_error_* at deregister).  Advisory:
+    never fails the query."""
+    try:
+        pred = predict_query(storage, tenants, q, runner)
+    # vlint: allow-broad-except(pricing is advisory, the query must run)
+    except Exception:
+        return
+    act.set("predicted_duration_s", pred["duration_s"])
+    act.set("predicted_bytes", pred["bytes_scanned"])
+    act.set("predicted_dispatches", pred["dispatches"])
+    act.set("predicted_rows", pred["rows_scanned"])
+
+
+# ---------------- explain=analyze grafting ----------------
+
+def analyze(storage, tenants, q, tree, runner=None, deadline=None,
+            endpoint="explain", include_trace=False) -> None:
+    """Execute the query and graft actuals onto the plan tree.
+
+    Actuals are SOURCED, not recomputed: query-level counters from the
+    activity record (PR 6), per-unit dispatch_rtt_s / device_sync /
+    emit from the span tree (PR 4) — the same numbers ?trace=1 and
+    /metrics report for this run."""
+    from ..engine.searcher import run_query
+
+    root = tracing.make_root("query", query=q.to_string())
+    rows_emitted = [0]
+
+    def sink(br) -> None:
+        rows_emitted[0] += br.nrows
+
+    with activity.reuse_or_track(endpoint, q.to_string(),
+                                 tenants[0] if tenants else None) as act:
+        root.set("qid", act.qid)
+        with tracing.activate(root):
+            run_query(storage, tenants, q, write_block=sink,
+                      runner=runner, deadline=deadline)
+        act.mark_exec_done()
+        snap = act.snapshot()
+    tdict = root.to_dict()
+    _graft(tree, tdict, snap.get("progress", {}), rows_emitted[0])
+    if include_trace:
+        tree["trace"] = tdict
+
+
+def _graft(tree, tdict, progress, rows_emitted) -> None:
+    tree["mode"] = "analyze"
+    actual = {k: v for k, v in sorted(progress.items())
+              if isinstance(v, (int, float))}
+    actual["rows_emitted"] = rows_emitted
+    tree["actual"] = actual
+    flat = tracing.flatten_tree(tdict)
+    tree["actual_spans"] = {
+        name: flat[name]
+        for name in ("pipeline", "prune", "stage", "submit", "harvest",
+                     "device_sync", "emit", "sched_wait")
+        if name in flat}
+    by_day: dict = {}
+    for psp in tracing.iter_tree(tdict, "partition"):
+        by_day[(psp.get("attrs") or {}).get("day")] = psp
+    for pnode in tree.get("partitions", ()):
+        psp = by_day.get(pnode.get("day"))
+        if psp is None:
+            continue
+        _graft_partition(pnode, psp)
+
+
+def _graft_partition(pnode, psp) -> None:
+    """Per-unit actuals: submit/harvest spans keyed by the pipeline's
+    per-partition unit sequence — the same sequence the plan's unit
+    list was generated in (pipeline.iter_pack_groups both times)."""
+    submits: dict = {}
+    harvests: dict = {}
+    for sp in tracing.iter_tree(psp, "submit"):
+        attrs = sp.get("attrs") or {}
+        if "unit" in attrs:
+            submits[attrs["unit"]] = (sp, attrs)
+    for sp in tracing.iter_tree(psp, "harvest"):
+        attrs = sp.get("attrs") or {}
+        if "unit" in attrs:
+            harvests[attrs["unit"]] = (sp, attrs)
+    for unode in pnode.get("units", ()):
+        seq = unode.get("seq")
+        actual: dict = {}
+        got = submits.get(seq)
+        if got is not None:
+            _sp, attrs = got
+            for k in ("rows", "blocks", "slot_wait_s"):
+                if k in attrs:
+                    actual[k] = attrs[k]
+        got = harvests.get(seq)
+        if got is not None:
+            sp, attrs = got
+            if "dispatch_rtt_s" in attrs:
+                actual["dispatch_rtt_s"] = attrs["dispatch_rtt_s"]
+            if attrs.get("host_unit"):
+                actual["host_unit"] = True
+            for child in sp.get("children", ()):
+                if child.get("name") == "device_sync":
+                    actual["device_sync_s"] = round(
+                        child.get("duration_ms", 0.0) / 1e3, 6)
+                elif child.get("name") == "emit":
+                    actual["emit_s"] = round(
+                        child.get("duration_ms", 0.0) / 1e3, 6)
+        if actual:
+            unode["actual"] = actual

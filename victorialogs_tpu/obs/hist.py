@@ -157,6 +157,33 @@ SLOT_WAIT = histogram(
     (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
      0.05, 0.1, 0.25, 0.5, 1.0))
 
+# cost-model accountability (obs/explain.py): per-query relative error
+# |predicted - actual| / actual of the plan-time pricing pass, one
+# histogram per priced dimension.  EWMA drift (backend change, tunnel
+# degradation, workload shift) shows up here as a rightward creep —
+# alarmable long before the VL_INFLIGHT=auto window or a future
+# priced-admission gate start making bad calls on stale rates.
+_COST_ERR_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0,
+                     4.0, 8.0, 16.0)
+
+COST_ERR_DURATION = histogram(
+    "vl_cost_model_rel_error_duration",
+    "relative error of the plan-time predicted execution duration vs "
+    "the measured exec time (|pred-actual|/actual, per priced query)",
+    _COST_ERR_BUCKETS)
+
+COST_ERR_BYTES = histogram(
+    "vl_cost_model_rel_error_bytes",
+    "relative error of the plan-time predicted bytes scanned vs the "
+    "query's actual bytes_scanned counter",
+    _COST_ERR_BUCKETS)
+
+COST_ERR_DISPATCHES = histogram(
+    "vl_cost_model_rel_error_dispatches",
+    "relative error of the planned dispatch-unit count vs the units "
+    "actually submitted through the pipeline window",
+    _COST_ERR_BUCKETS)
+
 MERGE_SECONDS = histogram(
     "vl_storage_merge_duration_seconds",
     "wall time of one background part merge (small/big tier "

@@ -13,6 +13,17 @@ delivered event into one log row:
   | stats by (endpoint) quantile(0.99, duration_ms)``);
 - ``_msg`` as a compact ``event k=v ...`` line for full-text search.
 
+``query_done`` events carry the cost-accountability pairs since the
+EXPLAIN PR: ``predicted_duration_s`` / ``predicted_bytes`` /
+``predicted_dispatches`` (plan-time pricing, obs/explain.py) next to
+the measured counters, the per-dimension relative errors
+(``cost_err_duration`` / ``cost_err_bytes`` / ``cost_err_dispatches``,
+folded at deregistration in obs/activity.py), and the sink-side
+exec/drain split (``exec_s`` stamped at the last harvest, ``drain_s``
+what the client spent pulling the response) — so cost-model drift and
+slow-consumer pathologies are LogsQL-queryable history, not just live
+/metrics histograms.
+
 Safety properties (the point of the subsystem — test-pinned in
 tests/test_journal.py):
 

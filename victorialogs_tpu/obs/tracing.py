@@ -175,6 +175,41 @@ class Span:
         return agg
 
 
+def flatten_tree(tree: dict) -> dict:
+    """Span.flatten over an EXPORTED to_dict() tree: per-span-name
+    aggregates {name: {count, total_ms}}.  The explain=analyze graft
+    (obs/explain.py) and cluster-merged traces work on dict trees —
+    storage-node frames arrive serialized, never as live Spans."""
+    agg: dict[str, dict] = {}
+
+    def walk(node: dict) -> None:
+        name = node.get("name", "?")
+        a = agg.setdefault(name, {"count": 0, "total_ms": 0.0})
+        a["count"] += 1
+        a["total_ms"] += node.get("duration_ms", 0.0)
+        for c in node.get("children", ()):
+            walk(c)
+
+    if tree:
+        walk(tree)
+    for a in agg.values():
+        a["total_ms"] = round(a["total_ms"], 3)
+    return agg
+
+
+def iter_tree(tree: dict, name: str):
+    """Yield every node of an exported span tree with the given name
+    (depth-first) — the explain graft's span lookup."""
+    if not tree:
+        return
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if node.get("name") == name:
+            yield node
+        stack.extend(node.get("children", ()))
+
+
 class _SpanCtx:
     """Context manager that creates the child at __enter__ and closes
     it (and restores the ambient span) on every exit path."""

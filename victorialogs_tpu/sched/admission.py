@@ -570,6 +570,19 @@ class _Admission:
         act = self._act
         if act is not None and getattr(act, "enabled", False):
             nbytes = act.counter("bytes_scanned")
+            exec_mono = getattr(act, "exec_mono", None)
+            if exec_mono is not None:
+                # sink-side exec/drain split (obs/activity
+                # mark_exec_done): the EWMA feeds on EXECUTION time
+                # only, so a stalled streaming client's drain cannot
+                # poison deadline feasibility for everyone queued
+                # behind it.  (_note_done's queue-timeout clamp stays
+                # as defense for records without the stamp.)  The
+                # record also carries predicted_duration_s — the
+                # per-QUERY priced estimate (obs/explain) this
+                # per-endpoint EWMA could be upgraded to consume.
+                duration = min(duration,
+                               max(exec_mono - self._t_admit, 0.0))
         with c._cond:
             self._release_locked()
             c._note_done(self._endpoint, duration, nbytes)

@@ -29,12 +29,13 @@ from .. import sched
 from .insertutil import (CommonParams, LocalLogRowsStorage,
                          LogMessageProcessor, get_tenant_id)
 from . import vlinsert
-from .vlselect import (HTTPError, handle_facets, handle_field_names,
-                       handle_field_values, handle_hits, handle_query,
-                       handle_stats_query, handle_stats_query_range,
+from .vlselect import (HTTPError, handle_explain, handle_facets,
+                       handle_field_names, handle_field_values,
+                       handle_hits, handle_query, handle_stats_query,
+                       handle_stats_query_range,
                        handle_stream_field_names, handle_stream_field_values,
                        handle_stream_ids, handle_streams, handle_tail,
-                       query_timeout_s)
+                       query_timeout_s, want_explain)
 
 
 def escape_label_value(v: str) -> str:
@@ -636,10 +637,16 @@ class VLServer(BaseHTTPApp):
                 n = int(args.get("n") or args.get("limit") or "10")
             except ValueError:
                 raise HTTPError(400, "invalid n arg")
-            self.respond_json(h, {
-                "status": "ok",
-                "top_queries": activity.top_queries(
-                    n, by=args.get("by", "duration"))})
+            # validated + clamped: an unknown by= is a client error
+            # (400 with the allowed set), never a silent fallthrough,
+            # and n is bounded by the completed-ring capacity region
+            n = max(1, min(n, 1000))
+            try:
+                top = activity.top_queries(n, by=args.get("by",
+                                                          "duration"))
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            self.respond_json(h, {"status": "ok", "top_queries": top})
             return
 
         # ---- queries (admission-controlled: per-tenant limits, a
@@ -767,7 +774,12 @@ class VLServer(BaseHTTPApp):
         m = self.metrics
         m.inc(metric_name("vl_http_requests_total", path=path))
         t0 = time.monotonic()
-        if path == "/select/logsql/query":
+        if path in _QUERY_DURATION_PATHS and want_explain(args):
+            # ?explain=1 / ?explain=analyze: the priced physical plan
+            # (JSON document, not a row stream) — vlselect.handle_explain
+            self.respond_json(h, handle_explain(s, path, args, headers,
+                                                runner=self.runner))
+        elif path == "/select/logsql/query":
             gen = handle_query(s, args, headers, runner=self.runner)
             self.respond_stream(h, gen)
         elif path == "/select/logsql/hits":

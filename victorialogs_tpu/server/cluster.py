@@ -436,6 +436,28 @@ def handle_internal_select(storage, args, runner=None):
         # pushed-down limit: each node returns at most N rows
         q.pipes.append(PipeLimit(limit))
 
+    # EXPLAIN sub-request (frontend handle_explain fan-out): build —
+    # and for analyze, execute — EAGERLY, then stream the one-frame
+    # result; the tree covers this node's REMOTE half of the pipe
+    # split, so the frontend's merged plan shows exactly what each
+    # node would dispatch.  Frames stay legacy JSON (trees are small).
+    explain_mode = args.get("explain", "")
+    if explain_mode:
+        if explain_mode not in ("plan", "analyze"):
+            raise ValueError(f"invalid explain mode {explain_mode!r}")
+        from ..obs import explain as _explain
+        tree = _explain.build_plan(storage, tenants, q, runner=runner)
+        if explain_mode == "analyze":
+            _explain.analyze(storage, tenants, q, tree, runner=runner,
+                             deadline=query_deadline(args),
+                             endpoint="/internal/select/query",
+                             include_trace=args.get("trace") == "1")
+
+        def gen_explain():
+            yield write_frame({"explain": tree})
+            yield END_FRAME
+        return gen_explain()
+
     # stream frames as blocks arrive; the shared worker protocol
     # (bounded queue + abandon-stream cancellation) lives in streamwork
     from .streamwork import stream_blocks
@@ -604,6 +626,33 @@ class NetInsertStorage:
 
 # ---------------- client side: scatter-gather select ----------------
 
+def _node_http_error(url: str, e: urllib.error.HTTPError) -> Exception:
+    """Map a storage node's HTTP error for the fan-out paths: a 429
+    (the node's admission control shed us) becomes AdmissionShed so the
+    frontend answers 429 + Retry-After with the node's reason and
+    concurrency hints — overload propagates as overload, not as an
+    internal error; anything else is a transport failure."""
+    if e.code != 429:
+        return IOError(f"{url}: HTTP {e.code}")
+    try:
+        info = json.loads(e.read().decode("utf-8", "replace"))
+    except (ValueError, OSError):
+        info = {}
+    try:
+        retry = float(e.headers.get("Retry-After") or 1)
+    except ValueError:
+        retry = 1.0
+    return sched.AdmissionShed(
+        info.get("reason", "queue_full"),
+        f"storage node {url} shed the sub-query: "
+        f"{info.get('error', 'overloaded')}",
+        retry_after=retry,
+        # forward the node's concurrency hints so the frontend's 429
+        # carries X-VL-Concurrency-* end to end
+        limit=info.get("limit"),
+        current=info.get("current"))
+
+
 class NetSelectStorage:
     """Query layer over N storage nodes: remote/local pipe split, parallel
     fan-out, first-error cancellation (netselect.go:324-369)."""
@@ -617,6 +666,109 @@ class NetSelectStorage:
         # predate the format, or run VL_WIRE_TYPED=0, ignore the arg
         # and answer with legacy JSON frames — handled per frame)
         self.wire_typed = wire_typed_enabled()
+
+    def net_explain(self, tenants, q, mode: str,
+                    timestamp: int | None = None,
+                    deadline: float | None = None,
+                    include_trace: bool = False) -> dict:
+        """Cluster EXPLAIN: scatter the (pipe-split) query to every
+        storage node with explain=<mode>, merge the per-node plan trees
+        under storage_node nodes — the same merge shape ?trace=1 uses —
+        and fold the node predictions into one cluster summary
+        (counts/seconds sum; duration is the max, nodes run in
+        parallel)."""
+        from concurrent.futures import ThreadPoolExecutor
+        from urllib.parse import urlencode
+        if isinstance(q, str):
+            q = parse_query(q, timestamp)
+        ts = q.timestamp if getattr(q, "timestamp", None) else \
+            (timestamp or time.time_ns())
+        if mode == "analyze":
+            # the run needs in(<subquery>) values; a plain explain=1
+            # must not execute anything, so subqueries stay symbolic
+            from ..engine.searcher import init_subqueries
+            init_subqueries(self, tenants, q, detach=True)
+        split_mode, split_at, local_pipes = split_query(q)
+        # limit pushdown parity with net_run_query: the plan (and the
+        # analyze execution) must describe the sub-query each node would
+        # actually run, early-exit included
+        push_limit = 0
+        if split_mode == "rows" and local_pipes and \
+                isinstance(local_pipes[0], PipeLimit):
+            push_limit = local_pipes[0].n
+        tenants = list(tenants) or [TenantID(0, 0)]
+        tenant_arg = ",".join(f"{t.account_id}:{t.project_id}"
+                              for t in tenants)
+        remaining_s = None
+        if deadline is not None:
+            remaining_s = max(deadline - time.monotonic(), 0.001)
+
+        def fetch(url: str) -> dict:
+            form = {
+                "version": PROTOCOL_VERSION,
+                "query": q.to_string(),
+                "ts": str(ts),
+                "mode": split_mode,
+                "split_at": str(split_at),
+                "limit": str(push_limit),
+                "tenant": tenant_arg,
+                "explain": mode,
+            }
+            if remaining_s is not None:
+                form["timeout"] = f"{remaining_s:.3f}s"
+            if include_trace:
+                # trace parity with the single-node path: each node's
+                # analyze tree then carries its own span tree
+                form["trace"] = "1"
+            req = urllib.request.Request(
+                f"{url}/internal/select/query",
+                data=urlencode(form).encode("utf-8"), method="POST")
+            req.add_header("Content-Type",
+                           "application/x-www-form-urlencoded")
+            http_timeout = self.timeout if remaining_s is None else \
+                min(self.timeout, remaining_s + 5.0)
+            tree = None
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=http_timeout) as resp:
+                    if resp.status != 200:
+                        raise IOError(f"{url}: HTTP {resp.status}")
+                    for payload, _n in read_frame_payloads(resp):
+                        frame = json.loads(payload)
+                        if "explain" in frame:
+                            tree = frame["explain"]
+            except urllib.error.HTTPError as e:
+                # a node's admission control shedding the explain
+                # sub-request must surface as 429 + Retry-After at the
+                # frontend, exactly like net_run_query
+                raise _node_http_error(url, e) from None
+            if tree is None:
+                raise IOError(f"{url}: no explain frame in reply")
+            return {"name": "storage_node", "url": url,
+                    "explain": tree}
+
+        with ThreadPoolExecutor(max_workers=len(self.urls)) as ex:
+            nodes = list(ex.map(fetch, self.urls))
+        merged: dict = {
+            "name": "explain", "mode": mode, "cluster": True,
+            "query": q.to_string(), "storage_nodes": nodes,
+        }
+        pred: dict = {}
+        calibrated = True
+        for node in nodes:
+            np_ = node["explain"].get("predicted") or {}
+            calibrated = calibrated and bool(np_.get("calibrated"))
+            for k, v in np_.items():
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool):
+                    continue
+                if k == "duration_s":
+                    pred[k] = max(pred.get(k, 0.0), v)
+                else:
+                    pred[k] = round(pred.get(k, 0) + v, 6)
+        pred["calibrated"] = calibrated
+        merged["predicted"] = pred
+        return merged
 
     def net_run_query(self, tenants, q, write_block=None,
                       timestamp: int | None = None,
@@ -760,32 +912,7 @@ class NetSelectStorage:
                                     nsp.set("trace_truncated", True)
                                     return
             except urllib.error.HTTPError as e:
-                if e.code == 429:
-                    # the node's admission control shed this sub-query:
-                    # surface it as AdmissionShed so the frontend
-                    # responds 429 + Retry-After (overload propagates
-                    # as overload, not as an internal error)
-                    try:
-                        info = json.loads(
-                            e.read().decode("utf-8", "replace"))
-                    except (ValueError, OSError):
-                        info = {}
-                    try:
-                        retry = float(e.headers.get("Retry-After") or 1)
-                    except ValueError:
-                        retry = 1.0
-                    errors.append(sched.AdmissionShed(
-                        info.get("reason", "queue_full"),
-                        f"storage node {url} shed the sub-query: "
-                        f"{info.get('error', 'overloaded')}",
-                        retry_after=retry,
-                        # forward the node's concurrency hints so the
-                        # frontend's 429 carries X-VL-Concurrency-*
-                        # end to end
-                        limit=info.get("limit"),
-                        current=info.get("current")))
-                else:
-                    errors.append(IOError(f"{url}: HTTP {e.code}"))
+                errors.append(_node_http_error(url, e))
                 stop.set()
             # collected errors re-raise on the caller thread after join
             # vlint: allow-broad-except(fan-out error channel)

@@ -170,6 +170,9 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint,
             with tracing.activate(root):
                 result = collect(storage, tenants, q, runner=runner,
                                  deadline=query_deadline(args))
+            # exec/drain split: the engine walk is done; what remains
+            # (JSON shaping, response write) is drain
+            act.mark_exec_done()
         finally:
             # in finally: the slowest queries are exactly the ones that
             # die on the deadline — they must still produce their
@@ -181,6 +184,65 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint,
     return result, tree
 
 
+# ---------------- ?explain=1 / ?explain=analyze ----------------
+
+def want_explain(args) -> str:
+    """'' (no explain), 'plan' (?explain=1) or 'analyze'
+    (?explain=analyze); anything else is a client error."""
+    v = args.get("explain", "")
+    if not v:
+        return ""
+    if v in ("1", "true", "yes", "plan"):
+        return "plan"
+    if v == "analyze":
+        return "analyze"
+    raise HTTPError(400, f"invalid explain arg {v!r} "
+                         f"(use explain=1 or explain=analyze)")
+
+
+def handle_explain(storage, path, args, headers, runner=None) -> dict:
+    """?explain on the query-execution endpoints: the priced physical
+    plan tree (obs/explain.py) for EXACTLY the query the endpoint would
+    run — including its injected pipes (hits' stats pipe, facets'
+    pipe, stats_query_range's _time bucketing).
+
+    explain=1 never executes: zero device dispatches, nothing read past
+    part headers / stream indexes / bloom sidecars.  explain=analyze
+    executes once and grafts the run's actuals (span-tree per-unit
+    timings, activity counters) onto the same tree.  On a cluster
+    frontend the per-node trees merge under storage_node nodes exactly
+    like ?trace=1."""
+    mode = want_explain(args)
+    q, tenants = parse_common_args(storage, args, headers)
+    if path.endswith("/query"):
+        _query_pipes(q, args)
+    elif path.endswith("/hits"):
+        _hits_pipes(q, args)
+    elif path.endswith("/facets"):
+        _facets_pipes(q, args)
+    elif path.endswith("/stats_query"):
+        _require_stats_query(q)
+    elif path.endswith("/stats_query_range"):
+        _stats_range_pipes(q, args)
+    from ..obs import explain as _explain
+    if hasattr(storage, "net_explain"):
+        # cluster frontend: scatter the explain, merge per-node trees
+        # under storage_node nodes (server/cluster.py)
+        tree = storage.net_explain(tenants, q, mode,
+                                   deadline=query_deadline(args),
+                                   include_trace=mode == "analyze"
+                                   and want_trace(args))
+    else:
+        tree = _explain.build_plan(storage, tenants, q, runner=runner)
+        if mode == "analyze":
+            _explain.analyze(storage, tenants, q, tree, runner=runner,
+                             deadline=query_deadline(args),
+                             endpoint=path,
+                             include_trace=want_trace(args))
+    tree["endpoint"] = path
+    return {"status": "ok", "explain": tree}
+
+
 # ---------------- /select/logsql/query ----------------
 
 def handle_query(storage, args, headers, runner=None):
@@ -190,12 +252,7 @@ def handle_query(storage, args, headers, runner=None):
     response; ONE extra final line carries the span tree as
     {"_trace": {...}}."""
     q, tenants = parse_common_args(storage, args, headers)
-    limit = _int_arg(args, "limit", 1000)
-    offset = _int_arg(args, "offset", 0)
-    if offset:
-        q.pipes.append(PipeOffset(offset))
-    if limit > 0:
-        q.pipes.append(PipeLimit(limit))
+    _query_pipes(q, args)
 
     # stream results as blocks arrive; the shared worker protocol
     # (bounded queue + abandon-stream cancellation) lives in streamwork
@@ -229,6 +286,13 @@ def handle_query(storage, args, headers, runner=None):
                 with tracing.activate(root), activity.use_activity(act):
                     run_query(storage, tenants, q, write_block=sink,
                               runner=runner, deadline=deadline)
+                    # exec/drain split: the last unit is harvested and
+                    # every block is in the response queue; what's left
+                    # is the CLIENT draining the stream.  (The bounded
+                    # queue means a stalled client can still back-
+                    # pressure sink() writes — exec_s includes that,
+                    # bounded at 64 chunks, drain_s gets the rest.)
+                    activity.current_activity().mark_exec_done()
 
             t0 = time.monotonic()
             try:
@@ -256,10 +320,26 @@ def handle_query(storage, args, headers, runner=None):
     return gen()
 
 
-# ---------------- /select/logsql/hits ----------------
+# ---------------- endpoint pipe preparation ----------------
+#
+# Each query-execution endpoint rewrites the parsed query's pipe chain
+# before running it.  The rewrites live in these helpers so the EXPLAIN
+# path (handle_explain) plans EXACTLY the query the endpoint would
+# execute — injected stats pipes and all — instead of the raw input.
 
-def handle_hits(storage, args, headers, runner=None) -> dict:
-    q, tenants = parse_common_args(storage, args, headers)
+def _query_pipes(q: Query, args) -> None:
+    """/select/logsql/query: offset + limit pushdown."""
+    limit = _int_arg(args, "limit", 1000)
+    offset = _int_arg(args, "offset", 0)
+    if offset:
+        q.pipes.append(PipeOffset(offset))
+    if limit > 0:
+        q.pipes.append(PipeLimit(limit))
+
+
+def _hits_pipes(q: Query, args) -> list:
+    """/select/logsql/hits: the injected `stats by (_time:step [, f..])
+    count() hits` pipe; returns the extra group fields."""
     step = args.get("step", "1d")
     if parse_duration(step) is None:
         raise HTTPError(400, f"invalid step {step!r}")
@@ -273,6 +353,33 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
     fn = sf.StatsCount([])
     fn.out_name = "hits"
     q.pipes.append(PipeStats(by, [fn]))
+    return fields
+
+
+def _facets_pipes(q: Query, args) -> None:
+    from ..logsql.pipes_transform import PipeFacets
+    q.pipes.append(PipeFacets(
+        limit=_int_arg(args, "limit", 10),
+        max_values_per_field=_int_arg(args, "max_values_per_field", 1000),
+        max_value_len=_int_arg(args, "max_value_len", 1000),
+        keep_const_fields=bool(args.get("keep_const_fields", ""))))
+
+
+def _stats_range_pipes(q: Query, args) -> PipeStats:
+    sp = _require_stats_query(q)
+    step = args.get("step", "1d")
+    if parse_duration(step) is None:
+        raise HTTPError(400, f"invalid step {step!r}")
+    if not any(b.name == "_time" for b in sp.by):
+        sp.by.insert(0, ByField("_time", bucket=step))
+    return sp
+
+
+# ---------------- /select/logsql/hits ----------------
+
+def handle_hits(storage, args, headers, runner=None) -> dict:
+    q, tenants = parse_common_args(storage, args, headers)
+    fields = _hits_pipes(q, args)
     # columnar collect: the stats output arrives as bulk columns (one
     # contract for local and cluster paths) — group rows are zipped
     # from the lists, never materialized as dicts
@@ -302,12 +409,7 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
 
 def handle_facets(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
-    from ..logsql.pipes_transform import PipeFacets
-    q.pipes.append(PipeFacets(
-        limit=_int_arg(args, "limit", 10),
-        max_values_per_field=_int_arg(args, "max_values_per_field", 1000),
-        max_value_len=_int_arg(args, "max_value_len", 1000),
-        keep_const_fields=bool(args.get("keep_const_fields", ""))))
+    _facets_pipes(q, args)
     (cols, n), trace_tree = _run_collect_traced(
         storage, tenants, q, args, runner, "/select/logsql/facets",
         collect=run_query_collect_columns)
@@ -433,12 +535,7 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
 
 def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
-    sp = _require_stats_query(q)
-    step = args.get("step", "1d")
-    if parse_duration(step) is None:
-        raise HTTPError(400, f"invalid step {step!r}")
-    if not any(b.name == "_time" for b in sp.by):
-        sp.by.insert(0, ByField("_time", bucket=step))
+    sp = _stats_range_pipes(q, args)
     (cols, nrows), trace_tree = _run_collect_traced(
         storage, tenants, q, args, runner,
         "/select/logsql/stats_query_range",

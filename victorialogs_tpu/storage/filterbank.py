@@ -174,6 +174,15 @@ class AggregateFilter:
     sizes: tuple                   # distinct filter word counts (|| mat)
     all_have: bool                 # every block has a non-empty bloom
 
+    # small result memo: parts are immutable and a query probes the
+    # same (leaf, part) pairs from the serial walk, the pipeline
+    # planner AND the explain pricing pass; a DICT (not a single slot)
+    # because several AND-path leaves alternate probes on one field's
+    # aggregate and would thrash a one-entry memo.  Bounded: cleared
+    # wholesale past _MEMO_MAX (GIL-atomic dict ops, no lock needed)
+    _memo: dict | None = None
+    _MEMO_MAX = 32
+
     def may_contain_all(self, hashes: np.ndarray) -> bool:
         """False only when some token is PROVABLY absent from every
         block (=> a filter requiring all tokens matches nothing in the
@@ -181,13 +190,24 @@ class AggregateFilter:
         where any block lacks one is never killable."""
         if not self.all_have or len(hashes) == 0:
             return True
+        key = hashes.tobytes()
+        memo = self._memo
+        if memo is None:
+            memo = self._memo = {}
+        got = memo.get(key)
+        if got is not None:
+            return got
         pos = bloom_probe_positions_multi(hashes, self.sizes)  # [S,T,6]
         wi = (pos >> np.uint64(6)) % self.widths[:, None, None]
         bit = (self.mat[np.arange(len(self.sizes))[:, None, None],
                         wi.astype(np.int64)]
                >> (pos & np.uint64(63))) & np.uint64(1)
         # a token is possible if SOME size's fold holds all its probes
-        return bool(bit.astype(bool).all(axis=2).any(axis=0).all())
+        out = bool(bit.astype(bool).all(axis=2).any(axis=0).all())
+        if len(memo) >= self._MEMO_MAX:
+            memo.clear()
+        memo[key] = out
+        return out
 
 
 class FilterBank:
@@ -428,6 +448,26 @@ def _observe_keep(keep: np.ndarray, observe: bool = True) -> np.ndarray:
     return keep
 
 
+def aggregate_kill_leaf(part, leaves, build: bool = True):
+    """The (field, tokens, owner_filter) leaf whose required tokens are
+    provably absent from every block of the part, or None — the
+    EXPLAIN plan's kill citation (obs/explain.py) and the predicate
+    behind part_aggregate_prunes.  No trace/registry side effects: pure
+    probe, so the pricing pass can call it without polluting the
+    counters the execution walk will land."""
+    fb = filter_bank(part) if build else \
+        getattr(part, "_filter_bank", None)
+    if fb is None:
+        return None
+    for field, tokens, f in leaves:
+        agg = fb.aggregate(part, field) if build else \
+            fb.cached_aggregate(field)
+        if agg is not None and \
+                not agg.may_contain_all(cached_token_hashes(f, tokens)):
+            return field, tokens, f
+    return None
+
+
 def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
     """O(1) part-level kill: True when some AND-path filter leaf's
     required tokens are provably absent from every block of the part.
@@ -439,19 +479,13 @@ def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
     reads every block's bloom, which a time-narrow query touching few
     candidate blocks should not pay — the caller gates on candidate
     coverage)."""
-    fb = filter_bank(part) if build else \
-        getattr(part, "_filter_bank", None)
-    if fb is None:
-        return False
-    for field, tokens, f in leaves:
-        agg = fb.aggregate(part, field) if build else \
-            fb.cached_aggregate(field)
-        if agg is not None and \
-                not agg.may_contain_all(cached_token_hashes(f, tokens)):
-            sp = tracing.current_span()
-            if sp.enabled:
-                sp.add("parts_pruned_aggregate")
-                sp.set("last_aggregate_prune_field", field)
-            activity.current_activity().add("parts_pruned")
-            return True
+    killed = aggregate_kill_leaf(part, leaves, build=build)
+    if killed is not None:
+        field = killed[0]
+        sp = tracing.current_span()
+        if sp.enabled:
+            sp.add("parts_pruned_aggregate")
+            sp.set("last_aggregate_prune_field", field)
+        activity.current_activity().add("parts_pruned")
+        return True
     return False

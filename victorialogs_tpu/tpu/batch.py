@@ -764,6 +764,14 @@ class CostModel:
         # and ceil(rtt/ewma) contracts to the clamp floor exactly on the
         # high-RTT backends that need a deep window.
         self.emit_ewma: float | None = None
+        # observed submit-to-harvest round trip of REAL dispatch units
+        # (tpu/pipeline.harvest_one) — the EXPLAIN pricing pass's
+        # per-unit term.  The probe rtt above is a minimal round trip
+        # for the host-vs-device decision; a real fused unit also pays
+        # program-arg marshalling and result download, which must not
+        # inflate the routing gate but should price the plan.
+        self.unit_rtt_ewma: float | None = None
+        self._unit_rtt_seen = False    # first unit pays jit compile
         self.force = os.environ.get("VL_COST_FORCE", "")
 
     # vlint: allow-jax-host-sync(the blocking round trip IS the probe)
@@ -823,6 +831,30 @@ class CostModel:
             self.emit_ewma = elapsed if cur is None else \
                 (1 - self._EWMA) * cur + self._EWMA * elapsed
 
+    def observe_unit_rtt(self, elapsed: float) -> None:
+        """One real dispatch unit's submit-to-harvest round trip
+        (records under VL_COST_FORCE too: it prices plans, it never
+        routes device-vs-host).
+
+        Robust to jit compilation: the very first unit pays a one-time
+        program compile that can be 100x the steady round trip —
+        seeding the EWMA with it would poison every prediction for tens
+        of queries — so the first observation is discarded, and later
+        spikes (fresh pad buckets compiling mid-stream) clamp at 10x
+        the current estimate instead of jerking it."""
+        if elapsed <= 0:
+            return
+        with self._mu:
+            if not self._unit_rtt_seen:
+                self._unit_rtt_seen = True
+                return
+            cur = self.unit_rtt_ewma
+            if cur is None:
+                self.unit_rtt_ewma = elapsed
+                return
+            self.unit_rtt_ewma = (1 - self._EWMA) * cur \
+                + self._EWMA * min(elapsed, 10 * cur)
+
     def observe_host_scan(self, rows: int, elapsed: float) -> None:
         if elapsed <= 0 or rows < 10000:
             return                 # tiny samples are all overhead
@@ -847,6 +879,42 @@ class CostModel:
             + n_dispatch * scan_bytes / self._dev_rate() \
             + self._COLD_AMORT * cold_bytes / self.upload_bytes_per_s
         return est_host < est_dev
+
+    # -- probe-free reads (EXPLAIN pricing; /metrics-safe) --
+
+    # cold-calibration RTT stand-in: a local-backend-scale figure, so an
+    # uncalibrated model underprices tunnel backends instead of
+    # overpricing local ones (the first real query measures the truth)
+    _RTT_COLD_DEFAULT = 1e-3
+
+    def peek(self) -> dict:
+        """Calibration snapshot WITHOUT the lazy RTT probe: the raw
+        EWMAs/fields plus cold-start defaults, for the EXPLAIN pricing
+        pass (obs/explain.py) — `explain=1` must never dispatch, so it
+        can't ride measured_rtt().  ``calibrated`` is False until a real
+        query has measured the round trip."""
+        with self._mu:
+            rtt, dev, emit = self.rtt, self.dev_bytes_per_s, \
+                self.emit_ewma
+            unit_rtt = self.unit_rtt_ewma
+            host, host_stats = self.host_rows_per_s, \
+                self.host_stats_rows_per_s
+        rtt_s = rtt if rtt is not None else self._RTT_COLD_DEFAULT
+        return {
+            "rtt_s": rtt_s,
+            # the pricing term: observed whole-unit round trips when a
+            # query has fed the EWMA, the probe rtt until then
+            "unit_rtt_s": unit_rtt if unit_rtt is not None else rtt_s,
+            "dev_bytes_per_s": dev if dev is not None
+            else self._dev_rate(),
+            "emit_unit_s": emit or 0.0,
+            "host_rows_per_s": host,
+            "host_stats_rows_per_s": host_stats,
+            "upload_bytes_per_s": self.upload_bytes_per_s,
+            "calibrated": rtt is not None or unit_rtt is not None,
+            "force": self.force,
+        }
+
 
 
 # ---------------- the batch runner ----------------
@@ -954,6 +1022,7 @@ class BatchRunner:
         # baseline signal): read raw fields, NEVER measured_rtt() — a
         # /metrics scrape must not trigger the lazy RTT probe dispatch
         out["cost_rtt_seconds"] = self.cost.rtt or 0.0
+        out["cost_unit_rtt_seconds"] = self.cost.unit_rtt_ewma or 0.0
         out["cost_dev_bytes_per_s"] = self.cost.dev_bytes_per_s or 0.0
         out["cost_emit_ewma_seconds"] = self.cost.emit_ewma or 0.0
         if self.cost.rtt is not None:

@@ -73,7 +73,7 @@ def inflight_auto() -> bool:
     return os.environ.get("VL_INFLIGHT", "").strip().lower() == "auto"
 
 
-def inflight_depth(runner=None) -> int:
+def inflight_depth(runner=None, probe: bool = True) -> int:
     """VL_INFLIGHT: max units with outstanding dispatches (>=1).
 
     ``VL_INFLIGHT=auto`` derives the depth from the cost model's
@@ -82,17 +82,21 @@ def inflight_depth(runner=None) -> int:
     behind wait-free host emit work, so the device never idles once
     ``depth * emit_per_unit >= rtt`` — depth = ceil(rtt / emit_ewma),
     clamped to [2, 16].  An explicit integer always wins; cold
-    calibration falls back to the default."""
+    calibration falls back to the default.
+
+    probe=False never issues the lazy RTT calibration dispatch — the
+    EXPLAIN pricing pass (obs/explain.py) prices with the SAME depth
+    derivation but must stay zero-dispatch (like pack_rows_cap)."""
     v = os.environ.get("VL_INFLIGHT", "4")
     if v.strip().lower() == "auto":
-        return _auto_depth(runner)
+        return _auto_depth(runner, probe)
     try:
         return max(1, int(v))
     except ValueError:
         return _AUTO_DEPTH_DEFAULT
 
 
-def _auto_depth(runner) -> int:
+def _auto_depth(runner, probe: bool = True) -> int:
     if runner is None:
         return _AUTO_DEPTH_DEFAULT
     host = runner.cost.emit_ewma
@@ -101,8 +105,9 @@ def _auto_depth(runner) -> int:
         # of this runner) — the default window, like VL_INFLIGHT unset
         return _AUTO_DEPTH_DEFAULT
     # we're on the query path already, so the lazy RTT probe is fair
-    # game here (unlike /metrics scrapes — see BatchRunner.stats)
-    rtt = runner.cost.measured_rtt()
+    # game here (unlike /metrics scrapes — see BatchRunner.stats);
+    # probe=False callers price with the unprobed calibration instead
+    rtt = runner.cost.measured_rtt() if probe else runner.cost.rtt
     if not rtt:
         return _AUTO_DEPTH_DEFAULT
     import math
@@ -118,7 +123,7 @@ def pack_limit() -> int:
         return 8
 
 
-def pack_rows_cap(runner) -> int:
+def pack_rows_cap(runner, probe: bool = True) -> int:
     """Parts above this many rows never pack.
 
     Packing trades per-dispatch overhead for a bigger fused program, so
@@ -128,14 +133,22 @@ def pack_rows_cap(runner) -> int:
     device_rate (at ~128 scanned bytes/row), so big parts keep their own
     dispatches on fast-RTT backends (measured 0.5-0.7x regressions when
     packing 128k-row parts on jax-CPU) while the tunnel packs far larger
-    parts.  VL_PACK_MAX_ROWS overrides the adaptive cap outright."""
+    parts.  VL_PACK_MAX_ROWS overrides the adaptive cap outright.
+
+    probe=False never issues the lazy RTT calibration dispatch: the
+    EXPLAIN pricing pass (obs/explain.py) plans with the floor until a
+    real query has measured the round trip — `explain=1` must stay
+    zero-dispatch."""
     v = os.environ.get("VL_PACK_MAX_ROWS")
     if v:
         try:
             return max(1, int(v))
         except ValueError:
             pass
-    cap = runner.cost.measured_rtt() * runner.cost._dev_rate() / 128
+    rtt = runner.cost.measured_rtt() if probe else runner.cost.rtt
+    if rtt is None:
+        return _PACK_ROWS_FLOOR
+    cap = rtt * runner.cost._dev_rate() / 128
     return int(min(max(cap, _PACK_ROWS_FLOOR), _PACK_ROWS_CEIL))
 
 
@@ -334,6 +347,42 @@ class _PackStats:
 
 # ---------------- planning ----------------
 
+def pack_bucket(part) -> int:
+    """The padded-row bucket packing groups on (shared with the EXPLAIN
+    planner so the displayed pack membership is the dispatched one)."""
+    return pad_bucket(max(part.num_rows, 1), minimum=1024)
+
+
+def iter_pack_groups(items, packable: bool, pack_max: int,
+                     rows_cap: int):
+    """Fold an iterable of pruned (part, candidate-bis) pairs into
+    dispatch-unit groups — THE pack-membership rules, in one place:
+    consecutive small parts (<= rows_cap rows) sharing a padded-row
+    bucket group up to pack_max; everything else is its own unit.  Lazy:
+    pulls from `items` only as groups are consumed, so the execution
+    stream's early exits (limit, deadline) stop the header walk exactly
+    where the serial loop would, and the EXPLAIN pricing pass
+    (obs/explain.py) walks the identical grouping without dispatching."""
+    group: list = []        # packable run sharing one row bucket
+    for part, bis in items:
+        small = packable and part.num_rows <= rows_cap
+        if not small:
+            if group:
+                yield group
+                group = []
+            yield [(part, bis)]
+            continue
+        if group and pack_bucket(group[0][0]) != pack_bucket(part):
+            yield group
+            group = []
+        group.append((part, bis))
+        if len(group) >= pack_max:
+            yield group
+            group = []
+    if group:
+        yield group
+
+
 def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
                  sort_spec, token_leaves, check_deadline):
     """Lazily fold the pruned (part, candidate-bis) stream into dispatch
@@ -353,9 +402,6 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
     pack_max = pack_limit()
     packable = pack_max > 1 and sort_spec is None
     rows_cap = pack_rows_cap(runner) if packable else 0
-
-    def bucket(p) -> int:
-        return pad_bucket(max(p.num_rows, 1), minimum=1024)
 
     def make_unit(group) -> _Unit:
         if len(group) == 1:
@@ -383,37 +429,27 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
         return _Unit(pack, bss, members, pack=True)
 
     act = activity.current_activity()
-    group: list = []        # packable run sharing one row bucket
-    for part in parts:
-        check_deadline()
-        if head.is_done():
-            raise QueryCancelled()
-        bis = cand_fn(part)
-        if not bis:
-            continue
-        if token_leaves and part_aggregate_prunes(
-                part, token_leaves,
-                build=len(bis) * 4 >= part.num_blocks):
-            runner._bump("agg_pruned_parts")
-            continue
-        # registry progress at part granularity (the planning pull IS
-        # the prune stage, so these land as the walk advances)
-        activity.note_part_scanned(act, part, bis)
-        small = packable and part.num_rows <= rows_cap
-        if not small:
-            if group:
-                yield make_unit(group)
-                group = []
-            yield make_unit([(part, bis)])
-            continue
-        if group and bucket(group[0][0]) != bucket(part):
-            yield make_unit(group)
-            group = []
-        group.append((part, bis))
-        if len(group) >= pack_max:
-            yield make_unit(group)
-            group = []
-    if group:
+
+    def pruned():
+        for part in parts:
+            check_deadline()
+            if head.is_done():
+                raise QueryCancelled()
+            bis = cand_fn(part)
+            if not bis:
+                continue
+            if token_leaves and part_aggregate_prunes(
+                    part, token_leaves,
+                    build=len(bis) * 4 >= part.num_blocks):
+                runner._bump("agg_pruned_parts")
+                continue
+            # registry progress at part granularity (the planning pull
+            # IS the prune stage, so these land as the walk advances)
+            activity.note_part_scanned(act, part, bis)
+            yield part, bis
+
+    for group in iter_pack_groups(pruned(), packable, pack_max,
+                                  rows_cap):
         yield make_unit(group)
 
 
@@ -652,6 +688,10 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
             rtt = time.perf_counter() - t_submit
             if dispatched:
                 hist.DISPATCH_RTT.observe(rtt)
+                # the EXPLAIN pricing pass's per-unit round-trip term
+                # (CostModel.predict) feeds on REAL unit RTTs, not the
+                # minimal probe the routing gate uses
+                runner.cost.observe_unit_rtt(rtt)
             if hsp.enabled:
                 if dispatched:
                     hsp.set("dispatch_rtt_s", round(rtt, 6))
