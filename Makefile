@@ -6,8 +6,8 @@
 NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test lint bench bench-bloom bench-pipeline \
-	bench-concurrent bench-emit bench-explain bench-journal \
-	bench-wire clean
+	bench-concurrent bench-emit bench-explain bench-faults \
+	bench-journal bench-wire clean
 
 all: native
 
@@ -74,6 +74,14 @@ bench-explain:
 # PERF.md round 10
 bench-wire:
 	python tools/bench_wire.py --json BENCH_wire.json
+
+# network-chaos round on a real 3-node cluster + fault proxy: strict
+# failure bounded by the deadline (refuse AND hang), partial-results
+# exactness, breaker recovery latency, and the ingest-outage
+# spool-replay zero-loss assertion — recorded into BENCH_faults.json
+# (PERF.md chaos round)
+bench-faults:
+	python tools/bench_faults.py --json BENCH_faults.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

@@ -1,0 +1,369 @@
+"""Network-chaos suite: real multi-process cluster (3 storage nodes +
+frontend) with one node behind an in-process FaultProxy
+(sched/netfaults.py).  Kills/degrades/revives that node and asserts
+the fault-tolerance contract end to end:
+
+- strict queries fail cleanly within the deadline (refuse AND hang —
+  no 120s transport-timeout pin);
+- ?partial=1 queries succeed from the surviving nodes, carrying
+  X-VL-Partial + the partial.failed_nodes block;
+- the breaker surfaces as vl_node_health on /metrics and recovers
+  (half-open probe) after revival;
+- with the node down during ingest, zero rows are lost: the frontend
+  spools, the replay drains on revival, LogsQL counts come back exact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from victorialogs_tpu.sched.netfaults import FaultProxy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fast-recovery knobs for every server in this module: breaker opens
+# after 2 failures, half-opens after 0.5s, one retry per sub-query
+CHAOS_ENV = {
+    "VL_BREAKER_OPEN_S": "0.5",
+    "VL_BREAKER_FAILURES": "2",
+    "VL_NET_RETRIES": "1",
+}
+
+
+def _start(args, extra_env=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.update(CHAOS_ENV)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "victorialogs_tpu.server"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=REPO)
+
+
+def _read_banner(proc, timeout=60):
+    import threading
+    got = {}
+
+    def rd():
+        for raw in proc.stdout:
+            line = raw.decode("utf-8", "replace").strip()
+            if "started victoria-logs server at" in line:
+                try:
+                    got["port"] = int(line.rstrip("/").rsplit(":", 1)[1])
+                except (IndexError, ValueError):
+                    pass
+                return
+
+    t = threading.Thread(target=rd, daemon=True)
+    t.start()
+    t.join(timeout)
+    return got.get("port")
+
+
+def _start_bound(args, extra_env=None, retries=3):
+    for _ in range(retries):
+        proc = _start(["-httpListenAddr", "127.0.0.1:0"] + args,
+                      extra_env=extra_env)
+        port = _read_banner(proc)
+        if port is not None:
+            return proc, port
+        proc.terminate()
+        proc.wait(10)
+    raise RuntimeError("server did not start (no startup banner)")
+
+
+def _insert(port, rows, stream_fields="app"):
+    body = b"\n".join(json.dumps(r).encode() for r in rows)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/insert/jsonline?"
+        f"_stream_fields={stream_fields}", data=body)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+
+
+def _flush(port):
+    urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/internal/force_flush", timeout=30)
+
+
+def _query_raw(port, query, http_timeout=30, **extra):
+    """extra kwargs become QUERY args (timeout="5s" is the server-side
+    deadline; the client-side urlopen bound is http_timeout)."""
+    args = {"query": query, "limit": "0"}
+    args.update(extra)
+    u = (f"http://127.0.0.1:{port}/select/logsql/query?"
+         + urllib.parse.urlencode(args))
+    with urllib.request.urlopen(u, timeout=http_timeout) as resp:
+        return (resp.status, dict(resp.headers),
+                resp.read().decode())
+
+
+def _count(port, **extra):
+    _st, _h, text = _query_raw(port, "* | stats count() n", **extra)
+    for line in text.splitlines():
+        obj = json.loads(line)
+        if "n" in obj:
+            return int(obj["n"])
+    raise AssertionError(f"no count row in {text!r}")
+
+
+def _rows(n, offset=0):
+    out = []
+    for i in range(offset, offset + n):
+        out.append({
+            "_time": f"2026-07-28T{10 + (i // 3600) % 4}:"
+                     f"{(i // 60) % 60:02d}:{i % 60:02d}Z",
+            "_msg": f"{'error' if i % 3 == 0 else 'ok'} request {i}",
+            "app": f"app{i % 10}",
+        })
+    return out
+
+
+N_ROWS = 600
+
+
+@pytest.fixture(scope="module")
+def chaos():
+    """3 storage nodes; node2 is reached through a FaultProxy so tests
+    can kill/degrade/revive it without touching the process."""
+    procs = []
+    proxy = None
+    tmp = tempfile.mkdtemp(prefix="vlchaos")
+    try:
+        node_ports = []
+        for k in range(3):
+            proc, port = _start_bound(
+                ["-storageDataPath", f"{tmp}/node{k}",
+                 "-retentionPeriod", "100y"])
+            procs.append(proc)
+            node_ports.append(port)
+        proxy = FaultProxy("127.0.0.1", node_ports[2])
+        storage_urls = [f"http://127.0.0.1:{node_ports[0]}",
+                        f"http://127.0.0.1:{node_ports[1]}", proxy.url]
+        front, front_port = _start_bound(
+            ["-storageDataPath", f"{tmp}/front",
+             "-retentionPeriod", "100y"]
+            + sum((["-storageNode", u] for u in storage_urls), []))
+        procs.append(front)
+        _insert(front_port, _rows(N_ROWS))
+        for p in node_ports:
+            _flush(p)
+        per_node = [_count(p) for p in node_ports]
+        assert sum(per_node) == N_ROWS
+        assert all(c > 0 for c in per_node), per_node
+        yield {"front": front_port, "nodes": node_ports,
+               "proxy": proxy, "per_node": per_node,
+               "storage_urls": storage_urls}
+    finally:
+        if proxy is not None:
+            proxy.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _wait_strict_ok(port, want, timeout=15):
+    """Poll a strict query until the cluster answers completely again
+    (breaker half-open probe + recovery)."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if _count(port, timeout="5s") == want:
+                return
+        except (urllib.error.HTTPError, OSError) as e:
+            last = e
+        time.sleep(0.25)
+    raise AssertionError(f"cluster did not recover: {last}")
+
+
+def test_chaos_baseline_no_faults_exact(chaos):
+    st, headers, text = _query_raw(chaos["front"],
+                                   "* | stats count() n")
+    assert st == 200
+    assert headers.get("X-VL-Partial") is None
+    lines = [json.loads(l) for l in text.splitlines() if l]
+    assert lines == [{"n": str(N_ROWS)}]   # no _partial line either
+
+
+def test_chaos_killed_node_strict_fails_fast_partial_succeeds(chaos):
+    proxy = chaos["proxy"]
+    live = N_ROWS - chaos["per_node"][2]
+    proxy.set_mode("refuse")
+    try:
+        # strict: fails loudly, well before any transport timeout
+        t0 = time.monotonic()
+        with pytest.raises((urllib.error.HTTPError, OSError)):
+            _query_raw(chaos["front"], "* | stats count() n",
+                       timeout="5s")
+        assert time.monotonic() - t0 < 10
+
+        # partial=1: the survivors answer, loudly marked
+        st, headers, text = _query_raw(chaos["front"],
+                                       "* | stats count() n",
+                                       partial="1", timeout="10s")
+        assert st == 200
+        assert headers.get("X-VL-Partial") == "true"
+        lines = [json.loads(l) for l in text.splitlines() if l]
+        counts = [l for l in lines if "n" in l]
+        marks = [l for l in lines if "_partial" in l]
+        assert counts == [{"n": str(live)}]
+        assert len(marks) == 1
+        assert marks[0]["_partial"]["failed_nodes"] == [proxy.url]
+
+        # JSON endpoint: the partial block + header ride the payload
+        u = (f"http://127.0.0.1:{chaos['front']}/select/logsql/hits?"
+             + urllib.parse.urlencode({"query": "*", "step": "1d",
+                                       "partial": "1",
+                                       "timeout": "10s"}))
+        with urllib.request.urlopen(u, timeout=30) as resp:
+            assert resp.headers.get("X-VL-Partial") == "true"
+            obj = json.loads(resp.read())
+        assert obj["partial"]["failed_nodes"] == [proxy.url]
+        assert sum(sum(g["values"]) for g in obj["hits"]) == live
+
+        # the breaker surfaces on /metrics: the dead node at health 0,
+        # the survivors at 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{chaos['front']}/metrics",
+                timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert f'vl_node_health{{node="{proxy.url}"}} 0' in metrics
+        assert 'vl_net_retries_total' in metrics
+    finally:
+        proxy.set_mode("pass")
+    _wait_strict_ok(chaos["front"], N_ROWS)
+
+
+def test_chaos_hang_strict_bounded_by_deadline(chaos):
+    """The hang-fault pin: a node that accepts and streams nothing must
+    cost the query deadline (here 3s), not the 120s transport
+    timeout."""
+    proxy = chaos["proxy"]
+    live = N_ROWS - chaos["per_node"][2]
+    proxy.set_mode("hang")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((urllib.error.HTTPError, OSError)):
+            _query_raw(chaos["front"], "* | stats count() n",
+                       timeout="3s")
+        wall = time.monotonic() - t0
+        assert wall < 10, f"hung node pinned the frontend for {wall}s"
+
+        # partial mode: the hung node is declared failed AT the
+        # deadline and the survivors' answer comes back marked
+        st, headers, text = _query_raw(chaos["front"],
+                                       "* | stats count() n",
+                                       partial="1", timeout="3s")
+        assert st == 200
+        assert headers.get("X-VL-Partial") == "true"
+        counts = [json.loads(l) for l in text.splitlines()
+                  if l and "n" in json.loads(l)]
+        assert counts == [{"n": str(live)}]
+    finally:
+        proxy.set_mode("pass")
+    _wait_strict_ok(chaos["front"], N_ROWS)
+
+
+def test_chaos_reset_mid_stream_strict_fails_cleanly(chaos):
+    proxy = chaos["proxy"]
+    # a stats sub-query's whole reply fits in ~250 bytes: cut inside
+    # the response HEADERS so the reset lands mid-stream for sure
+    proxy.reset_after_bytes = 40
+    proxy.set_mode("reset")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((urllib.error.HTTPError, OSError)):
+            _query_raw(chaos["front"], "* | stats count() n",
+                       timeout="5s")
+        assert time.monotonic() - t0 < 10
+    finally:
+        proxy.reset_after_bytes = 256
+        proxy.set_mode("pass")
+    _wait_strict_ok(chaos["front"], N_ROWS)
+
+
+def test_chaos_ingest_spool_zero_rows_lost():
+    """Single-node cluster behind the proxy: node down during ingest ->
+    the frontend spools (HTTP 200, rows delayed not dropped) -> node
+    revives -> replay drains -> the LogsQL count is exact."""
+    procs = []
+    proxy = None
+    tmp = tempfile.mkdtemp(prefix="vlchaos-spool")
+    try:
+        node, node_port = _start_bound(
+            ["-storageDataPath", f"{tmp}/node",
+             "-retentionPeriod", "100y"])
+        procs.append(node)
+        proxy = FaultProxy("127.0.0.1", node_port)
+        front, front_port = _start_bound(
+            ["-storageDataPath", f"{tmp}/front",
+             "-retentionPeriod", "100y", "-storageNode", proxy.url])
+        procs.append(front)
+
+        _insert(front_port, _rows(100))
+        assert _count(front_port) == 100
+
+        proxy.set_mode("refuse")
+        time.sleep(0.1)
+        # ingest INTO the outage: every batch is accepted (200) and
+        # spooled durably on the frontend
+        for k in range(4):
+            _insert(front_port, _rows(50, offset=100 + 50 * k))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front_port}/metrics",
+                timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert "vl_insert_spooled_blocks_total" in metrics
+        spooled = [l for l in metrics.splitlines()
+                   if l.startswith("vl_insert_spooled_blocks_total")]
+        assert spooled and float(spooled[0].split()[-1]) >= 1
+
+        proxy.set_mode("pass")
+        # replay is breaker-paced: half-open at 0.5s, then the queue
+        # drains; every row must arrive (zero lost, exact count)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if _count(front_port, timeout="5s") == 300:
+                    break
+            except (urllib.error.HTTPError, OSError):
+                pass
+            time.sleep(0.25)
+        assert _count(front_port) == 300
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front_port}/metrics",
+                timeout=30) as resp:
+            metrics = resp.read().decode()
+        replayed = [l for l in metrics.splitlines()
+                    if l.startswith("vl_insert_replayed_blocks_total")]
+        assert replayed and float(replayed[0].split()[-1]) >= 1
+        spool_gauge = [l for l in metrics.splitlines()
+                       if l.startswith("vl_insert_spool_bytes")]
+        assert spool_gauge and \
+            all(float(l.split()[-1]) == 0 for l in spool_gauge)
+    finally:
+        if proxy is not None:
+            proxy.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()

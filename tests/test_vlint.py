@@ -861,3 +861,84 @@ def test_lease_discipline_repo_instrumentation_is_clean():
         found = [f for f in locks.check(sf)
                  if not sf.allowed(f.checker, f.line)]
         assert found == [], [f.render() for f in found]
+
+
+# ---------------- net discipline ----------------
+
+NET_BAD_URLOPEN = """
+    import urllib.request
+
+    def fetch(url):
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.read()
+"""
+
+NET_BAD_CONN = """
+    import http.client
+
+    def fetch(host):
+        conn = http.client.HTTPConnection(host, 80)
+        return conn
+"""
+
+NET_GOOD = """
+    from . import netrobust
+
+    def fetch(url):
+        return netrobust.request(url, "/internal/insert", b"")
+"""
+
+
+def test_net_discipline_flags_raw_urlopen_in_server():
+    out = lint(NET_BAD_URLOPEN,
+               path="victorialogs_tpu/server/cluster.py")
+    assert "net-discipline" in checkers(out)
+    assert any("netrobust" in f.message for f in out)
+
+
+def test_net_discipline_flags_direct_http_client():
+    out = lint(NET_BAD_CONN,
+               path="victorialogs_tpu/server/vlagent.py")
+    assert "net-discipline" in checkers(out)
+
+
+def test_net_discipline_scoped_to_server_package():
+    # the same raw call OUTSIDE server/ is someone else's business
+    # (tools, tests, benches talk to servers as plain HTTP clients)
+    out = lint(NET_BAD_URLOPEN, path="victorialogs_tpu/cli/main.py")
+    assert "net-discipline" not in checkers(out)
+
+
+def test_net_discipline_skips_netrobust_module():
+    out = lint(NET_BAD_CONN,
+               path="victorialogs_tpu/server/netrobust.py")
+    assert "net-discipline" not in checkers(out)
+
+
+def test_net_discipline_clean_and_annotated():
+    assert "net-discipline" not in checkers(
+        lint(NET_GOOD, path="victorialogs_tpu/server/cluster.py"))
+    annotated = """
+        import urllib.request
+
+        def probe(url):
+            # vlint: allow-net-discipline(liveness probe, no policy wanted)
+            return urllib.request.urlopen(url, timeout=1)
+    """
+    assert "net-discipline" not in checkers(
+        lint(annotated, path="victorialogs_tpu/server/cluster.py"))
+
+
+def test_net_discipline_repo_cluster_hops_are_clean():
+    """Every cluster hop in server/ (cluster.py, vlagent.py, app.py)
+    must ride the policy layer — zero raw-client findings."""
+    from tools.vlint.core import SourceFile
+    from tools.vlint import netdiscipline
+    for rel in ("server/cluster.py", "server/vlagent.py",
+                "server/app.py", "server/agent_http.py"):
+        path = os.path.join(REPO, "victorialogs_tpu", rel)
+        sf = SourceFile.parse(path,
+                              display_path=f"victorialogs_tpu/{rel}")
+        found = [f for f in netdiscipline.check(sf)
+                 if not sf.allowed(f.checker, f.line)]
+        assert found == [], [f.render() for f in found]

@@ -215,9 +215,10 @@ def check_ctx_discipline(sf: "SourceFile", checker: str, ctors: dict,
 
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import accounting, hotpath, hygiene, leases, locks, spans
+    from . import (accounting, hotpath, hygiene, leases, locks,
+                   netdiscipline, spans)
     return [locks.check, hygiene.check, hotpath.check, spans.check,
-            accounting.check, leases.check]
+            accounting.check, leases.check, netdiscipline.check]
 
 
 def run_source(path: str, text: str, root: str = ".") -> list[Finding]:

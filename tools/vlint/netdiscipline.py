@@ -1,0 +1,66 @@
+"""net-discipline checker: cluster HTTP hops must ride the fault-policy
+layer.
+
+Scope: ``victorialogs_tpu/server/`` (the cluster seam).  A raw
+``urllib.request.urlopen`` call or a direct ``http.client
+.HTTPConnection`` / ``HTTPSConnection`` construction there bypasses
+``server/netrobust.py`` — the per-node circuit breakers, deadline-aware
+retries, hedging, per-read deadlines and fault injection that every
+cluster hop must share.  ``netrobust.py`` itself is the one exempt
+module (it IS the policy layer).
+
+Deliberate sites carry ``# vlint: allow-net-discipline(<why>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, SourceFile
+from .locks import _dotted
+
+SCOPE_RE = re.compile(r"(^|/)victorialogs_tpu/server/")
+EXEMPT_RE = re.compile(r"(^|/)netrobust\.py$")
+
+# flagged call targets: attribute-name match is enough — the import
+# style (urllib.request.urlopen vs request.urlopen vs urlopen) must not
+# decide whether the hop is visible to the checker
+_RAW_CALLS = {
+    "urlopen": "raw urllib urlopen — route cluster hops through "
+               "server/netrobust.py (request/node_stream), or annotate "
+               "allow-net-discipline(<why>)",
+    "HTTPConnection": "direct http.client connection — route cluster "
+                      "hops through server/netrobust.py, or annotate "
+                      "allow-net-discipline(<why>)",
+    "HTTPSConnection": "direct http.client connection — route cluster "
+                       "hops through server/netrobust.py, or annotate "
+                       "allow-net-discipline(<why>)",
+}
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    if not SCOPE_RE.search("/" + sf.path) or \
+            EXEMPT_RE.search(sf.path):
+        return []
+    findings: list[Finding] = []
+
+    def walk(node, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            if isinstance(child, ast.Call):
+                if isinstance(child.func, ast.Attribute):
+                    last = child.func.attr
+                else:
+                    last = _dotted(child.func).split(".")[-1]
+                msg = _RAW_CALLS.get(last)
+                if msg is not None:
+                    findings.append(Finding("net-discipline", sf.path,
+                                            child.lineno, sym, msg))
+            walk(child, sym)
+
+    walk(sf.tree, "")
+    return findings

@@ -184,6 +184,14 @@ COST_ERR_DISPATCHES = histogram(
     "actually submitted through the pipeline window",
     _COST_ERR_BUCKETS)
 
+NET_FIRST_FRAME = histogram(
+    "vl_net_first_frame_seconds",
+    "cluster sub-query round trip to the node's first response frame "
+    "(the hedging EWMA feeds on the same measurement — "
+    "server/netrobust.py)",
+    (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+     1.0, 2.5, 5.0, 10.0))
+
 MERGE_SECONDS = histogram(
     "vl_storage_merge_duration_seconds",
     "wall time of one background part merge (small/big tier "

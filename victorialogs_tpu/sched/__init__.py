@@ -23,6 +23,8 @@ from __future__ import annotations
 from .admission import (AdmissionController, AdmissionShed, REASONS,
                         admission_snapshots, note_rejected)
 from .admission import metrics_samples as _admission_metrics
+from .netfaults import (FaultProxy, clear_net_faults, inject_net_fault,
+                        maybe_fail_net)
 from .scheduler import (DispatchScheduler, InjectedFaultError,
                         check_balanced, clear_faults, device_slots,
                         global_budget, inject_fault, maybe_fail_submit,
@@ -31,10 +33,11 @@ from .scheduler import (DispatchScheduler, InjectedFaultError,
 from .scheduler import metrics_samples as _scheduler_metrics
 
 __all__ = [
-    "AdmissionController", "AdmissionShed", "REASONS",
+    "AdmissionController", "AdmissionShed", "FaultProxy", "REASONS",
     "DispatchScheduler", "InjectedFaultError", "admission_snapshots",
-    "check_balanced", "clear_faults", "device_slots", "global_budget",
-    "inject_fault", "maybe_fail_submit", "metrics_samples",
+    "check_balanced", "clear_faults", "clear_net_faults",
+    "device_slots", "global_budget", "inject_fault", "inject_net_fault",
+    "maybe_fail_net", "maybe_fail_submit", "metrics_samples",
     "note_rejected", "sched_enabled", "scheduler", "set_tenant_weight",
     "snapshot", "tenant_weight",
 ]

@@ -1,0 +1,309 @@
+"""Network fault injection: the chaos counterpart of ``inject_fault``.
+
+Two cooperating mechanisms drive the cluster-robustness test matrix
+(server/netrobust.py is the consumer):
+
+- **in-process client-side faults** — ``inject_net_fault(mode, nth)``
+  arms a deterministic one-shot failure of a chosen upcoming cluster
+  HTTP attempt, and ``VL_FAULT_NET="<mode>:<prob>"`` fails each attempt
+  with probability ``prob``.  Only the modes a CLIENT can simulate
+  without a wire exist here: ``refuse`` (connection refused before any
+  bytes move) and ``5xx`` (the node answered 503).  Every injection
+  emits a ``fault_injected`` journal event so a chaos run's synthetic
+  failures correlate with the retries/breaker transitions they caused;
+
+- :class:`FaultProxy` — a real in-process TCP proxy for the wire-level
+  modes no client-side hook can fake: ``hang`` (accept, then silence),
+  ``reset`` (RST mid-response-stream), ``trickle`` (bytes dribble out
+  slower than any progress), plus ``refuse`` / ``5xx`` / ``pass``.
+  Tests and ``make bench-faults`` park it between a frontend and one
+  storage node and flip ``set_mode`` to kill/degrade/revive that node
+  without touching the node process.
+
+Import discipline: this module must stay importable without the server
+package (sched is below server in the layer order), so it raises plain
+``OSError`` subclasses / returns mode strings and lets netrobust do the
+HTTP-flavored wrapping.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+from ..obs import events
+
+NET_MODES = ("refuse", "5xx")          # client-side injectable
+PROXY_MODES = ("pass", "refuse", "5xx", "hang", "reset", "trickle")
+
+_mu = threading.Lock()
+_targets: list[tuple[int, str]] = []   # (attempt_no, mode)
+_attempt_count = 0
+
+
+class InjectedNetFault(ConnectionRefusedError):
+    """An injected ``refuse`` fault (an OSError, so the policy layer
+    classifies it exactly like a real dead node)."""
+
+
+def inject_net_fault(mode: str = "refuse", nth: int = 0) -> None:
+    """Arm a one-shot network fault: the (nth+1)-th cluster HTTP attempt
+    from now fails with ``mode`` (deterministic counterpart of
+    VL_FAULT_NET, mirroring scheduler.inject_fault)."""
+    if mode not in NET_MODES:
+        raise ValueError(f"unknown net fault mode {mode!r} "
+                         f"(client-side modes: {NET_MODES})")
+    with _mu:
+        _targets.append((_attempt_count + 1 + max(0, int(nth)), mode))
+
+
+def clear_net_faults() -> None:
+    with _mu:
+        _targets.clear()
+
+
+def maybe_fail_net(url: str) -> str | None:
+    """Called by netrobust immediately before each cluster HTTP attempt.
+    Returns the injected mode ("refuse" / "5xx") or None.  AFTER the
+    breaker admitted the attempt, so chaos runs exercise the real
+    failure-accounting path."""
+    global _attempt_count
+    with _mu:
+        _attempt_count += 1
+        n = _attempt_count
+        hit = next((t for t in _targets if t[0] == n), None)
+        if hit is not None:
+            _targets.remove(hit)
+    mode = hit[1] if hit is not None else None
+    source = "inject_net_fault"
+    if mode is None:
+        spec = os.environ.get("VL_FAULT_NET", "")
+        if spec:
+            m, _, p = spec.partition(":")
+            try:
+                prob = float(p) if p else 1.0
+            except ValueError:
+                prob = 0.0
+            if m in NET_MODES and prob > 0:
+                import random
+                if prob >= 1.0 or random.random() < prob:
+                    mode = m
+                    source = "VL_FAULT_NET"
+    if mode is not None:
+        events.emit("fault_injected", kind="net", mode=mode, url=url,
+                    attempt_no=n, source=source)
+    return mode
+
+
+# ---------------- the wire-level fault proxy ----------------
+
+_HTTP_503 = (b"HTTP/1.1 503 Service Unavailable\r\n"
+             b"Content-Type: text/plain\r\n"
+             b"Content-Length: 23\r\n"
+             b"Connection: close\r\n\r\n"
+             b"injected fault: 5xx\r\n\r\n")
+
+
+class FaultProxy:
+    """In-process TCP proxy with switchable failure modes (see module
+    docstring).  Listens on an OS-assigned localhost port; point a
+    frontend's ``-storageNode`` at :attr:`url` and flip ``set_mode`` to
+    chaos the hop."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 reset_after_bytes: int = 256,
+                 trickle_delay_s: float = 0.25):
+        self.target = (target_host, int(target_port))
+        self.reset_after_bytes = reset_after_bytes
+        self.trickle_delay_s = trickle_delay_s
+        self._mode = "pass"
+        self._mu = threading.Lock()
+        self._closed = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._ls = socket.socket()
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind(("127.0.0.1", 0))
+        self._ls.listen(32)
+        self.port = self._ls.getsockname()[1]
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def mode(self) -> str:
+        with self._mu:
+            return self._mode
+
+    def set_mode(self, mode: str) -> None:
+        if mode not in PROXY_MODES:
+            raise ValueError(f"unknown proxy mode {mode!r} "
+                             f"(modes: {PROXY_MODES})")
+        with self._mu:
+            self._mode = mode
+            conns, self._conns = self._conns, []
+        # changing mode cuts every live relay: a revive ("pass") must
+        # not leave a pre-fault hung connection pinning a client
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _track(self, *socks) -> None:
+        with self._mu:
+            self._conns.extend(socks)
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                client, _addr = self._ls.accept()
+            except OSError:
+                return
+            mode = self.mode
+            if mode == "refuse":
+                # immediate close: the client sees ECONNRESET/EOF
+                # before any HTTP bytes — the dead-node signature
+                try:
+                    client.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            self._track(client)
+            threading.Thread(target=self._serve, args=(client, mode),
+                             daemon=True).start()
+
+    def _serve(self, client: socket.socket, mode: str) -> None:
+        try:
+            if mode == "5xx":
+                self._read_request(client)
+                client.sendall(_HTTP_503)
+                client.close()
+                return
+            if mode == "hang":
+                # accept + swallow the request, answer nothing: the
+                # straggler-node case the per-read deadline exists for.
+                # Clear _read_request's poll timeout: a REAL hang never
+                # answers until the mode changes or the proxy closes
+                # (set_mode/close close this socket, waking the recv)
+                self._read_request(client)
+                client.settimeout(None)
+                while not self._closed.is_set():
+                    if client.recv(65536) == b"":
+                        break
+                return
+            self._relay(client, mode)
+        except OSError:
+            pass
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_request(client: socket.socket) -> bytes:
+        """Read until the request is plausibly complete (headers + any
+        body already in flight); bounded, never exact — the faults only
+        need the client to have committed its bytes."""
+        client.settimeout(0.5)
+        buf = b""
+        try:
+            while len(buf) < 1 << 20:
+                chunk = client.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        except socket.timeout:
+            pass
+        return buf
+
+    def _relay(self, client: socket.socket, mode: str) -> None:
+        """pass / reset / trickle: forward to the real node, degrading
+        the RESPONSE leg for the degraded modes."""
+        up = socket.create_connection(self.target, timeout=10)
+        self._track(up)
+
+        def c2s() -> None:
+            try:
+                while True:
+                    data = client.recv(65536)
+                    if not data:
+                        break
+                    up.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    up.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        threading.Thread(target=c2s, daemon=True).start()
+        sent = 0
+        try:
+            while True:
+                data = up.recv(65536)
+                if not data:
+                    break
+                if mode == "reset" and \
+                        sent + len(data) > self.reset_after_bytes:
+                    keep = max(0, self.reset_after_bytes - sent)
+                    if keep:
+                        client.sendall(data[:keep])
+                    # SO_LINGER(1, 0): close() sends RST, not FIN —
+                    # the mid-stream connection-reset signature.  The
+                    # c2s thread is blocked in recv() on this socket;
+                    # its in-flight syscall holds the kernel file ref,
+                    # which would DEFER the close (and the RST)
+                    # indefinitely — shutdown(SHUT_RD) wakes it with
+                    # EOF without putting a FIN on the wire, then the
+                    # close fires the RST
+                    client.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                    try:
+                        client.shutdown(socket.SHUT_RD)
+                    except OSError:
+                        pass
+                    self._closed.wait(0.05)
+                    client.close()
+                    return
+                if mode == "trickle":
+                    for i in range(0, len(data), 64):
+                        if self._closed.wait(self.trickle_delay_s):
+                            return
+                        client.sendall(data[i:i + 64])
+                    sent += len(data)
+                else:
+                    client.sendall(data)
+                    sent += len(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                up.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._ls.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -28,7 +28,7 @@ from ..utils.memory import QueryMemoryError
 from .. import sched
 from .insertutil import (CommonParams, LocalLogRowsStorage,
                          LogMessageProcessor, get_tenant_id)
-from . import vlinsert
+from . import netrobust, vlinsert
 from .vlselect import (HTTPError, handle_explain, handle_facets,
                        handle_field_names, handle_field_values,
                        handle_hits, handle_query, handle_stats_query,
@@ -141,6 +141,17 @@ class Metrics:
         from . import cluster as _cluster
         for base, labels, v in _cluster.wire_metrics_samples():
             add(metric_name(base, **labels), v)
+        # cluster fault-policy surface: per-node breaker health
+        # (vl_node_health), retry/hedge/partial counters and the
+        # ingest-spool accounting (server/netrobust.py)
+        from . import netrobust as _netrobust
+        for base, labels, v in _netrobust.metrics_samples():
+            add(metric_name(base, **labels), v)
+        if server is not None and \
+                hasattr(getattr(server, "sink", None),
+                        "spool_metrics_samples"):
+            for base, labels, v in server.sink.spool_metrics_samples():
+                add(metric_name(base, **labels), v)
         if server is not None:
             from .. import __version__
             add(metric_name("vl_build_info", version=__version__,
@@ -245,10 +256,13 @@ class BaseHTTPApp:
         self._thread.start()
 
     # ---- helpers ----
-    def respond(self, h, status: int, ctype: str, body: bytes) -> None:
+    def respond(self, h, status: int, ctype: str, body: bytes,
+                extra_headers: dict | None = None) -> None:
         try:
             h.send_response(status)
             h.send_header("Content-Type", ctype)
+            for k, v in (extra_headers or {}).items():
+                h.send_header(k, v)
             h.send_header("Content-Length", str(len(body)))
             h.end_headers()
             if h.command != "HEAD":
@@ -256,21 +270,42 @@ class BaseHTTPApp:
         except (BrokenPipeError, ConnectionResetError):
             pass
 
-    def respond_json(self, h, obj, status: int = 200) -> None:
+    def respond_json(self, h, obj, status: int = 200,
+                     extra_headers: dict | None = None) -> None:
         self.respond(h, status, "application/json",
-                     json.dumps(obj, ensure_ascii=False).encode("utf-8"))
+                     json.dumps(obj, ensure_ascii=False).encode("utf-8"),
+                     extra_headers=extra_headers)
 
-    def respond_stream(self, h, gen, ctype="application/x-ndjson") -> None:
+    def respond_stream(self, h, gen, ctype="application/x-ndjson",
+                       headers_fn=None) -> None:
         try:
+            # headers_fn: extra response headers computed AFTER the
+            # first chunk exists (a partial-results marker is only
+            # known once the scatter-gather has made progress); pulling
+            # the first chunk before the status line keeps headers
+            # truthful whenever the failure precedes the first output
+            # block — and ALWAYS for stats-shaped queries, whose single
+            # output chunk follows the full gather
+            it = iter(gen)
+            first = next(it, None)
+            extra = headers_fn() if headers_fn is not None else {}
             # error paths that fire after this point (e.g. a storage
             # node shedding mid-stream) must not write a second status
             # line into the chunked body — see respond_shed
             h._vl_streamed = True
             h.send_response(200)
             h.send_header("Content-Type", ctype)
+            for k, v in extra.items():
+                h.send_header(k, v)
             h.send_header("Transfer-Encoding", "chunked")
             h.end_headers()
-            for chunk in gen:
+
+            def chunks():
+                if first is not None:
+                    yield first
+                yield from it
+
+            for chunk in chunks():
                 if not chunk:
                     continue
                 data = chunk.encode("utf-8") if isinstance(chunk, str) \
@@ -419,7 +454,17 @@ class BaseHTTPApp:
             # counter (vl_ingest_parse_failures_total on /metrics)
             activity.note_parse_failure(proto)
             raise HTTPError(400, str(e))
-        lmp.flush()
+        except netrobust.InsertRejectedError as e:
+            # a storage node judged the forwarded batch malformed
+            # (cluster 4xx): a client error end to end, never a 500 —
+            # and never a breaker trip / re-route cascade (cluster.py)
+            raise HTTPError(400, str(e))
+        try:
+            # small batches reach the sink HERE (no size-triggered
+            # mid-parse flush happened): same rejection mapping
+            lmp.flush()
+        except netrobust.InsertRejectedError as e:
+            raise HTTPError(400, str(e))
         self.respond_json(h, {"status": "ok", "ingested": n})
 
     def respond_shed(self, h, e) -> None:
@@ -518,9 +563,14 @@ class VLServer(BaseHTTPApp):
         if storage_nodes:
             # cluster mode: ingest shards to the nodes, queries
             # scatter-gather over them (reference -storageNode switch —
-            # app/vlstorage/main.go:87-93)
+            # app/vlstorage/main.go:87-93).  The ingest spool lives
+            # next to the frontend's own data so a frontend restart
+            # replays whatever a node outage left behind.
             from .cluster import NetInsertStorage, NetSelectStorage
-            self.sink = NetInsertStorage(storage_nodes)
+            self.sink = NetInsertStorage(
+                storage_nodes,
+                spool_dir=os.path.join(storage.path,
+                                       "cluster-insert-spool"))
             self.query_storage = NetSelectStorage(storage_nodes)
         else:
             self.sink = LocalLogRowsStorage(storage)
@@ -661,6 +711,15 @@ class VLServer(BaseHTTPApp):
             tenant = get_tenant_id(headers, args)
             with activity.track(path, args.get("query", ""),
                                 tenant) as act:
+                # resolve the partial-results mode HERE (explicit
+                # ?partial arg over the VL_PARTIAL_RESULTS default) and
+                # stamp it on the record: the scatter-gather reads it
+                # ambiently on whatever thread runs the fan-out.  Only
+                # stamped when it deviates from default-strict, so
+                # ordinary query_done records stay unchanged.
+                want_partial = netrobust.partial_requested(args)
+                if want_partial or "partial" in args:
+                    act.set("partial_ok", 1 if want_partial else -1)
                 act.set_phase("queued")
                 try:
                     with self.admission.admit(
@@ -767,7 +826,22 @@ class VLServer(BaseHTTPApp):
         # drain the journal FIRST (its flush writes through self.sink)
         if self.journal is not None:
             self.journal.close()
+        # then the sink (the cluster sharder owns the spool-replay
+        # thread + per-node durable queues)
+        sink_close = getattr(self.sink, "close", None)
+        if sink_close is not None:
+            sink_close()
         super().close()
+
+    @staticmethod
+    def _partial_headers() -> dict:
+        """X-VL-Partial marker when the ambient query record shows the
+        scatter-gather degraded to surviving nodes (cluster.py stamps
+        partial_failed_nodes).  Evaluated AFTER the handler produced
+        its payload (JSON endpoints) or its first chunk (streams)."""
+        if activity.current_activity().counter("partial_failed_nodes"):
+            return {"X-VL-Partial": "true"}
+        return {}
 
     def handle_select(self, h, path, args, headers) -> None:
         s = self.query_storage
@@ -781,13 +855,16 @@ class VLServer(BaseHTTPApp):
                                                 runner=self.runner))
         elif path == "/select/logsql/query":
             gen = handle_query(s, args, headers, runner=self.runner)
-            self.respond_stream(h, gen)
+            self.respond_stream(h, gen,
+                                headers_fn=self._partial_headers)
         elif path == "/select/logsql/hits":
             self.respond_json(h, handle_hits(s, args, headers,
-                                             runner=self.runner))
+                                             runner=self.runner),
+                              extra_headers=self._partial_headers())
         elif path == "/select/logsql/facets":
             self.respond_json(h, handle_facets(s, args, headers,
-                                               runner=self.runner))
+                                               runner=self.runner),
+                              extra_headers=self._partial_headers())
         elif path == "/select/logsql/field_names":
             self.respond_json(h, handle_field_names(s, args, headers))
         elif path == "/select/logsql/field_values":
@@ -804,10 +881,12 @@ class VLServer(BaseHTTPApp):
                                                             headers))
         elif path == "/select/logsql/stats_query":
             self.respond_json(h, handle_stats_query(s, args, headers,
-                                                    runner=self.runner))
+                                                    runner=self.runner),
+                              extra_headers=self._partial_headers())
         elif path == "/select/logsql/stats_query_range":
             self.respond_json(h, handle_stats_query_range(
-                s, args, headers, runner=self.runner))
+                s, args, headers, runner=self.runner),
+                extra_headers=self._partial_headers())
         elif path == "/select/logsql/tail":
             stop = {"flag": False}
             # empty keep-alive chunks are never written (a zero-length

@@ -13,8 +13,19 @@ internalinsert/internalselect stack:
   lib/logstorage/net_query_runner.go:67-96, pipe_stats.go:111-119; results
   stream back as length-prefixed zstd frames
   (app/vlselect/internalselect/internalselect.go:55-100);
-- failure semantics: any node error fails the whole query (the reference's
-  explicit no-partial-results design).
+- failure semantics: by default any node error fails the whole query (the
+  reference's explicit no-partial-results design); ``?partial=1`` (or
+  VL_PARTIAL_RESULTS=1) opts a request into merged results from the
+  surviving nodes when a node is still down after the policy layer's
+  retries, marked with X-VL-Partial + a ``partial.failed_nodes`` block.
+
+Every HTTP hop here rides the fault-policy layer (server/netrobust.py:
+per-node circuit breakers shared by select + insert, deadline-aware
+retries, hedging, per-read deadlines, fault injection) — enforced by
+the vlint ``net-discipline`` checker.  When re-routing exhausts healthy
+nodes, ingest spools the serialized shard body to a per-node durable
+queue and replays it when the node recovers, so an outage delays rows
+instead of dropping them.
 
 Wire formats are this repo's own: versioned via the `version` arg like
 the reference's per-endpoint protocol versions (netselect.go:28-63).
@@ -26,14 +37,11 @@ legacy JSON frame as the mandatory fallback; see the framing section.
 
 from __future__ import annotations
 
-import http.client
 import json
 import os
 import struct
 import threading
 import time
-import urllib.error
-import urllib.request
 
 import numpy as np
 
@@ -45,9 +53,9 @@ from ..obs import activity, events, tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
+from . import netrobust
 
 PROTOCOL_VERSION = "v1"
-CIRCUIT_BREAK_SECONDS = 10.0
 
 # frames are written/read from many response and fetch threads; the
 # utils.zstd helpers keep per-thread contexts (zstd objects are not
@@ -551,28 +559,59 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
 
 # ---------------- client side: sharded ingest ----------------
 
+# re-exported for callers that think in cluster terms; defined in the
+# policy layer so the HTTP app can catch it without importing cluster
+InsertRejectedError = netrobust.InsertRejectedError
+
+
 class NetInsertStorage:
     """LogRowsStorage that ships rows to storage nodes by stream hash.
 
-    Implements the reference's placement + failure policy: stream-hash
-    routing for locality, a 10s circuit breaker on a failed node, and
-    re-routing to the next healthy node (netinsert.go:368-409, 283-289)."""
+    Implements the reference's placement policy (stream-hash routing
+    for locality, re-routing to the next healthy node —
+    netinsert.go:368-409, 283-289) on top of the shared fault-policy
+    layer: per-node circuit breakers (netrobust.breaker_for — the same
+    breakers the select fan-out feeds), client-error classification
+    (4xx surfaces, 5xx/transport breaks, ingest 429s honor Retry-After
+    via breaker.throttle), and a durable per-node spool: when
+    re-routing exhausts healthy nodes the already-serialized shard body
+    lands in a PersistentQueue (bounded by VL_INSERT_SPOOL_MAX_BYTES)
+    and a background thread replays it once the node's breaker lets a
+    probe through — a storage-node outage delays rows instead of
+    dropping them."""
 
-    def __init__(self, node_urls: list, timeout: float = 30.0):
+    def __init__(self, node_urls: list, timeout: float = 30.0,
+                 spool_dir: str | None = None):
         if not node_urls:
             raise ValueError("no storage nodes configured")
         self.urls = [u.rstrip("/") for u in node_urls]
         self.timeout = timeout
-        self._disabled_until = [0.0] * len(self.urls)
-        self._lock = threading.Lock()
+        self._spool_dir = spool_dir
+        self._spools: dict[int, object] = {}
+        self._spool_mu = threading.Lock()
+        self._replay_stop = threading.Event()
+        self._replay_wake = threading.Event()
+        self._replay_thread = None
+        if self._spool_enabled():
+            # leftover spools from a previous process must replay even
+            # if this process never spools: open every existing queue
+            for idx in range(len(self.urls)):
+                if os.path.isdir(self._spool_path(idx)):
+                    self._spool_queue(idx)
+            self._start_replay()
 
-    def _healthy(self, idx: int) -> bool:
-        return time.monotonic() >= self._disabled_until[idx]
+    def _spool_enabled(self) -> bool:
+        return self._spool_dir is not None and \
+            netrobust.spool_max_bytes() > 0
 
-    def _mark_broken(self, idx: int) -> None:
-        with self._lock:
-            self._disabled_until[idx] = \
-                time.monotonic() + CIRCUIT_BREAK_SECONDS
+    def _spool_path(self, idx: int) -> str:
+        """One node's spool directory, keyed by URL hash so a node
+        list reorder never mixes queues (the ONE place the layout is
+        defined: startup discovery and queue creation both use it)."""
+        import hashlib
+        return os.path.join(
+            self._spool_dir,
+            hashlib.sha256(self.urls[idx].encode()).hexdigest()[:16])
 
     def must_add_rows(self, lr: LogRows) -> None:
         n_nodes = len(self.urls)
@@ -595,58 +634,174 @@ class NetInsertStorage:
         errors = []
         for node, blines in batches.items():
             body = _zstd.compress(b"\n".join(blines))
-            if not self._send(node, body):
-                # re-route to any healthy node (data locality is a
-                # preference, not a correctness requirement)
-                sent = False
-                for alt in range(n_nodes):
-                    if alt != node and self._healthy(alt) and \
-                            self._send(alt, body):
-                        sent = True
-                        break
-                if not sent:
-                    errors.append(f"all nodes down for shard {node}")
+            if self._send(node, body):
+                continue
+            # re-route to any healthy node (data locality is a
+            # preference, not a correctness requirement)
+            if any(alt != node and self._send(alt, body)
+                   for alt in range(n_nodes)):
+                continue
+            # every node is down/throttled: spool durably and replay
+            # when the shard's node recovers — delay, don't drop
+            if self._spool(node, body, nrows=len(blines)):
+                continue
+            errors.append(f"all nodes down for shard {node}")
         if errors:
             raise IOError("; ".join(errors))
 
     def _send(self, idx: int, body: bytes) -> bool:
-        if not self._healthy(idx):
-            return False
-        url = (f"{self.urls[idx]}/internal/insert?"
-               f"version={PROTOCOL_VERSION}")
-        req = urllib.request.Request(url, data=body, method="POST")
-        req.add_header("Content-Type", "application/octet-stream")
+        """One policy-managed delivery attempt.  False means 'this node
+        cannot take the batch right now' (down/throttled — breaker
+        accounting already done inside netrobust.request); a 4xx
+        rejection raises InsertRejectedError instead, because re-routing
+        a malformed batch would just cascade the rejection."""
+        url = self.urls[idx]
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return 200 <= resp.status < 300
-        except (OSError, http.client.HTTPException):
-            self._mark_broken(idx)
+            status, _headers, rbody = netrobust.request(
+                url, f"/internal/insert?version={PROTOCOL_VERSION}",
+                body,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=self.timeout)
+        except (IOError, OSError):
             return False
+        if 200 <= status < 300:
+            return True
+        if status != 429 and 400 <= status < 500:
+            raise InsertRejectedError(
+                f"storage node {url} rejected the batch: HTTP {status}: "
+                f"{rbody[:200].decode('utf-8', 'replace')}")
+        return False  # 429 (throttled via Retry-After) or 5xx
+
+    # ---- the durable spool ----
+
+    def _spool_queue(self, idx: int):
+        from ..utils.persistentqueue import PersistentQueue
+        with self._spool_mu:
+            q = self._spools.get(idx)
+            if q is None:
+                q = PersistentQueue(
+                    self._spool_path(idx),
+                    max_pending_bytes=netrobust.spool_max_bytes())
+                self._spools[idx] = q
+            return q
+
+    def _spool(self, idx: int, body: bytes, nrows: int) -> bool:
+        if not self._spool_enabled():
+            return False
+        from ..utils.persistentqueue import QueueOverflowError
+        q = self._spool_queue(idx)
+        was_empty = q.pending_bytes() == 0
+        try:
+            q.append(body)
+        except QueueOverflowError:
+            netrobust.note("spool_overflow")
+            events.emit("spool_overflow", node=self.urls[idx],
+                        rows=nrows, pending_bytes=q.pending_bytes())
+            return False
+        netrobust.note("spooled_blocks")
+        netrobust.note("spooled_rows", nrows)
+        if was_empty:
+            # one event per outage burst, not per batch
+            events.emit("ingest_spool_start", node=self.urls[idx])
+        self._start_replay()
+        self._replay_wake.set()
+        return True
+
+    def _start_replay(self) -> None:
+        with self._spool_mu:
+            if self._replay_thread is None:
+                self._replay_thread = threading.Thread(
+                    target=self._replay_loop, daemon=True,
+                    name="vl-insert-spool-replay")
+                self._replay_thread.start()
+
+    def _replay_loop(self) -> None:
+        """Drain per-node spools back to their nodes.  Paced by the
+        breakers: while a node's circuit is open the send attempt is
+        refused instantly, and the half-open probe IS the replay —
+        recovery and replay are one mechanism."""
+        while not self._replay_stop.is_set():
+            self._replay_wake.wait(0.25)
+            self._replay_wake.clear()
+            if self._replay_stop.is_set():
+                return
+            with self._spool_mu:
+                spools = list(self._spools.items())
+            for idx, q in spools:
+                drained = 0
+                while not self._replay_stop.is_set() and \
+                        q.pending_bytes() > 0:
+                    data = q.read(timeout=None)
+                    if data is None:
+                        break
+                    try:
+                        if not self._send(idx, data):
+                            break
+                    except InsertRejectedError:
+                        # a poisoned block must not wedge the whole
+                        # queue behind it: drop it, loudly
+                        netrobust.note("spool_rejected_blocks")
+                        events.emit("spool_block_rejected",
+                                    node=self.urls[idx])
+                        q.ack(len(data))
+                        continue
+                    q.ack(len(data))
+                    drained += 1
+                    netrobust.note("replayed_blocks")
+                if drained and q.pending_bytes() == 0:
+                    events.emit("ingest_spool_replayed",
+                                node=self.urls[idx], blocks=drained)
+
+    def spool_pending_bytes(self) -> int:
+        with self._spool_mu:
+            spools = list(self._spools.values())
+        return sum(q.pending_bytes() for q in spools)
+
+    def spool_metrics_samples(self) -> list:
+        """(base, labels, value) gauges for Metrics.render."""
+        with self._spool_mu:
+            spools = list(self._spools.items())
+        # vlint: allow-per-row-emit(metric samples, bounded by node count)
+        return [("vl_insert_spool_bytes", {"node": self.urls[idx]},
+                 q.pending_bytes()) for idx, q in spools]
+
+    def close(self) -> None:
+        self._replay_stop.set()
+        self._replay_wake.set()
+        t = self._replay_thread
+        if t is not None:
+            t.join(timeout=5)
+        with self._spool_mu:
+            spools, self._spools = list(self._spools.values()), {}
+        for q in spools:
+            q.close()
 
 
 # ---------------- client side: scatter-gather select ----------------
 
-def _node_http_error(url: str, e: urllib.error.HTTPError) -> Exception:
+def _node_http_error(url: str,
+                     e: netrobust.NodeHTTPError) -> Exception:
     """Map a storage node's HTTP error for the fan-out paths: a 429
     (the node's admission control shed us) becomes AdmissionShed so the
     frontend answers 429 + Retry-After with the node's reason and
     concurrency hints — overload propagates as overload, not as an
-    internal error; anything else is a transport failure."""
-    if e.code != 429:
-        return IOError(f"{url}: HTTP {e.code}")
+    internal error.  Other statuses keep the NodeHTTPError: a 4xx
+    means this frontend's sub-request was rejected by a live node
+    (version/endpoint skew) — never partial-eligible, never a breaker
+    trip, surfaced as an internal cluster error (HTTP 500) like the
+    legacy path's IOError; 5xx never reaches here (netrobust converts
+    it to NodeDownError after retries)."""
+    if e.status != 429:
+        return e
     try:
-        info = json.loads(e.read().decode("utf-8", "replace"))
-    except (ValueError, OSError):
-        info = {}
-    try:
-        retry = float(e.headers.get("Retry-After") or 1)
+        info = json.loads(e.body.decode("utf-8", "replace"))
     except ValueError:
-        retry = 1.0
+        info = {}
     return sched.AdmissionShed(
         info.get("reason", "queue_full"),
         f"storage node {url} shed the sub-query: "
         f"{info.get('error', 'overloaded')}",
-        retry_after=retry,
+        retry_after=netrobust.retry_after_s(e.headers),
         # forward the node's concurrency hints so the frontend's 429
         # carries X-VL-Concurrency-* end to end
         limit=info.get("limit"),
@@ -720,24 +875,27 @@ class NetSelectStorage:
                 # trace parity with the single-node path: each node's
                 # analyze tree then carries its own span tree
                 form["trace"] = "1"
-            req = urllib.request.Request(
-                f"{url}/internal/select/query",
-                data=urlencode(form).encode("utf-8"), method="POST")
-            req.add_header("Content-Type",
-                           "application/x-www-form-urlencoded")
             http_timeout = self.timeout if remaining_s is None else \
                 min(self.timeout, remaining_s + 5.0)
             tree = None
             try:
-                with urllib.request.urlopen(
-                        req, timeout=http_timeout) as resp:
-                    if resp.status != 200:
-                        raise IOError(f"{url}: HTTP {resp.status}")
-                    for payload, _n in read_frame_payloads(resp):
+                # the policy layer owns retries/breaker/deadline; an
+                # explain sub-request is idempotent by construction
+                frames = netrobust.node_stream(
+                    url, "/internal/select/query",
+                    urlencode(form).encode("utf-8"),
+                    {"Content-Type":
+                     "application/x-www-form-urlencoded"},
+                    io_timeout=http_timeout, deadline=deadline,
+                    idempotent=True)
+                try:
+                    for payload, _n in frames:
                         frame = json.loads(payload)
                         if "explain" in frame:
                             tree = frame["explain"]
-            except urllib.error.HTTPError as e:
+                finally:
+                    frames.close()
+            except netrobust.NodeHTTPError as e:
                 # a node's admission control shedding the explain
                 # sub-request must surface as 429 + Retry-After at the
                 # frontend, exactly like net_run_query
@@ -772,7 +930,12 @@ class NetSelectStorage:
 
     def net_run_query(self, tenants, q, write_block=None,
                       timestamp: int | None = None,
-                      deadline: float | None = None) -> None:
+                      deadline: float | None = None,
+                      partial: bool | None = None) -> None:
+        """Scatter-gather one query.  ``partial=None`` resolves the
+        partial-results mode from the ambient activity record (the HTTP
+        layer stamps ?partial=1 there) falling back to the
+        VL_PARTIAL_RESULTS default; True/False pin it."""
         from ..engine.searcher import build_processor_chain, init_subqueries
         if isinstance(q, str):
             q = parse_query(q, timestamp)
@@ -809,9 +972,14 @@ class NetSelectStorage:
         # the frontend's registry record ends the scatter-gather the
         # same way early-done does — fetch threads stop pulling frames
         act = activity.current_activity()
+        if partial is not None:
+            partial_ok = partial
+        else:
+            pf = act.counter("partial_ok")
+            partial_ok = pf > 0 if pf else netrobust.partial_default()
         lock = threading.Lock()
         stop = threading.Event()
-        errors: list = []
+        errors: list = []          # (url, exception) per failed node
         tenants = list(tenants) or [TenantID(0, 0)]
         tenant_arg = ",".join(f"{t.account_id}:{t.project_id}"
                               for t in tenants)
@@ -848,10 +1016,6 @@ class NetSelectStorage:
             if self.wire_typed:
                 form["wire"] = WIRE_FORMAT
             body = urlencode(form).encode("utf-8")
-            req = urllib.request.Request(
-                f"{url}/internal/select/query", data=body, method="POST")
-            req.add_header("Content-Type",
-                           "application/x-www-form-urlencoded")
             http_timeout = self.timeout if remaining_s is None else \
                 min(self.timeout, remaining_s + 5.0)
             try:
@@ -859,12 +1023,17 @@ class NetSelectStorage:
                 with tracing.use_span(parent_span), \
                         tracing.current_span().span("storage_node",
                                                     url=url) as nsp:
-                    with urllib.request.urlopen(
-                            req, timeout=http_timeout) as resp:
-                        if resp.status != 200:
-                            raise IOError(f"{url}: HTTP {resp.status}")
-                        for payload, wire_n in \
-                                read_frame_payloads(resp):
+                    # ALL fault policy (breaker, retries, hedging,
+                    # per-read deadlines, injected faults) lives in the
+                    # policy layer; this loop only decodes frames
+                    frames = netrobust.node_stream(
+                        url, "/internal/select/query", body,
+                        {"Content-Type":
+                         "application/x-www-form-urlencoded"},
+                        io_timeout=http_timeout, deadline=deadline,
+                        idempotent=True, span=nsp)
+                    try:
+                        for payload, wire_n in frames:
                             if stop.is_set() or act.is_cancelled():
                                 # abandoning the stream also abandons
                                 # the node's trailing trace frame — the
@@ -911,14 +1080,24 @@ class NetSelectStorage:
                                     stop.set()
                                     nsp.set("trace_truncated", True)
                                     return
-            except urllib.error.HTTPError as e:
-                errors.append(_node_http_error(url, e))
+                    finally:
+                        frames.close()
+            except netrobust.NodeHTTPError as e:
+                # 429 -> AdmissionShed, other 4xx stay client errors;
+                # both always fail the whole query (partial covers node
+                # LOSS, not a sub-query the node judged invalid)
+                errors.append((url, _node_http_error(url, e)))
                 stop.set()
             # collected errors re-raise on the caller thread after join
             # vlint: allow-broad-except(fan-out error channel)
             except Exception as e:
-                errors.append(e)
-                stop.set()
+                errors.append((url, e))
+                if not (partial_ok and isinstance(e, (IOError, OSError))):
+                    # strict mode: first error cancels the other
+                    # fetches.  In partial mode a transport failure
+                    # must NOT stop the surviving nodes — their merged
+                    # answer IS the degraded result.
+                    stop.set()
 
         threads = [threading.Thread(target=fetch, args=(u,), daemon=True)
                    for u in self.urls]
@@ -927,17 +1106,34 @@ class NetSelectStorage:
         for t in threads:
             t.join()
         if errors:
-            # no partial results: any storage-node failure fails the query.
-            # Local typed errors (memory budget, deadline) raised by
-            # head.write_block re-raise unwrapped so the HTTP layer maps
-            # them to 422/503 exactly as in single-node mode; only genuine
-            # transport failures become IOError.  A shed outranks other
-            # failures deterministically: the client must see 429 +
-            # Retry-After whenever ANY node shed, not only when that
-            # node's fetch thread happened to error first.
-            err = next((e for e in errors
-                        if isinstance(e, sched.AdmissionShed)),
-                       errors[0])
+            # Default: no partial results — any storage-node failure
+            # fails the query.  Local typed errors (memory budget,
+            # deadline) raised by head.write_block re-raise unwrapped so
+            # the HTTP layer maps them to 422/503 exactly as in
+            # single-node mode; only genuine transport failures become
+            # IOError.  A shed outranks other failures
+            # deterministically: the client must see 429 + Retry-After
+            # whenever ANY node shed, not only when that node's fetch
+            # thread happened to error first.
+            shed = next((e for _u, e in errors
+                         if isinstance(e, sched.AdmissionShed)), None)
+            if shed is None and partial_ok and \
+                    len(errors) < len(self.urls) and \
+                    all(isinstance(e, (IOError, OSError))
+                        for _u, e in errors):
+                # opted-in degradation: at least one node survived and
+                # every failure is an availability failure — answer
+                # from the survivors, loudly marked
+                failed = sorted({u for u, _e in errors})
+                act.set("partial_failed_nodes", failed)
+                parent_span.set("partial_failed_nodes", failed)
+                netrobust.note("partial_results")
+                events.emit("partial_result", query=q.to_string(),
+                            failed_nodes=",".join(failed),
+                            surviving=len(self.urls) - len(failed))
+                head.flush()
+                return
+            err = shed if shed is not None else errors[0][1]
             if isinstance(err, (IOError, OSError)):
                 raise IOError(f"cluster query failed: {err}")
             raise err

@@ -1,0 +1,763 @@
+"""Cluster fault-policy layer: every HTTP hop between cluster processes
+rides this module (enforced by the vlint ``net-discipline`` checker).
+
+The scatter-gather front (server/cluster.py) used to treat the network
+as either perfect or fatal: one attempt per node, a 10s ad-hoc disable
+array on the insert path, no deadline on a node that accepts a
+connection and then streams nothing.  This module centralizes the
+production behaviors:
+
+- **per-node circuit breaker** (:class:`CircuitBreaker`, shared by the
+  select and insert paths through :func:`breaker_for`): closed /
+  open / half-open with single-probe recovery, ``node_down`` /
+  ``node_recovered`` journal events on the transitions, health as
+  ``vl_node_health{node=}`` on /metrics.  Ingest 429s move the breaker
+  into a *throttled* open (honoring ``Retry-After``) without a
+  node_down event — overload is not death;
+- **deadline-aware retries** (:func:`node_stream`): idempotent select
+  sub-queries retry transport/5xx failures with jittered exponential
+  backoff, never past the request deadline and never after a frame was
+  already delivered downstream (a mid-stream replay would double-count
+  rows).  ``vl_net_retries_total`` counts them; per-node attempt counts
+  ride the ?trace=1 ``storage_node`` spans;
+- **request hedging**: when a node's first frame lags its own
+  p95-style RTT estimate (EWMA of mean + deviation, or a pinned
+  ``VL_NET_HEDGE_MS``), the sub-query is re-issued to the same node and
+  the first answer wins (``vl_net_hedges_total{won=}``).  Hedging
+  targets the SAME node because shards are not replicated — the hedge
+  beats a wedged worker/connection, not a dead machine;
+- **per-read deadlines**: the frame reader runs on its own thread with
+  socket timeouts re-derived per read, and the consuming side bounds
+  every wait by the query deadline — a hung or trickling node costs at
+  most the remaining budget, never the full transport timeout;
+- **fault injection**: every attempt consults
+  ``sched.netfaults.maybe_fail_net`` (``VL_FAULT_NET`` /
+  ``inject_net_fault``), so chaos tests drive these paths without a
+  wire.
+
+Partial results (``?partial=1`` / ``VL_PARTIAL_RESULTS``) are decided
+in cluster.py's gather; this module only supplies the policy helpers
+(:func:`partial_requested`) and the failure taxonomy that makes "node
+down" distinguishable from "query broken": :class:`NodeDownError`
+(IOError — partial-eligible) vs :class:`NodeHTTPError` with a 4xx
+status (the sub-query itself is bad — always strict).
+
+Lock order: breaker and counter locks are leaves; journal events are
+emitted outside them.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import queue
+import random
+import struct
+import threading
+import time
+import urllib.parse
+
+from ..obs import events, hist, tracing
+from ..sched import netfaults
+from ..utils import zstd as _zstd
+
+
+# ---------------- knobs ----------------
+
+def net_retries() -> int:
+    """VL_NET_RETRIES: extra attempts per idempotent select sub-query
+    after the first (0 disables retrying)."""
+    try:
+        return max(0, int(os.environ.get("VL_NET_RETRIES", "2")))
+    except ValueError:
+        return 2
+
+
+def breaker_failures() -> int:
+    """VL_BREAKER_FAILURES: consecutive transport failures that open a
+    node's circuit (>=1; default 2 so one transient blip retries
+    without blacklisting the node)."""
+    try:
+        return max(1, int(os.environ.get("VL_BREAKER_FAILURES", "2")))
+    except ValueError:
+        return 2
+
+
+def breaker_open_s() -> float:
+    """VL_BREAKER_OPEN_S: seconds an open circuit refuses requests
+    before half-opening one probe (the old fixed 10s disable)."""
+    try:
+        return max(0.05, float(os.environ.get("VL_BREAKER_OPEN_S", "10")))
+    except ValueError:
+        return 10.0
+
+
+def spool_max_bytes() -> int:
+    """VL_INSERT_SPOOL_MAX_BYTES: per-node durable ingest spool bound
+    (0 disables spooling — the old drop-on-outage behavior)."""
+    try:
+        return int(os.environ.get("VL_INSERT_SPOOL_MAX_BYTES",
+                                  str(256 << 20)))
+    except ValueError:
+        return 256 << 20
+
+
+def partial_default() -> bool:
+    """VL_PARTIAL_RESULTS=1 turns partial results on for requests that
+    do not carry an explicit ?partial arg."""
+    return os.environ.get("VL_PARTIAL_RESULTS", "0") in ("1", "true",
+                                                         "yes")
+
+
+def partial_requested(args) -> bool:
+    """Resolve one request's partial-results mode: explicit ?partial
+    arg wins, else the VL_PARTIAL_RESULTS default (strict off)."""
+    v = str(args.get("partial", "") or "")
+    if v:
+        return v in ("1", "true", "yes")
+    return partial_default()
+
+
+_RETRY_BACKOFF_BASE_S = 0.1
+_RETRY_BACKOFF_MAX_S = 2.0
+# minimum useful remaining budget for another attempt: retrying with
+# less than this left only burns the deadline
+_RETRY_FLOOR_S = 0.05
+
+
+# ---------------- failure taxonomy ----------------
+
+class NodeDownError(IOError):
+    """A node-side availability failure: refused/reset connection,
+    transport error, 5xx after retries, circuit open, or deadline
+    exceeded waiting on the node.  The ONLY failure class eligible for
+    ?partial=1 degradation — everything else means the query itself
+    (or this process) is broken and must stay strict."""
+
+
+class InsertRejectedError(ValueError):
+    """A storage node REJECTED an ingest batch (HTTP 4xx other than
+    429): the batch is malformed, not the node — surfaced to the
+    caller (HTTP 400) without tripping the breaker, re-routing, or
+    spooling (every node would reject it the same way)."""
+
+
+class NodeHTTPError(Exception):
+    """A complete non-200 HTTP response from a node (status, headers,
+    body preserved for upstream mapping: 429 -> AdmissionShed with
+    Retry-After, 5xx -> NodeDownError after retries).  Other 4xx mean
+    the node is alive but rejected the sub-request (version/endpoint
+    skew): no breaker trip, no retry, never partial-eligible — the
+    query fails as an internal cluster error (HTTP 500 at the
+    frontend, exactly like the legacy path's IOError)."""
+
+    def __init__(self, url: str, status: int, headers, body: bytes):
+        super().__init__(f"{url}: HTTP {status}")
+        self.url = url
+        self.status = status
+        self.headers = headers if headers is not None else {}
+        self.body = body or b""
+
+
+def retry_after_s(headers, default: float = 1.0) -> float:
+    try:
+        return max(0.1, float(headers.get("Retry-After") or default))
+    except (ValueError, AttributeError):
+        return default
+
+
+# ---------------- counters ----------------
+
+_counts_mu = threading.Lock()
+_counts: dict[str, int] = {}
+
+
+def note(key: str, delta: int = 1) -> None:
+    with _counts_mu:
+        _counts[key] = _counts.get(key, 0) + delta
+
+
+def counters() -> dict:
+    with _counts_mu:
+        return dict(_counts)
+
+
+# ---------------- per-node circuit breaker ----------------
+
+class CircuitBreaker:
+    """One node's health state (shared select + insert; see module
+    docstring).  All state under one leaf lock; journal events emitted
+    outside it."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self._mu = threading.Lock()
+        self._state = "closed"          # closed | open | half-open
+        self._consec = 0
+        self._open_until = 0.0
+        self._probing = False
+        self._probe_t0 = 0.0
+        self._insert_throttle_until = 0.0
+        self._down_emitted = False
+        self._opened_total = 0
+        self._failures_total = 0
+        # first-frame RTT estimate for hedging: EWMA of mean and of
+        # absolute deviation (a cheap p95-style bound: mean + 4*dev)
+        self._rtt_mean = 0.0
+        self._rtt_dev = 0.0
+        self._rtt_n = 0
+
+    # -- admission --
+    def allow(self) -> bool:
+        """May a request be sent to this node now?  In the half-open
+        window exactly one probe is admitted; its outcome (on_success /
+        on_failure) decides the next state.  A probe that can resolve
+        neither way (caller abandoned the stream) must call
+        abandon_probe(); a stale probe also self-expires after the
+        open window, so a missed release can never wedge the node
+        closed forever."""
+        now = time.monotonic()
+        with self._mu:
+            if self._state == "closed":
+                return True
+            if now < self._open_until:
+                return False
+            if self._probing:
+                if now - self._probe_t0 < max(breaker_open_s(), 5.0):
+                    return False
+                # stale probe: its owner vanished without resolving —
+                # reclaim the slot rather than refusing forever
+            self._state = "half-open"
+            self._probing = True
+            self._probe_t0 = now
+            return True
+
+    def allow_insert(self) -> bool:
+        """The ingest-path gate: availability (allow) AND not inside a
+        429 Retry-After window.  The throttle is insert-only — parking
+        the shared breaker would fail SELECTS with 'node down' for the
+        whole window, which node_stream's 429 policy deliberately
+        avoids."""
+        with self._mu:
+            throttled = time.monotonic() < self._insert_throttle_until
+        return not throttled and self.allow()
+
+    def abandon_probe(self) -> None:
+        """Release a probe slot whose outcome will never be known (the
+        consumer closed the sub-query stream mid-probe).  No state
+        change, no failure accounting; a no-op when the attempt
+        already resolved via on_success/on_failure."""
+        with self._mu:
+            self._probing = False
+
+    # -- outcome accounting --
+    def on_success(self) -> None:
+        with self._mu:
+            self._probing = False
+            recovered = self._down_emitted
+            self._down_emitted = False
+            self._state = "closed"
+            self._consec = 0
+            self._open_until = 0.0
+        if recovered:
+            note("nodes_recovered")
+            events.emit("node_recovered", node=self.url)
+
+    def on_failure(self) -> None:
+        now = time.monotonic()
+        with self._mu:
+            was_half_open = self._state == "half-open"
+            self._probing = False
+            self._consec += 1
+            self._failures_total += 1
+            went_down = False
+            if was_half_open or self._consec >= breaker_failures():
+                self._state = "open"
+                self._open_until = now + breaker_open_s()
+                self._opened_total += 1
+                if not self._down_emitted:
+                    self._down_emitted = True
+                    went_down = True
+        if went_down:
+            note("nodes_down")
+            events.emit("node_down", node=self.url,
+                        consecutive_failures=self._consec)
+
+    def throttle(self, seconds: float) -> None:
+        """The node shed an INSERT (429): park the ingest path for its
+        advertised Retry-After without counting a failure, declaring
+        the node down, or touching the select path (allow() is
+        unaffected — see allow_insert)."""
+        now = time.monotonic()
+        with self._mu:
+            self._probing = False
+            self._insert_throttle_until = max(
+                self._insert_throttle_until, now + max(0.1, seconds))
+
+    # -- introspection --
+    def health(self) -> float:
+        """1.0 closed, 0.5 half-open (probe window), 0.0 open."""
+        now = time.monotonic()
+        with self._mu:
+            if self._state == "closed":
+                return 1.0
+            if now < self._open_until:
+                return 0.0
+            return 0.5
+
+    def state(self) -> str:
+        now = time.monotonic()
+        with self._mu:
+            if self._state != "closed" and now >= self._open_until:
+                return "half-open"
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"node": self.url, "state": self._state,
+                    "consecutive_failures": self._consec,
+                    "opened_total": self._opened_total,
+                    "failures_total": self._failures_total,
+                    "rtt_ewma_s": round(self._rtt_mean, 6)}
+
+    # -- hedging RTT estimate --
+    def observe_rtt(self, dt: float) -> None:
+        with self._mu:
+            if self._rtt_n == 0:
+                self._rtt_mean = dt
+                self._rtt_dev = dt / 2
+            else:
+                self._rtt_dev = (0.8 * self._rtt_dev
+                                 + 0.2 * abs(dt - self._rtt_mean))
+                self._rtt_mean = 0.8 * self._rtt_mean + 0.2 * dt
+            self._rtt_n += 1
+
+    def hedge_delay_s(self) -> float | None:
+        """Delay before re-issuing a straggler sub-query, or None when
+        hedging is off.  VL_NET_HEDGE_MS pins it (0 = off); otherwise
+        the EWMA estimate applies once >= 8 RTT samples exist."""
+        env = os.environ.get("VL_NET_HEDGE_MS", "")
+        if env:
+            try:
+                ms = float(env)
+            except ValueError:
+                return None
+            return None if ms <= 0 else ms / 1000.0
+        with self._mu:
+            if self._rtt_n < 8:
+                return None
+            est = self._rtt_mean + 4.0 * self._rtt_dev
+        return min(max(est, 0.05), 5.0)
+
+
+_breakers_mu = threading.Lock()
+_breakers: dict[str, CircuitBreaker] = {}
+
+
+def breaker_for(url: str) -> CircuitBreaker:
+    url = url.rstrip("/")
+    with _breakers_mu:
+        br = _breakers.get(url)
+        if br is None:
+            br = _breakers[url] = CircuitBreaker(url)
+        return br
+
+
+def breaker_snapshots() -> list[dict]:
+    with _breakers_mu:
+        brs = list(_breakers.values())
+    return [br.snapshot() for br in brs]
+
+
+def reset_for_tests() -> None:
+    """Drop every breaker and counter (process-global state; tests
+    that assert exact transitions/counts start clean)."""
+    with _breakers_mu:
+        _breakers.clear()
+    with _counts_mu:
+        _counts.clear()
+
+
+def metrics_samples() -> list:
+    """(base, labels, value) samples for server/app.py Metrics.render:
+    per-node health gauges + the retry/hedge/partial/spool counters."""
+    c = counters()
+    out = [
+        ("vl_net_retries_total", {}, c.get("retries", 0)),
+        ("vl_net_hedges_total", {"won": "true"}, c.get("hedges_won", 0)),
+        ("vl_net_hedges_total", {"won": "false"},
+         c.get("hedges_lost", 0)),
+        ("vl_partial_results_total", {}, c.get("partial_results", 0)),
+        ("vl_insert_spooled_blocks_total", {}, c.get("spooled_blocks", 0)),
+        ("vl_insert_replayed_blocks_total", {},
+         c.get("replayed_blocks", 0)),
+        ("vl_insert_spool_overflow_total", {},
+         c.get("spool_overflow", 0)),
+    ]
+    with _breakers_mu:
+        brs = list(_breakers.items())
+    for url, br in brs:
+        # vlint: allow-per-row-emit(metric samples, bounded by node count)
+        out.append(("vl_node_health", {"node": url}, br.health()))
+        snap = br.snapshot()
+        # vlint: allow-per-row-emit(metric samples, bounded by node count)
+        out.append(("vl_node_breaker_opens_total", {"node": url},
+                    snap["opened_total"]))
+    return out
+
+
+# ---------------- one-shot requests (ingest / vlagent) ----------------
+
+def request(url: str, path: str, body: bytes = b"", *,
+            timeout: float = 30.0, deadline: float | None = None,
+            headers: dict | None = None, method: str = "POST",
+            gate: bool = True) -> tuple[int, object, bytes]:
+    """One policy-managed HTTP exchange with a node: returns (status,
+    headers, body) for ANY complete HTTP response; raises NodeDownError
+    on circuit-open / refused / transport failure.  Breaker accounting
+    happens here (5xx = failure, 429 = throttle via Retry-After,
+    anything else = liveness success); callers classify the status.
+    ``gate=False`` skips the circuit check (vlagent owns its own retry
+    cadence) but still feeds the health state."""
+    url = url.rstrip("/")
+    br = breaker_for(url)
+    if gate and not br.allow_insert():
+        raise NodeDownError(f"{url}: node circuit open")
+    try:
+        mode = netfaults.maybe_fail_net(url)
+        if mode == "refuse":
+            br.on_failure()
+            raise NodeDownError(f"{url}: injected net fault: refuse")
+        if mode == "5xx":
+            br.on_failure()
+            return 503, {}, b"injected net fault: 5xx"
+        u = urllib.parse.urlsplit(url)
+        io_t = timeout
+        if deadline is not None:
+            io_t = min(io_t, max(deadline - time.monotonic(), 0.01))
+        try:
+            conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                              timeout=io_t)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                status = resp.status
+                rheaders = resp.headers
+                rbody = resp.read()
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            br.on_failure()
+            raise NodeDownError(
+                f"{url}: {type(e).__name__}: {e}") from None
+        if status >= 500:
+            br.on_failure()
+        elif status == 429:
+            br.throttle(retry_after_s(rheaders))
+            br.on_success()   # the node ANSWERED: alive, just shedding
+        else:
+            br.on_success()
+        return status, rheaders, rbody
+    finally:
+        # a probe slot reserved by allow_insert() must never leak on
+        # an unclassified exit path (no-op when already resolved)
+        br.abandon_probe()
+
+
+# ---------------- streaming sub-queries (select fan-out) ----------------
+
+class _AttemptReader:
+    """One HTTP attempt on its own thread: opens the connection, sends
+    the request, and feeds frame payloads through a bounded queue.
+    Events: ("frame", payload, wire_len) / ("end",) / ("http", status,
+    headers, body) / ("err", exc).  ``abort()`` closes the connection
+    from outside, which unblocks any pending socket read."""
+
+    __slots__ = ("url", "path", "body", "headers", "io_timeout",
+                 "deadline", "q", "t0", "conn", "_aborted")
+
+    def __init__(self, url: str, path: str, body: bytes, headers: dict,
+                 io_timeout: float, deadline: float | None):
+        self.url = url
+        self.path = path
+        self.body = body
+        self.headers = headers
+        self.io_timeout = io_timeout
+        self.deadline = deadline
+        self.q: queue.Queue = queue.Queue(maxsize=8)
+        self.t0 = time.monotonic()
+        self.conn = None
+        self._aborted = threading.Event()
+
+    def start(self) -> None:
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def abort(self) -> None:
+        """Stop the reader: close the connection (wakes a blocked
+        read) and drain the queue (wakes a blocked put)."""
+        self._aborted.set()
+        conn = self.conn
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def _put(self, item) -> bool:
+        while not self._aborted.is_set():
+            try:
+                self.q.put(item, timeout=0.25)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _read_timeout(self) -> float:
+        t = self.io_timeout
+        if self.deadline is not None:
+            t = min(t, max(self.deadline - time.monotonic(), 0.01))
+        return t
+
+    def _read_exact(self, resp, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            sock = self.conn.sock
+            if sock is not None:
+                # per-READ deadline: a node that hangs or trickles
+                # mid-frame times out at the query deadline, not at the
+                # transport timeout
+                sock.settimeout(self._read_timeout())
+            chunk = resp.read(n - len(buf))
+            if not chunk:
+                raise IOError("truncated frame stream")
+            buf += chunk
+        return buf
+
+    def _run(self) -> None:
+        try:
+            u = urllib.parse.urlsplit(self.url)
+            conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                              timeout=self._read_timeout())
+            self.conn = conn
+            if self._aborted.is_set():
+                conn.close()
+                return
+            conn.request("POST", self.path, body=self.body,
+                         headers=self.headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                self._put(("http", resp.status, resp.headers,
+                           resp.read(1 << 16)))
+                return
+            while True:
+                hdr = self._read_exact(resp, 4)
+                n = struct.unpack(">I", hdr)[0]
+                if n == 0:
+                    self._put(("end",))
+                    return
+                payload = self._read_exact(resp, n)
+                data = _zstd.decompress(payload,
+                                        max_output_size=1 << 30)
+                if not self._put(("frame", data, n + 4)):
+                    return
+        # vlint: allow-broad-except(reader thread error channel: the consumer re-raises)
+        except Exception as e:
+            if not self._aborted.is_set():
+                self._put(("err", e))
+
+
+def _race(url: str, path: str, body: bytes, headers: dict,
+          io_timeout: float, deadline: float | None,
+          br: CircuitBreaker, span, allow_hedge: bool):
+    """One attempt (plus an optional hedge to the same node): yields
+    (payload, wire_len) frames from whichever connection answers
+    first.  Raises NodeHTTPError / NodeDownError / the reader's
+    transport error; the caller owns breaker classification and
+    retries."""
+    readers: list[_AttemptReader] = []
+    try:
+        primary = _AttemptReader(url, path, body, headers, io_timeout,
+                                 deadline)
+        primary.start()
+        readers.append(primary)
+        hedge_delay = br.hedge_delay_s() if allow_hedge else None
+        hedge_at = None if hedge_delay is None else \
+            primary.t0 + hedge_delay
+        winner = None
+        first_ev = None
+        first_err = None
+        alive = [primary]
+        while winner is None:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                raise NodeDownError(
+                    f"{url}: deadline exceeded awaiting node response")
+            if hedge_at is not None and len(readers) == 1 and \
+                    now >= hedge_at and \
+                    (deadline is None or deadline - now > 0.05):
+                # the straggler case: re-issue to the SAME node and
+                # race the two connections to the first frame
+                h = _AttemptReader(url, path, body, headers,
+                                   io_timeout, deadline)
+                h.start()
+                readers.append(h)
+                alive.append(h)
+                span.set("hedged", True)
+            if not alive:
+                raise first_err if first_err is not None else \
+                    NodeDownError(f"{url}: no reply")
+            wait = 0.25
+            if deadline is not None:
+                wait = min(wait, max(deadline - now, 0.001))
+            if hedge_at is not None and len(readers) == 1:
+                wait = min(wait, max(hedge_at - now, 0.001))
+            if len(alive) == 1:
+                try:
+                    ev = alive[0].q.get(timeout=wait)
+                except queue.Empty:
+                    continue
+                r = alive[0]
+            else:
+                # two live connections: poll both
+                r = None
+                for cand in list(alive):
+                    try:
+                        ev = cand.q.get_nowait()
+                        r = cand
+                        break
+                    except queue.Empty:
+                        continue
+                if r is None:
+                    time.sleep(0.005)
+                    continue
+            if ev[0] == "err":
+                alive.remove(r)
+                if first_err is None:
+                    first_err = ev[1]
+                continue
+            winner = r
+            first_ev = ev
+        if len(readers) > 1:
+            note("hedges_won" if winner is not readers[0]
+                 else "hedges_lost")
+            span.set("hedge_won", winner is not readers[0])
+        for r in readers:
+            if r is not winner:
+                r.abort()
+        ev = first_ev
+        first_frame = True
+        while True:
+            kind = ev[0]
+            if kind == "http":
+                raise NodeHTTPError(url, ev[1], ev[2], ev[3])
+            if kind == "end":
+                if first_frame:
+                    br.observe_rtt(time.monotonic() - winner.t0)
+                br.on_success()
+                return
+            if kind == "err":
+                raise ev[1]
+            if first_frame:
+                dt = time.monotonic() - winner.t0
+                br.observe_rtt(dt)
+                hist.NET_FIRST_FRAME.observe(dt)
+                first_frame = False
+            yield (ev[1], ev[2])
+            while True:
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    raise NodeDownError(
+                        f"{url}: deadline exceeded mid-stream")
+                wait = 0.25
+                if deadline is not None:
+                    wait = min(wait, max(deadline - now, 0.001))
+                try:
+                    ev = winner.q.get(timeout=wait)
+                    break
+                except queue.Empty:
+                    continue
+    finally:
+        for r in readers:
+            r.abort()
+
+
+def node_stream(url: str, path: str, body: bytes,
+                headers: dict | None = None, *,
+                io_timeout: float = 120.0,
+                deadline: float | None = None,
+                retries: int | None = None, idempotent: bool = True,
+                hedge: bool = True, span=None):
+    """Generator of (decompressed frame payload, wire length) from one
+    node sub-query, with the full fault policy applied: circuit
+    breaker, injected faults, jittered-backoff retries (idempotent
+    requests, only before the first delivered frame, never past the
+    deadline), hedging, per-read deadlines.  See the module
+    docstring."""
+    url = url.rstrip("/")
+    if span is None:
+        span = tracing.current_span()
+    br = breaker_for(url)
+    max_extra = net_retries() if retries is None else max(0, retries)
+    attempt_no = 0
+    backoff = _RETRY_BACKOFF_BASE_S
+    delivered = False
+    while True:
+        attempt_no += 1
+        span.set("net_attempts", attempt_no)
+        if not br.allow():
+            raise NodeDownError(f"{url}: node circuit open")
+        err: Exception
+        try:
+            mode = netfaults.maybe_fail_net(url)
+            if mode == "refuse":
+                raise netfaults.InjectedNetFault(
+                    f"{url}: injected net fault: refuse")
+            if mode == "5xx":
+                raise NodeHTTPError(url, 503, {},
+                                    b"injected net fault: 5xx")
+            for item in _race(url, path, body, headers or {},
+                              io_timeout, deadline, br, span,
+                              hedge and idempotent):
+                delivered = True
+                yield item
+            return
+        except NodeHTTPError as e:
+            if e.status < 500:
+                # the node ANSWERED: it is alive.  A 429 surfaces as a
+                # shed (the frontend's 429 + Retry-After contract owns
+                # the backoff — parking the breaker here would turn an
+                # overload blip into fail-fast "node down" errors for
+                # every later query); other 4xx mean the sub-query
+                # itself is bad.  Neither retries, neither breaks.
+                br.on_success()
+                raise
+            br.on_failure()
+            err = NodeDownError(str(e))
+        except (OSError, http.client.HTTPException) as e:
+            br.on_failure()
+            err = e if isinstance(e, NodeDownError) else \
+                NodeDownError(f"{url}: {type(e).__name__}: {e}")
+        finally:
+            # GeneratorExit (consumer stopped pulling: early-done,
+            # cancel, a sibling node failing in strict mode) and any
+            # exception outside the classified set would otherwise
+            # leave a half-open probe reserved forever — release it
+            # (no-op when the attempt resolved via on_success/
+            # on_failure above)
+            br.abandon_probe()
+        if delivered or not idempotent or attempt_no > max_extra:
+            raise err
+        delay = backoff * (0.5 + random.random())
+        if deadline is not None and \
+                time.monotonic() + delay + _RETRY_FLOOR_S >= deadline:
+            raise err
+        note("retries")
+        span.add("net_retries")
+        time.sleep(delay)
+        backoff = min(backoff * 2, _RETRY_BACKOFF_MAX_S)

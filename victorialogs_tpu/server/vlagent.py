@@ -15,19 +15,17 @@ from __future__ import annotations
 
 import argparse
 import hashlib
-import http.client
 import json
 import os
 import signal
 import sys
 import threading
 import time
-import urllib.error
-import urllib.request
 
 from ..storage.log_rows import LogRows
 from ..utils import zstd as _zstd
 from ..utils.persistentqueue import PersistentQueue
+from . import netrobust
 from .cluster import PROTOCOL_VERSION
 from .insertutil import LogRowsStorage
 
@@ -111,20 +109,21 @@ class RemoteWriteClient:
 
     def _send(self, body: bytes) -> tuple[bool, float | None]:
         """(delivered, retry_hint_s) — the hint is non-None only for an
-        explicit overload shed (HTTP 429)."""
-        req = urllib.request.Request(
-            f"{self.url}/internal/insert?version={PROTOCOL_VERSION}",
-            data=body, method="POST")
-        req.add_header("Content-Type", "application/octet-stream")
+        explicit overload shed (HTTP 429).  Rides the shared fault-
+        policy layer with ``gate=False``: the agent's own backoff
+        ladder owns the retry cadence (the queue IS the retry buffer),
+        but deliveries still feed the per-node breaker/health state."""
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return 200 <= resp.status < 300, None
-        except urllib.error.HTTPError as e:
-            if e.code == 429:
-                return False, self._shed_hint(e.headers)
+            status, headers, _rbody = netrobust.request(
+                self.url,
+                f"/internal/insert?version={PROTOCOL_VERSION}", body,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=self.timeout, gate=False)
+        except (IOError, OSError):
             return False, None
-        except (OSError, http.client.HTTPException):
-            return False, None
+        if status == 429:
+            return False, self._shed_hint(headers)
+        return 200 <= status < 300, None
 
     def close(self) -> None:
         self._stop.set()

@@ -150,14 +150,25 @@ def _trace_root(args, q: Query):
     return None
 
 
+def _partial_block(act) -> dict | None:
+    """The ``"partial"`` payload block when the cluster scatter-gather
+    degraded to surviving nodes (cluster.py stamps the record); None on
+    a complete answer."""
+    failed = act.counter("partial_failed_nodes")
+    if failed:
+        return {"failed_nodes": list(failed)}
+    return None
+
+
 def _run_collect_traced(storage, tenants, q, args, runner, endpoint,
                         collect=run_query_collect):
     """A collect entry point (run_query_collect or its columnar twin
     run_query_collect_columns) under an optional trace and an
-    active-query registry record; returns (result, tree) where tree is
-    the span-tree dict only when the request asked for it.  Emits the
-    slow-query line either way, with the qid correlating it to
-    active_queries/traces."""
+    active-query registry record; returns (result, tree, partial)
+    where tree is the span-tree dict only when the request asked for
+    it and partial is the ``"partial"`` payload block (or None).
+    Emits the slow-query line either way, with the qid correlating it
+    to active_queries/traces."""
     root = _trace_root(args, q)
     t0 = time.monotonic()
     # reuse the record the admission layer registered (server/app.py);
@@ -179,9 +190,10 @@ def _run_collect_traced(storage, tenants, q, args, runner, endpoint,
             # slow-log line
             slowlog.maybe_log(endpoint, q.to_string(),
                               time.monotonic() - t0, root, qid=act.qid)
+        partial = _partial_block(act)
     tree = root.to_dict() if root is not None and want_trace(args) \
         else None
-    return result, tree
+    return result, tree, partial
 
 
 # ---------------- ?explain=1 / ?explain=analyze ----------------
@@ -312,6 +324,15 @@ def handle_query(storage, args, headers, runner=None):
                 slowlog.maybe_log("/select/logsql/query", q.to_string(),
                                   time.monotonic() - t0, root,
                                   qid=act.qid)
+            partial = _partial_block(act)
+            if partial is not None:
+                # row lines stay bit-identical to a complete answer;
+                # ONE extra final line marks the degradation (the
+                # X-VL-Partial header additionally covers every case
+                # where the node loss preceded the first output chunk)
+                yield json.dumps({"_partial": partial},
+                                 ensure_ascii=False,
+                                 separators=(",", ":")) + "\n"
             if root is not None and want_trace(args):
                 yield json.dumps({"_trace": root.to_dict()},
                                  ensure_ascii=False,
@@ -383,7 +404,7 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
     # columnar collect: the stats output arrives as bulk columns (one
     # contract for local and cluster paths) — group rows are zipped
     # from the lists, never materialized as dicts
-    (cols, n), trace_tree = _run_collect_traced(
+    (cols, n), trace_tree, partial = _run_collect_traced(
         storage, tenants, q, args, runner, "/select/logsql/hits",
         collect=run_query_collect_columns)
     tcol = cols.get("_time") or [""] * n
@@ -400,6 +421,8 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
         g["total"] += hits
     out = {"hits": sorted(groups.values(),
                           key=lambda g: -g["total"])}
+    if partial is not None:
+        out["partial"] = partial
     if trace_tree is not None:
         out["trace"] = trace_tree
     return out
@@ -410,7 +433,7 @@ def handle_hits(storage, args, headers, runner=None) -> dict:
 def handle_facets(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     _facets_pipes(q, args)
-    (cols, n), trace_tree = _run_collect_traced(
+    (cols, n), trace_tree, partial = _run_collect_traced(
         storage, tenants, q, args, runner, "/select/logsql/facets",
         collect=run_query_collect_columns)
     out: dict[str, list] = {}
@@ -423,6 +446,8 @@ def handle_facets(storage, args, headers, runner=None) -> dict:
     # vlint: allow-per-row-emit(facet OUTPUT: one dict per faceted field)
     res = {"facets": [{"field_name": f, "values": v}
                       for f, v in sorted(out.items())]}
+    if partial is not None:
+        res["partial"] = partial
     if trace_tree is not None:
         res["trace"] = trace_tree
     return res
@@ -509,7 +534,7 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     sp = _require_stats_query(q)
     ts = _parse_time_arg(args.get("time", ""), time.time_ns(), end=True)
-    (cols, nrows), trace_tree = _run_collect_traced(
+    (cols, nrows), trace_tree, partial = _run_collect_traced(
         storage, tenants, q, args, runner, "/select/logsql/stats_query",
         collect=run_query_collect_columns)
     result = []
@@ -528,6 +553,8 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
                            "value": [ts / 1e9, vc[i]]})
     out = {"status": "success",
            "data": {"resultType": "vector", "result": result}}
+    if partial is not None:
+        out["partial"] = partial
     if trace_tree is not None:
         out["trace"] = trace_tree
     return out
@@ -536,7 +563,7 @@ def handle_stats_query(storage, args, headers, runner=None) -> dict:
 def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
     q, tenants = parse_common_args(storage, args, headers)
     sp = _stats_range_pipes(q, args)
-    (cols, nrows), trace_tree = _run_collect_traced(
+    (cols, nrows), trace_tree, partial = _run_collect_traced(
         storage, tenants, q, args, runner,
         "/select/logsql/stats_query_range",
         collect=run_query_collect_columns)
@@ -563,6 +590,8 @@ def handle_stats_query_range(storage, args, headers, runner=None) -> dict:
     out = {"status": "success",
            "data": {"resultType": "matrix",
                     "result": list(series.values())}}
+    if partial is not None:
+        out["partial"] = partial
     if trace_tree is not None:
         out["trace"] = trace_tree
     return out

@@ -19,6 +19,13 @@ SEGMENT_MAX_BYTES = 64 << 20
 READER_STATE = "reader.json"
 
 
+class QueueOverflowError(IOError):
+    """append() would exceed max_pending_bytes: the backlog bound hit.
+    An IOError for backward compatibility; callers that must react to
+    overflow specifically (the cluster ingest spool's counted-and-
+    journaled drop path) catch this type."""
+
+
 class PersistentQueue:
     def __init__(self, path: str, max_pending_bytes: int = 1 << 30):
         self.path = path
@@ -89,7 +96,7 @@ class PersistentQueue:
         rec = struct.pack(">I", len(data)) + data
         with self._lock:
             if self._pending + len(rec) > self.max_pending_bytes:
-                raise IOError("persistent queue overflow")
+                raise QueueOverflowError("persistent queue overflow")
             if self._writer.tell() >= SEGMENT_MAX_BYTES:
                 self._writer.flush()
                 os.fsync(self._writer.fileno())
