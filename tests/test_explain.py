@@ -35,6 +35,18 @@ T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
 TEN = TenantID(0, 0)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _pin_filter_index_v2():
+    """Several pins below (xor_aggregate/maplet kill reasons, exact
+    maplet-priced rows_scanned) require the v2 sidecar path; an
+    ambient VL_FILTER_INDEX=v1 would silently flip them."""
+    import os
+    old = os.environ.pop("VL_FILTER_INDEX", None)
+    yield
+    if old is not None:
+        os.environ["VL_FILTER_INDEX"] = old
+
+
 @pytest.fixture(scope="module")
 def runner():
     return BatchRunner()
@@ -188,7 +200,11 @@ def test_explain_plan_zero_dispatch_zero_block_reads(server, runner,
     # "beta" parts die on the aggregate bloom for token "alpha"
     assert pred["parts_retained"] == 3
     assert pred["parts_killed"] == 3
-    assert pred["rows_scanned"] == 1200
+    # sealed parts carry a v2 filter index: the maplet prices the
+    # EXACT candidate blocks — only the app0 stream blocks contain
+    # both "alpha" and "error" (g % 3 == 0 rows), 400 rows across the
+    # three retained parts, not the 1200-row whole-part estimate
+    assert pred["rows_scanned"] == 400
     assert pred["bytes_scanned"] > 0
     assert pred["dispatches"] >= 1
     assert pred["duration_s"] > 0
@@ -203,13 +219,29 @@ def test_explain_kill_reasons(server):
     retained = [p for p in parts if p["status"] == "retained"]
     assert len(retained) == 3 and len(killed) == 3
     for p in killed:
-        assert p["reason"] == "aggregate_bloom"
+        # sealed v2 parts kill on the xor-filter aggregate and say so
+        assert p["reason"] == "xor_aggregate"
+        assert p["killed_by"]["artifact"] == "xor_aggregate"
         assert p["killed_by"]["field"] == "_msg"
         assert "alpha" in p["killed_by"]["tokens"]
         assert "alpha" in p["killed_by"]["filter"]
     for p in retained:
         assert p["blocks_candidate"] > 0
         assert p["rows_candidate"] > 0
+
+    # tokens that coexist in a part but never in one BLOCK: the xor
+    # aggregate cannot kill (both tokens are in the part), the maplet
+    # intersection can — and the kill cites it.  u0 lives in part 0's
+    # app0 block (g=0), u100 in its app1 block (g=100); the beta/alpha
+    # parts without either token still die on the xor aggregate.
+    tree = _explain(server, "u0 u100 | fields _time")
+    parts = [p for pt in tree["partitions"] for p in pt["parts"]]
+    reasons = sorted(p["reason"] for p in parts if p["status"] == "killed")
+    assert "maplet" in reasons, reasons
+    mk = [p for p in parts if p["reason"] == "maplet"]
+    assert all(p["killed_by"]["artifact"] == "maplet" for p in mk)
+    assert tree["predicted"]["parts_retained"] == 0
+    assert tree["predicted"]["rows_scanned"] == 0
 
     # a time range past the data kills every part with reason
     # time_range before any header group decodes

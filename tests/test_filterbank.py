@@ -298,14 +298,19 @@ E2E_QUERIES = [
 ]
 
 
-def test_plane_and_aggregate_e2e_parity(storage):
+def test_plane_and_aggregate_e2e_parity(storage, monkeypatch):
     """CPU vs batched runner over queries where bloom kills some (or
     all) blocks of the part: bit-identical results, the plane probe ran
     on the batch path, the fused path emitted the in-dispatch bloom
-    node, and the absent-token query pruned the part outright."""
+    node, and the absent-token query pruned the part outright.
+
+    Pinned to VL_FILTER_INDEX=v1: this suite is the CLASSIC-path
+    differential (the kill-switch contract); the v2 sidecar path has
+    its own e2e pins in tests/test_filterindex.py."""
     from victorialogs_tpu.engine.searcher import run_query_collect
     from victorialogs_tpu.storage.log_rows import TenantID
     from victorialogs_tpu.tpu.batch import BatchRunner
+    monkeypatch.setenv("VL_FILTER_INDEX", "v1")
     ten = TenantID(0, 0)
     runner = BatchRunner()
     for q in E2E_QUERIES:
@@ -323,6 +328,7 @@ def test_device_bloom_disabled_still_identical(storage, monkeypatch):
     from victorialogs_tpu.storage.log_rows import TenantID
     from victorialogs_tpu.tpu.batch import BatchRunner
     monkeypatch.setenv("VL_DEVICE_BLOOM", "0")
+    monkeypatch.setenv("VL_FILTER_INDEX", "v1")
     ten = TenantID(0, 0)
     runner = BatchRunner()
     for q in E2E_QUERIES:

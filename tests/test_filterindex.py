@@ -1,0 +1,460 @@
+"""Sealed-part filter index v2 (storage/filterindex/).
+
+The acceptance contract:
+
+- ZERO false negatives, differential v2-vs-v1 over >=1000 randomized
+  (block, tokenset) pairs: any block the classic bloom path keeps AND
+  that truly contains the tokens must survive every v2 artifact, and
+  the v2 keep set is a subset of v1's (the maplet is exact);
+- measured false-positive bounds for the split-block parameters and
+  the xor aggregate;
+- corrupted/truncated sidecars (bytes flipped at EVERY header field)
+  fall back to the classic path with bit-identical results;
+- VL_FILTER_INDEX=v1 kill-switch parity, and e2e CPU-vs-device
+  hit-set identity with v2 on and off.
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.storage import filterbank as FB
+from victorialogs_tpu.storage import filterindex as FI
+from victorialogs_tpu.storage.bloom import bloom_build, bloom_contains_all
+from victorialogs_tpu.storage.filterindex import sidecar as SC
+from victorialogs_tpu.storage.filterindex.maplet import maplet_build
+from victorialogs_tpu.storage.filterindex.sbbloom import (
+    sb_build, sb_contains_all, sb_token_masks)
+from victorialogs_tpu.storage.filterindex.xorfilter import xor_build
+from victorialogs_tpu.utils.hashing import hash_tokens
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _pin_filter_index_v2():
+    """The whole suite exercises the v2 path; an ambient
+    VL_FILTER_INDEX=v1 would disable builds AND loads."""
+    old = os.environ.pop("VL_FILTER_INDEX", None)
+    yield
+    if old is not None:
+        os.environ["VL_FILTER_INDEX"] = old
+
+
+# ---------------- randomized differential: zero false negatives ----
+
+def _rand_blocks(rng, nblocks, universe):
+    """[(tokens set | None, hashes | None, v1 words | None)]"""
+    out = []
+    for _ in range(nblocks):
+        r = rng.random()
+        if r < 0.12:
+            out.append((None, None, None))       # no token coverage
+            continue
+        n = 1 if r < 0.25 else int(rng.integers(1, 300))
+        toks = list(rng.choice(universe, size=n, replace=False))
+        h = hash_tokens(toks)
+        out.append((set(toks), h, bloom_build(h)))
+    return out
+
+
+def test_differential_v2_vs_v1_1000_pairs():
+    """>=1000 (block, tokenset) pairs: v2 maplet keep ⊆ v1 bloom keep,
+    and both keep every block that truly contains all tokens.  The
+    split-block filter and the xor aggregate are checked for zero
+    false negatives on the same corpus."""
+    rng = np.random.default_rng(42)
+    universe = [f"tok{i}" for i in range(4000)]
+    pairs = 0
+    for _part in range(12):
+        nblocks = int(rng.integers(1, 50))
+        blocks = _rand_blocks(rng, nblocks, universe)
+        mp = maplet_build(
+            [(bi, h) for bi, (_t, h, _w) in enumerate(blocks)],
+            nblocks)
+        sbs = [None if h is None else sb_build(h)
+               for _t, h, _w in blocks]
+        all_h = [h for _t, h, _w in blocks if h is not None and len(h)]
+        xf = xor_build(np.concatenate(all_h)) if all_h else None
+        for _q in range(12):
+            t = int(rng.integers(0, 4))
+            if t and rng.random() < 0.5:
+                qt = list(rng.choice(universe, size=t, replace=False))
+            elif t:
+                qt = [f"absent{rng.integers(1 << 30)}" for _ in range(t)]
+            else:
+                qt = []
+            hashes = hash_tokens(qt)
+            v2 = mp.keep_mask(hashes)
+            for bi, (toks, h, words) in enumerate(blocks):
+                truth = toks is None or all(x in toks for x in qt)
+                v1 = words is None or bloom_contains_all(words, hashes)
+                # soundness: the truth always survives both paths
+                if truth:
+                    assert v1, (bi, qt)
+                    assert v2[bi], (bi, qt)
+                # exactness: v2 never keeps what v1 kills
+                if not v1:
+                    assert not v2[bi], (bi, qt)
+                # maplet == ground truth on covered blocks
+                if toks is not None and qt:
+                    assert bool(v2[bi]) == truth, (bi, qt)
+                # split-block filter: zero false negatives
+                if toks is not None and truth and qt:
+                    assert sb_contains_all(sbs[bi], hashes)
+                pairs += 1
+            # xor aggregate: may only kill when some token is truly
+            # absent from every covered block AND all blocks covered
+            if xf is not None and qt and \
+                    all(t0 is not None for t0, _h, _w in blocks):
+                part_truth = any(
+                    all(x in t0 for x in qt)
+                    for t0, _h, _w in blocks)
+                if part_truth:
+                    assert bool(xf.contains(hashes).all())
+    assert pairs >= 1000, pairs
+
+
+def test_sb_false_positive_rate_measured():
+    """Split-block params (16 bits/token, 6 probes in one 256-bit
+    block): the Poisson block-loading variance costs some fp rate vs
+    the classic spread — bound it at 1% (theory ~0.1-0.4%)."""
+    rng = np.random.default_rng(7)
+    for ntokens in (50, 500, 4000):
+        member = [f"m{i}" for i in range(ntokens)]
+        lanes = sb_build(hash_tokens(member))
+        absent = hash_tokens([f"a{i}" for i in range(20000)])
+        masks = sb_token_masks(absent)
+        from victorialogs_tpu.storage.filterindex.sbbloom import \
+            sb_block_select
+        m = lanes.shape[0] // 8
+        base = sb_block_select(absent, m) * 8
+        words = lanes[base[:, None] + np.arange(8)]
+        fp = ((words & masks) == masks).all(axis=1)
+        rate = fp.mean()
+        assert rate < 1e-2, (ntokens, rate)
+        # spot-agree with the scalar oracle on both outcomes
+        sample = list(rng.choice(20000, size=100, replace=False))
+        sample += list(np.nonzero(fp)[0][:10])
+        for i in sample:
+            assert bool(fp[i]) == sb_contains_all(lanes,
+                                                  absent[i:i + 1])
+
+
+def test_xor_filter_exact_membership_and_fp():
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1 << 63, size=50000, dtype=np.uint64)
+    xf = xor_build(keys)
+    assert xf is not None
+    assert bool(xf.contains(keys).all()), "xor false negative"
+    absent = rng.integers(0, 1 << 63, size=100000, dtype=np.uint64)
+    absent = np.setdiff1d(absent, keys)
+    rate = xf.contains(absent).mean()
+    assert rate < 2e-2, rate               # theory 1/256 ~= 0.0039
+    # the bits/key that buys the <=0.7x aggregate acceptance
+    bpk = xf.bits_per_key(len(np.unique(keys)))
+    assert bpk <= 0.7 * 16, bpk
+
+
+def test_sb_device_probe_matches_host():
+    """jnp split-block probe == numpy probe bit-for-bit on the packed
+    plane layout (the same parity contract the classic plane has)."""
+    from victorialogs_tpu.tpu.bloom_device import (probe_np_sb,
+                                                   sb_plane_probe)
+    rng = np.random.default_rng(11)
+    universe = [f"tok{i}" for i in range(3000)]
+    blocks = _rand_blocks(rng, 37, universe)
+    builder = SC.SidecarBuilder()
+    for bi, (_t, h, _w) in enumerate(blocks):
+        if h is not None:
+            builder.add(bi, "f", h)
+    cols = builder.build(37)
+    c = cols["f"]
+    mmax = int(c.nsb.max())
+    plane = np.zeros((37, 8 * mmax), dtype=np.uint32)
+    off = c.lane_offsets()
+    for bi in np.nonzero(c.nsb)[0]:
+        n = int(c.nsb[bi]) * 8
+        plane[bi, :n] = c.lanes[off[bi]:off[bi] + n]
+    from victorialogs_tpu.storage.filterindex.sbbloom import \
+        sb_block_select
+    checked = 0
+    for t in (1, 2, 5):
+        qt = list(rng.choice(universe, size=t, replace=False))
+        hashes = hash_tokens(qt)
+        nsb = c.nsb.astype(np.uint64)
+        from victorialogs_tpu.utils.hashing import splitmix64_np
+        from victorialogs_tpu.storage.filterindex.sbbloom import \
+            _SB_SELECT_SALT
+        r = splitmix64_np(hashes ^ _SB_SELECT_SALT) >> np.uint64(32)
+        sbidx = (((r[None, :] * nsb[:, None]) >> np.uint64(32))
+                 * np.uint64(8)).astype(np.int32)
+        mask = sb_token_masks(hashes)
+        want = probe_np_sb(plane, sbidx, mask, c.nsb)
+        got = np.asarray(sb_plane_probe(plane, sbidx, mask, c.nsb))
+        assert np.array_equal(got, want)
+        # and the host probe agrees with the per-block oracle
+        for bi, (_t0, h, _w) in enumerate(blocks):
+            if h is None:
+                assert want[bi]
+            else:
+                lanes = c.lanes[off[bi]:off[bi] + int(c.nsb[bi]) * 8]
+                assert bool(want[bi]) == sb_contains_all(
+                    np.ascontiguousarray(lanes), hashes)
+        checked += 1
+    assert checked
+
+
+# ---------------- sidecar verification / fallback ----------------
+
+def _mk_part_dir(tmp_path, nrows=600, name="part_0"):
+    from victorialogs_tpu.storage.block import build_blocks
+    from victorialogs_tpu.storage.log_rows import StreamID, TenantID
+    from victorialogs_tpu.storage.part import Part, write_part
+    sid = StreamID(TenantID(0, 0), 1, 2)
+    rows = [[("_msg", f"needle{i % 7} filler w{i}")]
+            for i in range(nrows)]
+    ts = np.arange(nrows, dtype=np.int64) + T0
+    blocks = build_blocks(sid, ts, rows, max_rows=100)
+    p = os.path.join(str(tmp_path), name)
+    stats = write_part(p, blocks)
+    assert stats is not None and stats["file_bytes"] > 0
+    return p, Part(p)
+
+
+def test_corrupted_sidecar_falls_back_every_header_field(tmp_path):
+    """Flip bytes at every header field offset (magic x8, version,
+    nblocks, hdrlen, crc, JSON header, payload) and truncate: the
+    loader must reject each mutant, serve identical keep-masks via the
+    classic path, and never raise."""
+    p, part = _mk_part_dir(tmp_path)
+    fi = FI.part_index(part)
+    assert fi is not None
+    hashes = hash_tokens(["needle3"])
+    want = FB.bloom_keep_mask(part, "_msg", hashes, observe=False)
+
+    sc_path = os.path.join(p, SC.FILTERINDEX_FILENAME)
+    blob = bytearray(open(sc_path, "rb").read())
+    # every header field: 8 magic bytes, then the 3 u32s, the crc,
+    # a byte inside the JSON header and one inside the payload
+    offsets = list(range(8)) + [8, 12, 16, 20, 24, len(blob) - 1]
+    for off in offsets:
+        mutant = bytearray(blob)
+        mutant[off] ^= 0xFF
+        with open(sc_path, "wb") as f:
+            f.write(mutant)
+        from victorialogs_tpu.storage.part import Part
+        part2 = Part(p)
+        assert FI.part_index(part2) is None, f"offset {off} accepted"
+        got = FB.bloom_keep_mask(part2, "_msg", hashes, observe=False)
+        assert np.array_equal(got, want), f"offset {off}"
+    # truncations: mid-header and mid-payload
+    for cut in (4, 14, 30, len(blob) // 2, len(blob) - 3):
+        with open(sc_path, "wb") as f:
+            f.write(blob[:cut])
+        from victorialogs_tpu.storage.part import Part
+        part3 = Part(p)
+        assert FI.part_index(part3) is None, f"cut {cut} accepted"
+        got = FB.bloom_keep_mask(part3, "_msg", hashes, observe=False)
+        assert np.array_equal(got, want), f"cut {cut}"
+    # restore: a pristine sidecar loads again
+    with open(sc_path, "wb") as f:
+        f.write(blob)
+    from victorialogs_tpu.storage.part import Part
+    assert FI.part_index(Part(p)) is not None
+
+
+def test_kill_switch_v1_pins_classic_path(tmp_path, monkeypatch):
+    p, part = _mk_part_dir(tmp_path, name="part_ks")
+    assert FI.part_index(part) is not None
+    hashes = hash_tokens(["needle3"])
+    v2 = FB.bloom_keep_mask(part, "_msg", hashes, observe=False)
+    monkeypatch.setenv("VL_FILTER_INDEX", "v1")
+    from victorialogs_tpu.storage.part import Part
+    part_v1 = Part(p)
+    assert FI.part_index(part_v1) is None
+    v1 = FB.bloom_keep_mask(part_v1, "_msg", hashes, observe=False)
+    # identical keep decisions on this corpus (needle3 is in every
+    # 7th row: blocks of 100 rows all contain it)
+    assert np.array_equal(v1, v2)
+    # v1 also pins the BUILD off: a part written under the switch has
+    # no sidecar at all
+    from victorialogs_tpu.storage.block import build_blocks
+    from victorialogs_tpu.storage.log_rows import StreamID, TenantID
+    from victorialogs_tpu.storage.part import write_part
+    sid = StreamID(TenantID(0, 0), 1, 2)
+    ts = np.arange(10, dtype=np.int64) + T0
+    blocks = build_blocks(sid, ts, [[("_msg", f"x{i}")]
+                                    for i in range(10)])
+    p2 = os.path.join(str(tmp_path), "part_nosc")
+    assert write_part(p2, blocks) is None
+    assert not os.path.exists(os.path.join(p2, SC.FILTERINDEX_FILENAME))
+
+
+def test_budget_declined_sidecar_serves_classic(tmp_path, monkeypatch):
+    """A sidecar that does not fit the bloom-bank budget is declined
+    (no second unbounded cache) and the classic path serves."""
+    p, part = _mk_part_dir(tmp_path, name="part_budget")
+    monkeypatch.setattr(FB, "_BANK_MAX_BYTES", 1)
+    from victorialogs_tpu.storage.part import Part
+    part2 = Part(p)
+    assert FI.part_index(part2) is None
+    hashes = hash_tokens(["needle3"])
+    got = FB.bloom_keep_mask(part2, "_msg", hashes, observe=False)
+    assert got.shape[0] == part2.num_blocks
+
+
+def test_budget_charge_released_at_part_gc(tmp_path):
+    import gc
+    p, part = _mk_part_dir(tmp_path, name="part_gc")
+    before = FB.bank_stats()["used_bytes"]
+    fi = FI.part_index(part)
+    assert fi is not None
+    during = FB.bank_stats()["used_bytes"]
+    assert during >= before + fi.nbytes
+    part.close()
+    del part, fi
+    gc.collect()
+    after = FB.bank_stats()["used_bytes"]
+    assert after <= during - 1, (before, during, after)
+
+
+# ---------------- e2e: CPU vs device, v2 on and off ----------------
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    random.seed(99)
+    s = Storage(str(tmp_path_factory.mktemp("fistore")),
+                retention_days=100000, flush_interval=3600)
+    lr = LogRows(stream_fields=["app"])
+    for i in range(6000):
+        app = f"app{i % 3}"
+        tok = ["zebra", "yak", "xylo"][i % 3]
+        msg = f"{tok} common u{i % 11} row{i}"
+        lr.add(TenantID(0, 0), T0 + i * NS,
+               [("app", app), ("_msg", msg)])
+    s.must_add_rows(lr)
+    s.debug_flush()
+    yield s
+    s.close()
+
+
+E2E_QUERIES = [
+    "zebra | fields _time",
+    "zebra common | fields _time",
+    "zebra or yak | stats count() c",
+    "zebra u5 | fields _time",
+    "absenttoken | fields _time",
+    "absenttoken | stats count() c",
+    "zebra yak | stats count() c",     # coexist in part, never a block
+    "common | stats by (app) count() c",
+]
+
+
+def test_e2e_cpu_device_hit_identity_v2(storage):
+    """v2 on: CPU and device walks return bit-identical hit sets, the
+    maplet served probes and exact-killed blocks pre-dispatch, the
+    device consumed the split-block layout, and the xor aggregate
+    killed the absent-token parts."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.storage.log_rows import TenantID
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    ten = TenantID(0, 0)
+    runner = BatchRunner()
+    for q in E2E_QUERIES:
+        cpu = run_query_collect(storage, [ten], q, timestamp=T0)
+        dev = run_query_collect(storage, [ten], q, timestamp=T0,
+                                runner=runner)
+        assert cpu == dev, q
+    assert runner.maplet_probes >= 1
+    assert runner.maplet_pruned_blocks >= 1
+    assert runner.agg_pruned_parts >= 2     # both absent-token queries
+    assert "bloom_sb_device" in runner.dispatch_kinds
+
+
+def test_e2e_v2_off_identical_results(storage, monkeypatch):
+    """VL_FILTER_INDEX=v1 returns bit-identical hit sets for the same
+    queries over the same (sidecar-carrying) parts."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.storage.log_rows import TenantID
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    ten = TenantID(0, 0)
+    v2 = {q: run_query_collect(storage, [ten], q, timestamp=T0)
+          for q in E2E_QUERIES}
+    monkeypatch.setenv("VL_FILTER_INDEX", "v1")
+    runner = BatchRunner()
+    for q in E2E_QUERIES:
+        cpu = run_query_collect(storage, [ten], q, timestamp=T0)
+        dev = run_query_collect(storage, [ten], q, timestamp=T0,
+                                runner=runner)
+        assert cpu == v2[q], q
+        assert dev == v2[q], q
+    assert runner.maplet_probes == 0
+    assert "bloom_sb_device" not in runner.dispatch_kinds
+
+
+def test_filter_index_built_journal_event(tmp_path):
+    """The seal emits filter_index_built with bits/key + bytes."""
+    from victorialogs_tpu.obs import events
+    from victorialogs_tpu.storage.datadb import DataDB
+    from victorialogs_tpu.storage.block import build_blocks
+    from victorialogs_tpu.storage.log_rows import StreamID, TenantID
+    got = []
+
+    def sub(_ts_ns, event, fields):
+        if event == "filter_index_built":
+            got.append(fields)
+    events.subscribe(sub)
+    try:
+        ddb = DataDB(str(tmp_path / "ddb"), flush_interval=3600)
+        sid = StreamID(TenantID(0, 0), 1, 2)
+        ts = np.arange(50, dtype=np.int64) + T0
+        ddb.must_add_blocks(build_blocks(
+            sid, ts, [[("_msg", f"ev w{i}")] for i in range(50)]))
+        ddb.flush_inmemory_parts()
+        ddb.close()
+    finally:
+        events.unsubscribe(sub)
+    assert got, "no filter_index_built event"
+    ev = got[0]
+    assert ev["bytes"] > 0 and ev["file_bytes"] > 0
+    assert ev["agg_bits_per_key"] > 0
+    assert ev["build_s"] >= 0
+
+
+def test_explain_cites_maplet_exact_counts(storage):
+    """?explain-level plan walk: the maplet's exact candidate count is
+    what the planner prices (direct build_plan call, no server)."""
+    from victorialogs_tpu.logsql.parser import parse_query
+    from victorialogs_tpu.obs.explain import build_plan
+    from victorialogs_tpu.storage.log_rows import TenantID
+    q = parse_query("zebra u5 | fields _time", T0)
+    tree = build_plan(storage, [TenantID(0, 0)], q)
+    parts = [p for pt in tree["partitions"] for p in pt["parts"]]
+    retained = [p for p in parts if p["status"] == "retained"]
+    assert retained
+    assert any(p.get("maplet_exact") for p in retained)
+    # "zebra yak": tokens coexist in parts but never in one block —
+    # every part dies, at least one citing the maplet
+    q2 = parse_query("zebra yak | fields _time", T0)
+    tree2 = build_plan(storage, [TenantID(0, 0)], q2)
+    parts2 = [p for pt in tree2["partitions"] for p in pt["parts"]]
+    assert parts2 and all(p["status"] == "killed" for p in parts2)
+    assert any(p["reason"] == "maplet"
+               and p["killed_by"]["artifact"] == "maplet"
+               for p in parts2)
+    assert tree2["predicted"]["rows_scanned"] == 0
+
+
+def test_sidecar_written_next_to_blooms(tmp_path):
+    p, part = _mk_part_dir(tmp_path, name="part_files")
+    names = sorted(os.listdir(p))
+    assert "blooms.bin" in names and SC.FILTERINDEX_FILENAME in names
+    meta = json.load(open(os.path.join(p, "metadata.json")))
+    assert meta["blocks"] == part.num_blocks

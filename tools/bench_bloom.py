@@ -1,19 +1,25 @@
 """Prune-throughput bench: host per-block bloom loop vs the batched
 plane probe (filter-index subsystem, ISSUE 2 acceptance: >=5x at 10k
-blocks on CPU).
+blocks on CPU), plus the v1-vs-v2 sealed-part round (ISSUE 12).
 
 Builds BENCH_BLOOM_BLOCKS synthetic block filters (mixed sizes, the
 realistic shape: per-block distinct-token counts vary), then times
 
-  - loop:  the pre-subsystem kill-path — hash_tokens once, then
-           bloom_contains_all per block in a Python loop;
-  - plane: FilterBank packed-plane probe (plane prebuilt and cached on
-           the part, exactly like the query path after first touch);
-  - agg:   the O(1) part-level aggregate probe (absent tokens only).
+  - loop:   the pre-subsystem kill-path — hash_tokens once, then
+            bloom_contains_all per block in a Python loop;
+  - plane:  FilterBank packed-plane probe (plane prebuilt and cached on
+            the part, exactly like the query path after first touch);
+  - agg:    the O(1) part-level aggregate probe (absent tokens only);
+  - v2:     the sealed-part filter index (storage/filterindex) —
+            token→block maplet keep-masks (probe throughput + prune
+            ratio vs the v1 plane), xor-filter aggregate bits/key vs
+            the classic 16-bit-per-token filter budget, and the full
+            sidecar build time.
 
-Prints ONE JSON line:
-  {"metric": "bloom_prune_throughput", "value": <plane blocks/s>,
-   "unit": "blocks/s", "vs_baseline": <plane/loop speedup>, ...}
+Asserts the ISSUE 12 acceptance: v2 probe throughput >= 1.5x the v1
+plane, aggregate bits/key <= 0.7x, v2 prune ratio >= v1 (the maplet is
+exact, so its kill set is a superset).  Prints ONE JSON line and
+records it to BENCH_bloom.json.
 
 Run via `make bench-bloom`.
 """
@@ -54,11 +60,13 @@ def main() -> None:
     universe = [f"tok{i}" for i in range(20000)]
     t0 = time.perf_counter()
     blooms = []
+    block_hashes = []
     for _ in range(N_BLOCKS):
         n = int(rng.integers(8, 256))
         toks = rng.choice(len(universe), size=n, replace=False)
-        blooms.append(bloom_build(hash_tokens(
-            [universe[int(i)] for i in toks])))
+        h = hash_tokens([universe[int(i)] for i in toks])
+        block_hashes.append(h)
+        blooms.append(bloom_build(h))
     build_s = time.perf_counter() - t0
     part = SyntheticPart(blooms)
 
@@ -129,6 +137,45 @@ def main() -> None:
                         if not a.may_contain_all(h))
     agg_s = (time.perf_counter() - t0) / REPS
 
+    # ---- v2 round: the sealed-part filter index ----
+    from victorialogs_tpu.storage.bloom import BLOOM_BITS_PER_TOKEN
+    from victorialogs_tpu.storage.filterindex.sidecar import (
+        SidecarBuilder, build_sidecar)
+
+    builder = SidecarBuilder()
+    for bi, h in enumerate(block_hashes):
+        builder.add(bi, "f", h)
+    t0 = time.perf_counter()
+    v2_cols, v2_stats = build_sidecar(builder, N_BLOCKS)
+    v2_build_s = time.perf_counter() - t0
+    mp = v2_cols["f"].maplet
+    xf = v2_cols["f"].xor
+
+    def run_maplet():
+        kills = 0
+        for h in hashes:
+            kills += int((~mp.keep_mask(h)).sum())
+        return kills
+
+    v2_kills = run_maplet()   # exact ⊇ plane kills (checked in fails)
+    v2_times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        run_maplet()
+        v2_times.append(time.perf_counter() - t0)
+    v2_s = statistics.median(v2_times)
+
+    # aggregate bits/key: the xor filter vs the classic filters'
+    # 16-bit-per-distinct-token budget (what v1 spends per key)
+    nkeys = int(mp.uhashes.shape[0])
+    v2_bpk = xf.bits_per_key(nkeys)
+    bpk_ratio = v2_bpk / BLOOM_BITS_PER_TOKEN
+    # and the REAL Bloofi fold footprint, for the record
+    v1_agg_bits = sum(a.mat.nbytes * 8 for a in aggs)
+    v1_agg_keys = sum(len(np.unique(np.concatenate(
+        block_hashes[i:i + ppart])))
+        for i in range(0, N_BLOCKS, ppart))
+
     probes = N_QUERIES * N_BLOCKS
     out = {
         "metric": "bloom_prune_throughput",
@@ -145,11 +192,39 @@ def main() -> None:
             agg_s / max(len(absent) * len(parts), 1), 9),
         "agg_part_kills": f"{agg_kills}/{len(absent) * len(parts)}",
         "bloom_build_s": round(build_s, 2),
+        # v2: sealed-part filter index (ISSUE 12 acceptance round)
+        "v2_maplet_blocks_per_s": round(probes / v2_s, 1),
+        "v2_probe_speedup_vs_plane": round(plane_s / v2_s, 2),
+        "v2_prune_kills": v2_kills,
+        "v1_prune_kills": kills,
+        "v2_prune_ratio": round(v2_kills / probes, 4),
+        "v1_prune_ratio": round(kills / probes, 4),
+        "v2_agg_bits_per_key": round(v2_bpk, 2),
+        "v1_filter_bits_per_key": BLOOM_BITS_PER_TOKEN,
+        "v2_agg_bits_per_key_ratio": round(bpk_ratio, 3),
+        "v1_bloofi_fold_bits_per_key": round(
+            v1_agg_bits / max(1, v1_agg_keys), 2),
+        "v2_sidecar_build_s": round(v2_build_s, 4),
+        "v2_sidecar_bytes": v2_stats["bytes"],
     }
     print(json.dumps(out))
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_bloom.json"), "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    fails = []
     if out["vs_baseline"] < 5:
-        print(f"WARN: speedup {out['vs_baseline']}x below the 5x target",
-              file=sys.stderr)
+        fails.append(f"plane speedup {out['vs_baseline']}x < 5x")
+    if out["v2_probe_speedup_vs_plane"] < 1.5:
+        fails.append(f"v2 probe {out['v2_probe_speedup_vs_plane']}x "
+                     "< 1.5x plane")
+    if bpk_ratio > 0.7:
+        fails.append(f"v2 agg bits/key ratio {bpk_ratio:.3f} > 0.7")
+    if v2_kills < kills:
+        fails.append("v2 prune ratio below v1")
+    if fails:
+        for msg in fails:
+            print(f"WARN: {msg}", file=sys.stderr)
         sys.exit(1)
 
 

@@ -31,8 +31,12 @@ from .locks import _dotted, _module_jit_names
 
 # obs/explain.py rides the same scope: the pricing pass runs at plan
 # time on EVERY query (and explain=1 must stay zero-dispatch), so a
-# hidden host sync or jit-closure there is a query-path regression
-SCOPE_RE = re.compile(r"(^|/)(tpu|engine)(/|$)|(^|/)obs/explain\.py$")
+# hidden host sync or jit-closure there is a query-path regression.
+# storage/filterindex/ too: its maplet/xor probes sit directly on the
+# per-part prune path of every query over sealed parts.
+SCOPE_RE = re.compile(
+    r"(^|/)(tpu|engine)(/|$)|(^|/)obs/explain\.py$"
+    r"|(^|/)storage/filterindex(/|$)")
 # the emit-shape rule runs where response/row materialization lives
 EMIT_SCOPE_RE = re.compile(r"(^|/)(server|engine)(/|$)")
 

@@ -424,7 +424,8 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 min_ts, max_ts, ctx, needed, deadline, pool,
                 stats_spec=None, sort_spec=None,
                 token_leaves=None) -> None:
-    from ..storage.filterbank import part_aggregate_prunes
+    from ..storage.filterbank import (maplet_prune_candidates,
+                                      part_aggregate_prunes)
     parts = [p for p in pt.ddb.snapshot_parts()
              if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
 
@@ -476,6 +477,14 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             if part_aggregate_prunes(
                     part, token_leaves,
                     build=len(part_bis) * 4 >= part.num_blocks):
+                continue
+            # sealed v2 parts: the token→block maplet turns AND-path
+            # leaf pruning into one exact lookup — surviving blocks
+            # are exactly the per-block kill-path's survivors, found
+            # before any block header or bloom word is touched
+            part_bis = maplet_prune_candidates(part, token_leaves,
+                                               part_bis)
+            if not part_bis:
                 continue
         activity.note_part_scanned(act, part, part_bis)
         cand: dict[int, BlockSearch] = {}

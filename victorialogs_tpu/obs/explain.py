@@ -169,6 +169,28 @@ def _walk(storage, tenants, q, runner, detail: bool) -> dict:
     return tree
 
 
+def _maplet_exact(part, token_leaves, bis):
+    """(exact_bis, killing_leaf, have_maplet): the sealed part's exact
+    AND-path candidate blocks from its token→block maplets.  Pure
+    probe — no trace/registry side effects, so both the explain
+    endpoint and the continuous pricing pass may call it; the AND
+    semantics live in ONE place (filterbank.maplet_leaf_keep, shared
+    with the execution pruning).  Classic parts return
+    (bis, None, False): their candidates stay the probabilistic
+    per-block estimate."""
+    from ..storage.filterbank import maplet_leaf_keep
+    from ..storage.filterindex import part_index
+    fi = part_index(part)
+    if fi is None:
+        return bis, None, False
+    keep, kill_leaf = maplet_leaf_keep(fi, token_leaves, bis)
+    if kill_leaf is not None:
+        return [], kill_leaf, True
+    if keep is None:
+        return bis, None, True
+    return [bi for bi, k in zip(bis, keep) if k], None, True
+
+
 def _part_header_table(part) -> dict:
     """Per-part header summary cached on the (immutable) part object —
     the pricing walk runs on EVERY query, so the per-block header
@@ -279,21 +301,47 @@ def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
             # (build=False) — with the result memo those repeats are
             # dict lookups, and a cold part the execution would build+
             # kill shows up as prediction error instead of a second
-            # cold fold per query
+            # cold fold per query.  Sealed v2 parts (filter-index
+            # sidecar) answer either way from the loaded xor aggregate.
             killed = aggregate_kill_leaf(
                 part, token_leaves,
                 build=detail and len(bis) * 4 >= part.num_blocks)
             if killed is not None:
-                field, tokens, f = killed
+                field, tokens, f, artifact = killed
                 tot["parts_killed"] += 1
                 if detail:
                     node.update(status="killed",
-                                reason="aggregate_bloom",
+                                reason="xor_aggregate"
+                                if artifact == "xor_aggregate"
+                                else "aggregate_bloom",
                                 killed_by={"field": field,
                                            "tokens": list(tokens),
-                                           "filter": f.to_string()})
+                                           "filter": f.to_string(),
+                                           "artifact": artifact})
                     pnode["parts"].append(node)
                 continue
+            # sealed v2 parts: the token→block maplet yields the EXACT
+            # candidate block list for the AND-path leaves — priced
+            # units reflect what the execution walk will dispatch, and
+            # an emptied list kills the part with the maplet cited
+            exact_bis, kill_leaf, have_maplet = _maplet_exact(
+                part, token_leaves, bis)
+            if kill_leaf is not None:
+                field, tokens, f = kill_leaf
+                tot["parts_killed"] += 1
+                if detail:
+                    node.update(status="killed", reason="maplet",
+                                killed_by={"field": field,
+                                           "tokens": list(tokens),
+                                           "filter": f.to_string(),
+                                           "artifact": "maplet"})
+                    pnode["parts"].append(node)
+                continue
+            if have_maplet and len(exact_bis) != len(bis):
+                bis = exact_bis
+                rows_cand = sum(part.block_rows(bi) for bi in bis)
+                if detail:
+                    node["maplet_exact"] = True
         bytes_est = int(rows_cand * activity.part_bytes_per_row(part))
         tot["parts_retained"] += 1
         tot["blocks_candidate"] += len(bis)

@@ -192,6 +192,14 @@ NET_FIRST_FRAME = histogram(
     (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
      1.0, 2.5, 5.0, 10.0))
 
+FILTER_INDEX_BUILD = histogram(
+    "vl_filter_index_build_seconds",
+    "wall time building one sealed part's v2 filter-index sidecar "
+    "(split-block planes + xor aggregates + maplets, "
+    "storage/filterindex — paid once per part at merge/flush seal)",
+    (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+     2.5, 5.0))
+
 MERGE_SECONDS = histogram(
     "vl_storage_merge_duration_seconds",
     "wall time of one background part merge (small/big tier "

@@ -79,18 +79,22 @@ class BlockData:
         return sz
 
 
-def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
-    """Attach a token bloom filter to a column (skipped for const/dict)."""
+def column_token_hashes(col: EncodedColumn, nrows: int):
+    """Distinct token hashes of a column, or None for const/dict
+    columns (no token coverage).  The block builder feeds these to the
+    bloom; the seal-time filter-index build (storage/filterindex) calls
+    this again for merge pass-through columns read back from disk —
+    tokenization is deterministic and VT round-trips are exact, so the
+    recomputed set equals the one the bloom was built from."""
     if col.vtype in (VT_CONST, VT_DICT):
-        return
+        return None
     if col.vtype == VT_STRING:
         # native fast path: tokenize+hash+dedupe in one C++ pass
         from .. import native
         hashes = native.unique_token_hashes_native(
             col.arena, col.offsets, col.lengths)
         if hashes is not None:
-            col.bloom = bloom_build(hashes)
-            return
+            return hashes
         ts_, te_, _ = tokenize_arena(col.arena, col.offsets, col.lengths)
         tokens = unique_tokens_bytes(col.arena, ts_, te_)
     else:
@@ -101,7 +105,16 @@ def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
                 if t not in seen:
                     seen.add(t)
                     tokens.append(t)
-    col.bloom = bloom_build(hash_tokens(tokens))
+    return hash_tokens(tokens)
+
+
+def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
+    """Attach a token bloom filter to a column (skipped for const/dict)."""
+    hashes = column_token_hashes(col, nrows)
+    if hashes is None:
+        return
+    col.token_hashes = hashes
+    col.bloom = bloom_build(hashes)
 
 
 def build_blocks(

@@ -432,7 +432,7 @@ class DataDB:
                 merged = merge_block_streams([im.blocks for im in imps])
             with self._lock:
                 name = self._new_part_name_locked()
-            write_part(os.path.join(self.path, name), merged)
+            fi_stats = write_part(os.path.join(self.path, name), merged)
             p = Part(os.path.join(self.path, name))
             p.name = name
             with self._lock:
@@ -448,13 +448,16 @@ class DataDB:
             # subscriber check keeps the tenant scan off the
             # journal-disabled path entirely
             if _events.subscriber_count():
+                tenant = _events.SYSTEM_TENANT \
+                    if _all_system_tenant(imps) else None
                 _events.emit(
-                    "storage_flush",
-                    tenant=_events.SYSTEM_TENANT
-                    if _all_system_tenant(imps) else None,
+                    "storage_flush", tenant=tenant,
                     parts=len(imps), rows=p.num_rows, out_part=name,
                     duration_ms=round(
                         (time.perf_counter() - t0) * 1e3, 3))
+                if fi_stats is not None:
+                    _events.emit("filter_index_built", tenant=tenant,
+                                 part=name, **fi_stats)
         except BaseException:
             # put the in-memory parts back so their rows stay visible
             with self._lock:
@@ -535,7 +538,7 @@ class DataDB:
             name = self._new_part_name_locked()
         out_path = os.path.join(self.path, name)
         try:
-            write_part(out_path, merged, big=big)
+            fi_stats = write_part(out_path, merged, big=big)
         except BaseException:
             # a failed write must not leave its .tmp dir eating the very
             # disk space the merge ran out of
@@ -571,6 +574,11 @@ class DataDB:
             "part_gc",
             tenant=_events.SYSTEM_TENANT if system_only else None,
             parts=len(to_merge), reclaimed_bytes=reclaimed)
+        if fi_stats is not None:
+            _events.emit(
+                "filter_index_built",
+                tenant=_events.SYSTEM_TENANT if system_only else None,
+                part=name, **fi_stats)
         return True
 
     # ---- read path ----

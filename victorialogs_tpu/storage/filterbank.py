@@ -408,7 +408,18 @@ def bloom_keep_mask(part, field: str, hashes: np.ndarray,
 
     observe=False skips the prune-ratio histogram and trace counters:
     the prefetcher probes the same (part, field, bis) the evaluator
-    will re-probe at dispatch — only the dispatch probe counts."""
+    will re-probe at dispatch — only the dispatch probe counts.
+
+    Sealed parts with a valid v2 sidecar (storage/filterindex) answer
+    from the token→block maplet instead: one lookup, an EXACT keep set
+    (strictly fewer survivors than the probabilistic probe, never a
+    false negative), and no host plane build at all.  Every caller
+    still sees this one contract — VL_FILTER_INDEX=v1, a corrupt
+    sidecar or an unsealed part land on the classic path below."""
+    from .filterindex import part_index
+    fi = part_index(part)
+    if fi is not None:
+        return _observe_keep(fi.keep_mask(field, hashes, bis), observe)
     fb = filter_bank(part)
     pl = fb.cached_plane(field)
     if pl is None and (bis is None
@@ -449,22 +460,37 @@ def _observe_keep(keep: np.ndarray, observe: bool = True) -> np.ndarray:
 
 
 def aggregate_kill_leaf(part, leaves, build: bool = True):
-    """The (field, tokens, owner_filter) leaf whose required tokens are
-    provably absent from every block of the part, or None — the
-    EXPLAIN plan's kill citation (obs/explain.py) and the predicate
+    """The (field, tokens, owner_filter, artifact) leaf whose required
+    tokens are provably absent from every block of the part, or None —
+    the EXPLAIN plan's kill citation (obs/explain.py) and the predicate
     behind part_aggregate_prunes.  No trace/registry side effects: pure
     probe, so the pricing pass can call it without polluting the
-    counters the execution walk will land."""
+    counters the execution walk will land.
+
+    Sealed v2 parts probe the xor-filter aggregate first (artifact
+    `xor_aggregate`: ~0.62x the bits/key and a fixed ~2^-8 fp rate, so
+    it kills a superset of what the classic fold kills); classic parts
+    use the Bloofi-style OR-folds (artifact `bloom_fold`)."""
+    from .filterindex import part_index
+    fi = part_index(part)
     fb = filter_bank(part) if build else \
         getattr(part, "_filter_bank", None)
-    if fb is None:
-        return None
     for field, tokens, f in leaves:
+        if fi is not None:
+            if fi.xor_kill(field, cached_token_hashes(f, tokens)):
+                return field, tokens, f, "xor_aggregate"
+            if fi.covers(field):
+                # the xor aggregate is exact over the part's token set
+                # (no false negatives): when it declines to kill, the
+                # coarser classic fold cannot kill either
+                continue
+        if fb is None:
+            continue
         agg = fb.aggregate(part, field) if build else \
             fb.cached_aggregate(field)
         if agg is not None and \
                 not agg.may_contain_all(cached_token_hashes(f, tokens)):
-            return field, tokens, f
+            return field, tokens, f, "bloom_fold"
     return None
 
 
@@ -481,11 +507,65 @@ def part_aggregate_prunes(part, leaves, build: bool = True) -> bool:
     coverage)."""
     killed = aggregate_kill_leaf(part, leaves, build=build)
     if killed is not None:
-        field = killed[0]
+        field, _tokens, _f, artifact = killed
         sp = tracing.current_span()
         if sp.enabled:
             sp.add("parts_pruned_aggregate")
             sp.set("last_aggregate_prune_field", field)
+            sp.set("last_aggregate_prune_artifact", artifact)
         activity.current_activity().add("parts_pruned")
         return True
     return False
+
+
+def maplet_leaf_keep(fi, leaves, bis):
+    """THE shared AND-path maplet core — both the execution pruning
+    below and the EXPLAIN walk (obs/explain._maplet_exact) ride it, so
+    the priced candidate set can never diverge from what execution
+    dispatches.  Returns (keep bool[len(bis)] | None, killing_leaf |
+    None): keep is None when no leaf had maplet coverage; killing_leaf
+    is the first leaf whose candidates emptied."""
+    keep = None
+    for field, tokens, f in leaves:
+        if not fi.has(field):
+            continue
+        km = fi.keep_mask(field, cached_token_hashes(f, tokens), bis)
+        keep = km if keep is None else keep & km
+        if not keep.any():
+            return keep, (field, tokens, f)
+    return keep, None
+
+
+def maplet_prune_candidates(part, leaves, bis, observe: bool = True):
+    """Exact AND-path block pruning from the sealed part's token→block
+    maplets: ONE lookup per leaf yields the candidate block list, so
+    blocks that cannot satisfy every AND-path token leaf drop out
+    BEFORE any header/bloom/dispatch work.  Returns the pruned block-id
+    list (possibly `bis` unchanged); classic parts (no v2 sidecar)
+    return `bis` untouched — their pruning happens per-leaf in
+    bloom_keep_mask.
+
+    The dropped blocks are exactly those the per-leaf kill-path would
+    have zeroed (the maplet is exact on token membership), so results
+    are identical — this only moves the kill earlier and makes its
+    size knowable to the EXPLAIN planner."""
+    from .filterindex import part_index
+    fi = part_index(part)
+    if fi is None or not leaves or not bis:
+        return bis
+    keep, _kill_leaf = maplet_leaf_keep(fi, leaves, bis)
+    if keep is None:
+        return bis
+    n = len(bis)
+    killed = n - int(keep.sum())
+    if observe:
+        sp = tracing.current_span()
+        if sp.enabled:
+            sp.add("blocks_probed_maplet", n)
+            sp.add("blocks_killed_maplet", killed)
+        if killed:
+            activity.current_activity().add("blocks_killed_maplet",
+                                            killed)
+    if not killed:
+        return bis
+    return [bi for bi, k in zip(bis, keep) if k]

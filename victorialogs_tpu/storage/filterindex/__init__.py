@@ -1,0 +1,37 @@
+"""Sealed-part filter index v2.
+
+Parts are immutable after merge, so their filter index can be built
+ONCE — at seal time, in the datadb merge/flush path — and traded for
+layouts a mutable index could not afford:
+
+- **split-block bloom planes** (`sbbloom.py`, Lang et al.
+  arXiv:2101.01719): every token's K probe bits confined to one
+  256-bit block, so a probe is ONE contiguous 8-lane gather + AND
+  instead of K scattered lane selects — the layout the device
+  keep-mask (tpu/bloom_device.plane_keep_sb) consumes directly.
+- **xor-filter part aggregates** (`xorfilter.py`, Graf & Lemire
+  arXiv:1912.08258): ~9.9 bits/key build-once filters over the
+  part-column's distinct tokens, replacing the Bloofi OR-folds for
+  sealed parts — smaller and O(1)-faster whole-part kills.
+- **token→block maplets** (`maplet.py`, "Time To Replace Your
+  Filter"): a compact map from token hash to a posting range of block
+  ids — "which blocks might match" becomes one binary search yielding
+  an EXACT candidate block list the EXPLAIN planner can price, instead
+  of B per-block probes.
+
+All three persist as ONE versioned, checksummed sidecar
+(`filterindex.bin`, `sidecar.py`) inside the part directory next to
+`blooms.bin`; part GC (the merge's rmtree) removes it with the part.
+The loader (`index.py`) verifies magic/version/checksum and falls back
+to `blooms.bin` + the classic filterbank planes on ANY mismatch — a
+corrupt sidecar can only lose speed, never correctness.
+`VL_FILTER_INDEX=v1` pins the classic path (neither builds nor reads
+sidecars).
+"""
+
+from __future__ import annotations
+
+from .index import (PartFilterIndex, enabled, mode,  # noqa: F401
+                    part_index, sb_plane_for_staging)
+from .sidecar import (FILTERINDEX_FILENAME, SidecarBuilder,  # noqa: F401
+                      build_sidecar, write_sidecar)

@@ -1,0 +1,209 @@
+"""Sidecar loader + per-part v2 index cache and query entry points.
+
+The index attaches lazily to the (immutable) part object on first
+probe — the same attach idiom as storage/filterbank.FilterBank — and
+its host bytes charge the SAME global budget as the classic bloom
+planes (`VL_BLOOM_BANK_MAX_BYTES`), released by a weakref finalizer
+when the part is garbage-collected after a merge.  There is no second
+unbounded filter cache: a sidecar that does not fit the remaining
+budget is declined (classic path serves, correctness unchanged).
+
+Every failure mode — missing file, bad magic/version/checksum, block
+count mismatch, budget exhaustion — degrades to `None`, which callers
+read as "use blooms.bin + the classic filterbank planes".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+import numpy as np
+
+from ...obs import events
+from .sbbloom import SB_LANES, sb_block_select, sb_token_masks
+from .sidecar import ColumnArtifacts, SidecarInvalid, load_sidecar
+
+
+def mode() -> str:
+    """`v2` (default) or `v1` (the classic-path kill switch)."""
+    return "v1" if os.environ.get("VL_FILTER_INDEX") == "v1" else "v2"
+
+
+def enabled() -> bool:
+    return mode() != "v1"
+
+
+class PartFilterIndex:
+    """One sealed part's loaded v2 artifacts, all columns."""
+
+    def __init__(self, cols: dict[str, ColumnArtifacts], nblocks: int,
+                 nbytes: int):
+        self.cols = cols
+        self.nblocks = nblocks
+        self.nbytes = nbytes
+        self._mu = threading.Lock()
+        self._planes: dict = {}
+        self._charged: list = [nbytes]
+
+    # ---- maplet: exact block-level keep masks ----
+
+    def has(self, field: str) -> bool:
+        return field in self.cols
+
+    def keep_mask(self, field: str, hashes: np.ndarray,
+                  bis=None) -> np.ndarray:
+        """Exact keep-mask over `bis` (or all blocks) — same contract
+        as filterbank.bloom_keep_mask, strictly fewer survivors.  A
+        field with no sidecar column has no token coverage anywhere in
+        the part: every block keeps (identical to the classic path)."""
+        c = self.cols.get(field)
+        if c is None:
+            n = self.nblocks if bis is None else len(bis)
+            return np.ones(n, dtype=bool)
+        return c.maplet.keep_mask(hashes, bis)
+
+    # ---- xor filter: O(1) whole-part kills ----
+
+    def covers(self, field: str) -> bool:
+        """Every block of the part has token coverage for the field
+        (the precondition for a part-level kill, exactly mirroring the
+        classic aggregate's all_have)."""
+        c = self.cols.get(field)
+        return c is not None and c.xor is not None
+
+    def xor_kill(self, field: str, hashes: np.ndarray) -> bool:
+        """True when some required token is provably absent from every
+        block of the part."""
+        c = self.cols.get(field)
+        if c is None or c.xor is None or len(hashes) == 0:
+            return False
+        return not bool(c.xor.contains(hashes).all())
+
+    # ---- split-block plane: the device-probe layout ----
+
+    def has_sb(self, field: str) -> bool:
+        c = self.cols.get(field)
+        return c is not None and bool(c.nsb.any())
+
+    def sb_plane(self, field: str):
+        """(plane uint32[B, SB_LANES*Mmax], nsb int32[B]) packed for
+        the device gather, or None (no sb filters / over budget).
+        Built lazily, memoized on the index, charged to the bank."""
+        with self._mu:
+            got = self._planes.get(field, _UNSET)
+        if got is not _UNSET:
+            return got
+        built = self._build_plane(field)
+        if built is not None:
+            from ..filterbank import _bank_try_charge
+            nbytes = int(built[0].nbytes)
+            if not _bank_try_charge(nbytes):
+                # transient budget pressure: decline WITHOUT memoizing
+                # so the plane can land once charges free up at part GC
+                events.emit("bloom_bank_evict", field=field,
+                            nbytes=nbytes, part="#sb_plane")
+                return None
+        with self._mu:
+            got = self._planes.setdefault(field, built)
+            if got is built and built is not None:
+                # the winner's charge is released by the part-GC
+                # finalizer; a race loser releases it right below
+                self._charged.append(nbytes)
+        if got is not built and built is not None:
+            from ..filterbank import _bank_release
+            _bank_release([nbytes])            # lost the build race
+        return got
+
+    def _build_plane(self, field: str):
+        c = self.cols.get(field)
+        if c is None or not c.nsb.any():
+            return None
+        mmax = int(c.nsb.max())
+        plane = np.zeros((self.nblocks, SB_LANES * mmax),
+                         dtype=np.uint32)
+        off = c.lane_offsets()
+        for bi in np.nonzero(c.nsb)[0]:
+            n = int(c.nsb[bi]) * SB_LANES
+            plane[bi, :n] = c.lanes[off[bi]:off[bi] + n]
+        return plane, np.ascontiguousarray(c.nsb, dtype=np.int32)
+
+    def sb_probe_idx(self, field: str, hashes: np.ndarray) -> np.ndarray:
+        """Per-(block, token) lane base -> int32[B, T]: the token's
+        selected 256-bit block times SB_LANES, 0 where the block has no
+        filter (kept via the nsb==0 term in the probe).  THE block
+        selection is sb_block_select — the same derivation sb_build
+        inserted with, so build and probe can never drift."""
+        c = self.cols[field]
+        sel = sb_block_select(hashes,
+                              c.nsb.astype(np.uint64)[:, None])
+        return (sel * SB_LANES).astype(np.int32)
+
+    @staticmethod
+    def sb_masks(hashes: np.ndarray) -> np.ndarray:
+        return sb_token_masks(hashes)
+
+
+_UNSET = object()
+_attach_mu = threading.Lock()
+
+
+def part_index(part) -> PartFilterIndex | None:
+    """The part's loaded v2 index, or None (no sidecar / invalid /
+    VL_FILTER_INDEX=v1 / in-memory part / over budget).  The outcome
+    is cached on the part — one sidecar read per part lifetime."""
+    if not enabled():
+        return None
+    got = getattr(part, "_filter_index", _UNSET)
+    if got is not _UNSET:
+        return got or None
+    path = getattr(part, "path", None)
+    if path is None:
+        part._filter_index = False        # unsealed in-memory part
+        return None
+    with _attach_mu:
+        got = getattr(part, "_filter_index", _UNSET)
+        if got is not _UNSET:
+            return got or None
+        fi = _load(part, path)
+        if fi is _DECLINED:
+            # transient budget pressure: no memo — the sidecar can
+            # still load on a later probe once part GC frees charges
+            return None
+        part._filter_index = fi if fi is not None else False
+    return fi
+
+
+_DECLINED = object()
+
+
+def _load(part, path: str):
+    """PartFilterIndex | None (permanent: missing/invalid sidecar) |
+    _DECLINED (transient: over the bank budget right now)."""
+    from ..filterbank import _bank_release, _bank_try_charge
+    try:
+        cols, nbytes = load_sidecar(path, part.num_blocks)
+    except FileNotFoundError:
+        return None                       # pre-v2 part: classic path
+    except (SidecarInvalid, OSError) as e:
+        events.emit("filter_index_fallback",
+                    part=str(getattr(part, "uid", "?")),
+                    reason=str(e))
+        return None
+    if not _bank_try_charge(nbytes):
+        events.emit("bloom_bank_evict", field="#filterindex",
+                    nbytes=nbytes,
+                    part=str(getattr(part, "uid", "?")))
+        return _DECLINED
+    fi = PartFilterIndex(cols, part.num_blocks, nbytes)
+    weakref.finalize(fi, _bank_release, fi._charged)
+    return fi
+
+
+def sb_plane_for_staging(part, field: str):
+    """(plane, nsb) for tpu/bloom_device.stage_sb_plane, or None."""
+    fi = part_index(part)
+    if fi is None:
+        return None
+    return fi.sb_plane(field)

@@ -1,0 +1,107 @@
+"""Token→block maplets: the exact per-part micro-index.
+
+"Which blocks might contain token t" is the question the classic path
+answers with B bloom probes (one per candidate block).  A sealed part
+can answer it with ONE lookup: sort the part-column's distinct token
+hashes once at build time and store, per token, the posting list of
+block ids that contain it ("Time To Replace Your Filter" — the maplet
+idea of returning a VALUE, not a bit, per key).  AND-path leaf pruning
+becomes a binary search + posting intersection whose result is an
+EXACT candidate block list — zero false positives at block
+granularity (up to 64-bit token-hash collisions, the same assumption
+every other filter layer already makes), which the EXPLAIN planner
+prices directly.
+
+Blocks that carry no token hashes for the column (missing column,
+dict-encoded, bloom-less) can hide anything; they ride a `covered`
+bitmap and are kept unconditionally, exactly like the classic path
+keeps bloom-less blocks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class Maplet:
+    uhashes: np.ndarray      # uint64[U] sorted distinct token hashes
+    offsets: np.ndarray      # int64[U+1] posting ranges into blocks
+    blocks: np.ndarray       # int32[P] block ids, grouped per token
+    covered: np.ndarray      # packbits bool[nblocks]: block has hashes
+    nblocks: int
+
+    def nbytes(self) -> int:
+        return int(self.uhashes.nbytes + self.offsets.nbytes
+                   + self.blocks.nbytes + self.covered.nbytes)
+
+    def covered_mask(self, bis: np.ndarray) -> np.ndarray:
+        byte = self.covered[bis >> 3]
+        return (byte >> (np.uint8(7) - (bis & 7).astype(np.uint8))) & 1 != 0
+
+    def all_covered(self) -> bool:
+        n = self.nblocks
+        full, rem = divmod(n, 8)
+        if full and not (self.covered[:full] == 0xFF).all():
+            return False
+        if rem:
+            want = np.uint8((0xFF << (8 - rem)) & 0xFF)
+            return bool(self.covered[full] & want == want)
+        return True
+
+    def keep_mask(self, hashes: np.ndarray, bis=None) -> np.ndarray:
+        """bool keep-mask over `bis` (or all blocks): True where the
+        block may contain ALL tokens — exact for covered blocks, always
+        True for uncovered ones.  Same contract as
+        filterbank.bloom_keep_mask, strictly fewer survivors."""
+        sel = np.arange(self.nblocks, dtype=np.int64) if bis is None \
+            else np.asarray(list(bis), dtype=np.int64)
+        if len(hashes) == 0:
+            return np.ones(sel.shape[0], dtype=bool)
+        t = len(hashes)
+        cnt = np.zeros(self.nblocks, dtype=np.int32)
+        pos = np.searchsorted(self.uhashes, hashes)
+        u = self.uhashes.shape[0]
+        for k in range(t):
+            p = int(pos[k])
+            if p >= u or self.uhashes[p] != hashes[k]:
+                # token absent from every covered block: only the
+                # uncovered blocks can still match
+                cnt = None
+                break
+            cnt[self.blocks[self.offsets[p]:self.offsets[p + 1]]] += 1
+        if cnt is None:
+            return ~self.covered_mask(sel)
+        return (cnt[sel] == t) | ~self.covered_mask(sel)
+
+
+def maplet_build(per_block: list, nblocks: int) -> Maplet:
+    """Build from [(block_idx, uint64 hashes or None)] — one entry per
+    block that has token hashes; every other block is uncovered."""
+    covered = np.zeros(nblocks, dtype=bool)
+    hs = []
+    bs = []
+    for bi, h in per_block:
+        if h is None:
+            continue
+        covered[bi] = True
+        if len(h):
+            hs.append(np.asarray(h, dtype=np.uint64))
+            bs.append(np.full(len(h), bi, dtype=np.int32))
+    if hs:
+        all_h = np.concatenate(hs)
+        all_b = np.concatenate(bs)
+        order = np.argsort(all_h, kind="stable")
+        sh, sb = all_h[order], all_b[order]
+        uhashes, starts = np.unique(sh, return_index=True)
+        offsets = np.concatenate(
+            [starts.astype(np.int64), [sh.shape[0]]])
+        blocks = sb
+    else:
+        uhashes = np.zeros(0, dtype=np.uint64)
+        offsets = np.zeros(1, dtype=np.int64)
+        blocks = np.zeros(0, dtype=np.int32)
+    return Maplet(uhashes=uhashes, offsets=offsets, blocks=blocks,
+                  covered=np.packbits(covered), nblocks=nblocks)

@@ -1,0 +1,247 @@
+"""Versioned sidecar persistence for the v2 filter index.
+
+One file per part — `filterindex.bin`, written into the part directory
+in the same `write_part` pass that seals it (so the atomic rename
+publishes part and index together, and part GC's rmtree collects
+both).  `blooms.bin` is untouched: it remains the mandatory fallback.
+
+Layout (all integers little-endian):
+
+    magic     8  b"VLFIDX2\\n"
+    version   u32
+    nblocks   u32   (must match the part; guards stale copies)
+    hdrlen    u32   (JSON header byte length)
+    crc32     u32   (zlib.crc32 over header + payload)
+    header    JSON  (per-column array descriptors [offset, length])
+    payload   raw arrays, each 8-byte aligned
+
+The loader re-derives every array as a zero-copy numpy view over one
+payload buffer after verifying magic, version, block count, header
+shape and the checksum; ANY mismatch raises SidecarInvalid and the
+caller falls back to the classic blooms.bin path — a corrupt or
+truncated sidecar can only cost speed, never results.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from .maplet import Maplet, maplet_build
+from .sbbloom import SB_LANES, sb_build
+from .xorfilter import XorFilter, xor_build
+
+FILTERINDEX_FILENAME = "filterindex.bin"
+MAGIC = b"VLFIDX2\n"
+VERSION = 2
+
+
+class SidecarInvalid(Exception):
+    """Sidecar failed verification; classic path must serve."""
+
+
+@dataclass
+class ColumnArtifacts:
+    """One column's three sealed-part artifacts (see package doc)."""
+    nsb: np.ndarray              # int32[nblocks] sb blocks per block
+    lanes: np.ndarray            # uint32[SB_LANES*sum(nsb)] concat
+    xor: XorFilter | None        # None when not every block is covered
+    maplet: Maplet
+
+    def lane_offsets(self) -> np.ndarray:
+        """int64[nblocks] lane start of each block's sb filter."""
+        off = np.zeros(self.nsb.shape[0], dtype=np.int64)
+        np.cumsum(self.nsb[:-1].astype(np.int64) * SB_LANES,
+                  out=off[1:])
+        return off
+
+    def nbytes(self) -> int:
+        n = int(self.nsb.nbytes + self.lanes.nbytes
+                + self.maplet.nbytes())
+        if self.xor is not None:
+            n += self.xor.nbytes()
+        return n
+
+
+class SidecarBuilder:
+    """Accumulates per-(block, column) token hashes during the part
+    write, then builds all three artifacts per column."""
+
+    def __init__(self):
+        self._cols: dict[str, list] = {}
+
+    def add(self, block_idx: int, name: str, hashes) -> None:
+        """hashes: uint64 array of the block-column's distinct token
+        hashes, or None when the column has no token coverage there
+        (dict-encoded / bloom-less) — the block stays uncovered."""
+        self._cols.setdefault(name, []).append((block_idx, hashes))
+
+    def build(self, nblocks: int) -> dict[str, ColumnArtifacts]:
+        out: dict[str, ColumnArtifacts] = {}
+        for name, per_block in self._cols.items():
+            nsb = np.zeros(nblocks, dtype=np.int32)
+            lane_parts = []
+            for bi, h in per_block:
+                if h is None:
+                    continue
+                lanes = sb_build(np.asarray(h, dtype=np.uint64))
+                nsb[bi] = lanes.shape[0] // SB_LANES
+                lane_parts.append((bi, lanes))
+            lane_parts.sort(key=lambda t: t[0])
+            lanes = np.concatenate([lp for _bi, lp in lane_parts]) \
+                if lane_parts else np.zeros(0, dtype=np.uint32)
+            mp = maplet_build(per_block, nblocks)
+            xf = xor_build(mp.uhashes) if mp.all_covered() else None
+            out[name] = ColumnArtifacts(nsb=nsb, lanes=lanes, xor=xf,
+                                        maplet=mp)
+        return out
+
+
+def build_sidecar(builder: SidecarBuilder, nblocks: int):
+    """build + stats, no IO (the bench rides this directly)."""
+    cols = builder.build(nblocks)
+    nbytes = sum(c.nbytes() for c in cols.values())
+    keys = sum(int(c.maplet.uhashes.shape[0]) for c in cols.values())
+    agg_bits = sum(8 * c.xor.fingerprints.shape[0]
+                   for c in cols.values() if c.xor is not None)
+    agg_keys = sum(int(c.maplet.uhashes.shape[0])
+                   for c in cols.values() if c.xor is not None)
+    stats = {
+        "cols": len(cols),
+        "tokens": keys,
+        "bytes": nbytes,
+        "agg_bits_per_key": round(agg_bits / agg_keys, 2)
+        if agg_keys else 0.0,
+    }
+    return cols, stats
+
+
+# ---------------- serialization ----------------
+
+def _pack(chunks: list, arr: np.ndarray, dtype: str):
+    """Append `arr` (8-byte aligned) -> [offset, length] descriptor."""
+    pos = sum(len(c) for c in chunks)
+    pad = (-pos) % 8
+    if pad:
+        chunks.append(b"\0" * pad)
+        pos += pad
+    raw = np.ascontiguousarray(arr).astype(dtype, copy=False).tobytes()
+    chunks.append(raw)
+    return [pos, int(arr.shape[0])]
+
+
+def write_sidecar(dir_path: str, cols: dict[str, ColumnArtifacts],
+                  nblocks: int) -> int:
+    """Serialize into dir_path/filterindex.bin -> bytes written."""
+    chunks: list[bytes] = []
+    hdr_cols: dict = {}
+    for name, c in cols.items():
+        d = {
+            "nsb": _pack(chunks, c.nsb, "<i4"),
+            "sb": _pack(chunks, c.lanes, "<u4"),
+            "mh": _pack(chunks, c.maplet.uhashes, "<u8"),
+            "mo": _pack(chunks, c.maplet.offsets, "<i8"),
+            "mb": _pack(chunks, c.maplet.blocks, "<i4"),
+            "cov": _pack(chunks, c.maplet.covered, "<u1"),
+        }
+        if c.xor is not None:
+            d["xor"] = {"seed": int(c.xor.seed),
+                        "seglen": int(c.xor.seglen),
+                        "fp": _pack(chunks, c.xor.fingerprints, "<u1")}
+        hdr_cols[name] = d
+    payload = b"".join(chunks)
+    header = json.dumps({"cols": hdr_cols,
+                         "payload_bytes": len(payload)},
+                        separators=(",", ":")).encode("utf-8")
+    crc = zlib.crc32(header + payload) & 0xFFFFFFFF
+    import struct
+    blob = (MAGIC
+            + struct.pack("<III", VERSION, nblocks, len(header))
+            + struct.pack("<I", crc)
+            + header + payload)
+    path = os.path.join(dir_path, FILTERINDEX_FILENAME)
+    with open(path, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    return len(blob)
+
+
+def _view(payload: bytes, desc, dtype: str) -> np.ndarray:
+    off, n = desc
+    itemsize = np.dtype(dtype).itemsize
+    end = off + n * itemsize
+    if off < 0 or end > len(payload):
+        raise SidecarInvalid(f"array [{off},{n}]x{dtype} out of range")
+    return np.frombuffer(payload, dtype=dtype, count=n, offset=off)
+
+
+def load_sidecar(dir_path: str, nblocks: int):
+    """-> (cols dict, payload_nbytes); raises SidecarInvalid on any
+    verification failure, FileNotFoundError when the part predates v2."""
+    path = os.path.join(dir_path, FILTERINDEX_FILENAME)
+    with open(path, "rb") as f:
+        blob = f.read()
+    import struct
+    if len(blob) < len(MAGIC) + 16:
+        raise SidecarInvalid("truncated header")
+    if blob[:len(MAGIC)] != MAGIC:
+        raise SidecarInvalid("bad magic")
+    version, nb, hdrlen = struct.unpack_from("<III", blob, len(MAGIC))
+    (crc,) = struct.unpack_from("<I", blob, len(MAGIC) + 12)
+    if version != VERSION:
+        raise SidecarInvalid(f"version {version}")
+    if nb != nblocks:
+        raise SidecarInvalid(f"nblocks {nb} != part {nblocks}")
+    body = blob[len(MAGIC) + 16:]
+    if hdrlen > len(body):
+        raise SidecarInvalid("header past EOF")
+    header, payload = body[:hdrlen], body[hdrlen:]
+    if (zlib.crc32(header + payload) & 0xFFFFFFFF) != crc:
+        raise SidecarInvalid("checksum mismatch")
+    try:
+        hdr = json.loads(header)
+        if len(payload) != hdr["payload_bytes"]:
+            raise SidecarInvalid("payload length mismatch")
+        cols: dict[str, ColumnArtifacts] = {}
+        for name, d in hdr["cols"].items():
+            nsb = _view(payload, d["nsb"], "<i4")
+            if nsb.shape[0] != nblocks:
+                raise SidecarInvalid("nsb length")
+            mp = Maplet(
+                uhashes=_view(payload, d["mh"], "<u8"),
+                offsets=_view(payload, d["mo"], "<i8"),
+                blocks=_view(payload, d["mb"], "<i4"),
+                covered=_view(payload, d["cov"], "<u1"),
+                nblocks=nblocks,
+            )
+            if mp.offsets.shape[0] != mp.uhashes.shape[0] + 1 or \
+                    (mp.offsets[-1:] > mp.blocks.shape[0]).any() or \
+                    mp.covered.shape[0] != (nblocks + 7) // 8:
+                raise SidecarInvalid("maplet shape")
+            if mp.blocks.shape[0] and \
+                    (int(mp.blocks.max()) >= nblocks
+                     or int(mp.blocks.min()) < 0):
+                raise SidecarInvalid("maplet block id out of range")
+            xf = None
+            if "xor" in d:
+                x = d["xor"]
+                fp = _view(payload, x["fp"], "<u1")
+                if fp.shape[0] != 3 * int(x["seglen"]):
+                    raise SidecarInvalid("xor shape")
+                xf = XorFilter(seed=int(x["seed"]),
+                               seglen=int(x["seglen"]),
+                               fingerprints=fp)
+            cols[name] = ColumnArtifacts(nsb=nsb,
+                                         lanes=_view(payload, d["sb"],
+                                                     "<u4"),
+                                         xor=xf, maplet=mp)
+        return cols, len(payload)
+    except SidecarInvalid:
+        raise
+    except Exception as e:  # malformed JSON/desc shapes of any kind
+        raise SidecarInvalid(f"malformed header: {e!r}") from e

@@ -1,0 +1,125 @@
+"""Xor filters: build-once part-level aggregates (~9.9 bits/key).
+
+Graf & Lemire (arXiv:1912.08258): a 3-wise xor construction over
+c = 32 + ceil(1.23*n) 8-bit fingerprint slots answers membership with
+one xor of three slot loads, at ~0.62x the classic filters' 16
+bits/key and a fixed ~2^-8 false-positive rate — strictly better than
+the Bloofi OR-folds it replaces for sealed parts, whose fp rate grows
+with every block folded in.  The catch is the build: peeling can fail
+(rarely) and costs O(n) — exactly the trade a part that never mutates
+again can afford, and one a mutable filter cannot.
+
+The peel here is round-vectorized numpy rather than the classic
+per-key stack: each round finds ALL degree-1 slots at once, records
+(key, slot), and removes the keys.  Assignment replays the rounds in
+reverse; within one round every peeled key's OTHER two slots were
+peeled in strictly later rounds (a same-round sibling slot would have
+had degree >= 2), so each round assigns as one vectorized gather/xor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...utils.hashing import splitmix64_np
+
+_MAX_TRIES = 16
+FINGERPRINT_BITS = 8
+
+
+def _slots_and_fp(hashes: np.ndarray, seed: int, seglen: int):
+    """Three fastrange slot indexes + the 8-bit fingerprint, all pure
+    integer math on (hash, seed) so probes re-derive them from the
+    sidecar's stored seed."""
+    z = splitmix64_np(hashes.astype(np.uint64) ^ np.uint64(seed))
+    z2 = splitmix64_np(z)
+    sl = np.uint64(seglen)
+    h0 = (((z & np.uint64(0xFFFFFFFF)) * sl) >> np.uint64(32))
+    h1 = (((z >> np.uint64(32)) * sl) >> np.uint64(32)) + sl
+    h2 = (((z2 & np.uint64(0xFFFFFFFF)) * sl) >> np.uint64(32)) \
+        + np.uint64(2) * sl
+    fp = ((z2 >> np.uint64(56)) & np.uint64(0xFF)).astype(np.uint8)
+    # fingerprint 0 would make an all-zero (empty) table claim
+    # membership; remap it like the reference implementations
+    fp = np.where(fp == 0, np.uint8(0xA5), fp)
+    return h0.astype(np.int64), h1.astype(np.int64), h2.astype(np.int64), fp
+
+
+@dataclass
+class XorFilter:
+    seed: int
+    seglen: int
+    fingerprints: np.ndarray       # uint8[3*seglen]
+
+    def contains(self, hashes: np.ndarray) -> np.ndarray:
+        """bool[T]: no false negatives for built keys, fp ~= 2^-8."""
+        if len(hashes) == 0:
+            return np.ones(0, dtype=bool)
+        h0, h1, h2, fp = _slots_and_fp(hashes, self.seed, self.seglen)
+        f = self.fingerprints
+        return (f[h0] ^ f[h1] ^ f[h2]) == fp
+
+    def nbytes(self) -> int:
+        return int(self.fingerprints.nbytes)
+
+    def bits_per_key(self, nkeys: int) -> float:
+        return 8.0 * self.fingerprints.shape[0] / max(1, nkeys)
+
+
+def xor_build(hashes: np.ndarray) -> XorFilter | None:
+    """Build an xor filter over DISTINCT uint64 hashes; None when the
+    peel fails _MAX_TRIES seeds in a row (astronomically unlikely —
+    the caller falls back to having no part aggregate)."""
+    keys = np.unique(hashes.astype(np.uint64))
+    n = len(keys)
+    seglen = max(4, (int(np.ceil(1.23 * n)) + 32 + 2) // 3)
+    cap = 3 * seglen
+    for attempt in range(_MAX_TRIES):
+        seed = (0x9E3779B9 * (attempt + 1)) & 0xFFFFFFFF
+        if n == 0:
+            return XorFilter(seed=seed, seglen=seglen,
+                             fingerprints=np.zeros(cap, dtype=np.uint8))
+        h0, h1, h2, _fp = _slots_and_fp(keys, seed, seglen)
+        slots = np.stack([h0, h1, h2], axis=1)         # int64[n, 3]
+        count = np.zeros(cap, dtype=np.int64)
+        xorkey = np.zeros(cap, dtype=np.int64)         # xor of key ids
+        flat = slots.reshape(-1)
+        np.add.at(count, flat, 1)
+        np.bitwise_xor.at(
+            xorkey, flat,
+            np.repeat(np.arange(n, dtype=np.int64), 3))
+        alive = np.ones(n, dtype=bool)
+        rounds: list[tuple[np.ndarray, np.ndarray]] = []
+        remaining = n
+        while remaining:
+            single = np.nonzero(count == 1)[0]
+            if single.shape[0] == 0:
+                break                                   # cycle: reseed
+            kid = xorkey[single]
+            # one key may sit in several degree-1 slots: peel it once
+            kid, first = np.unique(kid, return_index=True)
+            peel_slots = single[first]
+            live = alive[kid]
+            kid, peel_slots = kid[live], peel_slots[live]
+            if kid.shape[0] == 0:
+                break
+            alive[kid] = False
+            remaining -= kid.shape[0]
+            krows = slots[kid].reshape(-1)
+            np.add.at(count, krows, -1)
+            np.bitwise_xor.at(xorkey, krows, np.repeat(kid, 3))
+            rounds.append((kid, peel_slots))
+        if remaining:
+            continue
+        fps = np.zeros(cap, dtype=np.uint8)
+        _, _, _, fp_all = _slots_and_fp(keys, seed, seglen)
+        for kid, peel_slots in reversed(rounds):
+            ks = slots[kid]                             # int64[r, 3]
+            acc = fps[ks[:, 0]] ^ fps[ks[:, 1]] ^ fps[ks[:, 2]]
+            # the peel slot itself is still 0 in fps, so acc is the
+            # xor of the OTHER two; set it to close the equation
+            fps[peel_slots] = fp_all[kid] ^ acc
+        return XorFilter(seed=seed, seglen=seglen, fingerprints=fps)
+    return None

@@ -73,11 +73,17 @@ def _decompress(data: bytes) -> bytes:
     return _zstd.decompress(data)
 
 
-def write_part(path: str, blocks, big: bool = False) -> None:
+def write_part(path: str, blocks, big: bool = False) -> dict | None:
     """Write blocks (already sorted by (stream_id, ts)) as a part directory.
 
     blocks may be any iterable of BlockData (e.g. the streaming merger) —
-    it is consumed exactly once."""
+    it is consumed exactly once.  This is the SEAL point: the part never
+    changes again, so the v2 filter index (split-block planes, xor
+    aggregates, token→block maplets — storage/filterindex) is built here
+    and written as a sidecar into the same directory, published by the
+    same atomic rename.  Returns the filter-index build stats (or None
+    when the build is pinned off / declined)."""
+    from . import filterindex as _fidx
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     headers = []
@@ -85,6 +91,8 @@ def write_part(path: str, blocks, big: bool = False) -> None:
     min_ts, max_ts = None, None
     comp_size = 0
     uncomp_size = 0
+    fi_builder = _fidx.SidecarBuilder() if _fidx.enabled() else None
+    fi_hash_s = 0.0   # pass-through re-tokenize cost (merges only)
     with open(os.path.join(tmp, TIMESTAMPS_FILENAME), "wb") as ts_f, \
          open(os.path.join(tmp, COLUMNS_FILENAME), "wb") as col_f, \
          open(os.path.join(tmp, BLOOMS_FILENAME), "wb") as bloom_f:
@@ -117,6 +125,22 @@ def write_part(path: str, blocks, big: bool = False) -> None:
                     bloom_f.write(c.bloom.tobytes())
                     ch["b"] = [bloom_off, int(c.bloom.shape[0])]
                     bloom_off += c.bloom.shape[0] * 8
+                    if fi_builder is not None:
+                        # fresh blocks carry their hashes from the
+                        # bloom build; merge pass-through blocks read
+                        # back from disk recompute them (deterministic
+                        # tokenizer over round-trip-exact values) —
+                        # timed, so the new merge CPU cost stays
+                        # visible in the build histogram and event
+                        h = c.token_hashes
+                        if h is None:
+                            import time as _time
+                            t_h = _time.perf_counter()
+                            from .block import column_token_hashes
+                            h = column_token_hashes(c, b.num_rows)
+                            fi_hash_s += _time.perf_counter() - t_h
+                        if h is not None:
+                            fi_builder.add(len(headers), c.name, h)
                 if c.vtype == VT_DICT:
                     ch["dict"] = c.dict_values
                 elif c.vtype != VT_STRING:
@@ -142,6 +166,31 @@ def write_part(path: str, blocks, big: bool = False) -> None:
         for fh in (ts_f, col_f, bloom_f):
             fh.flush()
             os.fsync(fh.fileno())
+    fi_stats = None
+    if fi_builder is not None and headers:
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            fi_cols, fi_stats = _fidx.build_sidecar(fi_builder,
+                                                    len(headers))
+            fi_stats["file_bytes"] = _fidx.write_sidecar(
+                tmp, fi_cols, len(headers))
+        # a part without a sidecar is correct, just slower — but a
+        # deterministic build bug must stay visible in the journal
+        # vlint: allow-broad-except(filter-index build is advisory)
+        except Exception as e:
+            from ..obs import events as _events
+            _events.emit("filter_index_build_failed", part=path,
+                         reason=repr(e))
+            fi_stats = None
+        else:
+            from ..obs import hist as _hist
+            fi_stats["build_s"] = round(_time.perf_counter() - t0, 6)
+            fi_stats["hash_recompute_s"] = round(fi_hash_s, 6)
+            # the histogram carries the WHOLE seal cost, re-tokenize
+            # included — merge throughput regressions must show here
+            _hist.FILTER_INDEX_BUILD.observe(fi_stats["build_s"]
+                                             + fi_hash_s)
     # two-level index: compressed header GROUPS + a tiny metaindex that
     # locates them (open parses only the metaindex)
     groups_meta = []
@@ -196,6 +245,7 @@ def write_part(path: str, blocks, big: bool = False) -> None:
         os.fsync(dfd)
     finally:
         os.close(dfd)
+    return fi_stats
 
 
 def _column_payload(c: EncodedColumn) -> bytes:

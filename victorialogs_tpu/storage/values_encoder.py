@@ -76,6 +76,10 @@ class EncodedColumn:
     max_val: float = 0.0
     iso_frac_w: int = 0                            # VT_TIMESTAMP fractional digits
     bloom: np.ndarray | None = None                # uint64 words (set later)
+    # distinct token hashes behind `bloom`, kept through the flush so
+    # the seal-time filter-index build (storage/filterindex) doesn't
+    # re-tokenize fresh blocks; absent on columns read back from disk
+    token_hashes: np.ndarray | None = None
     _strings_cache: list[str] | None = field(default=None, repr=False)
 
     @property

@@ -947,6 +947,8 @@ class BatchRunner:
         self.topk_dispatches = 0
         self.bloom_plane_probes = 0
         self.agg_pruned_parts = 0
+        self.maplet_probes = 0         # v2 maplet served a keep-mask
+        self.maplet_pruned_blocks = 0  # blocks exact-killed pre-dispatch
         # async pipeline observability (tpu/pipeline.py)
         self.pipeline_units = 0        # units driven through the window
         self.packed_dispatches = 0     # super-dispatches over packed parts
@@ -1006,6 +1008,8 @@ class BatchRunner:
                 "topk_dispatches": self.topk_dispatches,
                 "bloom_plane_probes": self.bloom_plane_probes,
                 "agg_pruned_parts": self.agg_pruned_parts,
+                "maplet_probes": self.maplet_probes,
+                "maplet_pruned_blocks": self.maplet_pruned_blocks,
                 "pipeline_units": self.pipeline_units,
                 "packed_dispatches": self.packed_dispatches,
                 "packed_parts": self.packed_parts,
@@ -1379,7 +1383,12 @@ class BatchRunner:
             from ..storage.filterbank import filter_bank
             hashes = cached_token_hashes(plan.filter, plan.bloom_tokens)
             keep = bloom_keep_mask(part, plan.field, hashes, alive)
-            if filter_bank(part).cached_plane(plan.field) is not None:
+            from ..storage.filterindex import part_index
+            if part_index(part) is not None:
+                # evidence the v2 MAPLET served the probe (exact keep
+                # set, no plane build at all)
+                self._bump("maplet_probes")
+            elif filter_bank(part).cached_plane(plan.field) is not None:
                 # evidence the PLANE path served the probe (a declined
                 # column rode the per-block fallback instead)
                 self._bump("bloom_plane_probes")
@@ -1765,6 +1774,26 @@ class BatchRunner:
             if got is None:
                 got = stage_bloom_plane(part, field,
                                         put=self._put_replicated)
+                if got is None:
+                    self.cache.put_small(key, _UNSTAGEABLE)
+                else:
+                    self.cache.put(key, got)
+            return got
+
+    def _stage_sb_plane(self, part, field: str):
+        """HBM-resident split-block plane (sealed-part filter index v2)
+        for the fused in-dispatch bloom kill: ONE contiguous 8-lane
+        gather per (block, token) instead of 6 scattered lane selects.
+        None when the part has no valid v2 sidecar for the column."""
+        from .bloom_device import stage_sb_plane
+        key = (part.uid, "#sbbloom", field)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is _UNSTAGEABLE:
+                return None
+            if got is None:
+                got = stage_sb_plane(part, field,
+                                     put=self._put_replicated)
                 if got is None:
                     self.cache.put_small(key, _UNSTAGEABLE)
                 else:

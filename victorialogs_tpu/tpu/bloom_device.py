@@ -219,3 +219,108 @@ def pad_probe_args(idx: np.ndarray, shift: np.ndarray,
     out_i[:b] = idx
     out_s[:b] = shift
     return out_i, out_s
+
+
+# ---------------- split-block (v2) probe ----------------
+# Sealed-part filter index (storage/filterindex): every token's 6
+# probe bits live in ONE 256-bit block, so the probe is a single
+# contiguous 8-lane gather per (block, token) + an AND-compare against
+# a per-token mask — no scattered lane selects.  Layout contract:
+#   plane  uint32[B, LP]   per-block sb filters, 0-padded
+#   sbidx  int32[B, T]     lane base of each token's selected block
+#                          (sb block index * 8; 0 when nsb==0)
+#   mask   uint32[T, 8]    the token's 256-bit probe mask
+#   nsb    int32[B]        0 => block has no filter => always keep
+# returns bool[B]: True where the block may contain ALL probed tokens.
+
+SB_PROBE_LANES = 8
+
+
+def probe_np_sb(plane: np.ndarray, sbidx: np.ndarray, mask: np.ndarray,
+                nsb: np.ndarray) -> np.ndarray:
+    """Vectorized host probe of the split-block layout; bit-identical
+    to sbbloom.sb_contains_all per block (tests/test_filterindex.py)."""
+    b, t = sbidx.shape
+    if t == 0:
+        return np.ones(b, dtype=bool)
+    lane = (sbidx[:, :, None]
+            + np.arange(SB_PROBE_LANES, dtype=np.int32)) \
+        .reshape(b, t * SB_PROBE_LANES)
+    words = np.take_along_axis(plane, lane, axis=1) \
+        .reshape(b, t, SB_PROBE_LANES)
+    ok = ((words & mask[None, :, :]) == mask[None, :, :]).all(axis=2)
+    return ok.all(axis=1) | (nsb == 0)
+
+
+def plane_keep_sb(plane, sbidx, mask, nsb):
+    """jnp split-block keep-mask; traceable inside the fused dispatch
+    (the `bloom_sb` program node in tpu/fused.py)."""
+    b, t = sbidx.shape
+    lane = (sbidx[:, :, None]
+            + jnp.arange(SB_PROBE_LANES, dtype=jnp.int32)) \
+        .reshape(b, t * SB_PROBE_LANES)
+    words = jnp.take_along_axis(plane, lane, axis=1) \
+        .reshape(b, t, SB_PROBE_LANES)
+    ok = jnp.all((words & mask[None, :, :]) == mask[None, :, :], axis=2)
+    return jnp.all(ok, axis=1) | (nsb == 0)
+
+
+@jax.jit
+def sb_plane_probe(plane, sbidx, mask, nsb):
+    """Standalone jitted sb probe -> bool[B] (bench/parity entry)."""
+    return plane_keep_sb(plane, sbidx, mask, nsb)
+
+
+@dataclass
+class StagedSBPlane:
+    """One part column's split-block plane resident in HBM."""
+    plane: object                  # jax uint32[Bp, LPp]
+    nsb: object                    # jax int32[Bp]; 0 = always keep
+    bp: int                        # padded block count
+    nbytes: int
+
+    def device_bytes(self) -> int:
+        return self.nbytes
+
+
+def stage_sb_plane(part, field: str, put) -> StagedSBPlane | None:
+    """Upload the sealed part's packed split-block plane; None when the
+    part has no v2 sidecar (or the column no sb filters) — the caller
+    falls back to the classic plane staging."""
+    from ..storage.filterindex import sb_plane_for_staging
+    got = sb_plane_for_staging(part, field)
+    if got is None:
+        return None
+    plane, nsb = pad_sb_plane(*got)
+    return StagedSBPlane(plane=put(plane), nsb=put(nsb),
+                         bp=plane.shape[0],
+                         nbytes=plane.nbytes + nsb.nbytes)
+
+
+def pad_sb_plane(plane: np.ndarray, nsb: np.ndarray
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Pad to device tiles exactly like pad_plane: block axis to a
+    PROBE_TILE_B multiple, lanes to a PROBE_LANE multiple (bucketing
+    jit signatures against part-shape churn).  Pad blocks carry nsb=0
+    (always keep) and all-zero lanes (safe to gather)."""
+    b, lp = plane.shape
+    bp = ((b + PROBE_TILE_B - 1) // PROBE_TILE_B) * PROBE_TILE_B
+    lpp = max(PROBE_LANE,
+              ((lp + PROBE_LANE - 1) // PROBE_LANE) * PROBE_LANE)
+    if bp == b and lpp == lp:
+        return plane, np.ascontiguousarray(nsb, dtype=np.int32)
+    out = np.zeros((bp, lpp), dtype=np.uint32)
+    out[:b, :lp] = plane
+    ns = np.zeros(bp, dtype=np.int32)
+    ns[:b] = nsb
+    return out, ns
+
+
+def pad_sb_idx(sbidx: np.ndarray, bp: int) -> np.ndarray:
+    """Pad per-block sb lane bases to the padded block count."""
+    b = sbidx.shape[0]
+    if bp == b:
+        return sbidx
+    out = np.zeros((bp, sbidx.shape[1]), dtype=np.int32)
+    out[:b] = sbidx
+    return out

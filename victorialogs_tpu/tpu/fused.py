@@ -58,7 +58,8 @@ from ..utils.hashing import cached_token_hashes
 from . import kernels as K
 from . import kernels32 as K32
 from .batch import device_plan, StatsLayout
-from .bloom_device import MAX_PALLAS_PROBES, pad_probe_args, plane_keep
+from .bloom_device import (MAX_PALLAS_PROBES, pad_probe_args, pad_sb_idx,
+                           plane_keep, plane_keep_sb)
 from .layout import (row_width_bucket, rows_with_multibyte, to_fixed_width,
                      to_lanes32)
 
@@ -443,7 +444,12 @@ class _Planner:
             hashes = cached_token_hashes(plan.filter, plan.bloom_tokens)
             bis = list(self.bss)
             keep = bloom_keep_mask(self.part, plan.field, hashes, bis)
-            if filter_bank(self.part).cached_plane(plan.field) \
+            from ..storage.filterindex import part_index
+            if part_index(self.part) is not None:
+                # same evidence counters _eval_leaf keeps: the v2
+                # maplet (exact) served this probe
+                self.runner._bump("maplet_probes")
+            elif filter_bank(self.part).cached_plane(plan.field) \
                     is not None:
                 # same evidence counter _eval_leaf keeps on the per-leaf
                 # path: the PLANE served this probe
@@ -514,9 +520,19 @@ class _Planner:
         gathers to rows through the staged block-id column — so the
         bloom kill bitmap ANDs against the scan tree without any host
         round-trip.  None (leaf keeps host-planning semantics only)
-        when staging declines or VL_DEVICE_BLOOM=0."""
+        when staging declines or VL_DEVICE_BLOOM=0.
+
+        Sealed parts with a v2 filter index ship the split-block
+        layout instead (storage/filterindex): all 6 probe bits of a
+        token live in one 256-bit block, so the device probe is ONE
+        contiguous 8-lane gather + AND-compare per (block, token)
+        (`bloom_sb` node, tpu/bloom_device.plane_keep_sb) instead of 6
+        scattered lane selects."""
         if os.environ.get("VL_DEVICE_BLOOM", "1") == "0":
             return None
+        sb_node = self._bloom_sb_node(field, hashes)
+        if sb_node is not None:
+            return sb_node
         sp = self.runner._stage_bloom_plane(self.part, field)
         if sp is None:
             return None
@@ -534,6 +550,25 @@ class _Planner:
         return ("bloom", self.arg(sp.plane), self.arg(sp.nwords),
                 self.arg(idx), self.arg(shift),
                 self.arg(bid.ids, row=True), use_pallas)
+
+    def _bloom_sb_node(self, field: str, hashes):
+        """The v2 split-block variant of _bloom_node, or None when the
+        part has no valid sidecar for the column (classic plane path
+        serves)."""
+        from ..storage.filterindex import part_index
+        fi = part_index(self.part)
+        if fi is None or not fi.has_sb(field):
+            return None
+        sp = self.runner._stage_sb_plane(self.part, field)
+        if sp is None:
+            return None
+        sbidx = pad_sb_idx(fi.sb_probe_idx(field, hashes), sp.bp)
+        mask = fi.sb_masks(hashes)
+        bid = self.runner._stage_block_ids(self.part, self.layout)
+        self.runner._kind("bloom_sb_device")
+        return ("bloom_sb", self.arg(sp.plane), self.arg(sp.nsb),
+                self.arg(sbidx), self.arg(mask),
+                self.arg(bid.ids, row=True))
 
     def _numrange_leaf(self, f: F.FilterRange):
         """`status:>=500`-family on int-typed columns: the uint32 offset
@@ -663,6 +698,12 @@ def _eval_node(node, args, rlp):
         _, pi, nwi, ii, si, bidi, use_pallas = node
         keep = plane_keep(args[pi], args[ii], args[si], args[nwi],
                           use_pallas=use_pallas)
+        return keep[args[bidi]], None
+    if kind == "bloom_sb":
+        # split-block layout (sealed-part filter index v2): one
+        # contiguous 8-lane gather + AND-compare per (block, token)
+        _, pi, ni, ii, mi, bidi = node
+        keep = plane_keep_sb(args[pi], args[ii], args[mi], args[ni])
         return keep[args[bidi]], None
     if kind == "lenrange":
         _, li, oi, mi, a, b, b4 = node

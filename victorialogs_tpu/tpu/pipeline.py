@@ -398,7 +398,8 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
     consumer only pulls the window's lookahead ahead of execution."""
     from ..engine.block_search import BlockSearch
     from ..engine.searcher import QueryCancelled
-    from ..storage.filterbank import part_aggregate_prunes
+    from ..storage.filterbank import (maplet_prune_candidates,
+                                      part_aggregate_prunes)
     pack_max = pack_limit()
     packable = pack_max > 1 and sort_spec is None
     rows_cap = pack_rows_cap(runner) if packable else 0
@@ -443,6 +444,18 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
                     build=len(bis) * 4 >= part.num_blocks):
                 runner._bump("agg_pruned_parts")
                 continue
+            if token_leaves:
+                # sealed v2 parts: exact maplet block pruning before
+                # staging/packing — the dropped blocks are the ones
+                # the in-dispatch kill would have zeroed anyway
+                pruned_bis = maplet_prune_candidates(part, token_leaves,
+                                                     bis)
+                if len(pruned_bis) != len(bis):
+                    runner._bump("maplet_pruned_blocks",
+                                 len(bis) - len(pruned_bis))
+                    bis = pruned_bis
+                if not bis:
+                    continue
             # registry progress at part granularity (the planning pull
             # IS the prune stage, so these land as the walk advances)
             activity.note_part_scanned(act, part, bis)
