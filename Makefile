@@ -5,7 +5,7 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test lint bench bench-bloom bench-pipeline \
+.PHONY: all native test race lint bench bench-bloom bench-pipeline \
 	bench-concurrent bench-emit bench-explain bench-faults \
 	bench-journal bench-wire clean
 
@@ -19,10 +19,23 @@ $(NATIVE_DIR)/libvlnative.so: $(NATIVE_DIR)/vlnative.cpp
 test:
 	python -m pytest tests/ -x -q
 
-# repo-native static analysis (tools/vlint/README.md) + a compile sweep.
-# Fails on any finding not in tools/vlint/baseline.json.
+# the concurrency suites under BOTH runtime sanitizers: the lock-order
+# shim (VLINT_LOCK_ORDER=1, cross-validated against the static graph at
+# session end) and the vlsan end-of-test invariant sweep (on by
+# default; VLSAN=0 kills it).  This is the ROADMAP standing gate's
+# "run periodically" instruction as one command.
+race:
+	VLINT_LOCK_ORDER=1 python -m pytest tests/test_storage_races.py \
+		tests/test_ingest_mt.py tests/test_concurrent_ingest.py \
+		tests/test_sched.py tests/test_chaos.py -q
+
+# repo-native static analysis (tools/vlint/README.md) + the README
+# env-table drift gate (generated from victorialogs_tpu/config.py) +
+# a compile sweep.  Fails on any finding not in
+# tools/vlint/baseline.json (which stays EMPTY: fix or annotate).
 lint:
 	python -m tools.vlint victorialogs_tpu/
+	python -m tools.vlint --check-env-table
 	python -m compileall -q victorialogs_tpu tools tests
 
 bench:

@@ -35,36 +35,56 @@ try:
 except Exception:  # pragma: no cover - plain environments need no surgery
     pass
 
-# ---- vlint runtime lock-order sanitizer (opt-in) ----
-# VLINT_LOCK_ORDER=1 wraps every threading.Lock constructed inside
-# victorialogs_tpu with an acquisition-order-recording shim
-# (tools/vlint/runtime.py).  Installed here, at conftest import, so it
-# precedes every storage/server object the tests build.  At session end
-# the observed acquisition graph must (a) contain no runtime-observed
-# cycle and (b) stay acyclic when merged with the static lock-order
-# graph from tools.vlint.locks — the race suites and the static
-# analyzer validate each other.
+# ---- vlsan runtime sanitizers (tools/vlint/vlsan.py) ----
+# Two layers under one umbrella:
+#
+# 1. end-of-test invariant sweep (opt-OUT, VLSAN=0 kills it): after
+#    every test, the budgets/registries the test touched must balance —
+#    sched leases, StagingCache bytes, bloom-bank charges, event-bus
+#    subscriptions, journal accounting, admission pools, non-daemon
+#    threads, no negative counters.  The runtime twin of the static
+#    tools/vlint/balance.py checker.
+# 2. the lock-order sanitizer (opt-IN, VLINT_LOCK_ORDER=1): wraps every
+#    threading.Lock constructed inside victorialogs_tpu with an
+#    acquisition-order-recording shim; at session end the observed
+#    graph must stay acyclic when merged with the static lock-order
+#    graph — the race suites and the static analyzer validate each
+#    other.  `make race` runs the concurrency suites with both on.
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_VLINT_SANITIZER = None
-if os.environ.get("VLINT_LOCK_ORDER") == "1":
-    import sys
+import sys  # noqa: E402
 
-    if _REPO_ROOT not in sys.path:
-        sys.path.insert(0, _REPO_ROOT)
-    from tools.vlint.runtime import install as _vlint_install
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-    _VLINT_SANITIZER = _vlint_install()
+from tools.vlint import vlsan as _vlsan  # noqa: E402
+
+_VLINT_SANITIZER = _vlsan.install_lock_order()
+_VLSAN = _vlsan.Sanitizer() if _vlsan.enabled() else None
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _vlsan_sweep():
+    """End-of-test invariant sweep (VLSAN=0 disables).  Baselines are
+    captured after higher-scoped fixtures exist, so a module-scoped
+    live server never reads as a leak — only what THIS test failed to
+    release does."""
+    if _VLSAN is None:
+        yield
+        return
+    _VLSAN.begin_test()
+    yield
+    problems = _VLSAN.sweep()
+    if problems:
+        pytest.fail("vlsan: " + "; ".join(problems), pytrace=False)
 
 
 def pytest_sessionfinish(session, exitstatus):
     if _VLINT_SANITIZER is None:
         return
-    from tools.vlint.locks import build_static_graph
-
-    edges, site_map = build_static_graph(
-        [os.path.join(_REPO_ROOT, "victorialogs_tpu")], root=_REPO_ROOT)
-    problems = _VLINT_SANITIZER.check_static_consistency(edges, site_map)
+    problems = _vlsan.lock_order_problems(_VLINT_SANITIZER, _REPO_ROOT)
     n_edges = len(_VLINT_SANITIZER.edges)
     if problems:
         print("\nvlint lock-order sanitizer FAILED "

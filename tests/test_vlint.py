@@ -942,3 +942,650 @@ def test_net_discipline_repo_cluster_hops_are_clean():
         found = [f for f in netdiscipline.check(sf)
                  if not sf.allowed(f.checker, f.line)]
         assert found == [], [f.render() for f in found]
+
+
+# ---------------- balance checker (acquire/release pairs) ----------------
+
+def test_balance_pair_registry_inventory():
+    """The declared registry covers every budgeted pair in the tree —
+    the checker is driven by it, vlsan enforces the runtime_only rows."""
+    from tools.vlint.balance import PAIRS
+    names = {p.name for p in PAIRS}
+    assert names == {"bloom-bank", "sched-lease", "admission",
+                     "staging-cache", "events-subscription",
+                     "journal-accounting", "net-probe", "insert-spool"}
+    runtime = {p.name for p in PAIRS if p.runtime_only}
+    assert runtime == {"staging-cache", "journal-accounting"}
+
+
+def test_balance_double_release_sequence():
+    """The PR 12 class seeded: a charge released twice drives the
+    bank budget negative (= unbounded)."""
+    out = lint("""
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+        def seal(nbytes):
+            if not _bank_try_charge(nbytes):
+                return False
+            _bank_release([nbytes])
+            _bank_release([nbytes])
+            return True
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-double-release" in checkers(out)
+    assert any("negative" in f.message for f in out)
+
+
+def test_balance_double_release_except_plus_finally():
+    out = lint("""
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+        def seal(nbytes, build):
+            if not _bank_try_charge(nbytes):
+                return None
+            try:
+                return build()
+            except RuntimeError:
+                _bank_release([nbytes])
+                raise
+            finally:
+                _bank_release([nbytes])
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-double-release" in checkers(out)
+
+
+def test_balance_release_in_loop_with_acquire_outside():
+    out = lint("""
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+        def seal(parts, nbytes):
+            if not _bank_try_charge(nbytes):
+                return
+            try:
+                for p in parts:
+                    _bank_release([nbytes])
+            finally:
+                pass
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-double-release" in checkers(out)
+
+
+def test_balance_unguarded_acquire_flagged_and_finalize_clean():
+    bad = lint("""
+        from victorialogs_tpu.storage.filterbank import _bank_try_charge
+
+        def charge(n, stage):
+            if _bank_try_charge(n):
+                stage(n)
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-unguarded-acquire" in checkers(bad)
+    good = lint("""
+        import weakref
+
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+        class Bank:
+            def __init__(self):
+                self._charged = []
+                weakref.finalize(self, _bank_release, self._charged)
+
+            def charge(self, n, stage):
+                if _bank_try_charge(n):
+                    self._charged.append(n)
+                    stage(n)
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-unguarded-acquire" not in checkers(good)
+    guarded = lint("""
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+        def charge(n, stage):
+            if not _bank_try_charge(n):
+                return
+            try:
+                stage(n)
+            finally:
+                _bank_release([n])
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert "balance-unguarded-acquire" not in checkers(guarded)
+
+
+def test_balance_sched_lease_outside_scope():
+    bad = lint("""
+        def f(scope):
+            if scope.try_acquire():
+                return True
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "balance-unguarded-acquire" in checkers(bad)
+    good = lint("""
+        from victorialogs_tpu import sched
+
+        def f(act, submit):
+            with sched.device_slots(act) as slots:
+                if slots.try_acquire():
+                    submit()
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert "balance-unguarded-acquire" not in checkers(good)
+
+
+def test_balance_admit_outside_with():
+    bad = lint("""
+        def f(pool):
+            t = pool.admit("0:0", "/select/logsql/query")
+            return t
+    """, path="victorialogs_tpu/server/mod.py")
+    assert "balance-ctx" in checkers(bad)
+    good = lint("""
+        def f(pool, run):
+            with pool.admit("0:0", "/select/logsql/query"):
+                return run()
+    """, path="victorialogs_tpu/server/mod.py")
+    assert "balance-ctx" not in checkers(good)
+
+
+def test_balance_subscribe_needs_unsubscribe_in_file():
+    bad = lint("""
+        from victorialogs_tpu.obs import events
+
+        class Watcher:
+            def __init__(self):
+                events.subscribe(self._on_event)
+
+            def _on_event(self, ts_ns, event, fields):
+                pass
+    """, path="victorialogs_tpu/obs/mod.py")
+    assert "balance-unguarded-acquire" in checkers(bad)
+    good = lint("""
+        from victorialogs_tpu.obs import events
+
+        class Watcher:
+            def __init__(self):
+                events.subscribe(self._on_event)
+
+            def _on_event(self, ts_ns, event, fields):
+                pass
+
+            def close(self):
+                events.unsubscribe(self._on_event)
+    """, path="victorialogs_tpu/obs/mod.py")
+    assert "balance-unguarded-acquire" not in checkers(good)
+
+
+def test_balance_net_probe_must_resolve():
+    bad = lint("""
+        def send(br, do_net):
+            if not br.allow_insert():
+                return None
+            return do_net()
+    """, path="victorialogs_tpu/server/mod.py")
+    assert "balance-unguarded-acquire" in checkers(bad)
+    good = lint("""
+        def send(br, do_net):
+            if not br.allow_insert():
+                return None
+            try:
+                out = do_net()
+                br.on_success()
+                return out
+            finally:
+                br.abandon_probe()
+    """, path="victorialogs_tpu/server/mod.py")
+    assert "balance-unguarded-acquire" not in checkers(good)
+
+
+def test_callable_identity_flagged_and_equality_clean():
+    """The PR 8 class seeded: `is` against a bound method never
+    matches — every unsubscribe leaked its subscription."""
+    bad = lint("""
+        class Journal:
+            def _on_event(self, ts_ns, event, fields):
+                pass
+
+            def remove(self, subs):
+                return tuple(s for s in subs
+                             if s is not self._on_event)
+    """)
+    assert "callable-identity" in checkers(bad)
+    good = lint("""
+        class Journal:
+            def _on_event(self, ts_ns, event, fields):
+                pass
+
+            def remove(self, subs):
+                return tuple(s for s in subs
+                             if s != self._on_event)
+    """)
+    assert "callable-identity" not in checkers(good)
+    # `is` on plain data attributes stays legal (sentinel compares)
+    sentinel = lint("""
+        class C:
+            def __init__(self, cb):
+                self._cb = cb
+
+            def same(self, other):
+                return other is self._cb
+    """)
+    assert "callable-identity" not in checkers(sentinel)
+
+
+# ---------------- config/metrics registry drift ----------------
+
+def test_env_registry_flags_raw_read():
+    out = lint("""
+        import os
+
+        def wire_typed():
+            return os.environ.get("VL_WIRE_TYPED", "1") != "0"
+    """)
+    assert "env-registry" in checkers(out)
+    out2 = lint("""
+        import os
+
+        def wire_typed():
+            return os.getenv("VL_WIRE_TYPED")
+    """)
+    assert "env-registry" in checkers(out2)
+    out3 = lint("""
+        import os
+
+        def wire_typed():
+            return os.environ["VL_WIRE_TYPED"]
+    """)
+    assert "env-registry" in checkers(out3)
+
+
+def test_env_registry_flags_undeclared_name():
+    out = lint("""
+        from victorialogs_tpu import config
+
+        def f():
+            return config.env("VL_TOTALLY_UNDECLARED")
+    """)
+    assert "env-registry" in checkers(out)
+    good = lint("""
+        from victorialogs_tpu import config
+
+        def f():
+            return config.env_flag("VL_SCHED")
+    """)
+    assert "env-registry" not in checkers(good)
+
+
+def test_env_registry_repo_is_rerouted():
+    """No raw environ read anywhere in victorialogs_tpu/ outside
+    config.py (the CLI envflag mirror carries its annotation)."""
+    found = run_paths([os.path.join(REPO, "victorialogs_tpu")],
+                      root=REPO)
+    raw = [f for f in found if f.checker == "env-registry"]
+    assert raw == [], [f.render() for f in raw]
+
+
+def test_metric_registry_flags_undeclared():
+    out = lint("""
+        def f(metrics):
+            metrics.inc("vl_bogus_thing_total")
+    """)
+    assert "metric-registry" in checkers(out)
+    out2 = lint("""
+        from victorialogs_tpu.obs import hist
+
+        H = hist.histogram("vl_bogus_hist_seconds", "nope", (1, 2))
+    """)
+    assert "metric-registry" in checkers(out2)
+    good = lint("""
+        def f(metrics):
+            metrics.inc("vl_http_errors_total")
+    """)
+    assert "metric-registry" not in checkers(good)
+
+
+def test_metric_double_roll_flagged():
+    """The PR 4 / PR 6 class seeded: one event accumulated at two
+    sites double-counts the series."""
+    out = lint("""
+        def cancel(metrics):
+            metrics.inc("vl_queries_cancelled_total")
+
+        def cancel_http(metrics):
+            metrics.inc("vl_queries_cancelled_total")
+    """)
+    assert "metric-double-roll" in checkers(out)
+    # multi-site counters that are DECLARED multi-site stay legal
+    good = lint("""
+        def a(metrics):
+            metrics.inc("vl_http_errors_total")
+
+        def b(metrics):
+            metrics.inc("vl_http_errors_total")
+    """)
+    assert "metric-double-roll" not in checkers(good)
+
+
+def test_canonical_helper_flags_inline_splitmix():
+    """The PR 7/10/12 inline-copy-drift class seeded: a hand-copied
+    splitmix64 finalizer outside utils/hashing.py."""
+    out = lint("""
+        def my_hash(x):
+            x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+            z = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) \\
+                & 0xFFFFFFFFFFFFFFFF
+            return z
+    """)
+    assert "canonical-helper" in checkers(out)
+    # the canonical module itself is exempt
+    clean = lint("""
+        def my_hash(x):
+            return (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    """, path="victorialogs_tpu/utils/hashing.py")
+    assert "canonical-helper" not in checkers(clean)
+
+
+def test_canonical_helper_flags_inline_fastrange():
+    out = lint("""
+        import numpy as np
+
+        def block_select(h, m):
+            return (h * m) >> np.uint64(32)
+    """)
+    assert "canonical-helper" in checkers(out)
+    clean = lint("""
+        import numpy as np
+
+        def block_select(h, m):
+            return (h * m) >> np.uint64(32)
+    """, path="victorialogs_tpu/storage/filterindex/sbbloom.py")
+    assert "canonical-helper" not in checkers(clean)
+
+
+def test_env_table_matches_registry():
+    """README env table is byte-identical to the generated one —
+    the same gate `make lint` runs."""
+    from tools.vlint.__main__ import check_env_table
+    assert check_env_table() == 0
+
+
+def test_config_registry_shape():
+    from tools.vlint.registry import config_module
+    cfg = config_module()
+    for m in cfg.metric_decls().values():
+        if m.kind == "counter":
+            assert m.name.endswith("_total"), m.name
+        if m.kind == "gauge":
+            assert not m.name.endswith("_total"), m.name
+    for v in cfg.env_vars().values():
+        assert v.doc and v.display, v.name
+    import pytest
+    with pytest.raises(cfg.UndeclaredEnvVar):
+        cfg.env("VL_NOT_A_THING")
+
+
+# ---------------- annotation hygiene ----------------
+
+def test_bare_annotation_is_a_finding():
+    out = lint("""
+        # vlint: allow-wall-clock
+        import time
+
+        def f():
+            return time.time()
+    """)
+    assert "annotation-reason" in checkers(out)
+    # AND the bare form never suppressed the underlying finding
+    assert "wall-clock" in checkers(out)
+
+
+def test_empty_reason_is_a_finding():
+    out = lint("""
+        # vlint: allow-wall-clock( )
+        import time
+
+        def f():
+            return time.time()
+    """)
+    assert "annotation-reason" in checkers(out)
+
+
+def test_reasoned_annotation_is_clean():
+    out = lint("""
+        import time
+
+        def f():
+            # vlint: allow-wall-clock(row timestamps are wall time)
+            return time.time()
+    """)
+    assert "annotation-reason" not in checkers(out)
+    assert "wall-clock" not in checkers(out)
+
+
+# ---------------- parallel runner + cache ----------------
+
+def test_parallel_jobs_match_serial(tmp_path):
+    src_ok = "x = 1\n"
+    src_bad = ("import time\n\n\ndef f():\n"
+               "    return time.time()\n")
+    for i in range(4):
+        (tmp_path / f"m{i}.py").write_text(src_bad if i % 2 else src_ok)
+    serial = run_paths([str(tmp_path)], root=str(tmp_path), jobs=1)
+    para = run_paths([str(tmp_path)], root=str(tmp_path), jobs=2)
+    assert [f.render() for f in serial] == [f.render() for f in para]
+    assert any(f.checker == "wall-clock" for f in serial)
+
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+    cache = str(tmp_path / "cache.json")
+    first = run_paths([str(mod)], root=str(tmp_path), cache_path=cache)
+    assert any(f.checker == "wall-clock" for f in first)
+    assert os.path.exists(cache)
+    # warm: identical findings straight from the cache
+    warm = run_paths([str(mod)], root=str(tmp_path), cache_path=cache)
+    assert [f.render() for f in first] == [f.render() for f in warm]
+    # content change invalidates just that file
+    mod.write_text("x = 1\n")
+    third = run_paths([str(mod)], root=str(tmp_path), cache_path=cache)
+    assert third == []
+
+
+# ---------------- --explain CLI ----------------
+
+def test_explain_cli(tmp_path, capsys):
+    from tools.vlint.__main__ import main
+    mod = tmp_path / "m.py"
+    mod.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+    rc = main(["--json", "--no-baseline", "--no-cache", str(mod)])
+    out = capsys.readouterr().out
+    import json as _json
+    finding = _json.loads(out)["findings"][0]
+    assert rc == 1
+    rc = main(["--explain", finding["fingerprint"], str(mod)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "wall-clock" in text
+    assert "allow-wall-clock(" in text        # the annotation recipe
+    assert "tools/vlint/hygiene.py" in text   # the checker doc source
+    # unknown fingerprint: clean error, exit 1
+    rc = main(["--explain", "ffffffffffffffff", str(mod)])
+    assert rc == 1
+
+
+def test_baseline_stays_empty():
+    """Fix-or-annotate discipline: the committed baseline has zero
+    entries and the repo is clean against it."""
+    baseline = load_baseline()
+    assert baseline == {}
+
+
+# ---------------- vlsan: end-of-test invariant sanitizer ----------------
+
+def test_vlsan_clean_on_idle_process():
+    from tools.vlint import vlsan
+    san = vlsan.Sanitizer()
+    san.begin_test()
+    assert san.sweep() == []
+
+
+def test_vlsan_detects_subscriber_leak():
+    from tools.vlint import vlsan
+    from victorialogs_tpu.obs import events
+    san = vlsan.Sanitizer()
+    san.begin_test()
+
+    def cb(ts_ns, event, fields):
+        pass
+
+    events.subscribe(cb)
+    try:
+        problems = san.sweep()
+        assert any("subscriber" in p for p in problems), problems
+    finally:
+        events.unsubscribe(cb)
+    assert san.sweep() == []
+
+
+def test_vlsan_detects_bank_double_release():
+    """The historical negative-budget class, reproduced live: one
+    release too many drives _bank_bytes negative and the sweep says
+    so."""
+    from tools.vlint import vlsan
+    from victorialogs_tpu.storage import filterbank as fb
+    san = vlsan.Sanitizer()
+    san.begin_test()
+    fb._bank_release([4096])         # release with no matching charge
+    try:
+        problems = san.sweep()
+        assert any("bank" in p for p in problems), problems
+    finally:
+        assert fb._bank_try_charge(4096)   # restore the budget
+    assert san.sweep() == []
+
+
+def test_vlsan_detects_journal_imbalance():
+    from tools.vlint import vlsan
+    from victorialogs_tpu.obs import journal
+
+    class _Sink:
+        def must_add_rows(self, lr):
+            pass
+
+    san = vlsan.Sanitizer()
+    san.begin_test()
+    w = journal.JournalWriter(_Sink(), app="vlsan-test")
+    try:
+        ok, _ = w.check_balanced()
+        assert ok
+        w.accepted += 3                  # forge a torn counter
+        problems = san.sweep()
+        assert any("journal" in p for p in problems), problems
+        w.accepted -= 3
+    finally:
+        w.close()
+    assert san.sweep() == []
+
+
+def test_vlsan_detects_sched_imbalance():
+    from tools.vlint import vlsan
+    from victorialogs_tpu import sched
+    san = vlsan.Sanitizer()
+    san.begin_test()
+    scope = sched.device_slots(None, tenant="0:0")
+    scope.__enter__()
+    assert scope.try_acquire()
+    try:
+        problems = san.sweep()
+        assert any("lease" in p for p in problems), problems
+    finally:
+        scope.__exit__(None, None, None)
+    assert san.sweep() == []
+
+
+def test_vlsan_kill_switch(monkeypatch):
+    from tools.vlint import vlsan
+    monkeypatch.setenv("VLSAN", "0")
+    assert not vlsan.enabled()
+    monkeypatch.delenv("VLSAN")
+    assert vlsan.enabled()
+
+
+# ---------------- post-review regressions ----------------
+
+def test_journal_balance_survives_overflow_drops():
+    """Queue-bound drops were never accepted — the invariant must hold
+    through overflow, not just post-accept drops."""
+    from victorialogs_tpu.obs import journal
+
+    class _Sink:
+        def must_add_rows(self, lr):
+            pass
+
+    w = journal.JournalWriter(_Sink(), max_queue=2, app="vlsan-test")
+    try:
+        for _ in range(5):
+            w._on_event(1, "e", {})
+        ok, detail = w.check_balanced()
+        assert ok, detail
+        assert w.stats()["dropped"] == 3     # public total unchanged
+    finally:
+        w.close()
+    ok, detail = w.check_balanced()
+    assert ok, detail
+
+
+def test_scoped_run_preserves_cache(tmp_path):
+    """A single-file run must not evict the rest of the repo's cache
+    entries (only vanished files are pruned)."""
+    for name in ("a.py", "b.py"):
+        (tmp_path / name).write_text("x = 1\n")
+    cache = str(tmp_path / "c.json")
+    run_paths([str(tmp_path)], root=str(tmp_path), cache_path=cache)
+    import json as _json
+    with open(cache) as f:
+        assert len(_json.load(f)["files"]) == 2
+    run_paths([str(tmp_path / "a.py")], root=str(tmp_path),
+              cache_path=cache)
+    with open(cache) as f:
+        kept = _json.load(f)["files"]
+    assert set(kept) == {"a.py", "b.py"}
+    (tmp_path / "b.py").unlink()
+    run_paths([str(tmp_path / "a.py")], root=str(tmp_path),
+              cache_path=cache)
+    with open(cache) as f:
+        assert set(_json.load(f)["files"]) == {"a.py"}
+
+
+def test_explain_resolves_global_pass_fingerprint(tmp_path, capsys):
+    """metric-double-roll / lock-order-cycle findings come from the
+    cross-file passes — --explain must find their fingerprints too."""
+    from tools.vlint.__main__ import main
+    (tmp_path / "m.py").write_text(
+        'def a(m):\n    m.inc("vl_queries_cancelled_total")\n\n\n'
+        'def b(m):\n    m.inc("vl_queries_cancelled_total")\n')
+    rc = main(["--json", "--no-baseline", "--no-cache", str(tmp_path)])
+    import json as _json
+    fnd = _json.loads(capsys.readouterr().out)["findings"]
+    dbl = [f for f in fnd if f["checker"] == "metric-double-roll"]
+    assert rc == 1 and dbl
+    rc = main(["--explain", dbl[0]["fingerprint"], str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "metric-double-roll" in out and "registry.py" in out
+
+
+def test_checker_module_map_covers_all_ids():
+    """--explain cites the right checker source for every id the
+    checkers can emit (the hygiene ids were once mis-keyed)."""
+    from tools.vlint.core import checker_module_for
+    for cid, mod in (("nondaemon-thread", "hygiene"),
+                     ("broad-except", "hygiene"),
+                     ("lock-order-cycle", "locks"),
+                     ("jax-host-sync", "hotpath"),
+                     ("per-row-emit", "hotpath"),
+                     ("balance-double-release", "balance"),
+                     ("callable-identity", "balance"),
+                     ("metric-double-roll", "registry"),
+                     ("env-registry", "registry"),
+                     ("annotation-reason", "core")):
+        assert checker_module_for(cid) == mod, cid

@@ -1,17 +1,135 @@
 """CLI: python -m tools.vlint [paths...] [options].
 
 Exit codes: 0 = clean (no findings beyond the baseline), 1 = new
-findings, 2 = usage error.
+findings / drifted env table, 2 = usage error.
+
+Hygiene subcommands:
+
+- ``--explain <fingerprint>`` prints one finding in full: the rendered
+  site, the implementing checker's documentation, and the
+  allow-annotation recipe — the fix-or-annotate decision aid.
+- ``--check-env-table`` verifies the README env-var table is exactly
+  the table generated from victorialogs_tpu/config.py
+  (``--print-env-table`` regenerates it); wired into ``make lint`` so
+  doc drift fails the build.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
 
-from .core import (BASELINE_DEFAULT, load_baseline, new_findings,
-                   run_paths, write_baseline)
+from .core import (BASELINE_DEFAULT, CACHE_DEFAULT, checker_module_for,
+                   load_baseline, new_findings, run_paths,
+                   write_baseline)
+
+_README = os.path.normpath(os.path.join(
+    os.path.dirname(__file__), "..", "..", "README.md"))
+
+_ENV_BEGIN = "<!-- env-table:begin (generated from victorialogs_tpu/config.py — edit there, `python -m tools.vlint --print-env-table`) -->"
+_ENV_END = "<!-- env-table:end -->"
+
+
+def _generated_env_table() -> str:
+    from .registry import config_module
+    return config_module().render_env_table()
+
+
+def _readme_env_table() -> str | None:
+    try:
+        with open(_README, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    m = re.search(re.escape(_ENV_BEGIN) + r"\n(.*?)" + re.escape(_ENV_END),
+                  text, re.S)
+    return m.group(1) if m else None
+
+
+def check_env_table() -> int:
+    want = _generated_env_table()
+    got = _readme_env_table()
+    if got is None:
+        print("vlint: README.md has no env-table markers "
+              f"({_ENV_BEGIN!r}) — add them around the environment "
+              "variable table")
+        return 1
+    if got != want:
+        print("vlint: README env-var table drifted from the registry "
+              "(victorialogs_tpu/config.py).  Regenerate the section "
+              "with `python -m tools.vlint --print-env-table` — the "
+              "registry declaration is the single source of truth.")
+        import difflib
+        for line in difflib.unified_diff(
+                got.splitlines(), want.splitlines(),
+                "README.md", "generated", lineterm="", n=1):
+            print("  " + line)
+        return 1
+    print("vlint: README env-var table matches the registry "
+          f"({len(want.splitlines()) - 2} vars)")
+    return 0
+
+
+def explain(fingerprint: str, paths: list[str]) -> int:
+    """Print one finding (matched by fingerprint prefix) with its
+    checker doc and the annotation recipe.  Annotated findings are
+    searched too — you can explain a fingerprint somebody else already
+    triaged."""
+    from . import core, registry
+    from .core import SourceFile, check_annotations
+    from .locks import _analyze, check_edge_cycles
+
+    matches = []
+    all_edges = []
+    all_rolls = []
+    for fp in core.iter_py_files(paths):
+        rel = os.path.relpath(fp, ".")
+        try:
+            sf = SourceFile.parse(fp, display_path=rel)
+        except SyntaxError:
+            continue
+        found = []
+        for chk in core._checkers():
+            found.extend(chk(sf))
+        found.extend(check_annotations(sf))
+        _, edges, _ = _analyze(sf)
+        all_edges.extend(edges)
+        all_rolls.extend(registry.collect_roll_sites(sf))
+        for f in found:
+            if f.fingerprint().startswith(fingerprint):
+                matches.append(f)
+    # the cross-file passes produce findings too (lock-order-cycle,
+    # metric-double-roll) — their fingerprints must be explainable
+    for f in check_edge_cycles(all_edges) + \
+            registry.check_global_rolls(all_rolls):
+        if f.fingerprint().startswith(fingerprint):
+            matches.append(f)
+    if not matches:
+        print(f"vlint: no finding with fingerprint {fingerprint!r} "
+              f"under {' '.join(paths)} (annotated sites included in "
+              "the search)")
+        return 1
+    for f in matches:
+        mod_name = checker_module_for(f.checker)
+        print(f"finding   {f.fingerprint()}")
+        print(f"site      {f.render()}")
+        print(f"checker   {f.checker} (tools/vlint/{mod_name}.py)")
+        import importlib
+        mod = importlib.import_module(f"tools.vlint.{mod_name}") \
+            if mod_name != "core" else core
+        doc = (mod.__doc__ or "").strip()
+        if doc:
+            print("\n" + doc + "\n")
+        print("to accept this site deliberately, annotate the line "
+              "above it (or the def line to cover the function):")
+        print(f"  # vlint: allow-{f.checker}(<why this site is safe>)")
+        print("the reason is mandatory — a bare annotation is itself "
+              "a finding (annotation-reason).  The baseline stays "
+              "empty: fix or annotate, never regenerate.")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -29,10 +147,37 @@ def main(argv=None) -> int:
                     help="accept all current findings into the baseline")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="process-pool width for cold files "
+                         "(default: cpu count)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-hash result cache "
+                         "(tools/vlint/.cache.json)")
+    ap.add_argument("--explain", metavar="FINGERPRINT",
+                    help="print one finding, its checker doc and the "
+                         "allow-annotation recipe")
+    ap.add_argument("--check-env-table", action="store_true",
+                    help="verify the README env table matches the "
+                         "config registry")
+    ap.add_argument("--print-env-table", action="store_true",
+                    help="print the registry-generated README env "
+                         "table section")
     args = ap.parse_args(argv)
     paths = args.paths or ["victorialogs_tpu"]
 
-    findings = run_paths(paths)
+    if args.print_env_table:
+        sys.stdout.write(_ENV_BEGIN + "\n" + _generated_env_table()
+                         + _ENV_END + "\n")
+        return 0
+    if args.check_env_table:
+        return check_env_table()
+    if args.explain:
+        return explain(args.explain, paths)
+
+    jobs = args.jobs if args.jobs is not None else \
+        (os.cpu_count() or 1)
+    cache_path = None if args.no_cache else CACHE_DEFAULT
+    findings = run_paths(paths, jobs=jobs, cache_path=cache_path)
     if args.write_baseline:
         write_baseline(findings, args.baseline)
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")

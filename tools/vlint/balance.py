@@ -1,0 +1,425 @@
+"""Acquire/release balance checker, driven by a declared pair registry.
+
+Every budgeted resource in the tree pairs an acquire with a release,
+and CHANGES.md shows the same two failure classes re-found by review
+in four different subsystems: an exception path that escapes an
+acquire without a guaranteed release (leak), and a release reachable
+twice on one path (PR 12's double-released sb-plane charge drove the
+bloom-bank budget negative = unbounded).  The PAIRS registry below
+declares each pair once; the checker applies flow rules per pair:
+
+- balance-unguarded-acquire: an acquire call whose enclosing function
+  (or class, for charges released by a class-registered finalizer)
+  guarantees no release: no ``try/finally`` releasing the pair, no
+  enclosing ``with`` over the pair's scope opener, and no
+  ``weakref.finalize(..., <releaser>, ...)`` registration.
+- balance-double-release: a release reachable twice on one path —
+  the same pair released in BOTH an except handler and the finally of
+  one try statement, released twice in one statement sequence with no
+  intervening acquire, or released inside a loop whose acquire sits
+  outside it.  Code lexically inside a ``with`` over the pair's scope
+  opener is exempt: the scope's ``__exit__`` drain owns balance there
+  (that is what the context-manager-only disciplines exist for).
+- balance-ctx: a pair whose opener is context-manager-only
+  (``admission.admit``) called outside a ``with`` item.
+- callable-identity: ``is``/``is not`` comparison against a bound
+  method (an attribute access naming a method of a class in the same
+  file).  A bound method is a FRESH object per attribute access, so
+  identity never matches — PR 8's ``is``-matched unsubscribe leaked
+  every journal subscription.  Equality is what these sites need.
+
+Pairs enforced at runtime instead (vlsan, tools/vlint/vlsan.py) are
+declared with ``runtime_only=True`` so the registry stays the single
+inventory of balance invariants: StagingCache charge==entries,
+journal accepted==written+dropped(+queued), scheduler/admission
+drained, bank bytes == sum of live charges.
+
+The implementing module of a pair (the file defining its acquire or
+release functions) is exempt — it plays by its own rules.  Deliberate
+sites elsewhere carry ``# vlint: allow-<checker>(<why>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Finding, SourceFile
+
+
+@dataclass(frozen=True)
+class Pair:
+    name: str
+    doc: str
+    acquires: tuple = ()
+    releases: tuple = ()
+    scope_openers: tuple = ()   # with-openers whose exit drains the pair
+    finalizers: tuple = ()      # weakref.finalize callbacks that release
+    paths: tuple = ()           # path substrings where the pair applies
+    ctx_only: tuple = ()        # openers that must sit in a with item
+    file_balance: bool = False  # acquire in file => release in same file
+    runtime_only: bool = False  # enforced by vlsan, not statically
+
+
+PAIRS: tuple[Pair, ...] = (
+    Pair("bloom-bank",
+         "filterbank host-plane budget: every won _bank_try_charge is "
+         "released exactly once at part GC via a weakref.finalize over "
+         "_bank_release (double release = negative budget = unbounded)",
+         acquires=("_bank_try_charge",), releases=("_bank_release",),
+         finalizers=("_bank_release",),
+         paths=("victorialogs_tpu/storage/",)),
+    Pair("sched-lease",
+         "shared dispatch budget: slot leases live inside a "
+         "sched.device_slots(...) scope whose exit drains every held "
+         "lease (lease-discipline pins the with-item form)",
+         acquires=("try_acquire",), releases=(),
+         scope_openers=("device_slots",),
+         paths=("victorialogs_tpu/tpu/", "victorialogs_tpu/sched/",
+                "victorialogs_tpu/engine/")),
+    Pair("admission",
+         "admission pools: admit() is context-manager-only — the "
+         "with-block releases concurrency + bytes accounting on every "
+         "exit path (shed, cancel, disconnect, error)",
+         ctx_only=("admit",),
+         paths=("victorialogs_tpu/",)),
+    Pair("staging-cache",
+         "StagingCache byte budget: charge at put, release at "
+         "eviction; check_balanced() proves bytes == sum of live "
+         "entries (vlsan sweeps it after every test)",
+         runtime_only=True),
+    Pair("events-subscription",
+         "event bus: every events.subscribe(fn) needs a reachable "
+         "events.unsubscribe in the same file, and unsubscribe matches "
+         "by EQUALITY (bound methods are fresh objects per access)",
+         acquires=("subscribe",), releases=("unsubscribe",),
+         file_balance=True,
+         paths=("victorialogs_tpu/",)),
+    Pair("journal-accounting",
+         "journal writer: accepted == rows_written + dropped (+ still "
+         "queued/in-flight) on every path incl. close against a dead "
+         "sink (vlsan sweeps live writers after every test)",
+         runtime_only=True),
+    Pair("net-probe",
+         "circuit breaker half-open probe: a slot reserved by "
+         "allow()/allow_insert() must resolve via on_success/"
+         "on_failure or abandon_probe in the same function (an "
+         "unresolved probe wedges the breaker half-open forever)",
+         acquires=("allow_insert",),
+         releases=("on_success", "on_failure", "abandon_probe"),
+         paths=("victorialogs_tpu/server/",)),
+    Pair("insert-spool",
+         "durable ingest spool: a PersistentQueue push needs a "
+         "matching ack after successful replay in the same file, or "
+         "spooled batches replay forever",
+         acquires=("push",), releases=("ack",), file_balance=True,
+         paths=("victorialogs_tpu/server/",)),
+)
+
+
+def pair_registry() -> tuple[Pair, ...]:
+    return PAIRS
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _call_name(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return _dotted(call.func).split(".")[-1]
+
+
+def _calls_in(node, names: tuple) -> list[ast.Call]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _call_name(n) in names:
+            out.append(n)
+    return out
+
+
+def _has_finalize(node, finalizers: tuple) -> bool:
+    """A weakref.finalize(obj, <releaser>, ...) registration anywhere
+    under `node` — the ownership-transfer form of a guaranteed
+    release."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                _dotted(n.func).endswith("finalize"):
+            for a in n.args:
+                if _dotted(a).split(".")[-1] in finalizers:
+                    return True
+    return False
+
+
+def _defines(node, names: tuple) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                n.name in names:
+            return True
+    return False
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    path = sf.path.replace("\\", "/")
+    findings: list[Finding] = []
+    applicable = [p for p in PAIRS if not p.runtime_only and
+                  (not p.paths or any(s in path for s in p.paths))]
+    if applicable:
+        findings.extend(_check_pairs(sf, path, applicable))
+    findings.extend(_check_callable_identity(sf))
+    return findings
+
+
+def _check_pairs(sf: SourceFile, path: str,
+                 pairs: list[Pair]) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # with-item call ids (ctx_only rule) and, per node, the set of
+    # opener names of enclosing withs (scope-coverage rule)
+    with_item_calls: set[int] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_item_calls.add(id(item.context_expr))
+
+    def enclosing_openers(stack) -> set:
+        names = set()
+        for w in stack:
+            for item in w.items:
+                if isinstance(item.context_expr, ast.Call):
+                    names.add(_call_name(item.context_expr))
+        return names
+
+    # which pairs is this file the implementation of?
+    impl: set = set()
+    for p in pairs:
+        if _defines(sf.tree, p.acquires + p.releases + p.ctx_only):
+            impl.add(p.name)
+
+    def visit(node, sym, func_stack, class_stack, with_stack):
+        for child in ast.iter_child_nodes(node):
+            c_sym = sym
+            f_stack, c_stack, w_stack = func_stack, class_stack, \
+                with_stack
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                c_sym = f"{sym}.{child.name}" if sym else child.name
+                f_stack = func_stack + [child]
+            elif isinstance(child, ast.ClassDef):
+                c_sym = f"{sym}.{child.name}" if sym else child.name
+                c_stack = class_stack + [child]
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                w_stack = with_stack + [child]
+            if isinstance(child, ast.Call):
+                name = _call_name(child)
+                for p in pairs:
+                    if p.name in impl:
+                        continue
+                    if name in p.ctx_only and \
+                            id(child) not in with_item_calls:
+                        findings.append(Finding(
+                            "balance-ctx", sf.path, child.lineno, sym,
+                            f"{name}(...) outside a with item — the "
+                            f"{p.name} pair releases on scope exit; "
+                            f"open it via `with ...{name}(...):`"))
+                    if name in p.acquires:
+                        _check_acquire(p, child, sym, func_stack,
+                                       class_stack, with_stack)
+            visit(child, c_sym, f_stack, c_stack, w_stack)
+
+    def _check_acquire(p: Pair, call, sym, func_stack, class_stack,
+                       with_stack):
+        if p.file_balance:
+            if not _calls_in(sf.tree, p.releases):
+                findings.append(Finding(
+                    "balance-unguarded-acquire", sf.path, call.lineno,
+                    sym,
+                    f"{_call_name(call)}(...) [{p.name}] with no "
+                    f"reachable {'/'.join(p.releases)} in this file — "
+                    f"{p.doc.split(':')[0]} leaks"))
+            return
+        # lexically inside a with over the pair's scope opener: the
+        # scope exit drains the pair
+        if p.scope_openers and \
+                enclosing_openers(with_stack) & set(p.scope_openers):
+            return
+        func = func_stack[-1] if func_stack else None
+        cls = class_stack[-1] if class_stack else None
+        scope = func if func is not None else sf.tree
+        guaranteed = False
+        # try/finally releasing the pair, anywhere in the function
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Try) and n.finalbody:
+                for fb in n.finalbody:
+                    if _calls_in(fb, p.releases):
+                        guaranteed = True
+        # weakref.finalize registration in the function or its class
+        if not guaranteed and p.finalizers:
+            if _has_finalize(scope, p.finalizers) or \
+                    (cls is not None and
+                     _has_finalize(cls, p.finalizers)):
+                guaranteed = True
+        if not guaranteed:
+            want = "/".join(p.releases + tuple(
+                f"weakref.finalize(..{f}..)" for f in p.finalizers))
+            findings.append(Finding(
+                "balance-unguarded-acquire", sf.path, call.lineno, sym,
+                f"{_call_name(call)}(...) [{p.name}] without a "
+                f"finally/with/finalize-guaranteed release ({want}) — "
+                f"an exception path escapes holding the resource"))
+
+    visit(sf.tree, "", [], [], [])
+
+    # ---- double-release rules (per function, scope-covered code exempt)
+    findings.extend(_check_double_release(sf, pairs, impl))
+    return findings
+
+
+def _check_double_release(sf: SourceFile, pairs: list[Pair],
+                          impl: set) -> list[Finding]:
+    findings: list[Finding] = []
+    pairs = [p for p in pairs if p.releases and not p.file_balance and
+             p.name not in impl]
+    if not pairs:
+        return findings
+
+    def covered(with_stack, p) -> bool:
+        for w in with_stack:
+            for item in w.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        _call_name(item.context_expr) in \
+                        p.scope_openers:
+                    return True
+        return False
+
+    def scan_body(body: list, p: Pair, sym: str):
+        """Linear scan of one statement list: two release-bearing
+        statements with no acquire-bearing statement between them."""
+        last_release = None
+        for stmt in body:
+            rel = _calls_in(stmt, p.releases)
+            acq = _calls_in(stmt, p.acquires)
+            if acq:
+                last_release = None
+            if rel:
+                if last_release is not None and not acq:
+                    findings.append(Finding(
+                        "balance-double-release", sf.path,
+                        rel[0].lineno, sym,
+                        f"{p.name} released twice in sequence "
+                        f"(first at line {last_release}) with no "
+                        f"intervening acquire — the double-count "
+                        f"drives the budget negative"))
+                last_release = rel[0].lineno
+
+    def visit(node, sym, with_stack, func):
+        for child in ast.iter_child_nodes(node):
+            c_sym = sym
+            w_stack = with_stack
+            c_func = func
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                c_sym = f"{sym}.{child.name}" if sym else child.name
+                c_func = child
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                w_stack = with_stack + [child]
+            if isinstance(child, ast.Try):
+                for p in pairs:
+                    if covered(w_stack, p):
+                        continue
+                    fin_rel = [r for fb in child.finalbody
+                               for r in _calls_in(fb, p.releases)]
+                    exc_rel = [r for h in child.handlers
+                               for r in _calls_in(h, p.releases)]
+                    if fin_rel and exc_rel:
+                        findings.append(Finding(
+                            "balance-double-release", sf.path,
+                            fin_rel[0].lineno, c_sym,
+                            f"{p.name} released in BOTH an except "
+                            f"handler (line {exc_rel[0].lineno}) and "
+                            f"the finally — the exception path "
+                            f"releases twice"))
+            if isinstance(child, (ast.For, ast.While)):
+                for p in pairs:
+                    if covered(w_stack, p):
+                        continue
+                    loop_rel = _calls_in(child, p.releases)
+                    loop_acq = _calls_in(child, p.acquires)
+                    if loop_rel and not loop_acq and \
+                            c_func is not None and \
+                            _calls_in(c_func, p.acquires):
+                        findings.append(Finding(
+                            "balance-double-release", sf.path,
+                            loop_rel[0].lineno, c_sym,
+                            f"{p.name} released inside a loop whose "
+                            f"acquire sits outside it — one acquire, "
+                            f"N releases"))
+            if hasattr(child, "body") and isinstance(
+                    getattr(child, "body"), list):
+                for p in pairs:
+                    if not covered(w_stack, p):
+                        scan_body(child.body, p, c_sym)
+                        for attr in ("orelse", "finalbody"):
+                            extra = getattr(child, attr, None)
+                            if isinstance(extra, list):
+                                scan_body(extra, p, c_sym)
+            visit(child, c_sym, w_stack, c_func)
+
+    visit(sf.tree, "", [], None)
+    # module top level
+    for p in pairs:
+        scan_body(sf.tree.body, p, "")
+    return findings
+
+
+# ---------------- callable identity (is/is not on bound methods) ----------------
+
+def _check_callable_identity(sf: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    # every method name defined by any class in this file
+    method_names: set = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    method_names.add(stmt.name)
+    if not method_names:
+        return findings
+    # dunders and ubiquitous names would drown the signal: a bound
+    # method bug site names the specific callback it stored
+    method_names = {m for m in method_names if not m.startswith("__")}
+
+    def visit(node, sym):
+        for child in ast.iter_child_nodes(node):
+            c_sym = sym
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                c_sym = f"{sym}.{child.name}" if sym else child.name
+            if isinstance(child, ast.Compare) and \
+                    any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in child.ops):
+                for operand in [child.left] + list(child.comparators):
+                    if isinstance(operand, ast.Attribute) and \
+                            operand.attr in method_names:
+                        findings.append(Finding(
+                            "callable-identity", sf.path,
+                            child.lineno, c_sym,
+                            f"`is` comparison against bound method "
+                            f".{operand.attr} — a bound method is a "
+                            f"fresh object per attribute access, so "
+                            f"identity never matches; compare with "
+                            f"==/!="))
+                        break
+            visit(child, c_sym)
+
+    visit(sf.tree, "")
+    return findings

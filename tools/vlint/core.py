@@ -22,6 +22,11 @@ BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 # the documentation trail for every deliberately accepted site
 _ALLOW_RE = re.compile(r"#\s*vlint:\s*allow-([a-z0-9-]+)\s*\(([^)]*)\)")
 
+# any allow spelling, reasoned or not — a bare `# vlint: allow-x` never
+# suppressed anything (the regex above requires the parens), so it is
+# dead weight AND missing its documentation: both make it a finding
+_ALLOW_ANY_RE = re.compile(r"#\s*vlint:\s*allow-([a-z0-9-]+)")
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -215,49 +220,245 @@ def check_ctx_discipline(sf: "SourceFile", checker: str, ctors: dict,
 
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import (accounting, hotpath, hygiene, leases, locks,
-                   netdiscipline, spans)
+    from . import (accounting, balance, hotpath, hygiene, leases, locks,
+                   netdiscipline, registry, spans)
     return [locks.check, hygiene.check, hotpath.check, spans.check,
-            accounting.check, leases.check, netdiscipline.check]
+            accounting.check, leases.check, netdiscipline.check,
+            balance.check, registry.check]
+
+
+# checker-id -> implementing module name, for `--explain` doc lookup.
+# Prefix match (longest wins); ids not listed fall back to core.
+CHECKER_MODULES = {
+    "lock-": "locks", "blocking-": "locks",
+    "jax-": "hotpath", "per-row-emit": "hotpath",
+    "broad-except": "hygiene", "wall-clock": "hygiene",
+    "mutable-default": "hygiene", "nondaemon-thread": "hygiene",
+    "span-discipline": "spans",
+    "accounting-discipline": "accounting",
+    "lease-discipline": "leases",
+    "net-discipline": "netdiscipline",
+    "balance-": "balance", "callable-identity": "balance",
+    "env-registry": "registry", "metric-registry": "registry",
+    "metric-double-roll": "registry", "canonical-helper": "registry",
+    "annotation-reason": "core", "syntax-error": "core",
+}
+
+
+def checker_module_for(checker_id: str) -> str:
+    best = "core"
+    best_len = -1
+    for prefix, mod in CHECKER_MODULES.items():
+        if checker_id.startswith(prefix.rstrip("-")) or \
+                checker_id.startswith(prefix):
+            if len(prefix) > best_len:
+                best, best_len = mod, len(prefix)
+    return best
+
+
+def check_annotations(sf: SourceFile) -> list[Finding]:
+    """`# vlint: allow-<checker>` without a parenthesized non-empty
+    reason is itself a finding: the reason IS the documentation trail
+    (ROADMAP mandates the why), and the bare form never suppressed
+    anything in the first place."""
+    findings: list[Finding] = []
+    for i, line in enumerate(sf.text.splitlines(), start=1):
+        reasoned_at = set()
+        for m in _ALLOW_RE.finditer(line):
+            if m.group(2).strip():
+                reasoned_at.add(m.start())
+        for m in _ALLOW_ANY_RE.finditer(line):
+            if m.start() in reasoned_at:
+                continue
+            findings.append(Finding(
+                "annotation-reason", sf.path, i, "",
+                f"allow-{m.group(1)} annotation without a "
+                f"parenthesized reason — write "
+                f"`# vlint: allow-{m.group(1)}(<why>)`"))
+    return findings
+
+
+def _check_sf(sf: SourceFile) -> tuple[list, list, list]:
+    """(findings, lock_edges, roll_sites) for one parsed file —
+    annotation-filtered, ready for the global passes."""
+    from . import registry
+    from .locks import _analyze
+    findings: list[Finding] = []
+    for chk in _checkers():
+        for f in chk(sf):
+            if not sf.allowed(f.checker, f.line):
+                findings.append(f)
+    findings.extend(check_annotations(sf))
+    _, edges, _ = _analyze(sf)
+    edges = [e for e in edges
+             if not sf.allowed("lock-order-cycle", e[3])]
+    rolls = registry.collect_roll_sites(sf)
+    return findings, edges, rolls
 
 
 def run_source(path: str, text: str, root: str = ".") -> list[Finding]:
     """Run every checker over one in-memory module (test fixtures)."""
+    from . import registry
+    from .locks import check_edge_cycles
     display = os.path.relpath(path, root) if os.path.isabs(path) else path
     sf = SourceFile.parse(path, text=text, display_path=display)
-    found: list[Finding] = []
-    for chk in _checkers():
-        found.extend(chk(sf))
-    found = [f for f in found if not sf.allowed(f.checker, f.line)]
-    from .locks import check_global_graph
-    found.extend(check_global_graph([sf]))
+    found, edges, rolls = _check_sf(sf)
+    found.extend(check_edge_cycles(edges))
+    found.extend(registry.check_global_rolls(rolls))
     found.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
     return found
 
 
-def run_paths(paths: list[str], root: str = ".") -> list[Finding]:
+# ---------------- parallel runner + content-hash cache ----------------
+#
+# `make lint` walks ~100 modules through nine checkers; almost none of
+# them change between runs.  Two levers, both in run_paths:
+#
+# - a content-hash result cache (tools/vlint/.cache.json, git-ignored):
+#   per-file findings/edges/rolls keyed by sha1(file) under a global
+#   fingerprint of the checker sources themselves + config.py, so any
+#   checker or registry edit invalidates everything;
+# - a process pool (--jobs N) for the cold files.  The global passes
+#   (lock-order cycles, metric double-roll) merge the per-file
+#   summaries in the parent — they were designed file-separable.
+
+CACHE_DEFAULT = os.path.join(os.path.dirname(__file__), ".cache.json")
+
+_CACHE_VERSION = 1
+
+
+def _checker_fingerprint() -> str:
+    """sha1 over every checker source + the runtime registry — a cache
+    is only valid for the exact analyzer that filled it."""
+    h = hashlib.sha1()
+    vdir = os.path.dirname(__file__)
+    files = sorted(fn for fn in os.listdir(vdir) if fn.endswith(".py"))
+    for fn in files:
+        with open(os.path.join(vdir, fn), "rb") as f:
+            h.update(f.read())
+    from .registry import _CONFIG_PATH
+    if os.path.exists(_CONFIG_PATH):
+        with open(_CONFIG_PATH, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _check_one_path(args) -> tuple:
+    """Worker: (rel, sha, result-dict) for one file.  Everything in the
+    result is JSON-serializable — it goes straight into the cache."""
+    fp, rel = args
+    with open(fp, encoding="utf-8") as f:
+        text = f.read()
+    sha = hashlib.sha1(text.encode("utf-8")).hexdigest()
+    try:
+        sf = SourceFile.parse(fp, text=text, display_path=rel)
+    except SyntaxError as e:
+        return rel, sha, {"findings": [
+            ["syntax-error", rel.replace(os.sep, "/"),
+             e.lineno or 0, "", str(e.msg)]],
+            "edges": [], "rolls": []}
+    findings, edges, rolls = _check_sf(sf)
+    return rel, sha, {
+        "findings": [[f.checker, f.path, f.line, f.symbol, f.message]
+                     for f in findings],
+        "edges": [list(e) for e in edges],
+        "rolls": [list(r) for r in rolls]}
+
+
+def run_paths(paths: list[str], root: str = ".",
+              jobs: int | None = None,
+              cache_path: str | None = None) -> list[Finding]:
     """Run every checker over every .py file under `paths`.
 
     Annotated sites are dropped here; baseline filtering is the
-    caller's job (new_findings)."""
-    findings: list[Finding] = []
-    sources: list[SourceFile] = []
+    caller's job (new_findings).  jobs > 1 fans cold files over a
+    process pool; cache_path enables the content-hash result cache."""
+    from . import registry
+    from .locks import check_edge_cycles
+    work = []
     for fp in iter_py_files(paths):
-        rel = os.path.relpath(fp, root)
-        try:
-            sf = SourceFile.parse(fp, display_path=rel)
-        except SyntaxError as e:
-            findings.append(Finding("syntax-error", rel.replace(os.sep, "/"),
-                                    e.lineno or 0, "", str(e.msg)))
+        work.append((fp, os.path.relpath(fp, root)))
+
+    cache = None
+    fingerprint = None
+    if cache_path:
+        fingerprint = _checker_fingerprint()
+        cache = {"version": _CACHE_VERSION, "fingerprint": fingerprint,
+                 "files": {}}
+        if os.path.exists(cache_path):
+            try:
+                with open(cache_path, encoding="utf-8") as f:
+                    got = json.load(f)
+                if got.get("version") == _CACHE_VERSION and \
+                        got.get("fingerprint") == fingerprint:
+                    cache["files"] = got.get("files", {})
+            except (OSError, ValueError):
+                pass
+
+    results: dict[str, dict] = {}
+    cold = []
+    for fp, rel in work:
+        entry = cache["files"].get(rel) if cache else None
+        if entry is not None:
+            try:
+                with open(fp, "rb") as f:
+                    sha = hashlib.sha1(f.read()).hexdigest()
+            except OSError:
+                sha = None
+            if sha == entry.get("sha"):
+                results[rel] = entry["result"]
+                continue
+        cold.append((fp, rel))
+
+    if jobs is None:
+        jobs = 1
+    if jobs > 1 and len(cold) > 1:
+        import concurrent.futures as cf
+        import multiprocessing
+        # spawn, not fork: the in-process pytest gate runs under an
+        # interpreter that already imported (multithreaded) jax, and
+        # forking that can deadlock; workers only import tools.vlint
+        ctx = multiprocessing.get_context("spawn")
+        with cf.ProcessPoolExecutor(max_workers=jobs,
+                                    mp_context=ctx) as pool:
+            for rel, sha, result in pool.map(_check_one_path, cold,
+                                             chunksize=4):
+                results[rel] = result
+                if cache is not None:
+                    cache["files"][rel] = {"sha": sha, "result": result}
+    else:
+        for args in cold:
+            rel, sha, result = _check_one_path(args)
+            results[rel] = result
+            if cache is not None:
+                cache["files"][rel] = {"sha": sha, "result": result}
+
+    if cache is not None:
+        # drop only entries whose file vanished from disk — a SCOPED
+        # run (one subdir) must not evict the rest of the repo's
+        # entries or the next full `make lint` goes cold again
+        cache["files"] = {
+            rel: v for rel, v in cache["files"].items()
+            if os.path.exists(os.path.join(root, rel))}
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, cache_path)
+
+    findings: list[Finding] = []
+    all_edges = []
+    all_rolls = []
+    for _, rel in work:
+        result = results.get(rel)
+        if result is None:
             continue
-        sources.append(sf)
-    for sf in sources:
-        for chk in _checkers():
-            for f in chk(sf):
-                if not sf.allowed(f.checker, f.line):
-                    findings.append(f)
+        for c, p, line, sym, msg in result["findings"]:
+            findings.append(Finding(c, p, line, sym, msg))
+        all_edges.extend(tuple(e) for e in result["edges"])
+        all_rolls.extend(tuple(r) for r in result["rolls"])
     # the lock-order graph is global: cycles only emerge across files
-    from .locks import check_global_graph
-    findings.extend(check_global_graph(sources))
+    findings.extend(check_edge_cycles(all_edges))
+    # single_roll metrics: double-count sites only emerge across files
+    findings.extend(registry.check_global_rolls(all_rolls))
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
     return findings

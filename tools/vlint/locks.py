@@ -440,6 +440,13 @@ def check_global_graph(sources: list[SourceFile]) -> list[Finding]:
         for a, b, path, line in e:
             if not sf.allowed("lock-order-cycle", line):
                 edges.add((a, b, path, line))
+    return check_edge_cycles(edges)
+
+
+def check_edge_cycles(edges) -> list[Finding]:
+    """Cycle detection over pre-collected (a, b, path, line) edges —
+    the parallel/cached runner merges per-file edge summaries and
+    calls this in the parent process."""
     graph: dict[str, set] = {}
     anchor: dict = {}
     for a, b, path, line in sorted(edges):

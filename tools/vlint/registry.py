@@ -1,0 +1,291 @@
+"""Config/metrics registry drift checkers (victorialogs_tpu/config.py).
+
+The runtime registry declares every ``VL_*`` environment knob and every
+``vl_*`` metric name once, with type and documentation.  These checkers
+make bypassing it a lint failure — the three drift classes that
+repeatedly survived review (CHANGES.md):
+
+- env-registry: a raw ``os.environ.get`` / ``os.getenv`` /
+  ``os.environ[...]`` read anywhere in victorialogs_tpu/ outside
+  config.py itself.  Knobs read raw don't appear in the generated
+  README table and can't be audited for default/type drift.  Also
+  flagged: a ``config.env*("NAME")`` call whose literal name has no
+  declaration (the runtime would raise UndeclaredEnvVar — the checker
+  catches it before the code path ever runs).
+- metric-registry: a metric name rolled or rendered (``.inc(...)``,
+  ``metric_name(...)``, ``hist.histogram(...)``, ``events.note(...)``,
+  or a ``("vl_...", labels, value)`` sample tuple inside a
+  ``metrics_samples`` function) that is not declared.  Names under
+  ``config.DYNAMIC_METRIC_PREFIXES`` (runner stats keys) are exempt —
+  the vlsan runtime sweep guards those instead.
+- metric-double-roll: a metric declared ``single_roll=True`` with more
+  than one static roll site — the double-count class (PR 4 prune
+  ratio, PR 6 vlagent ingest bytes).  Roll sites are ``.inc``/
+  ``.note`` calls only; render-side ``metric_name``/sample tuples
+  read state, they don't accumulate it.
+- canonical-helper: raw splitmix64 magic constants or a
+  multiply-then-shift fastrange reduction outside the canonical
+  modules (utils/hashing.py, storage/filterindex/sbbloom.py) — the
+  inline-copy-drift class (PR 12's sb_probe_idx duplicate of the
+  salted fastrange diverged silently).
+
+Deliberate sites carry ``# vlint: allow-<checker>(<why>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+
+from .core import Finding, SourceFile
+
+_CONFIG_PATH = os.path.normpath(os.path.join(
+    os.path.dirname(__file__), "..", "..", "victorialogs_tpu",
+    "config.py"))
+
+_config_mod = None
+
+
+def config_module():
+    """The runtime registry, loaded standalone (config.py is
+    import-light by contract; loading it outside the package keeps the
+    linter free of jax and the rest of the tree)."""
+    global _config_mod
+    if _config_mod is None:
+        spec = importlib.util.spec_from_file_location(
+            "_vlint_config", _CONFIG_PATH)
+        mod = importlib.util.module_from_spec(spec)
+        # registered BEFORE exec: dataclass decorators look the module
+        # up in sys.modules while the body runs
+        sys.modules["_vlint_config"] = mod
+        spec.loader.exec_module(mod)
+        _config_mod = mod
+    return _config_mod
+
+
+# the registry module itself and the CLI envflag mirror play by their
+# own rules (the latter carries an allow annotation anyway)
+_EXEMPT_SUFFIX = ("victorialogs_tpu/config.py",)
+
+# config reader call names -> their first positional arg is an env name
+_ENV_READERS = frozenset((
+    "env", "env_int", "env_float", "env_flag", "env_bool"))
+
+# splitmix64 finalizer constants — any of these inline outside the
+# canonical modules is a hand-copied hash helper waiting to drift
+_SPLITMIX_CONSTS = frozenset((
+    0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9, 0x94D049BB133111EB))
+
+_CANONICAL_PATHS = ("victorialogs_tpu/utils/hashing.py",
+                    "victorialogs_tpu/storage/filterindex/sbbloom.py")
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _str_arg(call: ast.Call, i: int = 0) -> str | None:
+    if len(call.args) > i and isinstance(call.args[i], ast.Constant) \
+            and isinstance(call.args[i].value, str):
+        return call.args[i].value
+    return None
+
+
+def _is_environ_read(node: ast.AST) -> bool:
+    """os.environ.get(...), os.getenv(...), or os.environ[...] load."""
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d.endswith("environ.get") or d.endswith("os.getenv") \
+                or d == "getenv":
+            return True
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, ast.Load) and \
+            _dotted(node.value).endswith("environ"):
+        return True
+    return False
+
+
+def _walk_symbols(tree, fn):
+    """fn(node, symbol) for every node, symbol = enclosing Class.func."""
+    def walk(node, symbol):
+        for child in ast.iter_child_nodes(node):
+            sym = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sym = f"{symbol}.{child.name}" if symbol else child.name
+            fn(child, sym)
+            walk(child, sym)
+    walk(tree, "")
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    path = sf.path.replace("\\", "/")
+    if any(path.endswith(s) for s in _EXEMPT_SUFFIX):
+        return []
+    cfg = config_module()
+    declared_env = cfg.env_vars()
+    findings: list[Finding] = []
+    canonical = any(path.endswith(p) or p.endswith(path)
+                    for p in _CANONICAL_PATHS)
+
+    def visit(node, sym):
+        # ---- env-registry ----
+        if _is_environ_read(node):
+            findings.append(Finding(
+                "env-registry", sf.path, node.lineno, sym,
+                "raw environment read — route knobs through the "
+                "declared victorialogs_tpu/config.py registry "
+                "(config.env/env_int/env_flag/...)"))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            last = fn.attr if isinstance(fn, ast.Attribute) \
+                else _dotted(fn)
+            recv = _dotted(fn.value) if isinstance(fn, ast.Attribute) \
+                else ""
+            if last in _ENV_READERS and recv.endswith("config"):
+                name = _str_arg(node)
+                if name is not None and name not in declared_env:
+                    findings.append(Finding(
+                        "env-registry", sf.path, node.lineno, sym,
+                        f"env var {name} is not declared in "
+                        f"victorialogs_tpu/config.py — declare_env() "
+                        f"it (name, default, kind, doc)"))
+            # ---- metric-registry: roll/render sites ----
+            mname = None
+            if last == "inc" or last == "metric_name":
+                mname = _str_arg(node)
+                if mname is not None:
+                    # labeled sample names may arrive pre-rendered
+                    # ('vl_x_total{type="a"}') — the base is the name
+                    mname = mname.split("{", 1)[0]
+            elif last == "histogram" and recv.endswith("hist"):
+                mname = _str_arg(node)
+            elif last == "note" and recv.endswith("events"):
+                key = _str_arg(node)
+                if key is not None:
+                    mname = f"vl_{key}_total"
+            if mname is not None and mname.startswith("vl_") and \
+                    not cfg.metric_declared(mname):
+                findings.append(Finding(
+                    "metric-registry", sf.path, node.lineno, sym,
+                    f"metric {mname} is not declared in "
+                    f"victorialogs_tpu/config.py — declare_metric() "
+                    f"it (name, kind, help)"))
+        # sample tuples inside metrics_samples-style functions
+        if isinstance(node, ast.Tuple) and len(node.elts) == 3 and \
+                "metrics_samples" in sym.rsplit(".", 1)[-1] and \
+                isinstance(node.elts[0], ast.Constant) and \
+                isinstance(node.elts[0].value, str):
+            base = node.elts[0].value
+            if base.startswith("vl_") and not cfg.metric_declared(base):
+                findings.append(Finding(
+                    "metric-registry", sf.path, node.lineno, sym,
+                    f"metric {base} is not declared in "
+                    f"victorialogs_tpu/config.py — declare_metric() "
+                    f"it (name, kind, help)"))
+        # ---- canonical-helper ----
+        if not canonical and isinstance(node, ast.Constant) and \
+                isinstance(node.value, int) and \
+                node.value in _SPLITMIX_CONSTS:
+            findings.append(Finding(
+                "canonical-helper", sf.path, node.lineno, sym,
+                f"inline splitmix64 constant {node.value:#x} — use the "
+                f"canonical helpers in utils/hashing.py (hand copies "
+                f"drift silently)"))
+        if not canonical and isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.RShift) and \
+                isinstance(node.left, ast.BinOp) and \
+                isinstance(node.left.op, ast.Mult) and \
+                _shift_width(node.right) in (32, 64):
+            findings.append(Finding(
+                "canonical-helper", sf.path, node.lineno, sym,
+                "multiply-then-shift fastrange reduction — use "
+                "sb_block_select / the helpers in "
+                "storage/filterindex/sbbloom.py instead of an inline "
+                "copy"))
+
+    _walk_symbols(sf.tree, visit)
+    return findings
+
+
+def _shift_width(node) -> int | None:
+    """The shift amount of `x >> 32`-style fastrange tails: a bare int
+    constant or np.uint64(32)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Call) and len(node.args) == 1 and \
+            isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, int) and \
+            _dotted(node.func).endswith("uint64"):
+        return node.args[0].value
+    return None
+
+
+# ---------------- global pass: double-rolled single_roll metrics ----------------
+
+def collect_roll_sites(sf: SourceFile) -> list[tuple]:
+    """(metric, path, line, symbol) for every accumulation site —
+    ``.inc("name", ...)`` and ``events.note("key")`` calls.  Cached per
+    file by the runner; the cross-file aggregation happens in
+    check_global_rolls."""
+    rolls: list[tuple] = []
+
+    def visit(node, sym):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            return
+        last = node.func.attr
+        recv = _dotted(node.func.value)
+        mname = None
+        if last == "inc":
+            # .inc(metric_name("base", ...)) rolls the inner base name
+            if node.args and isinstance(node.args[0], ast.Call) and \
+                    _dotted(node.args[0].func).endswith("metric_name"):
+                mname = _str_arg(node.args[0])
+            else:
+                mname = _str_arg(node)
+                if mname is not None:
+                    mname = mname.split("{", 1)[0]
+        elif last == "note" and recv.endswith("events"):
+            key = _str_arg(node)
+            if key is not None:
+                mname = f"vl_{key}_total"
+        if mname is not None and mname.startswith("vl_"):
+            rolls.append((mname, sf.path, node.lineno, sym))
+
+    _walk_symbols(sf.tree, visit)
+    # annotated sites are not roll sites (the allow covers the class)
+    return [r for r in rolls
+            if not sf.allowed("metric-double-roll", r[2])]
+
+
+def check_global_rolls(rolls: list[tuple]) -> list[Finding]:
+    """Findings for single_roll metrics accumulated at >1 site."""
+    cfg = config_module()
+    decls = cfg.metric_decls()
+    by_name: dict[str, list[tuple]] = {}
+    for mname, path, line, sym in rolls:
+        by_name.setdefault(mname, []).append((path, line, sym))
+    findings = []
+    for mname, sites in sorted(by_name.items()):
+        d = decls.get(mname)
+        if d is None or not d.single_roll or len(sites) <= 1:
+            continue
+        sites.sort()
+        first = f"{sites[0][0]}:{sites[0][1]}"
+        for path, line, sym in sites[1:]:
+            findings.append(Finding(
+                "metric-double-roll", path, line, sym,
+                f"metric {mname} is declared single_roll but is also "
+                f"rolled at {first} — two accumulation sites "
+                f"double-count; roll in ONE place or declare it "
+                f"multi-site"))
+    return findings

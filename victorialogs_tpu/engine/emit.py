@@ -29,7 +29,7 @@ path's differential suite.
 from __future__ import annotations
 
 import json
-import os
+from .. import config
 
 from ..native import available as native_available
 from ..native import emit_ndjson_native
@@ -51,7 +51,7 @@ def _key_token(name: str) -> bytes:
 
 def native_emit_enabled() -> bool:
     """VL_NATIVE_EMIT=0 kills the native serializer (parity debugging)."""
-    return os.environ.get("VL_NATIVE_EMIT", "1") != "0"
+    return config.env_flag("VL_NATIVE_EMIT")
 
 
 def ndjson_block(br, fields: list[str] | None = None) -> bytes:

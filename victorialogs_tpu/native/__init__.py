@@ -14,6 +14,7 @@ import subprocess
 import threading
 
 import numpy as np
+from .. import config
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "vlnative.cpp")
@@ -43,7 +44,7 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("VL_NO_NATIVE"):
+        if config.env("VL_NO_NATIVE"):
             return None
         try:
             if not os.path.exists(_SO) or \

@@ -41,9 +41,9 @@ emitted/suppressed totals) for server/app.py Metrics.render.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
+from .. import config
 
 # reserved self-telemetry tenant: (AccountID 0, ProjectID 0xFFFFFFFE).
 # The project id sits at the top of the uint32 space where no real
@@ -59,7 +59,7 @@ def journal_enabled() -> bool:
     """VL_JOURNAL=0 is the kill-switch: server/app.py then never
     constructs a JournalWriter, so the bus has no subscriber and every
     emit() returns at its first instruction."""
-    return os.environ.get("VL_JOURNAL", "1") != "0"
+    return config.env_flag("VL_JOURNAL")
 
 
 # subscribers are kept in an immutable tuple swapped under _subs_mu so

@@ -39,7 +39,7 @@ displayed plan cannot diverge from what a real run would dispatch.
 
 from __future__ import annotations
 
-import os
+from .. import config
 
 from . import activity, tracing
 
@@ -59,7 +59,7 @@ _SCAN_BYTES_PER_ROW = 128
 def pricing_enabled() -> bool:
     """VL_QUERY_PRICING=0 kills the continuous plan-time pricing pass
     (the explain endpoints stay available either way)."""
-    return os.environ.get("VL_QUERY_PRICING", "1") != "0"
+    return config.env_flag("VL_QUERY_PRICING")
 
 
 # ---------------- the plan walk ----------------

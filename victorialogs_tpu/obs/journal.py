@@ -58,13 +58,13 @@ subscription: it must be ``close()``d (VLServer.close does).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import weakref
 from collections import deque
 
 from . import events
+from .. import config
 from ..storage.log_rows import LogRows, TenantID
 
 APP_NAME = "victorialogs-tpu"
@@ -81,13 +81,6 @@ _writers_mu = threading.Lock()
 _writers: "weakref.WeakSet[JournalWriter]" = weakref.WeakSet()
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 class JournalWriter:
     """One journal: bus subscription + bounded queue + flush thread
     writing LogRows into a sink with ``must_add_rows``.
@@ -100,18 +93,22 @@ class JournalWriter:
         self.sink = sink
         self.app = app
         self.max_queue = max_queue if max_queue is not None else \
-            _env_int("VL_JOURNAL_MAX_QUEUE", 4096)
+            config.env_int("VL_JOURNAL_MAX_QUEUE")
         if flush_ms is None:
-            flush_ms = _env_int("VL_JOURNAL_FLUSH_MS", 500)
+            flush_ms = config.env_int("VL_JOURNAL_FLUSH_MS")
         self.flush_s = max(0.01, flush_ms / 1e3)
         self.flush_deadline_s = max(
             self.flush_s,
-            _env_int("VL_JOURNAL_FLUSH_DEADLINE_MS", 5000) / 1e3)
+            config.env_int("VL_JOURNAL_FLUSH_DEADLINE_MS") / 1e3)
         self._mu = threading.Lock()
         self._q: deque = deque()
         # exact accounting (test-pinned): everything emitted to this
         # writer is either accepted (and eventually written) or dropped
         self.dropped = 0
+        # drops at the queue bound were never accepted; the flush-
+        # failure/close paths drop ACCEPTED events — check_balanced
+        # needs the split, stats()/metrics keep the one public total
+        self._dropped_overflow = 0
         self.accepted = 0
         self.rows_written = 0
         self.flushes = 0
@@ -137,6 +134,7 @@ class JournalWriter:
         with self._mu:
             if len(self._q) >= self.max_queue:
                 self.dropped += 1
+                self._dropped_overflow += 1
                 return
             self._q.append((ts_ns, event, fields))
             self.accepted += 1
@@ -202,15 +200,18 @@ class JournalWriter:
                 self._inflight = 0
             raise
         took = time.monotonic() - t0
+        # one locked update so accepted == written + dropped + queued
+        # + in-flight holds at every instant an observer can look
+        # (vlsan sweeps check_balanced between tests)
         with self._mu:
             self._inflight = 0
-        self.flushes += 1
-        if took > self.flush_deadline_s:
-            # a stalling storage must be visible, not silent: the
-            # flush deadline is observability, the bounded queue is
-            # the actual protection
-            self.flushes_slow += 1
-        self.rows_written += len(batch)
+            self.flushes += 1
+            if took > self.flush_deadline_s:
+                # a stalling storage must be visible, not silent: the
+                # flush deadline is observability, the bounded queue
+                # is the actual protection
+                self.flushes_slow += 1
+            self.rows_written += len(batch)
 
     def _row_fields(self, event: str, fields: dict) -> list:
         out = [("app", self.app), ("event", event)]
@@ -244,6 +245,21 @@ class JournalWriter:
             "flushes_slow": self.flushes_slow,
             "flush_errors": self.flush_errors,
         }
+
+    def check_balanced(self) -> tuple[bool, str]:
+        """The accounting invariant on every path (flush failure,
+        wedged close, bounded-queue drops included): every event this
+        writer ever accepted is written, dropped, queued, or in the
+        flush thread's hands right now."""
+        with self._mu:
+            lhs = self.accepted
+            # overflow drops never entered `accepted` — only drops of
+            # accepted events (failed flush, wedged close) balance it
+            rhs = self.rows_written + \
+                (self.dropped - self._dropped_overflow) + \
+                len(self._q) + self._inflight
+        return lhs == rhs, (f"accepted={lhs} != written+dropped(post-"
+                            f"accept)+queued+inflight={rhs}")
 
     def flush(self) -> None:
         """Synchronous drain (tests / shutdown): write everything
@@ -289,6 +305,13 @@ def maybe_start(sink) -> JournalWriter | None:
     if not events.journal_enabled():
         return None
     return JournalWriter(sink)
+
+
+def live_writers() -> list:
+    """Every live JournalWriter (the vlsan sweep checks each one's
+    accounting invariant after every test)."""
+    with _writers_mu:
+        return list(_writers)
 
 
 def metrics_samples() -> list[tuple[str, dict, float]]:

@@ -19,9 +19,9 @@ inject their own sink via set_sink().
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
+from .. import config
 
 from . import events
 
@@ -36,8 +36,8 @@ def set_sink(fn) -> None:
 
 def threshold_ms() -> float | None:
     """The armed threshold, or None when the log is off."""
-    v = os.environ.get("VL_SLOW_QUERY_MS", "")
-    if v == "":
+    v = config.env("VL_SLOW_QUERY_MS")
+    if not v:
         return None
     try:
         return float(v)

@@ -40,11 +40,11 @@ updates happen outside the controller lock).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import weakref
 
+from .. import config
 from ..obs import events, hist
 
 REASONS = ("tenant_limit", "queue_full", "deadline", "cancelled")
@@ -92,13 +92,6 @@ class AdmissionShed(Exception):
         self.status = status
         self.limit = limit
         self.current = current
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 # ---------------- process-global admitted/shed accounting ----------------
@@ -195,14 +188,16 @@ class AdmissionController:
         self._cond = threading.Condition(self._mu)
         self.pool = pool
         self._max = max_concurrent if max_concurrent else \
-            _env_int("VL_MAX_CONCURRENT", 8)
+            config.env_int("VL_MAX_CONCURRENT")
         if queue_timeout_s is None:
-            queue_timeout_s = _env_int("VL_QUEUE_TIMEOUT_MS", 30_000) / 1e3
+            queue_timeout_s = \
+                config.env_int("VL_QUEUE_TIMEOUT_MS") / 1e3
         self.queue_timeout_s = queue_timeout_s
-        self._queue_max = _env_int("VL_QUEUE_MAX", 2 * self._max)
+        self._queue_max = config.env_int("VL_QUEUE_MAX",
+                                         2 * self._max)
         self._tenant_max_default = \
-            _env_int("VL_TENANT_MAX_CONCURRENT", 0) or self._max
-        self._tenant_max_bytes = _env_int("VL_TENANT_MAX_BYTES", 0)
+            config.env_int("VL_TENANT_MAX_CONCURRENT") or self._max
+        self._tenant_max_bytes = config.env_int("VL_TENANT_MAX_BYTES")
         self._tenant_limits: dict[str, int] = {}
         self._active = 0
         self._tenant_active: dict[str, int] = {}

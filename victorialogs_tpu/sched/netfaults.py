@@ -28,10 +28,10 @@ HTTP-flavored wrapping.
 
 from __future__ import annotations
 
-import os
 import socket
 import struct
 import threading
+from .. import config
 
 from ..obs import events
 
@@ -79,7 +79,7 @@ def maybe_fail_net(url: str) -> str | None:
     mode = hit[1] if hit is not None else None
     source = "inject_net_fault"
     if mode is None:
-        spec = os.environ.get("VL_FAULT_NET", "")
+        spec = config.env("VL_FAULT_NET") or ""
         if spec:
             m, _, p = spec.partition(":")
             try:

@@ -45,9 +45,9 @@ lock is never taken while a caller holds ours on the release side).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
+from .. import config
 
 from ..obs import events
 
@@ -58,17 +58,14 @@ class InjectedFaultError(RuntimeError):
 
 def sched_enabled() -> bool:
     """VL_SCHED=0 disables the shared budget (leases grant instantly)."""
-    return os.environ.get("VL_SCHED", "1") != "0"
+    return config.env_flag("VL_SCHED")
 
 
 def global_budget() -> int:
     """VL_INFLIGHT_GLOBAL: max dispatch slots outstanding process-wide
     across ALL queries (>=1; default 8 = 2x the default per-query
     window, so a solo query never feels the scheduler)."""
-    try:
-        return max(1, int(os.environ.get("VL_INFLIGHT_GLOBAL", "8")))
-    except ValueError:
-        return 8
+    return max(1, config.env_int("VL_INFLIGHT_GLOBAL"))
 
 
 # ---------------- tenant weights ----------------
@@ -91,7 +88,7 @@ def tenant_weight(tenant: str) -> float:
     """Fair-share weight for one 'account:project' tenant (default 1.0;
     VL_TENANT_WEIGHTS="0:0=4,9:0=0.5" preseeds, sched_config updates)."""
     global _weights_env_cache
-    env = os.environ.get("VL_TENANT_WEIGHTS", "")
+    env = config.env("VL_TENANT_WEIGHTS") or ""
     with _weights_mu:
         got = _weight_overrides.get(str(tenant))
         if got is not None:
@@ -362,7 +359,7 @@ def maybe_fail_submit() -> None:
                     source="inject_fault")
         raise InjectedFaultError(
             f"injected dispatch submit fault (submit #{n})")
-    p = os.environ.get("VL_FAULT_SUBMIT", "")
+    p = config.env("VL_FAULT_SUBMIT") or ""
     if p:
         try:
             prob = float(p)

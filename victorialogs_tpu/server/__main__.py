@@ -24,6 +24,7 @@ from .app import VLServer
 from .syslog import SyslogServer
 
 
+# vlint: allow-env-registry(envflag mirror: names derive from the CLI flag spellings at runtime, not from fixed knobs the config registry could declare)
 def _env_default(name: str, default):
     env = "VL_" + name.replace(".", "_").replace("-", "_")
     return os.environ.get(env, default)

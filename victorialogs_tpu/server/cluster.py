@@ -44,6 +44,7 @@ import threading
 import time
 
 import numpy as np
+from .. import config
 
 from .. import sched
 from ..engine.block_result import (WIRE_CONST, WIRE_DICT, WIRE_ISO,
@@ -186,7 +187,7 @@ _W_NUM_DTYPES = {1: "<i8", 2: "<i8", 3: "<i8", 4: "<u8", 7: "<f8"}
 def wire_typed_enabled() -> bool:
     """VL_WIRE_TYPED=0 kill-switch: restores legacy JSON frames exactly
     (this process neither requests nor serves typed frames)."""
-    return os.environ.get("VL_WIRE_TYPED", "1") != "0"
+    return config.env_flag("VL_WIRE_TYPED")
 
 
 # ---- wire-protocol observability (vl_wire_* on /metrics) ----

@@ -49,7 +49,6 @@ emitted outside them.
 from __future__ import annotations
 
 import http.client
-import os
 import queue
 import random
 import struct
@@ -58,6 +57,7 @@ import time
 import urllib.parse
 
 from ..obs import events, hist, tracing
+from .. import config
 from ..sched import netfaults
 from ..utils import zstd as _zstd
 
@@ -67,46 +67,32 @@ from ..utils import zstd as _zstd
 def net_retries() -> int:
     """VL_NET_RETRIES: extra attempts per idempotent select sub-query
     after the first (0 disables retrying)."""
-    try:
-        return max(0, int(os.environ.get("VL_NET_RETRIES", "2")))
-    except ValueError:
-        return 2
+    return max(0, config.env_int("VL_NET_RETRIES"))
 
 
 def breaker_failures() -> int:
     """VL_BREAKER_FAILURES: consecutive transport failures that open a
     node's circuit (>=1; default 2 so one transient blip retries
     without blacklisting the node)."""
-    try:
-        return max(1, int(os.environ.get("VL_BREAKER_FAILURES", "2")))
-    except ValueError:
-        return 2
+    return max(1, config.env_int("VL_BREAKER_FAILURES"))
 
 
 def breaker_open_s() -> float:
     """VL_BREAKER_OPEN_S: seconds an open circuit refuses requests
     before half-opening one probe (the old fixed 10s disable)."""
-    try:
-        return max(0.05, float(os.environ.get("VL_BREAKER_OPEN_S", "10")))
-    except ValueError:
-        return 10.0
+    return max(0.05, config.env_float("VL_BREAKER_OPEN_S"))
 
 
 def spool_max_bytes() -> int:
     """VL_INSERT_SPOOL_MAX_BYTES: per-node durable ingest spool bound
     (0 disables spooling — the old drop-on-outage behavior)."""
-    try:
-        return int(os.environ.get("VL_INSERT_SPOOL_MAX_BYTES",
-                                  str(256 << 20)))
-    except ValueError:
-        return 256 << 20
+    return config.env_int("VL_INSERT_SPOOL_MAX_BYTES")
 
 
 def partial_default() -> bool:
     """VL_PARTIAL_RESULTS=1 turns partial results on for requests that
     do not carry an explicit ?partial arg."""
-    return os.environ.get("VL_PARTIAL_RESULTS", "0") in ("1", "true",
-                                                         "yes")
+    return config.env_bool("VL_PARTIAL_RESULTS")
 
 
 def partial_requested(args) -> bool:
@@ -336,7 +322,7 @@ class CircuitBreaker:
         """Delay before re-issuing a straggler sub-query, or None when
         hedging is off.  VL_NET_HEDGE_MS pins it (0 = off); otherwise
         the EWMA estimate applies once >= 8 RTT samples exist."""
-        env = os.environ.get("VL_NET_HEDGE_MS", "")
+        env = config.env("VL_NET_HEDGE_MS") or ""
         if env:
             try:
                 ms = float(env)

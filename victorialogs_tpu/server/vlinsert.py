@@ -11,6 +11,7 @@ from __future__ import annotations
 import functools
 import json
 import time as _time
+from .. import config
 
 from ..storage.log_rows import (LogColumns, StreamID,
                                 canonical_stream_tags)
@@ -510,8 +511,6 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
     own scan state and LogColumns batch; only the final sink append is
     lock-serialized).  Rows within a shard keep arrival order; shards
     interleave — same contract as concurrent client connections."""
-    import os as _os
-
     from .. import native
     try:
         # upfront validation for the whole body, exactly like the
@@ -527,10 +526,7 @@ def _jsonline_fast(cp: CommonParams, body: bytes,
         return st.n
     del text
     blen = len(body)
-    try:
-        nthreads = int(_os.environ.get("VL_INGEST_THREADS", "1") or "1")
-    except ValueError:
-        nthreads = 1
+    nthreads = config.env_int("VL_INGEST_THREADS")
     if nthreads > 1 and blen >= _MT_MIN_BODY:
         return _jsonline_fast_mt(cp, body, lmp, nthreads)
     st = _FastState(cp, lmp)

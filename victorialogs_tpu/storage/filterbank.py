@@ -31,13 +31,13 @@ change) and cached on the part object (parts are immutable).
 
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 from dataclasses import dataclass
 
 import numpy as np
 
+from .. import config
 from ..obs import activity, events, hist, tracing
 from ..utils.hashing import cached_token_hashes
 from .bloom import (BLOOM_HASHES, bloom_contains_all,
@@ -49,18 +49,38 @@ AGG_WORDS = 4096
 
 # planes beyond this decline to the per-block path (a pathological part
 # with huge per-block filters must not balloon host memory)
-_MAX_PLANE_BYTES = int(os.environ.get("VL_BLOOM_PLANE_MAX_BYTES",
-                                      str(256 << 20)))
+_MAX_PLANE_BYTES = config.env_int("VL_BLOOM_PLANE_MAX_BYTES")
 
 # global budget for ALL host-resident planes: planes duplicate the
 # mmap'd blooms.bin data in RAM, so a long-lived server querying many
 # (part, column) pairs must stay bounded — past the budget, new columns
 # take the per-block fallback (identical semantics, just slower) until
 # parts (and their banks) are garbage-collected
-_BANK_MAX_BYTES = int(os.environ.get("VL_BLOOM_BANK_MAX_BYTES",
-                                     str(1 << 30)))
+_BANK_MAX_BYTES = config.env_int("VL_BLOOM_BANK_MAX_BYTES")
 _bank_mu = threading.Lock()
 _bank_bytes = 0
+# every live charge list registered with a _bank_release finalizer —
+# the vlsan runtime sweep proves _bank_bytes == sum of live charges
+# (>= 0) after every test (tools/vlint/vlsan.py)
+_bank_owners: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _bank_track(owner) -> None:
+    """Register an object whose ._charged list was handed to a
+    _bank_release weakref.finalize (FilterBank, PartFilterIndex)."""
+    _bank_owners.add(owner)
+
+
+def bank_check_balanced() -> tuple[bool, str]:
+    """Budget-accounting invariant for the vlsan sweep: the global
+    byte total equals the sum of every live owner's charges and never
+    goes negative (a double release would).  Callers retry once after
+    gc.collect() — a finalizer may not have run yet."""
+    with _bank_mu:
+        used = _bank_bytes
+    live = sum(sum(o._charged) for o in list(_bank_owners))
+    ok = used == live and used >= 0
+    return ok, f"bank_bytes={used} sum(live charges)={live}"
 
 
 def _bank_try_charge(n: int) -> bool:
@@ -228,6 +248,7 @@ class FilterBank:
         # the bank (== its part) is garbage-collected
         self._charged: list = []
         weakref.finalize(self, _bank_release, self._charged)
+        _bank_track(self)
 
     def plane(self, part, field: str) -> BloomPlane | None:
         with self._mu:

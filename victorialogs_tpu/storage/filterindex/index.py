@@ -20,6 +20,7 @@ import threading
 import weakref
 
 import numpy as np
+from ... import config
 
 from ...obs import events
 from .sbbloom import SB_LANES, sb_block_select, sb_token_masks
@@ -28,7 +29,7 @@ from .sidecar import ColumnArtifacts, SidecarInvalid, load_sidecar
 
 def mode() -> str:
     """`v2` (default) or `v1` (the classic-path kill switch)."""
-    return "v1" if os.environ.get("VL_FILTER_INDEX") == "v1" else "v2"
+    return "v1" if config.env("VL_FILTER_INDEX") == "v1" else "v2"
 
 
 def enabled() -> bool:
@@ -99,6 +100,7 @@ class PartFilterIndex:
         if built is not None:
             from ..filterbank import _bank_try_charge
             nbytes = int(built[0].nbytes)
+            # vlint: allow-balance-unguarded-acquire(a WON charge joins self._charged below, whose _bank_release finalizer _load registered at index creation; the race loser releases inline right after)
             if not _bank_try_charge(nbytes):
                 # transient budget pressure: decline WITHOUT memoizing
                 # so the plane can land once charges free up at part GC
@@ -198,6 +200,8 @@ def _load(part, path: str):
         return _DECLINED
     fi = PartFilterIndex(cols, part.num_blocks, nbytes)
     weakref.finalize(fi, _bank_release, fi._charged)
+    from ..filterbank import _bank_track
+    _bank_track(fi)
     return fi
 
 

@@ -29,6 +29,7 @@ _MAX_TRIES = 16
 FINGERPRINT_BITS = 8
 
 
+# vlint: allow-canonical-helper(the 3-slot Graf-Lemire fastrange IS defined here, over per-segment seglen with a reseedable xor — not a copy of sb_block_select's salted whole-plane reduction)
 def _slots_and_fp(hashes: np.ndarray, seed: int, seglen: int):
     """Three fastrange slot indexes + the 8-bit fingerprint, all pure
     integer math on (hash, seed) so probes re-derive them from the

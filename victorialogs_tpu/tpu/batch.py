@@ -33,10 +33,12 @@ from dataclasses import dataclass, field as dc_field
 
 import os
 import threading
+import weakref
 
 import numpy as np
 
 from ..engine.block_search import BlockSearch
+from .. import config
 from ..logsql import filters as F
 from ..obs import hist
 from ..storage.filterbank import bloom_keep_mask
@@ -747,11 +749,11 @@ class CostModel:
 
     def __init__(self):
         self._mu = threading.Lock()
-        v = os.environ.get("VL_COST_RTT_MS")
+        v = config.env("VL_COST_RTT_MS")
         self.rtt = float(v) / 1e3 if v else None
-        v = os.environ.get("VL_COST_DEV_GBPS")
+        v = config.env("VL_COST_DEV_GBPS")
         self.dev_bytes_per_s = float(v) * 1e9 if v else None
-        v = os.environ.get("VL_COST_HOST_MROWS")
+        v = config.env("VL_COST_HOST_MROWS")
         # round-3 PERF.md: native host scans sustain 10-14M rows/s
         self.host_rows_per_s = float(v) * 1e6 if v else 12e6
         self.host_stats_rows_per_s = 30e6
@@ -772,7 +774,7 @@ class CostModel:
         # inflate the routing gate but should price the plan.
         self.unit_rtt_ewma: float | None = None
         self._unit_rtt_seen = False    # first unit pays jit compile
-        self.force = os.environ.get("VL_COST_FORCE", "")
+        self.force = config.env("VL_COST_FORCE") or ""
 
     # vlint: allow-jax-host-sync(the blocking round trip IS the probe)
     def measured_rtt(self) -> float:
@@ -919,6 +921,20 @@ class CostModel:
 
 # ---------------- the batch runner ----------------
 
+# live runners, for the vlsan end-of-test sweep: a non-daemon
+# vl-prefetch worker is fine while a reachable runner owns it (close()
+# releases it; the long-lived server runner never closes), and a
+# DROPPED runner's worker exits once the executor is collected — only
+# an ownerless surviving worker is a leak
+_live_runners: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_prefetch_pools() -> int:
+    """How many live runners currently own a prefetch pool."""
+    return sum(1 for r in list(_live_runners)
+               if r._prefetch_pool is not None)
+
+
 class BatchRunner:
     """Part-at-a-time filter evaluation with one dispatch per device leaf.
 
@@ -977,6 +993,7 @@ class BatchRunner:
         self._pack_mu = threading.Lock()
         self._packs: OrderedDict = OrderedDict()
         self._prefetch_pool = None  # lazy; see _prefetcher()
+        _live_runners.add(self)
 
     def _bump(self, attr: str, n=1) -> None:
         with self._counter_mu:
@@ -1037,10 +1054,8 @@ class BatchRunner:
             # override (a malformed value would make pack_rows_cap fall
             # through to measured_rtt and dispatch to the device from a
             # /metrics scrape)
-            try:
-                cap = max(1, int(os.environ.get("VL_PACK_MAX_ROWS", "")))
-            except ValueError:
-                cap = 0
+            v = config.env_int("VL_PACK_MAX_ROWS")
+            cap = max(1, v) if v is not None else 0
         out["pack_rows_cap"] = cap
         return out
 
@@ -1319,7 +1334,7 @@ class BatchRunner:
     def _run_part_device(self, f, part, bss: dict) -> dict:
         """run_part past the host gate (run_part_submit's fused-decline
         fallback lands here directly — its gate already ran)."""
-        trace_dir = os.environ.get("VL_XLA_TRACE_DIR")
+        trace_dir = config.env("VL_XLA_TRACE_DIR")
         if trace_dir:
             # XLA profiler hook at the block-runner seam (SURVEY §5);
             # inspect with tensorboard or xprof

@@ -44,12 +44,12 @@ them bit-exactly over randomized query matrices).
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 from functools import partial
 
 import jax
 import numpy as np
+from .. import config
 
 from ..logsql import filters as F
 from ..storage.filterbank import bloom_keep_mask, filter_bank
@@ -528,7 +528,7 @@ class _Planner:
         contiguous 8-lane gather + AND-compare per (block, token)
         (`bloom_sb` node, tpu/bloom_device.plane_keep_sb) instead of 6
         scattered lane selects."""
-        if os.environ.get("VL_DEVICE_BLOOM", "1") == "0":
+        if not config.env_flag("VL_DEVICE_BLOOM"):
             return None
         sb_node = self._bloom_sb_node(field, hashes)
         if sb_node is not None:
@@ -543,7 +543,7 @@ class _Planner:
         idx, shift = pad_probe_args(idx, shift, sp.bp)
         # the Pallas probe replaces the gather with a VMEM lane-select;
         # gated like kernels_pallas.match_scan, never on by default
-        use_pallas = (os.environ.get("VL_PALLAS") == "1"
+        use_pallas = (config.env("VL_PALLAS") == "1"
                       and idx.shape[1] <= MAX_PALLAS_PROBES)
         bid = self.runner._stage_block_ids(self.part, self.layout)
         self.runner._kind("bloom_device")
@@ -1288,7 +1288,7 @@ def fused_filter_enabled() -> bool:
     the pipeline's prefetch-mode decision so the two can never diverge
     (prefetching #fl layout staging for a path that will dispatch
     per-leaf would waste the upload AND leave the real staging cold)."""
-    return os.environ.get("VL_FUSED_FILTER", "1") != "0"
+    return config.env_flag("VL_FUSED_FILTER")
 
 
 def fused_filter_submit(runner, f, part, bss):

@@ -131,6 +131,20 @@ def stage_string_column(arena_np: np.ndarray, offsets_np: np.ndarray,
         nbytes=rb * w + rb * 4)
 
 
+import threading as _threading
+import weakref as _weakref
+
+_caches_mu = _threading.Lock()
+_caches: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def staging_caches() -> list:
+    """Every live StagingCache (vlsan sweeps check_balanced on each
+    after every test)."""
+    with _caches_mu:
+        return list(_caches)
+
+
 class StagingCache:
     """LRU over staged columns, bounded by device bytes.
 
@@ -139,6 +153,8 @@ class StagingCache:
 
     def __init__(self, max_bytes: int = 4 << 30):
         import threading
+        with _caches_mu:
+            _caches.add(self)
         self.max_bytes = max_bytes
         self._lru: OrderedDict[tuple, StagedStringColumn] = OrderedDict()
         self._bytes = 0

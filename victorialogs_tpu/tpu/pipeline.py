@@ -46,12 +46,12 @@ per-leaf row-query path inside each unit (tpu/fused.py).
 
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
 from dataclasses import dataclass
 
 import numpy as np
+from .. import config
 
 from ..obs import activity, events, hist, tracing
 from .. import sched
@@ -70,7 +70,7 @@ _AUTO_DEPTH_DEFAULT = 4
 
 
 def inflight_auto() -> bool:
-    return os.environ.get("VL_INFLIGHT", "").strip().lower() == "auto"
+    return (config.env("VL_INFLIGHT") or "").strip().lower() == "auto"
 
 
 def inflight_depth(runner=None, probe: bool = True) -> int:
@@ -87,7 +87,7 @@ def inflight_depth(runner=None, probe: bool = True) -> int:
     probe=False never issues the lazy RTT calibration dispatch — the
     EXPLAIN pricing pass (obs/explain.py) prices with the SAME depth
     derivation but must stay zero-dispatch (like pack_rows_cap)."""
-    v = os.environ.get("VL_INFLIGHT", "4")
+    v = config.env("VL_INFLIGHT")
     if v.strip().lower() == "auto":
         return _auto_depth(runner, probe)
     try:
@@ -117,10 +117,7 @@ def _auto_depth(runner, probe: bool = True) -> int:
 
 def pack_limit() -> int:
     """VL_PACK_PARTS: max parts per super-dispatch (<=1 disables)."""
-    try:
-        return max(1, int(os.environ.get("VL_PACK_PARTS", "8")))
-    except ValueError:
-        return 8
+    return max(1, config.env_int("VL_PACK_PARTS"))
 
 
 def pack_rows_cap(runner, probe: bool = True) -> int:
@@ -139,7 +136,7 @@ def pack_rows_cap(runner, probe: bool = True) -> int:
     EXPLAIN pricing pass (obs/explain.py) plans with the floor until a
     real query has measured the round trip — `explain=1` must stay
     zero-dispatch."""
-    v = os.environ.get("VL_PACK_MAX_ROWS")
+    v = config.env("VL_PACK_MAX_ROWS")
     if v:
         try:
             return max(1, int(v))
@@ -563,9 +560,10 @@ def _make_sync(runner):
 
     def sync(arr):
         t0 = time.perf_counter()
-        # vlint: allow-jax-host-sync(the window's single harvest point —
-        # materializing a completed dispatch in submission order IS the
-        # pipeline's output step; everything upstream stays async)
+        # the window's single harvest point — materializing a
+        # completed dispatch in submission order IS the pipeline's
+        # output step; everything upstream stays async
+        # vlint: allow-jax-host-sync(the single deliberate harvest sync; upstream stays async)
         out = np.asarray(arr)
         dt = time.perf_counter() - t0
         runner._bump("host_sync_wait_s", dt)

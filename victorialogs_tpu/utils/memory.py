@@ -8,14 +8,14 @@ VL_MEMORY_ALLOWED_BYTES for tests."""
 
 from __future__ import annotations
 
-import os
+from .. import config
 
 _cached: int | None = None
 
 
 def allowed() -> int:
     global _cached
-    env = os.environ.get("VL_MEMORY_ALLOWED_BYTES")
+    env = config.env("VL_MEMORY_ALLOWED_BYTES")
     if env:
         try:
             return int(env)
