@@ -6,8 +6,8 @@
 NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test race lint bench bench-bloom bench-pipeline \
-	bench-concurrent bench-emit bench-explain bench-faults \
-	bench-journal bench-wire clean
+	bench-cluster-obs bench-concurrent bench-emit bench-explain \
+	bench-faults bench-journal bench-wire clean
 
 all: native
 
@@ -95,6 +95,14 @@ bench-wire:
 # (PERF.md chaos round)
 bench-faults:
 	python tools/bench_faults.py --json BENCH_faults.json
+
+# cluster observability plane on a real 3-node cluster: rollup overhead
+# (<=1.10x concurrent p50) + the rollup-vs-node-sum differential,
+# federated active_queries completeness with parent_qid linkage, and
+# cancel-propagation kill latency vs the disconnect-probe path —
+# recorded into BENCH_cluster_obs.json (PERF.md round)
+bench-cluster-obs:
+	python tools/bench_cluster_obs.py --json BENCH_cluster_obs.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

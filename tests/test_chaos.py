@@ -367,3 +367,266 @@ def test_chaos_ingest_spool_zero_rows_lost():
                 p.wait(10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# ---------------- cluster observability plane ----------------
+#
+# Real multi-process coverage for the federated registry + usage
+# rollups: unlike the in-process suite (tests/test_cluster_obs.py,
+# where every server shares one process-global registry), each node
+# here accounts only its own share — so the rollup-vs-node-sum
+# differential is a genuine cross-process aggregation check, and the
+# qid linkage crosses real process boundaries.
+
+def _insert_tenant(port, rows, account, stream_fields="app"):
+    body = b"\n".join(json.dumps(r).encode() for r in rows)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/insert/jsonline?"
+        f"_stream_fields={stream_fields}", data=body,
+        headers={"AccountID": str(account)})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+
+
+def _metrics_text(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        return resp.read().decode()
+
+
+def _sample(text, sample):
+    """Value of one exact /metrics sample name (labels included), or
+    None when absent."""
+    for line in text.splitlines():
+        if line.startswith(sample + " "):
+            return float(line.split()[-1])
+    return None
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_cluster_obs_rollup_matches_per_node_sum(chaos):
+    """The 3-node differential: frontend vl_cluster_tenant_* == the sum
+    of every node's own vl_tenant_* for a tenant whose work is spread
+    across all nodes."""
+    front = chaos["front"]
+    rows = [{"_time": f"2026-07-28T11:00:{i % 60:02d}Z",
+             "_msg": f"tenant7 row {i}", "app": f"app{i % 10}"}
+            for i in range(300)]
+    _insert_tenant(front, rows, account=7)
+    for p in chaos["nodes"]:
+        _flush(p)
+    # two tenant-7 queries so select_seconds accrues on every node
+    for _ in range(2):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{front}/select/logsql/query?"
+            + urllib.parse.urlencode({"query": "* | stats count() n",
+                                      "timeout": "10s"}),
+            headers={"AccountID": "7"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            resp.read()
+
+    series = (("vl_tenant_rows_ingested_total",
+               "vl_cluster_tenant_rows_ingested_total"),
+              ("vl_tenant_select_seconds_total",
+               "vl_cluster_tenant_select_seconds_total"),
+              ("vl_tenant_bytes_scanned_total",
+               "vl_cluster_tenant_bytes_scanned_total"))
+    lbl = '{tenant="7:0"}'
+    deadline = time.monotonic() + 20
+    last = None
+    while time.monotonic() < deadline:
+        node_sums = {}
+        per_node_rows = []
+        for p in chaos["nodes"]:
+            text = _metrics_text(p)
+            for node_name, _cl in series:
+                v = _sample(text, node_name + lbl) or 0.0
+                node_sums[node_name] = node_sums.get(node_name, 0) + v
+            per_node_rows.append(
+                _sample(text, "vl_tenant_rows_ingested_total" + lbl)
+                or 0.0)
+        ftext = _metrics_text(front)
+        got = {cl: _sample(ftext, cl + lbl) for _n, cl in series}
+        last = (node_sums, got, per_node_rows)
+        ok = all(
+            got[cl] is not None
+            and abs(got[cl] - node_sums[nn])
+            <= max(1e-6, 1e-6 * abs(node_sums[nn]))
+            for nn, cl in series)
+        # every node holds a share (the work really is spread), the
+        # nodes' own counters sum to the ingested total, and the
+        # frontend rollup equals that sum
+        if ok and node_sums["vl_tenant_rows_ingested_total"] == 300 \
+                and all(v > 0 for v in per_node_rows) \
+                and node_sums["vl_tenant_select_seconds_total"] > 0:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError(
+            f"rollup never converged to the per-node sum: {last}")
+    # node liveness gauges ride the same rollup
+    for url in chaos["storage_urls"]:
+        assert _sample(ftext, f'vl_cluster_node_up{{node="{url}"}}') \
+            == 1
+
+    # federated top_queries across real processes: node-run sub-query
+    # completions carry their node URL, the frontend's own completions
+    # stay node="frontend", and nothing is listed twice
+    tq = _get_json(front, "/select/logsql/top_queries?cluster=1&n=100")
+    origins = {r["node"] for r in tq["top_queries"]}
+    assert "frontend" in origins
+    assert origins & set(chaos["storage_urls"]), origins
+    assert any(r["endpoint"] == "/internal/select/query"
+               and r.get("parent_qid")
+               for r in tq["top_queries"]), \
+        "node sub-query completions missing parent_qid attribution"
+    seen = [json.dumps({k: v for k, v in r.items() if k != "node"},
+                       sort_keys=True)
+            for r in tq["top_queries"]]
+    assert len(seen) == len(set(seen)), "federated merge double-counted"
+
+
+def test_cluster_obs_federated_views_degrade_and_recover(chaos):
+    """Chaos coverage: with one node dead, active_queries?cluster=1 and
+    /select/logsql/tenants answer partially (node marked down, never a
+    hang or 500); after revival the rollup recovers."""
+    proxy = chaos["proxy"]
+    front = chaos["front"]
+    want = _count(front)          # before the fault: breaker closed
+    proxy.set_mode("refuse")
+    try:
+        t0 = time.monotonic()
+        obj = _get_json(front, "/select/logsql/active_queries?cluster=1")
+        assert time.monotonic() - t0 < 10
+        ups = {n["node"]: n["up"] for n in obj["nodes"]}
+        assert ups[proxy.url] is False
+        assert all(ups[u] for u in chaos["storage_urls"][:2])
+        assert obj["failed_nodes"] == [proxy.url]
+
+        # the rollup marks the node down within a couple of polls and
+        # keeps serving the survivors' (and last-seen) totals
+        deadline = time.monotonic() + 15
+        down = None
+        while time.monotonic() < deadline:
+            tenants = _get_json(front, "/select/logsql/tenants")
+            down = {n["node"]: n["up"] for n in tenants["nodes"]}
+            if down[proxy.url] is False:
+                break
+            time.sleep(0.25)
+        assert down and down[proxy.url] is False
+        assert tenants["tenants"].get("0:0"), \
+            "last-seen totals vanished with the node"
+        assert _sample(_metrics_text(front),
+                       f'vl_cluster_node_up{{node="{proxy.url}"}}') == 0
+    finally:
+        proxy.set_mode("pass")
+    _wait_strict_ok(front, want)
+    deadline = time.monotonic() + 15
+    up = False
+    while time.monotonic() < deadline and not up:
+        tenants = _get_json(front, "/select/logsql/tenants")
+        up = {n["node"]: n["up"] for n in tenants["nodes"]}[proxy.url]
+        time.sleep(0.25)
+    assert up, "rollup never recovered after revival"
+
+
+def test_cluster_obs_linkage_and_cancel_propagation(chaos):
+    """End-to-end qid traceability across real processes: the federated
+    view nests each node's sub-query under the frontend query by
+    propagated parent_qid, and cancel_query on the frontend qid kills
+    the sub-queries on every node directly (no disconnect-probe lag).
+    Runs LAST in this module: it ingests extra rows."""
+    import threading
+    front = chaos["front"]
+    # enough data that the fan-out stays in flight long enough to
+    # observe: ~45k rows across 3 nodes, under a dedicated tenant
+    for batch in range(3):
+        rows = [{"_time": f"2026-07-28T12:{(i // 60) % 60:02d}:"
+                          f"{i % 60:02d}Z",
+                 "_msg": f"request {'error' if i % 3 == 0 else 'ok'} "
+                         f"path=/x/{i} id={i}",
+                 "app": f"app{i % 10}"}
+                for i in range(batch * 15000, (batch + 1) * 15000)]
+        _insert_tenant(front, rows, account=9)
+    for p in chaos["nodes"]:
+        _flush(p)
+    slow_q = ('~"request" | stats by (_msg) count() c, '
+              'count_uniq(id) u')
+
+    prop0 = sum(_sample(_metrics_text(p),
+                        "vl_queries_cancel_propagated_total") or 0
+                for p in chaos["nodes"])
+    linked = cancelled = None
+    for _attempt in range(6):
+        result = {}
+
+        def go():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{front}/select/logsql/query?"
+                + urllib.parse.urlencode({"query": slow_q,
+                                          "timeout": "30s"}),
+                headers={"AccountID": "9"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+                result["done"] = "ok"
+            except (urllib.error.HTTPError, OSError) as e:
+                result["done"] = str(e)
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and "done" not in result:
+            obj = _get_json(front,
+                            "/select/logsql/active_queries?cluster=1")
+            got = [r for r in obj["data"]
+                   if r.get("storage_node_queries")]
+            if got:
+                linked = got[0]
+                break
+            time.sleep(0.003)
+        if linked is not None and "done" not in result:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{front}/select/logsql/cancel_query"
+                f"?qid={linked['qid']}", data=b"")
+            t_cancel = time.monotonic()
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                cobj = json.loads(resp.read())
+            if cobj["propagated"]["cancelled"] >= 1:
+                cancelled = cobj
+                t.join(20)
+                break
+        t.join(30)
+        linked = None
+    assert linked is not None, "never caught the fan-out in flight"
+    assert cancelled is not None, \
+        "cancel never reached an in-flight sub-query"
+
+    # linkage shape: sub-records carry the propagated parent identity
+    subs = linked["storage_node_queries"]
+    assert subs and all(s["parent_qid"] == linked["global_qid"]
+                        for s in subs)
+    assert {s["node"] for s in subs} <= set(chaos["storage_urls"])
+
+    # the kill is direct: every node's registry drains promptly (the
+    # old path waited for the frontend disconnect probe / next frame
+    # write), and the node-side propagation counter moved
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        live = []
+        for p in chaos["nodes"]:
+            live += _get_json(p, "/select/logsql/active_queries")["data"]
+        if not live:
+            break
+        time.sleep(0.05)
+    drain_s = time.monotonic() - t_cancel
+    assert not live, f"sub-queries still live {drain_s:.1f}s after cancel"
+    prop1 = sum(_sample(_metrics_text(p),
+                        "vl_queries_cancel_propagated_total") or 0
+                for p in chaos["nodes"])
+    assert prop1 > prop0

@@ -1,0 +1,629 @@
+"""Cluster observability plane (propagated query identity + federated
+registry/cancel + tenant usage rollups):
+
+- parent_qid propagation: internal sub-query records/traces/journal
+  events carry the frontend query's global_qid end to end;
+- cancel_by_parent drain pin: a propagated cancel trips the record's
+  cancel flag directly and the device window drains with no downstream
+  writes (mirrors the PR 6 single-node pin);
+- federated views: active_queries?cluster=1 nests node sub-queries
+  under their parent, top_queries?cluster=1 merges rings with node
+  attribution, ?tenant= filters both (400 on malformed);
+- usage rollups: GET /internal/usage, the clusterstats poll loop,
+  vl_cluster_tenant_* /metrics aggregation and /select/logsql/tenants;
+- chaos: a dead/hung node degrades the federated views (node marked
+  down) instead of hanging or 500ing; cancel propagation to a dead
+  node is best-effort and journaled.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from test_obs import parse_prometheus
+
+from victorialogs_tpu.engine.searcher import run_query
+from victorialogs_tpu.obs import activity, events
+from victorialogs_tpu.sched.netfaults import FaultProxy
+from victorialogs_tpu.server import cluster as cluster_mod
+from victorialogs_tpu.server import netrobust
+from victorialogs_tpu.server.app import VLServer
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.tpu.batch import BatchRunner
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+N_PARTS = 12                    # < datadb.DEFAULT_PARTS_TO_MERGE (15)
+ROWS_PER_PART = 600
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return BatchRunner()
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    """Many small parts so a cancel lands mid-scan with plenty of walk
+    left to drain (the PR 6 fixture shape)."""
+    path = str(tmp_path_factory.mktemp("cobstore"))
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    n = 0
+    for _pp in range(N_PARTS):
+        lr = LogRows(stream_fields=["app"])
+        for _i in range(ROWS_PER_PART):
+            g = n
+            n += 1
+            lr.add(TEN, T0 + g * 50_000_000, [
+                ("app", f"app{g % 4}"),
+                ("_msg", f"m {'error' if g % 3 == 0 else 'ok'} {g}"),
+            ])
+        s.must_add_rows(lr)
+        s.debug_flush()
+    yield s
+    s.close()
+
+
+class _EventTap:
+    """Bus collector for journal-event assertions (events.subscribe
+    callbacks take (ts_ns, event, fields))."""
+
+    def __init__(self, *names):
+        self.names = names
+        self.got = []
+
+    def __call__(self, ts_ns, event, fields):
+        if event in self.names:
+            self.got.append((event, dict(fields)))
+
+    def __enter__(self):
+        events.subscribe(self)
+        return self
+
+    def __exit__(self, *exc):
+        events.unsubscribe(self)
+        return False
+
+
+# ---------------- identity + cascading-cancel drain pin ----------------
+
+def test_parent_qid_rides_record_completion_and_journal(storage, runner):
+    gq = activity.global_qid("777")
+    with _EventTap("query_done") as tap:
+        with activity.track("/internal/select/query", "error | limit 5",
+                            TEN, parent_qid=gq) as act:
+            qid = act.qid
+            snap = [a for a in activity.active_snapshot()
+                    if a["qid"] == qid][0]
+            assert snap["parent_qid"] == gq
+            run_query(storage, [TEN], "error | limit 5",
+                      write_block=lambda br: None, runner=runner)
+    rec = [r for r in activity.completed_snapshot()
+           if r["qid"] == qid][0]
+    assert rec["parent_qid"] == gq
+    done = [f for e, f in tap.got if f.get("qid") == qid]
+    assert done and done[0]["parent_qid"] == gq
+
+
+def test_propagated_cancel_drains_window_no_downstream_writes(
+        storage, runner):
+    """The cascading-cancel latency pin: tripping the record's cancel
+    flag via cancel_by_parent (what POST /internal/select/cancel does)
+    drains the in-flight device window with no further downstream
+    writes — same contract as the PR 6 local-cancel pin, but driven by
+    the PROPAGATED identity instead of the node-local qid."""
+    baseline = []
+    with activity.track("/internal/select/query", "error", TEN,
+                        parent_qid=activity.global_qid("b0")):
+        run_query(storage, [TEN], "error",
+                  write_block=lambda br: baseline.append(br.nrows),
+                  runner=runner)
+    assert len(baseline) > 2
+
+    gq = activity.global_qid("cancelme")
+    blocks = []
+    with activity.track("/internal/select/query", "error", TEN,
+                        parent_qid=gq) as act:
+        qid = act.qid
+
+        def sink(br):
+            blocks.append(br.nrows)
+            if len(blocks) == 1:
+                # what a frontend cancel propagation does on this node
+                assert activity.cancel_by_parent(gq) == 1
+        run_query(storage, [TEN], "error", write_block=sink,
+                  runner=runner)
+    assert len(blocks) <= 2
+    assert len(blocks) < len(baseline)
+    rec = [r for r in activity.completed_snapshot()
+           if r["qid"] == qid][0]
+    assert rec["status"] == "cancelled"
+    assert rec["parent_qid"] == gq
+
+
+def test_cancel_by_parent_unknown_is_zero():
+    assert activity.cancel_by_parent("nope:1") == 0
+    assert activity.cancel_by_parent("") == 0
+
+
+# ---------------- HTTP plumbing helpers ----------------
+
+def _req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _jreq(port, method, path, **kw):
+    st, data = _req(port, method, path, **kw)
+    return st, (json.loads(data) if data[:1] in (b"{", b"[") else data)
+
+
+def _mk_node(path, rows=0, runner=None, seed_offset=0):
+    st = Storage(str(path), retention_days=100000, flush_interval=3600)
+    if rows:
+        lr = LogRows(stream_fields=["app"])
+        for i in range(rows):
+            g = seed_offset + i
+            lr.add(TEN, T0 + g * 1_000_000, [
+                ("app", f"app{g % 5}"),
+                ("_msg", f"request {'error' if g % 3 == 0 else 'ok'} "
+                         f"path=/x/{g} id={g}")])
+        st.must_add_rows(lr)
+        st.debug_flush()
+    srv = VLServer(st, listen_addr="127.0.0.1", port=0, runner=runner)
+    return srv, st
+
+
+# ---------------- /internal/usage + /internal/select/cancel ----------------
+
+def test_internal_usage_endpoint(tmp_path, runner):
+    srv, st = _mk_node(tmp_path / "n", rows=100, runner=runner)
+    try:
+        s, obj = _jreq(srv.port, "GET", "/internal/usage")
+        assert s == 200
+        assert obj["status"] == "ok"
+        assert "tenants" in obj and "0:0" in obj["tenants"]
+        slot = obj["tenants"]["0:0"]
+        for k in ("select_queries", "select_seconds", "bytes_scanned",
+                  "rows_ingested", "bytes_ingested"):
+            assert k in slot
+        assert obj["active_queries"] >= 0
+        assert obj["queued"] >= 0
+        assert obj["admission"]["select"]["pool"] == "select"
+        assert "pending_merges" in obj["storage"]
+    finally:
+        srv.close()
+        st.close()
+
+
+def test_internal_cancel_endpoint(tmp_path, runner):
+    srv, st = _mk_node(tmp_path / "n", runner=runner)
+    try:
+        # guards: POST-only, args required
+        s, _ = _req(srv.port, "GET",
+                    "/internal/select/cancel?parent_qid=x:1")
+        assert s == 405
+        s, _ = _req(srv.port, "POST", "/internal/select/cancel")
+        assert s == 400
+
+        gq = activity.global_qid("http-cancel")
+        with activity.track("/internal/select/query", "*", TEN,
+                            parent_qid=gq) as act:
+            s, obj = _jreq(srv.port, "POST",
+                           "/internal/select/cancel?parent_qid="
+                           + urllib.parse.quote(gq))
+            assert s == 200 and obj["cancelled"] == 1
+            assert act.is_cancelled()
+        # no match: 200 with cancelled=0 (best-effort contract)
+        s, obj = _jreq(srv.port, "POST",
+                       "/internal/select/cancel?parent_qid="
+                       + urllib.parse.quote(gq))
+        assert s == 200 and obj["cancelled"] == 0
+        # the node-side counter rolled exactly once
+        s, data = _req(srv.port, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples["vl_queries_cancel_propagated_total"] == 1
+    finally:
+        srv.close()
+        st.close()
+
+
+# ---------------- tenant filtering (local forms) ----------------
+
+def test_tenant_filter_validation_and_filtering(tmp_path, runner):
+    srv, st = _mk_node(tmp_path / "n", runner=runner)
+    try:
+        for ep in ("/select/logsql/active_queries",
+                   "/select/logsql/top_queries",
+                   "/select/logsql/tenants"):
+            s, _ = _req(srv.port, "GET", ep + "?tenant=bogus")
+            assert s == 400, ep
+            s, _ = _req(srv.port, "GET", ep + "?tenant=1:2:3")
+            assert s == 400, ep
+
+        with activity.track("/t/a", "*", TenantID(41, 0)) as act_a, \
+                activity.track("/t/b", "*", TenantID(42, 0)):
+            s, obj = _jreq(srv.port, "GET",
+                           "/select/logsql/active_queries?tenant=41:0")
+            assert s == 200
+            assert {e["tenant"] for e in obj["data"]} == {"41:0"}
+            assert any(e["qid"] == act_a.qid for e in obj["data"])
+        # completed ring scoping
+        s, obj = _jreq(srv.port, "GET",
+                       "/select/logsql/top_queries?tenant=41:0&n=50")
+        assert s == 200
+        assert obj["top_queries"]
+        assert {r["tenant"] for r in obj["top_queries"]} == {"41:0"}
+        # local tenants view
+        s, obj = _jreq(srv.port, "GET",
+                       "/select/logsql/tenants?tenant=41:0")
+        assert s == 200 and obj["cluster"] is False
+        assert set(obj["tenants"]) == {"41:0"}
+    finally:
+        srv.close()
+        st.close()
+
+
+# ---------------- in-process cluster: federation end to end ----------------
+
+@pytest.fixture(scope="module")
+def cluster2(tmp_path_factory, runner):
+    """2 storage nodes (30k rows each, device runner) + a frontend —
+    real HTTP in one process."""
+    netrobust.reset_for_tests()
+    base = tmp_path_factory.mktemp("cobclu")
+    nodes = []
+    for k in range(2):
+        nodes.append(_mk_node(base / f"n{k}", rows=30000, runner=runner,
+                              seed_offset=k * 30000))
+    urls = [f"http://127.0.0.1:{srv.port}" for srv, _st in nodes]
+    fst = Storage(str(base / "front"), retention_days=100000,
+                  flush_interval=3600)
+    front = VLServer(fst, listen_addr="127.0.0.1", port=0,
+                     storage_nodes=urls)
+    yield {"front": front, "nodes": nodes, "urls": urls}
+    front.close()
+    fst.close()
+    for srv, st in nodes:
+        srv.close()
+        st.close()
+    netrobust.reset_for_tests()
+
+
+SLOW_Q = "* | stats by (_msg) count() c"
+
+
+def _start_query(port, query, result, **args):
+    args = dict({"query": query, "timeout": "30s"}, **args)
+
+    def go():
+        try:
+            result["resp"] = _req(port, "GET",
+                                  "/select/logsql/query?"
+                                  + urllib.parse.urlencode(args))
+        except OSError as e:
+            result["resp"] = ("err", str(e))
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t
+
+
+def _find_linked(obj):
+    return [rec for rec in obj["data"]
+            if rec.get("storage_node_queries")]
+
+
+def test_federated_active_queries_nest_by_parent_qid(cluster2):
+    """One frontend query is traceable end-to-end: the ?cluster=1 view
+    shows its storage-node sub-queries nested under it, matched by the
+    propagated parent_qid == the frontend record's global_qid."""
+    front = cluster2["front"]
+    linked = None
+    for _attempt in range(10):
+        result = {}
+        t = _start_query(front.port, SLOW_Q, result)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and "resp" not in result:
+            s, obj = _jreq(front.port, "GET",
+                           "/select/logsql/active_queries?cluster=1")
+            assert s == 200 and obj["cluster"] is True
+            got = _find_linked(obj)
+            if got:
+                linked = (got[0], obj)
+                break
+            time.sleep(0.002)
+        t.join(20)
+        if linked:
+            break
+    assert linked, "never caught the fan-out in flight"
+    rec, obj = linked
+    assert rec["endpoint"] == "/select/logsql/query"
+    assert rec["global_qid"] == activity.global_qid(rec["qid"])
+    subs = rec["storage_node_queries"]
+    assert all(s["parent_qid"] == rec["global_qid"] for s in subs)
+    assert all(s["endpoint"] == "/internal/select/query" for s in subs)
+    assert {s["node"] for s in subs} <= set(cluster2["urls"])
+    # per-node metadata: both nodes answered the federation fan-out
+    assert [n["up"] for n in obj["nodes"]] == [True, True]
+
+
+def test_cancel_query_propagates_and_kills_subqueries(cluster2):
+    """cancel_query on the frontend qid reaches every node by
+    parent_qid: the response's propagated block reports >=1 sub-query
+    cancelled, the registries drain promptly, and the node-side
+    vl_queries_cancel_propagated_total counter moves."""
+    front = cluster2["front"]
+    nsrv, _nst = cluster2["nodes"][0]
+    s, data = _req(nsrv.port, "GET", "/metrics")
+    prop0 = parse_prometheus(data.decode()).get(
+        "vl_queries_cancel_propagated_total", 0)
+    with _EventTap("query_cancel_propagated") as tap:
+        prop = None
+        for _attempt in range(10):
+            result = {}
+            t = _start_query(front.port, SLOW_Q, result)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and "resp" not in result:
+                s, obj = _jreq(front.port, "GET",
+                               "/select/logsql/active_queries?cluster=1")
+                got = _find_linked(obj)
+                if got:
+                    qid = got[0]["qid"]
+                    s, cobj = _jreq(front.port, "POST",
+                                    "/select/logsql/cancel_query?qid="
+                                    + qid)
+                    if s == 200 and \
+                            cobj["propagated"]["cancelled"] >= 1:
+                        prop = cobj["propagated"]
+                    break
+                time.sleep(0.002)
+            t.join(20)
+            if prop is not None:
+                break
+        assert prop is not None, \
+            "cancel never caught a sub-query in flight"
+    assert prop["nodes_ok"] == 2 and prop["nodes_failed"] == 0
+    assert any(f["cancelled"] >= 1 for _e, f in tap.got)
+    # registries drain (frontend + nodes) with nothing stuck
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not activity.active_snapshot():
+            break
+        time.sleep(0.02)
+    assert not activity.active_snapshot()
+    s, data = _req(nsrv.port, "GET", "/metrics")
+    prop1 = parse_prometheus(data.decode())[
+        "vl_queries_cancel_propagated_total"]
+    assert prop1 > prop0
+
+
+def test_trace_carries_qid_and_parent_qid(cluster2):
+    front = cluster2["front"]
+    # a stats-shaped query drains every node frame (an early-done limit
+    # would cut the trailing trace frame — trace_truncated by design)
+    s, data = _req(front.port, "GET", "/select/logsql/query?"
+                   + urllib.parse.urlencode({
+                       "query": "error | stats count() c",
+                       "trace": "1"}))
+    assert s == 200
+    tree = None
+    for line in data.decode().splitlines():
+        obj = json.loads(line)
+        if "_trace" in obj:
+            tree = obj["_trace"]
+    assert tree is not None
+    front_qid = tree["attrs"]["qid"]
+
+    def walk(node):
+        yield node
+        for c in node.get("children", ()):
+            yield from walk(c)
+
+    node_roots = [n for n in walk(tree)
+                  if n.get("name") == "storage_node_query"]
+    assert len(node_roots) == 2
+    for nr in node_roots:
+        assert nr["attrs"]["parent_qid"] == \
+            activity.global_qid(front_qid)
+        assert nr["attrs"]["qid"]
+
+
+def test_federated_top_queries_merge_and_errors(cluster2):
+    front = cluster2["front"]
+    # a couple of completions to merge
+    for _ in range(2):
+        s, _d = _req(front.port, "GET", "/select/logsql/query?"
+                     + urllib.parse.urlencode(
+                         {"query": "error | limit 2"}))
+        assert s == 200
+    s, obj = _jreq(front.port, "GET",
+                   "/select/logsql/top_queries?cluster=1&n=30")
+    assert s == 200 and obj["cluster"] is True
+    top = obj["top_queries"]
+    assert top and all("node" in r for r in top)
+    assert "frontend" in {r["node"] for r in top}
+    # the combined-deployment dedup guard: this in-process cluster
+    # shares ONE completed ring, so every node's fan-out re-serves the
+    # records the frontend already contributed — the merge must not
+    # list any record twice (node attribution on distinct records is
+    # pinned on the real multi-process cluster in test_chaos.py)
+    fps = [cluster_mod._rec_fingerprint(r) for r in top]
+    assert len(fps) == len(set(fps)), "federated merge double-counted"
+    durs = [r.get("duration_s", 0) for r in top]
+    assert durs == sorted(durs, reverse=True)
+    assert len(top) <= 30
+    # error paths keep local-form behavior under cluster=1
+    s, _ = _req(front.port, "GET",
+                "/select/logsql/top_queries?cluster=1&by=bogus")
+    assert s == 400
+    s, _ = _req(front.port, "GET",
+                "/select/logsql/top_queries?cluster=1&tenant=xx")
+    assert s == 400
+
+
+def test_cluster_rollup_metrics_match_node_usage_sum(cluster2):
+    """The differential: the frontend's vl_cluster_tenant_* aggregates
+    equal the sum of what each node's /internal/usage reports (and the
+    tenants endpoint serves the same numbers)."""
+    front = cluster2["front"]
+    assert front.clusterstats is not None
+    front.clusterstats.poll_now()
+    expect = {}
+    for srv, _st in cluster2["nodes"]:
+        s, obj = _jreq(srv.port, "GET", "/internal/usage")
+        assert s == 200
+        for t, slot in obj["tenants"].items():
+            cur = expect.setdefault(t, {"select_seconds": 0,
+                                        "bytes_scanned": 0,
+                                        "rows_ingested": 0})
+            for k in cur:
+                cur[k] += slot[k]
+    s, data = _req(front.port, "GET", "/metrics")
+    samples = parse_prometheus(data.decode())
+    for t, slot in expect.items():
+        for key, name in (
+                ("select_seconds",
+                 "vl_cluster_tenant_select_seconds_total"),
+                ("bytes_scanned",
+                 "vl_cluster_tenant_bytes_scanned_total"),
+                ("rows_ingested",
+                 "vl_cluster_tenant_rows_ingested_total")):
+            got = samples[f'{name}{{tenant="{t}"}}']
+            assert got == pytest.approx(slot[key], rel=1e-6), (t, name)
+    for url in cluster2["urls"]:
+        assert samples[f'vl_cluster_node_up{{node="{url}"}}'] == 1
+        assert f'vl_cluster_stats_age_seconds{{node="{url}"}}' in samples
+    # the JSON twin serves the same aggregation
+    s, obj = _jreq(front.port, "GET", "/select/logsql/tenants")
+    assert s == 200 and obj["cluster"] is True
+    for t, slot in expect.items():
+        for k in ("select_seconds", "bytes_scanned", "rows_ingested"):
+            assert obj["tenants"][t][k] == pytest.approx(
+                slot[k], rel=1e-6)
+    assert all(n["up"] for n in obj["nodes"])
+
+
+# ---------------- chaos: dead/hung nodes degrade, never hang ----------------
+
+@pytest.fixture()
+def chaos2(tmp_path, monkeypatch, runner):
+    """2 tiny nodes, node1 behind a FaultProxy; fast-recovery knobs."""
+    monkeypatch.setenv("VL_BREAKER_FAILURES", "1")
+    monkeypatch.setenv("VL_BREAKER_OPEN_S", "0.5")
+    monkeypatch.setenv("VL_NET_RETRIES", "0")
+    monkeypatch.setenv("VL_CLUSTER_STATS_MS", "200")
+    monkeypatch.setattr(cluster_mod, "FED_TIMEOUT_S", 1.0)
+    netrobust.reset_for_tests()
+    n0, st0 = _mk_node(tmp_path / "n0", rows=600, runner=runner)
+    n1, st1 = _mk_node(tmp_path / "n1", rows=600, seed_offset=600,
+                       runner=runner)
+    proxy = FaultProxy("127.0.0.1", n1.port)
+    urls = [f"http://127.0.0.1:{n0.port}", proxy.url]
+    fst = Storage(str(tmp_path / "front"), retention_days=100000,
+                  flush_interval=3600)
+    front = VLServer(fst, listen_addr="127.0.0.1", port=0,
+                     storage_nodes=urls)
+    yield {"front": front, "proxy": proxy, "urls": urls}
+    proxy.close()
+    front.close()
+    fst.close()
+    for srv, st in ((n0, st0), (n1, st1)):
+        srv.close()
+        st.close()
+    netrobust.reset_for_tests()
+
+
+@pytest.mark.parametrize("mode", ["refuse", "hang"])
+def test_federated_views_degrade_with_dead_node(chaos2, mode):
+    front, proxy = chaos2["front"], chaos2["proxy"]
+    proxy.set_mode(mode)
+    try:
+        t0 = time.monotonic()
+        s, obj = _jreq(front.port, "GET",
+                       "/select/logsql/active_queries?cluster=1")
+        wall = time.monotonic() - t0
+        assert s == 200, "federated view 500ed on a dead node"
+        assert wall < 5, f"federated view hung {wall:.1f}s"
+        ups = {n["node"]: n["up"] for n in obj["nodes"]}
+        assert ups[chaos2["urls"][0]] is True
+        assert ups[proxy.url] is False
+        assert obj["failed_nodes"] == [proxy.url]
+
+        # top_queries degrades the same way
+        s, tobj = _jreq(front.port, "GET",
+                        "/select/logsql/top_queries?cluster=1")
+        assert s == 200 and tobj["failed_nodes"] == [proxy.url]
+
+        # the rollup marks the node down after its next poll...
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            front.clusterstats.poll_now()
+            s, tenants = _jreq(front.port, "GET",
+                               "/select/logsql/tenants")
+            down = {n["node"]: n for n in tenants["nodes"]}
+            if not down[proxy.url]["up"]:
+                break
+            time.sleep(0.05)
+        assert not down[proxy.url]["up"]
+        # ...and still serves the surviving node + last-seen totals
+        assert down[chaos2["urls"][0]]["up"]
+        assert tenants["tenants"]
+        s, data = _req(front.port, "GET", "/metrics")
+        samples = parse_prometheus(data.decode())
+        assert samples[f'vl_cluster_node_up{{node="{proxy.url}"}}'] == 0
+        assert samples[
+            f'vl_cluster_node_up{{node="{chaos2["urls"][0]}"}}'] == 1
+    finally:
+        proxy.set_mode("pass")
+
+
+def test_cancel_propagation_to_dead_node_best_effort(chaos2):
+    front, proxy = chaos2["front"], chaos2["proxy"]
+    proxy.set_mode("refuse")
+    try:
+        with _EventTap("query_cancel_propagated") as tap, \
+                activity.track("/select/logsql/query", "*", TEN) as act:
+            s, obj = _jreq(front.port, "POST",
+                           "/select/logsql/cancel_query?qid=" + act.qid)
+            assert s == 200, "cancel failed because a node is dead"
+            prop = obj["propagated"]
+            assert prop["nodes_failed"] >= 1
+            assert proxy.url in prop["failed_nodes"]
+            assert act.is_cancelled()
+        assert tap.got, "propagation was not journaled"
+        _ev, fields = tap.got[0]
+        assert proxy.url in fields.get("failed_nodes", "")
+    finally:
+        proxy.set_mode("pass")
+
+
+def test_rollup_recovers_after_node_revival(chaos2):
+    front, proxy = chaos2["front"], chaos2["proxy"]
+    proxy.set_mode("refuse")
+    try:
+        front.clusterstats.poll_now()
+        s, obj = _jreq(front.port, "GET", "/select/logsql/tenants")
+        down = {n["node"]: n["up"] for n in obj["nodes"]}
+        assert down[proxy.url] is False
+    finally:
+        proxy.set_mode("pass")
+    # breaker half-opens after 0.5s; the poll probe IS the recovery
+    deadline = time.monotonic() + 10
+    up = False
+    while time.monotonic() < deadline and not up:
+        time.sleep(0.1)
+        front.clusterstats.poll_now()
+        s, obj = _jreq(front.port, "GET", "/select/logsql/tenants")
+        up = {n["node"]: n["up"] for n in obj["nodes"]}[proxy.url]
+    assert up, "rollup never recovered after revival"

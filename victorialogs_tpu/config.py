@@ -348,6 +348,14 @@ declare_env(
     "`0` disables spooling)",
     display="256 MiB")
 declare_env(
+    "VL_CLUSTER_STATS_MS", "1000", "int",
+    "cluster frontends poll every storage node's `GET /internal/usage` "
+    "on this cadence, rolling per-tenant usage up into "
+    "`vl_cluster_tenant_*_total` and node liveness into "
+    "`vl_cluster_node_up{node=}` on the frontend /metrics plus "
+    "`GET /select/logsql/tenants` (`obs/clusterstats.py`; `0` disables "
+    "the poll loop)")
+declare_env(
     "VL_MEMORY_ALLOWED_BYTES", None, "int",
     "query memory budget", display="auto")
 declare_env(
@@ -583,6 +591,27 @@ declare_metric("vl_insert_spool_overflow_total", "counter",
                single_roll=True)
 declare_metric("vl_insert_spool_bytes", "gauge",
                "bytes currently spooled per node")
+
+# -- cluster observability plane (obs/clusterstats.py, federated
+#    registry + cancel propagation in server/cluster.py + app.py) --
+declare_metric("vl_cluster_tenant_select_seconds_total", "counter",
+               "select execution seconds per tenant summed across all "
+               "storage nodes (frontend rollup)", single_roll=True)
+declare_metric("vl_cluster_tenant_bytes_scanned_total", "counter",
+               "bytes scanned per tenant summed across all storage "
+               "nodes (frontend rollup)", single_roll=True)
+declare_metric("vl_cluster_tenant_rows_ingested_total", "counter",
+               "rows ingested per tenant summed across all storage "
+               "nodes (frontend rollup)", single_roll=True)
+declare_metric("vl_cluster_node_up", "gauge",
+               "1 when the node answered the last usage poll, else 0",
+               single_roll=True)
+declare_metric("vl_cluster_stats_age_seconds", "gauge",
+               "staleness of a node's last successful usage poll",
+               single_roll=True)
+declare_metric("vl_queries_cancel_propagated_total", "counter",
+               "sub-queries cancelled via propagated cluster cancel "
+               "(POST /internal/select/cancel)", single_roll=True)
 
 # -- histograms (obs/hist.py) --
 declare_metric("vl_query_duration_seconds", "histogram",

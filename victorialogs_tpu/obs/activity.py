@@ -45,6 +45,7 @@ ingested, parse failures) accumulate forever and are rendered into
 from __future__ import annotations
 
 import contextvars
+import os
 import threading
 import time
 from collections import deque
@@ -57,6 +58,21 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
 PHASES = ("queued", "plan", "prune", "scan", "harvest", "emit")
 
 _COMPLETED_MAX = 256
+
+# Process-unique origin token for CLUSTER-wide query identity: local
+# qids are a plain per-process counter ("1", "2", ...), so two
+# frontends mint colliding qids.  global_qid() prefixes the origin,
+# and that spelling is what frontends propagate as `parent_qid` on
+# every /internal/select hop — storage-node records tagged with it are
+# attributable to exactly one frontend query, cluster-wide.
+_ORIGIN = os.urandom(4).hex()
+
+
+def global_qid(qid) -> str:
+    """Cluster-unique spelling of one of THIS process's qids (the
+    `parent_qid` value shipped with internal sub-requests and matched
+    by the federated active_queries merge)."""
+    return f"{_ORIGIN}:{qid}"
 
 
 def tenant_str(tenant) -> str:
@@ -81,15 +97,19 @@ class QueryActivity:
 
     __slots__ = ("qid", "tenant", "endpoint", "query", "start_unix",
                  "start_mono", "exec_mono", "phase", "abandoned", "_mu",
-                 "_c", "_cancel", "_phase_t0")
+                 "_c", "_cancel", "_phase_t0", "parent_qid")
 
     enabled = True
 
-    def __init__(self, qid: str, endpoint: str, query: str, tenant: str):
+    def __init__(self, qid: str, endpoint: str, query: str, tenant: str,
+                 parent_qid: str = ""):
         self.qid = qid
         self.endpoint = endpoint
         self.query = query
         self.tenant = tenant
+        # the propagated cluster identity: the frontend query this
+        # record is a sub-query of (global_qid spelling), or ""
+        self.parent_qid = parent_qid
         # vlint: allow-wall-clock(start timestamp shown to operators is real wall time)
         self.start_unix = time.time()
         self.start_mono = time.monotonic()
@@ -194,6 +214,8 @@ class QueryActivity:
             "duration_s": round(time.monotonic() - self.start_mono, 6),
             "progress": progress,
         }
+        if self.parent_qid:
+            out["parent_qid"] = self.parent_qid
         if self._cancel.is_set():
             out["cancel_requested"] = True
         if abandoned:
@@ -215,6 +237,7 @@ class _NoopActivity:
     phase = ""
     abandoned = False
     exec_mono = None
+    parent_qid = ""
 
     def add(self, key, n=1) -> None:
         pass
@@ -306,20 +329,24 @@ class _Track:
     sets the ambient activity on enter; deregisters, restores the
     ambient, and rolls the per-tenant accounting on EVERY exit path."""
 
-    __slots__ = ("_endpoint", "_query", "_tenant", "_act", "_token")
+    __slots__ = ("_endpoint", "_query", "_tenant", "_act", "_token",
+                 "_parent_qid")
 
-    def __init__(self, endpoint: str, query: str, tenant):
+    def __init__(self, endpoint: str, query: str, tenant,
+                 parent_qid: str = ""):
         self._endpoint = endpoint
         self._query = query
         self._tenant = tenant_str(tenant)
         self._act = None
         self._token = None
+        self._parent_qid = parent_qid
 
     def __enter__(self) -> QueryActivity:
         with _reg_mu:
             qid = _next_qid()
             act = QueryActivity(qid, self._endpoint, self._query,
-                                self._tenant)
+                                self._tenant,
+                                parent_qid=self._parent_qid)
             _active[qid] = act
         self._act = act
         self._token = _current.set(act)
@@ -361,6 +388,11 @@ class _Track:
             # what top_queries?by=cost_error sorts on: the dimension
             # the plan-time pricing got MOST wrong for this query
             rec["cost_error"] = cost_error
+        if act.parent_qid:
+            # the propagated cluster identity survives into the
+            # completed ring (federated top_queries attribution) and
+            # the query_done journal event below
+            rec["parent_qid"] = act.parent_qid
         with _reg_mu:
             _active.pop(act.qid, None)
             if len(_completed) == _COMPLETED_MAX:
@@ -375,9 +407,10 @@ class _Track:
         # query-lifecycle completion onto the event bus (outside every
         # lock; system-tenant completions are suppressed there — the
         # journal must not journal queries against itself)
+        extra = {"parent_qid": act.parent_qid} if act.parent_qid else {}
         events.emit("query_done", tenant=act.tenant, qid=act.qid,
                     endpoint=act.endpoint, status=status,
-                    duration_ms=round(duration * 1e3, 3),
+                    duration_ms=round(duration * 1e3, 3), **extra,
                     **{k: v for k, v in sorted(progress.items())
                        if isinstance(v, (int, float))})
         return False
@@ -424,11 +457,13 @@ def _fold_cost_errors(progress: dict, status: str,
     return round(max(errs.values()), 6)
 
 
-def track(endpoint: str, query: str, tenant=None) -> _Track:
+def track(endpoint: str, query: str, tenant=None,
+          parent_qid: str = "") -> _Track:
     """Register one query execution for its dynamic extent; the ONLY
     way to mint a QueryActivity (context-manager-only, enforced by the
-    vlint accounting-discipline checker)."""
-    return _Track(endpoint, query, tenant)
+    vlint accounting-discipline checker).  ``parent_qid`` tags a
+    cluster sub-query with its frontend query's global_qid."""
+    return _Track(endpoint, query, tenant, parent_qid=parent_qid)
 
 
 class _ReuseOrTrack:
@@ -501,12 +536,16 @@ def use_activity(act) -> _UseActivity:
 
 # ---------------- registry reads / control ----------------
 
-def active_snapshot() -> list[dict]:
+def active_snapshot(tenant: str | None = None) -> list[dict]:
     """Live records, registration order (the /select/logsql/
-    active_queries payload)."""
+    active_queries payload).  ``tenant`` ("a:p") scopes the view to one
+    tenant's queries."""
     with _reg_mu:
         acts = list(_active.values())
-    return [a.snapshot() for a in acts]
+    snaps = [a.snapshot() for a in acts]
+    if tenant is not None:
+        snaps = [s for s in snaps if s.get("tenant") == tenant]
+    return snaps
 
 
 def cancel(qid: str) -> bool:
@@ -520,30 +559,54 @@ def cancel(qid: str) -> bool:
     return True
 
 
+def cancel_by_parent(parent_qid: str) -> int:
+    """Trip the cancel flag of every live record registered under
+    ``parent_qid`` — the cluster cancel-propagation path (POST
+    /internal/select/cancel): the flag folds into the processor head's
+    is_done() exactly like a local cancel, so each sub-query's device
+    window drains immediately instead of waiting for the frontend
+    disconnect probe.  Returns how many records were cancelled."""
+    if not parent_qid:
+        return 0
+    with _reg_mu:
+        acts = [a for a in _active.values()
+                if a.parent_qid == parent_qid]
+    for a in acts:
+        a.cancel()
+    return len(acts)
+
+
 # the top_queries sort dimensions (a request with anything else is a
 # client error — server/app.py maps the ValueError to HTTP 400)
 TOP_QUERIES_BY = ("duration", "bytes", "bytes_scanned", "cost_error")
 
 
-def top_queries(n: int = 10, by: str = "duration") -> list[dict]:
-    """Heavy hitters from the completed-query ring buffer, most
-    expensive first.  by='duration' | 'bytes' — or 'cost_error' for
-    the queries the plan-time cost model priced WORST (unpriced
-    records sort last); anything else raises ValueError."""
+def top_sort_key(by: str) -> tuple[str, float]:
+    """(record key, missing-value default) for one top_queries sort
+    dimension — shared by the local ring sort below and the federated
+    cluster merge (server/cluster.py), so the two can never order
+    differently.  Raises ValueError on an unknown ``by``."""
     if by not in TOP_QUERIES_BY:
         raise ValueError(
             f"invalid by={by!r}; allowed: {', '.join(TOP_QUERIES_BY)}")
     if by == "cost_error":
-        key = "cost_error"
-        default = -1.0
-    elif by in ("bytes", "bytes_scanned"):
-        key = "bytes_scanned"
-        default = 0
-    else:
-        key = "duration_s"
-        default = 0
+        return "cost_error", -1.0
+    if by in ("bytes", "bytes_scanned"):
+        return "bytes_scanned", 0
+    return "duration_s", 0
+
+
+def top_queries(n: int = 10, by: str = "duration",
+                tenant: str | None = None) -> list[dict]:
+    """Heavy hitters from the completed-query ring buffer, most
+    expensive first.  by='duration' | 'bytes' — or 'cost_error' for
+    the queries the plan-time cost model priced WORST (unpriced
+    records sort last); anything else raises ValueError.  ``tenant``
+    scopes the ring to one tenant's completions."""
+    key, default = top_sort_key(by)
     with _reg_mu:
-        recs = list(_completed)
+        recs = [r for r in _completed
+                if tenant is None or r.get("tenant") == tenant]
     recs.sort(key=lambda r: r.get(key, default), reverse=True)
     return recs[:max(n, 0)]
 
@@ -568,6 +631,19 @@ def note_ingest(tenant, rows: int, nbytes: int = 0) -> None:
 def note_parse_failure(protocol: str) -> None:
     with _reg_mu:
         _parse_failures[protocol] = _parse_failures.get(protocol, 0) + 1
+
+
+def usage_snapshot() -> dict:
+    """This node's resource-usage snapshot for GET /internal/usage —
+    the payload the cluster-stats poll loop (obs/clusterstats.py) pulls
+    from every storage node: the forever-accumulating per-tenant
+    totals plus the live registry depth.  Counters are monotonic, so
+    the frontend rollup can sum last-seen values without re-reading
+    history."""
+    with _reg_mu:
+        tenants = {t: dict(slot) for t, slot in _tenant_totals.items()}
+        active = len(_active)
+    return {"tenants": tenants, "active_queries": active}
 
 
 # ---------------- /metrics integration ----------------

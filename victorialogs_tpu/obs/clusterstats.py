@@ -1,0 +1,227 @@
+"""Cluster-wide per-tenant usage rollups: the frontend-owned poll loop.
+
+Each storage node accounts its own per-tenant usage exactly
+(obs/activity.py: ``vl_tenant_*`` on /metrics), but that signal is
+node-local — a tenant hogging N storage nodes at once looks N times
+smaller than it is from any single vantage point, which is exactly the
+gap the ROADMAP's cluster-QoS item names.  Monarch's shape applies:
+identity is pushed DOWN with the work (parent_qid, server/cluster.py)
+and aggregates are pulled UP on a cadence — this module is the pull
+side.
+
+A :class:`ClusterStatsPoller` (one per cluster frontend, owned by
+VLServer) polls every storage node's ``GET /internal/usage`` snapshot
+(per-tenant totals, live/queued query depth, storage gauges) every
+``VL_CLUSTER_STATS_MS`` and serves:
+
+- ``vl_cluster_tenant_{select_seconds,bytes_scanned,rows_ingested}_total``
+  on the frontend's /metrics — the sum of each tenant's last-seen
+  per-node totals, the cluster-wide signal the admission scheduler
+  will consume;
+- ``vl_cluster_node_up{node=}`` + ``vl_cluster_stats_age_seconds{node=}``
+  — per-node rollup liveness/staleness;
+- ``GET /select/logsql/tenants`` — the same aggregation as JSON, with
+  per-node metadata.
+
+Design constraints (test-pinned in tests/test_cluster_obs.py):
+
+- **reads are cache-only** — the HTTP endpoints and /metrics serve the
+  poller's last-seen state and never fan out inline, so a hung node
+  can never hang a scrape; staleness is bounded by one poll interval
+  plus the per-request timeout and is exported per node as age;
+- **counters never regress** — a node that stops answering keeps its
+  last-seen totals in the aggregate (they are monotonic counters; the
+  node being down does not un-spend its tenants' usage), it is just
+  marked ``up: 0`` with its age growing;
+- **polls ride the policy layer** — requests go through
+  netrobust.request gated on the select-path breaker, so a dead node
+  costs one timeout until its circuit opens, then near-zero until the
+  half-open probe (which doubles as the recovery detector);
+- **one daemon thread per frontend** (``vl-clusterstats``), owned and
+  close()d by VLServer — the vlsan end-of-test sweep sees no orphan.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .. import config
+
+USAGE_PATH = "/internal/usage"
+
+# the /metrics rollup dimensions: (usage_snapshot key, metric name)
+ROLLUP_SERIES = (
+    ("select_seconds", "vl_cluster_tenant_select_seconds_total"),
+    ("bytes_scanned", "vl_cluster_tenant_bytes_scanned_total"),
+    ("rows_ingested", "vl_cluster_tenant_rows_ingested_total"),
+)
+
+
+class ClusterStatsPoller:
+    """The poll loop + last-seen cache.  Construct via
+    :func:`maybe_start` (honors VL_CLUSTER_STATS_MS=0 = disabled)."""
+
+    def __init__(self, node_urls: list, interval_ms: int | None = None):
+        self.urls = [u.rstrip("/") for u in node_urls]
+        if interval_ms is None:
+            interval_ms = config.env_int("VL_CLUSTER_STATS_MS")
+        self.interval_s = max(0.05, interval_ms / 1e3)
+        # a hung node must not starve the loop: each request is bounded
+        # well under the transport timeout (and the breaker makes the
+        # repeat case near-free)
+        self.timeout_s = max(0.2, min(5.0, self.interval_s * 2))
+        self._mu = threading.Lock()
+        self._nodes: dict[str, dict] = {
+            u: {"up": False, "mono": None, "tenants": {},
+                "error": "not polled yet"}
+            for u in self.urls}
+        self.polls = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="vl-clusterstats",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- the loop --
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_now()
+            # vlint: allow-broad-except(the poll loop must survive any node pathology; per-node errors are recorded in the cache)
+            except Exception:
+                pass
+            self._stop.wait(self.interval_s)
+
+    def poll_now(self) -> None:
+        """One synchronous poll round (the loop body; tests and the
+        bench call it directly for determinism).  Nodes are polled in
+        PARALLEL: one hung node (breaker not open yet) costs the round
+        its own timeout, never timeout x bad-node-count — healthy
+        nodes' freshness must not degrade because a sibling died."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(url: str):
+            if self._stop.is_set():
+                return url, None, "poller stopped"
+            # lazy import: obs sits below server in the layer order;
+            # the poller only exists on servers, where it's loaded
+            from ..server import netrobust
+            try:
+                status, _h, body = netrobust.request(
+                    url, USAGE_PATH, method="GET",
+                    timeout=self.timeout_s, gate="select")
+            except (IOError, OSError) as e:
+                return url, None, str(e)
+            if status != 200:
+                return url, None, f"HTTP {status}"
+            try:
+                return url, json.loads(body), None
+            except ValueError as e:
+                return url, None, f"bad JSON: {e}"
+
+        with ThreadPoolExecutor(max_workers=len(self.urls)) as ex:
+            rows = list(ex.map(one, self.urls))
+        now = time.monotonic()
+        with self._mu:
+            for url, snap, err in rows:
+                st = self._nodes[url]
+                if snap is not None:
+                    st.update(up=True, error=None, mono=now,
+                              tenants=snap.get("tenants") or {},
+                              active_queries=snap.get(
+                                  "active_queries", 0),
+                              queued=snap.get("queued", 0),
+                              storage=snap.get("storage") or {})
+                else:
+                    # keep the last-seen tenant totals: monotonic
+                    # counters must not regress because the node died
+                    st.update(up=False, error=err)
+            self.polls += 1
+
+    # -- cache reads --
+
+    def aggregated_tenants(self) -> dict[str, dict]:
+        """tenant -> summed last-seen totals across all nodes."""
+        agg: dict[str, dict] = {}
+        with self._mu:
+            node_tenants = [dict(st["tenants"])
+                            for st in self._nodes.values()]
+        for tenants in node_tenants:
+            for t, slot in tenants.items():
+                cur = agg.setdefault(t, {})
+                for k, v in slot.items():
+                    if isinstance(v, (int, float)):
+                        cur[k] = cur.get(k, 0) + v
+        return agg
+
+    def nodes_snapshot(self) -> list[dict]:
+        """Per-node poll metadata (liveness, staleness, live depth)."""
+        now = time.monotonic()
+        out = []
+        with self._mu:
+            for url in self.urls:
+                st = self._nodes[url]
+                d = {"node": url, "up": bool(st["up"])}
+                if st["mono"] is not None:
+                    d["age_s"] = round(now - st["mono"], 3)
+                if st.get("error"):
+                    d["error"] = st["error"]
+                if "active_queries" in st:
+                    d["active_queries"] = st["active_queries"]
+                    d["queued"] = st.get("queued", 0)
+                out.append(d)
+        return out
+
+    def tenants_payload(self, tenant: str | None = None) -> dict:
+        """The GET /select/logsql/tenants response body."""
+        agg = self.aggregated_tenants()
+        if tenant is not None:
+            agg = {t: s for t, s in agg.items() if t == tenant}
+        return {
+            "status": "ok", "cluster": True,
+            "tenants": {t: agg[t] for t in sorted(agg)},
+            "nodes": self.nodes_snapshot(),
+            "poll_interval_ms": int(self.interval_s * 1e3),
+        }
+
+    # -- /metrics integration --
+
+    def metrics_samples(self) -> list[tuple[str, dict, float]]:
+        """(base, labels, value) samples for Metrics.render: the
+        cluster-wide per-tenant rollups + per-node liveness."""
+        out: list[tuple[str, dict, float]] = []
+        agg = self.aggregated_tenants()
+        for t in sorted(agg):
+            slot = agg[t]
+            for key, name in ROLLUP_SERIES:
+                # vlint: allow-per-row-emit(metric samples, bounded by tenant cap x 3 series)
+                out.append((name, {"tenant": t}, slot.get(key, 0)))
+        now = time.monotonic()
+        with self._mu:
+            metas = [(url, dict(st)) for url, st in self._nodes.items()]
+        for url, st in metas:
+            # vlint: allow-per-row-emit(metric samples, bounded by node count)
+            out.append(("vl_cluster_node_up", {"node": url},
+                        1 if st["up"] else 0))
+            if st["mono"] is not None:
+                # vlint: allow-per-row-emit(metric samples, bounded by node count)
+                out.append(("vl_cluster_stats_age_seconds",
+                            {"node": url},
+                            round(now - st["mono"], 3)))
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def maybe_start(node_urls) -> ClusterStatsPoller | None:
+    """The server-side constructor: a poller when VL_CLUSTER_STATS_MS
+    is positive (default), None when 0/negative (rollups off)."""
+    interval_ms = config.env_int("VL_CLUSTER_STATS_MS")
+    if not node_urls or interval_ms <= 0:
+        return None
+    return ClusterStatsPoller(node_urls, interval_ms=interval_ms)

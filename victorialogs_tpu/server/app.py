@@ -57,6 +57,30 @@ def metric_name(base: str, **labels) -> str:
 # full sample name -> (base, "{labels}" or "")
 _SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?$")
 
+# the canonical tenant spelling for ?tenant= filters (activity
+# records label tenants "accountID:projectID"); the literal "other"
+# is the registry's hard-cap overflow bucket — the label an operator
+# most needs to drill into when tenant cardinality overflows
+_TENANT_ARG_RE = re.compile(r"^(\d+:\d+|other)$")
+
+
+def _tenant_arg(args):
+    """Validated optional ?tenant= filter for the registry views:
+    None when absent, the canonical "a:p" string (or the "other"
+    overflow bucket) when well-formed, HTTP 400 otherwise (a malformed
+    filter silently matching nothing would read as 'no queries')."""
+    t = args.get("tenant", "")
+    if not t:
+        return None
+    if not _TENANT_ARG_RE.match(t):
+        raise HTTPError(400, f"invalid tenant arg {t!r} "
+                             f"(want 'accountID:projectID')")
+    return t
+
+
+def _want_cluster(args) -> bool:
+    return args.get("cluster", "") in ("1", "true", "yes")
+
 # endpoints whose wall time IS a query execution (vl_query_duration_
 # seconds); excludes /tail (connection lifetime) and introspection
 _QUERY_DURATION_PATHS = frozenset((
@@ -151,6 +175,13 @@ class Metrics:
                 hasattr(getattr(server, "sink", None),
                         "spool_metrics_samples"):
             for base, labels, v in server.sink.spool_metrics_samples():
+                add(metric_name(base, **labels), v)
+        if server is not None and \
+                getattr(server, "clusterstats", None) is not None:
+            # cluster frontends: per-tenant usage rolled up across
+            # storage nodes + per-node rollup liveness/staleness
+            # (obs/clusterstats.py poll loop)
+            for base, labels, v in server.clusterstats.metrics_samples():
                 add(metric_name(base, **labels), v)
         if server is not None:
             from .. import __version__
@@ -572,9 +603,15 @@ class VLServer(BaseHTTPApp):
                 spool_dir=os.path.join(storage.path,
                                        "cluster-insert-spool"))
             self.query_storage = NetSelectStorage(storage_nodes)
+            # cluster-wide tenant usage rollups: the frontend-owned
+            # poll loop over every node's /internal/usage
+            # (obs/clusterstats.py; VL_CLUSTER_STATS_MS=0 disables)
+            from ..obs import clusterstats
+            self.clusterstats = clusterstats.maybe_start(storage_nodes)
         else:
             self.sink = LocalLogRowsStorage(storage)
             self.query_storage = storage
+            self.clusterstats = None
         # self-telemetry journal (obs/journal.py): the event bus's
         # subscriber, writing operational events through the NORMAL
         # ingest path (self.sink — local storage, or the cluster
@@ -587,9 +624,11 @@ class VLServer(BaseHTTPApp):
             self._start_http(listen_addr, port)
         except BaseException:
             # a failed bind must not leak the journal's bus
-            # subscription + flush thread
+            # subscription + flush thread (nor the usage poll loop)
             if self.journal is not None:
                 self.journal.close()
+            if self.clusterstats is not None:
+                self.clusterstats.close()
             raise
 
     def route(self, h, path, args, body, ctype) -> None:
@@ -642,10 +681,20 @@ class VLServer(BaseHTTPApp):
             # queued-but-not-admitted queries show up here too (phase
             # "queued") — that is what makes them cancellable by qid —
             # alongside the live scheduler state (budget, in-flight
-            # leases, admission pools)
-            self.respond_json(h, {"status": "ok",
-                                  "data": activity.active_snapshot(),
-                                  "scheduler": sched.snapshot()})
+            # leases, admission pools).  ?tenant= scopes the view;
+            # ?cluster=1 on a frontend federates it: every node's
+            # sub-query records nested under their parent query here
+            tenant = _tenant_arg(args)
+            urls = self._cluster_urls()
+            if _want_cluster(args) and urls:
+                from . import cluster
+                self.respond_json(h, cluster.federated_active_queries(
+                    urls, tenant=tenant))
+                return
+            self.respond_json(h, {
+                "status": "ok",
+                "data": activity.active_snapshot(tenant=tenant),
+                "scheduler": sched.snapshot()})
             return
         if path == "/select/logsql/sched_config":
             # mutating (per-tenant QoS knobs): POST only, same
@@ -680,7 +729,36 @@ class VLServer(BaseHTTPApp):
             if not activity.cancel(qid):
                 raise HTTPError(404, f"no active query with qid {qid!r}")
             m.inc("vl_queries_cancelled_total")
-            self.respond_json(h, {"status": "ok", "qid": qid})
+            resp = {"status": "ok", "qid": qid}
+            urls = self._cluster_urls()
+            if urls:
+                # cascading cancel: every node trips the sub-queries
+                # registered under this query's global_qid, draining
+                # their device windows NOW instead of at the next
+                # disconnect-probe/frame-write detection (best-effort:
+                # a dead node isn't running the sub-query anyway)
+                from . import cluster
+                resp["propagated"] = cluster.propagate_cancel(
+                    urls, qid, activity.global_qid(qid))
+            self.respond_json(h, resp)
+            return
+        if path == "/select/logsql/tenants":
+            # cluster-wide per-tenant usage (the clusterstats rollup
+            # cache — never an inline fan-out, so a hung node can't
+            # hang this view); single-node servers serve their local
+            # registry totals under the same shape
+            tenant = _tenant_arg(args)
+            cs = self.clusterstats
+            if cs is not None:
+                self.respond_json(h, cs.tenants_payload(tenant=tenant))
+                return
+            tenants = activity.usage_snapshot()["tenants"]
+            if tenant is not None:
+                tenants = {t: s for t, s in tenants.items()
+                           if t == tenant}
+            self.respond_json(h, {
+                "status": "ok", "cluster": False,
+                "tenants": {t: tenants[t] for t in sorted(tenants)}})
             return
         if path == "/select/logsql/top_queries":
             try:
@@ -691,9 +769,20 @@ class VLServer(BaseHTTPApp):
             # (400 with the allowed set), never a silent fallthrough,
             # and n is bounded by the completed-ring capacity region
             n = max(1, min(n, 1000))
+            tenant = _tenant_arg(args)
+            by = args.get("by", "duration")
+            urls = self._cluster_urls()
+            if _want_cluster(args) and urls:
+                from . import cluster
+                try:
+                    out = cluster.federated_top_queries(
+                        urls, n, by=by, tenant=tenant)
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                self.respond_json(h, out)
+                return
             try:
-                top = activity.top_queries(n, by=args.get("by",
-                                                          "duration"))
+                top = activity.top_queries(n, by=by, tenant=tenant)
             except ValueError as e:
                 raise HTTPError(400, str(e))
             self.respond_json(h, {"status": "ok", "top_queries": top})
@@ -733,6 +822,49 @@ class VLServer(BaseHTTPApp):
             return
 
         # ---- cluster-internal endpoints ----
+        if path == "/internal/usage":
+            # the cluster-stats poll target (obs/clusterstats.py):
+            # per-tenant totals + live/queued depth + storage gauges.
+            # Outside the admission gate — the rollup must keep seeing
+            # a node that is shedding queries.
+            usage = activity.usage_snapshot()
+            adm_sel = self.admission.snapshot()
+            adm_int = self.internal_admission.snapshot()
+            s = self.storage.update_stats()
+            usage.update({
+                "status": "ok",
+                "queued": adm_sel["queued"] + adm_int["queued"],
+                "admission": {"select": adm_sel, "internal": adm_int},
+                "storage": {
+                    "rows_small": s["small_rows"],
+                    "rows_big": s["big_rows"],
+                    "rows_inmemory": s["inmemory_rows"],
+                    "pending_merges": s["pending_merges"],
+                    "flush_age_seconds":
+                        round(s["flush_age_seconds"], 3),
+                    "is_read_only": bool(s["is_read_only"]),
+                },
+            })
+            self.respond_json(h, usage)
+            return
+        if path == "/internal/select/cancel":
+            # the cancel-propagation target: trip every sub-query
+            # registered under the frontend query's global_qid (and/or
+            # one node-local qid).  POST-only like cancel_query.
+            if h.command != "POST":
+                raise HTTPError(405, "cancel requires POST")
+            parent_qid = args.get("parent_qid", "")
+            qid = args.get("qid", "")
+            if not parent_qid and not qid:
+                raise HTTPError(400, "missing parent_qid or qid arg")
+            n = activity.cancel_by_parent(parent_qid) \
+                if parent_qid else 0
+            if qid and activity.cancel(qid):
+                n += 1
+            if n:
+                m.inc("vl_queries_cancel_propagated_total", n)
+            self.respond_json(h, {"status": "ok", "cancelled": n})
+            return
         if path == "/internal/insert":
             from . import cluster
             try:
@@ -823,6 +955,10 @@ class VLServer(BaseHTTPApp):
                      f"unknown path {path}".encode())
 
     def close(self) -> None:
+        # stop the usage poll loop (reads only; before the sink so a
+        # mid-poll node error can't race the teardown)
+        if self.clusterstats is not None:
+            self.clusterstats.close()
         # drain the journal FIRST (its flush writes through self.sink)
         if self.journal is not None:
             self.journal.close()
@@ -832,6 +968,12 @@ class VLServer(BaseHTTPApp):
         if sink_close is not None:
             sink_close()
         super().close()
+
+    def _cluster_urls(self) -> list | None:
+        """Storage-node URLs when this server is a cluster frontend
+        (the federated registry/cancel/rollup fan-out set), else
+        None."""
+        return getattr(self.query_storage, "urls", None)
 
     @staticmethod
     def _partial_headers() -> dict:

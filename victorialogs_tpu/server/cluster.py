@@ -493,6 +493,11 @@ def handle_internal_select(storage, args, runner=None):
     # which the frontend attaches under its per-node span
     root = tracing.make_root("storage_node_query", query=qs) \
         if args.get("trace") == "1" else None
+    # propagated query identity: the frontend ships its query's
+    # global_qid as parent_qid, so this node's registry record, trace
+    # tree and query_done journal event all correlate back to the ONE
+    # frontend query that fanned out here
+    parent_qid = args.get("parent_qid", "")
 
     def gen():
         # internal sub-queries register in the active-query registry
@@ -500,7 +505,11 @@ def handle_internal_select(storage, args, runner=None):
         # it is serving, and cancel_query on the node kills a runaway
         # sub-query with the same drain semantics
         with activity.track("/internal/select/query", qs,
-                            tenants) as act:
+                            tenants, parent_qid=parent_qid) as act:
+            if root is not None:
+                root.set("qid", act.qid)
+                if parent_qid:
+                    root.set("parent_qid", parent_qid)
 
             def run(sink):
                 # the query executes on streamwork's worker thread:
@@ -809,6 +818,198 @@ def _node_http_error(url: str,
         current=info.get("current"))
 
 
+# ---------------- federated introspection (cluster observability) ----------------
+#
+# The cluster-wide views of the PR 6 registry endpoints: a frontend
+# fans one introspection request out to every storage node through the
+# netrobust policy layer (select-path breaker gating, injected faults)
+# and merges the answers.  A down/hung node is DATA here — marked
+# `up: false` in the per-node metadata — never a query failure: the
+# federated view must work best exactly when part of the cluster does
+# not.
+
+# per-node bound on one introspection fan-out / cancel propagation;
+# a hung node costs at most this, and its breaker opens for next time
+FED_TIMEOUT_S = 5.0
+
+
+def _fanout_json(urls, path: str, *, method: str = "GET",
+                 timeout: float | None = None, retry: bool = True):
+    """One introspection request to every node in parallel.  Returns
+    (results, failures): url -> parsed JSON body / url -> error string.
+    Never raises — node loss degrades the view, marked per node."""
+    from concurrent.futures import ThreadPoolExecutor
+    if not urls:
+        return {}, {}
+    if timeout is None:
+        # late-bound so tests/operators can shrink the bound
+        timeout = FED_TIMEOUT_S
+
+    # one retry on a transport blip (idempotent introspection; the
+    # breaker makes the repeat near-free when the node is truly down);
+    # callers with side effects that COUNT (cancel propagation) pass
+    # retry=False so a blip after the node acted can't double-count
+    attempts = 1 + min(1, netrobust.net_retries()) if retry else 1
+
+    def one(url: str):
+        err = ""
+        for _ in range(attempts):
+            try:
+                status, _h, body = netrobust.request(
+                    url, path, method=method, timeout=timeout,
+                    gate="select")
+            except (IOError, OSError) as e:
+                err = str(e)
+                continue
+            if status != 200:
+                return url, None, f"HTTP {status}"
+            try:
+                return url, json.loads(body), None
+            except ValueError as e:
+                return url, None, f"bad JSON: {e}"
+        return url, None, err
+
+    with ThreadPoolExecutor(max_workers=len(urls)) as ex:
+        rows = list(ex.map(one, list(urls)))
+    results = {u: obj for u, obj, err in rows if err is None}
+    failures = {u: err for u, _obj, err in rows if err is not None}
+    return results, failures
+
+
+def federated_active_queries(urls, tenant: str | None = None,
+                             timeout: float | None = None) -> dict:
+    """GET /select/logsql/active_queries?cluster=1: this frontend's
+    live records with each node's sub-query records nested under their
+    parent query (matched by the propagated parent_qid == the parent's
+    global_qid).  Node records with no parent here (another frontend's
+    fan-out, direct node queries) land in ``unlinked`` with node
+    attribution; a node that cannot answer is marked down."""
+    path = "/select/logsql/active_queries"
+    if tenant:
+        from urllib.parse import urlencode
+        path += "?" + urlencode({"tenant": tenant})
+    # local view: frontend-level records only — this process's OWN
+    # internal sub-query records (combined frontend+storage deployments,
+    # in-process clusters) are re-fetched via the node fan-out below
+    # and must not show up twice
+    local = [r for r in activity.active_snapshot(tenant=tenant)
+             if r["endpoint"] != "/internal/select/query"]
+    by_gqid: dict[str, dict] = {}
+    for rec in local:
+        rec["global_qid"] = activity.global_qid(rec["qid"])
+        rec["storage_node_queries"] = []
+        by_gqid[rec["global_qid"]] = rec
+    results, failures = _fanout_json(urls, path, timeout=timeout)
+    nodes, unlinked = [], []
+    for url in urls:
+        if url in failures:
+            # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+            nodes.append({"node": url, "up": False,
+                          "error": failures[url]})
+            continue
+        data = results[url].get("data") or []
+        sub = [r for r in data
+               if r["endpoint"] == "/internal/select/query"]
+        # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+        nodes.append({"node": url, "up": True, "active": len(data)})
+        for nrec in sub:
+            nrec["node"] = url
+            parent = by_gqid.get(nrec.get("parent_qid") or "")
+            if parent is not None:
+                parent["storage_node_queries"].append(nrec)
+            else:
+                unlinked.append(nrec)
+    out = {"status": "ok", "cluster": True, "data": local,
+           "nodes": nodes, "scheduler": sched.snapshot()}
+    if unlinked:
+        out["unlinked"] = unlinked
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
+def _rec_fingerprint(rec: dict) -> str:
+    """Content identity of one completed-query record, attribution
+    excluded (the cross-process dedup key for the federated merge)."""
+    return json.dumps({k: v for k, v in rec.items() if k != "node"},
+                      sort_keys=True, default=str)
+
+
+def federated_top_queries(urls, n: int = 10, by: str = "duration",
+                          tenant: str | None = None,
+                          timeout: float | None = None) -> dict:
+    """GET /select/logsql/top_queries?cluster=1: this frontend's
+    completed ring merged with every node's, re-sorted on the same
+    dimension, each record attributed to where it ran (``node``:
+    "frontend" or the node URL).  Raises ValueError on an unknown
+    ``by`` (HTTP 400 upstream, same as the local form)."""
+    from urllib.parse import urlencode
+    key, default = activity.top_sort_key(by)
+    merged = [dict(r, node="frontend")
+              for r in activity.top_queries(n, by=by, tenant=tenant)]
+    # dedup guard for combined frontend+storage deployments (and
+    # in-process clusters), where the node fan-out re-fetches records
+    # this process's own ring already contributed: a record's full
+    # content minus the attribution IS its identity
+    seen = {_rec_fingerprint(r) for r in merged}
+    args = {"n": str(n), "by": by}
+    if tenant:
+        args["tenant"] = tenant
+    path = "/select/logsql/top_queries?" + urlencode(args)
+    results, failures = _fanout_json(urls, path, timeout=timeout)
+    nodes = []
+    for url in urls:
+        if url in failures:
+            # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+            nodes.append({"node": url, "up": False,
+                          "error": failures[url]})
+            continue
+        # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+        nodes.append({"node": url, "up": True})
+        for r in results[url].get("top_queries") or []:
+            fp = _rec_fingerprint(r)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            merged.append(dict(r, node=url))
+    merged.sort(key=lambda r: r.get(key, default), reverse=True)
+    out = {"status": "ok", "cluster": True,
+           "top_queries": merged[:max(n, 0)], "nodes": nodes}
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
+def propagate_cancel(urls, qid: str, gqid: str,
+                     timeout: float | None = None) -> dict:
+    """Cascade one frontend cancel to every storage node (POST
+    /internal/select/cancel?parent_qid=): each node trips the cancel
+    flag of every record registered under the query's global_qid, so
+    the sub-queries' device windows drain immediately — replacing the
+    frontend-disconnect probe (which a node only notices at its next
+    frame write) as the primary kill mechanism.  Best-effort by
+    design: a dead node cannot be running the sub-query anyway, so its
+    failure is recorded (journal ``query_cancel_propagated``), never
+    raised."""
+    from urllib.parse import urlencode
+    path = ("/internal/select/cancel?"
+            + urlencode({"parent_qid": gqid}))
+    results, failures = _fanout_json(urls, path, method="POST",
+                                     timeout=timeout, retry=False)
+    cancelled = sum(int(r.get("cancelled") or 0)
+                    for r in results.values())
+    fail_fields = {"failed_nodes": ",".join(sorted(failures))} \
+        if failures else {}
+    events.emit("query_cancel_propagated", qid=qid, parent_qid=gqid,
+                cancelled=cancelled, nodes_ok=len(results),
+                nodes_failed=len(failures), **fail_fields)
+    out = {"cancelled": cancelled, "nodes_ok": len(results),
+           "nodes_failed": len(failures)}
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
 class NetSelectStorage:
     """Query layer over N storage nodes: remote/local pipe split, parallel
     fan-out, first-error cancellation (netselect.go:324-369)."""
@@ -858,6 +1059,7 @@ class NetSelectStorage:
         remaining_s = None
         if deadline is not None:
             remaining_s = max(deadline - time.monotonic(), 0.001)
+        act = activity.current_activity()
 
         def fetch(url: str) -> dict:
             form = {
@@ -870,6 +1072,10 @@ class NetSelectStorage:
                 "tenant": tenant_arg,
                 "explain": mode,
             }
+            if act.enabled:
+                # identity propagation parity with net_run_query: the
+                # node's explain/analyze record correlates by qid too
+                form["parent_qid"] = activity.global_qid(act.qid)
             if remaining_s is not None:
                 form["timeout"] = f"{remaining_s:.3f}s"
             if include_trace:
@@ -1010,6 +1216,12 @@ class NetSelectStorage:
                 "limit": str(push_limit),
                 "tenant": tenant_arg,
             }
+            if act.enabled:
+                # query identity propagation: every storage node tags
+                # its sub-query record/trace/journal with the frontend
+                # query's cluster-unique id — the primitive the
+                # federated registry and cascading cancel ride
+                form["parent_qid"] = activity.global_qid(act.qid)
             if remaining_s is not None:
                 form["timeout"] = f"{remaining_s:.3f}s"
             if parent_span.enabled:

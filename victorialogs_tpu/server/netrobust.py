@@ -397,18 +397,23 @@ def metrics_samples() -> list:
 def request(url: str, path: str, body: bytes = b"", *,
             timeout: float = 30.0, deadline: float | None = None,
             headers: dict | None = None, method: str = "POST",
-            gate: bool = True) -> tuple[int, object, bytes]:
+            gate: bool | str = True) -> tuple[int, object, bytes]:
     """One policy-managed HTTP exchange with a node: returns (status,
     headers, body) for ANY complete HTTP response; raises NodeDownError
     on circuit-open / refused / transport failure.  Breaker accounting
     happens here (5xx = failure, 429 = throttle via Retry-After,
     anything else = liveness success); callers classify the status.
-    ``gate=False`` skips the circuit check (vlagent owns its own retry
-    cadence) but still feeds the health state."""
+    ``gate=True`` gates on the INSERT path (availability AND the 429
+    Retry-After throttle); ``gate="select"`` gates on availability only
+    (federated introspection / usage polls must not be parked by an
+    ingest throttle); ``gate=False`` skips the circuit check (vlagent
+    owns its own retry cadence) but still feeds the health state."""
     url = url.rstrip("/")
     br = breaker_for(url)
-    if gate and not br.allow_insert():
-        raise NodeDownError(f"{url}: node circuit open")
+    if gate:
+        allowed = br.allow() if gate == "select" else br.allow_insert()
+        if not allowed:
+            raise NodeDownError(f"{url}: node circuit open")
     try:
         mode = netfaults.maybe_fail_net(url)
         if mode == "refuse":
