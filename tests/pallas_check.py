@@ -118,3 +118,23 @@ for t in (1, 2, 3, 8):
     assert got[plb.plane.shape[0]:].all()    # pad blocks: nwords=0 keeps
     checked += 1
 print(f"BLOOM_PROBE_PARITY_OK tokensets={checked} blocks={len(blooms)}")
+
+# ---- segment-major stats count parity (tpu/stats_seg.py) ----
+
+import jax.numpy as _jnp  # noqa: E402
+
+from victorialogs_tpu.tpu import stats_seg as SS  # noqa: E402
+
+rng = _np.random.default_rng(31)
+R = SS.STATS_CHUNK * 3
+for nseg, nb in ((2, 7), (5, 64), (8, 251)):
+    seg = rng.integers(0, nseg, R).astype(_np.int32)
+    bkt = rng.integers(0, nb, R).astype(_np.int32)
+    m = rng.random(R) < 0.37
+    want = _np.asarray(SS.stats_count_seg_reference(
+        _jnp.asarray(seg), _jnp.asarray(bkt), _jnp.asarray(m), nseg, nb))
+    got = _np.asarray(SS.stats_count_seg_pallas(
+        _jnp.asarray(seg), _jnp.asarray(bkt), _jnp.asarray(m), nseg, nb,
+        interpret=True))
+    assert _np.array_equal(got, want), (nseg, nb)
+print(f"STATS_SEG_PARITY_OK rows={R} shapes=3")

@@ -26,6 +26,9 @@ def test_pallas_parity_subprocess():
     out = res.stdout.decode() + res.stderr.decode()
     assert res.returncode == 0, out
     assert "PALLAS_PARITY_OK" in out, out
+    assert "BLOOM_PROBE_PARITY_OK" in out, out
+    # segment-major stats count kernel (tpu/stats_seg.py)
+    assert "STATS_SEG_PARITY_OK" in out, out
 
 
 def test_pad_for_pallas():

@@ -566,6 +566,30 @@ def test_hotpath_covers_pipeline_module():
     assert sf.text.count("np.asarray") == 1   # a single sync site
 
 
+def test_hotpath_covers_stats_seg_module():
+    """The segment-major stats kernel module (tpu/stats_seg.py, PR 15)
+    rides the tpu/ hot-path scope: the checker must SEE the file (an
+    unannotated host sync there is flagged) and the real module must
+    run clean — its kernels are traced inside the fused dispatch, so a
+    hidden sync or jit-closure would stall every packed stats query."""
+    from tools.vlint import hotpath
+    from tools.vlint.core import SourceFile
+
+    out = lint("""
+        import jax.numpy as jnp
+        def reduce_seg(x):
+            return float(jnp.sum(x))
+    """, path="victorialogs_tpu/tpu/stats_seg.py")
+    assert "jax-host-sync" in checkers(out)
+
+    path = os.path.join(REPO, "victorialogs_tpu", "tpu", "stats_seg.py")
+    sf = SourceFile.parse(
+        path, display_path="victorialogs_tpu/tpu/stats_seg.py")
+    found = [f for f in hotpath.check(sf)
+             if not sf.allowed(f.checker, f.line)]
+    assert found == [], [f.render() for f in found]
+
+
 def test_repo_is_clean_against_baseline():
     findings = run_paths([os.path.join(REPO, "victorialogs_tpu")],
                          root=REPO)

@@ -118,6 +118,102 @@ def run_config(storage, ten, t0, inflight, pack, runs):
     return out
 
 
+def build_storage_multiday(path, days, parts_per_day, rows_per_part):
+    """3-day partitioned dataset of flush-sized parts — the ROADMAP's
+    named proof shape for the cross-partition window."""
+    from victorialogs_tpu.storage import datadb
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    datadb.DEFAULT_PARTS_TO_MERGE = 10 ** 9
+    t0 = 1_753_660_800_000_000_000
+    ns_day = 86_400 * 1_000_000_000
+    ten = TenantID(0, 0)
+    s = Storage(path, retention_days=100000, flush_interval=3600)
+    n = 0
+    for day in range(days):
+        for _pp in range(parts_per_day):
+            lr = LogRows(stream_fields=["app"])
+            for _i in range(rows_per_part):
+                g = n
+                n += 1
+                lvl = ["info", "warn", "err"][g % 3]
+                lr.add(ten, t0 + day * ns_day + (g % 1200) * 1_000_000, [
+                    ("app", f"app{g % 5}"),
+                    ("_msg", f"m {lvl} request x{g % 97} of {g}"),
+                    ("dur", str(g % 211)),
+                ])
+            s.must_add_rows(lr)
+            s.debug_flush()
+    assert len(s.partitions) == days
+    return s, ten, t0
+
+
+MULTIDAY_QUERIES = [
+    ("topk", "err | sort by (dur desc) limit 10 | fields dur, app"),
+    ("stats-wide", "* | stats by (dur:1) count() c, sum(dur) s"),
+    ("rows", "err warn | fields _time"),
+]
+
+MULTIDAY_CONFIGS = [
+    # the per-partition-drain baseline: the pre-PR-15 execution shape
+    # (window drains at every day boundary, sort-topk never packs)
+    ("per-partition-drain", {"VL_CROSS_PARTITION": "0",
+                             "VL_PACK_TOPK_K": "0"}),
+    # the universal packed device path under test
+    ("cross-partition", {"VL_CROSS_PARTITION": "1",
+                         "VL_PACK_TOPK_K": "1024"}),
+]
+
+
+def run_multipartition(days, parts_per_day, rows_per_part, runs):
+    """Per-partition-drain baseline vs the global window over a 3-day
+    fixture: wall clock, dispatches/query, packed-topk engagement and
+    the seg-major no-widening pin, hit sets bit-identical throughout."""
+    from victorialogs_tpu.engine.searcher import run_query_collect
+    from victorialogs_tpu.tpu.batch import BatchRunner
+    os.environ["VL_INFLIGHT"] = "4"
+    os.environ["VL_PACK_PARTS"] = "8"
+    out = {"days": days, "parts_per_day": parts_per_day,
+           "rows_per_part": rows_per_part}
+    with tempfile.TemporaryDirectory(prefix="vlbenchmp") as tmp:
+        storage, ten, t0 = build_storage_multiday(
+            tmp, days, parts_per_day, rows_per_part)
+        cpu = {name: sorted(map(str, run_query_collect(
+            storage, [ten], qs, timestamp=t0)))
+            for name, qs in MULTIDAY_QUERIES}
+        for label, env in MULTIDAY_CONFIGS:
+            for k, v in env.items():
+                os.environ[k] = v
+            runner = BatchRunner()
+            res = {}
+            for name, qs in MULTIDAY_QUERIES:
+                rows = run_query_collect(storage, [ten], qs,
+                                         timestamp=t0, runner=runner)
+                assert sorted(map(str, rows)) == cpu[name], \
+                    f"{label}/{name} diverged from the CPU executor"
+                d0 = runner.device_calls
+                times = []
+                for _r in range(runs):
+                    t0s = time.perf_counter()
+                    run_query_collect(storage, [ten], qs, timestamp=t0,
+                                      runner=runner)
+                    times.append(time.perf_counter() - t0s)
+                res[name] = {
+                    "p50_ms": statistics.median(times) * 1e3,
+                    "dispatches_per_query":
+                        (runner.device_calls - d0) / runs,
+                }
+            res["counters"] = {
+                k: v for k, v in runner.stats().items()
+                if not k.startswith("staging_")}
+            out[label] = res
+        storage.close()
+    for k, v in {"VL_CROSS_PARTITION": "1",
+                 "VL_PACK_TOPK_K": "1024"}.items():
+        os.environ.pop(k, None)
+    return out
+
+
 def _find_spans(tree, name):
     out = []
 
@@ -455,6 +551,8 @@ def main():
                          "probe")
     ap.add_argument("--queries-per-client", type=int, default=6)
     ap.add_argument("--light-clients", type=int, default=4)
+    ap.add_argument("--days", type=int, default=3)
+    ap.add_argument("--parts-per-day", type=int, default=6)
     ap.add_argument("--json", default="")
     ap.add_argument("--no-assert", action="store_true")
     args = ap.parse_args()
@@ -498,6 +596,12 @@ def main():
             shed_probe = run_shed_probe(storage, ten, t0,
                                         BatchRunner())
         storage.close()
+
+    print(f"multi-partition round: {args.days} days x "
+          f"{args.parts_per_day} parts, per-partition-drain vs "
+          f"cross-partition window ...", flush=True)
+    multiday = run_multipartition(args.days, args.parts_per_day,
+                                  args.rows, args.runs)
 
     print(f"\npipeline bench — {args.parts} parts x {args.rows} rows, "
           f"p50 of {args.runs} (jax-CPU backend)")
@@ -568,6 +672,26 @@ def main():
               f"{mg['light_p99_ms'] / max(um['light_p99_ms'], 1e-9):.2f}x"
               f"  (vs solo: {mg['light_p99_ms'] / max(tenant_mix['solo_light_p50_ms'], 1e-9):.1f}x)")
 
+    base = multiday["per-partition-drain"]
+    cross = multiday["cross-partition"]
+    print(f"multi-partition ({multiday['days']} days x "
+          f"{multiday['parts_per_day']} parts x "
+          f"{multiday['rows_per_part']} rows):")
+    md_ratio = {}
+    for name, _qs in MULTIDAY_QUERIES:
+        r = base[name]["p50_ms"] / max(cross[name]["p50_ms"], 1e-9)
+        md_ratio[name] = r
+        print(f"  {name:>10}: drain={base[name]['p50_ms']:.1f} ms "
+              f"({base[name]['dispatches_per_query']:.1f} disp)  "
+              f"cross={cross[name]['p50_ms']:.1f} ms "
+              f"({cross[name]['dispatches_per_query']:.1f} disp)  "
+              f"{r:.2f}x")
+    cc = cross["counters"]
+    print(f"  packed_topk_dispatches={cc['packed_topk_dispatches']}  "
+          f"cross_partition_packs={cc['cross_partition_packs']}  "
+          f"stats_onehot_width={cc['stats_onehot_width']} "
+          f"(drain {base['counters']['stats_onehot_width']})")
+
     if shed_probe is not None:
         print(f"shed probe (tenant capped at 1, 6 parallel): "
               f"ok={shed_probe['ok']} shed={shed_probe['shed']} "
@@ -592,6 +716,7 @@ def main():
                        "cpu": {k: len(v) for k, v in cpu.items()},
                        "trace_overhead": trace_oh,
                        "emit_split": emit_split,
+                       "multiday": multiday,
                        "concurrent": concurrent,
                        "tenant_mix": tenant_mix,
                        "shed_probe": shed_probe,
@@ -668,7 +793,27 @@ def main():
                        for ra in shed_probe["retry_after"]), shed_probe
             assert shed_probe["rejected_counter"] >= \
                 shed_probe["shed"], shed_probe
+        # the cross-partition acceptance bar (ISSUE 15): >=1.5x wall on
+        # the 3-day fixture vs the per-partition drain — the sort-topk
+        # shape carries it (12 serial per-part dispatches collapse to
+        # packed windowed super-dispatches); the other shapes must not
+        # regress beyond noise.  Packed topk engagement and the
+        # seg-major no-widening bound are counter-asserted.
+        assert md_ratio["topk"] >= 1.5, \
+            f"cross-partition topk must beat the drain >=1.5x, got " \
+            f"{md_ratio['topk']:.2f}x"
+        # the other shapes keep the drain baseline's dispatch counts
+        # (packs per day == packs across days at this fixture), so the
+        # bar is no-regression-beyond-noise, not a speedup
+        assert min(md_ratio.values()) >= 0.85, md_ratio
+        assert cc["packed_topk_dispatches"] > 0
+        assert cc["cross_partition_packs"] > 0
+        w = cc["stats_onehot_width"]
+        assert w == base["counters"]["stats_onehot_width"] == 211, \
+            "packed stats one-hot width must stay at the base group " \
+            f"count (211), got {w}"
         print("acceptance: >=4x fewer dispatches, >=1.5x wall clock, "
+              f"multi-partition topk {md_ratio['topk']:.1f}x, "
               "vltrace disabled-overhead within noise, "
               f"emit span cut {emit_ratio:.1f}x OK")
 

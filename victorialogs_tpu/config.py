@@ -176,6 +176,18 @@ declare_env(
     "max small parts folded into one fused super-dispatch; `1` = "
     "packing off (kill-switch)")
 declare_env(
+    "VL_PACK_TOPK_K", "1024", "int",
+    "largest `sort ... limit` k eligible for packed sort-topk "
+    "super-dispatches (the packed dispatch k-selects once per member, "
+    "so cost grows with pack_size * k); `0` = sort-topk packing off "
+    "(`tpu/pipeline.py`)")
+declare_env(
+    "VL_CROSS_PARTITION", "1", "flag",
+    "`0` = kill-switch for the cross-partition dispatch window: the "
+    "device pipeline drains at every day-partition boundary like "
+    "pre-PR-15 (per-partition prefetch depth, no boundary-spanning "
+    "packs — `engine/searcher.py`, `tpu/pipeline.py`)")
+declare_env(
     "VL_PACK_MAX_ROWS", None, "int",
     "parts above this many rows never pack; default scales with the "
     "measured dispatch RTT (floor 16k rows, cap 1M — flush-sized parts "
@@ -227,6 +239,15 @@ declare_env(
     "aggregates / maplets off — `storage/filterindex/`, bit-identical "
     "results)",
     display="`v2`")
+declare_env(
+    "VL_FILTER_INDEX_REBUILD", "0", "flag",
+    "`1` = rebuild missing `filterindex.bin` sidecars for pre-v2 "
+    "sealed parts IN PLACE at part-open time (from blooms.bin + "
+    "columns, the same deterministic tokenizer as the seal-time "
+    "build), so long-lived deployments get maplet/xor/split-block "
+    "pruning without waiting for a merge; journaled as "
+    "`filter_index_built` with `rebuilt=true` "
+    "(`storage/filterindex/index.py`)")
 declare_env(
     "VL_QUERY_PRICING", "1", "flag",
     "`0` = kill the continuous plan-time pricing pass: queries no "

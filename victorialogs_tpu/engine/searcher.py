@@ -320,17 +320,33 @@ def _run_query_guarded(storage, tenants, q, write_block, timestamp,
 
     try:
         pts = storage.select_partitions(min_ts, max_ts)
-        # per-day partitions search CONCURRENTLY under a worker cap
-        # (reference storage_search.go:1095-1126): a 30-day query is no
-        # longer 30x the single-day latency.  The processor chain is not
-        # thread-safe, so partition workers funnel through a locked head;
-        # within one partition, block order stays deterministic.
-        npw = min(len(pts), q.get_concurrency())
-        if npw <= 1:
-            for pt in pts:
-                scan_partition(pt, head)
+        if batch and _cross_partition_enabled():
+            # device path: ONE dispatch window across every selected
+            # partition (tpu/pipeline.scan_device_stream) — parts from
+            # partition N+1 submit while partition N harvests, packs
+            # may span the day boundary, and prefetch depth survives
+            # it.  The window IS the parallelism here (dispatches from
+            # several partitions overlap on the one device), so the
+            # thread-per-partition fan-out below stays host-only.
+            # VL_CROSS_PARTITION=0 restores the per-partition drain.
+            _scan_partitions_device(
+                pts, q, head, runner, tenants, tenant_set, sfs, min_ts,
+                max_ts, needed, deadline, stats_spec, sort_spec,
+                token_leaves)
         else:
-            _scan_partitions_parallel(pts, scan_partition, head, npw)
+            # per-day partitions search CONCURRENTLY under a worker cap
+            # (reference storage_search.go:1095-1126): a 30-day query
+            # is no longer 30x the single-day latency.  The processor
+            # chain is not thread-safe, so partition workers funnel
+            # through a locked head; within one partition, block order
+            # stays deterministic.
+            npw = min(len(pts), q.get_concurrency())
+            if npw <= 1:
+                for pt in pts:
+                    scan_partition(pt, head)
+            else:
+                _scan_partitions_parallel(pts, scan_partition, head,
+                                          npw)
     except QueryCancelled:
         pass
     finally:
@@ -398,6 +414,80 @@ def _scan_partitions_parallel(pts, scan_partition, head, npw) -> None:
         raise errors[0]
 
 
+def _cross_partition_enabled() -> bool:
+    from ..tpu.pipeline import cross_partition_enabled
+    return cross_partition_enabled()
+
+
+def _make_cand_fn(tenant_set, allowed_sids, min_ts, max_ts):
+    """Header-only candidate selection closure (shared by the serial
+    walk, the cross-partition device stream and the prefetcher);
+    candidate_blocks skips whole header groups outside the query's
+    time range without decoding them (v2 metaindex)."""
+    def cand_block_idxs(part) -> list:
+        out = []
+        for bi in part.candidate_blocks(min_ts, max_ts):
+            sid = part.block_stream_id(bi)
+            if sid.tenant not in tenant_set:
+                continue
+            if allowed_sids is not None and sid not in allowed_sids:
+                continue
+            out.append(bi)
+        return out
+    return cand_block_idxs
+
+
+def _scan_partitions_device(pts, q, head, runner, tenants, tenant_set,
+                            sfs, min_ts, max_ts, needed, deadline,
+                            stats_spec, sort_spec,
+                            token_leaves) -> None:
+    """The cross-partition device path: feed every selected partition's
+    parts through ONE async dispatch window (tpu/pipeline.py).
+
+    Partition setup stays lazy AND attributed: each partition resolves
+    its stream filters and snapshots its parts only when the window's
+    planning pull reaches it, under a short-lived per-partition span
+    (day, part count, stream-filter prunes — the same attribution the
+    per-partition walk recorded); an early exit (limit, deadline,
+    cancel) therefore stops the partition walk exactly where the old
+    loop would have."""
+    from ..tpu.pipeline import scan_device_stream
+    qsp = tracing.current_span()
+    act = activity.current_activity()
+
+    def part_stream():
+        for pt in pts:
+            parts = []
+            cand_fn = None
+            ctx = None
+            # the span covers partition SETUP only (it must not stay
+            # open across planning pulls — spans are ambient via a
+            # contextvar, and a generator holding one open would leak
+            # it into the window driver's own spans between pulls)
+            with qsp.span("partition", day=getattr(pt, "day",
+                                                   None)) as psp:
+                ctx = SearchContext(partition=pt, tenants=tenants)
+                allowed_sids = None
+                if sfs:
+                    allowed_sids = set.intersection(
+                        *(f.resolve(pt, tenants) for f in sfs))
+                    if not allowed_sids:
+                        psp.set("pruned_by_stream_filter", True)
+                if allowed_sids is None or allowed_sids:
+                    parts = [p for p in pt.ddb.snapshot_parts()
+                             if p.num_rows and p.min_ts <= max_ts
+                             and p.max_ts >= min_ts]
+                    psp.set("parts", len(parts))
+                    act.add("parts_total", len(parts))
+                    cand_fn = _make_cand_fn(tenant_set, allowed_sids,
+                                            min_ts, max_ts)
+            for part in parts:
+                yield part, cand_fn, ctx
+
+    scan_device_stream(part_stream(), q, head, runner, needed, deadline,
+                       stats_spec, sort_spec, token_leaves)
+
+
 def _eval_block_cpu(q, bs):
     bm = new_bitmap(bs.nrows)
     q.filter.apply_to_block(bs, bm)
@@ -428,20 +518,8 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                                       part_aggregate_prunes)
     parts = [p for p in pt.ddb.snapshot_parts()
              if p.num_rows and p.min_ts <= max_ts and p.max_ts >= min_ts]
-
-    def cand_block_idxs(part) -> list:
-        """Header-only candidate selection (shared with the prefetcher);
-        candidate_blocks skips whole header groups outside the query's
-        time range without decoding them (v2 metaindex)."""
-        out = []
-        for bi in part.candidate_blocks(min_ts, max_ts):
-            sid = part.block_stream_id(bi)
-            if sid.tenant not in tenant_set:
-                continue
-            if allowed_sids is not None and sid not in allowed_sids:
-                continue
-            out.append(bi)
-        return out
+    cand_block_idxs = _make_cand_fn(tenant_set, allowed_sids, min_ts,
+                                    max_ts)
 
     if batch:
         # async device pipeline: dispatches for up to VL_INFLIGHT units

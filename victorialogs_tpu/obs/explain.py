@@ -143,24 +143,42 @@ def _walk(storage, tenants, q, runner, detail: bool) -> dict:
     cost = {"rtt_s": 0.0, "device_scan_s": 0.0, "upload_s": 0.0,
             "emit_s": 0.0, "host_s": 0.0}
 
+    from ..tpu import pipeline as _pipeline
+    cross = batch and _pipeline.cross_partition_enabled()
     active_pts = 0
+    retained_all: list = []   # (pnode, part, bis, rows_cand, bytes_est)
     for pt in storage.select_partitions(min_ts, max_ts):
-        pnode = _walk_partition(
+        pnode, retained = _walk_partition(
             pt, tenants, tenant_set, min_ts, max_ts, sfs,
-            token_leaves, runner, batch, peek, plans, shape, fused,
-            sort_spec, depth, detail, tot, cost)
-        if pnode.pop("_active", False):
+            token_leaves, detail, tot)
+        if retained:
             active_pts += 1
+        retained_all.extend((pnode, p, b, rc, be)
+                            for p, b, rc, be in retained)
         if detail:
             tree["partitions"].append(pnode)
+
+    # planned dispatch units: THE pack-membership rules the window
+    # dispatches with (pipeline.pack_policy + iter_pack_groups), run
+    # over the CROSS-PARTITION retained stream exactly like the
+    # execution planner — packs may span a day boundary, and the unit
+    # seq is global (it matches the window's submit/harvest span
+    # numbering, which _graft keys on).  A unit node hangs off the
+    # partition of its FIRST member.  VL_CROSS_PARTITION=0 groups per
+    # partition like the old drain-at-boundary walk did.
+    _price_units(retained_all, runner, batch, peek, plans, shape,
+                 fused, sort_spec, depth, detail, tot, cost,
+                 per_partition=not cross)
+
     if not detail:
         tree.pop("partitions")
 
-    # per-day partitions scan concurrently under the worker cap
-    # (engine/searcher._scan_partitions_parallel), so wall time divides
-    # by the effective partition parallelism; within one partition the
-    # window already overlaps round trips (depth folded above)
-    npw = max(1, min(active_pts, q.get_concurrency()))
+    # host-path per-day partitions scan concurrently under the worker
+    # cap (engine/searcher._scan_partitions_parallel), so wall time
+    # divides by the effective partition parallelism.  The device
+    # path's cross-partition window overlaps round trips ACROSS
+    # partitions already (depth folded above): no extra parallelism.
+    npw = 1 if cross else max(1, min(active_pts, q.get_concurrency()))
     duration = sum(cost.values()) / npw
     tree["predicted"] = dict(tot)
     tree["predicted"].update({k: round(v, 6) for k, v in cost.items()})
@@ -213,10 +231,8 @@ def _part_header_table(part) -> dict:
 
 
 def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
-                    token_leaves, runner, batch, peek, plans, shape,
-                    fused, sort_spec, depth, detail, tot, cost) -> dict:
+                    token_leaves, detail, tot):
     from ..storage.filterbank import aggregate_kill_leaf
-    from ..tpu import pipeline
 
     pnode: dict = {"name": "partition",
                    "day": getattr(pt, "day", None),
@@ -227,7 +243,7 @@ def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
             *(f.resolve(pt, tenants) for f in sfs))
         if not allowed_sids:
             pnode["pruned_by_stream_filter"] = True
-            return pnode
+            return pnode, []
 
     retained: list = []      # (part, bis, rows_cand, bytes_est)
     for part in pt.ddb.snapshot_parts():
@@ -353,31 +369,59 @@ def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
             pnode["parts"].append(node)
         retained.append((part, bis, rows_cand, bytes_est))
 
-    if not retained:
-        return pnode
-    pnode["_active"] = True
+    return pnode, retained
 
-    # planned dispatch units: THE pack-membership rules the window
-    # dispatches with (pipeline.iter_pack_groups), priced per unit
-    by_part = {p.uid: (rc, be) for p, _b, rc, be in retained}
+
+def _price_units(retained_all, runner, batch, peek, plans, shape,
+                 fused, sort_spec, depth, detail, tot, cost,
+                 per_partition: bool) -> None:
+    """Group the retained-part stream into planned dispatch units and
+    price each one.  retained_all: (pnode, part, bis, rows, bytes)
+    tuples in partition-walk order — grouping runs over the WHOLE
+    stream (cross-partition window) or restarts at each partition
+    boundary (per_partition=True, the VL_CROSS_PARTITION=0 walk); the
+    unit seq is global either way, matching the execution window's
+    submit/harvest span numbering."""
+    from ..tpu import pipeline
+    if not retained_all:
+        return
+    by_part = {p.uid: (rc, be) for _pn, p, _b, rc, be in retained_all}
+    pnode_of = {p.uid: pn for pn, p, _b, _rc, _be in retained_all}
     if batch:
-        pack_max = pipeline.pack_limit()
-        packable = pack_max > 1 and sort_spec is None
-        rows_cap = pipeline.pack_rows_cap(runner, probe=False) \
-            if packable else 0
-        groups = pipeline.iter_pack_groups(
-            ((p, b) for p, b, _rc, _be in retained), packable,
-            pack_max, rows_cap)
-    else:
-        groups = ([(p, b)] for p, b, _rc, _be in retained)
+        packable, pack_max, rows_cap = pipeline.pack_policy(
+            runner, sort_spec, probe=False)
 
-    for seq, group in enumerate(groups):
-        unode = _price_unit(seq, group, by_part, runner, batch,
-                            peek, plans, shape, fused, depth, cost,
-                            tot, detail)
-        if detail:
-            pnode["units"].append(unode)
-    return pnode
+        def groups_of(items):
+            return pipeline.iter_pack_groups(items, packable, pack_max,
+                                             rows_cap)
+    else:
+        def groups_of(items):
+            return ([it] for it in items)
+
+    def runs():
+        if not per_partition:
+            yield [(p, b) for _pn, p, b, _rc, _be in retained_all]
+            return
+        run: list = []
+        cur = None
+        for pn, p, b, _rc, _be in retained_all:
+            if cur is not None and pn is not cur:
+                yield run
+                run = []
+            cur = pn
+            run.append((p, b))
+        if run:
+            yield run
+
+    seq = 0
+    for run in runs():
+        for group in groups_of(iter(run)):
+            unode = _price_unit(seq, group, by_part, runner, batch,
+                                peek, plans, shape, fused, depth,
+                                cost, tot, detail)
+            seq += 1
+            if detail and unode is not None:
+                pnode_of[group[0][0].uid]["units"].append(unode)
 
 
 def _price_unit(seq, group, by_part, runner, batch, peek, plans,
@@ -389,7 +433,10 @@ def _price_unit(seq, group, by_part, runner, batch, peek, plans,
     nbytes = sum(by_part[p.uid][1] for p, _b in group)
     blocks = sum(len(b) for _p, b in group)
     scan_bytes = rows * _SCAN_BYTES_PER_ROW
-    stats_rows = rows if shape == "stats" else 0
+    # topk units gate exactly like stats units do at execution time
+    # (run_part_topk_submit passes stats_rows=cand_rows): one fused
+    # dispatch whose host alternative pays the aggregate-scan rate
+    stats_rows = rows if shape in ("stats", "topk") else 0
 
     cold = 0
     n_dispatch = 0
@@ -543,6 +590,45 @@ def _graft(tree, tdict, progress, rows_emitted) -> None:
         for name in ("pipeline", "prune", "stage", "submit", "harvest",
                      "device_sync", "emit", "sched_wait")
         if name in flat}
+    _graft_units(tree, tdict)
+
+
+def _graft_units(tree, tdict) -> None:
+    """Per-unit actuals: submit/harvest spans keyed by the pipeline's
+    GLOBAL unit sequence — the cross-partition window numbers units
+    across the whole query, and the plan walk generated its unit list
+    with the same grouping and numbering (pipeline.iter_pack_groups
+    both times), so matching is tree-wide."""
+    submits: dict = {}
+    harvests: dict = {}
+    dup = False
+    for sp in tracing.iter_tree(tdict, "submit"):
+        attrs = sp.get("attrs") or {}
+        if "unit" in attrs:
+            dup = dup or attrs["unit"] in submits
+            submits[attrs["unit"]] = (sp, attrs)
+    for sp in tracing.iter_tree(tdict, "harvest"):
+        attrs = sp.get("attrs") or {}
+        if "unit" in attrs:
+            harvests[attrs["unit"]] = (sp, attrs)
+    if dup:
+        # VL_CROSS_PARTITION=0 restarts the unit sequence at every
+        # partition boundary (submit/harvest spans nest under their
+        # partition span there), so colliding global seqs mean the
+        # compat walk ran: match per partition instead — a partition's
+        # i-th planned unit IS its i-th executed unit
+        _graft_units_compat(tree, tdict)
+        return
+    units = [u for pnode in tree.get("partitions", ())
+             for u in pnode.get("units", ())]
+    for unode in units:
+        _attach_actual(unode, submits, harvests, unode.get("seq"))
+
+
+def _graft_units_compat(tree, tdict) -> None:
+    """Per-partition matching for the VL_CROSS_PARTITION=0 walk: each
+    partition span subtree carries its own 0-based unit sequence, and
+    the plan listed that partition's units in the same order."""
     by_day: dict = {}
     for psp in tracing.iter_tree(tdict, "partition"):
         by_day[(psp.get("attrs") or {}).get("day")] = psp
@@ -550,45 +636,41 @@ def _graft(tree, tdict, progress, rows_emitted) -> None:
         psp = by_day.get(pnode.get("day"))
         if psp is None:
             continue
-        _graft_partition(pnode, psp)
+        submits: dict = {}
+        harvests: dict = {}
+        for sp in tracing.iter_tree(psp, "submit"):
+            attrs = sp.get("attrs") or {}
+            if "unit" in attrs:
+                submits[attrs["unit"]] = (sp, attrs)
+        for sp in tracing.iter_tree(psp, "harvest"):
+            attrs = sp.get("attrs") or {}
+            if "unit" in attrs:
+                harvests[attrs["unit"]] = (sp, attrs)
+        for i, unode in enumerate(pnode.get("units", ())):
+            _attach_actual(unode, submits, harvests, i)
 
 
-def _graft_partition(pnode, psp) -> None:
-    """Per-unit actuals: submit/harvest spans keyed by the pipeline's
-    per-partition unit sequence — the same sequence the plan's unit
-    list was generated in (pipeline.iter_pack_groups both times)."""
-    submits: dict = {}
-    harvests: dict = {}
-    for sp in tracing.iter_tree(psp, "submit"):
-        attrs = sp.get("attrs") or {}
-        if "unit" in attrs:
-            submits[attrs["unit"]] = (sp, attrs)
-    for sp in tracing.iter_tree(psp, "harvest"):
-        attrs = sp.get("attrs") or {}
-        if "unit" in attrs:
-            harvests[attrs["unit"]] = (sp, attrs)
-    for unode in pnode.get("units", ()):
-        seq = unode.get("seq")
-        actual: dict = {}
-        got = submits.get(seq)
-        if got is not None:
-            _sp, attrs = got
-            for k in ("rows", "blocks", "slot_wait_s"):
-                if k in attrs:
-                    actual[k] = attrs[k]
-        got = harvests.get(seq)
-        if got is not None:
-            sp, attrs = got
-            if "dispatch_rtt_s" in attrs:
-                actual["dispatch_rtt_s"] = attrs["dispatch_rtt_s"]
-            if attrs.get("host_unit"):
-                actual["host_unit"] = True
-            for child in sp.get("children", ()):
-                if child.get("name") == "device_sync":
-                    actual["device_sync_s"] = round(
-                        child.get("duration_ms", 0.0) / 1e3, 6)
-                elif child.get("name") == "emit":
-                    actual["emit_s"] = round(
-                        child.get("duration_ms", 0.0) / 1e3, 6)
-        if actual:
-            unode["actual"] = actual
+def _attach_actual(unode, submits, harvests, seq) -> None:
+    actual: dict = {}
+    got = submits.get(seq)
+    if got is not None:
+        _sp, attrs = got
+        for k in ("rows", "blocks", "slot_wait_s"):
+            if k in attrs:
+                actual[k] = attrs[k]
+    got = harvests.get(seq)
+    if got is not None:
+        sp, attrs = got
+        if "dispatch_rtt_s" in attrs:
+            actual["dispatch_rtt_s"] = attrs["dispatch_rtt_s"]
+        if attrs.get("host_unit"):
+            actual["host_unit"] = True
+        for child in sp.get("children", ()):
+            if child.get("name") == "device_sync":
+                actual["device_sync_s"] = round(
+                    child.get("duration_ms", 0.0) / 1e3, 6)
+            elif child.get("name") == "emit":
+                actual["emit_s"] = round(
+                    child.get("duration_ms", 0.0) / 1e3, 6)
+    if actual:
+        unode["actual"] = actual

@@ -228,12 +228,14 @@ class MeshBatchRunner(BatchRunner):
             sp.set("mesh_devices", self.ndev)
 
     def _dispatch_fused(self, prog, strides, nb, n_values, nrows,
-                        cand_packed, ids_tuple, values_tuple, args):
+                        cand_packed, seg_map, ids_tuple, values_tuple,
+                        args):
         from ..tpu.fused import _fused_dispatch_mesh
         self._trace_collective()
         return _fused_dispatch_mesh(self.mesh, BLOCK_AXIS, prog, strides,
                                     nb, n_values, nrows, cand_packed,
-                                    ids_tuple, values_tuple, args)
+                                    seg_map, ids_tuple, values_tuple,
+                                    args)
 
     def _dispatch_filter(self, prog, nrows, cand_packed, args):
         # row-query fused filter under shard_map: each device evaluates

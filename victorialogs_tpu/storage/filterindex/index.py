@@ -187,7 +187,22 @@ def _load(part, path: str):
     try:
         cols, nbytes = load_sidecar(path, part.num_blocks)
     except FileNotFoundError:
-        return None                       # pre-v2 part: classic path
+        # pre-v2 part (sealed before the filter index existed).
+        # VL_FILTER_INDEX_REBUILD=1 rebuilds the sidecar IN PLACE from
+        # blooms.bin + columns right here at part-open time — the
+        # deterministic tokenizer recomputes exactly the hash sets the
+        # blooms were built from (the merge pass-through discipline),
+        # so long-lived deployments get maplet/xor/split-block pruning
+        # without waiting for a merge to reseal the part.  Off by
+        # default: the rebuild reads every bloom-covered column once.
+        if not config.env_flag("VL_FILTER_INDEX_REBUILD"):
+            return None                   # classic path serves
+        if not _rebuild_sidecar(part, path):
+            return None
+        try:
+            cols, nbytes = load_sidecar(path, part.num_blocks)
+        except (FileNotFoundError, SidecarInvalid, OSError):
+            return None
     except (SidecarInvalid, OSError) as e:
         events.emit("filter_index_fallback",
                     part=str(getattr(part, "uid", "?")),
@@ -203,6 +218,59 @@ def _load(part, path: str):
     from ..filterbank import _bank_track
     _bank_track(fi)
     return fi
+
+
+def _rebuild_sidecar(part, path: str) -> bool:
+    """Build + persist filterindex.bin for a sealed pre-v2 part, in
+    place, from its published blooms.bin + column payloads.
+
+    Runs under _attach_mu (one rebuild at a time, once per part
+    lifetime); the file lands via write-to-.tmp + os.replace so a crash
+    mid-write can never leave a half-written sidecar under the probed
+    name (and the crc check would reject one anyway).  Advisory like
+    the seal-time build: any failure journals filter_index_build_failed
+    and the classic path keeps serving."""
+    import time as _time
+    from ..block import column_token_hashes
+    from .sidecar import (FILTERINDEX_FILENAME, SidecarBuilder,
+                          build_sidecar, write_sidecar)
+    t0 = _time.perf_counter()
+    try:
+        builder = SidecarBuilder()
+        covered = 0
+        for bi in range(part.num_blocks):
+            nrows = part.block_rows(bi)
+            for name in part.block_col_names(bi):
+                ch = part.block_column_meta(bi, name)
+                if ch is None or ch.get("b") is None:
+                    continue          # no bloom => no token coverage
+                col = part.block_column(bi, name)
+                h = column_token_hashes(col, nrows)
+                if h is None:
+                    continue
+                builder.add(bi, name, h)
+                covered += 1
+        if not covered:
+            return False              # nothing bloom-covered to index
+        cols, stats = build_sidecar(builder, part.num_blocks)
+        tmp = FILTERINDEX_FILENAME + ".tmp"
+        stats["file_bytes"] = write_sidecar(path, cols,
+                                            part.num_blocks,
+                                            filename=tmp)
+        os.replace(os.path.join(path, tmp),
+                   os.path.join(path, FILTERINDEX_FILENAME))
+    # vlint: allow-broad-except(rebuild is advisory, classic path serves)
+    except Exception as e:
+        events.emit("filter_index_build_failed",
+                    part=str(getattr(part, "uid", "?")),
+                    reason=repr(e), rebuilt=True)
+        return False
+    from ...obs import hist as _hist
+    stats["build_s"] = round(_time.perf_counter() - t0, 6)
+    _hist.FILTER_INDEX_BUILD.observe(stats["build_s"])
+    events.emit("filter_index_built",
+                part=os.path.basename(path), rebuilt=True, **stats)
+    return True
 
 
 def sb_plane_for_staging(part, field: str):

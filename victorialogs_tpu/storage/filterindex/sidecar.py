@@ -135,8 +135,15 @@ def _pack(chunks: list, arr: np.ndarray, dtype: str):
 
 
 def write_sidecar(dir_path: str, cols: dict[str, ColumnArtifacts],
-                  nblocks: int) -> int:
-    """Serialize into dir_path/filterindex.bin -> bytes written."""
+                  nblocks: int,
+                  filename: str = FILTERINDEX_FILENAME) -> int:
+    """Serialize into dir_path/<filename> -> bytes written.
+
+    filename: the in-place REBUILD path (index._rebuild_sidecar) writes
+    to a .tmp name first and os.replace()s it over the final name, so a
+    crash mid-write can never leave a half-written file under the name
+    the loader probes (the seal-time build needs no such step — the
+    whole part dir publishes by one atomic rename)."""
     chunks: list[bytes] = []
     hdr_cols: dict = {}
     for name, c in cols.items():
@@ -163,7 +170,7 @@ def write_sidecar(dir_path: str, cols: dict[str, ColumnArtifacts],
             + struct.pack("<III", VERSION, nblocks, len(header))
             + struct.pack("<I", crc)
             + header + payload)
-    path = os.path.join(dir_path, FILTERINDEX_FILENAME)
+    path = os.path.join(dir_path, filename)
     with open(path, "wb") as f:
         f.write(blob)
         f.flush()

@@ -510,6 +510,12 @@ class AxesAssembly:
     strides: tuple
     nb: int
     uniq_shared: list              # (field, axis_idx)
+    # packed super-dispatch segment count (0 = no seg axis).  When set,
+    # ids_tuple[0] is the per-row segment ids and the fused kernel runs
+    # the SEGMENT-MAJOR reduction (tpu/stats_seg.py): the one-hot
+    # bucket width is nb // nseg — it no longer scales with the pack
+    # size, and MAX_BUCKETS gates only that base product.
+    nseg: int = 0
 
 
 def part_stats_layout(part, shards: int = 1) -> StatsLayout:
@@ -969,6 +975,12 @@ class BatchRunner:
         self.pipeline_units = 0        # units driven through the window
         self.packed_dispatches = 0     # super-dispatches over packed parts
         self.packed_parts = 0         # parts folded into super-dispatches
+        self.packed_topk_dispatches = 0  # sort-topk super-dispatches
+        self.cross_partition_packs = 0  # packs spanning a day boundary
+        # widest bucket one-hot any stats dispatch paid (the seg-major
+        # kernel keeps this at the BASE bucket product — it must not
+        # scale with VL_PACK_PARTS; bench-asserted)
+        self.stats_onehot_width = 0
         self.inflight_hwm = 0          # in-flight window high-water mark
         self.host_sync_wait_s = 0.0    # time blocked materializing results
         self.sched_slot_wait_s = 0.0   # time leasing dispatch slots from
@@ -1030,6 +1042,9 @@ class BatchRunner:
                 "pipeline_units": self.pipeline_units,
                 "packed_dispatches": self.packed_dispatches,
                 "packed_parts": self.packed_parts,
+                "packed_topk_dispatches": self.packed_topk_dispatches,
+                "cross_partition_packs": self.cross_partition_packs,
+                "stats_onehot_width": self.stats_onehot_width,
                 "inflight_hwm": self.inflight_hwm,
                 "host_sync_wait_s": self.host_sync_wait_s,
                 "sched_slot_wait_s": self.sched_slot_wait_s,
@@ -1087,7 +1102,8 @@ class BatchRunner:
 
     # ---- prefetch (stage part N+k while parts N..N+k-1 scan) ----
     def submit_prefetch(self, part, f, stats_spec=None,
-                        cand_bis=None, fused=False) -> None:
+                        cand_bis=None, fused=False,
+                        sort_field=None) -> None:
         """Queue background staging of what the query will need from
         `part`, so the host decode/upload of UPCOMING parts overlaps the
         device scans of the current ones (SURVEY §7 hard-part 3).  The
@@ -1104,7 +1120,10 @@ class BatchRunner:
         fused=True stages for the single-dispatch fused programs
         (layout-coordinate columns + timestamp planes — what the
         windowed pipeline dispatches, including packed super-parts)
-        instead of the per-leaf string staging."""
+        instead of the per-leaf string staging.
+        sort_field: the sort-topk by-column — its uint32 value staging
+        (the fused topk dispatch's score operand) uploads ahead like
+        the stats value columns."""
         from ..obs import activity, tracing
         # staging runs on the vl-prefetch worker: re-enter the caller's
         # span AND activity record there so staged_entries/staged_bytes
@@ -1119,7 +1138,7 @@ class BatchRunner:
                 with tracing.use_span(caller_span), \
                         activity.use_activity(caller_act):
                     self._prefetch_work(part, f, stats_spec, cand_bis,
-                                        fused)
+                                        fused, sort_field)
             # vlint: allow-broad-except(prefetch is best-effort)
             except Exception:
                 pass  # prefetch is best-effort; the scan path re-stages
@@ -1129,13 +1148,14 @@ class BatchRunner:
             pass  # pool closed between return and submit; best-effort
 
     def _prefetch_work(self, part, f, stats_spec, cand_bis,
-                       fused) -> None:
+                       fused, sort_field=None) -> None:
         bis = list(cand_bis) if cand_bis is not None else \
             list(range(part.num_blocks))
         cand_rows = sum(part.block_rows(bi) for bi in bis)
         if self._gate_host_est(
                 f, part, cand_rows,
-                stats_rows=cand_rows if stats_spec else 0):
+                stats_rows=cand_rows if stats_spec or sort_field
+                else 0):
             return     # the evaluator will take the host path
         layout = None
         if fused:
@@ -1145,6 +1165,17 @@ class BatchRunner:
                 layout = None
             elif _tree_has_time(f):
                 self._stage_ts_planes(part, layout)
+        if sort_field is not None and layout is not None:
+            # the topk score operand (fused_topk_submit's staging key).
+            # A decline (non-numeric sort column for this part) means
+            # the evaluator will decline the fused topk too and fall
+            # back to per-leaf string scans — revert THIS part's
+            # prefetch to the classic string staging instead of
+            # uploading #fl matrices the dispatch will never read.
+            from .stats_device import MAX_ABS_TIMES_ROWS
+            if self._stage_numeric(part, sort_field, layout,
+                                   MAX_ABS_TIMES_ROWS) is None:
+                layout = None
         for plan in device_plans(f):
             surv = bis
             if plan.bloom_tokens:
@@ -1203,16 +1234,18 @@ class BatchRunner:
 
     # ---- stats dispatch hooks (MeshBatchRunner shard_maps + psum-reduces)
     def _dispatch_fused(self, prog, strides, nb, n_values, nrows,
-                        cand_packed, ids_tuple, values_tuple, args):
+                        cand_packed, seg_map, ids_tuple, values_tuple,
+                        args):
         from .fused import _fused_dispatch
         return _fused_dispatch(prog, strides, nb, n_values, nrows,
-                               cand_packed, ids_tuple, values_tuple, args)
+                               cand_packed, seg_map, ids_tuple,
+                               values_tuple, args)
 
-    def _dispatch_topk(self, prog, k, desc, nrows, cand_packed, values,
-                       args):
+    def _dispatch_topk(self, prog, k, desc, nseg, nrows, cand_packed,
+                       seg_ids, seg_map, values, args):
         from .fused import _topk_dispatch
-        return _topk_dispatch(prog, k, desc, nrows, cand_packed, values,
-                              args)
+        return _topk_dispatch(prog, k, desc, nseg, nrows, cand_packed,
+                              seg_ids, seg_map, values, args)
 
     def _dispatch_filter(self, prog, nrows, cand_packed, args):
         from .fused import _filter_dispatch
@@ -1558,6 +1591,30 @@ class BatchRunner:
                 self.cache.put(key, got)
             return got
 
+    def _stage_seg_slots(self, part, layout: StatsLayout,
+                         min_len: int = 0):
+        """Segment-aligned slot map of a packed part (int32[S, Lp] row
+        indices, -1 padding — tpu/stats_seg.build_seg_slot_map): the
+        single-device seg-major kernels and the packed topk k-selection
+        gather members into their own padded slot rows through it.
+        min_len: floor on Lp (a topk dispatch needs >= k slots)."""
+        from .stats_seg import build_seg_slot_map, pad_slots
+        lp = pad_slots(max(p.num_rows for p in part.members), min_len)
+        key = (part.uid, "#segslots", lp)
+        with self._key_lock(key):
+            got = self.cache.get(key)
+            if got is None:
+                idx = build_seg_slot_map(part, layout, min_len)
+                # small and consumed whole by every device (the topk
+                # k-selection runs under GSPMD on mesh runners):
+                # replicated placement, like the bloom planes
+                got = StagedBuckets(ids=self._put_replicated(idx),
+                                    base=0,
+                                    num_buckets=idx.shape[1],
+                                    nbytes=int(idx.nbytes))
+                self.cache.put(key, got)
+            return got
+
     def _stage_buckets(self, part, layout: StatsLayout, step: int,
                        offset: int, max_buckets: int):
         key = (part.uid, "#tb", step, offset)
@@ -1665,9 +1722,18 @@ class BatchRunner:
                          (fld, sn.vmin)))
             eligibility.append(sn.eligible)
         nb = 1
-        for _k, _i, size, _p in axes:
+        nseg = 0
+        for k, _i, size, _p in axes:
             nb *= size
-        if nb > MAX_BUCKETS:
+            if k == "s":
+                nseg = size
+        # the segment axis of a packed super-dispatch does NOT count
+        # toward the bucket cap: the segment-major kernels
+        # (tpu/stats_seg.py) reduce it outside the bucket one-hot, so
+        # only the per-member base product pays VMEM/compare width.
+        # The [S, buckets] accumulator still scales with the pack —
+        # bounded by VL_PACK_PARTS * MAX_BUCKETS output cells.
+        if nb // max(nseg, 1) > MAX_BUCKETS:
             return None
         if axes:
             ids_tuple = tuple(a[1] for a in axes)
@@ -1692,7 +1758,7 @@ class BatchRunner:
         return AxesAssembly(layout=layout, numerics=numerics, axes=axes,
                             eligibility=eligibility, ids_tuple=ids_tuple,
                             strides=strides, nb=nb,
-                            uniq_shared=uniq_shared)
+                            uniq_shared=uniq_shared, nseg=nseg)
 
     def _key_parts(self, asm: "AxesAssembly", idx: int) -> tuple:
         """(group-key components, uniq-axis values) for one cell."""
@@ -1833,11 +1899,20 @@ class BatchRunner:
         k-th best sort key (a superset of the part's contribution to the
         global top-k — the host sort processor resolves order and ties
         exactly like the CPU path), or None when the shape declines."""
+        pending = self.run_part_topk_submit(f, part, bss, spec)
+        return None if pending is None else pending.harvest()
+
+    def run_part_topk_submit(self, f, part, bss: dict, spec):
+        """Async variant of run_part_topk: the dispatch (packed or
+        single-part) is ISSUED now and materialized at harvest(), so
+        the windowed pipeline keeps sort-topk units outstanding like
+        every other query shape.  None when the host gate or the fused
+        planner declines (caller falls back to ordinary evaluation)."""
         cand_rows = sum(bs.nrows for bs in bss.values())
         if self._gate_host(f, part, bss, stats_rows=max(cand_rows, 1)):
             return None               # run_part re-gates and runs host
-        from .fused import try_fused_topk
-        return try_fused_topk(self, f, part, bss, spec)
+        from .fused import fused_topk_submit
+        return fused_topk_submit(self, f, part, bss, spec)
 
     def run_part_stats(self, f, part, bss: dict, spec):
         """Filter + stats partials for one part.

@@ -374,6 +374,13 @@ class _Planner:
 
     def _time_leaf(self, f: F.FilterTime):
         ts = self.runner._stage_ts_planes(self.part, self.layout)
+        if self.part.max_ts - ts.base >= (1 << 47):
+            # the (hi >> 16) int32 plane is exact only below 2**47 ns
+            # of offset (~39h).  Per-day parts never exceed it and
+            # iter_pack_groups splits packs at PACK_TS_SPAN_MAX, so
+            # this is a defensive decline (e.g. a part from a widened
+            # retention layout), never a silent wrong compare.
+            raise _NoFuse("ts-span")
         if self.ts_slot is None:
             hi = self.arg(ts.hi, row=True)
             lo = self.arg(ts.lo, row=True)
@@ -778,17 +785,35 @@ def _eval_node(node, args, rlp):
     return d, (None if all(km is None for _, km in kids) else may)
 
 
+def _seg_base_ids(ids_tuple, strides):
+    """Combined BASE bucket ids of a seg-major dispatch (everything
+    after the leading segment axis; a seg-only grouping has base 0)."""
+    import jax.numpy as jnp
+    if len(ids_tuple) == 1:
+        return jnp.zeros(ids_tuple[0].shape[0], dtype=jnp.int32)
+    return K.combine_ids(ids_tuple[1:], strides[1:])
+
+
 def _fused_local(prog, strides, nb, n_values, axis, nrows, cand_packed,
-                 ids_tuple, values_tuple, args):
+                 seg_map, ids_tuple, values_tuple, args):
     """The fused program body, single-device or per-shard.
 
     axis: None for single-device execution; a mesh axis name when
     running inside shard_map — row-sized inputs arrive as this shard's
     stripe, stats reduce with psum/pmin/pmax over ICI, and the row
     index for the rows<nrows candidate form is offset by the shard's
-    global position."""
+    global position.
+
+    Packed super-dispatches (prog carries nseg > 0): ids_tuple[0] is
+    the per-row segment ids and the reduction runs SEGMENT-MAJOR
+    (tpu/stats_seg.py) — the bucket one-hot stays at the base product
+    nb // nseg instead of widening to the full nb, and the flattened
+    [S, base] result is bit-identical to the widened combined-id form
+    (the seg axis led the by order with stride == base)."""
     import jax.numpy as jnp
     tree, _rlp_global, has_maybe, has_cand = prog[:4]
+    nseg = prog[5] if len(prog) > 5 else 0
+    seg_pallas = prog[6] if len(prog) > 6 else False
     rl = ids_tuple[0].shape[0]         # LOCAL rows (== global w/o axis)
     d, m = _eval_node(tree, args, rl)
     if has_cand:
@@ -800,12 +825,51 @@ def _fused_local(prog, strides, nb, n_values, axis, nrows, cand_packed,
         cand = idx < nrows
     d = d & cand
     vary = (axis,) if axis is not None else ()
-    ids = K.combine_ids(ids_tuple, strides)
-    if n_values == 0:
+    if nseg:
+        from . import stats_seg as SS
+        seg = ids_tuple[0]
+        base = _seg_base_ids(ids_tuple, strides)
+        nb_base = nb // nseg
+        if axis is None and not seg_pallas:
+            # single-device: the segment-ALIGNED slot grid — each
+            # member reduces only its own padded slots (total work ~the
+            # members' rows, not S * R); bit-identical to the striped
+            # form below
+            if n_values == 0:
+                flat = SS.stats_count_slots(seg_map, base, d, nb_base)
+            else:
+                outs = [K.pack_stats(*SS.stats_values_slots(
+                    v, seg_map, base, d, nb_base))
+                    for v in values_tuple]
+                flat = jnp.stack(outs, axis=0).reshape(-1)
+        elif n_values == 0:
+            # mesh stripes (manual shard_map rows can't gather the
+            # global slot grid) and the VL_PALLAS count variant ride
+            # the row-striped seg kernels
+            flat = SS.stats_count_seg_local(seg, base, d, nseg, nb_base,
+                                            vary_axes=vary,
+                                            use_pallas=seg_pallas)
+            if axis is not None:
+                flat = jax.lax.psum(flat, axis)
+        else:
+            outs = []
+            for v in values_tuple:
+                cnt, sums, lo, hi = SS.stats_values_seg_local(
+                    v, seg, base, d, nseg, nb_base, vary_axes=vary)
+                if axis is not None:
+                    cnt = jax.lax.psum(cnt, axis)
+                    sums = jax.lax.psum(sums, axis)
+                    lo = jax.lax.pmin(lo, axis)
+                    hi = jax.lax.pmax(hi, axis)
+                outs.append(K.pack_stats(cnt, sums, lo, hi))
+            flat = jnp.stack(outs, axis=0).reshape(-1)
+    elif n_values == 0:
+        ids = K.combine_ids(ids_tuple, strides)
         flat = K.stats_count_local(ids, d, nb, vary_axes=vary)
         if axis is not None:
             flat = jax.lax.psum(flat, axis)
     else:
+        ids = K.combine_ids(ids_tuple, strides)
         outs = []
         for v in values_tuple:
             cnt, sums, lo, hi = K.stats_values_local(v, ids, d, nb,
@@ -835,50 +899,59 @@ def _fused_local(prog, strides, nb, n_values, axis, nrows, cand_packed,
 
 @partial(jax.jit, static_argnames=("prog", "strides", "nb", "n_values"))
 def _fused_dispatch(prog, strides, nb, n_values, nrows, cand_packed,
-                    ids_tuple, values_tuple, args):
+                    seg_map, ids_tuple, values_tuple, args):
     """One device call: filter tree -> stats partials (+ packed maybe).
 
-    prog: (tree, rlp, has_maybe, has_cand, arg_rows) — static, hashable;
-    arg_rows marks which leaf args are row-aligned (mesh sharding).
+    prog: (tree, rlp, has_maybe, has_cand, arg_rows[, nseg,
+    seg_pallas]) — static, hashable; arg_rows marks which leaf args are
+    row-aligned (mesh sharding); nseg > 0 marks a packed super-dispatch
+    (seg-major reduction, tpu/stats_seg.py).
     nrows: dynamic scalar (rows < nrows are live when cand_packed is
-    None-shaped); cand_packed: uint8[RLp/8] or zeros(1) when unused.
+    None-shaped); cand_packed: uint8[RLp/8] or zeros(1) when unused;
+    seg_map: the pack's int32[S, Lp] slot grid (zeros(1, 1) stub when
+    nseg == 0).
     Returns (flat, maybe_packed): flat is uint32[nb + 1] for count-only
     or uint32[n_values*7*nb + 1] — the trailing element is the
     maybe-any flag; maybe_packed is uint8[RLp/8] (zeros(1) when the
     program proves no maybe rows exist) and is only worth downloading
     when the flag is nonzero."""
     return _fused_local(prog, strides, nb, n_values, None, nrows,
-                        cand_packed, ids_tuple, values_tuple, args)
+                        cand_packed, seg_map, ids_tuple, values_tuple,
+                        args)
 
 
 @partial(jax.jit, static_argnames=("prog", "strides", "nb", "n_values",
                                    "mesh", "axis"))
 def _fused_dispatch_mesh(mesh, axis, prog, strides, nb, n_values, nrows,
-                         cand_packed, ids_tuple, values_tuple, args):
+                         cand_packed, seg_map, ids_tuple, values_tuple,
+                         args):
     """The fused program under shard_map: each device evaluates the tree
     over its row stripe; stats partials psum/pmin/pmax over ICI; the
     packed maybe-vector concatenates along the row axis.  This is the
     multi-chip product form of the reference's mergeState split
-    (pipe_stats.go:55-60) — one SPMD dispatch, in-network reduction."""
+    (pipe_stats.go:55-60) — one SPMD dispatch, in-network reduction.
+    The seg slot grid is unused here (manual row stripes cannot gather
+    global rows; the striped seg kernels serve) — it ships replicated
+    as an inert operand so the submit path stays uniform."""
     from jax.sharding import PartitionSpec as P
     has_cand = prog[3]
     arg_rows = prog[4]
     # roles are explicit: the planner marked row-aligned leaf args;
     # ids/values axes are always row-aligned; cand is row-aligned only
     # when a real candidate mask was shipped (else it is a zeros(1) stub)
-    in_specs = (P(), P(axis) if has_cand else P(),
+    in_specs = (P(), P(axis) if has_cand else P(), P(None, None),
                 tuple(P(axis) for _ in ids_tuple),
                 tuple(P(axis) for _ in values_tuple),
                 tuple(P(None, axis) if r == 2 else
                       (P(axis) if r else P()) for r in arg_rows))
 
-    def fn(nrows, cp, ids, vals, leaf_args):
+    def fn(nrows, cp, sm, ids, vals, leaf_args):
         return _fused_local(prog, strides, nb, n_values, axis, nrows,
-                            cp, ids, vals, leaf_args)
+                            cp, sm, ids, vals, leaf_args)
 
     return K.shard_map_fn()(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=(P(), P(axis)))(
-        nrows, cand_packed, ids_tuple, values_tuple, args)
+        nrows, cand_packed, seg_map, ids_tuple, values_tuple, args)
 
 
 # ---------------- residue: host settles the maybe rows ----------------
@@ -1061,21 +1134,33 @@ def fused_stats_submit(runner, f, part, bss, spec, asm):
         return _Ready(({}, handled, []))
 
     cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
+    # prog slots 5/6: segment count of a packed super-dispatch and the
+    # VL_PALLAS gate for the seg-major count kernel — static, so the
+    # jitted program specializes per (pack size, gate) like every other
+    # static knob (stats_seg.py)
+    seg_pallas = bool(asm.nseg) and config.env("VL_PALLAS") == "1"
     prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
-            tuple(planner.arg_rows))
+            tuple(planner.arg_rows), asm.nseg, seg_pallas)
+    seg_map = jnp.zeros((1, 1), dtype=jnp.int32)
+    if asm.nseg:
+        seg_map = runner._stage_seg_slots(part, layout).ids
     values_tuple = tuple(asm.numerics[fld].values
                          for fld in spec.value_fields)
     runner._bump("device_calls")
     runner._bump("stats_dispatches")
     runner._bump("fused_dispatches")
+    runner._bump_max("stats_onehot_width",
+                     asm.nb // max(asm.nseg, 1))
     runner._kind("fused_stats")
+    if asm.nseg:
+        runner._kind("fused_stats_seg")
     if spec.uniq_fields:
         runner._kind("fused_uniq")
     if spec.quantile_fields:
         runner._kind("fused_quantile")
     flat, mp = runner._dispatch_fused(
         prog, asm.strides, asm.nb, len(values_tuple),
-        jnp.int32(layout.nrows), cand_packed, asm.ids_tuple,
+        jnp.int32(layout.nrows), cand_packed, seg_map, asm.ids_tuple,
         values_tuple, tuple(planner.args))
     return _StatsPending(runner, f, part, bss, spec, asm, handled, flat,
                          mp)
@@ -1085,8 +1170,9 @@ def fused_stats_submit(runner, f, part, bss, spec, asm):
 
 # ---------------- fused filter | sort-topk prefilter ----------------
 
-@partial(jax.jit, static_argnames=("prog", "k", "desc"))
-def _topk_dispatch(prog, k, desc, nrows, cand_packed, values, args):
+@partial(jax.jit, static_argnames=("prog", "k", "desc", "nseg"))
+def _topk_dispatch(prog, k, desc, nseg, nrows, cand_packed, seg_ids,
+                   seg_map, values, args):
     """One device call: filter tree -> top-k threshold -> packed row sets.
 
     values: uint32[RLp] offsets from the part's column minimum (the same
@@ -1098,6 +1184,18 @@ def _topk_dispatch(prog, k, desc, nrows, cand_packed, values, args):
     so a part with fewer than k matches degenerates to the full match
     set.  Runs unchanged over mesh-sharded inputs (GSPMD inserts the
     top_k gather; only the packed bits come back).
+
+    nseg > 0: a packed super-dispatch — members gather into their own
+    padded rows of the seg slot grid (seg_map int32[S, Lp], Lp >= k;
+    stats_seg.build_seg_slot_map) and ONE batched lax.top_k over the
+    slot axis yields every member's k-th-best threshold at once, which
+    scatters back per row through seg_ids.  Each member gets exactly
+    the threshold its own single-part dispatch would have computed
+    (padding slots score -1, the same sentinel as non-matches), so the
+    harvested per-member candidate sets are bit-identical to the
+    serial per-part walk — and the k-selection work is the members'
+    own padded slots, LESS than a per-part dispatch's chunk-padded
+    scan.  nseg == 0: seg_ids/seg_map are ignored zeros stubs.
     """
     import jax.numpy as jnp
     tree, _rlp, has_maybe, has_cand = prog[:4]
@@ -1112,25 +1210,41 @@ def _topk_dispatch(prog, k, desc, nrows, cand_packed, values, args):
     v = values.astype(jnp.int32)
     if not desc:
         v = jnp.int32((1 << 31) - 2) - v   # ascending: reverse the order
-    s = jnp.where(d, v, jnp.int32(-1))
-    kv = jax.lax.top_k(s, k)[0][k - 1]
-    out_d = d & (s >= kv)
-    if mv is not None:
-        out_m = mv & (jnp.where(mv, v, jnp.int32(-1)) >= kv)
+    if nseg == 0:
+        s = jnp.where(d, v, jnp.int32(-1))
+        kv = jax.lax.top_k(s, k)[0][k - 1]
+        out_d = d & (s >= kv)
+        if mv is not None:
+            out_m = mv & (jnp.where(mv, v, jnp.int32(-1)) >= kv)
+        else:
+            out_m = jnp.zeros(rl, dtype=bool)
     else:
-        out_m = jnp.zeros(rl, dtype=bool)
+        s = jnp.where(d, v, jnp.int32(-1))
+        safe = jnp.maximum(seg_map, 0)
+        s2 = jnp.where(seg_map >= 0, s[safe], jnp.int32(-1))
+        kv = jax.lax.top_k(s2, k)[0][:, k - 1]       # (S,) thresholds
+        thr = kv[seg_ids.astype(jnp.int32)]          # scatter per row
+        out_d = d & (s >= thr)
+        if mv is not None:
+            out_m = mv & (v >= thr)
+        else:
+            out_m = jnp.zeros(rl, dtype=bool)
     return (jnp.packbits(out_d.astype(jnp.uint8)),
             jnp.packbits(out_m.astype(jnp.uint8)))
 
 
-def try_fused_topk(runner, f, part, bss, spec):
-    """Attempt the filter|sort-topk single-dispatch path for one part.
+def fused_topk_submit(runner, f, part, bss, spec):
+    """Plan + DISPATCH the filter|sort-topk program without
+    materializing anything; returns a pending handle (harvest() ->
+    block_idx -> bitmap, the _FilterPending protocol — maybe rows above
+    threshold settle through the filter's own host predicate), a _Ready
+    result for constant-false trees, or None when the shape declines
+    (caller falls back to ordinary filter evaluation).
 
-    Returns block_idx -> bitmap covering EVERY candidate block (the
-    bitmaps hold exactly the filter-matching rows whose sort key is
-    at-or-above the part's k-th best — a superset of the part's
-    contribution to the global top-k), or None when the shape declines
-    (caller falls back to ordinary filter evaluation)."""
+    part may be a PackedPart (tpu/pipeline.py): its per-row segment ids
+    stage like the stats seg axis and the dispatch k-selects per
+    member, so flush-sized parts under `sort | head` stop paying one
+    dispatch each."""
     import jax.numpy as jnp
     from .stats_device import MAX_ABS_TIMES_ROWS, MAX_STAT_ROWS
     layout = runner._stats_layout(part)
@@ -1142,41 +1256,54 @@ def try_fused_topk(runner, f, part, bss, spec):
         return None
     if sn.vmax - sn.vmin > (1 << 31) - 2:
         return None                # int32 score space
+    k = min(spec.k, layout.nrows_padded)
+    nseg = 0
+    seg_ids = jnp.zeros(1, dtype=jnp.int32)
+    seg_map = jnp.zeros((1, 1), dtype=jnp.int32)
+    if getattr(part, "num_segments", 0) > 1:
+        sg = runner._stage_segments(part, layout)
+        if sg is None:
+            return None
+        nseg = len(sg.values)
+        seg_ids = sg.ids
+        # the slot grid needs >= k slots per member for the batched
+        # k-selection (padding slots carry the -1 sentinel)
+        seg_map = runner._stage_seg_slots(part, layout, min_len=k).ids
     planner = _Planner(runner, part, bss, layout)
     try:
         tree = planner.plan(f)
     except _NoFuse:
         return None
     if tree == ("false",):
-        return {bi: np.zeros(bss[bi].nrows, dtype=bool) for bi in bss}
+        return _Ready({bi: np.zeros(bss[bi].nrows, dtype=bool)
+                       for bi in bss})
 
     cand_packed, has_cand = _stage_cand_mask(runner, part, bss, layout)
     prog = (tree, layout.nrows_padded, planner.has_maybe, has_cand,
             tuple(planner.arg_rows))
-    k = min(spec.k, layout.nrows_padded)
     runner._bump("device_calls")
     runner._bump("topk_dispatches")
-    runner._kind("topk")
+    runner._kind("topk_seg" if nseg else "topk")
     dm, mm = runner._dispatch_topk(
-        prog, k, spec.desc, jnp.int32(layout.nrows), cand_packed,
-        sn.values, tuple(planner.args))
-    dm = np.unpackbits(np.array(dm))[:layout.nrows_padded].astype(bool)
-    mm = np.unpackbits(np.array(mm))[:layout.nrows_padded].astype(bool)
-    bms = {}
-    for bi, bs in bss.items():
-        start = layout.starts[bi]
-        n = bs.nrows
-        bm = dm[start:start + n].copy()
-        sel = mm[start:start + n]
-        if sel.any():
-            # maybe rows above threshold: the filter tree's own host
-            # path decides them (same residue discipline as the fused
-            # stats harvest, _StatsPending)
-            vbm = sel.copy()
-            f.apply_to_block(bs, vbm)
-            bm |= vbm
-        bms[bi] = bm
-    return bms
+        prog, k, spec.desc, nseg, jnp.int32(layout.nrows), cand_packed,
+        seg_ids, seg_map, sn.values, tuple(planner.args))
+    # the maybe vector is only meaningful when the program proved maybe
+    # rows can exist; _FilterPending's harvest applies the same residue
+    # discipline as the fused stats/filter paths
+    return _FilterPending(runner, f, part, bss, layout, dm, mm,
+                          planner.has_maybe)
+
+
+def try_fused_topk(runner, f, part, bss, spec):
+    """Synchronous shim over fused_topk_submit (single-part callers):
+    block_idx -> bitmap covering EVERY candidate block (exactly the
+    filter-matching rows at-or-above the part's k-th best key — a
+    superset of the part's contribution to the global top-k), or None
+    when the shape declines."""
+    pending = fused_topk_submit(runner, f, part, bss, spec)
+    if pending is None:
+        return None
+    return pending.harvest()
 
 
 # ---------------- fused filter-only dispatch (row queries) ----------------

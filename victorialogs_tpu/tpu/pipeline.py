@@ -120,6 +120,36 @@ def pack_limit() -> int:
     return max(1, config.env_int("VL_PACK_PARTS"))
 
 
+def pack_topk_k() -> int:
+    """VL_PACK_TOPK_K: largest `sort ... limit` k eligible for packed
+    sort-topk super-dispatches (0 disables topk packing).  The packed
+    dispatch k-selects per member over the segment slot grid, whose
+    slot axis must hold at least k entries per member — a huge k
+    inflates every member's padded slots, so past this cap the
+    per-part dispatches win."""
+    return max(0, config.env_int("VL_PACK_TOPK_K"))
+
+
+def cross_partition_enabled() -> bool:
+    """VL_CROSS_PARTITION=0 restores the per-partition dispatch window
+    (the pre-PR-15 shape: the window drains at every day boundary)."""
+    return config.env_flag("VL_CROSS_PARTITION")
+
+
+def pack_policy(runner, sort_spec, probe: bool = True):
+    """(packable, pack_max, rows_cap) — THE pack-eligibility rule, in
+    one place for the execution planner (_unit_stream) and the EXPLAIN
+    walk (obs/explain.py), so the displayed pack membership can never
+    diverge from the dispatched one.  Sort-topk shapes pack when their
+    k fits the VL_PACK_TOPK_K cap (the packed dispatch k-selects per
+    member); stats/row shapes pack as before."""
+    pack_max = pack_limit()
+    packable = pack_max > 1 and (
+        sort_spec is None or 0 < sort_spec.k <= pack_topk_k())
+    rows_cap = pack_rows_cap(runner, probe) if packable else 0
+    return packable, pack_max, rows_cap
+
+
 def pack_rows_cap(runner, probe: bool = True) -> int:
     """Parts above this many rows never pack.
 
@@ -350,29 +380,51 @@ def pack_bucket(part) -> int:
     return pad_bucket(max(part.num_rows, 1), minimum=1024)
 
 
+# widest time range one pack may cover: the fused ts staging carries
+# ns offsets from the pack minimum as (hi >> 16) int32 planes, exact
+# only below 2**47 ns (~39h).  Same-day packs never come close; packs
+# spanning a partition boundary (cross-partition window) must split
+# when the data really spans further.
+PACK_TS_SPAN_MAX = 1 << 47
+
+
 def iter_pack_groups(items, packable: bool, pack_max: int,
                      rows_cap: int):
-    """Fold an iterable of pruned (part, candidate-bis) pairs into
-    dispatch-unit groups — THE pack-membership rules, in one place:
-    consecutive small parts (<= rows_cap rows) sharing a padded-row
-    bucket group up to pack_max; everything else is its own unit.  Lazy:
-    pulls from `items` only as groups are consumed, so the execution
-    stream's early exits (limit, deadline) stop the header walk exactly
-    where the serial loop would, and the EXPLAIN pricing pass
-    (obs/explain.py) walks the identical grouping without dispatching."""
+    """Fold an iterable of pruned part items into dispatch-unit groups
+    — THE pack-membership rules, in one place: consecutive small parts
+    (<= rows_cap rows) sharing a padded-row bucket group up to
+    pack_max, provided the group's combined time range stays inside
+    the staging-exact PACK_TS_SPAN_MAX window; everything else is its
+    own unit.  Items are tuples whose first element is the part (the
+    execution stream carries (part, bis, ctx); EXPLAIN carries
+    (part, bis)) — passed through untouched.  Lazy: pulls from `items`
+    only as groups are consumed, so the execution stream's early exits
+    (limit, deadline) stop the header walk exactly where the serial
+    loop would, and the EXPLAIN pricing pass (obs/explain.py) walks
+    the identical grouping without dispatching."""
     group: list = []        # packable run sharing one row bucket
-    for part, bis in items:
+    gmin = gmax = 0         # group's combined time range (ns)
+    for it in items:
+        part = it[0]
         small = packable and part.num_rows <= rows_cap
         if not small:
             if group:
                 yield group
                 group = []
-            yield [(part, bis)]
+            yield [it]
             continue
-        if group and pack_bucket(group[0][0]) != pack_bucket(part):
+        if group and (
+                pack_bucket(group[0][0]) != pack_bucket(part)
+                or max(gmax, part.max_ts) - min(gmin, part.min_ts)
+                >= PACK_TS_SPAN_MAX):
             yield group
             group = []
-        group.append((part, bis))
+        if group:
+            gmin = min(gmin, part.min_ts)
+            gmax = max(gmax, part.max_ts)
+        else:
+            gmin, gmax = part.min_ts, part.max_ts
+        group.append(it)
         if len(group) >= pack_max:
             yield group
             group = []
@@ -380,30 +432,32 @@ def iter_pack_groups(items, packable: bool, pack_max: int,
         yield group
 
 
-def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
-                 sort_spec, token_leaves, check_deadline):
-    """Lazily fold the pruned (part, candidate-bis) stream into dispatch
-    units, in part order.
+def _unit_stream(runner, items, head, stats_spec, sort_spec,
+                 token_leaves, check_deadline):
+    """Lazily fold the pruned part stream into dispatch units, in part
+    order.  `items` yields (part, cand_fn, ctx) — the cross-partition
+    window feeds parts from EVERY selected partition through one
+    stream (each carrying its partition's SearchContext), so packs may
+    span a day boundary when the members share a pad bucket.
 
     Consecutive parts pack when packing is on, the query shape supports
-    a pack dispatch (sort-topk thresholds are per part, so sort queries
-    never pack), every member is small (pack_rows_cap) and the members
-    share a padded-row bucket (the shared width/nrows bucketing that
-    keeps the jit cache small keeps pack shapes small too).  Lazy on
-    purpose: a `limit`-style early exit (head.is_done) or a deadline
-    must stop the header walk exactly like the serial loop did — the
-    consumer only pulls the window's lookahead ahead of execution."""
+    a pack dispatch (pack_policy — sort-topk packs under the
+    VL_PACK_TOPK_K cap via the per-member k-selection), every member is
+    small (pack_rows_cap) and the members share a padded-row bucket
+    (the shared width/nrows bucketing that keeps the jit cache small
+    keeps pack shapes small too).  Lazy on purpose: a `limit`-style
+    early exit (head.is_done) or a deadline must stop the header walk
+    exactly like the serial loop did — the consumer only pulls the
+    window's lookahead ahead of execution."""
     from ..engine.block_search import BlockSearch
     from ..engine.searcher import QueryCancelled
     from ..storage.filterbank import (maplet_prune_candidates,
                                       part_aggregate_prunes)
-    pack_max = pack_limit()
-    packable = pack_max > 1 and sort_spec is None
-    rows_cap = pack_rows_cap(runner) if packable else 0
+    packable, pack_max, rows_cap = pack_policy(runner, sort_spec)
 
     def make_unit(group) -> _Unit:
         if len(group) == 1:
-            p, bis = group[0]
+            p, bis, ctx = group[0]
             bss = {}
             blocks = []
             for bi in bis:
@@ -412,10 +466,12 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
                 bss[bi] = bs
                 blocks.append((bi, bs))
             return _Unit(p, bss, [(p, blocks)])
-        pack = _get_pack(runner, [p for p, _b in group])
+        pack = _get_pack(runner, [g[0] for g in group])
+        if len({id(g[2].partition) for g in group}) > 1:
+            runner._bump("cross_partition_packs")
         bss = {}
         members = []
-        for mi, (p, bis) in enumerate(group):
+        for mi, (p, bis, ctx) in enumerate(group):
             off = pack.block_offset(mi)
             blocks = []
             for bi in bis:
@@ -429,7 +485,7 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
     act = activity.current_activity()
 
     def pruned():
-        for part in parts:
+        for part, cand_fn, ctx in items:
             check_deadline()
             if head.is_done():
                 raise QueryCancelled()
@@ -456,7 +512,7 @@ def _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
             # registry progress at part granularity (the planning pull
             # IS the prune stage, so these land as the walk advances)
             activity.note_part_scanned(act, part, bis)
-            yield part, bis
+            yield part, bis, ctx
 
     for group in iter_pack_groups(pruned(), packable, pack_max,
                                   rows_cap):
@@ -473,10 +529,16 @@ def _submit(runner, f, unit: _Unit, stats_spec, sort_spec, spec_seg):
         return _SingleStats(unit, runner.run_part_stats_submit(
             f, unit.part, unit.bss, stats_spec))
     if sort_spec is not None:
+        if unit.pack:
+            return _submit_pack_topk(runner, f, unit, sort_spec)
+        pending = runner.run_part_topk_submit(f, unit.part, unit.bss,
+                                              sort_spec)
+        if pending is not None:
+            # async: the dispatch stays outstanding in the window like
+            # every other shape (harvest -> block_idx -> bitmap)
+            return _SingleRows(unit, pending)
         part, blocks = unit.members[0]
-        bms = runner.run_part_topk(f, part, unit.bss, sort_spec)
-        if bms is None:
-            bms = runner.run_part(f, part, unit.bss)
+        bms = runner.run_part(f, part, unit.bss)
         return _UnitReady([_Member(part, blocks, bms, set(), [])])
     if unit.pack:
         return _submit_pack_rows(runner, f, unit)
@@ -520,6 +582,40 @@ def _submit_pack_rows(runner, f, unit: _Unit):
     out = []
     for p, blocks in unit.members:
         bms = runner.run_part_submit(f, p, dict(blocks)).harvest()
+        out.append(_Member(p, blocks, bms, set(), []))
+    return _UnitReady(out)
+
+
+def _submit_pack_topk(runner, f, unit: _Unit, sort_spec):
+    """Packed sort-topk super-dispatch: ONE fused dispatch k-selects
+    per member over the concatenated pack (fused._topk_dispatch's
+    segment unroll), so every member's harvested candidate set — and
+    therefore the host sort processor's input, order and ties included
+    — is bit-identical to its own single-part dispatch."""
+    cand_rows = sum(bs.nrows for bs in unit.bss.values())
+    if runner._gate_host(f, unit.part, unit.bss,
+                         stats_rows=max(cand_rows, 1)):
+        runner._bump("gated_host_parts", len(unit.members))
+        return _UnitReady(_host_members(runner, f, unit))
+    pending = None
+    if runner.fused_enabled:
+        from .fused import fused_topk_submit
+        pending = fused_topk_submit(runner, f, unit.part, unit.bss,
+                                    sort_spec)
+    if pending is not None:
+        _count_pack(runner, unit, pending)
+        from .fused import _Ready
+        if not isinstance(pending, _Ready):
+            runner._bump("packed_topk_dispatches")
+        return _PackRows(unit, pending)
+    # decline (non-numeric sort column, unfusable leaf): serial
+    # per-member path — results identical to the unpacked walk
+    out = []
+    for p, blocks in unit.members:
+        mbss = dict(blocks)
+        bms = runner.run_part_topk(f, p, mbss, sort_spec)
+        if bms is None:
+            bms = runner.run_part(f, p, mbss)
         out.append(_Member(p, blocks, bms, set(), []))
     return _UnitReady(out)
 
@@ -577,13 +673,33 @@ def _make_sync(runner):
 def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                       deadline, stats_spec, sort_spec,
                       token_leaves) -> None:
-    """Drive one partition's parts through the async dispatch window.
+    """Drive ONE partition's parts through the async dispatch window
+    (the VL_CROSS_PARTITION=0 compatibility shape: the window drains at
+    the partition boundary).  The default path is scan_device_stream,
+    which engine/searcher feeds with parts from EVERY selected
+    partition so the window never drains between days."""
+    act = activity.current_activity()
+    act.add("parts_total", len(parts))
+    scan_device_stream(((p, cand_fn, ctx) for p in parts), q, head,
+                       runner, needed, deadline, stats_spec, sort_spec,
+                       token_leaves)
+
+
+def scan_device_stream(items, q, head, runner, needed, deadline,
+                       stats_spec, sort_spec, token_leaves) -> None:
+    """Drive a cross-partition part stream through the async dispatch
+    window.
 
     Replaces the serial device walk of engine/searcher._scan_parts:
     candidate pruning and part-aggregate kills are unchanged; submission
     keeps up to VL_INFLIGHT units' dispatches outstanding; harvest is in
     submission order, so downstream block order and stats absorb
-    granularity are identical to the serial path."""
+    granularity are identical to the serial path.  `items` yields
+    (part, cand_fn, ctx) lazily — partitions resolve their stream
+    filters and snapshot their parts only as the planning pull reaches
+    them, so parts from partition N+1 submit while partition N
+    harvests, prefetch depth survives the day boundary, and packs may
+    span it (iter_pack_groups' pad-bucket + time-span rules)."""
     from ..engine.block_result import BlockResult
     from ..engine.searcher import (QueryCancelled, QueryTimeoutError,
                                    _absorb_stats_partials)
@@ -606,7 +722,6 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
         runner._set("inflight_auto_depth", depth)
     sync = _make_sync(runner)
     act = activity.current_activity()
-    act.add("parts_total", len(parts))
     window: deque = deque()
     spec_seg = None
     if stats_spec is not None and pack_limit() > 1 and sort_spec is None:
@@ -632,19 +747,23 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                 sp.add("rows_downloaded", br.nrows)
                 head.write_block(br)
 
-    stream = _unit_stream(runner, parts, head, cand_fn, ctx, stats_spec,
-                          sort_spec, token_leaves, check_deadline)
+    stream = _unit_stream(runner, items, head, stats_spec, sort_spec,
+                          token_leaves, check_deadline)
     lookahead: deque = deque()
     exhausted = False
     prefetched: set = set()
     # prefetch staging mode must match what the units will dispatch:
-    # fused layout staging for stats and (unless the VL_FUSED_FILTER
-    # kill-switch reverts to the per-leaf path) row queries; the sort
-    # shape keeps string staging for its run_part fallback
+    # fused layout staging for stats, for sort-topk (now a fused
+    # async dispatch — packed or single) and (unless the
+    # VL_FUSED_FILTER kill-switch reverts to the per-leaf path) row
+    # queries
     from .fused import fused_filter_enabled
     fused_pf = stats_spec is not None or (
+        sort_spec is not None and runner.fused_enabled) or (
         sort_spec is None and fused_filter_enabled()
         and runner.fused_enabled)
+    sort_field = sort_spec.field if sort_spec is not None and \
+        runner.fused_enabled else None
     psp = tracing.current_span()
     seq = 0
 
@@ -756,7 +875,8 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                                 runner.submit_prefetch(
                                     uj.part, f, stats_spec,
                                     cand_bis=list(uj.bss),
-                                    fused=fused_pf)
+                                    fused=fused_pf,
+                                    sort_field=sort_field)
                     # our own window's depth backpressure is NOT
                     # scheduler wait: drain it untimed first, so the
                     # slot-wait metric means what it says

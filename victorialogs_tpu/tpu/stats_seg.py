@@ -1,0 +1,343 @@
+"""Segment-major stats kernels for packed super-dispatches.
+
+The PR 3 segment axis rode the generic bucket product: a pack of S
+member parts prepended a ``ByKey('seg')`` axis, so the one-hot
+compare-and-reduce in kernels.stats_count_local widened from
+(STATS_CHUNK, buckets) to (STATS_CHUNK, S*buckets) — every chunk's VMEM
+tile and VPU compare count scaled with the pack size, and MAX_BUCKETS
+gated the MULTIPLIED product, so wide group-bys taxed (or declined)
+packing exactly on the shape packing exists for.
+
+This module is the segment-major replacement: the segment axis is
+reduced OUTSIDE the bucket one-hot —
+
+- counts/sums: TWO small one-hots, (C, S) segment membership and
+  (C, buckets) bucket membership, contracted on the row axis as an
+  (S, C) x (C, B) matmul (MXU work; exact — per-chunk cell counts and
+  uint8 plane sums stay < 2**24, the f32 mantissa);
+- min/max: a static per-segment unroll of the classic (C, B) masked
+  reduction (S <= VL_PACK_PARTS, so the unroll is a handful of steps
+  and peak VMEM per step stays (C, B), not (C, S*B)).
+
+The accumulator is the [S, buckets] layout the harvest already decodes
+(the 'seg' axis was FIRST in the by order, so its stride equals the
+base bucket product — the flattened seg-major result is bit-identical
+to what the widened kernel produced), and the per-chunk working-set
+width no longer scales with the pack size.  tpu/batch._assemble_axes
+therefore stops counting the segment axis toward MAX_BUCKETS.
+
+A Pallas variant of the count reduction (the dominant shape: plain
+``count()`` group-bys) is gated behind VL_PALLAS=1 like every Pallas
+kernel in this repo (kernels_pallas.py — never on by default, parity
+checked in a clean subprocess via tests/pallas_check.py); the values
+variant stays jnp until profiled on hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import kernels as K
+from .kernels import STATS_CHUNK, _vary
+from .kernels_pallas import _VMEM, PALLAS_AVAILABLE, pl
+
+# Pallas tile geometry: segments pad to one f32 sublane tile, buckets
+# to the 128-lane vector width (same discipline as kernels_pallas).
+SEG_TILE = 8
+LANE = 128
+
+
+def _onehots(si, bi, mi, segs, buckets):
+    """The two small one-hot operands of the seg-major contraction."""
+    seg1h = (si[:, None] == segs[None, :]) & mi[:, None]      # (C, S)
+    b1h = bi[:, None] == buckets[None, :]                     # (C, B)
+    return seg1h, b1h
+
+
+def stats_count_seg_local(seg_ids: jnp.ndarray, bucket_ids: jnp.ndarray,
+                          mask: jnp.ndarray, nseg: int, nb: int,
+                          vary_axes=(), use_pallas: bool = False,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Masked per-(segment, bucket) row counts, flattened seg-major.
+
+    seg_ids/bucket_ids: int-typed [R] (R a STATS_CHUNK multiple);
+    mask: bool[R] (padding rows False).  Returns uint32[nseg*nb] in the
+    exact order kernels.stats_count_local produced for the widened
+    combined id (seg stride == nb) — the host decode is unchanged."""
+    if use_pallas and PALLAS_AVAILABLE and nseg <= SEG_TILE:
+        return stats_count_seg_pallas(seg_ids, bucket_ids, mask, nseg,
+                                      nb, interpret=interpret)
+    sg = seg_ids.astype(jnp.int32).reshape(-1, STATS_CHUNK)
+    b = bucket_ids.astype(jnp.int32).reshape(-1, STATS_CHUNK)
+    m = mask.reshape(-1, STATS_CHUNK)
+    segs = jnp.arange(nseg, dtype=jnp.int32)
+    buckets = jnp.arange(nb, dtype=jnp.int32)
+
+    def body(acc, xs):
+        si, bi, mi = xs
+        seg1h, b1h = _onehots(si, bi, mi, segs, buckets)
+        # (S, C) x (C, B) matmul: per-chunk cell counts <= STATS_CHUNK
+        # < 2**24, exact in the f32 contraction
+        acc = acc + jnp.einsum("cs,cb->sb", seg1h.astype(jnp.float32),
+                               b1h.astype(jnp.float32)).astype(jnp.uint32)
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        body, _vary(jnp.zeros((nseg, nb), jnp.uint32), vary_axes),
+        (sg, b, m))
+    return acc.reshape(-1)
+
+
+def stats_values_seg_local(values: jnp.ndarray, seg_ids: jnp.ndarray,
+                           bucket_ids: jnp.ndarray, mask: jnp.ndarray,
+                           nseg: int, nb: int, vary_axes=()):
+    """Seg-major count/sum/min/max partials for one uint32 value column.
+
+    Returns (cnt, sums[4, .], lo, hi), each flattened over nseg*nb in
+    seg-major order — drop-in for kernels.stats_values_local over the
+    widened combined id, with the same exactness contract (uint8 byte
+    planes contracted in f32, per-chunk plane sums < 2**24)."""
+    v = values.reshape(-1, STATS_CHUNK)
+    sg = seg_ids.astype(jnp.int32).reshape(-1, STATS_CHUNK)
+    b = bucket_ids.astype(jnp.int32).reshape(-1, STATS_CHUNK)
+    m = mask.reshape(-1, STATS_CHUNK)
+    segs = jnp.arange(nseg, dtype=jnp.int32)
+    buckets = jnp.arange(nb, dtype=jnp.int32)
+    u32max = jnp.uint32(0xFFFFFFFF)
+
+    def body(carry, xs):
+        cnt, sums, lo, hi = carry
+        vi, si, bi, mi = xs
+        seg1h, b1h = _onehots(si, bi, mi, segs, buckets)
+        seg_f = seg1h.astype(jnp.float32)
+        b_f = b1h.astype(jnp.float32)
+        cnt = cnt + jnp.einsum("cs,cb->sb", seg_f,
+                               b_f).astype(jnp.uint32)
+        # four byte planes, each its own (S, C) x (C, B) contraction of
+        # the plane-weighted bucket one-hot — peak working set stays
+        # (C, max(S, B)), never (C, S*B)
+        ps = []
+        for p in range(4):
+            plane = ((vi >> (8 * p)) & 0xFF).astype(jnp.float32)
+            ps.append(jnp.einsum("cs,cb->sb", seg_f,
+                                 b_f * plane[:, None]))
+        sums = sums + jnp.stack(ps, axis=0).astype(jnp.uint32)
+        # min/max: static per-segment unroll of the classic masked
+        # reduction (S <= VL_PACK_PARTS)
+        los = []
+        his = []
+        for s in range(nseg):
+            sel = b1h & seg1h[:, s][:, None]
+            los.append(jnp.min(jnp.where(sel, vi[:, None], u32max),
+                               axis=0))
+            his.append(jnp.max(jnp.where(sel, vi[:, None],
+                                         jnp.uint32(0)), axis=0))
+        lo = jnp.minimum(lo, jnp.stack(los, axis=0))
+        hi = jnp.maximum(hi, jnp.stack(his, axis=0))
+        return (cnt, sums, lo, hi), None
+
+    init = tuple(
+        _vary(a, vary_axes)
+        for a in (jnp.zeros((nseg, nb), jnp.uint32),
+                  jnp.zeros((4, nseg, nb), jnp.uint32),
+                  jnp.full((nseg, nb), u32max),
+                  jnp.zeros((nseg, nb), jnp.uint32)))
+    (cnt, sums, lo, hi), _ = jax.lax.scan(body, init, (v, sg, b, m))
+    return (cnt.reshape(-1), sums.reshape(4, -1), lo.reshape(-1),
+            hi.reshape(-1))
+
+
+# ---------------- slot-map (segment-aligned) kernels ----------------
+#
+# The scan kernels above reduce every segment against every row chunk
+# (the unroll/min-max term costs S passes per chunk), which is what
+# shard_map's manual row stripes require — but a single-device dispatch
+# can do better: gather the pack's rows into a [S, Lp] SEGMENT-ALIGNED
+# grid (members are contiguous row ranges of the pack layout, so the
+# map is a host-built static index table, cached per pack like any
+# staging), then reduce each member against only ITS OWN padded slots.
+# Total reduction work drops from S * R_padded to ~R (the members' own
+# rows), the (S, SLOT_CHUNK, B) one-hot tile matches the classic
+# (STATS_CHUNK, B) footprint, and results stay bit-identical.
+
+SLOT_CHUNK = 1024      # slots per scan step; S*SLOT_CHUNK ~ STATS_CHUNK
+
+
+def pad_slots(n: int, k: int = 0) -> int:
+    """Slot-axis length: a SLOT_CHUNK multiple >= max(n, k, 1) (k: a
+    topk dispatch needs at least k slots per member to select on)."""
+    need = max(n, k, 1)
+    return ((need + SLOT_CHUNK - 1) // SLOT_CHUNK) * SLOT_CHUNK
+
+
+def build_seg_slot_map(part, layout, min_len: int = 0):
+    """int32[S, Lp] row-index table of a packed part: row idx of member
+    s's slot j, -1 on padding slots.  Members occupy contiguous row
+    ranges of the pack layout (blocks concatenate in member order), so
+    the table is pure host arithmetic over the block map."""
+    import numpy as np
+    nseg = part.num_segments
+    starts = []
+    lens = []
+    for mi in range(nseg):
+        first = part.block_offset(mi)
+        nxt = part.block_offset(mi + 1) if mi + 1 < nseg else \
+            part.num_blocks
+        starts.append(layout.starts[first])
+        lens.append(sum(part.block_rows(bi) for bi in range(first,
+                                                            nxt)))
+    lp = pad_slots(max(lens), min_len)
+    idx = np.full((nseg, lp), -1, dtype=np.int32)
+    for mi, (st, ln) in enumerate(zip(starts, lens)):
+        idx[mi, :ln] = np.arange(st, st + ln, dtype=np.int32)
+    return idx
+
+
+def _slot_gather(seg_map, arr, fill=None):
+    """arr[seg_map] with -1 slots masked (bool arrs -> False)."""
+    valid = seg_map >= 0
+    safe = jnp.maximum(seg_map, 0)
+    got = arr[safe]
+    if fill is None:
+        return got, valid
+    return jnp.where(valid, got, fill), valid
+
+
+def stats_count_slots(seg_map, bucket_ids, mask, nb: int):
+    """Seg-major masked counts via the slot grid; uint32[S*nb]."""
+    s, _lp = seg_map.shape
+    b2, valid = _slot_gather(seg_map, bucket_ids.astype(jnp.int32))
+    m2 = mask[jnp.maximum(seg_map, 0)] & valid
+    buckets = jnp.arange(nb, dtype=jnp.int32)
+    bc = jnp.moveaxis(b2.reshape(s, -1, SLOT_CHUNK), 1, 0)
+    mc = jnp.moveaxis(m2.reshape(s, -1, SLOT_CHUNK), 1, 0)
+
+    def body(acc, xs):
+        bi, mi = xs
+        oh = (bi[:, :, None] == buckets[None, None, :]) \
+            & mi[:, :, None]
+        return acc + jnp.sum(oh.astype(jnp.uint32), axis=1), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((s, nb), jnp.uint32),
+                          (bc, mc))
+    return acc.reshape(-1)
+
+
+def stats_values_slots(values, seg_map, bucket_ids, mask, nb: int):
+    """Seg-major count/sum/min/max via the slot grid — each member
+    reduces only its own slots; exactness contract as the scan form
+    (per-cell plane sums <= 255 * SLOT_CHUNK < 2**24 in f32)."""
+    s, _lp = seg_map.shape
+    safe = jnp.maximum(seg_map, 0)
+    valid = seg_map >= 0
+    v2 = values[safe]
+    b2 = bucket_ids.astype(jnp.int32)[safe]
+    m2 = mask[safe] & valid
+    buckets = jnp.arange(nb, dtype=jnp.int32)
+    u32max = jnp.uint32(0xFFFFFFFF)
+    vc = jnp.moveaxis(v2.reshape(s, -1, SLOT_CHUNK), 1, 0)
+    bc = jnp.moveaxis(b2.reshape(s, -1, SLOT_CHUNK), 1, 0)
+    mc = jnp.moveaxis(m2.reshape(s, -1, SLOT_CHUNK), 1, 0)
+
+    def body(carry, xs):
+        cnt, sums, lo, hi = carry
+        vi, bi, mi = xs                              # (S, CL) each
+        oh = (bi[:, :, None] == buckets[None, None, :]) \
+            & mi[:, :, None]                         # (S, CL, B)
+        cnt = cnt + jnp.sum(oh.astype(jnp.uint32), axis=1)
+        ohf = oh.astype(jnp.float32)
+        ps = []
+        for p in range(4):
+            plane = ((vi >> (8 * p)) & 0xFF).astype(jnp.float32)
+            ps.append(jnp.einsum("sc,scb->sb", plane, ohf))
+        sums = sums + jnp.stack(ps, axis=0).astype(jnp.uint32)
+        lo = jnp.minimum(lo, jnp.min(
+            jnp.where(oh, vi[:, :, None], u32max), axis=1))
+        hi = jnp.maximum(hi, jnp.max(
+            jnp.where(oh, vi[:, :, None], jnp.uint32(0)), axis=1))
+        return (cnt, sums, lo, hi), None
+
+    init = (jnp.zeros((s, nb), jnp.uint32),
+            jnp.zeros((4, s, nb), jnp.uint32),
+            jnp.full((s, nb), u32max),
+            jnp.zeros((s, nb), jnp.uint32))
+    (cnt, sums, lo, hi), _ = jax.lax.scan(body, init, (vc, bc, mc))
+    return (cnt.reshape(-1), sums.reshape(4, -1), lo.reshape(-1),
+            hi.reshape(-1))
+
+
+# ---------------- Pallas count variant (VL_PALLAS gate) ----------------
+
+def _count_seg_kernel(seg_ref, b_ref, m_ref, out_ref, *, nseg: int,
+                      nbp: int):
+    """One (STATS_CHUNK, 1) id-column tile: both one-hots built from
+    broadcast iotas (dense VPU compares, no gather) and contracted on
+    the MXU; the [SEG_TILE, nbp] accumulator lives in the revisited
+    output block (same multi-step accumulation discipline as
+    kernels_pallas, init on the first grid step)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    sg = seg_ref[:, :]                         # int32[C, 1]
+    bi = b_ref[:, :]
+    mi = m_ref[:, :]                           # int32[C, 1] 0/1
+    c = sg.shape[0]
+    seg_iota = jax.lax.broadcasted_iota(jnp.int32, (c, SEG_TILE), 1)
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (c, nbp), 1)
+    # padding segments/buckets never match a real id: rows land only in
+    # their own (segment, bucket) cell, mask zeroes dead rows
+    seg1h = ((sg == seg_iota) & (mi > 0)).astype(jnp.float32)
+    b1h = (bi == b_iota).astype(jnp.float32)
+    out_ref[:, :] += jax.lax.dot_general(
+        seg1h, b1h, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("nseg", "nb", "interpret"))
+def stats_count_seg_pallas(seg_ids, bucket_ids, mask, nseg: int,
+                           nb: int, interpret: bool = False):
+    """Pallas seg-major count; bit-identical to the jnp path (padded
+    segments/buckets reduce to zero and are sliced off)."""
+    r = seg_ids.shape[0]
+    g = r // STATS_CHUNK
+    nbp = ((nb + LANE - 1) // LANE) * LANE
+    sg = seg_ids.astype(jnp.int32).reshape(r, 1)
+    b = bucket_ids.astype(jnp.int32).reshape(r, 1)
+    m = mask.astype(jnp.int32).reshape(r, 1)
+
+    def spec(block, index_map):
+        if interpret or _VMEM is None:
+            return pl.BlockSpec(block, index_map)
+        return pl.BlockSpec(block, index_map, memory_space=_VMEM)
+
+    kernel = partial(_count_seg_kernel, nseg=nseg, nbp=nbp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            spec((STATS_CHUNK, 1), lambda i: (i, 0)),
+            spec((STATS_CHUNK, 1), lambda i: (i, 0)),
+            spec((STATS_CHUNK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=spec((SEG_TILE, nbp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((SEG_TILE, nbp), jnp.float32),
+        interpret=interpret,
+    )(sg, b, m)
+    return out[:nseg, :nb].astype(jnp.uint32).reshape(-1)
+
+
+# ---------------- reference (differential-test oracle) ----------------
+
+def stats_count_seg_reference(seg_ids, bucket_ids, mask, nseg: int,
+                              nb: int) -> jnp.ndarray:
+    """The widened-combined-id formulation this module replaces, kept
+    as the parity oracle: seg stride == nb, one (C, S*B) one-hot."""
+    combined = K.combine_ids(
+        (seg_ids, bucket_ids), (nb, 1))
+    return K.stats_count_local(combined, mask, nseg * nb)
