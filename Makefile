@@ -5,11 +5,20 @@
 
 NATIVE_DIR := victorialogs_tpu/native
 
-.PHONY: all native test race lint bench bench-bloom bench-pipeline \
-	bench-cluster-obs bench-concurrent bench-emit bench-explain \
-	bench-faults bench-journal bench-wire clean
+.PHONY: all native test race lint check help bench bench-bloom \
+	bench-pipeline bench-cluster-obs bench-concurrent bench-emit \
+	bench-explain bench-faults bench-journal bench-wire clean
 
 all: native
+
+help:
+	@echo "victorialogs_tpu targets:"
+	@echo "  make check    pre-push gate: lint + tier-1 suite + race smoke"
+	@echo "  make lint     vlint static analysis + env-table drift + compile sweep"
+	@echo "  make test     full test suite (fail-fast)"
+	@echo "  make race     concurrency suites under both runtime sanitizers"
+	@echo "  make native   build the native host core explicitly"
+	@echo "  make bench-*  recorded performance rounds (see PERF.md)"
 
 native: $(NATIVE_DIR)/libvlnative.so
 
@@ -37,6 +46,14 @@ lint:
 	python -m tools.vlint victorialogs_tpu/
 	python -m tools.vlint --check-env-table
 	python -m compileall -q victorialogs_tpu tools tests
+
+# the single pre-push gate: static analysis (including the v3
+# interprocedural graph passes), the tier-1 suite on the CPU backend,
+# and a race-suite smoke under both runtime sanitizers.  Green here ==
+# safe to push; `make race` remains the full concurrency soak.
+check: lint
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+	VLINT_LOCK_ORDER=1 python -m pytest tests/test_storage_races.py -q
 
 bench:
 	python bench.py

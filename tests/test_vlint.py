@@ -1611,5 +1611,355 @@ def test_checker_module_map_covers_all_ids():
                      ("callable-identity", "balance"),
                      ("metric-double-roll", "registry"),
                      ("env-registry", "registry"),
-                     ("annotation-reason", "core")):
+                     ("annotation-reason", "core"),
+                     ("lock-blocking-deep", "effects"),
+                     ("rpc-under-lock", "effects"),
+                     ("hotpath-sync-deep", "effects"),
+                     ("thread-lifecycle", "effects"),
+                     ("wire-taint", "effects")):
         assert checker_module_for(cid) == mod, cid
+
+
+# ---------------- v3 interprocedural graph passes ----------------
+#
+# The whole-program call graph (tools/vlint/callgraph.py) + effect
+# propagation (tools/vlint/effects.py).  The first two tests pin the
+# ISSUE acceptance fixtures: a >=3-call-deep transitive
+# blocking-under-lock chain and a forged wire offset into frombuffer.
+
+def test_lock_blocking_deep_three_deep_chain():
+    """flush holds the lock and calls _compact -> _rewrite -> _settle
+    -> time.sleep: blocking reachable at depth 3, crossing from the
+    class into module helpers (which the per-file locks checker cannot
+    see through)."""
+    f = lint("""
+        import threading
+        import time
+
+
+        def _settle():
+            time.sleep(0.5)
+
+
+        def _rewrite():
+            _settle()
+
+
+        class Store:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def flush(self):
+                with self._mu:
+                    self._compact()
+
+            def _compact(self):
+                _rewrite()
+    """)
+    deep = [x for x in f if x.checker == "lock-blocking-deep"]
+    assert len(deep) == 1
+    assert deep[0].symbol == "Store.flush"
+    assert "Store._mu" in deep[0].message
+    assert "depth 3" in deep[0].message
+    assert "_rewrite -> _settle" in deep[0].message   # witness chain
+
+
+def test_lock_blocking_deep_annotated():
+    f = lint("""
+        import threading
+        import time
+
+
+        def _settle():
+            time.sleep(0.5)
+
+
+        def _rewrite():
+            _settle()
+
+
+        class Store:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def flush(self):
+                with self._mu:
+                    # vlint: allow-lock-blocking-deep(bounded 0.5s settle)
+                    self._compact()
+
+            def _compact(self):
+                _rewrite()
+    """)
+    assert not [x for x in f if x.checker == "lock-blocking-deep"]
+
+
+def test_lock_blocking_deep_leaves_intraclass_to_locks():
+    """A pure self.m() chain stays the per-file checker's finding —
+    the graph pass must not double-report it."""
+    f = lint("""
+        import threading
+        import time
+
+
+        class Store:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def flush(self):
+                with self._mu:
+                    self._compact()
+
+            def _compact(self):
+                time.sleep(0.5)
+    """)
+    assert [x.checker for x in f] == ["lock-blocking-call"]
+
+
+def test_rpc_under_lease_scope():
+    """The ISSUE fixture: a scheduler dispatch lease held across a
+    cluster RPC through a helper — a slow/partitioned peer now
+    occupies a device slot for the full RPC deadline."""
+    f = lint("""
+        from . import netrobust
+        from ..sched.scheduler import device_slots
+
+
+        def _push(payload):
+            return netrobust.request("POST", "http://n1/x", payload)
+
+
+        def fan_out(payload):
+            with device_slots(1):
+                _push(payload)
+    """, path="victorialogs_tpu/server/mod.py")
+    rpc = [x for x in f if x.checker == "rpc-under-lock"]
+    assert len(rpc) == 1
+    assert rpc[0].symbol == "fan_out"
+    assert "lease:device_slots" in rpc[0].message
+
+
+def test_rpc_under_lock_direct_and_unheld_clean():
+    held = lint("""
+        import threading
+
+        from . import netrobust
+
+
+        class Agg:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def poll(self):
+                with self._mu:
+                    return netrobust.request("GET", "http://n1/x", None)
+    """, path="victorialogs_tpu/server/mod.py")
+    assert [x.checker for x in held] == ["rpc-under-lock"]
+    free = lint("""
+        from . import netrobust
+
+
+        def _push(payload):
+            return netrobust.request("POST", "http://n1/x", payload)
+
+
+        def fan_out(payload):
+            _push(payload)
+    """, path="victorialogs_tpu/server/mod.py")
+    assert not [x for x in free if x.checker == "rpc-under-lock"]
+
+
+def test_thread_lifecycle_orphan_spawn():
+    f = lint("""
+        import threading
+
+
+        def kick(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """)
+    orphan = [x for x in f if x.checker == "thread-lifecycle"]
+    assert len(orphan) == 1 and orphan[0].symbol == "kick"
+    # joined / handed-off spawns are clean
+    for tail in ("    t.join()\n", "    return t\n"):
+        f = lint("import threading\n\n\ndef kick(fn):\n"
+                 "    t = threading.Thread(target=fn)\n"
+                 "    t.start()\n" + tail)
+        assert not [x for x in f if x.checker == "thread-lifecycle"]
+
+
+def test_thread_lifecycle_stored_thread_needs_owner_close():
+    src = """
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """
+    f = lint(src)
+    missing = [x for x in f if x.checker == "thread-lifecycle"]
+    assert len(missing) == 1 and "self._t" in missing[0].message
+    f = lint(textwrap.dedent(src) +
+             "\n    def close(self):\n        self._t.join()\n")
+    assert not [x for x in f if x.checker == "thread-lifecycle"]
+
+
+def test_thread_lifecycle_shutdown_order():
+    """The declared VLServer teardown order (PR 8): usage poller, then
+    journal, then super().close() — any inversion is two findings here
+    (each adjacent pair violated)."""
+    f = lint("""
+        class VLServer:
+            def close(self):
+                super().close()
+                self.journal.close()
+                self.clusterstats.close()
+    """, path="victorialogs_tpu/server/app.py")
+    order = [x for x in f if x.checker == "thread-lifecycle"]
+    assert len(order) == 2
+    assert all("shutdown order" in x.message for x in order)
+
+
+def test_wire_taint_forged_offset_caught():
+    """The ISSUE fixture: a wire-decoded offset flows into frombuffer
+    with no dominating bounds guard — the PR 9/12 forged-frame class."""
+    f = lint("""
+        import struct
+
+        import numpy as np
+
+
+        def parse(buf):
+            (off,) = struct.unpack_from("<I", buf, 0)
+            return np.frombuffer(buf, np.uint8, 16, off)
+    """, path="victorialogs_tpu/server/wire.py")
+    taint = [x for x in f if x.checker == "wire-taint"]
+    assert len(taint) == 1
+    assert "off" in taint[0].message and "guard" in taint[0].message
+
+
+def test_wire_taint_guarded_and_out_of_scope_clean():
+    guarded = """
+        import struct
+
+        import numpy as np
+
+
+        def parse(buf):
+            (off,) = struct.unpack_from("<I", buf, 0)
+            if off > len(buf) - 16:
+                raise ValueError("forged offset")
+            return np.frombuffer(buf, np.uint8, 16, off)
+    """
+    f = lint(guarded, path="victorialogs_tpu/server/wire.py")
+    assert not [x for x in f if x.checker == "wire-taint"]
+    # same unguarded flow OUTSIDE the wire-decode scope: not wire data
+    f = lint("""
+        import struct
+
+        import numpy as np
+
+
+        def parse(buf):
+            (off,) = struct.unpack_from("<I", buf, 0)
+            return np.frombuffer(buf, np.uint8, 16, off)
+    """, path="victorialogs_tpu/tpu/mod.py")
+    assert not [x for x in f if x.checker == "wire-taint"]
+
+
+_GRAPH_A = ("import threading\n\nimport b\n\n\nclass S:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n\n"
+            "    def flush(self):\n        with self._mu:\n"
+            "            b.rewrite()\n")
+_GRAPH_B = ("import time\n\n\ndef settle():\n    time.sleep(1.0)\n\n\n"
+            "def rewrite():\n    settle()\n")
+
+
+def test_graph_pass_parallel_matches_serial(tmp_path):
+    """The graph pass runs once over merged summaries — worker count
+    must not change its findings (cross-FILE chain on purpose)."""
+    (tmp_path / "a.py").write_text(_GRAPH_A)
+    (tmp_path / "b.py").write_text(_GRAPH_B)
+    serial = run_paths([str(tmp_path)], root=str(tmp_path), jobs=1)
+    para = run_paths([str(tmp_path)], root=str(tmp_path), jobs=2)
+    assert [f.render() for f in serial] == [f.render() for f in para]
+    assert any(f.checker == "lock-blocking-deep" for f in serial)
+
+
+def test_graph_cache_unrelated_change_and_path_change(tmp_path):
+    """Graph-pass cache key is the hash of ALL merged summaries: an
+    edit to an unrelated file (same summary) reuses the cached graph
+    findings; an edit to a function ON a reported path re-runs the
+    graph and drops the finding."""
+    (tmp_path / "a.py").write_text(_GRAPH_A)
+    (tmp_path / "b.py").write_text(_GRAPH_B)
+    (tmp_path / "c.py").write_text("x = 1\n")
+    cache = str(tmp_path / "cache.json")
+    first = run_paths([str(tmp_path)], root=str(tmp_path),
+                      cache_path=cache)
+    assert any(f.checker == "lock-blocking-deep" for f in first)
+    import json as _json
+    with open(cache) as fh:
+        got = _json.load(fh)
+    assert got.get("graph", {}).get("findings")
+    # unrelated edit: summaries unchanged -> warm graph equivalence
+    (tmp_path / "c.py").write_text("x = 2\n")
+    warm = run_paths([str(tmp_path)], root=str(tmp_path),
+                     cache_path=cache)
+    assert [f.render() for f in first] == [f.render() for f in warm]
+    # fix the blocking primitive: b.py is on the reported path
+    (tmp_path / "b.py").write_text(
+        "def settle():\n    return 1\n\n\ndef rewrite():\n"
+        "    settle()\n")
+    third = run_paths([str(tmp_path)], root=str(tmp_path),
+                      cache_path=cache)
+    assert not [f for f in third if f.checker == "lock-blocking-deep"]
+
+
+def test_explain_resolves_graph_pass_fingerprint(tmp_path, capsys,
+                                                 monkeypatch):
+    """--explain must find fingerprints minted by the graph passes and
+    cite tools/vlint/effects.py as the checker source."""
+    from tools.vlint.__main__ import main
+    (tmp_path / "a.py").write_text(_GRAPH_A)
+    (tmp_path / "b.py").write_text(_GRAPH_B)
+    monkeypatch.chdir(tmp_path)     # main() resolves modules from cwd
+    rc = main(["--json", "--no-baseline", "--no-cache", "."])
+    import json as _json
+    fnd = _json.loads(capsys.readouterr().out)["findings"]
+    deep = [f for f in fnd if f["checker"] == "lock-blocking-deep"]
+    assert rc == 1 and deep
+    rc = main(["--explain", deep[0]["fingerprint"], "."])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lock-blocking-deep" in out
+    assert "allow-lock-blocking-deep(" in out
+    assert "tools/vlint/effects.py" in out
+
+
+def test_balance_release_through_same_file_helper_clean():
+    """The v3 see-through rule in balance.py: a finally that drains
+    the pair via a same-file helper counts as a guaranteed release."""
+    f = lint("""
+        from victorialogs_tpu.storage.filterbank import (
+            _bank_release, _bank_try_charge)
+
+
+        def _drop(n):
+            _bank_release([n])
+
+
+        def load(n):
+            if not _bank_try_charge(n):
+                return None
+            try:
+                return object()
+            finally:
+                _drop(n)
+    """, path="victorialogs_tpu/storage/mod.py")
+    assert not [x for x in f if x.checker == "balance-unguarded-acquire"]

@@ -78,13 +78,14 @@ def explain(fingerprint: str, paths: list[str]) -> int:
     checker doc and the annotation recipe.  Annotated findings are
     searched too — you can explain a fingerprint somebody else already
     triaged."""
-    from . import core, registry
+    from . import callgraph, core, effects, registry
     from .core import SourceFile, check_annotations
     from .locks import _analyze, check_edge_cycles
 
     matches = []
     all_edges = []
     all_rolls = []
+    summaries = []
     for fp in core.iter_py_files(paths):
         rel = os.path.relpath(fp, ".")
         try:
@@ -98,13 +99,19 @@ def explain(fingerprint: str, paths: list[str]) -> int:
         _, edges, _ = _analyze(sf)
         all_edges.extend(edges)
         all_rolls.extend(registry.collect_roll_sites(sf))
+        summaries.append(callgraph.summarize(sf))
         for f in found:
             if f.fingerprint().startswith(fingerprint):
                 matches.append(f)
     # the cross-file passes produce findings too (lock-order-cycle,
-    # metric-double-roll) — their fingerprints must be explainable
+    # metric-double-roll, and the v3 graph families) — their
+    # fingerprints must be explainable.  Annotations are NOT honoured
+    # here on purpose: already-triaged sites stay explainable, and the
+    # graph pass reruns with empty allow tables to surface them.
+    bare = [dict(s, allows={}, allow_spans=[]) for s in summaries]
     for f in check_edge_cycles(all_edges) + \
-            registry.check_global_rolls(all_rolls):
+            registry.check_global_rolls(all_rolls) + \
+            effects.check_graph(bare, all_edges):
         if f.fingerprint().startswith(fingerprint):
             matches.append(f)
     if not matches:

@@ -145,6 +145,30 @@ def _calls_in(node, names: tuple) -> list[ast.Call]:
     return out
 
 
+def _release_closure(tree, releases: tuple) -> tuple:
+    """The release names plus every same-file function whose body
+    transitively reaches one of them — so a ``finally`` that drains
+    the pair through a helper (``finally: self._cleanup()``) still
+    counts as a guaranteed release.  File-local on purpose: the
+    whole-program effect graph (tools/vlint/effects.py) owns the
+    cross-file version of this question."""
+    calls: dict[str, set] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            calls.setdefault(n.name, set()).update(
+                _call_name(c) for c in ast.walk(n)
+                if isinstance(c, ast.Call))
+    reach = set(releases)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in reach and callees & reach:
+                reach.add(name)
+                changed = True
+    return tuple(reach)
+
+
 def _has_finalize(node, finalizers: tuple) -> bool:
     """A weakref.finalize(obj, <releaser>, ...) registration anywhere
     under `node` — the ownership-transfer form of a guaranteed
@@ -180,6 +204,7 @@ def check(sf: SourceFile) -> list[Finding]:
 def _check_pairs(sf: SourceFile, path: str,
                  pairs: list[Pair]) -> list[Finding]:
     findings: list[Finding] = []
+    closures: dict[tuple, tuple] = {}
 
     # with-item call ids (ctx_only rule) and, per node, the set of
     # opener names of enclosing withs (scope-coverage rule)
@@ -255,11 +280,14 @@ def _check_pairs(sf: SourceFile, path: str,
         cls = class_stack[-1] if class_stack else None
         scope = func if func is not None else sf.tree
         guaranteed = False
-        # try/finally releasing the pair, anywhere in the function
+        # try/finally releasing the pair, anywhere in the function —
+        # directly or through a same-file helper (release closure)
+        if p.releases not in closures:
+            closures[p.releases] = _release_closure(sf.tree, p.releases)
         for n in ast.walk(scope):
             if isinstance(n, ast.Try) and n.finalbody:
                 for fb in n.finalbody:
-                    if _calls_in(fb, p.releases):
+                    if _calls_in(fb, closures[p.releases]):
                         guaranteed = True
         # weakref.finalize registration in the function or its class
         if not guaranteed and p.finalizers:

@@ -1,0 +1,752 @@
+"""vlint v3 per-file extraction: call-graph nodes + effect primitives.
+
+This module is the PER-FILE half of the interprocedural engine (the
+cross-file half — graph resolution, effect fixpoint, and the checkers
+built on them — lives in effects.py).  For one parsed module it
+produces a JSON-serializable **FileSummary**:
+
+- one node per function/method (``qualname`` keyed) recording, with the
+  lock/slot/lease tokens HELD at each site:
+  - outgoing calls as resolvable descriptors
+    (``["local", f]`` / ``["self", m]`` / ``["selfattr", attr, m]`` /
+    ``["var", Type, m]`` / ``["mod", alias, f]`` / ``["meth", m]`` /
+    ``["super", m]``),
+  - blocking primitives (sleep/join/socket/subprocess/fsync/jit
+    dispatch/device sync — the locks.py catalogue, module-wide),
+  - cluster RPC primitives (``netrobust.request``),
+  - jax host-sync primitives (``block_until_ready``/``device_get``),
+  - wire-taint facts: local findings, ``returns_taint``,
+    ``returns_calls`` and guarded-at-source pending sinks;
+- per-class ownership facts: ctor-typed attributes, lock attributes,
+  ``Thread``/executor spawns stored on ``self``, join/shutdown sites,
+  and the intraclass call closure (for owner-close reachability);
+- orphaned local thread/executor spawns;
+- the file's allow-annotation tables, so the cross-file passes can
+  honour ``# vlint: allow-*`` at the reported call site.
+
+Everything in the summary is plain lists/dicts/strings — it is cached
+verbatim by the runner next to the per-file findings, and the graph
+pass re-keys on a hash over all summaries (see core.run_paths).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import _dotted, _module_jit_names, _self_attr
+
+SUMMARY_VERSION = 1
+
+_SPAWN_THREAD = {"Thread"}
+_SPAWN_EXEC = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_JOINERS = {"join", "shutdown", "cancel"}
+_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready",
+                "jax.effects_barrier"}
+_SOCKET_ATTRS = {"recv", "accept", "connect", "sendall"}
+
+# `with <recv>.NAME(...)` openers that confer a held token beyond
+# plain locks: admission slots and scheduler dispatch leases
+_OPENER_TOKENS = {"admit": "slot:admit",
+                  "device_slots": "lease:device_slots"}
+
+# attribute names too generic for the unique-method-name fallback:
+# binding `pool.submit(...)` to some class's submit() would fabricate
+# call edges (and executor-submitted work runs on another thread)
+_GENERIC_METHS = {
+    "append", "add", "get", "put", "pop", "items", "keys", "values",
+    "update", "extend", "read", "write", "close", "open", "send",
+    "split", "strip", "encode", "decode", "format", "copy", "submit",
+    "start", "run", "join", "result", "acquire", "release", "set",
+    "clear", "wait", "notify", "notify_all", "info", "debug",
+    "warning", "error", "exception", "inc", "dec", "observe", "now",
+    "sort", "index", "count", "remove", "insert", "setdefault",
+}
+
+# wire-taint scope: frame decoders + sidecar loaders (the PR 9/12
+# forged-frame class); other struct.unpack users parse self-written
+# files and stay out of scope
+_WIRE_SCOPE = ("/server/", "/storage/filterindex/")
+
+
+def module_of(rel: str) -> str:
+    """Dotted module path for a repo-relative file path."""
+    rel = rel.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def in_wire_scope(path: str) -> bool:
+    return any(s in "/" + path.replace("\\", "/") for s in _WIRE_SCOPE)
+
+
+def _collect_imports(tree: ast.AST, module: str):
+    """(mod_imports, fn_imports): local name -> dotted module, and
+    local name -> [defining module, exported name] for from-imports
+    (which may bind either a submodule or a function — effects.py
+    tries both)."""
+    pkg = module.rsplit(".", 1)[0] if "." in module else ""
+    mod_imports: dict = {}
+    fn_imports: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    mod_imports[a.asname] = a.name
+                else:
+                    root = a.name.split(".")[0]
+                    mod_imports[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            base = ""
+            if node.level:
+                parts = pkg.split(".") if pkg else []
+                keep = len(parts) - (node.level - 1)
+                parts = parts[:keep] if keep >= 0 else []
+                base = ".".join(parts)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                fn_imports[local] = [base, a.name]
+                mod_imports.setdefault(
+                    local, f"{base}.{a.name}" if base else a.name)
+    return mod_imports, fn_imports
+
+
+def _is_lock_ctor(v) -> bool:
+    return isinstance(v, ast.Call) and \
+        _dotted(v.func) in ("threading.Lock", "threading.RLock")
+
+
+def _daemon_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _spawn_kind(v) -> str | None:
+    if not isinstance(v, ast.Call):
+        return None
+    last = _dotted(v.func).split(".")[-1]
+    if last in _SPAWN_THREAD:
+        return "thread"
+    if last in _SPAWN_EXEC:
+        return "executor"
+    return None
+
+
+def _collect_class_facts(cnode: ast.ClassDef) -> dict:
+    """Ownership/lock facts for one class (JSON-ready)."""
+    lock_attrs: list = []
+    pool_attrs: list = []
+    attr_types: dict = {}
+    spawn_attrs: dict = {}
+    for node in ast.walk(cnode):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            v = node.value
+            if _is_lock_ctor(v):
+                if attr not in lock_attrs:
+                    lock_attrs.append(attr)
+            elif isinstance(v, (ast.ListComp, ast.List)):
+                inner = v.elt if isinstance(v, ast.ListComp) else \
+                    (v.elts[0] if v.elts else None)
+                if inner is not None and _is_lock_ctor(inner):
+                    if attr not in lock_attrs:
+                        lock_attrs.append(attr)
+                    if attr not in pool_attrs:
+                        pool_attrs.append(attr)
+            kind = _spawn_kind(v)
+            if kind is not None:
+                spawn_attrs[attr] = [kind, _daemon_kw(v), v.lineno]
+            elif isinstance(v, ast.Call):
+                last = _dotted(v.func).split(".")[-1]
+                if last[:1].isupper() and attr not in attr_types:
+                    attr_types[attr] = last
+    methods = [n.name for n in cnode.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return {"methods": methods, "attr_types": attr_types,
+            "lock_attrs": lock_attrs, "pool_attrs": pool_attrs,
+            "spawn_attrs": spawn_attrs, "joins": [], "self_calls": []}
+
+
+class _FnWalker:
+    """One function/method walk tracking the held token set and
+    recording calls + effect primitives into a node dict."""
+
+    def __init__(self, node: dict, sym: str, cls: dict | None,
+                 cls_name: str, module: str, mod_locks: set,
+                 mod_funcs: set, mod_imports: dict, fn_imports: dict,
+                 jit_names: set):
+        self.node = node
+        self.sym = sym
+        self.cls = cls
+        self.cls_name = cls_name
+        self.module = module
+        self.mod_locks = mod_locks
+        self.mod_funcs = mod_funcs
+        self.mod_imports = mod_imports
+        self.fn_imports = fn_imports
+        self.jit_names = jit_names
+        self.var_types: dict = {}       # local var -> ctor class name
+        self.aliases: dict = {}         # local var -> bound-method desc
+        self.attr_alias: dict = {}      # local var -> self.<attr> copied
+        self.loop_src: dict = {}        # loop var -> self.<attr> iterated
+        self.spawn_locals: dict = {}    # var -> [kind, daemon, line]
+        self.handled_spawns: set = set()
+        self.thread_targets: set = set()
+
+    def prescan(self, fnode) -> None:
+        for n in ast.walk(fnode):
+            if isinstance(n, ast.Call) and _spawn_kind(n) == "thread":
+                for kw in n.keywords:
+                    if kw.arg == "target" and \
+                            isinstance(kw.value, ast.Name):
+                        self.thread_targets.add(kw.value.id)
+
+    # -- held tokens --
+
+    def _held_token(self, expr) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None and \
+                attr in self.cls["lock_attrs"]:
+            return f"lock:{self.cls_name}.{attr}"
+        if isinstance(expr, ast.Subscript):
+            attr = _self_attr(expr.value)
+            if attr is not None and self.cls is not None and \
+                    attr in self.cls["pool_attrs"]:
+                return f"lock:{self.cls_name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return f"lock:{self.module}.{expr.id}"
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            last = f.attr if isinstance(f, ast.Attribute) else \
+                _dotted(f).split(".")[-1]
+            return _OPENER_TOKENS.get(last)
+        return None
+
+    # -- descriptors --
+
+    def _desc(self, func) -> list | None:
+        if isinstance(func, ast.Name):
+            n = func.id
+            if n in self.aliases:
+                return self.aliases[n]
+            if n in self.mod_funcs:
+                return ["local", n]
+            if n in self.fn_imports:
+                return ["mod", n, n]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        m = func.attr
+        base = func.value
+        if isinstance(base, ast.Call) and _dotted(base.func) == "super":
+            return ["super", m]
+        a = _self_attr(base)
+        if a is not None:
+            return ["selfattr", a, m]
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ["self", m]
+            if base.id in self.var_types:
+                return ["var", self.var_types[base.id], m]
+            if base.id in self.mod_imports:
+                return ["mod", base.id, m]
+        if m in _GENERIC_METHS:
+            return None
+        return ["meth", m]
+
+    def _is_rpc(self, func) -> bool:
+        if isinstance(func, ast.Attribute) and func.attr == "request":
+            return _dotted(func.value).split(".")[-1] == "netrobust"
+        if isinstance(func, ast.Name) and func.id == "request":
+            return self.fn_imports.get("request", ["", ""])[0] \
+                .endswith("netrobust")
+        return False
+
+    def _blocking_desc(self, call: ast.Call) -> str | None:
+        func = call.func
+        name = _dotted(func)
+        if name == "open":
+            return "open()"
+        if name in ("os.fsync", "os.replace", "time.sleep"):
+            return f"{name}()"
+        root = name.split(".")[0] if name else ""
+        if root in ("subprocess", "shutil"):
+            return f"{name}()"
+        if name.endswith("urlopen"):
+            return "urlopen()"
+        if name in self.jit_names:
+            return f"jit dispatch {name}()"
+        if name in _SYNC_DOTTED:
+            return f"device sync {name}()"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return "device sync .block_until_ready()"
+            if func.attr == "result":
+                return ".result()"
+            if func.attr == "join" and len(call.args) < 2 and \
+                    not isinstance(func.value, ast.Constant) and \
+                    not _dotted(func).startswith("os.path."):
+                return ".join()"
+            if func.attr == "get" and \
+                    "queue" in _dotted(func.value).lower():
+                return "queue.get()"
+            if func.attr in _SOCKET_ATTRS and \
+                    isinstance(func.value, (ast.Name, ast.Attribute)):
+                return f"socket .{func.attr}()"
+        return None
+
+    def _sync_desc(self, call: ast.Call) -> str | None:
+        name = _dotted(call.func)
+        if name in _SYNC_DOTTED:
+            return f"{name}()"
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "block_until_ready":
+            return ".block_until_ready()"
+        return None
+
+    # -- the walk --
+
+    def visit(self, node, held: frozenset) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._one(child, held)
+
+    def _one(self, node, held: frozenset) -> None:
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure handed to Thread(target=...) runs on another
+            # thread — not part of this node's synchronous effects.
+            # Every other nested def (executor fan-out workers the
+            # encloser waits on, retry bodies, callbacks) folds into
+            # the encloser: its RPC/blocking effects happen while the
+            # caller's locks are the ones that matter.
+            if node.name in self.thread_targets:
+                return
+            self.visit(node, held)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            add = []
+            for item in node.items:
+                tok = self._held_token(item.context_expr)
+                if tok is not None:
+                    add.append(tok)
+                if isinstance(item.context_expr, ast.Call) and \
+                        _spawn_kind(item.context_expr) is not None:
+                    # with-scoped executor: joined on exit by contract
+                    pass
+                self._one(item.context_expr, held)
+            inner = held | frozenset(add)
+            for stmt in node.body:
+                self._one(stmt, inner)
+            return
+        if isinstance(node, ast.For):
+            a = _self_attr(node.iter)
+            if a is not None and isinstance(node.target, ast.Name):
+                self.loop_src[node.target.id] = a
+            self.visit(node, held)
+            return
+        if isinstance(node, ast.Assign):
+            self._assign(node, held)
+            return
+        if isinstance(node, ast.Return):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in self.spawn_locals:
+                self.handled_spawns.add(node.value.id)
+            self.visit(node, held)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            for child in ast.iter_child_nodes(node):
+                self._one(child, held)
+            return
+        self.visit(node, held)
+
+    def _assign(self, node: ast.Assign, held: frozenset) -> None:
+        v = node.value
+        kind = _spawn_kind(v)
+        single = node.targets[0] if len(node.targets) == 1 else None
+        if isinstance(single, ast.Name):
+            if kind is not None:
+                self.spawn_locals[single.id] = \
+                    [kind, _daemon_kw(v), v.lineno]
+            elif isinstance(v, ast.Call):
+                last = _dotted(v.func).split(".")[-1]
+                if last[:1].isupper():
+                    self.var_types[single.id] = last
+            elif isinstance(v, ast.Attribute):
+                a = _self_attr(v)
+                if a is not None:
+                    if self.cls is not None and \
+                            a in self.cls["methods"]:
+                        self.aliases[single.id] = ["self", a]
+                    else:
+                        # pool = self._pool (handoff before close)
+                        self.attr_alias[single.id] = a
+                elif _self_attr(v.value) is not None:
+                    # f = self.attr.m — bound-method alias
+                    self.aliases[single.id] = \
+                        ["selfattr", _self_attr(v.value), v.attr]
+        elif isinstance(single, ast.Tuple) and \
+                isinstance(v, ast.Tuple) and \
+                len(single.elts) == len(v.elts):
+            # pool, self._pool = self._pool, None — swap-out handoff
+            for t, e in zip(single.elts, v.elts):
+                a = _self_attr(e)
+                if isinstance(t, ast.Name) and a is not None:
+                    self.attr_alias[t.id] = a
+        if v is not None:
+            self._one(v, held)
+
+    def _call(self, call: ast.Call, held: frozenset) -> None:
+        func = call.func
+        hl = sorted(held)
+        line = call.lineno
+        # spawn var escaping as an argument = ownership transferred
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(a, ast.Name) and a.id in self.spawn_locals:
+                self.handled_spawns.add(a.id)
+        if isinstance(func, ast.Attribute):
+            m = func.attr
+            recv_self = _self_attr(func.value)
+            recv_name = func.value.id \
+                if isinstance(func.value, ast.Name) else None
+            if m in _JOINERS:
+                if recv_self is not None and self.cls is not None:
+                    self.cls["joins"].append([recv_self, self.sym])
+                if recv_name is not None:
+                    if recv_name in self.spawn_locals:
+                        self.handled_spawns.add(recv_name)
+                    src = self.loop_src.get(recv_name) or \
+                        self.attr_alias.get(recv_name)
+                    if src is not None and self.cls is not None:
+                        self.cls["joins"].append([src, self.sym])
+            if m == "append" and recv_self is not None and \
+                    self.cls is not None and call.args and \
+                    isinstance(call.args[0], ast.Name) and \
+                    call.args[0].id in self.spawn_locals:
+                # self.<container>.append(t): the container owns it
+                sp = self.spawn_locals[call.args[0].id]
+                self.cls["spawn_attrs"].setdefault(recv_self, sp)
+                self.handled_spawns.add(call.args[0].id)
+            if m == "start" and isinstance(func.value, ast.Call) and \
+                    _spawn_kind(func.value) is not None:
+                # Thread(...).start() — never bound to a name
+                self.node["local_spawns"].append(
+                    ["thread", _daemon_kw(func.value), line])
+        if self._is_rpc(func):
+            self.node["rpc"].append([hl, line])
+            return
+        b = self._blocking_desc(call)
+        if b is not None:
+            self.node["blocking"].append([b, hl, line])
+        s = self._sync_desc(call)
+        if s is not None:
+            self.node["sync"].append([s, hl, line])
+        if _spawn_kind(call) is None:
+            d = self._desc(func)
+            if d is not None and ["self", self.sym] != d:
+                self.node["calls"].append([d, hl, line])
+
+    def finish(self) -> None:
+        for var, (kind, daemon, line) in sorted(
+                self.spawn_locals.items()):
+            if var not in self.handled_spawns:
+                self.node["local_spawns"].append([kind, daemon, line])
+
+
+# ---------------- wire-taint (file-local dataflow) ----------------
+
+_ALLOC_CALLS = {"np.zeros", "np.empty", "np.full", "bytearray"}
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _TaintPass:
+    """Per-function taint flow: integers unpacked from wire payloads
+    (struct.unpack/_from over frame/sidecar bytes) reaching frombuffer
+    count/offset, alloc sizes, or index/slice bounds without a
+    DOMINATING bounds guard (any Compare — or min/max clamp — at an
+    earlier line mentioning the value or anything sharing a taint
+    root with it).  Calls whose results feed a sink unguarded are
+    recorded as PENDING sinks keyed by the callee descriptor; effects
+    fires them once the returns-taint fixpoint proves the callee
+    returns wire-derived data."""
+
+    def __init__(self, walker: _FnWalker):
+        self.w = walker
+        self.roots: dict = {}          # var -> frozenset of taint roots
+        self.call_origin: dict = {}    # var -> [desc, line]
+        self.guard_lines: dict = {}    # name -> [lineno...]
+        self.sinks: list = []          # (var, sinkdesc, line)
+
+    def _roots_of(self, expr) -> frozenset:
+        out: set = set()
+        for n in _names_in(expr):
+            out |= self.roots.get(n, frozenset())
+        return frozenset(out)
+
+    def run(self, fnode) -> None:
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Assign):
+                self._assign(node)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                r = self._roots_of(node.value) | \
+                    self.roots.get(node.target.id, frozenset())
+                if r:
+                    self.roots[node.target.id] = frozenset(r)
+            elif isinstance(node, ast.Compare):
+                for n in _names_in(node):
+                    self.guard_lines.setdefault(n, []).append(node.lineno)
+            elif isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Subscript):
+                self._subscript(node)
+
+    def _assign(self, node: ast.Assign) -> None:
+        v = node.value
+        targets: list = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(e.id for e in t.elts
+                               if isinstance(e, ast.Name))
+        if not targets:
+            return
+        if isinstance(v, ast.Call):
+            d = _dotted(v.func)
+            if d in ("struct.unpack", "struct.unpack_from"):
+                for t in targets:
+                    self.roots[t] = frozenset([t])
+                return
+            if d.split(".")[-1] in ("min", "max") and d in ("min", "max"):
+                # clamp: result is bounded; clamped args count guarded
+                for n in _names_in(v):
+                    self.guard_lines.setdefault(n, []).append(v.lineno)
+                return
+            if d in ("int", "abs"):
+                r = self._roots_of(v)
+                if r:
+                    for t in targets:
+                        self.roots[t] = r
+                return
+            desc = self.w._desc(v.func)
+            if desc is not None and len(targets) == 1:
+                self.call_origin[targets[0]] = [desc, v.lineno]
+            return
+        r = self._roots_of(v)
+        if r:
+            for t in targets:
+                self.roots[t] = r
+        elif len(targets) == 1 and isinstance(v, ast.Name) and \
+                v.id in self.call_origin:
+            self.call_origin[targets[0]] = self.call_origin[v.id]
+
+    def _call(self, call: ast.Call) -> None:
+        d = _dotted(call.func)
+        last = d.split(".")[-1]
+        if last == "frombuffer":
+            for a in call.args[1:]:
+                self._sink_arg(a, "frombuffer count/offset", call.lineno)
+            for kw in call.keywords:
+                if kw.arg in ("count", "offset"):
+                    self._sink_arg(kw.value, f"frombuffer {kw.arg}",
+                                   call.lineno)
+        elif d in _ALLOC_CALLS or last in ("zeros", "empty", "full") \
+                and d.startswith(("np.", "numpy.")):
+            if call.args:
+                self._sink_arg(call.args[0], f"{last}() size",
+                               call.lineno)
+        elif d in ("min", "max"):
+            for n in _names_in(call):
+                self.guard_lines.setdefault(n, []).append(call.lineno)
+
+    def _subscript(self, node: ast.Subscript) -> None:
+        sl = node.slice
+        parts = []
+        if isinstance(sl, ast.Slice):
+            parts = [p for p in (sl.lower, sl.upper) if p is not None]
+        elif isinstance(sl, ast.Tuple):
+            parts = list(sl.elts)
+        else:
+            parts = [sl]
+        for p in parts:
+            if isinstance(p, ast.Slice):
+                parts.extend(q for q in (p.lower, p.upper)
+                             if q is not None)
+                continue
+            if isinstance(p, ast.Name):
+                self._sink_arg(p, "index/slice bound", node.lineno)
+
+    def _sink_arg(self, expr, what: str, line: int) -> None:
+        if not isinstance(expr, ast.Name):
+            # composite sink expr: any tainted name inside it sinks
+            for n in sorted(_names_in(expr)):
+                if self.roots.get(n):
+                    self.sinks.append((n, what, line))
+            return
+        if self.roots.get(expr.id) or expr.id in self.call_origin:
+            self.sinks.append((expr.id, what, line))
+
+    def _guarded(self, var: str, line: int) -> bool:
+        mine = self.roots.get(var, frozenset([var]))
+        for name, lines in self.guard_lines.items():
+            if not any(ln < line for ln in lines):
+                continue
+            if name == var:
+                return True
+            other = self.roots.get(name, frozenset())
+            if mine & other:
+                return True
+        return False
+
+    def findings(self, path: str, sym: str):
+        """(local findings, pending sinks) after the walk."""
+        out: list = []
+        pending: list = []
+        seen: set = set()
+        for var, what, line in self.sinks:
+            if (var, what, line) in seen or self._guarded(var, line):
+                continue
+            seen.add((var, what, line))
+            if self.roots.get(var):
+                out.append(Finding(
+                    "wire-taint", path, line, sym,
+                    f"wire-derived value `{var}` reaches {what} "
+                    f"without a dominating bounds guard — validate "
+                    f"against the payload length first (forged-frame "
+                    f"hardening)"))
+            else:
+                pending.append([self.call_origin[var][0], var, what,
+                                line])
+        return out, pending
+
+    def return_taint(self, fnode):
+        """(returns_taint, returns_calls) over the function's returns."""
+        taints = False
+        calls: list = []
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if self._roots_of(node.value):
+                taints = True
+            v = node.value
+            if isinstance(v, ast.Name) and v.id in self.call_origin:
+                calls.append(self.call_origin[v.id][0])
+            elif isinstance(v, ast.Call):
+                d = self.w._desc(v.func)
+                if d is not None:
+                    calls.append(d)
+        return taints, calls
+
+
+# ---------------- summary assembly ----------------
+
+def _new_node(line: int, cls: str) -> dict:
+    return {"line": line, "cls": cls, "calls": [], "blocking": [],
+            "rpc": [], "sync": [], "local_spawns": [],
+            "returns_taint": False, "returns_calls": [],
+            "pending_sinks": []}
+
+
+def _analyze(sf: SourceFile) -> dict:
+    """Build (and memoize) the FileSummary for one parsed module."""
+    if hasattr(sf, "_vlint_graph"):
+        return sf._vlint_graph
+    module = module_of(sf.path)
+    mod_imports, fn_imports = _collect_imports(sf.tree, module)
+    jit_names = _module_jit_names(sf.tree)
+    wire = in_wire_scope(sf.path)
+
+    mod_funcs: set = set()
+    mod_locks: set = set()
+    classes: dict = {}
+    body = sf.tree.body if isinstance(sf.tree, ast.Module) else []
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod_funcs.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = _collect_class_facts(node)
+        elif isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod_locks.add(t.id)
+
+    functions: dict = {}
+    taint_findings: list = []
+
+    def visit_fn(fnode, qual: str, cls: dict | None, cls_name: str):
+        nd = _new_node(fnode.lineno, cls_name)
+        w = _FnWalker(nd, qual, cls, cls_name, module, mod_locks,
+                      mod_funcs, mod_imports, fn_imports, jit_names)
+        w.prescan(fnode)
+        w.visit(fnode, frozenset())
+        w.finish()
+        if cls is not None:
+            meth = qual.split(".")[-1]
+            for d, _h, _ln in nd["calls"]:
+                if d[0] == "self":
+                    cls["self_calls"].append([meth, d[1]])
+        if wire:
+            tp = _TaintPass(w)
+            tp.run(fnode)
+            found, pending = tp.findings(sf.path, qual)
+            taint_findings.extend(found)
+            nd["pending_sinks"] = pending
+            nd["returns_taint"], nd["returns_calls"] = \
+                tp.return_taint(fnode)
+        functions[qual] = nd
+
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit_fn(node, node.name, None, "")
+        elif isinstance(node, ast.ClassDef):
+            ci = classes[node.name]
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    visit_fn(sub, f"{node.name}.{sub.name}",
+                             ci, node.name)
+
+    summary = {
+        "version": SUMMARY_VERSION,
+        "path": sf.path,
+        "module": module,
+        "mod_imports": mod_imports,
+        "fn_imports": fn_imports,
+        "functions": functions,
+        "classes": classes,
+        "allows": {str(ln): sorted(ids)
+                   for ln, ids in sf.allows.items()},
+        "allow_spans": [[a, b, sorted(ids)]
+                        for a, b, ids in sf.allow_spans],
+    }
+    sf._vlint_graph = (summary, taint_findings)
+    return sf._vlint_graph
+
+
+def summarize(sf: SourceFile) -> dict:
+    return _analyze(sf)[0]
+
+
+def check(sf: SourceFile) -> list:
+    """The file-LOCAL findings of the v3 engine: direct wire-taint
+    sinks (interprocedural families are emitted by effects.py over the
+    merged summaries)."""
+    return list(_analyze(sf)[1])

@@ -220,11 +220,11 @@ def check_ctx_discipline(sf: "SourceFile", checker: str, ctors: dict,
 
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import (accounting, balance, hotpath, hygiene, leases, locks,
-                   netdiscipline, registry, spans)
+    from . import (accounting, balance, callgraph, hotpath, hygiene,
+                   leases, locks, netdiscipline, registry, spans)
     return [locks.check, hygiene.check, hotpath.check, spans.check,
             accounting.check, leases.check, netdiscipline.check,
-            balance.check, registry.check]
+            balance.check, registry.check, callgraph.check]
 
 
 # checker-id -> implementing module name, for `--explain` doc lookup.
@@ -242,6 +242,9 @@ CHECKER_MODULES = {
     "env-registry": "registry", "metric-registry": "registry",
     "metric-double-roll": "registry", "canonical-helper": "registry",
     "annotation-reason": "core", "syntax-error": "core",
+    "lock-blocking-deep": "effects", "rpc-under-lock": "effects",
+    "hotpath-sync-deep": "effects", "thread-lifecycle": "effects",
+    "wire-taint": "effects",
 }
 
 
@@ -278,10 +281,10 @@ def check_annotations(sf: SourceFile) -> list[Finding]:
     return findings
 
 
-def _check_sf(sf: SourceFile) -> tuple[list, list, list]:
-    """(findings, lock_edges, roll_sites) for one parsed file —
-    annotation-filtered, ready for the global passes."""
-    from . import registry
+def _check_sf(sf: SourceFile) -> tuple[list, list, list, dict]:
+    """(findings, lock_edges, roll_sites, graph_summary) for one
+    parsed file — annotation-filtered, ready for the global passes."""
+    from . import callgraph, registry
     from .locks import _analyze
     findings: list[Finding] = []
     for chk in _checkers():
@@ -293,18 +296,19 @@ def _check_sf(sf: SourceFile) -> tuple[list, list, list]:
     edges = [e for e in edges
              if not sf.allowed("lock-order-cycle", e[3])]
     rolls = registry.collect_roll_sites(sf)
-    return findings, edges, rolls
+    return findings, edges, rolls, callgraph.summarize(sf)
 
 
 def run_source(path: str, text: str, root: str = ".") -> list[Finding]:
     """Run every checker over one in-memory module (test fixtures)."""
-    from . import registry
+    from . import effects, registry
     from .locks import check_edge_cycles
     display = os.path.relpath(path, root) if os.path.isabs(path) else path
     sf = SourceFile.parse(path, text=text, display_path=display)
-    found, edges, rolls = _check_sf(sf)
+    found, edges, rolls, summary = _check_sf(sf)
     found.extend(check_edge_cycles(edges))
     found.extend(registry.check_global_rolls(rolls))
+    found.extend(effects.check_graph([summary], edges))
     found.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
     return found
 
@@ -324,7 +328,7 @@ def run_source(path: str, text: str, root: str = ".") -> list[Finding]:
 
 CACHE_DEFAULT = os.path.join(os.path.dirname(__file__), ".cache.json")
 
-_CACHE_VERSION = 1
+_CACHE_VERSION = 2
 
 
 def _checker_fingerprint() -> str:
@@ -356,13 +360,14 @@ def _check_one_path(args) -> tuple:
         return rel, sha, {"findings": [
             ["syntax-error", rel.replace(os.sep, "/"),
              e.lineno or 0, "", str(e.msg)]],
-            "edges": [], "rolls": []}
-    findings, edges, rolls = _check_sf(sf)
+            "edges": [], "rolls": [], "summary": None}
+    findings, edges, rolls, summary = _check_sf(sf)
     return rel, sha, {
         "findings": [[f.checker, f.path, f.line, f.symbol, f.message]
                      for f in findings],
         "edges": [list(e) for e in edges],
-        "rolls": [list(r) for r in rolls]}
+        "rolls": [list(r) for r in rolls],
+        "summary": summary}
 
 
 def run_paths(paths: list[str], root: str = ".",
@@ -373,7 +378,7 @@ def run_paths(paths: list[str], root: str = ".",
     Annotated sites are dropped here; baseline filtering is the
     caller's job (new_findings).  jobs > 1 fans cold files over a
     process pool; cache_path enables the content-hash result cache."""
-    from . import registry
+    from . import effects, registry
     from .locks import check_edge_cycles
     work = []
     for fp in iter_py_files(paths):
@@ -392,6 +397,7 @@ def run_paths(paths: list[str], root: str = ".",
                 if got.get("version") == _CACHE_VERSION and \
                         got.get("fingerprint") == fingerprint:
                     cache["files"] = got.get("files", {})
+                    cache["graph"] = got.get("graph")
             except (OSError, ValueError):
                 pass
 
@@ -433,7 +439,46 @@ def run_paths(paths: list[str], root: str = ".",
             if cache is not None:
                 cache["files"][rel] = {"sha": sha, "result": result}
 
+    findings: list[Finding] = []
+    all_edges = []
+    all_rolls = []
+    summaries = []
+    for _, rel in work:
+        result = results.get(rel)
+        if result is None:
+            continue
+        for c, p, line, sym, msg in result["findings"]:
+            findings.append(Finding(c, p, line, sym, msg))
+        all_edges.extend(tuple(e) for e in result["edges"])
+        all_rolls.extend(tuple(r) for r in result["rolls"])
+        if result.get("summary") is not None:
+            summaries.append(result["summary"])
+    # the lock-order graph is global: cycles only emerge across files
+    findings.extend(check_edge_cycles(all_edges))
+    # single_roll metrics: double-count sites only emerge across files
+    findings.extend(registry.check_global_rolls(all_rolls))
+    # interprocedural graph passes (effects.py) — keyed by a hash over
+    # every file's summary + the lock edges: an edit that leaves all
+    # summaries identical (comments, unrelated modules outside the
+    # scanned set never even reach here) reuses the cached result, any
+    # summary change re-runs the whole-program analysis
+    graph_key = hashlib.sha1(json.dumps(
+        {"summaries": summaries, "edges": sorted(all_edges)},
+        sort_keys=True).encode("utf-8")).hexdigest()
+    graph_entry = cache.get("graph") if cache else None
+    if graph_entry and graph_entry.get("hash") == graph_key:
+        graph_findings = [Finding(c, p, line, sym, msg)
+                          for c, p, line, sym, msg
+                          in graph_entry["findings"]]
+    else:
+        graph_findings = effects.check_graph(summaries, all_edges)
+    findings.extend(graph_findings)
+
     if cache is not None:
+        cache["graph"] = {
+            "hash": graph_key,
+            "findings": [[f.checker, f.path, f.line, f.symbol,
+                          f.message] for f in graph_findings]}
         # drop only entries whose file vanished from disk — a SCOPED
         # run (one subdir) must not evict the rest of the repo's
         # entries or the next full `make lint` goes cold again
@@ -445,20 +490,5 @@ def run_paths(paths: list[str], root: str = ".",
             json.dump(cache, f)
         os.replace(tmp, cache_path)
 
-    findings: list[Finding] = []
-    all_edges = []
-    all_rolls = []
-    for _, rel in work:
-        result = results.get(rel)
-        if result is None:
-            continue
-        for c, p, line, sym, msg in result["findings"]:
-            findings.append(Finding(c, p, line, sym, msg))
-        all_edges.extend(tuple(e) for e in result["edges"])
-        all_rolls.extend(tuple(r) for r in result["rolls"])
-    # the lock-order graph is global: cycles only emerge across files
-    findings.extend(check_edge_cycles(all_edges))
-    # single_roll metrics: double-count sites only emerge across files
-    findings.extend(registry.check_global_rolls(all_rolls))
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
     return findings

@@ -1,0 +1,591 @@
+"""vlint v3 interprocedural checkers over merged per-file summaries.
+
+callgraph.py extracts one JSON-serializable FileSummary per module;
+this module resolves them into a project-wide call graph, propagates
+per-function effect summaries to a fixpoint (RacerD-style compositional
+analysis: each function's effects are computed once and reused at
+every call site), and emits the graph-pass checker families:
+
+- ``lock-blocking-deep``: a call made while holding a lock whose
+  callee TRANSITIVELY reaches a blocking primitive (sleep, join,
+  socket, subprocess, fsync, jit dispatch, device sync) — the
+  cross-file/cross-class extension of locks.py's lock-blocking-call,
+  which only sees through intraclass ``self.m()`` helpers.  The
+  message carries the witness call chain.
+- ``rpc-under-lock``: a lock, admission slot (``with ...admit(...)``)
+  or scheduler dispatch lease (``with ...device_slots(...)``) held on
+  a path reaching a cluster RPC (``netrobust.request``).  On a
+  combined frontend+storage node the RPC can re-enter this process:
+  if an internal RPC handler acquires the same lock the fan-out
+  deadlocks on itself, so the lock-order graph is augmented with RPC
+  edges (lock -> RPC -> handler-acquired lock) and cycles through the
+  RPC node are reported here.
+- ``hotpath-sync-deep``: a helper called from the TPU pipeline's
+  submit/flush path that host-syncs (``block_until_ready`` /
+  ``jax.device_get``) OUTSIDE the files the per-file hotpath checker
+  scans — the cross-partition dispatch window must stay async.
+- ``thread-lifecycle``: every ``Thread``/executor stored on ``self``
+  needs an owner whose close()/shutdown()/stop() transitively reaches
+  ``.join()``/``.shutdown()`` on it (daemon fire-and-forget threads
+  are exempt — hygiene.py already forces the daemon choice to be
+  explicit); local non-daemon threads must be joined, stored, or
+  handed off before return; executors must be with-scoped, shut down,
+  or returned; the owner-close graph must be acyclic; and declared
+  shutdown orders (``SHUTDOWN_ORDER`` below — the VLServer
+  journal-drains-before-httpd-teardown invariant from PR 8) must hold.
+- ``wire-taint`` (cross-file part): a helper whose RETURN value is
+  wire-derived (struct.unpack over frame/sidecar payloads, propagated
+  through the returns-taint fixpoint) feeding frombuffer/alloc/index
+  sinks in a caller without a dominating bounds guard.  Direct
+  in-function flows are emitted by callgraph.check.
+
+Annotate accepted sites at the REPORTED line:
+``# vlint: allow-<checker>(<why>)``.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import deque
+
+from .core import Finding
+from .locks import _find_cycles
+
+# declared teardown sequences: (class, method, ordered receivers of
+# .close() calls; "__super__" = the super().close() delegation).  The
+# VLServer order is the PR 8 invariant: the usage poller stops first
+# (reads only), the journal drains through self.sink, the sink flushes
+# its spools, and only then may the httpd (super) stop serving.
+SHUTDOWN_ORDER = [
+    ("VLServer", "close", ["clusterstats", "journal", "__super__"]),
+]
+
+_RPC_NODE = "RPC:netrobust.request"
+
+_PIPELINE_RE = re.compile(r"(^|/)tpu/pipeline\.py$")
+_HOTPATH_LOCAL_RE = re.compile(
+    r"(^|/)(tpu|engine)(/|$)|(^|/)obs/explain\.py$"
+    r"|(^|/)storage/filterindex(/|$)")
+_ENTRY_NAME_RE = re.compile(r"submit|flush|drain", re.I)
+_HANDLER_RE = re.compile(r"(^|\.)(handle_)?internal_")
+
+_CLOSERS = {"close", "shutdown", "stop", "__exit__", "finish", "drain"}
+
+
+def _allowed(summary: dict, checker: str, line: int) -> bool:
+    allows = summary.get("allows", {})
+    for ln in (line, line - 1):
+        if checker in allows.get(str(ln), ()):
+            return True
+    for start, end, ids in summary.get("allow_spans", ()):
+        if start <= line <= end and checker in ids:
+            return True
+    return False
+
+
+class _Graph:
+    """Resolved whole-program call graph over FileSummaries."""
+
+    def __init__(self, summaries: list):
+        self.summaries = {s["path"]: s for s in summaries}
+        self.nodes: dict = {}        # nid -> function node dict
+        self.node_sym: dict = {}     # nid -> (path, qual)
+        self.by_module: dict = {}    # module -> {fn: nid}
+        self.by_class: dict = {}     # Class -> [(path, {meth: nid})]
+        meth_index: dict = {}
+        for s in summaries:
+            path, module = s["path"], s["module"]
+            mod_map = self.by_module.setdefault(module, {})
+            cls_maps: dict = {}
+            for qual, nd in s["functions"].items():
+                nid = f"{path}::{qual}"
+                self.nodes[nid] = nd
+                self.node_sym[nid] = (path, qual)
+                if "." not in qual:
+                    mod_map[qual] = nid
+                else:
+                    cls, meth = qual.split(".", 1)
+                    cls_maps.setdefault(cls, {})[meth] = nid
+                    meth_index.setdefault(meth, []).append(nid)
+            for cls, mm in cls_maps.items():
+                self.by_class.setdefault(cls, []).append((path, mm))
+        self.uniq_meth = {m: nids[0] for m, nids in meth_index.items()
+                          if len(nids) == 1}
+        # resolved edges: nid -> [(callee nid, held, line, desc)]
+        self.edges: dict = {}
+        self.redges: dict = {}
+        for nid, nd in self.nodes.items():
+            out = []
+            path, qual = self.node_sym[nid]
+            s = self.summaries[path]
+            for d, held, line in nd["calls"]:
+                callee = self.resolve(s, nd["cls"], d)
+                if callee is not None and callee != nid:
+                    out.append((callee, tuple(held), line, tuple(d)))
+                    self.redges.setdefault(callee, []).append(nid)
+            self.edges[nid] = out
+
+    def _class_meth(self, cls: str, meth: str,
+                    prefer_path: str) -> str | None:
+        cands = self.by_class.get(cls, [])
+        same = [mm for p, mm in cands if p == prefer_path]
+        for mm in same or [mm for _p, mm in cands]:
+            if meth in mm:
+                return mm[meth]
+        return None
+
+    def resolve(self, summary: dict, cls: str, d) -> str | None:
+        kind = d[0]
+        path, module = summary["path"], summary["module"]
+        if kind == "local":
+            nid = self.by_module.get(module, {}).get(d[1])
+            if nid is not None:
+                return nid
+            fi = summary["fn_imports"].get(d[1])
+            if fi is not None:
+                return self.by_module.get(fi[0], {}).get(fi[1])
+            return None
+        if kind == "self":
+            return self._class_meth(cls, d[1], path) if cls else None
+        if kind == "selfattr":
+            if not cls:
+                return None
+            typ = summary["classes"].get(cls, {}) \
+                .get("attr_types", {}).get(d[1])
+            return self._class_meth(typ, d[2], path) if typ else None
+        if kind == "var":
+            return self._class_meth(d[1], d[2], path)
+        if kind == "mod":
+            target = summary["mod_imports"].get(d[1])
+            if target is None:
+                return None
+            nid = self.by_module.get(target, {}).get(d[2])
+            if nid is not None:
+                return nid
+            fi = summary["fn_imports"].get(d[1])
+            if fi is not None and fi[0]:
+                sub = f"{fi[0]}.{fi[1]}"
+                return self.by_module.get(sub, {}).get(d[2])
+            return None
+        if kind == "meth":
+            return self.uniq_meth.get(d[1])
+        return None
+
+    # -- effect propagation --
+
+    def propagate(self, seeds: dict) -> dict:
+        """seeds: nid -> (what, 0, None); returns nid -> (what, depth,
+        via-nid) reverse-BFS closure over call edges."""
+        eff = dict(seeds)
+        q = deque(sorted(seeds))
+        while q:
+            nid = q.popleft()
+            what, depth, _via = eff[nid]
+            for caller in sorted(set(self.redges.get(nid, ()))):
+                if caller not in eff:
+                    eff[caller] = (what, depth + 1, nid)
+                    q.append(caller)
+        return eff
+
+    def chain(self, start: str, eff: dict) -> list:
+        """Witness qualname chain from `start` down to the primitive."""
+        out = [start]
+        nid = start
+        seen = {start}
+        while True:
+            _w, _d, via = eff[nid]
+            if via is None or via in seen:
+                break
+            out.append(via)
+            seen.add(via)
+            nid = via
+        return out
+
+    def qual(self, nid: str) -> str:
+        return self.node_sym[nid][1]
+
+    def path(self, nid: str) -> str:
+        return self.node_sym[nid][0]
+
+
+# ---------------- checkers ----------------
+
+def _lock_names(held) -> list:
+    return sorted(t.split(":", 1)[1] for t in held
+                  if t.startswith("lock:"))
+
+
+def _chain_str(g: _Graph, chain: list) -> str:
+    return " -> ".join(g.qual(n) for n in chain)
+
+
+def _check_blocking_deep(g: _Graph) -> list:
+    seeds = {}
+    for nid, nd in g.nodes.items():
+        if nd["blocking"]:
+            seeds[nid] = (nd["blocking"][0][0], 0, None)
+    eff = g.propagate(seeds)
+    findings = []
+    for nid in sorted(g.nodes):
+        path, qual = g.node_sym[nid]
+        s = g.summaries[path]
+        cls = g.nodes[nid]["cls"]
+        seen = set()
+        for callee, held, line, d in g.edges[nid]:
+            locks = _lock_names(held)
+            if not locks or callee not in eff or (line, callee) in seen:
+                continue
+            seen.add((line, callee))
+            chain = g.chain(callee, eff)
+            if d[0] == "self" and cls and all(
+                    g.path(n) == path
+                    and g.qual(n).startswith(cls + ".")
+                    for n in chain):
+                continue  # intraclass: locks.py lock-blocking-call owns it
+            if _allowed(s, "lock-blocking-deep", line):
+                continue
+            what, depth, _ = eff[callee]
+            prim = chain[-1]
+            findings.append(Finding(
+                "lock-blocking-deep", path, line, qual,
+                f"holding {','.join(locks)}: call {g.qual(callee)}() "
+                f"reaches blocking {what} in {g.qual(prim)} "
+                f"({g.path(prim)}) at depth {depth + 1} "
+                f"via {_chain_str(g, chain)}"))
+    return findings
+
+
+def _handler_locks(g: _Graph) -> set:
+    """Lock tokens acquired anywhere reachable from the internal RPC
+    handlers (server-side entry points of netrobust.request)."""
+    entries = [nid for nid, (path, qual) in g.node_sym.items()
+               if "/server/" in "/" + path and _HANDLER_RE.search(qual)]
+    seen = set(entries)
+    q = deque(entries)
+    toks: set = set()
+    while q:
+        nid = q.popleft()
+        nd = g.nodes[nid]
+        for rec in nd["blocking"] + nd["sync"]:
+            toks.update(t for t in rec[1] if t.startswith("lock:"))
+        for held, _line in nd["rpc"]:
+            toks.update(t for t in held if t.startswith("lock:"))
+        for callee, held, _line, _d in g.edges[nid]:
+            toks.update(t for t in held if t.startswith("lock:"))
+            if callee not in seen:
+                seen.add(callee)
+                q.append(callee)
+    return toks
+
+
+def _check_rpc_under_lock(g: _Graph, lock_edges) -> list:
+    seeds = {nid: ("netrobust.request", 0, None)
+             for nid, nd in g.nodes.items() if nd["rpc"]}
+    eff = g.propagate(seeds)
+    handler = _handler_locks(g)
+    findings = []
+    rpc_edges: set = set()
+
+    def note(held) -> str:
+        both = sorted(set(held) & handler)
+        if both:
+            return (" — an internal RPC handler path acquires "
+                    f"{','.join(_lock_names(both))} too: on a combined "
+                    "frontend+storage node the self-fanout deadlocks")
+        return ""
+
+    for nid in sorted(g.nodes):
+        path, qual = g.node_sym[nid]
+        s = g.summaries[path]
+        nd = g.nodes[nid]
+        for held, line in nd["rpc"]:
+            if not held:
+                continue
+            for lk in _lock_names(held):
+                rpc_edges.add((lk, _RPC_NODE, path, line))
+            if _allowed(s, "rpc-under-lock", line):
+                continue
+            findings.append(Finding(
+                "rpc-under-lock", path, line, qual,
+                f"cluster RPC netrobust.request() while holding "
+                f"{','.join(sorted(held))} — the remote node may be "
+                f"this process{note(held)}"))
+        seen = set()
+        for callee, held, line, _d in g.edges[nid]:
+            if not held or callee not in eff or (line, callee) in seen:
+                continue
+            seen.add((line, callee))
+            chain = g.chain(callee, eff)
+            for lk in _lock_names(held):
+                rpc_edges.add((lk, _RPC_NODE, path, line))
+            if _allowed(s, "rpc-under-lock", line):
+                continue
+            _w, depth, _ = eff[callee]
+            findings.append(Finding(
+                "rpc-under-lock", path, line, qual,
+                f"holding {','.join(sorted(held))}: call "
+                f"{g.qual(callee)}() reaches cluster RPC "
+                f"netrobust.request() at depth {depth + 1} via "
+                f"{_chain_str(g, chain)}{note(held)}"))
+
+    # cross-node deadlock cycles: locks held across the RPC feed the
+    # handler side's acquisitions through the RPC node
+    if rpc_edges:
+        for tok in sorted(handler):
+            rpc_edges.add((_RPC_NODE, tok.split(":", 1)[1],
+                           "<rpc-handler>", 0))
+        graph: dict = {}
+        anchor: dict = {}
+        for a, b, path, line in sorted(set(lock_edges) | rpc_edges):
+            graph.setdefault(a, set()).add(b)
+            anchor.setdefault((a, b), (path, line))
+        for cyc in _find_cycles(graph):
+            if _RPC_NODE not in cyc:
+                continue  # pure lock cycles are lock-order-cycle's job
+            i = cyc.index(_RPC_NODE)
+            prev = cyc[i - 1]
+            path, line = anchor[(prev, _RPC_NODE)]
+            findings.append(Finding(
+                "rpc-under-lock", path, line, "",
+                "lock-order cycle through a cluster RPC (combined-"
+                "node deadlock): " + " -> ".join(cyc + [cyc[0]])))
+    return findings
+
+
+def _check_sync_deep(g: _Graph) -> list:
+    seeds = {}
+    for nid, nd in g.nodes.items():
+        if nd["sync"]:
+            seeds[nid] = (nd["sync"][0][0], 0, None)
+    eff = g.propagate(seeds)
+    findings = []
+    for nid in sorted(g.nodes):
+        path, qual = g.node_sym[nid]
+        if not _PIPELINE_RE.search(path) or \
+                not _ENTRY_NAME_RE.search(qual):
+            continue
+        s = g.summaries[path]
+        seen = set()
+        for callee, _held, line, _d in g.edges[nid]:
+            if callee not in eff or callee in seen:
+                continue
+            seen.add(callee)
+            chain = g.chain(callee, eff)
+            prim = chain[-1]
+            if _HOTPATH_LOCAL_RE.search(g.path(prim)):
+                continue  # hotpath.py flags the primitive site itself
+            if _allowed(s, "hotpath-sync-deep", line):
+                continue
+            what, depth, _ = eff[callee]
+            findings.append(Finding(
+                "hotpath-sync-deep", path, line, qual,
+                f"pipeline submit path: call {g.qual(callee)}() "
+                f"reaches host sync {what} in {g.qual(prim)} "
+                f"({g.path(prim)}) at depth {depth + 1} via "
+                f"{_chain_str(g, chain)} — the dispatch window must "
+                f"stay async"))
+    return findings
+
+
+def _check_thread_lifecycle(g: _Graph) -> list:
+    findings = []
+    for path in sorted(g.summaries):
+        s = g.summaries[path]
+        for cls in sorted(s["classes"]):
+            ci = s["classes"][cls]
+            if not ci["spawn_attrs"]:
+                continue
+            # intraclass reach from the closer methods
+            adj: dict = {}
+            for caller, callee in ci["self_calls"]:
+                adj.setdefault(caller, set()).add(callee)
+            reach = {m for m in ci["methods"] if m in _CLOSERS}
+            q = deque(reach)
+            while q:
+                m = q.popleft()
+                for n in adj.get(m, ()):
+                    if n not in reach:
+                        reach.add(n)
+                        q.append(n)
+            joined = {attr for attr, sym in ci["joins"]
+                      if sym.split(".")[-1] in reach}
+            for attr in sorted(ci["spawn_attrs"]):
+                kind, daemon, line = ci["spawn_attrs"][attr]
+                if kind == "thread" and daemon:
+                    continue  # fire-and-forget by explicit choice
+                if attr in joined:
+                    continue
+                if _allowed(s, "thread-lifecycle", line):
+                    continue
+                want = ".join()" if kind == "thread" else ".shutdown()"
+                findings.append(Finding(
+                    "thread-lifecycle", path, line, cls,
+                    f"{kind} stored on self.{attr} has no owner "
+                    f"shutdown path: no close()/shutdown()/stop() "
+                    f"method reaches self.{attr}{want}"))
+        for qual in sorted(s["functions"]):
+            nd = s["functions"][qual]
+            for kind, daemon, line in nd["local_spawns"]:
+                if kind == "thread" and daemon:
+                    continue
+                if _allowed(s, "thread-lifecycle", line):
+                    continue
+                msg = ("non-daemon thread spawned and orphaned — "
+                       "join it, store it on an owner, or mark it "
+                       "daemon") if kind == "thread" else \
+                      ("executor created without with-scope or "
+                       "shutdown — worker threads leak")
+                findings.append(Finding(
+                    "thread-lifecycle", path, line, qual, msg))
+
+    # owner-close graph: self.attr = OtherClass(...) ownership edges
+    # between spawning/closeable classes must not form a cycle
+    owns: dict = {}
+    anchor: dict = {}
+    for path in sorted(g.summaries):
+        s = g.summaries[path]
+        for cls in sorted(s["classes"]):
+            ci = s["classes"][cls]
+            for attr in sorted(ci["attr_types"]):
+                typ = ci["attr_types"][attr]
+                if typ == cls or typ not in g.by_class:
+                    continue
+                tclosable = any(
+                    m in _CLOSERS
+                    for _p, mm in g.by_class[typ] for m in mm)
+                if tclosable or any(
+                        tc["spawn_attrs"]
+                        for p2 in g.summaries.values()
+                        for c2, tc in p2["classes"].items()
+                        if c2 == typ):
+                    owns.setdefault(cls, set()).add(typ)
+                    anchor.setdefault((cls, typ), (path, attr))
+    for cyc in _find_cycles({a: set(bs) for a, bs in owns.items()}):
+        path, attr = anchor[(cyc[0], cyc[1])]
+        findings.append(Finding(
+            "thread-lifecycle", path, 0, cyc[0],
+            "owner-close cycle (teardown can never complete): "
+            + " -> ".join(cyc + [cyc[0]])
+            + f" (via self.{attr})"))
+
+    # declared shutdown orders
+    for cls, meth, order in SHUTDOWN_ORDER:
+        for path2, mm in g.by_class.get(cls, []):
+            nid = mm.get(meth)
+            if nid is None:
+                continue
+            s = g.summaries[path2]
+            lines: dict = {}
+            for d, _held, line in g.nodes[nid]["calls"]:
+                if d[0] == "selfattr" and d[2] == "close":
+                    lines.setdefault(d[1], line)
+                elif d[0] == "super" and d[1] == meth:
+                    lines.setdefault("__super__", line)
+            prev = None
+            for item in order:
+                ln = lines.get(item)
+                disp = "super().close()" if item == "__super__" \
+                    else f"self.{item}.close()"
+                if ln is None:
+                    findings.append(Finding(
+                        "thread-lifecycle", path2,
+                        g.nodes[nid]["line"], f"{cls}.{meth}",
+                        f"declared shutdown order: {disp} not found "
+                        f"in {cls}.{meth}()"))
+                    continue
+                if prev is not None and ln < prev[1] and \
+                        not _allowed(s, "thread-lifecycle", ln):
+                    findings.append(Finding(
+                        "thread-lifecycle", path2, ln, f"{cls}.{meth}",
+                        f"declared shutdown order violated: {disp} "
+                        f"must run after "
+                        f"{'super().close()' if prev[0] == '__super__' else 'self.' + prev[0] + '.close()'}"))
+                prev = (item, ln)
+    return findings
+
+
+def _check_wire_pending(g: _Graph) -> list:
+    rt = {nid: bool(nd.get("returns_taint"))
+          for nid, nd in g.nodes.items()}
+    changed = True
+    while changed:
+        changed = False
+        for nid, nd in g.nodes.items():
+            if rt[nid]:
+                continue
+            path = g.path(nid)
+            s = g.summaries[path]
+            for d in nd.get("returns_calls", ()):
+                callee = g.resolve(s, nd["cls"], d)
+                if callee is not None and rt.get(callee):
+                    rt[nid] = True
+                    changed = True
+                    break
+    findings = []
+    for nid in sorted(g.nodes):
+        nd = g.nodes[nid]
+        path, qual = g.node_sym[nid]
+        s = g.summaries[path]
+        for d, var, what, line in nd.get("pending_sinks", ()):
+            callee = g.resolve(s, nd["cls"], d)
+            if callee is None or not rt.get(callee):
+                continue
+            if _allowed(s, "wire-taint", line):
+                continue
+            findings.append(Finding(
+                "wire-taint", path, line, qual,
+                f"value `{var}` from {g.qual(callee)}() is "
+                f"wire-derived and reaches {what} without a "
+                f"dominating bounds guard — validate against the "
+                f"payload length first (forged-frame hardening)"))
+    return findings
+
+
+# ---------------- entry points ----------------
+
+def check_graph(summaries: list, lock_edges=()) -> list:
+    """All interprocedural findings over the merged summaries.
+    `lock_edges` are the per-file lock-order edges (a, b, path, line)
+    so RPC-augmented deadlock cycles can be detected."""
+    g = _Graph(summaries)
+    findings = []
+    findings.extend(_check_blocking_deep(g))
+    findings.extend(_check_rpc_under_lock(g, lock_edges))
+    findings.extend(_check_sync_deep(g))
+    findings.extend(_check_thread_lifecycle(g))
+    findings.extend(_check_wire_pending(g))
+    return findings
+
+
+def static_rpc_lock_edges(paths: list, root: str = "."):
+    """(lock -> RPC -> handler-lock) edge set for the runtime
+    lock-order sanitizer (vlsan): merged with the static lock graph so
+    an observed acquisition order that closes a cycle THROUGH a
+    cluster RPC is reported at session finish, not in production."""
+    import os
+
+    from .core import SourceFile, iter_py_files
+    from . import callgraph
+    summaries = []
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        try:
+            sf = SourceFile.parse(fp, display_path=rel)
+        except SyntaxError:
+            continue
+        summaries.append(callgraph.summarize(sf))
+    g = _Graph(summaries)
+    handler = _handler_locks(g)
+    eff = g.propagate({n: ("rpc", 0, None)
+                       for n, x in g.nodes.items() if x["rpc"]})
+    edges: set = set()
+    for nid, nd in g.nodes.items():
+        held_sets = [h for h, _l in nd["rpc"]]
+        held_sets += [h for c, h, _l, _d in g.edges[nid] if c in eff]
+        for held in held_sets:
+            for lk in _lock_names(held):
+                edges.add((lk, _RPC_NODE))
+    if edges:
+        for tok in handler:
+            edges.add((_RPC_NODE, tok.split(":", 1)[1]))
+    return edges

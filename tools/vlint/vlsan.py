@@ -269,8 +269,14 @@ def install_lock_order():
 
 def lock_order_problems(sanitizer, repo_root: str) -> list[str]:
     """Session-end check: the observed acquisition graph must be
-    acyclic and stay acyclic when merged with the static graph."""
+    acyclic and stay acyclic when merged with the static graph —
+    INCLUDING the v3 effect-graph's RPC edges (a lock held across a
+    cluster RPC feeds the remote handler's acquisitions: on a combined
+    frontend+storage node that closes cycles no single process's
+    observed order ever shows)."""
+    from .effects import static_rpc_lock_edges
     from .locks import build_static_graph
-    edges, site_map = build_static_graph(
-        [os.path.join(repo_root, "victorialogs_tpu")], root=repo_root)
+    paths = [os.path.join(repo_root, "victorialogs_tpu")]
+    edges, site_map = build_static_graph(paths, root=repo_root)
+    edges |= static_rpc_lock_edges(paths, root=repo_root)
     return sanitizer.check_static_consistency(edges, site_map)

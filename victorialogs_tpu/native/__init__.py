@@ -49,6 +49,7 @@ def _load():
         try:
             if not os.path.exists(_SO) or \
                     os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                # vlint: allow-lock-blocking-deep(one-time lazy init — the compile is deliberately serialized under _lock; every contender needs the artifact and must wait for it)
                 if not _build():
                     return None
             lib = ctypes.CDLL(_SO)

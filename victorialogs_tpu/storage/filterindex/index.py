@@ -154,7 +154,14 @@ _attach_mu = threading.Lock()
 def part_index(part) -> PartFilterIndex | None:
     """The part's loaded v2 index, or None (no sidecar / invalid /
     VL_FILTER_INDEX=v1 / in-memory part / over budget).  The outcome
-    is cached on the part — one sidecar read per part lifetime."""
+    is cached on the part — one sidecar read per part lifetime.
+
+    The global mutex only mints the PER-PART lock; the sidecar read
+    (and the optional in-place rebuild, which re-reads every bloom
+    column) runs under the part's own lock so concurrent queries
+    attaching DIFFERENT parts never serialize behind each other's
+    disk IO — only same-part racers wait, which is exactly what keeps
+    the bank charge in _load single-shot."""
     if not enabled():
         return None
     got = getattr(part, "_filter_index", _UNSET)
@@ -165,6 +172,10 @@ def part_index(part) -> PartFilterIndex | None:
         part._filter_index = False        # unsealed in-memory part
         return None
     with _attach_mu:
+        mu = getattr(part, "_filter_index_mu", None)
+        if mu is None:
+            mu = part._filter_index_mu = threading.Lock()
+    with mu:
         got = getattr(part, "_filter_index", _UNSET)
         if got is not _UNSET:
             return got or None
