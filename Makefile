@@ -7,7 +7,8 @@ NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test race lint check help bench bench-bloom \
 	bench-pipeline bench-cluster-obs bench-concurrent bench-emit \
-	bench-explain bench-faults bench-journal bench-wire clean
+	bench-explain bench-faults bench-journal bench-standing \
+	bench-wire clean
 
 all: native
 
@@ -120,6 +121,15 @@ bench-faults:
 # recorded into BENCH_cluster_obs.json (PERF.md round)
 bench-cluster-obs:
 	python tools/bench_cluster_obs.py --json BENCH_cluster_obs.json
+
+# standing queries + per-part result cache: repeated-query round (2nd
+# run must submit >=5x fewer dispatches, hit ratio >= 0.9, cached
+# parts priced ~0 in EXPLAIN, post-flush run re-dispatches only the
+# head part) and the 100-subscriber standing-panel round (ONE
+# evaluation per refresh, every subscriber's delta == a fresh full
+# evaluation) — PERF.md round
+bench-standing:
+	python tools/bench_standing.py --json BENCH_standing.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

@@ -19,6 +19,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # (fast-RTT) CPU backend; the gate itself is covered by
 # tests/test_cost_model.py, which overrides this per-test
 os.environ.setdefault("VL_COST_FORCE", "device")
+# the per-part result cache replays a warm part instead of executing
+# it — correct (and covered by tests/test_standing.py, which opts back
+# in), but it would silently hollow out every CPU-vs-device parity
+# differential in this suite: the serial oracle run would seed the
+# cache and the device run would replay it, exercising no kernel at
+# all.  Parity suites must execute what they compare, so the cache is
+# opt-in under test.
+os.environ.setdefault("VL_RESULT_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -63,6 +71,20 @@ _VLINT_SANITIZER = _vlsan.install_lock_order()
 _VLSAN = _vlsan.Sanitizer() if _vlsan.enabled() else None
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _result_cache_isolation():
+    """Start every test with a cold per-part result cache.  Warm
+    entries stay CORRECT across tests (keys are immutable part uids,
+    kept alive here by module-scoped storage fixtures), but a replayed
+    part stages nothing and dispatches nothing — which silently zeroes
+    the staging-hit / device-call counts older suites assert.  Cheap
+    no-op when the module was never imported."""
+    rc = sys.modules.get("victorialogs_tpu.engine.standing.resultcache")
+    if rc is not None:
+        rc.reset_for_tests()
+    yield
 
 
 @pytest.fixture(autouse=True)

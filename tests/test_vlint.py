@@ -977,7 +977,8 @@ def test_balance_pair_registry_inventory():
     names = {p.name for p in PAIRS}
     assert names == {"bloom-bank", "sched-lease", "admission",
                      "staging-cache", "events-subscription",
-                     "journal-accounting", "net-probe", "insert-spool"}
+                     "journal-accounting", "net-probe", "insert-spool",
+                     "result-cache", "standing-subscription"}
     runtime = {p.name for p in PAIRS if p.runtime_only}
     assert runtime == {"staging-cache", "journal-accounting"}
 

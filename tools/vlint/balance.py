@@ -114,6 +114,25 @@ PAIRS: tuple[Pair, ...] = (
          "spooled batches replay forever",
          acquires=("push",), releases=("ack",), file_balance=True,
          paths=("victorialogs_tpu/server/",)),
+    Pair("result-cache",
+         "per-part result-cache byte budget (engine/standing/"
+         "resultcache.py): every won _rc_try_charge is released "
+         "exactly once at part GC via a weakref.finalize over "
+         "_rc_release; cache_check_balanced() proves bytes == sum of "
+         "live charges == sum of entry sizes (vlsan sweeps it after "
+         "every test)",
+         acquires=("_rc_try_charge",), releases=("_rc_release",),
+         finalizers=("_rc_release",),
+         paths=("victorialogs_tpu/engine/",)),
+    Pair("standing-subscription",
+         "standing-query subscriber streams: every attach_subscriber "
+         "needs a reachable detach_subscriber in the same file (a "
+         "leaked subscriber keeps the standing entry — and its "
+         "resident evaluation — alive forever); vlsan additionally "
+         "sweeps the registry back to its per-test baseline",
+         acquires=("attach_subscriber",),
+         releases=("detach_subscriber",), file_balance=True,
+         paths=("victorialogs_tpu/",)),
 )
 
 

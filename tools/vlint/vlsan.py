@@ -22,6 +22,13 @@ parser test never pays for the cluster stack):
   the PR 8 ``is``-matched-unsubscribe leak class;
 - every live ``JournalWriter``: accepted == written + dropped +
   queued + in-flight;
+- per-part result cache (engine/standing/resultcache.py):
+  ``cache_check_balanced()`` — cache bytes equal the sum of live
+  part charges and the sum of entry sizes, never negative; retried
+  after ``gc.collect()`` like the bank (part-GC finalizers release);
+- standing-query registry drained back to its per-test baseline — a
+  leaked registration keeps a resident evaluation (and its bus
+  subscription) alive forever;
 - admission pools drained: zero active, zero queued in every live
   controller;
 - no new non-daemon thread left running (daemon pools are process
@@ -62,6 +69,7 @@ class Sanitizer:
     def __init__(self):
         self._subs_baseline = 0
         self._threads_baseline: set[int] = set()
+        self._standing_baseline = 0
 
     # -- baselines --
 
@@ -70,6 +78,9 @@ class Sanitizer:
         self._subs_baseline = ev.subscriber_count() if ev else 0
         self._threads_baseline = {
             t.ident for t in threading.enumerate() if not t.daemon}
+        sm = _mod("victorialogs_tpu.engine.standing.manager")
+        self._standing_baseline = \
+            len(sm.standing_snapshot()) if sm else 0
 
     # -- the sweep --
 
@@ -78,6 +89,8 @@ class Sanitizer:
         problems += self._check_sched()
         problems += self._check_staging()
         problems += self._check_bank()
+        problems += self._check_result_cache()
+        problems += self._check_standing()
         problems += self._check_subscribers()
         problems += self._check_journal()
         problems += self._check_admission()
@@ -142,6 +155,39 @@ class Sanitizer:
             return [f"bloom bank imbalance: {detail} — a charge was "
                     f"released twice or never released "
                     f"(VL_BLOOM_BANK_MAX_BYTES budget corrupt)"]
+        return []
+
+    def _check_result_cache(self) -> list[str]:
+        rc = _mod("victorialogs_tpu.engine.standing.resultcache")
+        if rc is None:
+            return []
+
+        def probe():
+            ok, detail = rc.cache_check_balanced()
+            if not ok:
+                # a dead part's finalizer may still be queued
+                gc.collect()
+                ok, detail = rc.cache_check_balanced()
+            return ok, detail
+
+        ok, detail = self._retry(probe, tries=2)
+        if not ok:
+            return [f"result cache imbalance: {detail} — a part charge "
+                    f"was released twice or never released "
+                    f"(VL_RESULT_CACHE_MAX_BYTES budget corrupt)"]
+        return []
+
+    def _check_standing(self) -> list[str]:
+        sm = _mod("victorialogs_tpu.engine.standing.manager")
+        if sm is None:
+            return []
+        base = self._standing_baseline
+        ok, detail = self._retry(
+            lambda: sm.standing_check_drained(baseline=base))
+        if not ok:
+            return [f"standing registry not drained: {detail} — a "
+                    f"registration leaked past its last subscriber "
+                    f"(the entry keeps a resident evaluation alive)"]
         return []
 
     def _check_subscribers(self) -> list[str]:
@@ -240,6 +286,10 @@ class Sanitizer:
                 ("victorialogs_tpu.server.cluster",
                  "wire_metrics_samples"),
                 ("victorialogs_tpu.server.netrobust",
+                 "metrics_samples"),
+                ("victorialogs_tpu.engine.standing.resultcache",
+                 "metrics_samples"),
+                ("victorialogs_tpu.engine.standing.manager",
                  "metrics_samples")):
             mod = _mod(modname)
             fn = getattr(mod, provider, None) if mod else None

@@ -388,6 +388,32 @@ declare_env(
 declare_env(
     "VL_XLA_TRACE_DIR", None, "str",
     "XLA profiler traces at the runner seam", display="off")
+declare_env(
+    "VL_RESULT_CACHE", "1", "bool",
+    "per-part result cache (`engine/standing/resultcache.py`): "
+    "repeated queries replay sealed parts' cached stats partials / "
+    "filter bitmaps and re-dispatch only the unsealed head; `0` "
+    "disables (every part recomputes)")
+declare_env(
+    "VL_RESULT_CACHE_MAX_BYTES", str(64 << 20), "int",
+    "byte budget for the per-part result cache; past it LRU entries "
+    "evict (counted + journaled as `result_cache_evict`), and a "
+    "part's GC releases its entries' bytes like the bloom bank",
+    display="64 MiB")
+declare_env(
+    "VL_STANDING", "1", "bool",
+    "standing-query registration (`POST /select/logsql/"
+    "standing_query`): one resident evaluation per distinct query "
+    "fingerprint, re-run on storage flush/merge and fanned out to all "
+    "subscribers; `0` refuses registrations (503)")
+declare_env(
+    "VL_STANDING_MAX", "64", "int",
+    "max standing-query entries per node; past it registrations are "
+    "refused with 429")
+declare_env(
+    "VL_STANDING_DEBOUNCE_MS", "100", "int",
+    "coalescing window for standing re-evaluation: flush/merge bursts "
+    "inside it trigger ONE re-run per registered query")
 
 
 _TABLE_HEADER = ("| Variable | Default | Meaning |",
@@ -633,6 +659,35 @@ declare_metric("vl_cluster_stats_age_seconds", "gauge",
 declare_metric("vl_queries_cancel_propagated_total", "counter",
                "sub-queries cancelled via propagated cluster cancel "
                "(POST /internal/select/cancel)", single_roll=True)
+
+# -- standing queries / per-part result cache (engine/standing/) --
+declare_metric("vl_result_cache_hits_total", "counter",
+               "per-part result cache hits (parts replayed without a "
+               "dispatch)")
+declare_metric("vl_result_cache_misses_total", "counter",
+               "per-part result cache misses (parts that recomputed)")
+declare_metric("vl_result_cache_evictions_total", "counter",
+               "entries evicted at the VL_RESULT_CACHE_MAX_BYTES "
+               "budget (LRU)")
+declare_metric("vl_result_cache_stores_total", "counter",
+               "entries stored at harvest/absorb")
+declare_metric("vl_result_cache_bytes", "gauge",
+               "bytes resident in the per-part result cache")
+declare_metric("vl_result_cache_max_bytes", "gauge",
+               "VL_RESULT_CACHE_MAX_BYTES budget")
+declare_metric("vl_result_cache_entries", "gauge",
+               "live (fingerprint, part uid) entries")
+declare_metric("vl_standing_queries", "gauge",
+               "registered standing-query fingerprints on this node")
+declare_metric("vl_standing_subscribers", "gauge",
+               "subscriber streams attached across all standing "
+               "queries")
+declare_metric("vl_standing_reevals_total", "counter",
+               "standing-query re-evaluations (flush/merge-triggered "
+               "+ registration seeds)")
+declare_metric("vl_standing_pushes_dropped_total", "counter",
+               "payload pushes dropped at a stalled subscriber's "
+               "queue bound")
 
 # -- histograms (obs/hist.py) --
 declare_metric("vl_query_duration_seconds", "histogram",

@@ -279,6 +279,15 @@ def _run_query_guarded(storage, tenants, q, write_block, timestamp,
         from ..tpu.sort_device import device_sort_spec
         sort_spec = device_sort_spec(q)
 
+    # per-part result cache (engine/standing/resultcache.py): a
+    # repeated query's sealed parts replay their cached stats partials
+    # / filter bitmaps instead of re-dispatching — only the unsealed
+    # head recomputes.  for_query returns None when caching can't
+    # apply (VL_RESULT_CACHE=0, in(<subquery>) filters).
+    from .standing.resultcache import QueryCache
+    qcache = QueryCache.for_query(q, tenants, stats_spec, sort_spec,
+                                  min_ts, max_ts)
+
     sfs: list[FilterStream] = []
     _collect_stream_filters(q.filter, sfs)
 
@@ -316,7 +325,7 @@ def _run_query_guarded(storage, tenants, q, write_block, timestamp,
             _scan_parts(pt, q, sink_head, runner, batch, tenant_set,
                         allowed_sids, min_ts, max_ts, ctx, needed,
                         deadline, pool, stats_spec, sort_spec,
-                        token_leaves)
+                        token_leaves, qcache)
 
     try:
         pts = storage.select_partitions(min_ts, max_ts)
@@ -332,7 +341,7 @@ def _run_query_guarded(storage, tenants, q, write_block, timestamp,
             _scan_partitions_device(
                 pts, q, head, runner, tenants, tenant_set, sfs, min_ts,
                 max_ts, needed, deadline, stats_spec, sort_spec,
-                token_leaves)
+                token_leaves, qcache)
         else:
             # per-day partitions search CONCURRENTLY under a worker cap
             # (reference storage_search.go:1095-1126): a 30-day query
@@ -440,7 +449,7 @@ def _make_cand_fn(tenant_set, allowed_sids, min_ts, max_ts):
 def _scan_partitions_device(pts, q, head, runner, tenants, tenant_set,
                             sfs, min_ts, max_ts, needed, deadline,
                             stats_spec, sort_spec,
-                            token_leaves) -> None:
+                            token_leaves, qcache=None) -> None:
     """The cross-partition device path: feed every selected partition's
     parts through ONE async dispatch window (tpu/pipeline.py).
 
@@ -485,7 +494,8 @@ def _scan_partitions_device(pts, q, head, runner, tenants, tenant_set,
                 yield part, cand_fn, ctx
 
     scan_device_stream(part_stream(), q, head, runner, needed, deadline,
-                       stats_spec, sort_spec, token_leaves)
+                       stats_spec, sort_spec, token_leaves,
+                       qcache=qcache)
 
 
 def _eval_block_cpu(q, bs):
@@ -513,7 +523,7 @@ def _absorb_stats_partials(head, q, spec, partials) -> None:
 def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
                 min_ts, max_ts, ctx, needed, deadline, pool,
                 stats_spec=None, sort_spec=None,
-                token_leaves=None) -> None:
+                token_leaves=None, qcache=None) -> None:
     from ..storage.filterbank import (maplet_prune_candidates,
                                       part_aggregate_prunes)
     parts = [p for p in pt.ddb.snapshot_parts()
@@ -530,7 +540,7 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
         from ..tpu.pipeline import scan_parts_device
         scan_parts_device(parts, q, head, runner, cand_block_idxs, ctx,
                           needed, deadline, stats_spec, sort_spec,
-                          token_leaves)
+                          token_leaves, qcache)
         return
 
     sp = tracing.current_span()
@@ -565,6 +575,26 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             if not part_bis:
                 continue
         activity.note_part_scanned(act, part, part_bis)
+        if qcache is not None and qcache.kind == "bms":
+            # sealed-part replay: the cached bitmaps feed the chain in
+            # the exact block order the walk below would produce
+            e = qcache.probe(part, part_bis)
+            if e is not None:
+                cached_bms = qcache.entry_bms(e)
+                for bi in part_bis:
+                    if head.is_done():
+                        raise QueryCancelled()
+                    bm = cached_bms[bi]
+                    if not bm.any():
+                        continue
+                    bs = BlockSearch(part, bi)
+                    bs.ctx = ctx
+                    br = BlockResult.from_block_search(bs, bm, needed)
+                    sp.add("blocks_out")
+                    sp.add("rows_out", br.nrows)
+                    head.write_block(br)
+                continue
+        collected: dict[int, np.ndarray] = {}
         cand: dict[int, BlockSearch] = {}
         for bi in part_bis:
             if head.is_done():
@@ -579,6 +609,7 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             else:
                 bm = new_bitmap(bs.nrows)
                 q.filter.apply_to_block(bs, bm)
+            collected[bi] = bm
             if not bm.any():
                 continue
             br = BlockResult.from_block_search(bs, bm, needed)
@@ -586,6 +617,8 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             sp.add("rows_out", br.nrows)
             head.write_block(br)
         if not cand:
+            if qcache is not None:
+                qcache.store_bms(part, part_bis, collected)
             continue
         if head.is_done():
             raise QueryCancelled()
@@ -605,6 +638,9 @@ def _scan_parts(pt, q, head, runner, batch, tenant_set, allowed_sids,
             sp.add("blocks_out")
             sp.add("rows_out", br.nrows)
             head.write_block(br)
+        if qcache is not None:
+            collected.update(bms)
+            qcache.store_bms(part, part_bis, collected)
 
 
 def run_query_collect(storage, tenants, q: Query | str,

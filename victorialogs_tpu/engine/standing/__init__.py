@@ -1,0 +1,26 @@
+"""Standing-query subsystem: per-part result cache + incremental
+dashboard evaluation.
+
+Two layers over the immutable-part storage model:
+
+- ``resultcache`` — a byte-budgeted cache of per-part query results
+  (stats partials / filter bitmaps) keyed by (query fingerprint, part
+  uid).  Parts are immutable, so a key can never go stale; a repeated
+  dashboard query recomputes only the unsealed head parts.
+
+- ``manager`` — standing-query registrations: one resident evaluation
+  per distinct query fingerprint per node, re-run on the journal bus's
+  storage_flush/storage_merge events and fanned out to N subscribers
+  over the /tail streaming machinery.
+"""
+
+from .resultcache import (QueryCache, cache_check_balanced, cache_stats,
+                          metrics_samples, reset_for_tests)
+from .manager import StandingRegistry, standing_check_drained
+from .manager import metrics_samples as standing_metrics_samples
+
+__all__ = [
+    "QueryCache", "cache_check_balanced", "cache_stats",
+    "metrics_samples", "reset_for_tests", "StandingRegistry",
+    "standing_check_drained", "standing_metrics_samples",
+]

@@ -1,0 +1,380 @@
+"""Standing queries: one resident evaluation per distinct query
+fingerprint per node, fanned out to N subscribers.
+
+A dashboard panel refreshed by many clients is the same LogsQL query
+re-POSTed over and over.  A standing registration
+(``POST /select/logsql/standing_query`` — server/app.py) collapses all
+of them to ONE entry keyed by the query's fingerprint: the entry keeps
+its latest result resident, subscribes to the journal bus's
+``storage_flush``/``storage_merge`` events, re-evaluates when storage
+actually changed, and pushes the updated result bytes to every
+subscriber queue (the /tail streaming machinery drains them to the
+clients).  Re-evaluation rides the per-part result cache
+(resultcache.py), so each push recomputes only the parts the flush or
+merge minted — price-after-cache, and the admission controller prices
+exactly that residual work (``admission.admit`` wraps every re-eval).
+
+Lifecycle discipline (the vlsan/balance-checked invariants):
+
+- ``attach_subscriber``/``detach_subscriber`` bracket every consumer —
+  the LAST detach drops the entry (and an explicit ``unregister``
+  pushes a ``None`` sentinel so attached streams end);
+- the bus subscription exists while ANY entry does (first register
+  subscribes, last drop unsubscribes — both in this module, the PR 8
+  ``is``-vs-``==`` class);
+- ``standing_query_{registered,unregistered,reeval}`` journal events
+  carry the entry's tenant, so standing evaluations of journal-only
+  data are suppressed by the PR 8 recursion guard and cannot
+  self-heartbeat.
+
+``VL_STANDING=0`` kills registration; ``VL_STANDING_MAX`` caps entries
+per node; ``VL_STANDING_DEBOUNCE_MS`` coalesces flush bursts into one
+re-evaluation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import weakref
+
+from ... import config
+from ...obs import activity, events
+
+# every live registry, for /metrics gauges and the vlsan sweep
+_registries: "weakref.WeakSet" = weakref.WeakSet()
+_counts_mu = threading.Lock()
+_counts = {"reevals": 0, "pushes_dropped": 0}
+
+# per-subscriber queue depth: a stalled client drops ITS oldest
+# payloads (counted) without blocking the evaluation or its siblings
+_SUB_QUEUE_DEPTH = 8
+
+
+def standing_enabled() -> bool:
+    return config.env_flag("VL_STANDING")
+
+
+def standing_max() -> int:
+    return config.env_int("VL_STANDING_MAX")
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _counts_mu:
+        _counts[key] += n
+
+
+def standing_fingerprint(q, tenants) -> str:
+    tstr = ",".join(sorted(activity.tenant_str(t) for t in tenants))
+    return hashlib.sha1(
+        (q.to_string() + "\x00" + tstr).encode()).hexdigest()
+
+
+class StandingLimit(Exception):
+    """Registration refused: VL_STANDING_MAX reached (HTTP 429) or
+    VL_STANDING=0 (HTTP 503)."""
+
+
+class _Standing:
+    """One registered query fingerprint and its subscriber fan-out."""
+
+    def __init__(self, fp: str, q, tenants: tuple, parent_qid: str):
+        self.fp = fp
+        self.q = q
+        self.tenants = tenants
+        self.parent_qid = parent_qid
+        self.subs: list[queue.Queue] = []
+        self.last_payload: bytes | None = None
+        self.reevals = 0
+        self.dirty = False
+
+    def tenant(self) -> str:
+        return activity.tenant_str(self.tenants[0])
+
+
+class StandingRegistry:
+    """Per-server standing-query registry (server/app.py owns one)."""
+
+    def __init__(self, storage, runner=None, admission=None):
+        self._storage = storage
+        self._runner = runner
+        self._admission = admission
+        self._mu = threading.Lock()
+        self._entries: dict[str, _Standing] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+        self._subscribed = False
+        _registries.add(self)
+
+    # -- registration --
+
+    def register(self, q, tenants, parent_qid: str = "") -> str:
+        """Register (or join) the standing evaluation for q; returns
+        its fingerprint.  The first registration evaluates immediately
+        so a joining subscriber is seeded with the current result."""
+        if not standing_enabled():
+            raise StandingLimit("standing queries disabled "
+                                "(VL_STANDING=0)")
+        tenants = tuple(tenants)
+        fp = standing_fingerprint(q, tenants)
+        created = None
+        with self._mu:
+            e = self._entries.get(fp)
+            if e is None:
+                if len(self._entries) >= standing_max():
+                    raise StandingLimit(
+                        f"standing query limit reached "
+                        f"(VL_STANDING_MAX={standing_max()})")
+                e = created = _Standing(fp, q, tenants, parent_qid)
+                self._entries[fp] = e
+                if not self._subscribed:
+                    events.subscribe(self._on_event)
+                    self._subscribed = True
+                self._ensure_worker()
+        if created is not None:
+            events.emit("standing_query_registered",
+                        tenant=created.tenant(), fingerprint=fp,
+                        query=q.to_string(), parent_qid=parent_qid)
+            try:
+                self._reeval(created)
+            except BaseException:
+                # a failed seed evaluation (admission shed, bad query
+                # against the live schema) must not leave a
+                # subscriber-less entry resident forever
+                self.unregister(fp)
+                raise
+        return fp
+
+    def unregister(self, fp: str) -> bool:
+        """Explicit teardown: attached subscriber streams receive the
+        end-of-stream sentinel and the entry drops immediately."""
+        with self._mu:
+            e = self._entries.pop(fp, None)
+            if e is not None:
+                subs = list(e.subs)
+                e.subs.clear()
+                self._maybe_unsubscribe_locked()
+        if e is None:
+            return False
+        for sub in subs:
+            self._push_one(sub, None)
+        events.emit("standing_query_unregistered", tenant=e.tenant(),
+                    fingerprint=fp, reason="unregister")
+        return True
+
+    # -- subscribers --
+
+    def attach_subscriber(self, fp: str) -> queue.Queue:
+        """One consumer's delta queue, seeded with the latest result so
+        a joining dashboard paints without waiting for the next flush.
+        Always balanced by detach_subscriber (vlint balance pair)."""
+        with self._mu:
+            e = self._entries.get(fp)
+            if e is None:
+                raise KeyError(fp)
+            sub: queue.Queue = queue.Queue(_SUB_QUEUE_DEPTH)
+            e.subs.append(sub)
+            if e.last_payload is not None:
+                sub.put_nowait(e.last_payload)
+        return sub
+
+    def detach_subscriber(self, fp: str, sub) -> None:
+        """The LAST detach drops the whole entry — a standing query
+        nobody is watching must not keep re-evaluating."""
+        dropped = None
+        with self._mu:
+            e = self._entries.get(fp)
+            if e is None:
+                return
+            if sub in e.subs:
+                e.subs.remove(sub)
+            if not e.subs:
+                dropped = self._entries.pop(fp)
+                self._maybe_unsubscribe_locked()
+        if dropped is not None:
+            events.emit("standing_query_unregistered",
+                        tenant=dropped.tenant(), fingerprint=fp,
+                        reason="last_subscriber_detached")
+
+    def _maybe_unsubscribe_locked(self) -> None:
+        if self._subscribed and not self._entries:
+            events.unsubscribe(self._on_event)
+            self._subscribed = False
+
+    # -- the journal-bus trigger --
+
+    def _on_event(self, ts_ns, event, fields) -> None:
+        """Runs on the EMITTER's thread (storage flush/merge): mark and
+        wake, never evaluate here."""
+        if event not in ("storage_flush", "storage_merge"):
+            return
+        with self._mu:
+            for e in self._entries.values():
+                e.dirty = True
+        self._wake.set()
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="vl-standing", daemon=True)
+            self._worker.start()
+
+    def _run(self) -> None:
+        debounce_s = config.env_int("VL_STANDING_DEBOUNCE_MS") / 1e3
+        while not self._stop.is_set():
+            if not self._wake.wait(0.5):
+                continue
+            # coalesce a flush burst (a merge right after its flushes)
+            # into ONE re-evaluation per entry
+            if self._stop.wait(debounce_s):
+                break
+            self._wake.clear()
+            with self._mu:
+                todo = [e for e in self._entries.values() if e.dirty]
+                for e in todo:
+                    e.dirty = False
+            for e in todo:
+                if self._stop.is_set():
+                    break
+                try:
+                    self._reeval(e)
+                # vlint: allow-broad-except(one broken standing entry must not kill the shared worker)
+                except Exception:
+                    with self._mu:
+                        e.dirty = True
+
+    # -- evaluation --
+
+    def _reeval(self, e: _Standing) -> None:
+        """ONE full evaluation of the standing query; sealed parts hit
+        the result cache so only flush/merge-minted parts re-dispatch.
+        The push is the delta: subscribers receive the new result bytes
+        only when they differ from the previous push."""
+        from ..searcher import run_query
+        from ..emit import ndjson_block
+        chunks: list[bytes] = []
+
+        def sink(br):
+            chunks.append(ndjson_block(br))
+
+        def run():
+            with activity.track("/select/logsql/standing_query",
+                                e.q.to_string(), e.tenants,
+                                parent_qid=e.parent_qid):
+                run_query(self._storage, list(e.tenants), e.q.clone(),
+                          write_block=sink, runner=self._runner)
+
+        adm = self._admission
+        if adm is not None:
+            # standing re-evaluations are PRICED tenant workload: the
+            # admission pool sees the post-cache residual scan exactly
+            # like an interactive query (AdmissionShed re-marks dirty
+            # via the worker's retry path)
+            with adm.admit(tenant=e.tenant(),
+                           endpoint="/select/logsql/standing_query"):
+                run()
+        else:
+            run()
+        payload = b"".join(chunks)
+        changed = payload != e.last_payload
+        dropped = 0
+        with self._mu:
+            e.last_payload = payload
+            e.reevals += 1
+            subs = list(e.subs) if changed else []
+        _bump("reevals")
+        for sub in subs:
+            dropped += self._push_one(sub, payload)
+        if dropped:
+            _bump("pushes_dropped", dropped)
+        events.emit("standing_query_reeval", tenant=e.tenant(),
+                    fingerprint=e.fp, bytes=len(payload),
+                    changed=changed, subscribers=len(subs))
+
+    @staticmethod
+    def _push_one(sub: queue.Queue, payload) -> int:
+        """Enqueue-or-drop-oldest: a stalled subscriber loses ITS
+        backlog (returned as the drop count), never blocks the
+        evaluation."""
+        dropped = 0
+        while True:
+            try:
+                sub.put_nowait(payload)
+                return dropped
+            except queue.Full:
+                try:
+                    sub.get_nowait()
+                    dropped += 1
+                except queue.Empty:
+                    continue
+
+    # -- introspection / teardown --
+
+    def snapshot(self) -> list[dict]:
+        with self._mu:
+            # vlint: allow-per-row-emit(introspection metadata, bounded by VL_STANDING_MAX)
+            return [{
+                "fingerprint": e.fp,
+                "query": e.q.to_string(),
+                "tenant": e.tenant(),
+                "parent_qid": e.parent_qid,
+                "subscribers": len(e.subs),
+                "reevals": e.reevals,
+            } for e in self._entries.values()]
+
+    def entry_count(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def reeval_now(self, fp: str) -> bool:
+        """Synchronous re-evaluation (bench/test determinism)."""
+        with self._mu:
+            e = self._entries.get(fp)
+        if e is None:
+            return False
+        self._reeval(e)
+        return True
+
+    def close(self) -> None:
+        for fp in [e["fingerprint"] for e in self.snapshot()]:
+            self.unregister(fp)
+        self._stop.set()
+        self._wake.set()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout=5)
+        with self._mu:
+            self._maybe_unsubscribe_locked()
+
+
+def standing_snapshot() -> list[dict]:
+    """Every live registry's entries (the /metrics and vlsan view)."""
+    out = []
+    for r in list(_registries):
+        out.extend(r.snapshot())
+    return out
+
+
+def standing_check_drained(baseline: int = 0) -> tuple[bool, str]:
+    """vlsan end-of-test sweep: the standing registry must be back to
+    its per-test baseline — a leaked entry keeps a resident evaluation
+    (and its bus subscription) alive forever."""
+    entries = standing_snapshot()
+    ok = len(entries) <= baseline
+    return ok, (f"standing entries={len(entries)} baseline={baseline} "
+                f"({[e['fingerprint'][:8] for e in entries]})")
+
+
+def metrics_samples() -> list[tuple[str, dict, float]]:
+    entries = standing_snapshot()
+    with _counts_mu:
+        c = dict(_counts)
+    return [
+        ("vl_standing_queries", {}, len(entries)),
+        ("vl_standing_subscribers", {},
+         sum(e["subscribers"] for e in entries)),
+        ("vl_standing_reevals_total", {}, c["reevals"]),
+        ("vl_standing_pushes_dropped_total", {}, c["pushes_dropped"]),
+    ]

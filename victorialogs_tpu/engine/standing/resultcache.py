@@ -1,0 +1,388 @@
+"""Per-part query result cache: repeated queries recompute only the
+unsealed head.
+
+Dashboard/alert traffic is dominated by the SAME query re-run over a
+sliding window.  Parts are immutable and uid'd, and the device stats
+path already produces per-part partials (the segment axis), so the
+per-part share of a repeated query's answer is a pure function of
+(query fingerprint, part uid) — cacheable forever, staleness-proof by
+construction: a merge mints fresh uids and the old entries die with
+their parts' GC finalizers, exactly like the bloom bank
+(storage/filterbank.py).
+
+What is cached, per (fingerprint, part uid):
+
+- ``stats`` entries — the raw per-part partial tuples a fused stats
+  dispatch harvested, BEFORE build_partial_states: replaying them
+  through the same absorb path merges to the bit-identical uncached
+  answer (float accumulation order is preserved — partials re-merge in
+  the same part order);
+- ``bms`` entries — per-block filter bitmaps (np.packbits'd), for rows
+  queries and sort-topk prefilters.  Topk bitmaps are keyed by the
+  (field, desc, k) shape: they are a per-part superset of any smaller
+  re-ask of the same shape only for the SAME k, so the key carries it.
+
+Safety rules (enforced at both store and probe):
+
+- the query's top-level AND-path time range must fully cover the part
+  (the stripped time filter is then a row-level no-op on it) — the
+  range itself stays OUT of the fingerprint, so every 15s-refresh
+  sliding window hits the same keys;
+- the candidate block list must match exactly (tenants and stream
+  filters are in the fingerprint; the bis check is belt-and-braces);
+- queries with in(<subquery>) filters never cache (materialized values
+  depend on mutable storage contents, not the query text).
+
+Budget: ``VL_RESULT_CACHE_MAX_BYTES``, accounted like the bloom bank —
+per-part charge lists released by a ``weakref.finalize`` at part GC,
+LRU eviction past the budget, and a ``cache_check_balanced()`` twin for
+the vlsan end-of-test sweep (cache bytes == sum of live charges >= 0).
+``VL_RESULT_CACHE=0`` is the kill switch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from ... import config
+from ...logsql.filters import FilterAnd, FilterTime
+from ...logsql.parser import MAX_TS, MIN_TS
+
+
+def cache_enabled() -> bool:
+    return config.env_flag("VL_RESULT_CACHE")
+
+
+def _cache_max_bytes() -> int:
+    return config.env_int("VL_RESULT_CACHE_MAX_BYTES")
+
+
+# ---------------- the byte-budgeted store ----------------
+
+_cache_mu = threading.Lock()
+_cache_bytes = 0
+# (fingerprint, part uid) -> _Entry, LRU order (move_to_end on hit)
+_entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+# part uid -> set of live keys, so a part's GC finalizer can drop its
+# entries without a full scan
+_part_index: dict[int, set] = {}
+# every live Part whose ._rc_charged list was handed to a _rc_release
+# weakref.finalize — the vlsan sweep proves _cache_bytes == sum of live
+# charges (>= 0) after every test (tools/vlint/vlsan.py)
+_cache_owners: "weakref.WeakSet" = weakref.WeakSet()
+_counts = {"hits": 0, "misses": 0, "evictions": 0, "stores": 0}
+
+
+@dataclass
+class _Entry:
+    kind: str                     # "stats" | "bms"
+    bis: tuple                    # candidate block idxs the value covers
+    value: object                 # stats: list of raw partial tuples;
+    #                               bms: {bi: (nrows, packed uint8)}
+    nbytes: int
+    charges: list                 # the owning part's live charge list
+
+
+def _sizeof(v) -> int:
+    """Recursive byte estimate for budget accounting (exact for the
+    ndarray payloads that dominate; fixed overheads elsewhere)."""
+    if isinstance(v, np.ndarray):
+        return int(v.nbytes) + 64
+    if isinstance(v, (bytes, bytearray, str)):
+        return len(v) + 48
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return 56 + sum(_sizeof(x) for x in v)
+    if isinstance(v, dict):
+        return 64 + sum(_sizeof(k) + _sizeof(x) for k, x in v.items())
+    return 32
+
+
+def _rc_try_charge(part, n: int) -> tuple[bool, list]:
+    """Reserve n bytes against the budget for one of `part`'s entries,
+    evicting LRU entries of OTHER keys if needed.  Returns
+    (ok, evicted_keys) — the caller emits the evict events OUTSIDE the
+    lock (journal emit must never run under _cache_mu)."""
+    global _cache_bytes
+    evicted = []
+    with _cache_mu:
+        maxb = _cache_max_bytes()
+        if n > maxb:
+            return False, evicted
+        while _cache_bytes + n > maxb and _entries:
+            key, e = _entries.popitem(last=False)
+            _part_index.get(key[1], set()).discard(key)
+            e.charges.remove(e.nbytes)
+            _cache_bytes -= e.nbytes
+            _counts["evictions"] += 1
+            evicted.append(key)
+        if _cache_bytes + n > maxb:
+            return False, evicted
+        charges = getattr(part, "_rc_charged", None)
+        if charges is None:
+            charges = part._rc_charged = []
+            weakref.finalize(part, _rc_release, part.uid, charges)
+            _cache_owners.add(part)
+        charges.append(n)
+        _cache_bytes += n
+        return True, evicted
+
+
+def _rc_release(uid, charges: list) -> None:
+    """weakref.finalize callback: a collected part returns its entries'
+    bytes to the budget and drops its keys (charges is the part's live
+    charge list — entries evicted earlier already removed their
+    share)."""
+    global _cache_bytes
+    with _cache_mu:
+        for key in _part_index.pop(uid, ()):
+            _entries.pop(key, None)
+        _cache_bytes -= sum(charges)
+        charges.clear()
+
+
+def cache_check_balanced() -> tuple[bool, str]:
+    """Budget-accounting invariant for the vlsan sweep: the byte total
+    equals both the sum of every live owner's charges and the sum of
+    live entry sizes, and never goes negative.  Callers retry after
+    gc.collect() — a part finalizer may not have run yet."""
+    with _cache_mu:
+        used = _cache_bytes
+        entry_bytes = sum(e.nbytes for e in _entries.values())
+    live = sum(sum(o._rc_charged) for o in list(_cache_owners))
+    ok = used == live == entry_bytes and used >= 0
+    return ok, (f"cache_bytes={used} sum(live charges)={live} "
+                f"sum(entry nbytes)={entry_bytes}")
+
+
+def cache_stats() -> dict:
+    with _cache_mu:
+        return {"used_bytes": _cache_bytes,
+                "max_bytes": _cache_max_bytes(),
+                "entries": len(_entries), **_counts}
+
+
+def metrics_samples() -> list[tuple[str, dict, float]]:
+    """(base, labels, value) samples for server/app.py Metrics.render."""
+    s = cache_stats()
+    return [
+        ("vl_result_cache_hits_total", {}, s["hits"]),
+        ("vl_result_cache_misses_total", {}, s["misses"]),
+        ("vl_result_cache_evictions_total", {}, s["evictions"]),
+        ("vl_result_cache_stores_total", {}, s["stores"]),
+        ("vl_result_cache_bytes", {}, s["used_bytes"]),
+        ("vl_result_cache_max_bytes", {}, s["max_bytes"]),
+        ("vl_result_cache_entries", {}, s["entries"]),
+    ]
+
+
+def reset_for_tests() -> None:
+    """Drop every entry and zero the counters (test isolation only —
+    charges release through the normal accounting so the balance
+    invariant holds across the reset)."""
+    global _cache_bytes
+    with _cache_mu:
+        for key, e in _entries.items():
+            e.charges.remove(e.nbytes)
+            _cache_bytes -= e.nbytes
+        _entries.clear()
+        _part_index.clear()
+        for k in _counts:
+            _counts[k] = 0
+
+
+def _emit_evictions(evicted: list) -> None:
+    if not evicted:
+        return
+    from ...obs import events
+    events.emit("result_cache_evict", entries=len(evicted),
+                fingerprint=evicted[0][0])
+
+
+# ---------------- fingerprints ----------------
+
+def _has_subquery(f) -> bool:
+    from ..searcher import _iter_subquery_filters
+    return any(True for _ in _iter_subquery_filters(f))
+
+
+def _time_free_filter_str(f) -> str:
+    """The filter's to_string with top-level AND-path FilterTime nodes
+    removed — the sliding-window part of a dashboard query, which the
+    full-coverage validity rule makes a per-part no-op.  Nested time
+    filters (inside or:/NOT) stay in the string: they narrow rows and
+    must key the entry."""
+    if isinstance(f, FilterTime):
+        return "*"
+    if isinstance(f, FilterAnd):
+        subs = [s for s in f.filters if not isinstance(s, FilterTime)]
+        if not subs:
+            return "*"
+        if len(subs) == 1:
+            return subs[0].to_string()
+        return FilterAnd(subs).to_string()
+    return f.to_string()
+
+
+class QueryCache:
+    """One query execution's view of the global store: the fingerprint,
+    the validity window, and per-query hit/miss accounting.
+
+    ``for_query`` returns None when the cache cannot apply (kill
+    switch, subquery filters) — callers then skip every hook.
+    """
+
+    def __init__(self, fp_probe: tuple, fp_store: str, kind: str,
+                 min_ts: int, max_ts: int):
+        self._fp_probe = fp_probe     # fingerprints to try, in order
+        self._fp_store = fp_store     # fingerprint new entries key on
+        self.kind = kind              # "stats" | "bms"
+        self._min_ts = min_ts
+        self._max_ts = max_ts
+        self.hits = 0
+        self.misses = 0
+        self.hit_uids: set = set()
+
+    @staticmethod
+    def for_query(q, tenants, stats_spec, sort_spec, min_ts, max_ts
+                  ) -> "QueryCache | None":
+        if not cache_enabled():
+            return None
+        if _has_subquery(q.filter):
+            return None
+        from ...obs import activity
+        tstr = ",".join(sorted(activity.tenant_str(t) for t in tenants))
+        base = hashlib.sha1(
+            (_time_free_filter_str(q.filter) + "\x00" + tstr)
+            .encode()).hexdigest()
+        rows_fp = base + ":rows"
+        if stats_spec is not None:
+            # the stats subtree keys the partials; NO rows-bitmap
+            # fallback — replayed partials preserve the float
+            # accumulation order, a bitmap re-scan would not
+            fp = base + ":stats:" + q.pipes[0].to_string()
+            return QueryCache((fp,), fp, "stats", min_ts, max_ts)
+        if sort_spec is not None:
+            # a topk prefilter keeps every row at-or-above the part's
+            # k-th best key — full rows bitmaps are a valid superset,
+            # so probe falls back to them; stores stay under the topk
+            # key (the prefiltered bitmaps are NOT general rows answers)
+            fp = (base + f":topk:{sort_spec.field}:"
+                  f"{int(sort_spec.desc)}:{sort_spec.k}")
+            return QueryCache((fp, rows_fp), fp, "bms", min_ts, max_ts)
+        return QueryCache((rows_fp,), rows_fp, "bms", min_ts, max_ts)
+
+    # -- validity --
+
+    def _covers(self, part) -> bool:
+        """The query's time range fully covers the part (the stripped
+        top-level time filter then keeps every row of it)."""
+        return ((self._min_ts == MIN_TS or part.min_ts >= self._min_ts)
+                and (self._max_ts == MAX_TS
+                     or part.max_ts <= self._max_ts))
+
+    def _lookup(self, part, bis):
+        if not self._covers(part):
+            return None
+        bist = tuple(bis)
+        with _cache_mu:
+            for fp in self._fp_probe:
+                e = _entries.get((fp, part.uid))
+                if e is not None and e.bis == bist:
+                    _entries.move_to_end((fp, part.uid))
+                    return e
+        return None
+
+    # -- probe (execution) --
+
+    def probe(self, part, bis):
+        """The cached entry covering (part, bis), or None.  Counts the
+        per-query and global hit/miss totals; ``peek`` is the EXPLAIN
+        twin that touches neither."""
+        e = self._lookup(part, bis)
+        with _cache_mu:
+            _counts["hits" if e is not None else "misses"] += 1
+        if e is not None:
+            self.hits += 1
+            self.hit_uids.add(part.uid)
+        else:
+            self.misses += 1
+        return e
+
+    def peek(self, part, bis) -> bool:
+        return self._lookup(part, bis) is not None
+
+    # -- hit materialization --
+
+    @staticmethod
+    def entry_partials(e) -> list:
+        return list(e.value)
+
+    @staticmethod
+    def entry_bms(e) -> dict:
+        out = {}
+        for bi, (nrows, packed) in e.value.items():
+            out[bi] = np.unpackbits(packed, count=nrows).view(bool)
+        return out
+
+    # -- store (harvest/absorb) --
+
+    def _store(self, part, bis, kind: str, value, evicted_out: list
+               ) -> None:
+        global _cache_bytes
+        if part.uid in self.hit_uids or not self._covers(part):
+            return
+        if not isinstance(part.uid, int):
+            return                    # never cache a PackedPart facade
+        key = (self._fp_store, part.uid)
+        nbytes = _sizeof(value)
+        ok, evicted = _rc_try_charge(part, nbytes)
+        evicted_out.extend(evicted)
+        if not ok:
+            return
+        with _cache_mu:
+            old = _entries.pop(key, None)
+            if old is not None:
+                # a concurrent query of the same shape raced us here:
+                # keep ours, return the loser's bytes
+                old.charges.remove(old.nbytes)
+                _cache_bytes -= old.nbytes
+            _entries[key] = _Entry(kind, tuple(bis), value, nbytes,
+                                   part._rc_charged)
+            _part_index.setdefault(part.uid, set()).add(key)
+            _counts["stores"] += 1
+
+    def store_member(self, m) -> None:
+        """Harvest-side population (tpu/pipeline.py emit): cache a
+        fully-materialized member's result when its shape matches the
+        query's cache kind."""
+        evicted: list = []
+        bis = [bi for bi, _bs in m.blocks]
+        if self.kind == "stats":
+            # only a FULLY partial-handled member replays exactly; a
+            # mixed member (some blocks fell back to bitmaps) declines
+            if m.handled == set(bis):
+                self._store(m.part, bis, "stats", list(m.partials),
+                            evicted)
+        elif not m.partials and not m.handled and \
+                all(bi in m.bms for bi in bis):
+            packed = {bi: (int(m.bms[bi].shape[0]),
+                           np.packbits(m.bms[bi]))
+                      for bi in bis}
+            self._store(m.part, bis, "bms", packed, evicted)
+        _emit_evictions(evicted)
+
+    def store_bms(self, part, bis, bms: dict) -> None:
+        """Serial-walk population (engine/searcher._scan_parts)."""
+        if self.kind != "bms" or any(bi not in bms for bi in bis):
+            return
+        evicted: list = []
+        packed = {bi: (int(bms[bi].shape[0]), np.packbits(bms[bi]))
+                  for bi in bis}
+        self._store(part, bis, "bms", packed, evicted)
+        _emit_evictions(evicted)

@@ -138,10 +138,19 @@ def _walk(storage, tenants, q, runner, detail: bool) -> dict:
         tree["filter"] = filter_plan_tree(q.filter)
 
     tot = {"parts_total": 0, "parts_retained": 0, "parts_killed": 0,
-           "blocks_candidate": 0, "rows_scanned": 0, "bytes_scanned": 0,
-           "dispatches": 0, "bytes_staged": 0}
+           "parts_cached": 0, "blocks_candidate": 0, "rows_scanned": 0,
+           "bytes_scanned": 0, "dispatches": 0, "bytes_staged": 0}
     cost = {"rtt_s": 0.0, "device_scan_s": 0.0, "upload_s": 0.0,
             "emit_s": 0.0, "host_s": 0.0}
+
+    # result-cache peek (engine/standing/resultcache.py): parts whose
+    # answer would replay from the cache are priced ~0 — the admission
+    # layer then charges a repeated query only its post-cache residual
+    # scan (price-after-cache).  peek touches no counters and no LRU
+    # state, so explain=1 stays a pure read.
+    from ..engine.standing.resultcache import QueryCache
+    qcache = QueryCache.for_query(q, tenants, stats_spec, sort_spec,
+                                  min_ts, max_ts)
 
     from ..tpu import pipeline as _pipeline
     cross = batch and _pipeline.cross_partition_enabled()
@@ -150,7 +159,7 @@ def _walk(storage, tenants, q, runner, detail: bool) -> dict:
     for pt in storage.select_partitions(min_ts, max_ts):
         pnode, retained = _walk_partition(
             pt, tenants, tenant_set, min_ts, max_ts, sfs,
-            token_leaves, detail, tot)
+            token_leaves, detail, tot, qcache)
         if retained:
             active_pts += 1
         retained_all.extend((pnode, p, b, rc, be)
@@ -231,7 +240,7 @@ def _part_header_table(part) -> dict:
 
 
 def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
-                    token_leaves, detail, tot):
+                    token_leaves, detail, tot, qcache=None):
     from ..storage.filterbank import aggregate_kill_leaf
 
     pnode: dict = {"name": "partition",
@@ -358,6 +367,17 @@ def _walk_partition(pt, tenants, tenant_set, min_ts, max_ts, sfs,
                 rows_cand = sum(part.block_rows(bi) for bi in bis)
                 if detail:
                     node["maplet_exact"] = True
+        if qcache is not None and qcache.peek(part, bis):
+            # the part's answer replays from the result cache: it is
+            # retained but priced ~0 (no dispatch, no bytes scanned) —
+            # the dashboard-refresh query pays only its unsealed head
+            tot["parts_retained"] += 1
+            tot["parts_cached"] += 1
+            if detail:
+                node.update(status="retained", cached=True,
+                            blocks_candidate=len(bis))
+                pnode["parts"].append(node)
+            continue
         bytes_est = int(rows_cand * activity.part_bytes_per_row(part))
         tot["parts_retained"] += 1
         tot["blocks_candidate"] += len(bis)

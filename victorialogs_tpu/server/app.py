@@ -14,6 +14,7 @@ import gzip
 import io
 import json
 import os
+import queue
 import re
 import threading
 import time
@@ -35,7 +36,7 @@ from .vlselect import (HTTPError, handle_explain, handle_facets,
                        handle_stats_query_range,
                        handle_stream_field_names, handle_stream_field_values,
                        handle_stream_ids, handle_streams, handle_tail,
-                       query_timeout_s, want_explain)
+                       parse_common_args, query_timeout_s, want_explain)
 
 
 def escape_label_value(v: str) -> str:
@@ -170,6 +171,16 @@ class Metrics:
         # ingest-spool accounting (server/netrobust.py)
         from . import netrobust as _netrobust
         for base, labels, v in _netrobust.metrics_samples():
+            add(metric_name(base, **labels), v)
+        # standing-query plane: per-part result-cache occupancy and
+        # hit/miss/eviction accounting plus the resident standing
+        # registrations and their re-evaluation totals
+        # (engine/standing/)
+        from ..engine.standing import resultcache as _resultcache
+        from ..engine.standing import manager as _standing
+        for base, labels, v in _resultcache.metrics_samples():
+            add(metric_name(base, **labels), v)
+        for base, labels, v in _standing.metrics_samples():
             add(metric_name(base, **labels), v)
         if server is not None and \
                 hasattr(getattr(server, "sink", None),
@@ -620,11 +631,24 @@ class VLServer(BaseHTTPApp):
         # (emit() structurally zero-cost).  Never behind admission: the
         # journal must not be shed by the overload it records.
         self.journal = journal.maybe_start(self.sink)
+        # standing-query registry (engine/standing/manager.py):
+        # resident merged state per distinct query fingerprint,
+        # re-evaluated on flush/merge bus events, deltas fanned out to
+        # tail-style subscriber streams.  Evaluates against the SAME
+        # storage facade interactive queries use (local storage, or the
+        # scatter-gather view on a cluster frontend) and is priced
+        # through the select admission pool like any tenant workload.
+        from ..engine.standing import StandingRegistry
+        self.standing = StandingRegistry(
+            self.query_storage, runner=runner,
+            admission=self.admission)
         try:
             self._start_http(listen_addr, port)
         except BaseException:
             # a failed bind must not leak the journal's bus
-            # subscription + flush thread (nor the usage poll loop)
+            # subscription + flush thread (nor the usage poll loop or
+            # the standing registry's worker/bus subscription)
+            self.standing.close()
             if self.journal is not None:
                 self.journal.close()
             if self.clusterstats is not None:
@@ -786,6 +810,16 @@ class VLServer(BaseHTTPApp):
             except ValueError as e:
                 raise HTTPError(400, str(e))
             self.respond_json(h, {"status": "ok", "top_queries": top})
+            return
+
+        if path == "/select/logsql/standing_query":
+            # standing queries (engine/standing): NOT behind the
+            # select gate itself — registration/introspection must work
+            # on a shedding server, and the re-evaluations the registry
+            # runs are individually priced through the SAME admission
+            # pool (manager._reeval), so the workload is still
+            # accounted per tenant
+            self.handle_standing_query(h, path, args, headers)
             return
 
         # ---- queries (admission-controlled: per-tenant limits, a
@@ -955,6 +989,10 @@ class VLServer(BaseHTTPApp):
                      f"unknown path {path}".encode())
 
     def close(self) -> None:
+        # stop standing re-evaluations FIRST: they run queries against
+        # the storage being torn down and emit journal events the
+        # (still-alive) journal should record
+        self.standing.close()
         # stop the usage poll loop (reads only; before the sink so a
         # mid-poll node error can't race the teardown)
         if self.clusterstats is not None:
@@ -984,6 +1022,83 @@ class VLServer(BaseHTTPApp):
         if activity.current_activity().counter("partial_failed_nodes"):
             return {"X-VL-Partial": "true"}
         return {}
+
+    def handle_standing_query(self, h, path, args, headers) -> None:
+        """/select/logsql/standing_query — GET lists registrations
+        (?cluster=1 federates the view on a frontend); POST with
+        ?unregister=1&fingerprint= tears one down (federated on a
+        frontend); POST with ?query= registers (or joins) the standing
+        evaluation and streams result deltas until the client goes
+        away.  N dashboard panels asking the same query collapse to
+        ONE resident evaluation per node."""
+        from ..engine.standing.manager import StandingLimit
+        reg = self.standing
+        urls = self._cluster_urls()
+        if h.command != "POST":
+            # introspection: local registrations, or the cluster-wide
+            # view (every node's registry + this frontend's own)
+            if _want_cluster(args) and urls:
+                from . import cluster
+                self.respond_json(
+                    h, cluster.federated_standing_queries(urls))
+                return
+            self.respond_json(h, {
+                "status": "ok", "cluster": False,
+                "standing_queries": reg.snapshot()})
+            return
+        if args.get("unregister", "") not in ("", "0"):
+            fp = args.get("fingerprint", "")
+            if not fp:
+                raise HTTPError(400, "missing fingerprint arg")
+            resp = {"status": "ok", "fingerprint": fp,
+                    "removed": int(reg.unregister(fp))}
+            if urls:
+                # best-effort cascade, retry=False like cancel
+                # propagation: an unregister that already landed must
+                # not double-count on a transport blip
+                from . import cluster
+                resp["propagated"] = \
+                    cluster.federated_standing_unregister(urls, fp)
+            self.respond_json(h, resp)
+            return
+        # POST with a query: register (or join) + subscribe; the
+        # response is a tail-style chunked NDJSON stream whose first
+        # line carries the fingerprint (the unregister/introspection
+        # handle), followed by one payload per changed re-evaluation
+        q, tenants = parse_common_args(self.query_storage, args,
+                                       headers)
+        try:
+            fp = reg.register(q, tenants,
+                              parent_qid=args.get("parent_qid", ""))
+        except StandingLimit as e:
+            status = 503 if "VL_STANDING=0" in str(e) else 429
+            self.respond(h, status, "text/plain",
+                         (str(e) + "\n").encode())
+            return
+        sub = reg.attach_subscriber(fp)
+        gone = self._peer_gone(h)
+        with activity.reuse_or_track(path, q.to_string(),
+                                     tenants[0]) as act:
+            def gen():
+                yield (json.dumps({"standing_fingerprint": fp})
+                       + "\n").encode()
+                while True:
+                    if gone() or act.is_cancelled():
+                        return
+                    try:
+                        payload = sub.get(timeout=1.0)
+                    except queue.Empty:
+                        # keep-alive tick: respond_stream drops empty
+                        # chunks, so this only drives the gone() probe
+                        yield b""
+                        continue
+                    if payload is None:
+                        return  # unregistered underneath us
+                    yield payload
+            try:
+                self.respond_stream(h, gen())
+            finally:
+                reg.detach_subscriber(fp, sub)
 
     def handle_select(self, h, path, args, headers) -> None:
         s = self.query_storage

@@ -1010,6 +1010,56 @@ def propagate_cancel(urls, qid: str, gqid: str,
     return out
 
 
+def federated_standing_queries(urls,
+                               timeout: float | None = None) -> dict:
+    """GET /select/logsql/standing_query?cluster=1: this frontend's
+    standing registrations plus every node's, each node's entries
+    attributed to it.  A node that cannot answer is marked down —
+    degraded view, never an error."""
+    from ..engine.standing import manager as _standing
+    path = "/select/logsql/standing_query"
+    local = _standing.standing_snapshot()
+    results, failures = _fanout_json(urls, path, timeout=timeout)
+    nodes = []
+    for url in urls:
+        if url in failures:
+            # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+            nodes.append({"node": url, "up": False,
+                          "error": failures[url]})
+            continue
+        entries = results[url].get("standing_queries") or []
+        # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+        nodes.append({"node": url, "up": True,
+                      "standing_queries": entries})
+    out = {"status": "ok", "cluster": True,
+           "standing_queries": local, "nodes": nodes}
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
+def federated_standing_unregister(urls, fp: str,
+                                  timeout: float | None = None) -> dict:
+    """Cascade one standing-query unregister to every storage node
+    (POST /select/logsql/standing_query?unregister=1): a panel torn
+    down at the frontend must not leave node-local registrations
+    re-evaluating forever.  retry=False — an unregister that landed
+    must not double-count on a transport blip; best-effort like cancel
+    propagation (a dead node's registry died with it)."""
+    from urllib.parse import urlencode
+    path = ("/select/logsql/standing_query?"
+            + urlencode({"unregister": "1", "fingerprint": fp}))
+    results, failures = _fanout_json(urls, path, method="POST",
+                                     timeout=timeout, retry=False)
+    removed = sum(int(r.get("removed") or 0)
+                  for r in results.values())
+    out = {"removed": removed, "nodes_ok": len(results),
+           "nodes_failed": len(failures)}
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
 class NetSelectStorage:
     """Query layer over N storage nodes: remote/local pipe split, parallel
     fan-out, first-error cancellation (netselect.go:324-369)."""

@@ -977,6 +977,9 @@ class BatchRunner:
         self.packed_parts = 0         # parts folded into super-dispatches
         self.packed_topk_dispatches = 0  # sort-topk super-dispatches
         self.cross_partition_packs = 0  # packs spanning a day boundary
+        self.result_cache_units = 0    # units satisfied from the
+        #                                per-part result cache (no
+        #                                dispatch, no slot lease)
         # widest bucket one-hot any stats dispatch paid (the seg-major
         # kernel keeps this at the BASE bucket product — it must not
         # scale with VL_PACK_PARTS; bench-asserted)
@@ -1044,6 +1047,7 @@ class BatchRunner:
                 "packed_parts": self.packed_parts,
                 "packed_topk_dispatches": self.packed_topk_dispatches,
                 "cross_partition_packs": self.cross_partition_packs,
+                "result_cache_units": self.result_cache_units,
                 "stats_onehot_width": self.stats_onehot_width,
                 "inflight_hwm": self.inflight_hwm,
                 "host_sync_wait_s": self.host_sync_wait_s,

@@ -311,6 +311,37 @@ class _UnitReady:
         return self._members
 
 
+class _CacheHit:
+    """Planning marker for a part whose result came from the per-part
+    result cache (engine/standing/resultcache.py).  num_rows sits above
+    every pack cap so iter_pack_groups keeps the hit in its own
+    singleton group — a cached part must never join a pack dispatch."""
+
+    __slots__ = ("part", "entry")
+    num_rows = 1 << 62
+
+    def __init__(self, part, entry):
+        self.part = part
+        self.entry = entry
+
+
+class _CachedUnit:
+    """A unit satisfied entirely from the result cache: no prefetch, no
+    dispatch, no scheduler slot — it rides the window as an
+    already-materialized member so harvest stays in submission order
+    (downstream block order and stats absorb order bit-identical to the
+    uncached walk)."""
+
+    pack = False
+    cached = True
+
+    def __init__(self, part, member: "_Member"):
+        self.part = part
+        self.bss: dict = {}
+        self.members = [(part, member.blocks)]
+        self.ready = [member]
+
+
 class _SingleRows:
     def __init__(self, unit: _Unit, pending):
         self.unit = unit
@@ -433,7 +464,7 @@ def iter_pack_groups(items, packable: bool, pack_max: int,
 
 
 def _unit_stream(runner, items, head, stats_spec, sort_spec,
-                 token_leaves, check_deadline):
+                 token_leaves, check_deadline, qcache=None):
     """Lazily fold the pruned part stream into dispatch units, in part
     order.  `items` yields (part, cand_fn, ctx) — the cross-partition
     window feeds parts from EVERY selected partition through one
@@ -455,7 +486,22 @@ def _unit_stream(runner, items, head, stats_spec, sort_spec,
                                       part_aggregate_prunes)
     packable, pack_max, rows_cap = pack_policy(runner, sort_spec)
 
-    def make_unit(group) -> _Unit:
+    def make_unit(group):
+        if len(group) == 1 and isinstance(group[0][0], _CacheHit):
+            hit, bis, ctx = group[0]
+            e = hit.entry
+            if e.kind == "stats":
+                member = _Member(hit.part, [], {}, set(),
+                                 qcache.entry_partials(e))
+            else:
+                blocks = []
+                for bi in bis:
+                    bs = BlockSearch(hit.part, bi)
+                    bs.ctx = ctx
+                    blocks.append((bi, bs))
+                member = _Member(hit.part, blocks, qcache.entry_bms(e),
+                                 set(), [])
+            return _CachedUnit(hit.part, member)
         if len(group) == 1:
             p, bis, ctx = group[0]
             bss = {}
@@ -512,6 +558,13 @@ def _unit_stream(runner, items, head, stats_spec, sort_spec,
             # registry progress at part granularity (the planning pull
             # IS the prune stage, so these land as the walk advances)
             activity.note_part_scanned(act, part, bis)
+            if qcache is not None:
+                e = qcache.probe(part, bis)
+                if e is not None:
+                    # result cached from an earlier identical query:
+                    # the part never enters the dispatch stream
+                    yield _CacheHit(part, e), bis, ctx
+                    continue
             yield part, bis, ctx
 
     for group in iter_pack_groups(pruned(), packable, pack_max,
@@ -672,7 +725,7 @@ def _make_sync(runner):
 
 def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
                       deadline, stats_spec, sort_spec,
-                      token_leaves) -> None:
+                      token_leaves, qcache=None) -> None:
     """Drive ONE partition's parts through the async dispatch window
     (the VL_CROSS_PARTITION=0 compatibility shape: the window drains at
     the partition boundary).  The default path is scan_device_stream,
@@ -682,11 +735,12 @@ def scan_parts_device(parts, q, head, runner, cand_fn, ctx, needed,
     act.add("parts_total", len(parts))
     scan_device_stream(((p, cand_fn, ctx) for p in parts), q, head,
                        runner, needed, deadline, stats_spec, sort_spec,
-                       token_leaves)
+                       token_leaves, qcache=qcache)
 
 
 def scan_device_stream(items, q, head, runner, needed, deadline,
-                       stats_spec, sort_spec, token_leaves) -> None:
+                       stats_spec, sort_spec, token_leaves,
+                       qcache=None) -> None:
     """Drive a cross-partition part stream through the async dispatch
     window.
 
@@ -731,6 +785,11 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
     def emit(members: list) -> None:
         sp = tracing.current_span()
         for m in members:
+            if qcache is not None:
+                # harvest-side population: a fully-materialized member
+                # is the per-part answer a repeated query can replay
+                # (store skips parts this query already hit on)
+                qcache.store_member(m)
             if stats_spec is not None and m.partials:
                 sp.add("stats_partials", len(m.partials))
                 _absorb_stats_partials(head, q, stats_spec, m.partials)
@@ -748,7 +807,7 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
                 head.write_block(br)
 
     stream = _unit_stream(runner, items, head, stats_spec, sort_spec,
-                          token_leaves, check_deadline)
+                          token_leaves, check_deadline, qcache=qcache)
     lookahead: deque = deque()
     exhausted = False
     prefetched: set = set()
@@ -789,7 +848,7 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
             prsp.set("units_planned", planned)
 
     def harvest_one() -> None:
-        hseq, hunit, t_submit, pending = window.popleft()
+        hseq, hunit, t_submit, pending, leased = window.popleft()
         act.set_phase("harvest")
         act.set("dispatches_in_flight", len(window))
         with psp.span("harvest", unit=hseq) as hsp:
@@ -810,7 +869,10 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
             # drain fires.  Bounded and self-healing, but a
             # completion-driven release (harvest on dispatch-done
             # callbacks) would free them earlier — ROADMAP follow-on.
-            slots.release()
+            # Cached units never leased a slot (nothing dispatched),
+            # so only leased entries return one.
+            if leased:
+                slots.release()
             # _UnitReady units never dispatched (host gate / serial
             # fallback): their submit-to-harvest time is pure window
             # queue wait and must not pollute the device-RTT histogram
@@ -867,7 +929,8 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
                     # prefetch as the pack, hitting the same #fl/#num
                     # staging keys the super-dispatch will use)
                     todo = [uj for uj in lookahead
-                            if uj.part.uid not in prefetched]
+                            if not getattr(uj, "cached", False)
+                            and uj.part.uid not in prefetched]
                     if todo:
                         with psp.span("stage", units=len(todo)):
                             for uj in todo:
@@ -883,6 +946,21 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
                     while len(window) >= depth:
                         check_deadline()
                         harvest_one()
+                    if getattr(unit, "cached", False):
+                        # a result-cache hit: rides the window for
+                        # submission-order harvest but skips the slot
+                        # lease, the dispatch counters and prefetch —
+                        # the part's price collapsed to ~0
+                        runner._bump("result_cache_units")
+                        window.append((seq, unit, time.perf_counter(),
+                                       _UnitReady(unit.ready), False))
+                        seq += 1
+                        runner._bump_max("inflight_hwm", len(window))
+                        if act.enabled:
+                            act.add("result_cache_hits")
+                            act.set("dispatches_in_flight",
+                                    len(window))
+                        continue
                     # lease the submit slot from the shared scheduler:
                     # fast-path non-blocking grant (uncontended budget
                     # behaves exactly like the per-query window); under
@@ -927,7 +1005,7 @@ def scan_device_stream(items, q, head, runner, needed, deadline,
                         window.append((seq, unit, time.perf_counter(),
                                        _submit(runner, f, unit,
                                                stats_spec, sort_spec,
-                                               spec_seg)))
+                                               spec_seg), True))
                     seq += 1
                     runner._bump_max("inflight_hwm", len(window))
                     if act.enabled:
