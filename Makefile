@@ -7,8 +7,8 @@ NATIVE_DIR := victorialogs_tpu/native
 
 .PHONY: all native test race lint check help bench bench-bloom \
 	bench-pipeline bench-cluster-obs bench-concurrent bench-emit \
-	bench-explain bench-faults bench-journal bench-standing \
-	bench-wire clean
+	bench-explain bench-faults bench-ingest bench-journal \
+	bench-standing bench-wire clean
 
 all: native
 
@@ -130,6 +130,14 @@ bench-cluster-obs:
 # evaluation) — PERF.md round
 bench-standing:
 	python tools/bench_standing.py --json BENCH_standing.json
+
+# typed ingest wire format i1 end-to-end: library hot path (+4-core
+# Amdahl projection), i1 codec encode/decode rates, typed-vs-legacy
+# insert hop (>=3x, zero per-row json.loads pinned by counters),
+# spool-replay chaos (zero rows lost, zero re-encodes), and the
+# typed-vs-legacy stored-data differential — PERF.md round 16
+bench-ingest:
+	python tools/bench_ingest.py --json BENCH_ingest.json
 
 clean:
 	rm -f $(NATIVE_DIR)/libvlnative.so

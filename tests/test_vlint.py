@@ -978,7 +978,8 @@ def test_balance_pair_registry_inventory():
     assert names == {"bloom-bank", "sched-lease", "admission",
                      "staging-cache", "events-subscription",
                      "journal-accounting", "net-probe", "insert-spool",
-                     "result-cache", "standing-subscription"}
+                     "result-cache", "standing-subscription",
+                     "ingest-encoder-pool"}
     runtime = {p.name for p in PAIRS if p.runtime_only}
     assert runtime == {"staging-cache", "journal-accounting"}
 

@@ -1,0 +1,657 @@
+"""Typed ingest wire format "i1" (server/wire_ingest.py): codec round
+trips + differential typed-vs-legacy STORED DATA over many payload
+shapes, corruption suite (every truncation prefix, forged offsets /
+lengths / refs, bad magic -> whole-batch 400, never partial ingest),
+mixed-version negotiation in BOTH directions under the
+VL_WIRE_TYPED_INSERT kill switch, vlagent single-encode-across-retries,
+spool-replay chaos (dead node -> zero rows lost), and the
+zero-per-row-json.loads pin on the storage hop."""
+
+import http.client
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.obs import events, tracing
+from victorialogs_tpu.server import cluster, vlagent, wire_ingest
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.utils import zstd as _zstd
+from victorialogs_tpu.utils.hashing import stream_id_hash
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+# ---------------- helpers ----------------
+
+def _rows_lr(rows, stream_fields=("app",)):
+    """[(tenant, ts, {field: value})...] -> LogRows."""
+    lr = LogRows(stream_fields=list(stream_fields))
+    for tenant, ts, fields in rows:
+        lr.add(tenant, ts, list(fields.items()))
+    return lr
+
+
+def _flatten(lc):
+    """Order-insensitive content view of a columnar batch: one tuple
+    per row carrying tenant, ts, canonical stream tags and all
+    fields."""
+    out = []
+    for names, g in lc.groups.items():
+        for k in range(len(g.ts)):
+            sid, tenant, tags = g.streams[g.sref[k]]
+            out.append(((tenant.account_id, tenant.project_id),
+                        g.ts[k], tags,
+                        tuple(sorted((nm, c[k])
+                                     for nm, c in zip(names, g.cols)))))
+    return sorted(out)
+
+
+def _decode_body(body: bytes):
+    data = _zstd.decompress(body, max_output_size=1 << 30)
+    assert data.startswith(wire_ingest.INSERT_MAGIC)
+    return wire_ingest.decode_frame(data)
+
+
+def _store_rows(tmp_path, name, body):
+    """One wire body -> a fresh Storage via the real storage-hop
+    decoder (handle_internal_insert), flushed."""
+    s = Storage(str(tmp_path / name), retention_days=100000,
+                flush_interval=3600)
+    n = cluster.handle_internal_insert(s, {}, body)
+    s.debug_flush()
+    return s, n
+
+
+def _query_lines(s, tenants, q="*"):
+    from victorialogs_tpu.engine.emit import ndjson_block
+    from victorialogs_tpu.engine.searcher import run_query
+    blocks = []
+    run_query(s, tenants, q, write_block=blocks.append,
+              timestamp=T0 + 3600 * NS)
+    lines = []
+    for br in blocks:
+        lines.extend(ndjson_block(br).splitlines())
+    return sorted(lines)
+
+
+def _req(srv, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _mk_server(path, port=0, **kw):
+    from victorialogs_tpu.server.app import VLServer
+    storage = Storage(str(path), retention_days=100000,
+                      flush_interval=3600)
+    return VLServer(storage, listen_addr="127.0.0.1", port=port, **kw)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------- payload shapes (differential corpus) ----------------
+
+def _shape_rows(shape: str):
+    mk = {"app": "web"}
+    if shape == "basic":
+        return [(TEN, T0 + i * NS, {**mk, "_msg": f"m{i}", "k": str(i)})
+                for i in range(20)]
+    if shape == "non_ascii":
+        return [(TEN, T0 + i * NS,
+                 {**mk, "_msg": f"héllo ✓ {i} é中文",
+                  "emoji": "🚀" * (i % 4)})
+                for i in range(12)]
+    if shape == "empty_values":
+        return [(TEN, T0 + i * NS, {**mk, "_msg": "", "empty": ""})
+                for i in range(8)]
+    if shape == "huge_field":
+        return [(TEN, T0, {**mk, "_msg": "x" * (256 << 10)}),
+                (TEN, T0 + NS, {**mk, "_msg": "small"})]
+    if shape == "multi_schema":
+        rows = [(TEN, T0 + i * NS, {**mk, "_msg": f"a{i}", "only_a": "1"})
+                for i in range(7)]
+        rows += [(TEN, T0 + i * NS, {**mk, "_msg": f"b{i}", "only_b": "2",
+                                     "extra": "e"})
+                 for i in range(9)]
+        return rows
+    if shape == "multi_tenant":
+        return [(TenantID(i % 3, (i * 7) % 5), T0 + i * NS,
+                 {**mk, "_msg": f"t{i}"}) for i in range(21)]
+    if shape == "many_streams":
+        return [(TEN, T0 + i * NS,
+                 {"app": f"app{i % 30}", "_msg": f"s{i}"})
+                for i in range(90)]
+    if shape == "quoting":
+        return [(TEN, T0 + i * NS,
+                 {**mk, "_msg": f'q"uo\\te {i}\tx\nnewline\x01ctl'})
+                for i in range(6)]
+    if shape == "single_row":
+        return [(TEN, T0, {**mk, "_msg": "only one"})]
+    if shape == "extreme_ts":
+        return [(TEN, 1, {**mk, "_msg": "epoch"}),
+                (TEN, T0 + 86_399 * NS, {**mk, "_msg": "late"})]
+    if shape == "dictish":
+        return [(TEN, T0 + i * NS,
+                 {**mk, "_msg": f"d{i}", "lvl": ["info", "warn"][i % 2]})
+                for i in range(16)]
+    if shape == "no_stream_fields":
+        return [(TEN, T0 + i * NS, {"_msg": f"ns{i}"}) for i in range(5)]
+    raise AssertionError(shape)
+
+
+SHAPES = ["basic", "non_ascii", "empty_values", "huge_field",
+          "multi_schema", "multi_tenant", "many_streams", "quoting",
+          "single_row", "extreme_ts", "dictish", "no_stream_fields"]
+
+
+def _shape_lc(shape: str):
+    sf = () if shape == "no_stream_fields" else ("app",)
+    return wire_ingest.rows_to_columns(_rows_lr(_shape_rows(shape), sf))
+
+
+# ---------------- codec round trips ----------------
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_codec_roundtrip_shapes(shape):
+    lc = _shape_lc(shape)
+    body = wire_ingest.encode_columns(lc)
+    lc2 = _decode_body(body)
+    assert lc2.nrows == lc.nrows
+    assert _flatten(lc2) == _flatten(lc)
+    # StreamIDs are NOT shipped: the decoder recomputed every one from
+    # the canonical tags bytes (forged-frame hardening)
+    for g in lc2.groups.values():
+        for sid, _tenant, tags in g.streams:
+            hi, lo = stream_id_hash(tags.encode("utf-8"))
+            assert (sid.hi, sid.lo) == (hi, lo)
+
+
+def test_codec_empty_batch():
+    from victorialogs_tpu.storage.log_rows import LogColumns
+    lc = LogColumns()
+    body = wire_ingest.encode_columns(lc)
+    lc2 = _decode_body(body)
+    assert lc2.nrows == 0 and not lc2.groups
+
+
+def test_encode_rows_matches_encode_columns():
+    lr = _rows_lr(_shape_rows("basic"))
+    lc = wire_ingest.rows_to_columns(lr)
+    assert _flatten(_decode_body(wire_ingest.encode_rows(lr))) == \
+        _flatten(lc)
+
+
+def test_reencode_legacy_roundtrip():
+    lc = _shape_lc("non_ascii")
+    typed = wire_ingest.encode_columns(lc)
+    legacy = wire_ingest.reencode_legacy(typed)
+    assert legacy is not None
+    lines = _zstd.decompress(legacy, max_output_size=1 << 30)
+    rows = [json.loads(ln) for ln in lines.splitlines() if ln]
+    assert len(rows) == lc.nrows
+    # a legacy body is NOT re-reencodable (idempotence guard)
+    assert wire_ingest.reencode_legacy(legacy) is None
+    assert wire_ingest.reencode_legacy(b"not zstd at all") is None
+
+
+def test_encode_overflow_falls_back_to_legacy():
+    # tenant ids beyond u32 can't ride i1: plain ValueError so senders
+    # fall back to legacy lines (never a corrupted frame on the wire)
+    bad = _rows_lr([(TenantID(1 << 32, 0), T0, {"app": "w",
+                                                "_msg": "x"})])
+    lc = wire_ingest.rows_to_columns(bad)
+    with pytest.raises(ValueError):
+        wire_ingest.encode_columns(lc)
+    body = vlagent.encode_rows(bad)
+    data = _zstd.decompress(body, max_output_size=1 << 30)
+    assert not data.startswith(wire_ingest.INSERT_MAGIC)
+    assert data.lstrip().startswith(b"{")
+
+
+# ---------------- differential: typed vs legacy stored data ----------
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_differential_stored_data_identical(shape, tmp_path):
+    """The SAME batch shipped as an i1 frame and as legacy JSON lines
+    must produce identical stored data through the real storage hop
+    (handle_internal_insert -> Storage -> query)."""
+    lc = _shape_lc(shape)
+    tenants = sorted({tenant
+                      for g in lc.groups.values()
+                      for _sid, tenant, _tags in g.streams})
+    s_t, n_t = _store_rows(tmp_path, "typed",
+                           wire_ingest.encode_columns(lc))
+    s_l, n_l = _store_rows(tmp_path, "legacy",
+                           wire_ingest.encode_legacy_columns(lc))
+    try:
+        assert n_t == n_l == lc.nrows
+        got_t = _query_lines(s_t, tenants)
+        got_l = _query_lines(s_l, tenants)
+        assert len(got_t) == lc.nrows
+        assert got_t == got_l, shape
+    finally:
+        s_t.close()
+        s_l.close()
+
+
+def test_typed_hop_zero_per_row_json_loads(tmp_path, monkeypatch):
+    """The storage node's typed decode path never touches json.loads —
+    pinned structurally (a bombed json.loads) AND by the rx_rows
+    counters."""
+    lc = _shape_lc("basic")
+    body = wire_ingest.encode_columns(lc)
+
+    def bomb(*_a, **_k):
+        raise AssertionError("json.loads on the typed insert hop")
+    import types
+    monkeypatch.setattr(cluster, "json",
+                        types.SimpleNamespace(loads=bomb))
+    c0 = wire_ingest.counters()
+    s, n = _store_rows(tmp_path, "zjson", body)
+    try:
+        c1 = wire_ingest.counters()
+        assert n == lc.nrows
+        assert c1.get("rx_rows_typed", 0) - c0.get("rx_rows_typed", 0) \
+            == lc.nrows
+        assert c1.get("rx_rows_json", 0) == c0.get("rx_rows_json", 0)
+        assert c1.get("rx_frames_typed", 0) \
+            == c0.get("rx_frames_typed", 0) + 1
+    finally:
+        s.close()
+
+
+# ---------------- corruption suite ----------------
+
+def _payload(shape="basic") -> bytes:
+    return _zstd.decompress(
+        wire_ingest.encode_columns(_shape_lc(shape)),
+        max_output_size=1 << 30)
+
+
+def test_truncation_at_every_prefix_raises():
+    payload = _payload("multi_schema")
+    for cut in range(len(wire_ingest.INSERT_MAGIC), len(payload)):
+        with pytest.raises(wire_ingest.WireInsertError):
+            wire_ingest.decode_frame(payload[:cut])
+    with pytest.raises(wire_ingest.WireInsertError):
+        wire_ingest.decode_frame(payload + b"junk")
+    with pytest.raises(wire_ingest.WireInsertError):
+        wire_ingest.decode_frame(b"\x00NOPE" + payload[5:])
+
+
+def _mk_frame(total_rows=1, n_streams=1, tags=b"{app=\"w\"}",
+              tag_off=0, tag_len=None, names=("_msg",),
+              stream_pos=(), n_rows=1, srefs=(0,), arena=b"hi",
+              offs=(0,), lens=(2,), groups_extra=b"",
+              n_groups=1):
+    """Hand-built i1 payload so forged geometry survives to the
+    decoder (mirrors the frame layout pinned in the module
+    docstring)."""
+    if tag_len is None:
+        tag_len = len(tags)
+    p = [wire_ingest.INSERT_MAGIC,
+         struct.pack("<IIH", total_rows, n_streams, n_groups),
+         struct.pack("<I", len(tags)), tags]
+    for _ in range(n_streams):
+        p.append(struct.pack("<IIII", tag_off, tag_len, 0, 0))
+    p.append(struct.pack("<H", len(names)))
+    for nm in names:
+        nb = nm.encode()
+        p.append(struct.pack("<H", len(nb)) + nb)
+    p.append(struct.pack("<H", len(stream_pos)))
+    p.append(np.asarray(stream_pos, dtype="<u2").tobytes())
+    p.append(struct.pack("<I", n_rows))
+    p.append(np.full(n_rows, T0, dtype="<i8").tobytes())
+    p.append(np.asarray(srefs, dtype="<u4").tobytes())
+    for _ in names:
+        p.append(struct.pack("<I", len(arena)) + arena)
+        p.append(np.asarray(offs, dtype="<u4").tobytes())
+        p.append(np.asarray(lens, dtype="<u4").tobytes())
+    p.append(groups_extra)
+    return b"".join(p)
+
+
+def test_layout_pin_handcrafted_frame_decodes():
+    lc = wire_ingest.decode_frame(_mk_frame())
+    assert lc.nrows == 1
+    assert _flatten(lc)[0][3] == (("_msg", "hi"),)
+
+
+@pytest.mark.parametrize("mutation,kw", [
+    ("value offset past arena", dict(offs=(1 << 30,))),
+    ("value length past arena", dict(offs=(1,), lens=(2,))),
+    ("stream ref out of range", dict(srefs=(7,))),
+    ("stream pos out of range", dict(stream_pos=(5,))),
+    ("tags slice out of range", dict(tag_off=4, tag_len=100)),
+    ("row count mismatch", dict(total_rows=9)),
+    ("invalid utf-8 value arena", dict(arena=b"\xff\xfe", lens=(2,))),
+    ("invalid utf-8 tags arena", dict(tags=b"\xff\xfe\x00\x00",
+                                      tag_len=4)),
+])
+def test_forged_frames_raise(mutation, kw):
+    with pytest.raises(wire_ingest.WireInsertError):
+        wire_ingest.decode_frame(_mk_frame(**kw))
+
+
+def test_duplicate_schema_group_raises():
+    one = _mk_frame()
+    # append a second identical group record (same names tuple)
+    group = one[one.index(b"\x01\x00\x04\x00_msg"):]
+    forged = one.replace(
+        struct.pack("<IIH", 1, 1, 1),
+        struct.pack("<IIH", 2, 1, 2)) + group
+    with pytest.raises(wire_ingest.WireInsertError):
+        wire_ingest.decode_frame(forged)
+
+
+def test_corrupt_body_is_http_400_whole_batch(tmp_path):
+    """Corruption -> 400 and ZERO rows ingested, even when the frame
+    carries some valid rows before the corruption (whole-batch
+    discipline, no partial ingest)."""
+    srv = _mk_server(tmp_path / "corrupt")
+    try:
+        good = _payload("basic")
+        # forged stream ref: the rest of the frame is structurally
+        # fine, the batch must still die whole
+        bad = _mk_frame(srefs=(3,))
+        for body, want in [
+                (_zstd.compress(bad), 400),
+                (_zstd.compress(good[:len(good) - 3]), 400),
+                (b"not even zstd", 400),
+                (_zstd.compress(good), 200)]:
+            status, out = _req(srv, "POST", "/internal/insert",
+                               body=body)
+            assert status == want, out[:200]
+            if want == 400:
+                _req(srv, "GET", "/internal/force_flush")
+                assert _query_lines(srv.storage, [TEN]) == []
+    finally:
+        srv.close()
+        srv.storage.close()
+
+
+# ---------------- mixed-version negotiation (both directions) --------
+
+def _count_http(srv, q="*"):
+    _req(srv, "GET", "/internal/force_flush")
+    return len(_query_lines(srv.storage, [TEN], q))
+
+
+def test_killswitch_receiver_rejects_typed(tmp_path, monkeypatch):
+    srv = _mk_server(tmp_path / "ks")
+    try:
+        body = wire_ingest.encode_columns(_shape_lc("basic"))
+        monkeypatch.setenv("VL_WIRE_TYPED_INSERT", "0")
+        status, out = _req(srv, "POST", "/internal/insert", body=body)
+        assert status == 400 and b"VL_WIRE_TYPED_INSERT" in out
+        assert _count_http(srv) == 0
+        monkeypatch.delenv("VL_WIRE_TYPED_INSERT")
+        status, _ = _req(srv, "POST", "/internal/insert", body=body)
+        assert status == 200
+        assert _count_http(srv) == 20
+    finally:
+        srv.close()
+        srv.storage.close()
+
+
+def test_typed_sender_legacy_node_falls_back(tmp_path, monkeypatch):
+    """New frontend vs a node that refuses i1 (kill switch on its
+    side): one 400, sticky legacy pin, SAME rows delivered as JSON
+    lines, wire_fallback journal event with hop=insert."""
+    srv = _mk_server(tmp_path / "mixed1")
+    ins = cluster.NetInsertStorage([f"http://127.0.0.1:{srv.port}"])
+    seen = []
+
+    def sub(ts_ns, event, fields):
+        if event == "wire_fallback":
+            seen.append(dict(fields))
+    events.subscribe(sub)
+    # the kill switch below is the NODE side; keep the sender typed
+    monkeypatch.setattr(ins, "_node_speaks_typed",
+                        lambda idx: idx not in ins._legacy_nodes)
+    monkeypatch.setenv("VL_WIRE_TYPED_INSERT", "0")
+    try:
+        c0 = wire_ingest.counters()
+        ins.must_add_rows(_rows_lr(_shape_rows("basic")))
+        c1 = wire_ingest.counters()
+        assert _count_http(srv) == 20
+        assert 0 in ins._legacy_nodes
+        assert c1.get("fallbacks", 0) == c0.get("fallbacks", 0) + 1
+        assert c1.get("rx_frames_json", 0) > c0.get("rx_frames_json", 0)
+        assert [e for e in seen if e.get("hop") == "insert"]
+        # the pin is sticky: the next batch goes straight to legacy,
+        # no second 400 round trip
+        ins.must_add_rows(_rows_lr(_shape_rows("single_row")))
+        c2 = wire_ingest.counters()
+        assert c2.get("fallbacks", 0) == c1.get("fallbacks", 0)
+        assert _count_http(srv) == 21
+    finally:
+        events.unsubscribe(sub)
+        ins.close()
+        srv.close()
+        srv.storage.close()
+
+
+def test_legacy_sender_typed_node(tmp_path, monkeypatch):
+    """Old frontend (never speaks i1) vs a new node: legacy lines land
+    unchanged — the receiver keeps speaking both formats forever."""
+    srv = _mk_server(tmp_path / "mixed2")
+    ins = cluster.NetInsertStorage([f"http://127.0.0.1:{srv.port}"])
+    monkeypatch.setattr(ins, "_node_speaks_typed", lambda idx: False)
+    try:
+        c0 = wire_ingest.counters()
+        ins.must_add_rows(_rows_lr(_shape_rows("basic")))
+        c1 = wire_ingest.counters()
+        assert _count_http(srv) == 20
+        assert c1.get("rx_frames_typed", 0) == \
+            c0.get("rx_frames_typed", 0)
+        assert c1.get("rx_rows_json", 0) - c0.get("rx_rows_json", 0) \
+            == 20
+        assert c1.get("fallbacks", 0) == c0.get("fallbacks", 0)
+    finally:
+        ins.close()
+        srv.close()
+        srv.storage.close()
+
+
+# ---------------- vlagent: encode once, retry the same bytes ---------
+
+def test_vlagent_single_encode_across_retries(tmp_path, monkeypatch):
+    from victorialogs_tpu.utils.persistentqueue import PersistentQueue
+    sent = []
+    fail = [2]
+
+    def fake_request(url, path, body, **kw):
+        sent.append(body)
+        if fail[0] > 0:
+            fail[0] -= 1
+            raise IOError("simulated outage")
+        return 200, {}, b""
+    monkeypatch.setattr(vlagent.netrobust, "request", fake_request)
+    lr = _rows_lr(_shape_rows("basic"))
+    c0 = wire_ingest.counters()
+    block = vlagent.encode_rows(lr)
+    q = PersistentQueue(str(tmp_path / "q"))
+    q.append(block)
+    client = vlagent.RemoteWriteClient("http://127.0.0.1:9", q,
+                                       timeout=5)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline and client.delivered_blocks == 0:
+            time.sleep(0.05)
+        assert client.delivered_blocks == 1
+        c1 = wire_ingest.counters()
+        # one typed encode total; three delivery attempts shipped the
+        # IDENTICAL bytes (no per-retry re-encode)
+        assert c1.get("encodes_typed", 0) \
+            == c0.get("encodes_typed", 0) + 1
+        assert len(sent) == 3
+        assert all(b == block for b in sent)
+        assert client.dropped_blocks == 0
+    finally:
+        client.close()
+        q.close()
+
+
+def test_vlagent_rejected_typed_falls_back_then_poison(tmp_path,
+                                                       monkeypatch):
+    from victorialogs_tpu.utils.persistentqueue import PersistentQueue
+    delivered = []
+
+    def fake_request(url, path, body, **kw):
+        data = _zstd.decompress(body, max_output_size=1 << 30)
+        if data.startswith(wire_ingest.INSERT_MAGIC):
+            return 400, {}, b"typed insert frames disabled"
+        if b"poison-me" in data:
+            return 400, {}, b"bad batch"
+        delivered.append(body)
+        return 200, {}, b""
+    monkeypatch.setattr(vlagent.netrobust, "request", fake_request)
+    seen = []
+
+    def sub(ts_ns, event, fields):
+        if event in ("wire_fallback", "queue_block_rejected"):
+            seen.append((event, dict(fields)))
+    events.subscribe(sub)
+    q = PersistentQueue(str(tmp_path / "q"))
+    q.append(vlagent.encode_rows(_rows_lr(_shape_rows("basic"))))
+    q.append(wire_ingest.encode_legacy_columns(
+        wire_ingest.rows_to_columns(_rows_lr(
+            [(TEN, T0, {"app": "w", "_msg": "poison-me"})]))))
+    q.append(vlagent.encode_rows(_rows_lr(_shape_rows("single_row"))))
+    client = vlagent.RemoteWriteClient("http://127.0.0.1:9", q,
+                                       timeout=5)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline and \
+                (client.delivered_blocks < 2 or q.pending_bytes() > 0):
+            time.sleep(0.05)
+        # block 1: typed rejected -> pinned -> redelivered as legacy;
+        # block 2: legacy rejected -> dropped loudly, queue NOT wedged;
+        # block 3: delivered (as legacy, node stays pinned)
+        assert client.delivered_blocks == 2
+        assert client.dropped_blocks == 1
+        assert client._legacy_remote
+        assert len(delivered) == 2
+        assert [e for e, f in seen if e == "wire_fallback"]
+        assert [e for e, f in seen if e == "queue_block_rejected"]
+    finally:
+        events.unsubscribe(sub)
+        client.close()
+        q.close()
+
+
+# ---------------- spool replay chaos: dead node, zero loss -----------
+
+def test_spool_replay_zero_rows_lost(tmp_path):
+    """Storage node down at ingest time: must_add_rows spools the
+    ALREADY-ENCODED i1 frames durably; when the node comes up the
+    replay ships them VERBATIM (typed rx on the receiver) and every
+    row is queryable — delay, never drop."""
+    port = _free_port()
+    ins = cluster.NetInsertStorage([f"http://127.0.0.1:{port}"],
+                                   timeout=5,
+                                   spool_dir=str(tmp_path / "spool"))
+    srv = None
+    try:
+        c0 = wire_ingest.counters()
+        for i in range(3):
+            ins.must_add_rows(_rows_lr(
+                [(TEN, T0 + (i * 50 + j) * NS,
+                  {"app": f"a{j % 3}", "_msg": f"chaos {i}/{j}"})
+                 for j in range(50)]))
+        assert ins.spool_pending_bytes() > 0
+        c1 = wire_ingest.counters()
+        assert c1.get("encodes_typed", 0) \
+            == c0.get("encodes_typed", 0) + 3
+
+        srv = _mk_server(tmp_path / "revived", port=port)
+        deadline = time.time() + 45
+        while time.time() < deadline and ins.spool_pending_bytes() > 0:
+            time.sleep(0.1)
+        assert ins.spool_pending_bytes() == 0
+        c2 = wire_ingest.counters()
+        # the replay shipped the spooled typed frames verbatim: typed
+        # rx counted, zero re-encodes
+        assert c2.get("rx_frames_typed", 0) \
+            >= c1.get("rx_frames_typed", 0) + 3
+        assert c2.get("encodes_typed", 0) == c1.get("encodes_typed", 0)
+        assert _count_http(srv) == 150
+    finally:
+        ins.close()
+        if srv is not None:
+            srv.close()
+            srv.storage.close()
+
+
+# ---------------- sharding ----------------
+
+def test_split_columns_by_node_partitions_rows():
+    lc = _shape_lc("many_streams")
+    shards = wire_ingest.split_columns_by_node(lc, 3)
+    assert sum(s.nrows for s in shards.values()) == lc.nrows
+    merged = []
+    for node, sub in shards.items():
+        for g in sub.groups.values():
+            for sid, _t, _s in g.streams:
+                assert (sid.hi ^ sid.lo) % 3 == node
+        merged.extend(_flatten(sub))
+    assert sorted(merged) == _flatten(lc)
+    # single node / single stream: identity, no copy
+    assert wire_ingest.split_columns_by_node(lc, 1)[0] is lc
+    one = _shape_lc("basic")
+    (only,) = wire_ingest.split_columns_by_node(one, 4).values()
+    assert only is one
+
+
+def test_columns_tenant_rows():
+    lc = _shape_lc("multi_tenant")
+    per = wire_ingest.columns_tenant_rows(lc)
+    assert sum(per.values()) == lc.nrows
+    assert all(isinstance(t, TenantID) for t in per)
+
+
+# ---------------- observability ----------------
+
+def test_encode_span_attrs():
+    root = tracing.make_root("ingest-test")
+    with tracing.activate(root):
+        wire_ingest.encode_columns(_shape_lc("basic"))
+    tree = root.to_dict()
+    assert tree["attrs"].get("typed_frames") == 1
+    assert tree["attrs"].get("encode_s", -1) >= 0
+
+
+def test_ingest_wire_metrics_on_endpoint(tmp_path):
+    srv = _mk_server(tmp_path / "metrics")
+    try:
+        body = wire_ingest.encode_columns(_shape_lc("basic"))
+        status, _ = _req(srv, "POST", "/internal/insert", body=body)
+        assert status == 200
+        _s, text = _req(srv, "GET", "/metrics")
+        text = text.decode()
+        m = [ln for ln in text.splitlines() if ln.startswith(
+            'vl_ingest_wire_frames_total{dir="rx",fmt="typed"}')]
+        assert m and float(m[0].split()[-1]) > 0
+        assert 'vl_ingest_wire_bytes_total{dir="rx",fmt="typed"}' in text
+        assert "vl_ingest_wire_fallbacks_total" in text
+    finally:
+        srv.close()
+        srv.storage.close()

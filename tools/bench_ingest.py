@@ -1,0 +1,369 @@
+"""Typed ingest end-to-end benchmark (wire format "i1", PR 18).
+
+Four recorded rounds over one synthetic jsonline corpus:
+
+  library      the frontend hot path (vlinsert.handle_jsonline ->
+               columnar build -> Storage) at 1 ingest thread and at
+               VL_INGEST_THREADS=N, plus the GIL-free fraction of the
+               serial wall (native scan + numpy/zstd encode both drop
+               the GIL) and the Amdahl projection at 4 cores — on a
+               1-CPU CI host the projection is the honest scalability
+               number, labeled as such in the JSON
+  hop          the cluster insert hop: ONE pre-encoded body decoded +
+               stored by the storage-node path (handle_internal_insert)
+               — typed i1 frame vs legacy zstd'd JSON lines, with the
+               rx counters pinning ZERO per-row json.loads on typed
+  spool        chaos replay: every node down at ingest time, i1 shard
+               bodies spool durably, a revived node drains them —
+               blocks replay VERBATIM (no re-encode) and no row is lost
+  differential typed and legacy bodies for the SAME batch stored into
+               two fresh Storages must query back bit-identically
+
+Asserted (--no-assert skips):
+  * typed wire DECODE rows/s >= 3x the 277k jsonline library baseline
+    (PERF.md ingestion table) — measured, not projected: the i1 codec
+    this PR adds must never be the storage node's bottleneck
+  * typed hop decode+store >= 3x legacy hop decode+store (the per-row
+    json.loads tax; the remaining cost is the format-independent block
+    build both sides pay)
+  * measured single-thread library rows/s >= the 277k baseline (no
+    regression); the 4-core Amdahl projection is reported, not
+    asserted (1-CPU CI cannot measure it)
+  * rx_rows_json counter delta == 0 across the typed hop round
+  * spool replay: zero rows lost, zero re-encodes
+  * differential: sorted query lines identical
+
+Run: make bench-ingest   (writes BENCH_ingest.json)
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+BASELINE_ROWS_PER_S = 277_000   # PERF.md ingestion table, jsonline lib
+
+
+def make_body(n: int) -> bytes:
+    return ("\n".join(json.dumps({
+        "_time": T0 + i * 1_000_000,
+        "_msg": f"GET /api/v{i % 4}/items/{i} status={200 + i % 3} "
+                f"dur={i % 97}ms",
+        "app": f"app{i % 8}",
+        "level": "error" if i % 11 == 0 else "info",
+    }) for i in range(n)) + "\n").encode()
+
+
+def make_columns(n: int):
+    from victorialogs_tpu.server import wire_ingest
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    lr = LogRows(stream_fields=["app"])
+    ten = TenantID(0, 0)
+    for i in range(n):
+        lr.add(ten, T0 + i * 1_000_000, [
+            ("app", f"app{i % 8}"),
+            ("_msg", f"GET /api/v{i % 4}/items/{i} "
+                     f"status={200 + i % 3} dur={i % 97}ms"),
+            ("level", "error" if i % 11 == 0 else "info"),
+        ])
+    return wire_ingest.rows_to_columns(lr)
+
+
+def lib_ingest(body: bytes, threads: int):
+    from victorialogs_tpu.server import vlinsert
+    from victorialogs_tpu.server.insertutil import (CommonParams,
+                                                    LogMessageProcessor)
+    from victorialogs_tpu.storage.log_rows import TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    os.environ["VL_INGEST_THREADS"] = str(threads)
+    d = tempfile.mkdtemp(prefix="bench-ing-lib")
+    s = Storage(d, retention_days=100000, flush_interval=3600)
+    cp = CommonParams(tenant=TenantID(0, 0), stream_fields=["app"])
+    lmp = LogMessageProcessor(cp, s)
+    t0 = time.perf_counter()
+    n = vlinsert.handle_jsonline(cp, body, lmp)
+    lmp.flush()
+    el = time.perf_counter() - t0
+    s.close()
+    return el, n
+
+
+def round_library(n_rows: int, threads: int) -> dict:
+    from victorialogs_tpu import native
+    from victorialogs_tpu.storage.log_rows import LogColumns
+    body = make_body(n_rows)
+    lib_ingest(make_body(20_000), 1)     # warmup (imports, JIT)
+    el1, got = min(lib_ingest(body, 1) for _ in range(2))
+    elN, _ = min(lib_ingest(body, threads) for _ in range(2))
+
+    # GIL-free fraction of the serial wall (native ctypes scan +
+    # columnar numpy/zstd block build) -> Amdahl projection at 4 cores
+    t_par = [0.0]
+    orig_scan = native.jsonline_scan_native
+    orig_build = LogColumns.build_blocks
+
+    def timed_scan(chunk):
+        t0 = time.perf_counter()
+        r = orig_scan(chunk)
+        t_par[0] += time.perf_counter() - t0
+        return r
+
+    def timed_build(self, *a, **kw):
+        t0 = time.perf_counter()
+        r = orig_build(self, *a, **kw)
+        t_par[0] += time.perf_counter() - t0
+        return r
+
+    native.jsonline_scan_native = timed_scan
+    LogColumns.build_blocks = timed_build
+    try:
+        el_f, _ = lib_ingest(body, 1)
+    finally:
+        native.jsonline_scan_native = orig_scan
+        LogColumns.build_blocks = orig_build
+    frac = t_par[0] / el_f
+    amdahl4 = 1.0 / ((1 - frac) + frac / 4)
+    return {
+        "rows": got, "body_mb": round(len(body) / 1e6, 1),
+        "threads": threads, "cores": os.cpu_count(),
+        "rows_per_s_1thread": round(got / el1),
+        "rows_per_s_Nthreads": round(got / elN),
+        "gil_free_fraction": round(frac, 3),
+        "amdahl_speedup_4core": round(amdahl4, 2),
+        "rows_per_s_projected_4core": round(amdahl4 * got / el1),
+        "projection_note": "projected from the measured GIL-free "
+                           "fraction; the measured rows_per_s_1thread "
+                           "is the wall number on this host",
+    }
+
+
+def _hop_store(body: bytes, n_rows: int, runs: int):
+    from victorialogs_tpu.server import cluster
+    from victorialogs_tpu.storage.storage import Storage
+    best = float("inf")
+    for _ in range(runs):
+        d = tempfile.mkdtemp(prefix="bench-ing-hop")
+        s = Storage(d, retention_days=100000, flush_interval=3600)
+        t0 = time.perf_counter()
+        got = cluster.handle_internal_insert(s, {}, body)
+        best = min(best, time.perf_counter() - t0)
+        assert got == n_rows, (got, n_rows)
+        s.close()
+    return best
+
+
+def round_hop(n_rows: int, runs: int) -> dict:
+    from victorialogs_tpu.server import wire_ingest
+    from victorialogs_tpu.utils import zstd as _zstd
+    lc = make_columns(n_rows)
+    typed = wire_ingest.encode_columns(lc)
+    legacy = wire_ingest.encode_legacy_columns(lc)
+
+    # the codec stages in isolation (what this PR adds to the hop)
+    el_enc = min(_timeit(lambda: wire_ingest.encode_columns(lc))
+                 for _ in range(runs))
+    payload = _zstd.decompress(typed, max_output_size=1 << 30)
+    el_dec = min(_timeit(lambda: wire_ingest.decode_frame(payload))
+                 for _ in range(runs))
+
+    c0 = wire_ingest.counters()
+    el_t = _hop_store(typed, n_rows, runs)
+    c1 = wire_ingest.counters()
+    el_l = _hop_store(legacy, n_rows, runs)
+    json_rows_during_typed = c1.get("rx_rows_json", 0) \
+        - c0.get("rx_rows_json", 0)
+    return {
+        "rows": n_rows, "runs": runs,
+        "typed_body_mb": round(len(typed) / 1e6, 2),
+        "legacy_body_mb": round(len(legacy) / 1e6, 2),
+        "encode_rows_per_s": round(n_rows / el_enc),
+        "decode_rows_per_s": round(n_rows / el_dec),
+        "typed_rows_per_s": round(n_rows / el_t),
+        "legacy_rows_per_s": round(n_rows / el_l),
+        "speedup": round(el_l / el_t, 2),
+        "rx_rows_json_during_typed": json_rows_during_typed,
+        "store_note": "typed/legacy_rows_per_s include the "
+                      "format-independent block build; decode_rows_"
+                      "per_s is the wire codec alone",
+    }
+
+
+def _timeit(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def round_spool(n_blocks: int, rows_per_block: int) -> dict:
+    import socket
+
+    from victorialogs_tpu.server import cluster, wire_ingest
+    from victorialogs_tpu.server.app import VLServer
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    ten = TenantID(0, 0)
+    sk = socket.socket()
+    sk.bind(("127.0.0.1", 0))
+    port = sk.getsockname()[1]
+    sk.close()
+    tmp = tempfile.TemporaryDirectory(prefix="bench-ing-spool")
+    ins = cluster.NetInsertStorage(
+        [f"http://127.0.0.1:{port}"], timeout=5,
+        spool_dir=os.path.join(tmp.name, "spool"))
+    srv = None
+    try:
+        c0 = wire_ingest.counters()
+        t_ing = time.perf_counter()
+        for b in range(n_blocks):
+            lr = LogRows(stream_fields=["app"])
+            for i in range(rows_per_block):
+                g = b * rows_per_block + i
+                lr.add(ten, T0 + g * 1_000_000,
+                       [("app", f"app{g % 8}"), ("_msg", f"chaos {g}")])
+            ins.must_add_rows(lr)
+        t_ing = time.perf_counter() - t_ing
+        pending = ins.spool_pending_bytes()
+        assert pending > 0, "nothing spooled: is the node up?"
+
+        storage = Storage(os.path.join(tmp.name, "node"),
+                          retention_days=100000, flush_interval=3600)
+        srv = VLServer(storage, listen_addr="127.0.0.1", port=port)
+        t0 = time.perf_counter()
+        deadline = t0 + 120
+        while time.perf_counter() < deadline and \
+                ins.spool_pending_bytes() > 0:
+            time.sleep(0.05)
+        t_drain = time.perf_counter() - t0
+        assert ins.spool_pending_bytes() == 0, "spool did not drain"
+        storage.debug_flush()
+        c1 = wire_ingest.counters()
+
+        from victorialogs_tpu.engine.searcher import run_query
+        blocks = []
+        run_query(storage, [ten], "*", write_block=blocks.append,
+                  timestamp=T0 + 3600 * NS)
+        stored = sum(b.nrows for b in blocks)
+        total = n_blocks * rows_per_block
+        reencodes = (c1.get("encodes_typed", 0)
+                     - c0.get("encodes_typed", 0)) - n_blocks
+        return {
+            "blocks": n_blocks, "rows": total,
+            "spooled_bytes": pending,
+            "ingest_wall_s": round(t_ing, 3),
+            "drain_wall_s": round(t_drain, 3),
+            "replay_rows_per_s": round(total / t_drain),
+            "rows_stored": stored, "rows_lost": total - stored,
+            "replay_reencodes": reencodes,
+        }
+    finally:
+        ins.close()
+        if srv is not None:
+            srv.close()
+            srv.storage.close()
+        tmp.cleanup()
+
+
+def round_differential(n_rows: int) -> dict:
+    from victorialogs_tpu.engine.emit import ndjson_block
+    from victorialogs_tpu.engine.searcher import run_query
+    from victorialogs_tpu.server import cluster, wire_ingest
+    from victorialogs_tpu.storage.log_rows import TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    lc = make_columns(n_rows)
+    lines = {}
+    with tempfile.TemporaryDirectory(prefix="bench-ing-diff") as tmp:
+        for fmt, body in (
+                ("typed", wire_ingest.encode_columns(lc)),
+                ("legacy", wire_ingest.encode_legacy_columns(lc))):
+            s = Storage(os.path.join(tmp, fmt), retention_days=100000,
+                        flush_interval=3600)
+            cluster.handle_internal_insert(s, {}, body)
+            s.debug_flush()
+            blocks = []
+            run_query(s, [TenantID(0, 0)], "*",
+                      write_block=blocks.append,
+                      timestamp=T0 + 3600 * NS)
+            lines[fmt] = sorted(ln for b in blocks
+                                for ln in ndjson_block(b).splitlines())
+            s.close()
+    return {"rows": n_rows,
+            "identical": lines["typed"] == lines["legacy"],
+            "stored_rows": len(lines["typed"])}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--json", default="")
+    ap.add_argument("--no-assert", action="store_true")
+    args = ap.parse_args()
+
+    lib = round_library(args.rows, args.threads)
+    print(f"library: {lib['rows_per_s_1thread']:,} rows/s (1 thread), "
+          f"{lib['rows_per_s_Nthreads']:,} rows/s "
+          f"({args.threads} threads on {lib['cores']} cores)")
+    print(f"  GIL-free fraction {100 * lib['gil_free_fraction']:.0f}% "
+          f"-> 4-core projection {lib['amdahl_speedup_4core']}x = "
+          f"{lib['rows_per_s_projected_4core']:,} rows/s")
+
+    hop = round_hop(args.rows, args.runs)
+    print(f"i1 codec: encode {hop['encode_rows_per_s']:,} rows/s, "
+          f"decode {hop['decode_rows_per_s']:,} rows/s")
+    print(f"insert hop (decode+store): typed "
+          f"{hop['typed_rows_per_s']:,} rows/s vs legacy "
+          f"{hop['legacy_rows_per_s']:,} rows/s "
+          f"({hop['speedup']}x); per-row json.loads on typed: "
+          f"{hop['rx_rows_json_during_typed']}")
+
+    spool = round_spool(n_blocks=6,
+                        rows_per_block=max(args.rows // 12, 1000))
+    print(f"spool replay: {spool['rows']} rows in {spool['blocks']} "
+          f"blocks drained in {spool['drain_wall_s']}s "
+          f"({spool['replay_rows_per_s']:,} rows/s), lost "
+          f"{spool['rows_lost']}, re-encodes "
+          f"{spool['replay_reencodes']}")
+
+    diff = round_differential(min(args.rows, 20_000))
+    print(f"differential: typed vs legacy stored data identical = "
+          f"{diff['identical']} ({diff['stored_rows']} rows)")
+
+    out = {"baseline_rows_per_s": BASELINE_ROWS_PER_S,
+           "library": lib, "hop": hop, "spool": spool,
+           "differential": diff}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {args.json}")
+
+    if not args.no_assert:
+        floor = 3 * BASELINE_ROWS_PER_S
+        assert hop["decode_rows_per_s"] >= floor, \
+            f"i1 decode {hop['decode_rows_per_s']} < 3x baseline " \
+            f"{floor}"
+        assert hop["typed_rows_per_s"] >= \
+            3 * hop["legacy_rows_per_s"], "typed hop under 3x legacy"
+        assert hop["rx_rows_json_during_typed"] == 0, \
+            "typed hop paid per-row json.loads"
+        assert lib["rows_per_s_1thread"] >= BASELINE_ROWS_PER_S, \
+            f"library regressed under the {BASELINE_ROWS_PER_S} baseline"
+        assert spool["rows_lost"] == 0, "spool replay lost rows"
+        assert spool["replay_reencodes"] == 0, \
+            "spool replay re-encoded blocks"
+        assert diff["identical"], "typed vs legacy stored data differ"
+        print("asserts: all passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -124,6 +124,15 @@ PAIRS: tuple[Pair, ...] = (
          acquires=("_rc_try_charge",), releases=("_rc_release",),
          finalizers=("_rc_release",),
          paths=("victorialogs_tpu/engine/",)),
+    Pair("ingest-encoder-pool",
+         "shared ingest-wire encoder pool (server/wire_ingest.py): "
+         "every wire_ingest.acquire_pool() needs a reachable "
+         "release_pool() in the same file (the pool is refcounted "
+         "process-wide; a leaked ref keeps its worker threads alive "
+         "after close)",
+         acquires=("acquire_pool",), releases=("release_pool",),
+         file_balance=True,
+         paths=("victorialogs_tpu/server/",)),
     Pair("standing-subscription",
          "standing-query subscriber streams: every attach_subscriber "
          "needs a reachable detach_subscriber in the same file (a "

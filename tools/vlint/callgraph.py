@@ -16,7 +16,13 @@ produces a JSON-serializable **FileSummary**:
   - cluster RPC primitives (``netrobust.request``),
   - jax host-sync primitives (``block_until_ready``/``device_get``),
   - wire-taint facts: local findings, ``returns_taint``,
-    ``returns_calls`` and guarded-at-source pending sinks;
+    ``returns_calls``, guarded-at-source pending sinks, plus the
+    arg-taint surface: ``taint_calls`` (calls handing wire-derived
+    values to other functions), ``param_sinks`` (parameters that reach
+    a sink with no in-function bounds check — the caller must guard)
+    and ``param_guards`` (parameters the function compares itself, so
+    calling it IS a dominating guard — e.g. a ``_check_slices``-style
+    arena validator);
 - per-class ownership facts: ctor-typed attributes, lock attributes,
   ``Thread``/executor spawns stored on ``self``, join/shutdown sites,
   and the intraclass call closure (for owner-close reachability);
@@ -36,7 +42,7 @@ import ast
 from .core import Finding, SourceFile
 from .locks import _dotted, _module_jit_names, _self_attr
 
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2
 
 _SPAWN_THREAD = {"Thread"}
 _SPAWN_EXEC = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
@@ -476,23 +482,60 @@ def _names_in(node) -> set:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
+# calls that merely TRANSFORM tainted data (result is the same wire
+# data in another shape) — taint roots flow through unchanged.  Any
+# OTHER call taking a tainted argument yields new data that is merely
+# sized/positioned by a wire integer (reader.take(n)-style), which is
+# independently tainted under a fresh root so a bounds check on one
+# read never masquerades as a guard for a different read.
+_TAINT_TRANSFORMS = {"asarray", "unique", "nonzero", "sorted", "list",
+                     "tuple", "zip", "int", "abs"}
+
+# callee-name prefixes treated as raise-style bounds validators at the
+# call site (the i1 codec's ``_check_slices``): everything handed to
+# one counts guarded from that line on.  The interprocedural layer
+# keeps this honest — effects._check_wire_arg_taint only credits
+# validator calls whose callee really compares the parameter
+# (``param_guards``).
+_GUARD_CALL_PREFIXES = ("_check", "check_", "_validate", "validate_")
+
+# taint BREAKS: the result is payload CONTENT (a decoded string), not
+# geometry — a wire-derived string can key a dict or compare equal
+# safely; only integers can index out of bounds
+_TAINT_STOPS = {"decode"}
+
+
 class _TaintPass:
     """Per-function taint flow: integers unpacked from wire payloads
-    (struct.unpack/_from over frame/sidecar bytes) reaching frombuffer
-    count/offset, alloc sizes, or index/slice bounds without a
-    DOMINATING bounds guard (any Compare — or min/max clamp — at an
-    earlier line mentioning the value or anything sharing a taint
-    root with it).  Calls whose results feed a sink unguarded are
-    recorded as PENDING sinks keyed by the callee descriptor; effects
-    fires them once the returns-taint fixpoint proves the callee
-    returns wire-derived data."""
+    (struct.unpack/_from over frame/sidecar bytes — the tuple form AND
+    the ``x = struct.unpack(...)[0]`` single-value idiom) reaching
+    frombuffer count/offset, alloc sizes, or index/slice bounds without
+    a DOMINATING bounds guard (any Compare — or min/max clamp, or a
+    ``_check_*`` validator call — at an earlier line mentioning the
+    value or anything sharing a taint root with it).  Taint follows the
+    data through transforms (.astype/.tolist/np.unique/zip), loop and
+    comprehension targets, so decoded-arena offset/length arrays stay
+    tainted all the way to the slice that reads through them.
 
-    def __init__(self, walker: _FnWalker):
+    Calls whose results feed a sink unguarded are recorded as PENDING
+    sinks keyed by the callee descriptor; effects fires them once the
+    returns-taint fixpoint proves the callee returns wire-derived data.
+    Run with ``params`` seeded, the same walk yields the function's
+    arg-taint summary instead (param_summary): which parameters reach a
+    sink with no in-function guard, and which ones the function
+    validates itself."""
+
+    def __init__(self, walker: _FnWalker, params=()):
         self.w = walker
+        self.params = tuple(p for p in params if p not in ("self", "cls"))
         self.roots: dict = {}          # var -> frozenset of taint roots
+        for p in self.params:
+            self.roots[p] = frozenset([p])
         self.call_origin: dict = {}    # var -> [desc, line]
         self.guard_lines: dict = {}    # name -> [lineno...]
         self.sinks: list = []          # (var, sinkdesc, line)
+        self.taint_calls: list = []    # [desc, line, [[nm, roots, g]..]]
+        self.collect = True
 
     def _roots_of(self, expr) -> frozenset:
         out: set = set()
@@ -501,6 +544,23 @@ class _TaintPass:
         return frozenset(out)
 
     def run(self, fnode) -> None:
+        # propagation is flow-insensitive but chain-sensitive: ast.walk
+        # can visit `b = a.tolist()` before `a` gains taint, so iterate
+        # to a fixpoint first, then collect sinks/call records once
+        # (guard dominance is by line number, so order never matters
+        # for guards)
+        self.collect = False
+        prev = -1
+        for _ in range(4):
+            self._walk(fnode)
+            size = sum(len(r) for r in self.roots.values())
+            if size == prev:
+                break
+            prev = size
+        self.collect = True
+        self._walk(fnode)
+
+    def _walk(self, fnode) -> None:
         for node in ast.walk(fnode):
             if isinstance(node, ast.Assign):
                 self._assign(node)
@@ -517,6 +577,23 @@ class _TaintPass:
                 self._call(node)
             elif isinstance(node, ast.Subscript):
                 self._subscript(node)
+            elif isinstance(node, ast.For):
+                self._bind(node.target, self._roots_of(node.iter))
+            elif isinstance(node, ast.comprehension):
+                self._bind(node.target, self._roots_of(node.iter))
+
+    def _bind(self, target, r) -> None:
+        """Loop/comprehension target <- roots of the iterated expr
+        (``for s, e in zip(offs, ends)`` keeps the slice bounds
+        tainted)."""
+        if not r:
+            return
+        if isinstance(target, ast.Name):
+            self.roots[target.id] = frozenset(
+                r | self.roots.get(target.id, frozenset()))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, r)
 
     def _assign(self, node: ast.Assign) -> None:
         v = node.value
@@ -529,6 +606,14 @@ class _TaintPass:
                                if isinstance(e, ast.Name))
         if not targets:
             return
+        if isinstance(v, ast.Subscript) and isinstance(v.value, ast.Call):
+            # `x = struct.unpack("<I", ...)[0]` — the single-value
+            # idiom the i1 ingest codec uses everywhere
+            d = _dotted(v.value.func)
+            if d in ("struct.unpack", "struct.unpack_from"):
+                for t in targets:
+                    self.roots[t] = frozenset([t])
+                return
         if isinstance(v, ast.Call):
             d = _dotted(v.func)
             if d in ("struct.unpack", "struct.unpack_from"):
@@ -540,11 +625,22 @@ class _TaintPass:
                 for n in _names_in(v):
                     self.guard_lines.setdefault(n, []).append(v.lineno)
                 return
-            if d in ("int", "abs"):
-                r = self._roots_of(v)
-                if r:
+            r = self._roots_of(v)
+            if r:
+                last = v.func.attr if isinstance(v.func, ast.Attribute) \
+                    else d.split(".")[-1]
+                if last in _TAINT_STOPS:
+                    return
+                method_transform = isinstance(v.func, ast.Attribute) \
+                    and bool(self._roots_of(v.func.value))
+                if method_transform or last in _TAINT_TRANSFORMS:
+                    # same wire data, new shape: roots flow through
                     for t in targets:
-                        self.roots[t] = r
+                        self.roots[t] = frozenset(r)
+                else:
+                    # new data sized by a wire integer: fresh root
+                    for t in targets:
+                        self.roots[t] = frozenset([t])
                 return
             desc = self.w._desc(v.func)
             if desc is not None and len(targets) == 1:
@@ -561,6 +657,11 @@ class _TaintPass:
     def _call(self, call: ast.Call) -> None:
         d = _dotted(call.func)
         last = d.split(".")[-1]
+        if last.startswith(_GUARD_CALL_PREFIXES):
+            # raise-style validator: everything it was handed counts
+            # guarded from here on (effects cross-checks the callee)
+            for n in _names_in(call):
+                self.guard_lines.setdefault(n, []).append(call.lineno)
         if last == "frombuffer":
             for a in call.args[1:]:
                 self._sink_arg(a, "frombuffer count/offset", call.lineno)
@@ -576,6 +677,35 @@ class _TaintPass:
         elif d in ("min", "max"):
             for n in _names_in(call):
                 self.guard_lines.setdefault(n, []).append(call.lineno)
+        if self.collect:
+            self._record_call(call)
+
+    def _record_call(self, call: ast.Call) -> None:
+        """Arg-taint record for the interprocedural pass: a resolvable
+        call with >=1 tainted positional arg, each arg as
+        [display name, sorted taint roots, guarded-at-callsite]."""
+        if not call.args:
+            return
+        desc = self.w._desc(call.func)
+        if desc is None:
+            return
+        args: list = []
+        tainted = False
+        for a in call.args:
+            names = [a.id] if isinstance(a, ast.Name) \
+                else sorted(_names_in(a))
+            roots: set = set()
+            for n in names:
+                roots |= self.roots.get(n, frozenset())
+            guarded = bool(roots) and any(
+                self._guarded(n, call.lineno)
+                for n in names if self.roots.get(n))
+            if roots:
+                tainted = True
+            args.append([names[0] if names else "?",
+                         sorted(roots), bool(guarded)])
+        if tainted:
+            self.taint_calls.append([desc, call.lineno, args])
 
     def _subscript(self, node: ast.Subscript) -> None:
         sl = node.slice
@@ -595,6 +725,8 @@ class _TaintPass:
                 self._sink_arg(p, "index/slice bound", node.lineno)
 
     def _sink_arg(self, expr, what: str, line: int) -> None:
+        if not self.collect:
+            return
         if not isinstance(expr, ast.Name):
             # composite sink expr: any tainted name inside it sinks
             for n in sorted(_names_in(expr)):
@@ -637,6 +769,28 @@ class _TaintPass:
                                 line])
         return out, pending
 
+    def param_summary(self):
+        """With params seeded as taint roots: ({param: [[sink, line]..]}
+        for params reaching a sink with no in-function guard — the
+        caller must bound them BEFORE the call — and the sorted list of
+        params the function compares itself, making a call to it a
+        dominating guard for the corresponding args)."""
+        pset = set(self.params)
+        sinks: dict = {}
+        for var, what, line in self.sinks:
+            if self._guarded(var, line):
+                continue
+            for p in sorted(self.roots.get(var, frozenset([var]))
+                            & pset):
+                sinks.setdefault(p, []).append([what, line])
+        guards: set = set()
+        for name in self.guard_lines:
+            if name in pset:
+                guards.add(name)
+            else:
+                guards |= self.roots.get(name, frozenset()) & pset
+        return sinks, sorted(guards)
+
     def return_taint(self, fnode):
         """(returns_taint, returns_calls) over the function's returns."""
         taints = False
@@ -662,7 +816,8 @@ def _new_node(line: int, cls: str) -> dict:
     return {"line": line, "cls": cls, "calls": [], "blocking": [],
             "rpc": [], "sync": [], "local_spawns": [],
             "returns_taint": False, "returns_calls": [],
-            "pending_sinks": []}
+            "pending_sinks": [], "taint_calls": [], "params": [],
+            "param_sinks": {}, "param_guards": []}
 
 
 def _analyze(sf: SourceFile) -> dict:
@@ -711,6 +866,17 @@ def _analyze(sf: SourceFile) -> dict:
             nd["pending_sinks"] = pending
             nd["returns_taint"], nd["returns_calls"] = \
                 tp.return_taint(fnode)
+            nd["taint_calls"] = tp.taint_calls
+            # second pass with every parameter seeded as a taint root:
+            # the function's arg-taint summary (effects matches caller
+            # taint_calls against callee param_sinks/param_guards)
+            params = [a.arg for a in (fnode.args.posonlyargs
+                                      + fnode.args.args)]
+            nd["params"] = [p for p in params
+                            if p not in ("self", "cls")]
+            pp = _TaintPass(w, params=params)
+            pp.run(fnode)
+            nd["param_sinks"], nd["param_guards"] = pp.param_summary()
         functions[qual] = nd
 
     for node in body:

@@ -541,6 +541,68 @@ def _check_wire_pending(g: _Graph) -> list:
     return findings
 
 
+def _check_wire_arg_taint(g: _Graph) -> list:
+    """Tainted arguments crossing a call boundary: a wire-derived value
+    passed, unguarded, into a function whose matching parameter reaches
+    a sink (index/slice bound, frombuffer count, alloc size) with no
+    in-function bounds check (``param_sinks``).  A prior call in the
+    same caller handing the same taint to a real validator — a callee
+    whose ``param_guards`` cover that position, like the i1 codec's
+    ``_check_slices(offs, lens, alen)`` — counts as the dominating
+    guard.  This is what keeps the ingest codec honest: decoded-arena
+    offsets/lengths MUST pass the arena bounds check before anything
+    slices through them, even when the slicing lives in a helper."""
+    findings = []
+    for nid in sorted(g.nodes):
+        nd = g.nodes[nid]
+        calls = nd.get("taint_calls") or ()
+        if not calls:
+            continue
+        path, qual = g.node_sym[nid]
+        s = g.summaries[path]
+        resolved = []
+        guards = []          # (line, frozenset of validated taint roots)
+        for d, line, args in calls:
+            callee = g.resolve(s, nd["cls"], d)
+            resolved.append((line, args, callee))
+            if callee is None:
+                continue
+            cnd = g.nodes[callee]
+            pg = set(cnd.get("param_guards") or ())
+            params = cnd.get("params") or ()
+            for i, (_nm, roots, _gd) in enumerate(args):
+                if roots and i < len(params) and params[i] in pg:
+                    guards.append((line, frozenset(roots)))
+        for line, args, callee in resolved:
+            if callee is None:
+                continue
+            cnd = g.nodes[callee]
+            ps = cnd.get("param_sinks") or {}
+            if not ps:
+                continue
+            params = cnd.get("params") or ()
+            for i, (nm, roots, guarded) in enumerate(args):
+                if not roots or guarded or i >= len(params):
+                    continue
+                p = params[i]
+                if p not in ps:
+                    continue
+                if any(gl < line and set(roots) & grs
+                       for gl, grs in guards):
+                    continue
+                if _allowed(s, "wire-taint", line):
+                    continue
+                what, sline = ps[p][0]
+                findings.append(Finding(
+                    "wire-taint", path, line, qual,
+                    f"wire-derived `{nm}` flows into "
+                    f"{g.qual(callee)}() whose parameter `{p}` "
+                    f"reaches {what} (line {sline}) with no bounds "
+                    f"guard on either side — validate against the "
+                    f"arena/payload length first"))
+    return findings
+
+
 # ---------------- entry points ----------------
 
 def check_graph(summaries: list, lock_edges=()) -> list:
@@ -554,6 +616,7 @@ def check_graph(summaries: list, lock_edges=()) -> list:
     findings.extend(_check_sync_deep(g))
     findings.extend(_check_thread_lifecycle(g))
     findings.extend(_check_wire_pending(g))
+    findings.extend(_check_wire_arg_taint(g))
     return findings
 
 

@@ -172,6 +172,13 @@ declare_env(
     "internal-select hop uses the legacy list-of-strings JSON frames "
     "(bit-identical results — `server/cluster.py`, `tests/test_wire.py`)")
 declare_env(
+    "VL_WIRE_TYPED_INSERT", "1", "flag",
+    "`0` = kill-switch for the typed ingest wire format \"i1\": this "
+    "process neither encodes nor accepts typed insert frames — "
+    "frontends/vlagent ship legacy zstd'd JSON lines and storage nodes "
+    "reject i1 bodies with a 400 so senders pin them to legacy "
+    "(`server/wire_ingest.py`, `tests/test_wire_ingest.py`)")
+declare_env(
     "VL_PACK_PARTS", "8", "int",
     "max small parts folded into one fused super-dispatch; `1` = "
     "packing off (kill-switch)")
@@ -381,7 +388,10 @@ declare_env(
     "query memory budget", display="auto")
 declare_env(
     "VL_INGEST_THREADS", "1", "int",
-    "ingest assembly parallelism", display="auto")
+    "ingest shard parallelism: bodies over 8 MB split at newline "
+    "boundaries across this many workers, each scanning/assembling its "
+    "own columnar batch and handing it to the sink on the worker "
+    "(`server/vlinsert.py`)", display="auto")
 declare_env(
     "VL_NO_NATIVE", None, "str",
     "`1` = skip the C++ host core, numpy fallbacks", display="off")
@@ -612,6 +622,17 @@ declare_metric("vl_wire_bytes_total", "counter",
                single_roll=True)
 declare_metric("vl_wire_fallbacks_total", "counter",
                "typed-requesting frontends answered with JSON frames",
+               single_roll=True)
+
+# -- typed ingest wire (server/wire_ingest.py) --
+declare_metric("vl_ingest_wire_frames_total", "counter",
+               "insert wire bodies by dir (tx/rx) and format "
+               "(typed/json)", single_roll=True)
+declare_metric("vl_ingest_wire_bytes_total", "counter",
+               "insert wire body bytes (compressed) by dir and format",
+               single_roll=True)
+declare_metric("vl_ingest_wire_fallbacks_total", "counter",
+               "insert hops pinned from i1 back to legacy JSON lines",
                single_roll=True)
 
 # -- cluster fault policy (server/netrobust.py) --

@@ -166,6 +166,11 @@ class Metrics:
         from . import cluster as _cluster
         for base, labels, v in _cluster.wire_metrics_samples():
             add(metric_name(base, **labels), v)
+        # typed ingest wire accounting: i1 vs legacy insert bodies by
+        # direction + sticky fallbacks (server/wire_ingest.py)
+        from . import wire_ingest as _wire_ingest
+        for base, labels, v in _wire_ingest.metrics_samples():
+            add(metric_name(base, **labels), v)
         # cluster fault-policy surface: per-node breaker health
         # (vl_node_health), retry/hedge/partial counters and the
         # ingest-spool accounting (server/netrobust.py)

@@ -54,7 +54,7 @@ from ..obs import activity, events, tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
-from . import netrobust
+from . import netrobust, wire_ingest
 
 PROTOCOL_VERSION = "v1"
 
@@ -538,7 +538,31 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
     if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
         raise ValueError(f"unsupported protocol version "
                          f"{args.get('version')!r}")
-    data = _zstd.decompress(body, max_output_size=1 << 30)
+    try:
+        data = _zstd.decompress(body, max_output_size=1 << 30)
+    except Exception as e:
+        # zlib.error / ZstdError are NOT ValueErrors; an undecodable
+        # body is the sender's corruption, not our 500 — whole-batch 400
+        raise ValueError(f"undecodable insert body: {e}") from None
+    if data.startswith(wire_ingest.INSERT_MAGIC):
+        # typed i1 body (self-describing: JSON lines start with "{").
+        # With the kill switch thrown this node speaks legacy ONLY —
+        # the 400 tells the sender to re-encode and pin this node to
+        # JSON lines (the mixed-version fallback discipline).
+        if not wire_ingest.wire_typed_insert_enabled():
+            raise ValueError(
+                "typed insert frames disabled (VL_WIRE_TYPED_INSERT=0)")
+        lc = wire_ingest.decode_frame(data)   # WireInsertError -> 400
+        wire_ingest.note("rx_frames_typed")
+        wire_ingest.note("rx_bytes_typed", len(body))
+        wire_ingest.note("rx_rows_typed", lc.nrows)
+        if lc.nrows:
+            storage.must_add_columns(lc)
+            per_tenant = wire_ingest.columns_tenant_rows(lc)
+            for tenant, rows in per_tenant.items():
+                activity.note_ingest(
+                    tenant, rows, nbytes=len(data) * rows // lc.nrows)
+        return lc.nrows
     lr = LogRows()
     n = 0
     per_tenant: dict = {}
@@ -556,6 +580,9 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
         lr.tenants.append(tenant)
         per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
         n += 1
+    wire_ingest.note("rx_frames_json")
+    wire_ingest.note("rx_bytes_json", len(body))
+    wire_ingest.note("rx_rows_json", n)
     if n:
         storage.must_add_rows(lr)
         for tenant, rows in per_tenant.items():
@@ -572,6 +599,35 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
 # re-exported for callers that think in cluster terms; defined in the
 # policy layer so the HTTP app can catch it without importing cluster
 InsertRejectedError = netrobust.InsertRejectedError
+
+
+class _ShardBodies:
+    """Per-shard lazy wire-body cache: the typed i1 body and the legacy
+    JSON-lines body are each built AT MOST ONCE per batch, whatever
+    combination of preferred/fallback/re-routed sends ends up used —
+    a retry never re-pays per-row encoding."""
+
+    __slots__ = ("lc", "_typed", "_legacy")
+
+    def __init__(self, lc):
+        self.lc = lc
+        self._typed = None
+        self._legacy = None
+
+    def typed(self) -> bytes | None:
+        """The i1 body, or None when the batch can't ride the format
+        (arena/tenant-id overflow — it falls back to legacy lines)."""
+        if self._typed is None:
+            try:
+                self._typed = wire_ingest.encode_columns(self.lc)
+            except ValueError:
+                self._typed = b""
+        return self._typed or None
+
+    def legacy(self) -> bytes:
+        if self._legacy is None:
+            self._legacy = wire_ingest.encode_legacy_columns(self.lc)
+        return self._legacy
 
 
 class NetInsertStorage:
@@ -596,6 +652,11 @@ class NetInsertStorage:
             raise ValueError("no storage nodes configured")
         self.urls = [u.rstrip("/") for u in node_urls]
         self.timeout = timeout
+        # nodes that rejected an i1 frame stay pinned to legacy JSON
+        # lines for this process's lifetime (mixed-version discipline);
+        # plain set: single-item ops are atomic under the GIL
+        self._legacy_nodes: set[int] = set()
+        self._encode_pool = wire_ingest.acquire_pool()
         self._spool_dir = spool_dir
         self._spools: dict[int, object] = {}
         self._spool_mu = threading.Lock()
@@ -624,40 +685,84 @@ class NetInsertStorage:
             hashlib.sha256(self.urls[idx].encode()).hexdigest()[:16])
 
     def must_add_rows(self, lr: LogRows) -> None:
-        n_nodes = len(self.urls)
-        # each row's wire bytes are built EXACTLY ONCE, before any node
-        # grouping: routing, re-routing and the compressed per-node
-        # bodies all reuse the same serialized lines instead of
-        # re-paying json.dumps per send target
-        # vlint: allow-per-row-emit(ingest wire format is per-row framed JSON; ONE dumps per row total, reused across targets)
-        lines = [json.dumps(
-            # vlint: allow-per-row-emit(ingest wire format is per-row framed JSON; ONE dumps per row total, reused across targets)
-            {"t": lr.timestamps[i], "a": lr.tenants[i].account_id,
-             "p": lr.tenants[i].project_id,
-             "s": lr.stream_tags_str[i], "f": lr.rows[i]},
-            ensure_ascii=False, separators=(",", ":")).encode("utf-8")
-            for i in range(len(lr))]
-        batches: dict[int, list] = {}
-        for i, sid in enumerate(lr.stream_ids):
-            batches.setdefault((sid.hi ^ sid.lo) % n_nodes,
-                               []).append(lines[i])
+        if not len(lr):
+            return
+        self.must_add_columns(wire_ingest.rows_to_columns(lr))
+
+    def must_add_columns(self, lc) -> None:
+        """Ship a columnar batch: shard by stream hash, encode each
+        shard's wire body ONCE (i1 when the node speaks it, legacy
+        JSON lines otherwise), deliver with re-route + durable-spool
+        semantics.  Multi-shard encodes run on the shared encoder pool
+        (numpy packing + zstd drop the GIL)."""
+        if lc.nrows == 0:
+            return
+        shards = sorted(wire_ingest.split_columns_by_node(
+            lc, len(self.urls)).items())
+        items = [(node, _ShardBodies(slc)) for node, slc in shards]
+        if len(items) > 1:
+            for f in [self._encode_pool.submit(
+                    self._preferred_body, node, bodies)
+                    for node, bodies in items]:
+                f.result()
         errors = []
-        for node, blines in batches.items():
-            body = _zstd.compress(b"\n".join(blines))
-            if self._send(node, body):
+        for node, bodies in items:
+            if self._send_shard(node, bodies):
                 continue
             # re-route to any healthy node (data locality is a
             # preference, not a correctness requirement)
-            if any(alt != node and self._send(alt, body)
-                   for alt in range(n_nodes)):
+            if any(alt != node and self._send_shard(alt, bodies)
+                   for alt in range(len(self.urls))):
                 continue
             # every node is down/throttled: spool durably and replay
-            # when the shard's node recovers — delay, don't drop
-            if self._spool(node, body, nrows=len(blines)):
+            # when the shard's node recovers — delay, don't drop.
+            # The ALREADY-ENCODED body spools verbatim: replay ships
+            # the same bytes, no re-encode per attempt.
+            if self._spool(node, self._preferred_body(node, bodies),
+                           nrows=bodies.lc.nrows):
                 continue
             errors.append(f"all nodes down for shard {node}")
         if errors:
             raise IOError("; ".join(errors))
+
+    def _node_speaks_typed(self, idx: int) -> bool:
+        return wire_ingest.wire_typed_insert_enabled() and \
+            idx not in self._legacy_nodes
+
+    def _preferred_body(self, idx: int, bodies: _ShardBodies) -> bytes:
+        """The wire body this node should receive (building it if
+        needed) — the pool pre-encode and the spool both route here so
+        format choice has exactly one home."""
+        if self._node_speaks_typed(idx):
+            body = bodies.typed()
+            if body is not None:
+                return body
+        return bodies.legacy()
+
+    def _send_shard(self, idx: int, bodies: _ShardBodies) -> bool:
+        """One node delivery with the typed→legacy sticky fallback: a
+        4xx on an i1 frame pins the node to legacy JSON lines and
+        resends the SAME batch once (negotiation without a handshake,
+        the t1 discipline on the insert hop)."""
+        typed_body = bodies.typed() if self._node_speaks_typed(idx) \
+            else None
+        if typed_body is None:
+            return self._send(idx, bodies.legacy())
+        try:
+            return self._send(idx, typed_body)
+        except InsertRejectedError:
+            self._legacy_nodes.add(idx)
+            wire_ingest.note("fallbacks")
+            events.emit("wire_fallback", url=self.urls[idx],
+                        requested=wire_ingest.WIRE_INSERT_FORMAT,
+                        hop="insert")
+            try:
+                return self._send(idx, bodies.legacy())
+            except InsertRejectedError:
+                # the legacy body was rejected too: the BATCH is the
+                # problem, not the node's protocol — unpin it
+                self._legacy_nodes.discard(idx)
+                raise
 
     def _send(self, idx: int, body: bytes) -> bool:
         """One policy-managed delivery attempt.  False means 'this node
@@ -744,10 +849,27 @@ class NetInsertStorage:
                     data = q.read(timeout=None)
                     if data is None:
                         break
+                    # a node already pinned to legacy can't take a
+                    # spooled i1 frame: re-encode the SAME rows as
+                    # JSON lines (typed frames replay verbatim)
+                    send_data = data
+                    if idx in self._legacy_nodes:
+                        alt = wire_ingest.reencode_legacy(data)
+                        if alt is not None:
+                            send_data = alt
                     try:
-                        if not self._send(idx, data):
+                        if not self._send(idx, send_data):
                             break
                     except InsertRejectedError:
+                        verdict = "poison"
+                        if send_data is data:
+                            verdict = self._replay_reject_fallback(
+                                idx, q, data)
+                        if verdict == "ok":
+                            drained += 1
+                            continue
+                        if verdict == "down":
+                            break   # keep the block; retry later
                         # a poisoned block must not wedge the whole
                         # queue behind it: drop it, loudly
                         netrobust.note("spool_rejected_blocks")
@@ -761,6 +883,33 @@ class NetInsertStorage:
                 if drained and q.pending_bytes() == 0:
                     events.emit("ingest_spool_replayed",
                                 node=self.urls[idx], blocks=drained)
+
+    def _replay_reject_fallback(self, idx: int, q, data: bytes) -> str:
+        """A spooled body was rejected: if it is an i1 frame, the node
+        may have stopped speaking typed between spool time and replay
+        (downgrade / kill switch) — pin the node to legacy and retry
+        the SAME rows as JSON lines once.  Returns 'ok' (delivered +
+        acked), 'down' (node unavailable: keep the block, retry
+        later), or 'poison' (rejected either way: caller drops it)."""
+        legacy = wire_ingest.reencode_legacy(data)
+        if legacy is None:
+            return "poison"       # not typed / undecodable
+        self._legacy_nodes.add(idx)
+        wire_ingest.note("fallbacks")
+        events.emit("wire_fallback", url=self.urls[idx],
+                    requested=wire_ingest.WIRE_INSERT_FORMAT,
+                    hop="insert-replay")
+        try:
+            if self._send(idx, legacy):
+                q.ack(len(data))
+                netrobust.note("replayed_blocks")
+                return "ok"
+            return "down"
+        except InsertRejectedError:
+            # rejected as legacy too: genuinely poisoned — the batch
+            # was the problem, not the node's protocol, so unpin
+            self._legacy_nodes.discard(idx)
+            return "poison"
 
     def spool_pending_bytes(self) -> int:
         with self._spool_mu:
@@ -785,6 +934,7 @@ class NetInsertStorage:
             spools, self._spools = list(self._spools.values()), {}
         for q in spools:
             q.close()
+        wire_ingest.release_pool()
 
 
 # ---------------- client side: scatter-gather select ----------------

@@ -15,34 +15,33 @@ from __future__ import annotations
 
 import argparse
 import hashlib
-import json
 import os
 import signal
 import sys
 import threading
 import time
 
+from ..obs import events
 from ..storage.log_rows import LogRows
-from ..utils import zstd as _zstd
 from ..utils.persistentqueue import PersistentQueue
-from . import netrobust
+from . import netrobust, wire_ingest
 from .cluster import PROTOCOL_VERSION
 from .insertutil import LogRowsStorage
 
 def encode_rows(lr: LogRows) -> bytes:
-    """Native wire block (same format /internal/insert consumes).
-
-    Thread-local compressor (utils.zstd): zstd objects are not
-    thread-safe and ingest handlers encode from many HTTP threads."""
-    lines = []
-    for i in range(len(lr)):
-        ten = lr.tenants[i]
-        # vlint: allow-per-row-emit(persistent-queue wire format is per-row framed JSON)
-        lines.append(json.dumps({
-            "t": lr.timestamps[i], "a": ten.account_id,
-            "p": ten.project_id, "s": lr.stream_tags_str[i],
-            "f": lr.rows[i]}, ensure_ascii=False, separators=(",", ":")))
-    return _zstd.compress(("\n".join(lines)).encode("utf-8"))
+    """One queue block (same wire body /internal/insert consumes):
+    a typed i1 frame since wire format "i1" — encoded ONCE here, then
+    replicated to every remote's queue and replayed VERBATIM across
+    retries and restarts — with legacy zstd'd JSON lines under the
+    VL_WIRE_TYPED_INSERT=0 kill switch (or when a batch can't ride
+    the typed format: arena/tenant-id overflow)."""
+    if wire_ingest.wire_typed_insert_enabled():
+        try:
+            return wire_ingest.encode_rows(lr)
+        except ValueError:
+            pass
+    return wire_ingest.encode_legacy_columns(
+        wire_ingest.rows_to_columns(lr))
 
 
 class RemoteWriteClient:
@@ -56,21 +55,67 @@ class RemoteWriteClient:
         self.delivered_blocks = 0
         self.errors = 0
         self.retry_after_honored = 0
+        self.dropped_blocks = 0
+        # sticky: the remote rejected an i1 frame (old version or
+        # VL_WIRE_TYPED_INSERT=0 on its side) — deliver legacy lines
+        self._legacy_remote = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _wire_body(self, block: bytes) -> bytes:
+        """The bytes to put on the wire for one queue block.  Typed
+        blocks ship VERBATIM; only a legacy-pinned remote pays a
+        re-encode (decode i1 -> JSON lines), and only once per block
+        because the caller caches the result across retries."""
+        if self._legacy_remote:
+            legacy = wire_ingest.reencode_legacy(block)
+            if legacy is not None:
+                return legacy
+        return block
+
     def _loop(self) -> None:
         backoff = 0.5
+        # the in-flight block is read from disk ONCE and its wire body
+        # built ONCE: every retry (backoff, Retry-After park, breaker
+        # re-probe) reuses the same bytes instead of re-reading the
+        # queue head and re-paying the encode per attempt
+        block: bytes | None = None
+        body: bytes | None = None
         while not self._stop.is_set():
-            data = self.queue.read(timeout=0.5)
-            if data is None:
-                continue
-            ok, hint = self._send(data)
+            if block is None:
+                block = self.queue.read(timeout=0.5)
+                if block is None:
+                    continue
+                body = self._wire_body(block)
+            ok, hint, rejected = self._send(body)
             if ok:
-                self.queue.ack(len(data))
+                self.queue.ack(len(block))
                 self.delivered_blocks += 1
+                block = body = None
                 backoff = 0.5
+            elif rejected:
+                self.errors += 1
+                if body is block and not self._legacy_remote:
+                    legacy = wire_ingest.reencode_legacy(block)
+                    if legacy is not None:
+                        # the remote can't speak i1: pin it to legacy
+                        # lines and retry the SAME rows immediately
+                        self._legacy_remote = True
+                        wire_ingest.note("fallbacks")
+                        events.emit("wire_fallback", url=self.url,
+                                    requested=(wire_ingest
+                                               .WIRE_INSERT_FORMAT),
+                                    hop="agent")
+                        body = legacy
+                        continue
+                # rejected in the format the remote speaks: a poisoned
+                # block must not wedge the queue behind it — drop it,
+                # loudly
+                self.dropped_blocks += 1
+                events.emit("queue_block_rejected", url=self.url)
+                self.queue.ack(len(block))
+                block = body = None
             elif hint is not None:
                 # the remote SAID how loaded it is (429 + Retry-After +
                 # X-VL-Concurrency hints): honor its guidance instead
@@ -107,12 +152,15 @@ class RemoteWriteClient:
             wait *= min(4.0, max(0.5, current / limit))
         return max(0.1, wait)
 
-    def _send(self, body: bytes) -> tuple[bool, float | None]:
-        """(delivered, retry_hint_s) — the hint is non-None only for an
-        explicit overload shed (HTTP 429).  Rides the shared fault-
-        policy layer with ``gate=False``: the agent's own backoff
-        ladder owns the retry cadence (the queue IS the retry buffer),
-        but deliveries still feed the per-node breaker/health state."""
+    def _send(self, body: bytes) -> tuple[bool, float | None, bool]:
+        """(delivered, retry_hint_s, rejected) — the hint is non-None
+        only for an explicit overload shed (HTTP 429); rejected is True
+        for a non-429 4xx (the remote REFUSED the body: retrying the
+        same bytes can't succeed — the caller falls back to legacy
+        lines or drops the block).  Rides the shared fault-policy layer
+        with ``gate=False``: the agent's own backoff ladder owns the
+        retry cadence (the queue IS the retry buffer), but deliveries
+        still feed the per-node breaker/health state."""
         try:
             status, headers, _rbody = netrobust.request(
                 self.url,
@@ -120,10 +168,11 @@ class RemoteWriteClient:
                 headers={"Content-Type": "application/octet-stream"},
                 timeout=self.timeout, gate=False)
         except (IOError, OSError):
-            return False, None
+            return False, None, False
         if status == 429:
-            return False, self._shed_hint(headers)
-        return 200 <= status < 300, None
+            return False, self._shed_hint(headers), False
+        return (200 <= status < 300, None,
+                400 <= status < 500)
 
     def close(self) -> None:
         self._stop.set()
@@ -151,7 +200,25 @@ class VLAgent(LogRowsStorage):
     def must_add_rows(self, lr: LogRows) -> None:
         if not len(lr):
             return
-        block = encode_rows(lr)
+        self._append_block(encode_rows(lr), len(lr))
+
+    def must_add_columns(self, lc) -> None:
+        """Columnar twin of must_add_rows: the jsonline bulk fast path
+        lands here (supports_columns), so the agent encodes the i1
+        frame straight from the columnar batch — no per-row
+        LogRows detour before the queue."""
+        if lc.nrows == 0:
+            return
+        if wire_ingest.wire_typed_insert_enabled():
+            try:
+                block = wire_ingest.encode_columns(lc)
+            except ValueError:
+                block = wire_ingest.encode_legacy_columns(lc)
+        else:
+            block = wire_ingest.encode_legacy_columns(lc)
+        self._append_block(block, lc.nrows)
+
+    def _append_block(self, block: bytes, nrows: int) -> None:
         for c in self.clients:
             c.queue.append(block)
         # forwarded-traffic accounting: each batch counted ONCE (rows
@@ -161,7 +228,7 @@ class VLAgent(LogRowsStorage):
         # accounting already happened in the HTTP layer's
         # handle_insert (note_ingest), so none here.
         with self._stats_mu:
-            self.rows_forwarded += len(lr)
+            self.rows_forwarded += nrows
             self.bytes_forwarded += len(block)
 
     def pending_bytes(self) -> int:

@@ -427,35 +427,47 @@ def _scan_chunk_native(st: _FastState, chunk: bytes, scan) -> None:
             else:
                 tns = _time.time_ns
                 ts_list = [tns() for _ in range(n)]
-            # stream identity per row
+            # stream identity per row: refs into the group's interned
+            # stream table, cached under the RAW stream-value tuple —
+            # one cheap str-tuple dict hit per row; the StreamID hash
+            # and dataclass construction are paid once per unique
+            # stream (intern_stream), not per row
             scache = st.scache
             snames = plan.stream_names
-            if plan.stream_pos:
-                scols = [out_cols[p] for p in plan.stream_pos]
-                sids = []
-                tagsl = []
-                for skv in zip(*scols):
-                    info = scache.get((snames, skv))
-                    if info is None:
-                        pairs = list(zip(snames, skv))
-                        tags = canonical_stream_tags(pairs)
-                        hi, lo = stream_id_hash(tags.encode("utf-8"))
-                        info = scache[(snames, skv)] = \
-                            (StreamID(st.cp.tenant, hi, lo), tags)
-                    sids.append(info[0])
-                    tagsl.append(info[1])
-            else:
-                info = scache.get((snames, ()))
-                if info is None:
-                    tags = canonical_stream_tags([])
-                    hi, lo = stream_id_hash(tags.encode("utf-8"))
-                    info = scache[(snames, ())] = \
-                        (StreamID(st.cp.tenant, hi, lo), tags)
-                sids = [info[0]] * n
-                tagsl = [info[1]] * n
             lc = st.lc
             g = lc.group(plan.names, plan.stream_pos)
-            lc.add_bulk(g, st.cp.tenant, ts_list, out_cols, sids, tagsl)
+            kidx = g.key_idx
+            if plan.stream_pos:
+                scols = [out_cols[p] for p in plan.stream_pos]
+                srefs = []
+                ap = srefs.append
+                for skv in zip(*scols):
+                    si = kidx.get(skv)
+                    if si is None:
+                        info = scache.get((snames, skv))
+                        if info is None:
+                            pairs = list(zip(snames, skv))
+                            tags = canonical_stream_tags(pairs)
+                            hi, lo = stream_id_hash(
+                                tags.encode("utf-8"))
+                            info = scache[(snames, skv)] = \
+                                (StreamID(st.cp.tenant, hi, lo), tags)
+                        si = kidx[skv] = lc.intern_stream(
+                            g, st.cp.tenant, info[0], info[1])
+                    ap(si)
+            else:
+                si = kidx.get(())
+                if si is None:
+                    info = scache.get((snames, ()))
+                    if info is None:
+                        tags = canonical_stream_tags([])
+                        hi, lo = stream_id_hash(tags.encode("utf-8"))
+                        info = scache[(snames, ())] = \
+                            (StreamID(st.cp.tenant, hi, lo), tags)
+                    si = kidx[()] = lc.intern_stream(
+                        g, st.cp.tenant, info[0], info[1])
+                srefs = [si] * n
+            lc.add_bulk_refs(g, ts_list, out_cols, srefs)
             st.n += n
             if lc.nrows >= _FAST_CHUNK_ROWS:
                 st.lmp.ingest_columns(lc)
@@ -553,17 +565,21 @@ def _jsonline_fast_mt(cp: CommonParams, body: bytes,
 
     def work(k: int) -> None:
         s, e = spans[k]
-        _scan_span(states[k], body, s, e, True)
+        st = states[k]
+        _scan_span(st, body, s, e, True)
+        # hand the shard's batch to the sink ON the worker: the sink's
+        # numpy block build / i1 encode / zstd all drop the GIL, so
+        # shard K's sink work overlaps shard K+1's scan instead of
+        # serializing on the request thread after the barrier
+        # (ingest_columns is lock-serialized internally)
+        lmp.ingest_columns(st.lc)
+        st.lc = LogColumns()
 
     with ThreadPoolExecutor(max_workers=len(spans)) as pool:
         # surface the first worker error (e.g. IngestError) to the caller
         for fut in [pool.submit(work, k) for k in range(len(spans))]:
             fut.result()
-    n = 0
-    for st in states:
-        lmp.ingest_columns(st.lc)
-        n += st.n
-    return n
+    return sum(st.n for st in states)
 
 
 @_ingest_guard("jsonline")

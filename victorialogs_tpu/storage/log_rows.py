@@ -213,6 +213,32 @@ class LogColumns:
             col.append(v)
         self.nrows += 1
 
+    def intern_stream(self, g: "_ColGroup", tenant: TenantID,
+                      sid: StreamID, tags: str) -> int:
+        """One stream -> its ref in g's table (registering it batch-wide
+        on first sight).  Callers that cache the returned ref under a
+        cheap key (vlinsert's per-group raw-value cache) skip the
+        StreamID dataclass hash per ROW — it is paid once per unique
+        stream here."""
+        si = g.stream_idx.get(sid)
+        if si is None:
+            si = g.stream_idx[sid] = len(g.streams)
+            g.streams.append((sid, tenant, tags))
+            if sid not in self.stream_tags:
+                self.stream_tags[sid] = tags
+        return si
+
+    def add_bulk_refs(self, g: "_ColGroup", ts_list: list,
+                      col_lists: list, srefs: list) -> None:
+        """Append many rows of ONE schema whose stream refs are already
+        interned (via intern_stream) — the hot bulk path: per-column
+        extends only, zero per-row dict lookups."""
+        g.ts.extend(ts_list)
+        g.sref.extend(srefs)
+        for col, vals in zip(g.cols, col_lists):
+            col.extend(vals)
+        self.nrows += len(ts_list)
+
     def add_bulk(self, g: "_ColGroup", tenant: TenantID, ts_list: list,
                  col_lists: list, sid_list: list, tags_list: list) -> None:
         """Append many rows of ONE schema at once: per-column extends
@@ -364,7 +390,7 @@ class _ColGroup:
     """One schema group inside a LogColumns batch."""
 
     __slots__ = ("names", "stream_pos", "cols", "ts", "sref",
-                 "streams", "stream_idx")
+                 "streams", "stream_idx", "key_idx")
 
     def __init__(self, names: tuple, stream_pos: tuple):
         self.names = names
@@ -374,3 +400,6 @@ class _ColGroup:
         self.sref: list = []
         self.streams: list = []        # (sid, tenant, tags_str)
         self.stream_idx: dict = {}
+        # optional producer-side cache: raw stream-value key -> sref,
+        # so bulk producers skip the StreamID hash per row (vlinsert)
+        self.key_idx: dict = {}
