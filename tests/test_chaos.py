@@ -369,6 +369,112 @@ def test_chaos_ingest_spool_zero_rows_lost():
                 p.kill()
 
 
+def test_chaos_ingest_status_stalled_and_ledger_balances_exactly():
+    """The ingest-observability acceptance round: 3 nodes + frontend,
+    every node behind a FaultProxy.  With the node set unreachable
+    mid-ingest (a single down node fails over to its healthy
+    siblings — spooling needs the whole set down),
+    GET /insert/status?cluster=1 shows the stalled (spooled) batches
+    and marks the nodes down; after revive + replay drain the
+    row-conservation ledger balances EXACTLY cluster-wide —
+    frontend accepted == sum(node stored) + dropped, zero in flight
+    (received telescopes against forwarded across hops)."""
+    procs = []
+    proxies = []
+    tmp = tempfile.mkdtemp(prefix="vlchaos-ledger")
+    try:
+        node_ports = []
+        for k in range(3):
+            proc, port = _start_bound(
+                ["-storageDataPath", f"{tmp}/node{k}",
+                 "-retentionPeriod", "100y"])
+            procs.append(proc)
+            node_ports.append(port)
+            proxies.append(FaultProxy("127.0.0.1", port))
+        storage_urls = [p.url for p in proxies]
+        front, front_port = _start_bound(
+            ["-storageDataPath", f"{tmp}/front",
+             "-retentionPeriod", "100y"]
+            + sum((["-storageNode", u] for u in storage_urls), []))
+        procs.append(front)
+
+        _insert(front_port, _rows(120))
+        assert _count(front_port) == 120
+
+        for p in proxies:
+            p.set_mode("refuse")
+        time.sleep(0.1)
+        # ingest INTO the outage: every shard spools on the frontend
+        for k in range(4):
+            _insert(front_port, _rows(30, offset=120 + 30 * k))
+
+        # stalled batches are visible cluster-wide while the nodes
+        # are down, and the down nodes are marked
+        deadline = time.monotonic() + 10
+        st = None
+        while time.monotonic() < deadline:
+            st = _get_json(front_port, "/insert/status?cluster=1")
+            if st.get("stalled_batches_cluster", 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert st["cluster"] is True
+        assert st["stalled_batches_cluster"] >= 1, st
+        ups = {n["node"]: n["up"] for n in st["nodes"]}
+        assert not any(ups.values()), ups
+        assert st["spool"]["pending_bytes"] > 0, st["spool"]
+        # the spool gauges ride /metrics (depth, entries, age)
+        metrics = _metrics_text(front_port)
+        for g in ("vl_insert_spool_bytes", "vl_insert_spool_entries",
+                  "vl_insert_spool_oldest_age_seconds"):
+            assert g in metrics, g
+
+        for p in proxies:
+            p.set_mode("pass")
+        # replay drains breaker-paced; wait for the exact count AND
+        # the ledger to quiesce (no batch in flight, spool empty)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if _count(front_port, timeout="5s") == 240:
+                    st = _get_json(front_port,
+                                   "/insert/status?cluster=1")
+                    if st["spool"]["pending_bytes"] == 0 \
+                            and not st["in_flight"]:
+                        break
+            except (urllib.error.HTTPError, OSError):
+                pass
+            time.sleep(0.25)
+        assert _count(front_port) == 240
+
+        # EXACT conservation for tenant 0:0 across processes
+        st = _get_json(front_port, "/insert/status?cluster=1")
+        local = st["ledger"]["0:0"]
+        assert local["accepted"] == 240, local
+        assert local["in_flight"] == 0, local
+        assert local["dropped_rows"] == 0, local
+        assert local["forwarded"] == local["accepted"], local
+        assert local["replayed"] == local["spooled"], local
+        stored = dropped = 0
+        for n in st["nodes"]:
+            assert n["up"] is True, n
+            slot = n["ledger"].get("0:0", {})
+            stored += slot.get("stored", 0)
+            dropped += slot.get("dropped_rows", 0)
+            assert slot.get("in_flight", 1) == 0, n
+        assert stored + dropped == local["accepted"], st
+        assert dropped == 0, st
+    finally:
+        for p in proxies:
+            p.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 # ---------------- cluster observability plane ----------------
 #
 # Real multi-process coverage for the federated registry + usage

@@ -1,0 +1,394 @@
+"""Ingest-path observability (obs/ingestledger.py): the
+row-conservation ledger, per-hop batch tracing, /insert/status, spool
+and queue depth gauges, freshness histograms, the idle-quiesce
+recursion guard, and the vlint drop-discipline checker.
+
+The cross-process acceptance round (stalled batches visible during an
+outage, exact cluster-wide balance after the drain) lives in
+tests/test_chaos.py; this module pins the in-process semantics."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from victorialogs_tpu.obs import events, hist, ingestledger
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    ingestledger.reset_for_tests()
+    yield
+    ingestledger.reset_for_tests()
+
+
+# ---------------------------------------------------------------- units
+
+def test_conservation_accept_store_balances():
+    with ingestledger.begin_batch("0:0") as ctx:
+        ingestledger.note_accepted("0:0", 100)
+        ingestledger.note_stored("0:0", 100, max_ts_unix=T0 / 1e9)
+    d = ingestledger.balance_snapshot()["0:0"]
+    assert d["accepted"] == 100 and d["stored"] == 100
+    assert d["in_flight"] == 0 and d["dropped_rows"] == 0
+    assert ingestledger.check_balanced() == []
+    assert ingestledger.inflight_batches() == 0
+    assert ctx.state == "done"
+    # the freshness watermark advanced to the max stored row time
+    st = ingestledger.status_payload()
+    assert st["watermark_unix"]["0:0"] == pytest.approx(T0 / 1e9)
+
+
+def test_conservation_spool_detour_stalls_then_replay_resolves():
+    with ingestledger.begin_batch("0:0") as ctx:
+        bid = ctx.batch_id
+        ingestledger.note_accepted("0:0", 60)
+        ingestledger.note_forwarded("0:0", 40)
+        ingestledger.note_spooled("0:0", 20)
+    # rows parked in the spool: the batch is NOT done — it shows as a
+    # stalled (spooled) entry on /insert/status
+    assert ctx.state == "spooled"
+    st = ingestledger.status_payload()
+    assert st["stalled_batches"] >= 1
+    assert any(b["batch_id"] == bid and b["state"] == "spooled"
+               for b in st["in_flight"])
+    d = ingestledger.balance_snapshot()["0:0"]
+    assert d["in_flight"] == 20
+
+    # replay re-ships from the spool record (no ambient ctx, found by
+    # batch_id): rolls replayed AND forwarded, completes the batch
+    ingestledger.note_replayed("0:0", 20, batch_id=bid)
+    d = ingestledger.balance_snapshot()["0:0"]
+    assert d["replayed"] == 20 and d["forwarded"] == 60
+    assert d["in_flight"] == 0
+    assert ctx.state == "done"
+    assert ingestledger.check_balanced() == []
+
+
+def test_conservation_drop_exits_with_reason():
+    with ingestledger.begin_batch("0:0"):
+        ingestledger.note_accepted("0:0", 10)
+        ingestledger.note_dropped("0:0", 4, "too_old")
+        ingestledger.note_stored("0:0", 6)
+    d = ingestledger.balance_snapshot()["0:0"]
+    assert d["dropped"] == {"too_old": 4}
+    assert d["in_flight"] == 0
+    assert ingestledger.check_balanced() == []
+
+
+def test_begin_batch_reenters_known_id_and_system_tenant_skips():
+    """An /internal/insert hop carrying a known batch_id re-enters the
+    SAME record (the in-process cluster case: frontend + storage hops
+    share one ctx), and system-tenant rolls stay off the ledger."""
+    with ingestledger.begin_batch("0:0") as outer:
+        ingestledger.note_accepted("0:0", 5)
+        with ingestledger.begin_batch(
+                "0:0", origin="internal",
+                batch_id=outer.batch_id) as inner:
+            assert inner is outer
+            ingestledger.note_received("0:0", 5)
+            ingestledger.note_stored("0:0", 5)
+        # inner extent exit must not complete the still-open outer
+        assert outer.state == "active"
+        ingestledger.note_forwarded("0:0", 5)
+    assert outer.state == "done"
+    assert outer.rows == 10 and outer.resolved == 10
+
+    ingestledger.note_accepted(events.SYSTEM_TENANT, 50)
+    ingestledger.note_stored(events.SYSTEM_TENANT, 50)
+    assert events.SYSTEM_TENANT not in ingestledger.balance_snapshot()
+
+
+def test_wrap_unwrap_roundtrip_and_legacy_passthrough():
+    body = b"\x28\xb5\x2f\xfdwire-bytes"
+    rec = ingestledger.wrap_record(body, "abcd:7", "3:0", 123,
+                                   accept_unix=1753660800.25)
+    meta, out = ingestledger.unwrap_record(rec)
+    assert out == body
+    assert meta == {"batch_id": "abcd:7", "tenant": "3:0",
+                    "nrows": 123, "ts": 1753660800.25}
+    # headerless (pre-upgrade spool) records pass through untouched
+    assert ingestledger.unwrap_record(body) == (None, body)
+    # torn header: fail open, never lose the payload
+    assert ingestledger.unwrap_record(b"VLB1\x00\x00\x00\xffxx")[0] is None
+
+
+def test_hop_aggregates_always_on_trace_off():
+    assert not ingestledger.trace_enabled()
+    with ingestledger.begin_batch("0:0") as ctx:
+        ingestledger.note_accepted("0:0", 1)
+        with ingestledger.hop("parse"):
+            pass
+        with ingestledger.hop("parse"):
+            pass
+        assert ctx.span is None          # no span tree unless opted in
+        ingestledger.note_stored("0:0", 1)
+    st = ingestledger.status_payload()
+    agg = st["hop_latency"]["0:0"]["parse"]
+    assert agg["count"] == 2 and agg["total_s"] >= 0
+    assert st["trace_enabled"] is False
+
+
+def test_trace_opt_in_grows_span_tree(monkeypatch):
+    monkeypatch.setenv("VL_INGEST_TRACE", "1")
+    with ingestledger.begin_batch("0:0") as ctx:
+        ingestledger.note_accepted("0:0", 1)
+        with ingestledger.hop("parse"):
+            pass
+        ingestledger.note_stored("0:0", 1)
+    snap = ctx.snapshot()
+    assert snap["trace"]["name"] == "ingest_batch"
+    assert [c["name"] for c in snap["trace"]["children"]] == ["parse"]
+
+
+def test_eviction_bounds_inflight_registry(monkeypatch):
+    monkeypatch.setenv("VL_INGEST_BATCHES_MAX", "8")
+    extents = [ingestledger.begin_batch("0:0") for _ in range(12)]
+    for e in extents:
+        e.__enter__()
+    assert ingestledger.inflight_batches() <= 8
+    for e in reversed(extents):
+        e.__exit__(None, None, None)
+
+
+def test_ledger_metrics_samples_shapes():
+    with ingestledger.begin_batch("9:0"):
+        ingestledger.note_accepted("9:0", 7)
+        ingestledger.note_dropped("9:0", 2, "too_new")
+        ingestledger.note_stored("9:0", 5)
+    samples = {(base, tuple(sorted(labels.items()))): v
+               for base, labels, v in ingestledger.metrics_samples()}
+    assert samples[("vl_ingest_ledger_rows_total",
+                    (("state", "accepted"), ("tenant", "9:0")))] == 7
+    assert samples[("vl_ingest_ledger_dropped_total",
+                    (("reason", "too_new"), ("tenant", "9:0")))] == 2
+    assert samples[("vl_ingest_ledger_in_flight",
+                    (("tenant", "9:0"),))] == 0
+    assert ("vl_ingest_batches_in_flight", ()) in samples
+
+
+# ------------------------------------------- storage chokepoint rolls
+
+def _mk_storage(tmp_path, name):
+    # 10000 days keeps 2025-era fixture rows in range while leaving
+    # min_ts positive, so the epoch-adjacent row really is too_old
+    return Storage(str(tmp_path / name), retention_days=10000,
+                   flush_interval=3600)
+
+
+def test_storage_rolls_stored_and_range_drops_only_under_batch(tmp_path):
+    s = _mk_storage(tmp_path, "ledgerstore")
+    try:
+        # no ambient batch: a direct test write stays OFF the ledger
+        lr = LogRows(stream_fields=["app"])
+        for i in range(10):
+            lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", f"m{i}")])
+        s.must_add_rows(lr)
+        assert "0:0" not in ingestledger.balance_snapshot()
+
+        # under a batch: stored + too_old/too_new rolls, exact
+        lr = LogRows(stream_fields=["app"])
+        for i in range(8):
+            lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", f"g{i}")])
+        lr.add(TEN, 1, [("app", "a"), ("_msg", "ancient")])
+        with ingestledger.begin_batch("0:0"):
+            ingestledger.note_accepted("0:0", 9)
+            s.must_add_rows(lr)
+        d = ingestledger.balance_snapshot()["0:0"]
+        assert d["stored"] == 8
+        assert d["dropped"] == {"too_old": 1}
+        assert d["in_flight"] == 0
+        # the ingest->queryable histogram observed this batch
+        assert hist.INGEST_TO_QUERYABLE.snapshot()[2] >= 1
+    finally:
+        s.close()
+
+
+def test_flush_observes_freshness_histogram(tmp_path):
+    s = _mk_storage(tmp_path, "freshstore")
+    try:
+        lr = LogRows(stream_fields=["app"])
+        for i in range(50):
+            lr.add(TEN, T0 + i * NS, [("app", "a"), ("_msg", f"f{i}")])
+        before = hist.INGEST_FRESHNESS.snapshot()[2]
+        s.must_add_rows(lr)
+        s.debug_flush()
+        assert hist.INGEST_FRESHNESS.snapshot()[2] > before
+    finally:
+        s.close()
+
+
+# -------------------------------------------------- HTTP plane
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_insert_status_endpoint_and_idle_quiesce(tmp_path):
+    from victorialogs_tpu.server.app import VLServer
+    s = _mk_storage(tmp_path, "statstore")
+    srv = VLServer(s, port=0)
+    got = []
+
+    def tap(ts_ns, event, fields):
+        if event == "ingest_batch":
+            got.append(dict(fields))
+    events.subscribe(tap)
+    try:
+        body = "\n".join(json.dumps(
+            {"_time": T0 + i * NS, "_msg": f"hello {i}", "app": "web"})
+            for i in range(40)).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}"
+            f"/insert/jsonline?_stream_fields=app", data=body)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+
+        st = _get_json(srv.port, "/insert/status")
+        assert st["status"] == "ok"
+        led = st["ledger"]["0:0"]
+        assert led["accepted"] == 40 and led["stored"] == 40
+        assert led["in_flight"] == 0
+        assert not st["in_flight"] and st["stalled_batches"] == 0
+        assert st["hop_latency"]["0:0"]["parse"]["count"] >= 1
+        assert st["recent"] and st["recent"][-1]["rows"] == 40
+        # single-node servers have no cluster spool section
+        assert "spool" not in st
+
+        # the batch completion journaled exactly once, with row counts
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.05)
+        assert [e["rows"] for e in got] == [40]
+        assert got[0]["status"] == "ok"
+
+        # ledger counters ride /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert ('vl_ingest_ledger_rows_total'
+                '{state="accepted",tenant="0:0"} 40') in metrics
+        assert "vl_ingest_batches_in_flight 0" in metrics
+        assert 'vl_ingest_watermark_seconds{tenant="0:0"}' in metrics
+        # and the per-tenant section rides /internal/usage for the
+        # clusterstats rollup
+        usage = _get_json(srv.port, "/internal/usage")
+        assert usage["ingest_ledger"]["0:0"]["stored"] == 40
+
+        # RECURSION GUARD (test-pinned): an idle server quiesces — the
+        # journal observing the ledger must not tick new ingest_batch
+        # events (system-tenant suppressed, zero-row batches silent)
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/internal/force_flush",
+            timeout=30)
+        n0 = len(got)
+        time.sleep(1.0)
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/internal/force_flush",
+            timeout=30)
+        time.sleep(0.5)
+        assert len(got) == n0, got[n0:]
+        assert ingestledger.check_balanced() == []
+    finally:
+        events.unsubscribe(tap)
+        srv.close()
+        s.close()
+
+
+# -------------------------------------------------- queue depth gauges
+
+def test_persistentqueue_entry_and_age_gauges(tmp_path):
+    from victorialogs_tpu.utils.persistentqueue import PersistentQueue
+    q = PersistentQueue(str(tmp_path / "pq"))
+    try:
+        assert q.pending_entries() == 0
+        assert q.oldest_age_seconds() == 0.0
+        q.append(b"a" * 10)
+        time.sleep(0.05)
+        q.append(b"b" * 20)
+        assert q.pending_entries() == 2
+        assert q.oldest_age_seconds() >= 0.05
+        first = q.read(timeout=1)
+        assert first == b"a" * 10
+        q.ack(len(first))
+        # FIFO byte-drain: the oldest entry left, the younger remains
+        assert q.pending_entries() == 1
+        assert q.oldest_age_seconds() < 10.0
+        second = q.read(timeout=1)
+        q.ack(len(second))
+        assert q.pending_entries() == 0
+        assert q.oldest_age_seconds() == 0.0
+    finally:
+        q.close()
+
+
+# -------------------------------------------------- drop-discipline lint
+
+def test_vlint_drop_discipline_checker():
+    from tools.vlint.core import SourceFile
+    from tools.vlint.dropdiscipline import check
+
+    src = '''
+def bad(self, n):
+    self.rows_dropped += n
+    events.emit("spool_overflow", node=1)
+
+def ledgered(self, t, n):
+    ingestledger.note_dropped(t, n, "too_old")
+    self.rows_dropped += n
+
+def via_helper(self, t, n):
+    self.rows_dropped += n
+    self._roll(t, n)
+
+def _roll(self, t, n):
+    ingestledger.note_dropped(t, n, "x")
+
+def annotated(self):
+    # vlint: allow-drop-discipline(block-level, rows counted upstream)
+    self.dropped_blocks += 1
+    events.emit("queue_block_rejected")
+'''
+    sf = SourceFile.parse("victorialogs_tpu/server/fake.py", text=src)
+    found = [f for f in check(sf)
+             if not sf.allowed(f.checker, f.line)]
+    assert {f.symbol for f in found} == {"bad"}
+    assert len(found) == 2          # the emit and the tally advance
+
+    # out-of-scope layers are never flagged
+    sf2 = SourceFile.parse("victorialogs_tpu/engine/fake.py", text=src)
+    assert check(sf2) == []
+
+
+def test_repo_is_drop_discipline_clean():
+    """Every drop site in server/ + storage/ goes through the ledger
+    (or carries a reasoned annotation) — the satellite's whole point,
+    pinned so a new bare drop site fails CI."""
+    import os
+    from tools.vlint.core import SourceFile
+    from tools.vlint.dropdiscipline import check
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = []
+    for sub in ("server", "storage"):
+        root = os.path.join(repo, "victorialogs_tpu", sub)
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            sf = SourceFile.parse(
+                f"victorialogs_tpu/{sub}/{fn}",
+                text=open(path, encoding="utf-8").read())
+            bad += [f.render() for f in check(sf)
+                    if not sf.allowed(f.checker, f.line)]
+    assert bad == []

@@ -17,7 +17,11 @@ Rounds (all recorded into BENCH_faults.json, asserting as it goes):
    answer (breaker half-open probe pacing);
 5. ingest outage — rows ingested while the only storage node is dead
    spool on the frontend and replay on revival: zero rows lost, exact
-   LogsQL count, replay drain time recorded.
+   LogsQL count, replay drain time recorded.  The outage must be
+   VISIBLE while it lasts (GET /insert/status shows stalled batches +
+   spool depth) and the conservation ledger must balance to the row
+   afterwards (accepted == forwarded == node-stored, replayed ==
+   spooled, zero in flight, zero dropped) on /insert/status?cluster=1.
 
 Usage: python tools/bench_faults.py [--json BENCH_faults.json]
 """
@@ -113,6 +117,12 @@ def _count(port, **extra):
         if "n" in obj:
             return int(obj["n"])
     raise AssertionError(f"no count in {text!r}")
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
 
 
 def main() -> int:
@@ -253,6 +263,29 @@ def main() -> int:
                     _rows(N_SPOOL_ROWS // 4,
                           offset=500 + k * (N_SPOOL_ROWS // 4)))
         ingest_s = time.monotonic() - t0
+
+        # the outage must be VISIBLE while it lasts: GET /insert/status
+        # shows the spooled batches as stalled and a non-empty durable
+        # spool (poll briefly — the ship->spool handoff is async
+        # relative to the ingest 200s)
+        t0 = time.monotonic()
+        while True:
+            st = _get_json(front_s_port, "/insert/status")
+            if st["stalled_batches"] >= 1 and \
+                    st["spool"]["pending_bytes"] > 0:
+                break
+            if time.monotonic() - t0 > 10:
+                raise AssertionError(f"outage invisible on "
+                                     f"/insert/status: {st}")
+            time.sleep(0.1)
+        stall_seen = {
+            "stalled_batches": st["stalled_batches"],
+            "spool_pending_bytes": st["spool"]["pending_bytes"],
+            "spool_entries": st["spool"].get("entries"),
+        }
+        print(f"outage visible: {stall_seen['stalled_batches']} stalled "
+              f"batches, {stall_seen['spool_pending_bytes']} spool bytes")
+
         sproxy.set_mode("pass")
         t0 = time.monotonic()
         while True:
@@ -268,12 +301,45 @@ def main() -> int:
                     f"{_count(front_s_port, partial='1')}")
             time.sleep(0.1)
         replay_s = time.monotonic() - t0
+
+        # exact conservation after the drain: the federated status must
+        # balance to the row — accepted rows all forwarded, every
+        # spooled row replayed, nothing in flight, nothing dropped,
+        # and the storage node's ledger shows them all stored
+        total = 500 + N_SPOOL_ROWS
+        t0 = time.monotonic()
+        while True:
+            st = _get_json(front_s_port, "/insert/status?cluster=1")
+            if st["spool"]["pending_bytes"] == 0 and \
+                    not st["in_flight"]:
+                break
+            if time.monotonic() - t0 > 30:
+                raise AssertionError(f"ledger did not settle: {st}")
+            time.sleep(0.1)
+        assert st["cluster"] is True, st
+        led = st["ledger"]["0:0"]
+        assert led["accepted"] == total, led
+        assert led["forwarded"] == total, led
+        assert led["in_flight"] == 0, led
+        assert led["dropped_rows"] == 0, led
+        assert led["replayed"] == led["spooled"], led
+        node_stored = sum(
+            (n.get("ledger") or {}).get("0:0", {}).get("stored", 0)
+            for n in st["nodes"] if n["up"])
+        assert node_stored == total, (node_stored, st["nodes"])
+        assert st["stalled_batches_cluster"] == 0, st
+
         out["ingest_outage"] = {
             "rows_during_outage": N_SPOOL_ROWS,
             "ingest_accept_s": round(ingest_s, 4),
             "replay_drain_s": round(replay_s, 4),
             "rows_lost": 0,
             "count_exact": True,
+            "outage_visible": stall_seen,
+            "ledger_balanced_exact": True,
+            "ledger": {k: led[k] for k in
+                       ("accepted", "forwarded", "spooled", "replayed",
+                        "in_flight", "dropped_rows")},
         }
         print(f"ingest outage: {N_SPOOL_ROWS} rows accepted in "
               f"{ingest_s:.3f}s while node dead, replay drained in "

@@ -1,6 +1,6 @@
 """Typed ingest end-to-end benchmark (wire format "i1", PR 18).
 
-Four recorded rounds over one synthetic jsonline corpus:
+Five recorded rounds over one synthetic jsonline corpus:
 
   library      the frontend hot path (vlinsert.handle_jsonline ->
                columnar build -> Storage) at 1 ingest thread and at
@@ -18,6 +18,10 @@ Four recorded rounds over one synthetic jsonline corpus:
                blocks replay VERBATIM (no re-encode) and no row is lost
   differential typed and legacy bodies for the SAME batch stored into
                two fresh Storages must query back bit-identically
+  freshness    ingest observability (PR 19): per-batch ingest ->
+               queryable latency p50/p99, plus the ledger/hop
+               instrumentation's own cost — the same corpus bare vs
+               under begin_batch with VL_INGEST_TRACE off
 
 Asserted (--no-assert skips):
   * typed wire DECODE rows/s >= 3x the 277k jsonline library baseline
@@ -32,11 +36,13 @@ Asserted (--no-assert skips):
   * rx_rows_json counter delta == 0 across the typed hop round
   * spool replay: zero rows lost, zero re-encodes
   * differential: sorted query lines identical
+  * freshness: tracing-off ledger overhead <= 1.10x bare ingest
 
 Run: make bench-ingest   (writes BENCH_ingest.json)
 """
 
 import argparse
+import gc
 import json
 import os
 import sys
@@ -273,6 +279,80 @@ def round_spool(n_blocks: int, rows_per_block: int) -> dict:
         tmp.cleanup()
 
 
+def round_freshness(n_batches: int, rows_per_batch: int) -> dict:
+    """Ingest observability round (PR 19): per-batch ingest->queryable
+    latency (p50/p99 over n_batches single-node library batches) plus
+    the cost of the always-on ledger/hop instrumentation itself —
+    the same corpus ingested bare (no batch ctx: the ledger's rolls
+    are all gated off) vs under begin_batch with tracing OFF.  The
+    overhead ratio is asserted <= 1.10x in main()."""
+    from victorialogs_tpu.obs import ingestledger
+    from victorialogs_tpu.server import vlinsert
+    from victorialogs_tpu.server.insertutil import (CommonParams,
+                                                    LogMessageProcessor)
+    from victorialogs_tpu.storage.log_rows import TenantID
+    from victorialogs_tpu.storage.storage import Storage
+    os.environ["VL_INGEST_THREADS"] = "1"
+    os.environ.pop("VL_INGEST_TRACE", None)
+    body = make_body(rows_per_batch)
+
+    def ingest_all(with_batch: bool):
+        """Total wall + per-batch accept->queryable samples."""
+        d = tempfile.mkdtemp(prefix="bench-ing-fresh")
+        s = Storage(d, retention_days=100000, flush_interval=3600)
+        cp = CommonParams(tenant=TenantID(0, 0), stream_fields=["app"])
+        samples = []
+        t_all = time.perf_counter()
+        for _ in range(n_batches):
+            t0 = time.perf_counter()
+            lmp = LogMessageProcessor(cp, s)
+            if with_batch:
+                with ingestledger.begin_batch("0:0"):
+                    with ingestledger.hop("parse"):
+                        n = vlinsert.handle_jsonline(cp, body, lmp)
+                    lmp.flush()
+            else:
+                n = vlinsert.handle_jsonline(cp, body, lmp)
+                lmp.flush()
+            assert n == rows_per_batch, (n, rows_per_batch)
+            # rows are queryable the moment must_add returned
+            # (snapshot_parts serves in-memory parts)
+            samples.append(time.perf_counter() - t0)
+        el = time.perf_counter() - t_all
+        s.close()
+        return el, samples
+
+    ingest_all(True)                     # warmup (imports, JIT)
+    # Interleave bare/ledger pairs so slow drift in a long-running
+    # bench process (GC pressure, allocator fragmentation from the
+    # earlier rounds) cancels out instead of landing entirely on
+    # whichever variant runs last.
+    bare_runs, led_runs = [], []
+    for _ in range(3):
+        gc.collect()
+        bare_runs.append(ingest_all(False))
+        gc.collect()
+        led_runs.append(ingest_all(True))
+    el_bare, _ = min(bare_runs)
+    el_led, samples = min(led_runs)
+    samples.sort()
+    p50 = samples[len(samples) // 2]
+    p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+    total = n_batches * rows_per_batch
+    return {
+        "batches": n_batches, "rows_per_batch": rows_per_batch,
+        "ingest_to_queryable_p50_ms": round(p50 * 1e3, 3),
+        "ingest_to_queryable_p99_ms": round(p99 * 1e3, 3),
+        "bare_rows_per_s": round(total / el_bare),
+        "ledger_rows_per_s": round(total / el_led),
+        "tracing_off_overhead_x": round(el_led / el_bare, 3),
+        "trace_enabled": False,
+        "note": "overhead_x compares the full ledger+hop path "
+                "(tracing off, the production default) against the "
+                "same ingest with every ledger roll gated off",
+    }
+
+
 def round_differential(n_rows: int) -> dict:
     from victorialogs_tpu.engine.emit import ndjson_block
     from victorialogs_tpu.engine.searcher import run_query
@@ -339,9 +419,16 @@ def main():
     print(f"differential: typed vs legacy stored data identical = "
           f"{diff['identical']} ({diff['stored_rows']} rows)")
 
+    fresh = round_freshness(n_batches=16,
+                            rows_per_batch=max(args.rows // 16, 1000))
+    print(f"freshness: ingest->queryable p50 "
+          f"{fresh['ingest_to_queryable_p50_ms']}ms / p99 "
+          f"{fresh['ingest_to_queryable_p99_ms']}ms; ledger overhead "
+          f"(tracing off) {fresh['tracing_off_overhead_x']}x")
+
     out = {"baseline_rows_per_s": BASELINE_ROWS_PER_S,
            "library": lib, "hop": hop, "spool": spool,
-           "differential": diff}
+           "differential": diff, "freshness": fresh}
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
@@ -362,6 +449,9 @@ def main():
         assert spool["replay_reencodes"] == 0, \
             "spool replay re-encoded blocks"
         assert diff["identical"], "typed vs legacy stored data differ"
+        assert fresh["tracing_off_overhead_x"] <= 1.10, \
+            f"ledger overhead {fresh['tracing_off_overhead_x']}x > " \
+            f"1.10x with tracing off"
         print("asserts: all passed")
 
 

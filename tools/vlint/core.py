@@ -220,11 +220,13 @@ def check_ctx_discipline(sf: "SourceFile", checker: str, ctors: dict,
 
 def _checkers():
     # late import: checker modules import core for Finding
-    from . import (accounting, balance, callgraph, hotpath, hygiene,
-                   leases, locks, netdiscipline, registry, spans)
+    from . import (accounting, balance, callgraph, dropdiscipline,
+                   hotpath, hygiene, leases, locks, netdiscipline,
+                   registry, spans)
     return [locks.check, hygiene.check, hotpath.check, spans.check,
             accounting.check, leases.check, netdiscipline.check,
-            balance.check, registry.check, callgraph.check]
+            balance.check, registry.check, dropdiscipline.check,
+            callgraph.check]
 
 
 # checker-id -> implementing module name, for `--explain` doc lookup.
@@ -236,6 +238,7 @@ CHECKER_MODULES = {
     "mutable-default": "hygiene", "nondaemon-thread": "hygiene",
     "span-discipline": "spans",
     "accounting-discipline": "accounting",
+    "drop-discipline": "dropdiscipline",
     "lease-discipline": "leases",
     "net-discipline": "netdiscipline",
     "balance-": "balance", "callable-identity": "balance",

@@ -1,0 +1,152 @@
+"""Drop-discipline checker (obs/ingestledger.py row conservation).
+
+The ingest ledger's invariant — ``accepted == stored + dropped +
+in_flight`` per tenant, swept after every test by vlsan and asserted
+exactly by the chaos round — only holds if every site that throws rows
+away also rolls ``ingestledger.note_dropped(tenant, n, reason)``.  A
+drop site that skips the ledger doesn't fail loudly: the rows just
+look in-flight forever, which is precisely the silent-loss class the
+ledger exists to catch.
+
+So the checker flags, in ``victorialogs_tpu/server/`` and
+``victorialogs_tpu/storage/`` (the two layers rows traverse), any
+function that *evidently drops or rejects data*:
+
+- an ``emit(...)`` / ``note(...)`` call whose string-literal argument
+  mentions dropped/rejected/overflow/discard (the repo's event and
+  fault-counter naming convention for loss paths), or
+- a ``+=`` onto a name or attribute containing ``dropped`` (a local
+  drop tally being advanced);
+
+...unless that function rolls the ledger — directly via
+``note_dropped(...)``, or through a same-module helper that does (one
+hop: ``Storage._ledger_rolls`` is the pattern) — or carries
+``# vlint: allow-drop-discipline(<why>)``.  The canonical allowed case
+is a *replica-level* block drop (vlagent's poisoned-queue-block path):
+the rows were already forwarded-counted once at enqueue, so no per-row
+ledger exit is owed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+# path fragments that put a module in scope: the layers ingest rows
+# traverse (obs/ingestledger.py itself lives outside both)
+_SCOPE = ("victorialogs_tpu/server/", "victorialogs_tpu/storage/")
+
+# loss vocabulary in event names / fault counters
+_KEYWORDS = ("dropped", "rejected", "overflow", "discard")
+
+# reporting calls whose string args carry the loss vocabulary:
+# events.emit / journal emit, netrobust.note / wire_ingest.note
+_EMITTERS = {"emit", "note"}
+
+
+def _callee(func) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _loss_string(call: ast.Call) -> str | None:
+    """The first string literal in the call mentioning a loss keyword."""
+    consts = [a for a in call.args if isinstance(a, ast.Constant)]
+    consts += [kw.value for kw in call.keywords
+               if isinstance(kw.value, ast.Constant)]
+    for c in consts:
+        if isinstance(c.value, str):
+            low = c.value.lower()
+            if any(k in low for k in _KEYWORDS):
+                return c.value
+    return None
+
+
+def _aug_target(node: ast.AugAssign) -> str | None:
+    t = node.target
+    if isinstance(t, ast.Attribute):
+        return t.attr
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+def _own_body(fn) -> list:
+    """Every node in the function EXCLUDING nested defs (each visited
+    exactly once) — a nested function's drop sites are judged against
+    the nested function (it is in the module walk too), not
+    double-attributed to its parent."""
+    out = []
+    stack = [n for n in fn.body
+             if not isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                stack.append(child)
+    return out
+
+
+def check(sf: SourceFile) -> list[Finding]:
+    path = sf.path.replace("\\", "/")
+    if not any(s in path for s in _SCOPE):
+        return []
+
+    funcs = [n for n in ast.walk(sf.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    # pass 1: ledger-rolling helpers — functions that call note_dropped
+    # directly; calling one of them satisfies the discipline (one hop)
+    rollers = set()
+    for fn in funcs:
+        for sub in _own_body(fn):
+            if isinstance(sub, ast.Call) and \
+                    _callee(sub.func) == "note_dropped":
+                rollers.add(fn.name)
+
+    findings: list[Finding] = []
+    for fn in funcs:
+        calls: set[str] = set()
+        indicators: list[tuple[int, str]] = []
+        for sub in _own_body(fn):
+            if isinstance(sub, ast.Call):
+                name = _callee(sub.func)
+                if name:
+                    calls.add(name)
+                if name in _EMITTERS:
+                    s = _loss_string(sub)
+                    if s is not None:
+                        indicators.append(
+                            (sub.lineno,
+                             f"`{name}({s!r})` reports a loss path"))
+            elif isinstance(sub, ast.AugAssign):
+                t = _aug_target(sub)
+                if t and "dropped" in t.lower():
+                    indicators.append(
+                        (sub.lineno,
+                         f"`{t} +=` advances a drop tally"))
+        if not indicators:
+            continue
+        if "note_dropped" in calls or calls & rollers:
+            continue
+        # one annotated indicator documents the whole function's drop
+        # path (the reason applies to the path, not the single line)
+        if any(sf.allowed("drop-discipline", ln) for ln, _ in indicators):
+            continue
+        for ln, desc in indicators:
+            findings.append(Finding(
+                "drop-discipline", sf.path, ln, fn.name,
+                f"{desc} but the function never rolls "
+                f"ingestledger.note_dropped(tenant, n, reason) — the "
+                f"dropped rows stay 'in flight' forever and the "
+                f"accepted == stored + dropped + in_flight sweep "
+                f"cannot prove conservation; roll the ledger or "
+                f"annotate why no per-row exit is owed"))
+    return findings

@@ -22,6 +22,10 @@ parser test never pays for the cluster stack):
   the PR 8 ``is``-matched-unsubscribe leak class;
 - every live ``JournalWriter``: accepted == written + dropped +
   queued + in-flight;
+- ingest row-conservation ledger (obs/ingestledger.py):
+  ``check_balanced()`` — no counter negative, no tenant resolved more
+  rows than entered (accepted+received >= stored+forwarded+dropped),
+  replays bounded by spools;
 - per-part result cache (engine/standing/resultcache.py):
   ``cache_check_balanced()`` — cache bytes equal the sum of live
   part charges and the sum of entry sizes, never negative; retried
@@ -93,6 +97,7 @@ class Sanitizer:
         problems += self._check_standing()
         problems += self._check_subscribers()
         problems += self._check_journal()
+        problems += self._check_ingest_ledger()
         problems += self._check_admission()
         problems += self._check_threads()
         problems += self._check_counters()
@@ -217,6 +222,24 @@ class Sanitizer:
                            f"broken: {detail}")
         return out
 
+    def _check_ingest_ledger(self) -> list[str]:
+        il = _mod("victorialogs_tpu.obs.ingestledger")
+        if il is None:
+            return []
+        # rows may legitimately still be in flight (a spool the test
+        # never drained), but no counter may go NEGATIVE and no tenant
+        # may resolve more rows than entered — retried because a
+        # storage roll can race the sweep by one flush
+        ok, detail = self._retry(
+            lambda: ((not il.check_balanced()),
+                     "; ".join(il.check_balanced())))
+        if not ok:
+            return [f"ingest ledger conservation violated: {detail} — "
+                    f"a hop rolled stored/forwarded/dropped without a "
+                    f"matching accepted/received entry (or double-"
+                    f"counted a terminal state)"]
+        return []
+
     def _check_admission(self) -> list[str]:
         adm = _mod("victorialogs_tpu.sched.admission")
         if adm is None:
@@ -280,6 +303,8 @@ class Sanitizer:
         for modname, provider in (
                 ("victorialogs_tpu.obs.events", "metrics_samples"),
                 ("victorialogs_tpu.obs.journal", "metrics_samples"),
+                ("victorialogs_tpu.obs.ingestledger",
+                 "metrics_samples"),
                 ("victorialogs_tpu.obs.activity", "metrics_samples"),
                 ("victorialogs_tpu.sched.scheduler", "metrics_samples"),
                 ("victorialogs_tpu.sched.admission", "metrics_samples"),

@@ -384,6 +384,19 @@ declare_env(
     "`GET /select/logsql/tenants` (`obs/clusterstats.py`; `0` disables "
     "the poll loop)")
 declare_env(
+    "VL_INGEST_TRACE", "0", "bool",
+    "`1` = per-batch ingest span trees: every accepted batch grows a "
+    "real `obs/tracing.py` tree (one child span per hop: parse/encode/"
+    "shard/ship/spool/replay/decode/store) surfaced on "
+    "`GET /insert/status` and in `ingest_batch` journal events; off, "
+    "only the always-on per-(tenant, hop) latency aggregates roll "
+    "(`obs/ingestledger.py`; bench-asserted <=1.10x when off)")
+declare_env(
+    "VL_INGEST_BATCHES_MAX", "512", "int",
+    "max in-flight ingest batch records the row-conservation ledger "
+    "tracks; past it the oldest records evict to the completed ring "
+    "(counters are unaffected — only per-batch detail is bounded)")
+declare_env(
     "VL_MEMORY_ALLOWED_BYTES", None, "int",
     "query memory budget", display="auto")
 declare_env(
@@ -659,6 +672,28 @@ declare_metric("vl_insert_spool_overflow_total", "counter",
                single_roll=True)
 declare_metric("vl_insert_spool_bytes", "gauge",
                "bytes currently spooled per node")
+declare_metric("vl_insert_spool_entries", "gauge",
+               "blocks currently spooled per node")
+declare_metric("vl_insert_spool_oldest_age_seconds", "gauge",
+               "age of the oldest unreplayed spool block per node")
+
+# -- ingest observability plane (obs/ingestledger.py) --
+declare_metric("vl_ingest_ledger_rows_total", "counter",
+               "row-conservation ledger counters by tenant and state "
+               "(accepted/received/forwarded/spooled/replayed/stored)",
+               single_roll=True)
+declare_metric("vl_ingest_ledger_dropped_total", "counter",
+               "rows terminally dropped by tenant and reason "
+               "(the ledger's only loss exit)", single_roll=True)
+declare_metric("vl_ingest_ledger_in_flight", "gauge",
+               "derived in-flight rows per tenant: accepted+received "
+               "- stored - forwarded - dropped", single_roll=True)
+declare_metric("vl_ingest_batches_in_flight", "gauge",
+               "ingest batches currently tracked by the ledger",
+               single_roll=True)
+declare_metric("vl_ingest_watermark_seconds", "gauge",
+               "per-tenant freshness lag: seconds since the max stored "
+               "row timestamp", single_roll=True)
 
 # -- cluster observability plane (obs/clusterstats.py, federated
 #    registry + cancel propagation in server/cluster.py + app.py) --
@@ -677,6 +712,12 @@ declare_metric("vl_cluster_node_up", "gauge",
 declare_metric("vl_cluster_stats_age_seconds", "gauge",
                "staleness of a node's last successful usage poll",
                single_roll=True)
+declare_metric("vl_cluster_ingest_in_flight", "gauge",
+               "worst-case (max across nodes) in-flight ingest rows "
+               "per tenant from the ledger rollup", single_roll=True)
+declare_metric("vl_cluster_ingest_dropped", "gauge",
+               "worst-case (max across nodes) dropped ingest rows per "
+               "tenant from the ledger rollup", single_roll=True)
 declare_metric("vl_queries_cancel_propagated_total", "counter",
                "sub-queries cancelled via propagated cluster cancel "
                "(POST /internal/select/cancel)", single_roll=True)
@@ -737,3 +778,9 @@ declare_metric("vl_cost_model_rel_error_bytes", "histogram",
 declare_metric("vl_cost_model_rel_error_dispatches", "histogram",
                "cost-model relative error: predicted vs actual "
                "dispatch count")
+declare_metric("vl_ingest_freshness_seconds", "histogram",
+               "in-memory residency of rows at flush: flush time minus "
+               "the flushed parts' oldest creation time")
+declare_metric("vl_ingest_to_queryable_seconds", "histogram",
+               "accept wall clock to rows queryable (storage "
+               "must_add return), observed per batch")

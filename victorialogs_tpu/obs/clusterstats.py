@@ -134,7 +134,9 @@ class ClusterStatsPoller:
                               active_queries=snap.get(
                                   "active_queries", 0),
                               queued=snap.get("queued", 0),
-                              storage=snap.get("storage") or {})
+                              storage=snap.get("storage") or {},
+                              ingest_ledger=snap.get(
+                                  "ingest_ledger") or {})
                 else:
                     # keep the last-seen tenant totals: monotonic
                     # counters must not regress because the node died
@@ -155,6 +157,30 @@ class ClusterStatsPoller:
                 for k, v in slot.items():
                     if isinstance(v, (int, float)):
                         cur[k] = cur.get(k, 0) + v
+        return agg
+
+    def ledger_rollup(self) -> dict[str, dict]:
+        """tenant -> worst-case ingest-conservation view across nodes
+        (from each node's /internal/usage ``ingest_ledger`` section).
+
+        Uses MAX per counter, not SUM: in-process test clusters share
+        one ledger registry so every node reports identical totals and
+        a sum would multi-count N-fold, while for real per-process
+        nodes the max is still the right *stall/loss indicator* —
+        any tenant with rows stuck (in_flight) or lost (dropped) on ANY
+        node shows a nonzero value here.  Exact cluster totals come
+        from summing ``vl_ingest_ledger_*`` across scrapes, where the
+        scraper sees one process per target."""
+        agg: dict[str, dict] = {}
+        with self._mu:
+            node_ledgers = [dict(st.get("ingest_ledger") or {})
+                            for st in self._nodes.values()]
+        for ledger in node_ledgers:
+            for t, slot in ledger.items():
+                cur = agg.setdefault(t, {})
+                for k, v in slot.items():
+                    if isinstance(v, (int, float)):
+                        cur[k] = max(cur.get(k, 0), v)
         return agg
 
     def nodes_snapshot(self) -> list[dict]:
@@ -180,9 +206,13 @@ class ClusterStatsPoller:
         agg = self.aggregated_tenants()
         if tenant is not None:
             agg = {t: s for t, s in agg.items() if t == tenant}
+        ledger = self.ledger_rollup()
+        if tenant is not None:
+            ledger = {t: s for t, s in ledger.items() if t == tenant}
         return {
             "status": "ok", "cluster": True,
             "tenants": {t: agg[t] for t in sorted(agg)},
+            "ingest_ledger": {t: ledger[t] for t in sorted(ledger)},
             "nodes": self.nodes_snapshot(),
             "poll_interval_ms": int(self.interval_s * 1e3),
         }
@@ -199,6 +229,13 @@ class ClusterStatsPoller:
             for key, name in ROLLUP_SERIES:
                 # vlint: allow-per-row-emit(metric samples, bounded by tenant cap x 3 series)
                 out.append((name, {"tenant": t}, slot.get(key, 0)))
+        ledger = self.ledger_rollup()
+        for t in sorted(ledger):
+            # vlint: allow-per-row-emit(metric samples, bounded by tenant cap x 2 series)
+            out.append(("vl_cluster_ingest_in_flight", {"tenant": t},
+                        ledger[t].get("in_flight", 0)))
+            out.append(("vl_cluster_ingest_dropped", {"tenant": t},
+                        ledger[t].get("dropped", 0)))
         now = time.monotonic()
         with self._mu:
             metas = [(url, dict(st)) for url, st in self._nodes.items()]

@@ -200,6 +200,22 @@ FILTER_INDEX_BUILD = histogram(
     (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
      2.5, 5.0))
 
+INGEST_FRESHNESS = histogram(
+    "vl_ingest_freshness_seconds",
+    "how long flushed rows sat in memory: flush time minus the oldest "
+    "flushed in-memory part's creation time (storage/datadb.py "
+    "flush_inmemory_parts — the part-visible half of the freshness "
+    "watermark pair)",
+    (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+
+INGEST_TO_QUERYABLE = histogram(
+    "vl_ingest_to_queryable_seconds",
+    "accept wall clock to rows queryable: observed per batch at the "
+    "storage chokepoint (snapshot_parts serves in-memory parts the "
+    "moment must_add returns — obs/ingestledger.py)",
+    (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+     2.5, 5.0, 10.0, 30.0))
+
 MERGE_SECONDS = histogram(
     "vl_storage_merge_duration_seconds",
     "wall time of one background part merge (small/big tier "

@@ -1,0 +1,682 @@
+"""Row-conservation ledger + per-hop batch tracing for the ingest path.
+
+The write-path twin of obs/activity.py: every batch of rows entering
+the process is minted a cluster-unique ``batch_id`` at its accept point
+(vlinsert HTTP handlers, vlagent pickup, /internal/insert decode), and
+every hop it crosses — parse, encode, shard, ship, spool, replay,
+decode, store — rolls into one process-global registry:
+
+- **conservation counters** per tenant: ``accepted`` (client-facing
+  entry) and ``received`` (internal-hop entry) on the way in;
+  ``stored``, ``forwarded`` and ``dropped{reason}`` as terminal states;
+  ``spooled`` / ``replayed`` as the durable detour.  The invariant
+
+      accepted + received == stored + forwarded + dropped + in_flight
+
+  holds per process at all times (entry counters roll BEFORE terminal
+  ones on every path, so the derived ``in_flight`` never goes
+  negative), and telescopes cluster-wide — summing over all nodes,
+  every ``forwarded`` row is some node's ``received`` row, leaving the
+  ISSUE form ``accepted == stored + dropped + in_flight``.  The vlsan
+  end-of-test sweep calls :func:`check_balanced`, making "zero lost
+  rows" a machine-checked invariant instead of a test assertion;
+- **per-hop latency aggregates** per (tenant, hop): count / total_s /
+  max_s, always on and amortized per batch (never per row).  With
+  ``VL_INGEST_TRACE=1`` each batch additionally grows a real
+  obs/tracing.py span tree (root ``ingest_batch``, one child per hop)
+  surfaced on ``GET /insert/status`` and in the ``ingest_batch``
+  journal event;
+- **freshness watermarks** per tenant: the max stored row timestamp
+  (``vl_ingest_watermark_seconds``) plus the accept-wall-clock →
+  queryable latency histogram fed from the storage chokepoint.
+
+Batch identity propagates ambiently via a contextvar
+(:func:`current_batch`; :func:`use_batch` re-enters on worker threads)
+and across processes as the ``batch_id`` query arg on
+``/internal/insert`` — the ingest twin of ``parent_qid`` — plus a
+small header on spool / vlagent queue records (:func:`wrap_record`),
+so replay after a restart still attributes rows to their batch.
+
+The reserved system tenant (journal self-ingest) is excluded from the
+ledger entirely and its ``ingest_batch`` events are suppressed by the
+events-bus recursion guard, so the database observing itself cannot
+unbalance — or re-enter — the ledger (test-pinned: idle server
+quiesces).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import struct
+import threading
+import time
+from collections import deque
+
+from .. import config
+from . import events, hist, tracing
+
+SYSTEM_TENANT = events.SYSTEM_TENANT
+
+# the conservation counters rendered as
+# vl_ingest_ledger_rows_total{tenant=,state=}
+STATES = ("accepted", "received", "forwarded", "spooled", "replayed",
+          "stored")
+
+# an in-flight batch older than this (or parked in the spool) counts
+# into /insert/status "stalled_batches" — the chaos-round signal
+STALL_AGE_S = 5.0
+
+# tenant labels come from client headers: cap the map like
+# obs/activity.py so cycling AccountIDs can't explode /metrics
+_TENANT_MAX = 1024
+_TENANT_OVERFLOW = "other"
+_COMPLETED_MAX = 64
+
+# process-unique batch-id origin, the ingest twin of
+# activity._ORIGIN/global_qid: local seqs collide across frontends,
+# the prefixed spelling is what propagates on /internal/insert hops
+_ORIGIN = os.urandom(4).hex()
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "vl_ingest_batch", default=None)
+
+# one registry lock: counter rolls are per batch/hop (never per row),
+# so contention is noise next to the work being measured
+_mu = threading.Lock()
+_seq = 0
+_tenants: dict[str, dict] = {}        # tenant -> {state: n, "dropped": {}}
+_hops: dict[str, dict] = {}           # tenant -> {hop: [count, total, max]}
+_watermark: dict[str, float] = {}     # tenant -> max stored _time (unix s)
+_inflight: dict[str, "BatchCtx"] = {}
+_completed: deque = deque(maxlen=_COMPLETED_MAX)
+
+
+def trace_enabled() -> bool:
+    """VL_INGEST_TRACE=1 grows a real span tree per batch; default off
+    (the always-on hop aggregates are the zero-config signal — the
+    bench asserts tracing-off overhead stays within 1.10x)."""
+    return config.env_bool("VL_INGEST_TRACE")
+
+
+def _batches_max() -> int:
+    return max(8, config.env_int("VL_INGEST_BATCHES_MAX"))
+
+
+class BatchCtx:
+    """One ingest batch's lifetime record.  Mint only via
+    :func:`begin_batch`; fields are mutated under the module lock."""
+
+    __slots__ = ("batch_id", "tenant", "accept_unix", "t0", "t1",
+                 "state", "origin", "rows", "resolved", "spool_pending",
+                 "dropped_rows", "hops", "span", "extents")
+
+    def __init__(self, batch_id: str, tenant: str, origin: str,
+                 accept_unix: float):
+        self.batch_id = batch_id
+        self.tenant = tenant
+        self.origin = origin
+        self.accept_unix = accept_unix
+        self.t0 = time.monotonic()
+        self.t1: float | None = None
+        self.state = "active"
+        self.rows = 0            # entry-counted rows (accepted+received)
+        self.resolved = 0        # terminal rows (stored+forwarded+dropped)
+        self.spool_pending = 0   # rows parked in the durable spool
+        self.dropped_rows = 0
+        self.hops: dict[str, list] = {}   # hop -> [count, total_s, max_s]
+        self.span = tracing.make_root(
+            "ingest_batch", batch_id=batch_id,
+            tenant=tenant, origin=origin) if trace_enabled() else None
+        self.extents = 0         # live begin_batch/use_batch extents
+
+    def unresolved(self) -> int:
+        return self.rows - self.resolved
+
+    def snapshot(self, now: float | None = None) -> dict:
+        if now is None:
+            now = time.monotonic()
+        end = self.t1 if self.t1 is not None else now
+        out = {
+            "batch_id": self.batch_id,
+            "tenant": self.tenant,
+            "origin": self.origin,
+            "state": self.state,
+            "rows": self.rows,
+            "resolved": self.resolved,
+            "age_s": round(end - self.t0, 3),
+        }
+        if self.spool_pending:
+            out["spool_pending_rows"] = self.spool_pending
+        if self.dropped_rows:
+            out["dropped_rows"] = self.dropped_rows
+        if self.hops:
+            out["hops"] = {h: {"count": c[0],
+                               "total_s": round(c[1], 6),
+                               "max_s": round(c[2], 6)}
+                           for h, c in sorted(self.hops.items())}
+        if self.span is not None:
+            out["trace"] = self.span.to_dict()
+        return out
+
+
+def current_batch() -> BatchCtx | None:
+    """The ambient batch of this thread's ingest extent, or None — the
+    storage chokepoint gates its ``stored`` roll on this, so direct
+    storage writes (tests, journal self-ingest) stay off the ledger."""
+    return _current.get()
+
+
+def _tenant_cap(tenant: str) -> str:
+    # caller holds _mu
+    if tenant in _tenants or len(_tenants) < _TENANT_MAX:
+        return tenant
+    return _TENANT_OVERFLOW
+
+
+def _slot(tenant: str) -> dict:
+    # caller holds _mu
+    tenant = _tenant_cap(tenant)
+    slot = _tenants.get(tenant)
+    if slot is None:
+        slot = _tenants[tenant] = {s: 0 for s in STATES}
+        slot["dropped"] = {}
+    return slot
+
+
+class _BatchExtent:
+    """Dynamic extent of one batch hop on this thread: sets the ambient
+    ctx, finishes the batch bookkeeping on every exit path."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: BatchCtx):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> BatchCtx:
+        with _mu:
+            self._ctx.extents += 1
+        self._token = _current.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _current.reset(self._token)
+        _finish_extent(self._ctx)
+        return False
+
+
+def begin_batch(tenant, origin: str = "http", batch_id: str | None = None,
+                accept_unix: float | None = None) -> _BatchExtent:
+    """Enter one batch's tracking extent (context-manager-only).
+
+    Without ``batch_id`` a fresh cluster-unique id is minted — the
+    accept point.  With one (an /internal/insert or replay hop) the
+    existing in-flight record is re-entered when this process already
+    tracks it (the in-process cluster case), so a batch's frontend and
+    storage hops share one record; otherwise a record is registered
+    under the propagated id (the separate-process case)."""
+    global _seq
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if accept_unix is None:
+        # vlint: allow-wall-clock(accept time anchors the ingest->queryable latency, real wall time by design)
+        accept_unix = time.time()
+    with _mu:
+        ctx = _inflight.get(batch_id) if batch_id else None
+        if ctx is None:
+            if batch_id is None:
+                _seq += 1
+                batch_id = f"{_ORIGIN}:{_seq}"
+            ctx = BatchCtx(batch_id, tenant, origin, accept_unix)
+            if tenant != SYSTEM_TENANT:
+                _inflight[batch_id] = ctx
+                _evict_locked()
+    return _BatchExtent(ctx)
+
+
+def use_batch(ctx: BatchCtx | None) -> _BatchExtent | tracing._NoopCtx:
+    """Re-enter an existing batch in another thread — the propagation
+    shim for ingest worker fan-outs (the sharded-parse pool)."""
+    if ctx is None:
+        return tracing._NOOP_CTX
+    return _BatchExtent(ctx)
+
+
+def _evict_locked() -> None:
+    over = len(_inflight) - _batches_max()
+    if over <= 0:
+        return
+    for bid in sorted(_inflight, key=lambda b: _inflight[b].t0)[:over]:
+        ctx = _inflight.pop(bid)
+        ctx.state = "evicted"
+        ctx.t1 = time.monotonic()
+        _completed.append(ctx.snapshot(ctx.t1))
+
+
+def _finish_extent(ctx: BatchCtx) -> None:
+    done = None
+    with _mu:
+        ctx.extents -= 1
+        if ctx.extents > 0 or ctx.state in ("done", "evicted"):
+            return
+        if ctx.unresolved() > 0 or ctx.spool_pending > 0:
+            # rows parked in the durable spool (or shipped but not yet
+            # decoded): the batch stays in-flight until replay/decode
+            # resolves it — what /insert/status shows as stalled
+            ctx.state = "spooled" if ctx.spool_pending > 0 else "shipping"
+            return
+        done = _complete_locked(ctx)
+    if done is not None:
+        _emit_done(done)
+
+
+def _complete_locked(ctx: BatchCtx) -> BatchCtx:
+    ctx.state = "done"
+    ctx.t1 = time.monotonic()
+    if ctx.span is not None:
+        ctx.span.close()
+    _inflight.pop(ctx.batch_id, None)
+    if ctx.rows > 0:
+        # zero-row batches (system-tenant journal flushes riding
+        # /internal/insert, empty client posts) leave no trace: the
+        # idle-quiesce guarantee
+        _completed.append(ctx.snapshot(ctx.t1))
+    return ctx
+
+
+def _emit_done(ctx: BatchCtx) -> None:
+    # outside the lock; system-tenant batches suppress in events.emit,
+    # zero-row batches (journal self-ingest hops) emit nothing at all
+    if ctx.rows <= 0:
+        return
+    events.emit("ingest_batch", tenant=ctx.tenant,
+                batch_id=ctx.batch_id, origin=ctx.origin,
+                rows=ctx.rows, dropped_rows=ctx.dropped_rows,
+                duration_ms=round((ctx.t1 - ctx.t0) * 1e3, 3),
+                status="dropped" if ctx.dropped_rows else "ok")
+
+
+def _maybe_complete_locked(ctx: BatchCtx) -> BatchCtx | None:
+    """A terminal roll resolved rows on a batch whose extents already
+    exited (spool replay, cross-thread decode): complete it."""
+    if ctx.extents == 0 and ctx.state not in ("done", "evicted") and \
+            ctx.unresolved() <= 0 and ctx.spool_pending <= 0:
+        return _complete_locked(ctx)
+    return None
+
+
+# ---------------------------------------------------------------- counters
+
+def _enter_rows(tenant: str, state: str, n: int,
+                ctx: BatchCtx | None) -> None:
+    with _mu:
+        _slot(tenant)[state] += n
+        if ctx is not None:
+            ctx.rows += n
+
+
+def _terminal_rows(tenant: str, state: str, n: int,
+                   ctx: BatchCtx | None) -> None:
+    done = None
+    with _mu:
+        _slot(tenant)[state] += n
+        if ctx is not None:
+            ctx.resolved += n
+            done = _maybe_complete_locked(ctx)
+    if done is not None:
+        _emit_done(done)
+
+
+def note_accepted(tenant, n: int) -> None:
+    """Rows entered at a client-facing accept point (vlinsert HTTP,
+    vlagent pickup).  Entry counters roll BEFORE any terminal counter
+    on every path, so derived in_flight never dips negative."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    _enter_rows(tenant, "accepted", n, _current.get())
+
+
+def note_received(tenant, n: int) -> None:
+    """Rows entered via an internal hop (/internal/insert decode) —
+    the counter that cancels ``forwarded`` in the cluster-wide sum."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    _enter_rows(tenant, "received", n, _current.get())
+
+
+def note_forwarded(tenant, n: int, batch: BatchCtx | None = None) -> None:
+    """Rows shipped to another node (terminal for THIS process)."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    _terminal_rows(tenant, "forwarded", n,
+                   batch if batch is not None else _current.get())
+
+
+def note_stored(tenant, n: int, max_ts_unix: float | None = None) -> None:
+    """Rows written into local storage (terminal).  ``max_ts_unix``
+    advances the tenant's freshness watermark."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    ctx = _current.get()
+    done = None
+    with _mu:
+        _slot(tenant)["stored"] += n
+        if max_ts_unix is not None:
+            t = _tenant_cap(tenant)
+            if max_ts_unix > _watermark.get(t, 0.0):
+                _watermark[t] = max_ts_unix
+        if ctx is not None:
+            ctx.resolved += n
+            done = _maybe_complete_locked(ctx)
+    if done is not None:
+        _emit_done(done)
+    if ctx is not None and ctx.accept_unix:
+        # accept wall clock -> rows queryable (snapshot_parts serves
+        # in-memory parts the moment must_add returns): the
+        # ingest-to-queryable latency, observed per batch
+        # vlint: allow-wall-clock(latency vs the batch's accept wall time)
+        now = time.time()
+        hist.INGEST_TO_QUERYABLE.observe(
+            max(0.0, now - ctx.accept_unix))
+
+
+def note_spooled(tenant, n: int) -> None:
+    """Rows parked in the durable spool (NOT terminal: they stay
+    in-flight until replay forwards or drops them)."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    ctx = _current.get()
+    with _mu:
+        _slot(tenant)["spooled"] += n
+        if ctx is not None:
+            ctx.spool_pending += n
+
+
+def note_replayed(tenant, n: int, batch_id: str | None = None) -> None:
+    """Rows successfully re-shipped from the spool: rolls ``replayed``
+    AND ``forwarded`` (the terminal state), and drains the owning
+    batch's spool-pending count (found by the spool record's
+    ``batch_id`` header — the replay loop has no ambient ctx)."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    done = None
+    with _mu:
+        slot = _slot(tenant)
+        slot["replayed"] += n
+        slot["forwarded"] += n
+        ctx = _inflight.get(batch_id) if batch_id else None
+        if ctx is not None:
+            ctx.spool_pending = max(0, ctx.spool_pending - n)
+            ctx.resolved += n
+            done = _maybe_complete_locked(ctx)
+    if done is not None:
+        _emit_done(done)
+
+
+def note_dropped(tenant, n: int, reason: str,
+                 batch_id: str | None = None,
+                 from_spool: bool = False) -> None:
+    """Rows terminally dropped, with a reason label — the ONE exit
+    every drop site in server/ and storage/ must take (enforced by the
+    vlint drop-discipline checker)."""
+    from . import activity
+    tenant = activity.tenant_str(tenant)
+    if tenant == SYSTEM_TENANT or n <= 0:
+        return
+    done = None
+    with _mu:
+        slot = _slot(tenant)
+        slot["dropped"][reason] = slot["dropped"].get(reason, 0) + n
+        ctx = _inflight.get(batch_id) if batch_id else _current.get()
+        if ctx is not None:
+            if from_spool:
+                ctx.spool_pending = max(0, ctx.spool_pending - n)
+            ctx.resolved += n
+            ctx.dropped_rows += n
+            done = _maybe_complete_locked(ctx)
+    if done is not None:
+        _emit_done(done)
+
+
+# ---------------------------------------------------------------- hops
+
+def _note_hop(tenant: str, name: str, dt: float,
+              ctx: BatchCtx | None) -> None:
+    with _mu:
+        agg = _hops.setdefault(_tenant_cap(tenant), {})
+        cell = agg.setdefault(name, [0, 0.0, 0.0])
+        cell[0] += 1
+        cell[1] += dt
+        cell[2] = max(cell[2], dt)
+        if ctx is not None:
+            cell = ctx.hops.setdefault(name, [0, 0.0, 0.0])
+            cell[0] += 1
+            cell[1] += dt
+            cell[2] = max(cell[2], dt)
+
+
+class _Hop:
+    """Times one hop's extent into the per-(tenant, hop) aggregates;
+    under VL_INGEST_TRACE it also opens a real child span on the
+    batch's trace tree.  Cost when tracing is off: one perf_counter
+    pair + one locked dict roll per batch hop — never per row."""
+
+    __slots__ = ("_name", "_tenant", "_ctx", "_t0", "_spanctx")
+
+    def __init__(self, name: str, tenant: str | None):
+        self._name = name
+        self._tenant = tenant
+        self._ctx = None
+        self._t0 = 0.0
+        self._spanctx = None
+
+    def __enter__(self) -> "_Hop":
+        ctx = _current.get()
+        self._ctx = ctx
+        if ctx is not None and ctx.span is not None:
+            # vlint: allow-span-discipline(_Hop IS the with-block: the child span enters here and closes in __exit__ on every unwind path)
+            self._spanctx = ctx.span.span(self._name)
+            self._spanctx.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dt = time.perf_counter() - self._t0
+        if self._spanctx is not None:
+            self._spanctx.__exit__(exc_type, exc, tb)
+        tenant = self._tenant or \
+            (self._ctx.tenant if self._ctx is not None else None)
+        if tenant and tenant != SYSTEM_TENANT:
+            _note_hop(tenant, self._name, dt, self._ctx)
+        return False
+
+
+def hop(name: str, tenant: str | None = None) -> _Hop:
+    """Context manager timing one ingest hop (parse/encode/shard/ship/
+    spool/replay/decode/store).  ``tenant`` overrides the ambient
+    batch's attribution (the replay loop runs without one)."""
+    return _Hop(name, tenant)
+
+
+# ------------------------------------------------- spool record framing
+
+# spool / vlagent queue records gain a small self-describing header so
+# replay AFTER a process restart still attributes rows to their batch
+# and tenant; headerless records (pre-upgrade spools) pass through
+_REC_MAGIC = b"VLB1"
+
+
+def wrap_record(body: bytes, batch_id: str, tenant, nrows: int,
+                accept_unix: float | None = None) -> bytes:
+    from . import activity
+    m = {"batch_id": batch_id, "tenant": activity.tenant_str(tenant),
+         "nrows": nrows}
+    if accept_unix:
+        # the batch's original accept wall clock survives the spool, so
+        # ingest->queryable latency measured after replay still spans
+        # the outage it sat out
+        m["ts"] = round(accept_unix, 6)
+    meta = json.dumps(m, separators=(",", ":")).encode()
+    return _REC_MAGIC + struct.pack(">I", len(meta)) + meta + body
+
+
+def unwrap_record(rec: bytes) -> tuple[dict | None, bytes]:
+    """(meta, body); meta is None for a headerless legacy record."""
+    if not rec.startswith(_REC_MAGIC):
+        return None, rec
+    try:
+        n = struct.unpack(">I", rec[4:8])[0]
+        meta = json.loads(rec[8:8 + n])
+        return meta, rec[8 + n:]
+    except (struct.error, ValueError):
+        return None, rec
+
+
+# ---------------------------------------------------------------- reads
+
+def _derived_locked(slot: dict) -> tuple[int, int]:
+    dropped = sum(slot["dropped"].values())
+    in_flight = (slot["accepted"] + slot["received"] - slot["stored"]
+                 - slot["forwarded"] - dropped)
+    return dropped, in_flight
+
+
+def balance_snapshot() -> dict[str, dict]:
+    """tenant -> counters + derived dropped_rows / in_flight — what the
+    chaos tests assert exact conservation on."""
+    out = {}
+    with _mu:
+        for t, slot in _tenants.items():
+            dropped, in_flight = _derived_locked(slot)
+            d = {s: slot[s] for s in STATES}
+            d["dropped"] = dict(slot["dropped"])
+            d["dropped_rows"] = dropped
+            d["in_flight"] = in_flight
+            out[t] = d
+    return out
+
+
+def check_balanced() -> list[str]:
+    """Conservation problems, empty when the ledger balances — the
+    vlsan end-of-test sweep's check.  in_flight is derived, so the
+    invariant reduces to: no counter negative, no tenant resolved more
+    rows than entered, replays bounded by spools."""
+    problems = []
+    for t, d in balance_snapshot().items():
+        for s in STATES:
+            if d[s] < 0:
+                problems.append(f"tenant {t}: {s} negative ({d[s]})")
+        for reason, n in d["dropped"].items():
+            if n < 0:
+                problems.append(
+                    f"tenant {t}: dropped[{reason}] negative ({n})")
+        if d["in_flight"] < 0:
+            problems.append(
+                f"tenant {t}: conservation violated — "
+                f"accepted+received={d['accepted'] + d['received']} < "
+                f"stored+forwarded+dropped="
+                f"{d['stored'] + d['forwarded'] + d['dropped_rows']}")
+        if d["replayed"] > d["spooled"]:
+            problems.append(
+                f"tenant {t}: replayed {d['replayed']} > "
+                f"spooled {d['spooled']}")
+    return problems
+
+
+def inflight_batches() -> int:
+    with _mu:
+        return len(_inflight)
+
+
+def status_payload() -> dict:
+    """The ledger's part of GET /insert/status (server/app.py adds the
+    spool / vlagent queue sections and the cluster federation)."""
+    now = time.monotonic()
+    with _mu:
+        inflight = [c.snapshot(now)
+                    for c in sorted(_inflight.values(),
+                                    key=lambda c: c.t0)]
+        recent = list(_completed)
+        hops = {t: {h: {"count": c[0], "total_s": round(c[1], 6),
+                        "max_s": round(c[2], 6)}
+                    for h, c in sorted(agg.items())}
+                for t, agg in sorted(_hops.items())}
+        wm = {t: round(w, 3) for t, w in sorted(_watermark.items())}
+    stalled = sum(1 for b in inflight
+                  if b["state"] == "spooled" or b["age_s"] > STALL_AGE_S)
+    return {
+        "ledger": balance_snapshot(),
+        "in_flight": inflight,
+        "recent": recent,
+        "hop_latency": hops,
+        "watermark_unix": wm,
+        "stalled_batches": stalled,
+        "trace_enabled": trace_enabled(),
+    }
+
+
+def usage_section() -> dict:
+    """Per-tenant conservation totals for GET /internal/usage — what
+    the frontend's clusterstats poll loop rolls up cluster-wide."""
+    out = {}
+    for t, d in balance_snapshot().items():
+        out[t] = {"accepted": d["accepted"], "received": d["received"],
+                  "forwarded": d["forwarded"], "stored": d["stored"],
+                  "dropped": d["dropped_rows"],
+                  "in_flight": d["in_flight"]}
+    return out
+
+
+def metrics_samples() -> list[tuple[str, dict, float]]:
+    """(base, labels, value) samples for Metrics.render + the vlsan
+    counter sweep: the conservation counters, derived in-flight rows,
+    freshness watermarks and the in-flight batch gauge."""
+    out: list[tuple[str, dict, float]] = [
+        ("vl_ingest_batches_in_flight", {}, inflight_batches())]
+    snap = balance_snapshot()
+    # vlint: allow-wall-clock(watermark age is vs real wall time by definition)
+    now = time.time()
+    with _mu:
+        wm = dict(_watermark)
+    for t in sorted(snap):
+        d = snap[t]
+        lbl = {"tenant": t}
+        for s in STATES:
+            # vlint: allow-per-row-emit(metric samples, bounded by tenant cap x 6 states)
+            out.append(("vl_ingest_ledger_rows_total",
+                        {"tenant": t, "state": s}, d[s]))
+        for reason in sorted(d["dropped"]):
+            # vlint: allow-per-row-emit(metric samples, bounded by drop-reason count)
+            out.append(("vl_ingest_ledger_dropped_total",
+                        {"tenant": t, "reason": reason},
+                        d["dropped"][reason]))
+        out.append(("vl_ingest_ledger_in_flight", lbl, d["in_flight"]))
+        if t in wm:
+            out.append(("vl_ingest_watermark_seconds", lbl,
+                        round(max(0.0, now - wm[t]), 3)))
+    return out
+
+
+def reset_for_tests() -> None:
+    global _seq
+    with _mu:
+        _seq = 0
+        _tenants.clear()
+        _hops.clear()
+        _watermark.clear()
+        _inflight.clear()
+        _completed.clear()

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import time
 
+from ..obs import ingestledger
 from .app import BaseHTTPApp, Metrics
 from .vlselect import HTTPError
 
@@ -40,8 +41,31 @@ class AgentServer(BaseHTTPApp):
                 out.append(f"vlagent_delivered_blocks_total{lbl} "
                            f"{c.delivered_blocks}")
                 out.append(f"vlagent_delivery_errors_total{lbl} {c.errors}")
+                out.append(f"vlagent_queue_entries{lbl} "
+                           f"{c.queue.pending_entries()}")
+                out.append(f"vlagent_queue_oldest_age_seconds{lbl} "
+                           f"{c.queue.oldest_age_seconds():.3f}")
+            for base, labels, v in ingestledger.metrics_samples():
+                lbl = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+                out.append(f"{base}{{{lbl}}} {v}" if lbl else f"{base} {v}")
             self.respond(h, 200, "text/plain",
                          ("\n".join(out) + "\n").encode())
+            return
+        if path == "/insert/status":
+            payload = ingestledger.status_payload()
+            payload["status"] = "ok"
+            payload["queues"] = [
+                # vlint: allow-per-row-emit(status payload, bounded by remote count)
+                {"url": c.url,
+                 "pending_bytes": c.queue.pending_bytes(),
+                 "entries": c.queue.pending_entries(),
+                 "oldest_age_seconds":
+                     round(c.queue.oldest_age_seconds(), 3),
+                 "delivered_blocks": c.delivered_blocks,
+                 "dropped_blocks": c.dropped_blocks,
+                 "errors": c.errors}
+                for c in self.agent.clients]
+            self.respond_json(h, payload)
             return
         if path == "/":
             self.respond_json(h, {

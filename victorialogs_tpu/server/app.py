@@ -23,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 from ..engine.searcher import QueryTimeoutError
-from ..obs import activity, events, hist, journal
+from ..obs import activity, events, hist, ingestledger, journal
 from ..storage.storage import Storage
 from ..utils.memory import QueryMemoryError
 from .. import sched
@@ -159,6 +159,11 @@ class Metrics:
         for base, labels, v in events.metrics_samples():
             add(metric_name(base, **labels), v)
         for base, labels, v in journal.metrics_samples():
+            add(metric_name(base, **labels), v)
+        # ingest conservation ledger: per-tenant accepted/forwarded/
+        # stored/dropped{reason} rolls, derived in-flight rows and the
+        # freshness watermark age (obs/ingestledger.py)
+        for base, labels, v in ingestledger.metrics_samples():
             add(metric_name(base, **labels), v)
         # cluster wire-protocol accounting: typed vs legacy frame
         # counts and raw tx/rx bytes (server/cluster.py; lazy import —
@@ -451,68 +456,86 @@ class BaseHTTPApp:
                   len(body))
             activity.note_ingest(cp.tenant, n, nbytes=len(body))
 
-        try:
-            if path == "/insert/jsonline":
-                n = vlinsert.handle_jsonline(cp, body, lmp)
-                count(n)
-            elif path.endswith("/_bulk"):
-                n, resp = vlinsert.handle_elasticsearch_bulk(cp, body, lmp)
-                count(n)
-                lmp.flush()
-                self.respond_json(h, resp)
-                return
-            elif path == "/insert/loki/api/v1/push":
-                if ctype == "application/x-protobuf" or \
-                        (body[:1] != b"{" and ctype != "application/json"):
-                    n = vlinsert.handle_loki_protobuf(cp, body, lmp)
+        # the accept point: mint the batch_id that rides every hop
+        # (sink ship, /internal/insert, spool replay) — the ingest twin
+        # of activity.track.  Everything below, final flush included,
+        # runs inside the batch extent so the sink's ledger rolls
+        # attribute here; the extent's exit settles the batch state
+        # (done / shipping / spooled).
+        with ingestledger.begin_batch(cp.tenant, origin=proto):
+            try:
+                if path == "/insert/jsonline":
+                    with ingestledger.hop("parse"):
+                        n = vlinsert.handle_jsonline(cp, body, lmp)
+                    count(n)
+                elif path.endswith("/_bulk"):
+                    with ingestledger.hop("parse"):
+                        n, resp = vlinsert.handle_elasticsearch_bulk(
+                            cp, body, lmp)
+                    count(n)
+                    lmp.flush()
+                    self.respond_json(h, resp)
+                    return
+                elif path == "/insert/loki/api/v1/push":
+                    with ingestledger.hop("parse"):
+                        if ctype == "application/x-protobuf" or \
+                                (body[:1] != b"{" and
+                                 ctype != "application/json"):
+                            n = vlinsert.handle_loki_protobuf(
+                                cp, body, lmp)
+                        else:
+                            n = vlinsert.handle_loki_json(cp, body, lmp)
+                    count(n)
+                    lmp.flush()
+                    self.respond(h, 204, "text/plain", b"")
+                    return
+                elif path == "/insert/opentelemetry/v1/logs":
+                    with ingestledger.hop("parse"):
+                        if ctype == "application/json":
+                            n = vlinsert.handle_otlp_json(cp, body, lmp)
+                        else:
+                            n = vlinsert.handle_otlp_protobuf(
+                                cp, body, lmp)
+                    count(n)
+                    lmp.flush()
+                    self.respond_json(h, {"partialSuccess": {}})
+                    return
+                elif path in ("/insert/datadog/api/v2/logs",
+                              "/insert/datadog/api/v1/input"):
+                    with ingestledger.hop("parse"):
+                        n = vlinsert.handle_datadog(cp, body, lmp)
+                    count(n)
+                    lmp.flush()
+                    self.respond_json(h, {})
+                    return
+                elif path == "/insert/journald/upload":
+                    with ingestledger.hop("parse"):
+                        n = vlinsert.handle_journald(cp, body, lmp)
+                    count(n)
+                elif path.startswith("/insert/elasticsearch"):
+                    # ES-compat discovery endpoints
+                    self.respond_json(h, {"version": {"number": "8.9.0"}})
+                    return
                 else:
-                    n = vlinsert.handle_loki_json(cp, body, lmp)
-                count(n)
+                    raise HTTPError(404, f"unknown insert path {path}")
+            except vlinsert.IngestError as e:
+                # parse failures land in the registry's per-protocol
+                # counter (vl_ingest_parse_failures_total on /metrics)
+                activity.note_parse_failure(proto)
+                raise HTTPError(400, str(e))
+            except netrobust.InsertRejectedError as e:
+                # a storage node judged the forwarded batch malformed
+                # (cluster 4xx): a client error end to end, never a
+                # 500 — and never a breaker trip / re-route cascade
+                # (cluster.py)
+                raise HTTPError(400, str(e))
+            try:
+                # small batches reach the sink HERE (no size-triggered
+                # mid-parse flush happened): same rejection mapping
                 lmp.flush()
-                self.respond(h, 204, "text/plain", b"")
-                return
-            elif path == "/insert/opentelemetry/v1/logs":
-                if ctype == "application/json":
-                    n = vlinsert.handle_otlp_json(cp, body, lmp)
-                else:
-                    n = vlinsert.handle_otlp_protobuf(cp, body, lmp)
-                count(n)
-                lmp.flush()
-                self.respond_json(h, {"partialSuccess": {}})
-                return
-            elif path in ("/insert/datadog/api/v2/logs",
-                          "/insert/datadog/api/v1/input"):
-                n = vlinsert.handle_datadog(cp, body, lmp)
-                count(n)
-                lmp.flush()
-                self.respond_json(h, {})
-                return
-            elif path == "/insert/journald/upload":
-                n = vlinsert.handle_journald(cp, body, lmp)
-                count(n)
-            elif path.startswith("/insert/elasticsearch"):
-                # ES-compat discovery endpoints
-                self.respond_json(h, {"version": {"number": "8.9.0"}})
-                return
-            else:
-                raise HTTPError(404, f"unknown insert path {path}")
-        except vlinsert.IngestError as e:
-            # parse failures land in the registry's per-protocol
-            # counter (vl_ingest_parse_failures_total on /metrics)
-            activity.note_parse_failure(proto)
-            raise HTTPError(400, str(e))
-        except netrobust.InsertRejectedError as e:
-            # a storage node judged the forwarded batch malformed
-            # (cluster 4xx): a client error end to end, never a 500 —
-            # and never a breaker trip / re-route cascade (cluster.py)
-            raise HTTPError(400, str(e))
-        try:
-            # small batches reach the sink HERE (no size-triggered
-            # mid-parse flush happened): same rejection mapping
-            lmp.flush()
-        except netrobust.InsertRejectedError as e:
-            raise HTTPError(400, str(e))
-        self.respond_json(h, {"status": "ok", "ingested": n})
+            except netrobust.InsertRejectedError as e:
+                raise HTTPError(400, str(e))
+            self.respond_json(h, {"status": "ok", "ingested": n})
 
     def respond_shed(self, h, e) -> None:
         """429 (or 499 for cancelled-while-queued) with Retry-After and
@@ -697,6 +720,18 @@ class VLServer(BaseHTTPApp):
                          VMUI_HTML.encode("utf-8"))
             return
 
+        # ---- ingest observability (before the /insert/ prefix match,
+        # and deliberately outside any admission gate: the spool/ledger
+        # view matters most exactly when a storage node is down) ----
+        if path == "/insert/status":
+            payload = self._insert_status_payload()
+            urls = self._cluster_urls()
+            if _want_cluster(args) and urls:
+                from . import cluster
+                payload = cluster.federated_insert_status(urls, payload)
+            self.respond_json(h, payload)
+            return
+
         # ---- ingestion ----
         if path.startswith("/insert/"):
             self.handle_insert(h, path, args, body, ctype)
@@ -874,6 +909,10 @@ class VLServer(BaseHTTPApp):
                 "status": "ok",
                 "queued": adm_sel["queued"] + adm_int["queued"],
                 "admission": {"select": adm_sel, "internal": adm_int},
+                # per-tenant conservation totals: what the frontend's
+                # clusterstats poll rolls up into the cluster-wide
+                # zero-lost-rows view (obs/ingestledger.py)
+                "ingest_ledger": ingestledger.usage_section(),
                 "storage": {
                     "rows_small": s["small_rows"],
                     "rows_big": s["big_rows"],
@@ -1017,6 +1056,18 @@ class VLServer(BaseHTTPApp):
         (the federated registry/cancel/rollup fan-out set), else
         None."""
         return getattr(self.query_storage, "urls", None)
+
+    def _insert_status_payload(self) -> dict:
+        """This node's GET /insert/status body: the ledger's in-flight/
+        recent batches, conservation counters, hop latencies and
+        freshness watermarks, plus the durable-spool depth/age when the
+        sink is the cluster sharder."""
+        payload = ingestledger.status_payload()
+        payload["status"] = "ok"
+        spool_status = getattr(self.sink, "spool_status", None)
+        if spool_status is not None:
+            payload["spool"] = spool_status()
+        return payload
 
     @staticmethod
     def _partial_headers() -> dict:

@@ -50,7 +50,7 @@ from .. import sched
 from ..engine.block_result import (WIRE_CONST, WIRE_DICT, WIRE_ISO,
                                    WIRE_STR, WIRE_TIME, BlockResult)
 from ..logsql.parser import MAX_TS, MIN_TS, parse_query
-from ..obs import activity, events, tracing
+from ..obs import activity, events, ingestledger, tracing
 from ..logsql.pipes import PipeLimit, PipeStats, Processor
 from ..storage.log_rows import LogRows, StreamID, TenantID
 from ..utils.hashing import stream_id_hash
@@ -538,12 +538,31 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
     if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
         raise ValueError(f"unsupported protocol version "
                          f"{args.get('version')!r}")
+    # the batch identity the sender propagated (the ingest twin of
+    # parent_qid): re-enter the frontend's in-flight record when it
+    # lives in THIS process (in-process clusters), else register the
+    # propagated id so the hop still traces/ledgers.  Legacy senders
+    # without batch args get a fresh internal-origin record.
     try:
-        data = _zstd.decompress(body, max_output_size=1 << 30)
-    except Exception as e:
-        # zlib.error / ZstdError are NOT ValueErrors; an undecodable
-        # body is the sender's corruption, not our 500 — whole-batch 400
-        raise ValueError(f"undecodable insert body: {e}") from None
+        accept = float(args.get("batch_ts") or 0.0)
+    except ValueError:
+        accept = 0.0
+    with ingestledger.begin_batch(
+            args.get("batch_tenant") or "0:0", origin="internal",
+            batch_id=args.get("batch_id") or None,
+            accept_unix=accept or None):
+        return _internal_insert(storage, args, body)
+
+
+def _internal_insert(storage, args, body: bytes) -> int:
+    with ingestledger.hop("decode"):
+        try:
+            data = _zstd.decompress(body, max_output_size=1 << 30)
+        except Exception as e:
+            # zlib.error / ZstdError are NOT ValueErrors; an
+            # undecodable body is the sender's corruption, not our
+            # 500 — whole-batch 400
+            raise ValueError(f"undecodable insert body: {e}") from None
     if data.startswith(wire_ingest.INSERT_MAGIC):
         # typed i1 body (self-describing: JSON lines start with "{").
         # With the kill switch thrown this node speaks legacy ONLY —
@@ -552,13 +571,18 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
         if not wire_ingest.wire_typed_insert_enabled():
             raise ValueError(
                 "typed insert frames disabled (VL_WIRE_TYPED_INSERT=0)")
-        lc = wire_ingest.decode_frame(data)   # WireInsertError -> 400
+        with ingestledger.hop("decode"):
+            lc = wire_ingest.decode_frame(data)  # WireInsertError -> 400
         wire_ingest.note("rx_frames_typed")
         wire_ingest.note("rx_bytes_typed", len(body))
         wire_ingest.note("rx_rows_typed", lc.nrows)
         if lc.nrows:
-            storage.must_add_columns(lc)
+            # entry roll BEFORE the storage chokepoint's `stored` roll
             per_tenant = wire_ingest.columns_tenant_rows(lc)
+            for tenant, rows in per_tenant.items():
+                ingestledger.note_received(tenant, rows)
+            with ingestledger.hop("store"):
+                storage.must_add_columns(lc)
             for tenant, rows in per_tenant.items():
                 activity.note_ingest(
                     tenant, rows, nbytes=len(data) * rows // lc.nrows)
@@ -566,25 +590,29 @@ def handle_internal_insert(storage, args, body: bytes) -> int:
     lr = LogRows()
     n = 0
     per_tenant: dict = {}
-    for line in data.splitlines():
-        if not line:
-            continue
-        row = json.loads(line)
-        tenant = TenantID(int(row.get("a", 0)), int(row.get("p", 0)))
-        tags_str = row.get("s", "")
-        hi, lo = stream_id_hash(tags_str.encode("utf-8"))
-        lr.timestamps.append(int(row["t"]))
-        lr.rows.append([(k, v) for k, v in row["f"]])
-        lr.stream_ids.append(StreamID(tenant, hi, lo))
-        lr.stream_tags_str.append(tags_str)
-        lr.tenants.append(tenant)
-        per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
-        n += 1
+    with ingestledger.hop("decode"):
+        for line in data.splitlines():
+            if not line:
+                continue
+            row = json.loads(line)
+            tenant = TenantID(int(row.get("a", 0)), int(row.get("p", 0)))
+            tags_str = row.get("s", "")
+            hi, lo = stream_id_hash(tags_str.encode("utf-8"))
+            lr.timestamps.append(int(row["t"]))
+            lr.rows.append([(k, v) for k, v in row["f"]])
+            lr.stream_ids.append(StreamID(tenant, hi, lo))
+            lr.stream_tags_str.append(tags_str)
+            lr.tenants.append(tenant)
+            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+            n += 1
     wire_ingest.note("rx_frames_json")
     wire_ingest.note("rx_bytes_json", len(body))
     wire_ingest.note("rx_rows_json", n)
     if n:
-        storage.must_add_rows(lr)
+        for tenant, rows in per_tenant.items():
+            ingestledger.note_received(tenant, rows)
+        with ingestledger.hop("store"):
+            storage.must_add_rows(lr)
         for tenant, rows in per_tenant.items():
             # apportion DECOMPRESSED bytes so vl_tenant_ingest_bytes_
             # total means the same thing on storage nodes as on
@@ -697,29 +725,55 @@ class NetInsertStorage:
         (numpy packing + zstd drop the GIL)."""
         if lc.nrows == 0:
             return
-        shards = sorted(wire_ingest.split_columns_by_node(
-            lc, len(self.urls)).items())
-        items = [(node, _ShardBodies(slc)) for node, slc in shards]
+        batch = ingestledger.current_batch()
+        with ingestledger.hop("shard"):
+            shards = sorted(wire_ingest.split_columns_by_node(
+                lc, len(self.urls)).items())
+            items = [(node, _ShardBodies(slc)) for node, slc in shards]
         if len(items) > 1:
-            for f in [self._encode_pool.submit(
-                    self._preferred_body, node, bodies)
-                    for node, bodies in items]:
-                f.result()
+            with ingestledger.hop("encode"):
+                for f in [self._encode_pool.submit(
+                        self._preferred_body, node, bodies)
+                        for node, bodies in items]:
+                    f.result()
         errors = []
         for node, bodies in items:
-            if self._send_shard(node, bodies):
-                continue
-            # re-route to any healthy node (data locality is a
-            # preference, not a correctness requirement)
-            if any(alt != node and self._send_shard(alt, bodies)
-                   for alt in range(len(self.urls))):
+            # per-tenant shard rows for the conservation rolls; only
+            # batch-tracked flows ledger (journal self-ingest and
+            # direct test writes carry no ambient batch)
+            tenant_rows = wire_ingest.columns_tenant_rows(bodies.lc) \
+                if batch is not None else None
+            try:
+                with ingestledger.hop("ship"):
+                    delivered = self._send_shard(node, bodies) or any(
+                        alt != node and self._send_shard(alt, bodies)
+                        for alt in range(len(self.urls)))
+            except InsertRejectedError:
+                # the 400 path is terminal for these rows: the client
+                # gets the rejection, nothing is retried or spooled
+                if tenant_rows:
+                    for t, rows in tenant_rows.items():
+                        ingestledger.note_dropped(t, rows,
+                                                  "rejected_by_node")
+                raise
+            if delivered:
+                # re-route to any healthy node already folded in above
+                # (data locality is a preference, not a correctness
+                # requirement)
+                if tenant_rows:
+                    for t, rows in tenant_rows.items():
+                        ingestledger.note_forwarded(t, rows)
                 continue
             # every node is down/throttled: spool durably and replay
             # when the shard's node recovers — delay, don't drop.
             # The ALREADY-ENCODED body spools verbatim: replay ships
             # the same bytes, no re-encode per attempt.
-            if self._spool(node, self._preferred_body(node, bodies),
-                           nrows=bodies.lc.nrows):
+            with ingestledger.hop("spool"):
+                spooled = self._spool(
+                    node, self._preferred_body(node, bodies),
+                    nrows=bodies.lc.nrows, tenant_rows=tenant_rows,
+                    batch=batch)
+            if spooled:
                 continue
             errors.append(f"all nodes down for shard {node}")
         if errors:
@@ -764,7 +818,27 @@ class NetInsertStorage:
                 self._legacy_nodes.discard(idx)
                 raise
 
-    def _send(self, idx: int, body: bytes) -> bool:
+    @staticmethod
+    def _batch_args(batch_meta: dict | None) -> str:
+        """The propagated batch identity on /internal/insert — the
+        ingest twin of parent_qid.  From the spool record's header on
+        replay (``batch_meta``), else from the ambient batch."""
+        from urllib.parse import urlencode
+        if batch_meta is not None:
+            args = {"batch_id": batch_meta.get("batch_id", ""),
+                    "batch_tenant": batch_meta.get("tenant", "")}
+            if batch_meta.get("ts"):
+                args["batch_ts"] = f"{batch_meta['ts']:.6f}"
+        else:
+            ctx = ingestledger.current_batch()
+            if ctx is None:
+                return ""
+            args = {"batch_id": ctx.batch_id, "batch_tenant": ctx.tenant,
+                    "batch_ts": f"{ctx.accept_unix:.6f}"}
+        return "&" + urlencode(args)
+
+    def _send(self, idx: int, body: bytes,
+              batch_meta: dict | None = None) -> bool:
         """One policy-managed delivery attempt.  False means 'this node
         cannot take the batch right now' (down/throttled — breaker
         accounting already done inside netrobust.request); a 4xx
@@ -773,7 +847,8 @@ class NetInsertStorage:
         url = self.urls[idx]
         try:
             status, _headers, rbody = netrobust.request(
-                url, f"/internal/insert?version={PROTOCOL_VERSION}",
+                url, f"/internal/insert?version={PROTOCOL_VERSION}"
+                     f"{self._batch_args(batch_meta)}",
                 body,
                 headers={"Content-Type": "application/octet-stream"},
                 timeout=self.timeout)
@@ -800,21 +875,42 @@ class NetInsertStorage:
                 self._spools[idx] = q
             return q
 
-    def _spool(self, idx: int, body: bytes, nrows: int) -> bool:
+    def _spool(self, idx: int, body: bytes, nrows: int,
+               tenant_rows: dict | None = None, batch=None) -> bool:
         if not self._spool_enabled():
+            if tenant_rows:
+                # spool disabled is a hard drop for a batch-tracked
+                # shard once every node refused it
+                for t, rows in tenant_rows.items():
+                    ingestledger.note_dropped(t, rows, "spool_disabled")
             return False
         from ..utils.persistentqueue import QueueOverflowError
         q = self._spool_queue(idx)
         was_empty = q.pending_bytes() == 0
+        rec = body
+        if batch is not None and tenant_rows:
+            # self-describing spool record: replay (this process or the
+            # next one after a restart) still attributes the rows to
+            # their batch, tenant and accept time
+            primary = max(tenant_rows, key=tenant_rows.get)
+            rec = ingestledger.wrap_record(
+                body, batch.batch_id, primary, nrows,
+                accept_unix=batch.accept_unix)
         try:
-            q.append(body)
+            q.append(rec)
         except QueueOverflowError:
             netrobust.note("spool_overflow")
             events.emit("spool_overflow", node=self.urls[idx],
                         rows=nrows, pending_bytes=q.pending_bytes())
+            if tenant_rows:
+                for t, rows in tenant_rows.items():
+                    ingestledger.note_dropped(t, rows, "spool_overflow")
             return False
         netrobust.note("spooled_blocks")
         netrobust.note("spooled_rows", nrows)
+        if tenant_rows:
+            for t, rows in tenant_rows.items():
+                ingestledger.note_spooled(t, rows)
         if was_empty:
             # one event per outage burst, not per batch
             events.emit("ingest_spool_start", node=self.urls[idx])
@@ -849,22 +945,31 @@ class NetInsertStorage:
                     data = q.read(timeout=None)
                     if data is None:
                         break
+                    # batch-tracked records carry a self-describing
+                    # header (wrap_record); pre-upgrade records pass
+                    # through with meta=None and skip the ledger
+                    meta, payload = ingestledger.unwrap_record(data)
                     # a node already pinned to legacy can't take a
                     # spooled i1 frame: re-encode the SAME rows as
                     # JSON lines (typed frames replay verbatim)
-                    send_data = data
+                    send_data = payload
                     if idx in self._legacy_nodes:
-                        alt = wire_ingest.reencode_legacy(data)
+                        alt = wire_ingest.reencode_legacy(payload)
                         if alt is not None:
                             send_data = alt
                     try:
-                        if not self._send(idx, send_data):
+                        with ingestledger.hop(
+                                "replay",
+                                tenant=meta["tenant"] if meta else None):
+                            sent = self._send(idx, send_data,
+                                              batch_meta=meta)
+                        if not sent:
                             break
                     except InsertRejectedError:
                         verdict = "poison"
-                        if send_data is data:
+                        if send_data is payload:
                             verdict = self._replay_reject_fallback(
-                                idx, q, data)
+                                idx, q, data, payload, meta)
                         if verdict == "ok":
                             drained += 1
                             continue
@@ -875,23 +980,37 @@ class NetInsertStorage:
                         netrobust.note("spool_rejected_blocks")
                         events.emit("spool_block_rejected",
                                     node=self.urls[idx])
+                        if meta:
+                            ingestledger.note_dropped(
+                                meta["tenant"], meta["nrows"],
+                                "spool_block_rejected",
+                                batch_id=meta.get("batch_id"),
+                                from_spool=True)
                         q.ack(len(data))
                         continue
                     q.ack(len(data))
                     drained += 1
                     netrobust.note("replayed_blocks")
+                    if meta:
+                        ingestledger.note_replayed(
+                            meta["tenant"], meta["nrows"],
+                            batch_id=meta.get("batch_id"))
                 if drained and q.pending_bytes() == 0:
                     events.emit("ingest_spool_replayed",
                                 node=self.urls[idx], blocks=drained)
 
-    def _replay_reject_fallback(self, idx: int, q, data: bytes) -> str:
+    def _replay_reject_fallback(self, idx: int, q, data: bytes,
+                                payload: bytes,
+                                meta: dict | None) -> str:
         """A spooled body was rejected: if it is an i1 frame, the node
         may have stopped speaking typed between spool time and replay
         (downgrade / kill switch) — pin the node to legacy and retry
         the SAME rows as JSON lines once.  Returns 'ok' (delivered +
         acked), 'down' (node unavailable: keep the block, retry
-        later), or 'poison' (rejected either way: caller drops it)."""
-        legacy = wire_ingest.reencode_legacy(data)
+        later), or 'poison' (rejected either way: caller drops it).
+        ``data`` is the raw spool record (what ack() measures),
+        ``payload`` the wire body inside it."""
+        legacy = wire_ingest.reencode_legacy(payload)
         if legacy is None:
             return "poison"       # not typed / undecodable
         self._legacy_nodes.add(idx)
@@ -900,9 +1019,13 @@ class NetInsertStorage:
                     requested=wire_ingest.WIRE_INSERT_FORMAT,
                     hop="insert-replay")
         try:
-            if self._send(idx, legacy):
+            if self._send(idx, legacy, batch_meta=meta):
                 q.ack(len(data))
                 netrobust.note("replayed_blocks")
+                if meta:
+                    ingestledger.note_replayed(
+                        meta["tenant"], meta["nrows"],
+                        batch_id=meta.get("batch_id"))
                 return "ok"
             return "down"
         except InsertRejectedError:
@@ -920,9 +1043,31 @@ class NetInsertStorage:
         """(base, labels, value) gauges for Metrics.render."""
         with self._spool_mu:
             spools = list(self._spools.items())
-        # vlint: allow-per-row-emit(metric samples, bounded by node count)
-        return [("vl_insert_spool_bytes", {"node": self.urls[idx]},
-                 q.pending_bytes()) for idx, q in spools]
+        out = []
+        for idx, q in spools:
+            lbl = {"node": self.urls[idx]}
+            # vlint: allow-per-row-emit(metric samples, bounded by node count)
+            out.append(("vl_insert_spool_bytes", lbl,
+                        q.pending_bytes()))
+            out.append(("vl_insert_spool_entries", lbl,
+                        q.pending_entries()))
+            out.append(("vl_insert_spool_oldest_age_seconds", lbl,
+                        round(q.oldest_age_seconds(), 3)))
+        return out
+
+    def spool_status(self) -> dict:
+        """Per-node spool depth/age for GET /insert/status — the
+        wedged-spool view that matters mid-outage."""
+        with self._spool_mu:
+            spools = list(self._spools.items())
+        # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+        nodes = [{"node": self.urls[idx],
+                  "pending_bytes": q.pending_bytes(),
+                  "entries": q.pending_entries(),
+                  "oldest_age_seconds": round(q.oldest_age_seconds(), 3)}
+                 for idx, q in spools]
+        return {"pending_bytes": sum(n["pending_bytes"] for n in nodes),
+                "nodes": nodes}
 
     def close(self) -> None:
         self._replay_stop.set()
@@ -1125,6 +1270,42 @@ def federated_top_queries(urls, n: int = 10, by: str = "duration",
     merged.sort(key=lambda r: r.get(key, default), reverse=True)
     out = {"status": "ok", "cluster": True,
            "top_queries": merged[:max(n, 0)], "nodes": nodes}
+    if failures:
+        out["failed_nodes"] = sorted(failures)
+    return out
+
+
+def federated_insert_status(urls, local: dict,
+                            timeout: float | None = None) -> dict:
+    """GET /insert/status?cluster=1: this frontend's own payload (the
+    spool lives here) plus every storage node's, per node — never
+    summed: combined frontend+storage deployments and in-process
+    clusters share one process-global ledger, so summing would
+    multi-count (the same reason federated_top_queries dedups).  A
+    node that cannot answer is marked down — exactly the state in
+    which its unshipped batches show as this frontend's stalled/
+    spooled entries."""
+    results, failures = _fanout_json(urls, "/insert/status",
+                                     timeout=timeout)
+    nodes = []
+    stalled = local.get("stalled_batches", 0)
+    for url in urls:
+        if url in failures:
+            # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+            nodes.append({"node": url, "up": False,
+                          "error": failures[url]})
+            continue
+        p = results[url]
+        stalled = max(stalled, p.get("stalled_batches", 0))
+        # vlint: allow-per-row-emit(introspection metadata, bounded by node count)
+        nodes.append({"node": url, "up": True,
+                      "stalled_batches": p.get("stalled_batches", 0),
+                      "in_flight": len(p.get("in_flight") or []),
+                      "spool": p.get("spool"),
+                      "ledger": p.get("ledger")})
+    out = dict(local)
+    out.update({"cluster": True, "nodes": nodes,
+                "stalled_batches_cluster": stalled})
     if failures:
         out["failed_nodes"] = sorted(failures)
     return out

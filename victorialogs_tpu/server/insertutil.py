@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field as dc_field
 
 from ..engine.block_result import parse_rfc3339
+from ..obs import ingestledger
 from ..storage.log_rows import LogRows, TenantID
 
 MAX_BATCH_ROWS = 100_000
@@ -209,6 +210,14 @@ class LogMessageProcessor:
 
     def _flush_locked(self) -> None:
         if len(self.lr):
+            # the conservation ledger's entry roll sits at the sink
+            # handoff (not the HTTP handler) so `accepted` always
+            # precedes the sink's terminal stored/forwarded rolls —
+            # derived in_flight can never dip negative.  Gated on the
+            # ambient batch: non-batch users (syslog periodic flush)
+            # stay off the ledger entirely, entry AND terminal side.
+            if ingestledger.current_batch() is not None:
+                ingestledger.note_accepted(self.cp.tenant, len(self.lr))
             self.sink.must_add_rows(self.lr)
             self.lr = LogRows(stream_fields=list(self.lr.stream_fields),
                               ignore_fields=list(self.cp.ignore_fields),
@@ -237,6 +246,8 @@ class LogMessageProcessor:
             return
         with self._lock:
             self._flush_locked()
+            if ingestledger.current_batch() is not None:
+                ingestledger.note_accepted(self.cp.tenant, lc.nrows)
             self.sink.must_add_columns(lc)
             self.rows_total += lc.nrows
 

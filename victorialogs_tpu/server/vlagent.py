@@ -21,11 +21,11 @@ import sys
 import threading
 import time
 
-from ..obs import events
+from ..obs import events, ingestledger
 from ..storage.log_rows import LogRows
 from ..utils.persistentqueue import PersistentQueue
 from . import netrobust, wire_ingest
-from .cluster import PROTOCOL_VERSION
+from .cluster import NetInsertStorage, PROTOCOL_VERSION
 from .insertutil import LogRowsStorage
 
 def encode_rows(lr: LogRows) -> bytes:
@@ -79,25 +79,31 @@ class RemoteWriteClient:
         # the in-flight block is read from disk ONCE and its wire body
         # built ONCE: every retry (backoff, Retry-After park, breaker
         # re-probe) reuses the same bytes instead of re-reading the
-        # queue head and re-paying the encode per attempt
+        # queue head and re-paying the encode per attempt.  ack() always
+        # takes the RAW record length (batch header included) — the wire
+        # body may be shorter (header stripped) or longer (legacy
+        # re-encode)
         block: bytes | None = None
+        payload: bytes | None = None
         body: bytes | None = None
+        meta: dict | None = None
         while not self._stop.is_set():
             if block is None:
                 block = self.queue.read(timeout=0.5)
                 if block is None:
                     continue
-                body = self._wire_body(block)
-            ok, hint, rejected = self._send(body)
+                meta, payload = ingestledger.unwrap_record(block)
+                body = self._wire_body(payload)
+            ok, hint, rejected = self._send(body, meta)
             if ok:
                 self.queue.ack(len(block))
                 self.delivered_blocks += 1
-                block = body = None
+                block = payload = body = meta = None
                 backoff = 0.5
             elif rejected:
                 self.errors += 1
-                if body is block and not self._legacy_remote:
-                    legacy = wire_ingest.reencode_legacy(block)
+                if body is payload and not self._legacy_remote:
+                    legacy = wire_ingest.reencode_legacy(payload)
                     if legacy is not None:
                         # the remote can't speak i1: pin it to legacy
                         # lines and retry the SAME rows immediately
@@ -111,11 +117,15 @@ class RemoteWriteClient:
                         continue
                 # rejected in the format the remote speaks: a poisoned
                 # block must not wedge the queue behind it — drop it,
-                # loudly
+                # loudly.  This is a replica-level drop (this remote's
+                # copy only; the rows were forwarded-counted ONCE at
+                # enqueue and other replicas may still deliver them), so
+                # it stays out of the per-row ledger by design.
+                # vlint: allow-drop-discipline(replica-level block drop; rows were forwarded-counted once at _append_block)
                 self.dropped_blocks += 1
                 events.emit("queue_block_rejected", url=self.url)
                 self.queue.ack(len(block))
-                block = body = None
+                block = payload = body = meta = None
             elif hint is not None:
                 # the remote SAID how loaded it is (429 + Retry-After +
                 # X-VL-Concurrency hints): honor its guidance instead
@@ -152,7 +162,8 @@ class RemoteWriteClient:
             wait *= min(4.0, max(0.5, current / limit))
         return max(0.1, wait)
 
-    def _send(self, body: bytes) -> tuple[bool, float | None, bool]:
+    def _send(self, body: bytes,
+              meta: dict | None = None) -> tuple[bool, float | None, bool]:
         """(delivered, retry_hint_s, rejected) — the hint is non-None
         only for an explicit overload shed (HTTP 429); rejected is True
         for a non-429 4xx (the remote REFUSED the body: retrying the
@@ -164,7 +175,9 @@ class RemoteWriteClient:
         try:
             status, headers, _rbody = netrobust.request(
                 self.url,
-                f"/internal/insert?version={PROTOCOL_VERSION}", body,
+                f"/internal/insert?version={PROTOCOL_VERSION}"
+                f"{NetInsertStorage._batch_args(meta) if meta else ''}",
+                body,
                 headers={"Content-Type": "application/octet-stream"},
                 timeout=self.timeout, gate=False)
         except (IOError, OSError):
@@ -219,6 +232,18 @@ class VLAgent(LogRowsStorage):
         self._append_block(block, lc.nrows)
 
     def _append_block(self, block: bytes, nrows: int) -> None:
+        batch = ingestledger.current_batch()
+        if batch is not None:
+            # the queue record carries the batch identity + accept time
+            # so delivery (possibly days later, after an agent restart)
+            # still propagates them to the remote's ledger
+            block = ingestledger.wrap_record(
+                block, batch.batch_id, batch.tenant, nrows,
+                accept_unix=batch.accept_unix)
+            # ledger: rows leave this process at durable enqueue — the
+            # queue owns delivery from here; replicas are transport
+            # fan-out of the same rows, not new rows
+            ingestledger.note_forwarded(batch.tenant, nrows, batch=batch)
         for c in self.clients:
             c.queue.append(block)
         # forwarded-traffic accounting: each batch counted ONCE (rows

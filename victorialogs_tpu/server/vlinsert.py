@@ -562,17 +562,23 @@ def _jsonline_fast_mt(cp: CommonParams, body: bytes,
     bounds.append(blen)
     spans = [(s, e) for s, e in zip(bounds[:-1], bounds[1:]) if s < e]
     states = [_FastState(cp, lmp) for _ in spans]
+    # contextvars don't cross thread spawns: carry the ambient ingest
+    # batch onto the workers so the sink's ledger rolls (accepted /
+    # forwarded / stored) still attribute to this request's batch
+    from ..obs import ingestledger
+    batch = ingestledger.current_batch()
 
     def work(k: int) -> None:
         s, e = spans[k]
         st = states[k]
-        _scan_span(st, body, s, e, True)
-        # hand the shard's batch to the sink ON the worker: the sink's
-        # numpy block build / i1 encode / zstd all drop the GIL, so
-        # shard K's sink work overlaps shard K+1's scan instead of
-        # serializing on the request thread after the barrier
-        # (ingest_columns is lock-serialized internally)
-        lmp.ingest_columns(st.lc)
+        with ingestledger.use_batch(batch):
+            _scan_span(st, body, s, e, True)
+            # hand the shard's batch to the sink ON the worker: the
+            # sink's numpy block build / i1 encode / zstd all drop the
+            # GIL, so shard K's sink work overlaps shard K+1's scan
+            # instead of serializing on the request thread after the
+            # barrier (ingest_columns is lock-serialized internally)
+            lmp.ingest_columns(st.lc)
         st.lc = LogColumns()
 
     with ThreadPoolExecutor(max_workers=len(spans)) as pool:

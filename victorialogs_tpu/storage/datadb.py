@@ -28,6 +28,7 @@ from .block import BlockData, blocks_from_log_rows, build_blocks
 from .part import Part, write_part
 from .values_encoder import decode_values
 from ..obs import events as _events
+from ..obs import hist as _hist
 
 
 def _all_system_tenant(parts) -> bool:
@@ -442,6 +443,13 @@ class DataDB:
                 self.small_parts.append(p)
                 self._write_manifest_locked()
                 self._buffer_drained.notify_all()
+            # freshness: age of the OLDEST buffered row batch at the moment
+            # it became durably queryable; system-tenant-only flushes
+            # (journal self-ingest) are excluded so idle servers report none
+            if not _all_system_tenant(imps):
+                _hist.INGEST_FRESHNESS.observe(
+                    time.monotonic()
+                    - min(im.created_at for im in imps))
             # a flush of journal-only rows reports AS journal work
             # (suppressed+counted) so the journal's own ingest cannot
             # tick the storage into a perpetual flush-event loop; the

@@ -15,11 +15,55 @@ import shutil
 import threading
 import time
 
+from ..obs import ingestledger
 from .log_rows import LogRows, TenantID
 from .partition import Partition
 
 NSECS_PER_DAY = 86400 * 1_000_000_000
 PARTITIONS_DIRNAME = "partitions"
+
+
+def _columns_tenant_stats(lc, out: dict) -> None:
+    """Accumulate tenant -> [rows, max_ts_ns] over one columnar batch
+    (one bincount + maximum.at per group — never per row in Python)."""
+    import numpy as np
+    for g in lc.groups.values():
+        if not g.ts:
+            continue
+        sref = np.asarray(g.sref, dtype=np.int64)
+        ts = np.asarray(g.ts, dtype=np.int64)
+        counts = np.bincount(sref, minlength=len(g.streams))
+        maxs = np.full(len(g.streams), -1, dtype=np.int64)
+        np.maximum.at(maxs, sref, ts)
+        for (_sid, tenant, _tags), c, m in zip(
+                g.streams, counts.tolist(), maxs.tolist()):
+            if c:
+                cell = out.setdefault(tenant, [0, 0])
+                cell[0] += c
+                cell[1] = max(cell[1], m)
+
+
+def _columns_tenant_dropped(lc, min_ts: int,
+                            max_ts: int) -> tuple[dict, dict]:
+    """Per-tenant too_old / too_new row counts for a columnar batch —
+    only computed when the range check actually dropped rows."""
+    import numpy as np
+    old: dict = {}
+    new: dict = {}
+    for g in lc.groups.values():
+        if not g.ts:
+            continue
+        ts = np.asarray(g.ts, dtype=np.int64)
+        sref = np.asarray(g.sref, dtype=np.int64)
+        for mask, acc in ((ts < min_ts, old), (ts > max_ts, new)):
+            if mask.any():
+                counts = np.bincount(sref[mask],
+                                     minlength=len(g.streams))
+                for (_sid, tenant, _tags), c in zip(
+                        g.streams, counts.tolist()):
+                    if c:
+                        acc[tenant] = acc.get(tenant, 0) + c
+    return old, new
 
 
 def day_from_ts(ts_ns: int) -> int:
@@ -88,15 +132,33 @@ class Storage:
         now_ns = time.time_ns()
         min_ts = now_ns - int(self.retention_days * NSECS_PER_DAY)
         max_ts = now_ns + int(self.future_retention_days * NSECS_PER_DAY)
+        # conservation-ledger attribution only for batch-tracked flows
+        # (the ambient ctx gates it): direct writes — tests, journal
+        # self-ingest — never rolled `accepted`, so they must not roll
+        # `stored`/`dropped` either
+        ctx = ingestledger.current_batch()
+        stored: dict = {}        # tenant -> [rows, max_ts_ns]
+        dropped_old: dict = {}
+        dropped_new: dict = {}
         by_day: dict[int, list[int]] = {}
         for i, ts in enumerate(lr.timestamps):
             if ts < min_ts:
                 self.rows_dropped_too_old += 1
+                if ctx is not None:
+                    t = lr.tenants[i]
+                    dropped_old[t] = dropped_old.get(t, 0) + 1
                 continue
             if ts > max_ts:
                 self.rows_dropped_too_new += 1
+                if ctx is not None:
+                    t = lr.tenants[i]
+                    dropped_new[t] = dropped_new.get(t, 0) + 1
                 continue
             by_day.setdefault(day_from_ts(ts), []).append(i)
+            if ctx is not None:
+                cell = stored.setdefault(lr.tenants[i], [0, 0])
+                cell[0] += 1
+                cell[1] = max(cell[1], ts)
         for day, idxs in by_day.items():
             pt = self._get_partition(day)
             if len(by_day) == 1 and len(idxs) == n:
@@ -110,6 +172,8 @@ class Storage:
                     sub.stream_tags_str.append(lr.stream_tags_str[i])
                     sub.tenants.append(lr.tenants[i])
                 pt.must_add_rows(sub)
+        if ctx is not None:
+            self._ledger_rolls(stored, dropped_old, dropped_new)
 
     def must_add_columns(self, lc) -> None:
         """Columnar-batch twin of must_add_rows (LogColumns fast path)."""
@@ -120,11 +184,37 @@ class Storage:
         now_ns = time.time_ns()
         min_ts = now_ns - int(self.retention_days * NSECS_PER_DAY)
         max_ts = now_ns + int(self.future_retention_days * NSECS_PER_DAY)
+        ctx = ingestledger.current_batch()
+        dropped_old: dict = {}
+        dropped_new: dict = {}
         by_day, old, new = lc.split_by_day(min_ts, max_ts, NSECS_PER_DAY)
         self.rows_dropped_too_old += old
         self.rows_dropped_too_new += new
+        if ctx is not None and (old or new):
+            dropped_old, dropped_new = _columns_tenant_dropped(
+                lc, min_ts, max_ts)
+        stored: dict = {}
         for day, sub in by_day.items():
             self._get_partition(day).must_add_columns(sub)
+            if ctx is not None:
+                _columns_tenant_stats(sub, stored)
+        if ctx is not None:
+            self._ledger_rolls(stored, dropped_old, dropped_new)
+
+    @staticmethod
+    def _ledger_rolls(stored: dict, dropped_old: dict,
+                      dropped_new: dict) -> None:
+        """Terminal conservation rolls for one batch-tracked must_add:
+        `stored` advances the tenant's freshness watermark with the max
+        stored row time; range-check drops take the ledger's reasoned
+        drop exit (the vlint drop-discipline contract)."""
+        for t, (rows, max_ts_ns) in stored.items():
+            ingestledger.note_stored(t, rows,
+                                     max_ts_unix=max_ts_ns / 1e9)
+        for t, rows in dropped_old.items():
+            ingestledger.note_dropped(t, rows, "too_old")
+        for t, rows in dropped_new.items():
+            ingestledger.note_dropped(t, rows, "too_new")
 
     def _get_partition(self, day: int) -> Partition:
         with self._lock:

@@ -13,6 +13,8 @@ import json
 import os
 import struct
 import threading
+import time
+from collections import deque
 
 
 SEGMENT_MAX_BYTES = 64 << 20
@@ -67,6 +69,21 @@ class PersistentQueue:
         # sweep at open, then +rec on append / -rec on ack) — stat-ing
         # every live segment per append made ingest cost grow with backlog
         self._pending = self._scan_pending_bytes()
+        # record-level visibility for the spool/queue gauges: (bytes
+        # left, enqueue mono-time) per record, consumed FIFO on ack.
+        # A pre-existing backlog can't be re-framed per record cheaply,
+        # so it seeds ONE entry aged by the oldest segment's mtime —
+        # entries is then a floor and the age a conservative bound
+        self._entries: deque = deque()
+        if self._pending:
+            try:
+                mtime = os.path.getmtime(self._seg_path(self._read_seg))
+                # vlint: allow-wall-clock(segment mtime is wall time; converted to the mono clock once at open)
+                age = max(0.0, time.time() - mtime)
+            except OSError:
+                age = 0.0
+            self._entries.append([self._pending,
+                                  time.monotonic() - age])
 
     @staticmethod
     def _truncate_torn_tail(path: str) -> None:
@@ -107,6 +124,7 @@ class PersistentQueue:
             self._writer.flush()
             os.fsync(self._writer.fileno())
             self._pending += len(rec)
+            self._entries.append([len(rec), time.monotonic()])
             self._data_ready.notify_all()
 
     def _scan_pending_bytes(self) -> int:
@@ -122,6 +140,19 @@ class PersistentQueue:
     def pending_bytes(self) -> int:
         with self._lock:
             return self._pending
+
+    def pending_entries(self) -> int:
+        """Undelivered records (a pre-existing backlog counts as one)."""
+        with self._lock:
+            return len(self._entries)
+
+    def oldest_age_seconds(self) -> float:
+        """Age of the oldest undelivered record; 0.0 when drained —
+        the wedged-spool signal the chaos dashboards watch."""
+        with self._lock:
+            if not self._entries:
+                return 0.0
+            return max(0.0, time.monotonic() - self._entries[0][1])
 
     # ---- reader ----
     def read(self, timeout: float | None = None) -> bytes | None:
@@ -173,6 +204,14 @@ class PersistentQueue:
         with self._lock:
             self._read_off += 4 + data_len
             self._pending = max(0, self._pending - (4 + data_len))
+            n = 4 + data_len
+            while n > 0 and self._entries:
+                head = self._entries[0]
+                take = min(head[0], n)
+                head[0] -= take
+                n -= take
+                if head[0] == 0:
+                    self._entries.popleft()
             tmp = os.path.join(self.path, READER_STATE + ".tmp")
             with open(tmp, "w") as f:
                 json.dump({"seg": self._read_seg, "off": self._read_off}, f)
