@@ -135,7 +135,10 @@ bench-standing:
 # Amdahl projection), i1 codec encode/decode rates, typed-vs-legacy
 # insert hop (>=3x, zero per-row json.loads pinned by counters),
 # spool-replay chaos (zero rows lost, zero re-encodes), and the
-# typed-vs-legacy stored-data differential — PERF.md round 16
+# typed-vs-legacy stored-data differential — PERF.md round 16 — plus
+# the sharded block-build round: columnar arena encode vs the list
+# path (>=1.5x) and serial-vs-sharded insert hop against the 352k
+# baseline (>=2x asserted only when >=2 cores) — PERF.md round 18
 bench-ingest:
 	python tools/bench_ingest.py --json BENCH_ingest.json
 

@@ -1,0 +1,391 @@
+"""Sharded block build (storage/block_build.py): parallel-vs-serial
+flushed-part BYTE identity across thread counts and the arena/list
+encode paths, direct arena-vs-list values-encoder differentials over
+the typed-detection edge cases, the unified size-bounded chunker pin,
+ledger conservation + per-hop `build` aggregates under concurrent
+builds, pool drain on DataDB.close (vlsan-swept), the
+VL_BLOCK_BUILD_THREADS=0 serial fallback, the VL_INSERT_PIPELINE
+decode/store hop overlap, and syslog-vs-jsonline columnar parity."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from victorialogs_tpu.obs import ingestledger
+from victorialogs_tpu.server import cluster, wire_ingest
+from victorialogs_tpu.storage import block_build
+from victorialogs_tpu.storage.block import chunk_end, row_cost_cum
+from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+from victorialogs_tpu.storage.storage import Storage
+from victorialogs_tpu.storage.values_encoder import (
+    VT_CONST, VT_DICT, VT_FLOAT64, VT_INT64, VT_IPV4, VT_STRING,
+    VT_TIMESTAMP_ISO8601, VT_UINT8, decode_values, encode_values)
+
+NS = 1_000_000_000
+T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
+TEN = TenantID(0, 0)
+
+# the realistic invalid-UTF-8 ingest outcome: bytes that failed strict
+# decode arrive as U+FFFD replacements (HTTP readers use errors="replace")
+BAD_UTF8 = b"\xff\xfe broken \x80".decode("utf-8", "replace")
+
+
+def _mixed_lr(nrows=4000, nstreams=9):
+    """>=8 streams x 3 schema groups x every value type the encoder
+    detects (const/dict/uint/int/float/ipv4/iso/string), plus empty
+    values, embedded NULs and replacement chars from invalid UTF-8."""
+    lr = LogRows(stream_fields=["app", "host"])
+    for i in range(nrows):
+        s = i % nstreams
+        fields = [("app", f"a{s}"), ("host", f"h{s % 3}"),
+                  ("_msg", f"msg {i} tok{i % 37} {'x' * (i % 23)}"),
+                  ("level", ["info", "warn", "error"][i % 3]),
+                  ("count", str(i)),
+                  ("neg", str(-i)),
+                  ("f", f"{i}.25"),
+                  ("ip", f"10.0.{i % 256}.{i % 200}"),
+                  ("iso", "2025-07-28T12:00:%02d.%03dZ" % (i % 60,
+                                                           i % 1000)),
+                  ("const", "xyz")]
+        if i % 3 == 0:  # schema group 2: extra sparse field
+            fields.append(("sparse", f"s{i % 4}"))
+        if i % 7 == 0:  # schema group 3: nasty values
+            fields.append(("nasty", ["", "12\x00", BAD_UTF8,
+                                     "snow☃"][i % 4]))
+        lr.add(TEN, T0 + (i % 500) * NS + i, fields)
+    return lr
+
+
+def _filedict(root):
+    out = {}
+    for dp, _dns, fns in os.walk(root):
+        for fn in fns:
+            p = os.path.join(dp, fn)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+def _query_lines(s, q="*"):
+    from victorialogs_tpu.engine.emit import ndjson_block
+    from victorialogs_tpu.engine.searcher import run_query
+    blocks = []
+    run_query(s, [TEN], q, write_block=blocks.append,
+              timestamp=T0 + 86400 * NS)
+    out = []
+    for br in blocks:
+        out.extend(ndjson_block(br).splitlines())
+    return sorted(out)
+
+
+def _store(path, body, flush=True):
+    s = Storage(str(path), retention_days=100000, flush_interval=3600)
+    n = cluster.handle_internal_insert(s, {}, body)
+    if flush:
+        s.debug_flush()
+    return s, n
+
+
+# ---------------- parallel vs serial byte identity ----------------
+
+def test_parallel_serial_arena_part_byte_identity(tmp_path, monkeypatch):
+    """The acceptance pin: flushed parts from the sharded build are
+    byte-identical to the serial build, and the arena (columnar)
+    encode produces the same bytes as the materialized-string path —
+    all four (threads x arena) combinations, through the real
+    /internal/insert storage hop."""
+    lr = _mixed_lr()
+    body = wire_ingest.encode_rows(lr)
+    fds = {}
+    for threads, arena in [(4, "1"), (0, "1"), (4, "0"), (0, "0")]:
+        monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", str(threads))
+        monkeypatch.setenv("VL_ARENA_BUILD", arena)
+        root = tmp_path / f"t{threads}a{arena}"
+        s, n = _store(root, body)
+        s.close()
+        assert n == len(lr)
+        fds[(threads, arena)] = _filedict(str(root))
+    ref = fds[(0, "0")]
+    assert len(ref) > 5
+    for key, fd in fds.items():
+        assert fd.keys() == ref.keys(), key
+        diff = [k for k in ref if fd[k] != ref[k]]
+        assert not diff, (key, diff)
+
+
+def test_row_vs_columnar_part_byte_identity(tmp_path, monkeypatch):
+    """Same-schema batches produce byte-identical parts whether they
+    enter as LogRows or as a columnar batch — the unified chunker +
+    shared `_build_one_block`/`encode_values` core."""
+    monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", "4")
+
+    def lr():
+        out = LogRows(stream_fields=["app"])
+        for i in range(3000):
+            out.add(TEN, T0 + i * NS, [("app", f"a{i % 8}"),
+                                       ("_msg", f"m {i}"),
+                                       ("k", str(i % 5))])
+        return out
+
+    sa = Storage(str(tmp_path / "rows"), retention_days=100000,
+                 flush_interval=3600)
+    sa.must_add_rows(lr())
+    sa.debug_flush()
+    sa.close()
+    sb = Storage(str(tmp_path / "cols"), retention_days=100000,
+                 flush_interval=3600)
+    sb.must_add_columns(wire_ingest.rows_to_columns(lr()))
+    sb.debug_flush()
+    sb.close()
+    fa, fb = _filedict(str(tmp_path / "rows")), \
+        _filedict(str(tmp_path / "cols"))
+    assert fa.keys() == fb.keys() and len(fa) > 3
+    assert [k for k in fa if fa[k] != fb[k]] == []
+
+
+# ---------------- arena encoder differential ----------------
+
+def _arena_of(vals):
+    """list[str] (ASCII) -> dense (sub, offs, lens) arena triple."""
+    raw = "".join(vals).encode("utf-8")
+    lens = np.asarray([len(v) for v in vals], dtype=np.int64)
+    offs = np.zeros(len(vals), dtype=np.int64)
+    if len(vals) > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    return np.frombuffer(raw, dtype=np.uint8), offs, lens
+
+
+TRICKY_COLUMNS = [
+    ("const", ["xyz"] * 64, VT_CONST),
+    ("const_empty", [""] * 64, VT_CONST),
+    ("dict8", [f"v{i % 8}" for i in range(64)], VT_DICT),
+    ("dict9_overflow", [f"v{i % 9}" for i in range(64)], VT_STRING),
+    # 8 distinct values, 32 bytes each = 256 distinct bytes: at the cap
+    ("dict_256b", [("%d" % (i % 8)) * 32 for i in range(64)], VT_DICT),
+    # 8 distinct, 33 bytes each = 264 > 256: over the cap
+    ("dict_264b", [("%d" % (i % 8)) * 33 for i in range(64)], VT_STRING),
+    # >8 distinct everywhere below: the dict trial must lose so the
+    # typed trials (and their rejection paths) actually run
+    ("uint8", [str(i % 200) for i in range(64)], VT_UINT8),
+    ("uint_leading_zero", ["01"] + [str(i) for i in range(2, 65)],
+     VT_STRING),
+    ("int_neg", [str(-i) for i in range(10, 74)], VT_INT64),
+    ("float", [f"{i}.5" for i in range(10, 74)], VT_FLOAT64),
+    ("float_inf", ["inf"] + [f"{i}.5" for i in range(63)], VT_STRING),
+    ("ipv4", [f"10.0.0.{i % 200}" for i in range(64)], VT_IPV4),
+    ("ipv4_noncanon", ["10.0.00.1"] + [f"10.0.0.{i}" for i in range(63)],
+     VT_STRING),
+    ("iso", ["2025-07-28T12:00:%02d.%03dZ" % (i % 60, i % 1000)
+             for i in range(64)], VT_TIMESTAMP_ISO8601),
+    ("iso_mixed_frac", ["2025-07-28T12:00:01.5Z"]
+     + ["2025-07-28T12:00:01.%03dZ" % i for i in range(63)], VT_STRING),
+    ("nul_byte", [f"v{i}\x00" for i in range(64)], VT_STRING),
+    ("empty_mixed", [""] + [f"a{i}" for i in range(63)], VT_STRING),
+    ("plain", [f"word{i} and more" for i in range(64)], VT_STRING),
+]
+
+
+@pytest.mark.parametrize("name,vals,want_vtype",
+                         TRICKY_COLUMNS,
+                         ids=[c[0] for c in TRICKY_COLUMNS])
+def test_encode_arena_column_matches_encode_values(name, vals,
+                                                   want_vtype):
+    """The columnar encoder must pick the SAME encoding with the SAME
+    payload bytes as the per-row-string encoder, for every detection
+    edge case — that equality is what makes VL_ARENA_BUILD invisible
+    in the stored bytes."""
+    got = block_build.encode_arena_column(name, *_arena_of(vals))
+    want = encode_values(name, vals)
+    assert want.vtype == want_vtype
+    assert got.vtype == want.vtype
+    for f in ("const_value", "dict_values", "ids", "nums", "arena",
+              "offsets", "lengths", "min_val", "max_val", "iso_frac_w"):
+        ga, wa = getattr(got, f), getattr(want, f)
+        if isinstance(wa, np.ndarray):
+            assert np.array_equal(np.asarray(ga), wa), f
+        else:
+            assert ga == wa, f
+    assert decode_values(got, len(vals)) == vals
+
+
+def test_gather_non_contiguous_rows():
+    """_gather re-densifies an arbitrary row subset of an arena; the
+    encoder over the subset matches encode_values over the same rows."""
+    vals = [f"v{i % 3}" for i in range(100)]
+    ac = block_build.ArenaColumn("".join(vals).encode(),
+                                 *_arena_of(vals)[1:3], "".join(vals))
+    idx = np.asarray([3, 5, 8, 13, 21, 34, 55, 89], dtype=np.int64)
+    got = block_build.encode_arena_column(
+        "x", *block_build._gather(ac, idx))
+    want = encode_values("x", [vals[i] for i in idx])
+    assert got.vtype == want.vtype == VT_DICT
+    assert np.array_equal(got.ids, want.ids)
+    assert got.dict_values == want.dict_values
+
+
+# ---------------- unified chunker ----------------
+
+def test_chunk_end_strict_boundary():
+    """A row landing EXACTLY on max_bytes is excluded (strict <), at
+    least one row always ships, and max_rows caps the chunk — the one
+    canonical chunker both build paths now share."""
+    rows = [[("k", "v" * 10)]] * 10          # cost/row: 1+10+16+8 = 35
+    cum = row_cost_cum(rows)
+    assert cum[0] == 35 and cum[-1] == 350
+    # budget exactly 2 rows: cum[2]-0 = 105 > 70, cum[1] = 70 is NOT
+    # < 70+base... strict: rows j with cum[j-1] - base < max_bytes
+    assert chunk_end(cum, 0, max_bytes=70) == 2
+    assert chunk_end(cum, 0, max_bytes=71) == 3
+    assert chunk_end(cum, 0, max_bytes=1) == 1      # >=1 row always
+    assert chunk_end(cum, 0, max_rows=4, max_bytes=10**9) == 4
+    assert chunk_end(cum, 8, max_bytes=10**9) == 10  # tail clamp
+    # walking the chunker covers every row exactly once
+    s, seen = 0, 0
+    while s < len(rows):
+        e = chunk_end(cum, s, max_bytes=100)
+        assert e > s
+        seen += e - s
+        s = e
+    assert seen == len(rows)
+
+
+# ---------------- ledger + hop aggregates under concurrency ----------
+
+def test_ledger_conservation_concurrent_builds(tmp_path, monkeypatch):
+    """N threads ingesting through /internal/insert while the build
+    pool shards each batch: the row-conservation invariant holds, no
+    rows stay in flight, and the per-hop latency aggregates grew a
+    `build` hop nested under `store`."""
+    monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", "4")
+    s = Storage(str(tmp_path / "s"), retention_days=100000,
+                flush_interval=3600)
+    bodies = [wire_ingest.encode_rows(_mixed_lr(nrows=800))
+              for _ in range(4)]
+    errs = []
+
+    def one(body):
+        try:
+            cluster.handle_internal_insert(s, {}, body)
+        except Exception as e:  # pragma: no cover - assertion surface
+            errs.append(e)
+
+    ts = [threading.Thread(target=one, args=(b,)) for b in bodies]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s.debug_flush()
+    s.close()
+    assert not errs
+    bal = ingestledger.balance_snapshot()["0:0"]
+    assert bal["in_flight"] == 0
+    assert bal["dropped_rows"] == 0
+    hops = ingestledger.status_payload()["hop_latency"]["0:0"]
+    assert hops["build"]["count"] >= 4
+    assert hops["store"]["count"] >= 4
+
+
+# ---------------- pool lifecycle ----------------
+
+def test_build_pool_drains_on_close(tmp_path, monkeypatch):
+    """DataDB.close() shuts the pool down: its vl-block-build workers
+    exit and the vlsan live-pool registry goes back to zero, so the
+    end-of-test non-daemon-thread sweep stays green."""
+    monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", "3")
+    s = Storage(str(tmp_path / "s"), retention_days=100000,
+                flush_interval=3600)
+    s.must_add_rows(_mixed_lr(nrows=500))
+    s.debug_flush()
+    assert any(t.name.startswith("vl-block-build")
+               for t in threading.enumerate())
+    assert block_build.live_build_pools() > 0
+    s.close()
+    assert block_build.live_build_pools() == 0
+    for t in threading.enumerate():
+        if t.name.startswith("vl-block-build"):
+            t.join(timeout=5)
+    assert not any(t.name.startswith("vl-block-build")
+                   for t in threading.enumerate())
+
+
+def test_threads_zero_serial_fallback(monkeypatch):
+    """VL_BLOCK_BUILD_THREADS=0 (and 1) never constructs an executor —
+    the build runs inline on the caller."""
+    monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", "0")
+    p = block_build.BuildPool()
+    assert block_build.build_threads() == 0
+    assert p.executor() is None
+    monkeypatch.setenv("VL_BLOCK_BUILD_THREADS", "1")
+    assert p.executor() is None
+    p.close()
+    assert p.executor() is None  # closed pools stay serial
+
+
+# ---------------- insert pipeline (hop overlap) ----------------
+
+def test_insert_pipeline_overlap(tmp_path, monkeypatch):
+    """VL_INSERT_PIPELINE>0: the handler returns after decode + entry
+    rolls, the drainer stores under the SAME batch record, and after
+    drain() the rows are flushed, queryable-by-count and the ledger
+    balances to zero in flight."""
+    monkeypatch.setenv("VL_INSERT_PIPELINE", "2")
+    s = Storage(str(tmp_path / "s"), retention_days=100000,
+                flush_interval=3600)
+    lrs = [_mixed_lr(nrows=300) for _ in range(3)]
+    total = sum(len(lr) for lr in lrs)
+    for i, lr in enumerate(lrs):
+        n = cluster.handle_internal_insert(
+            s, {"batch_id": f"pipe:{i}", "batch_tenant": "0:0"},
+            wire_ingest.encode_rows(lr))
+        assert n == len(lr)
+    cluster.INSERT_PIPELINE.drain()
+    assert cluster.INSERT_PIPELINE.stored_total >= total
+    s.debug_flush()
+    assert len(_query_lines(s)) == total
+    s.close()
+    bal = ingestledger.balance_snapshot()["0:0"]
+    assert bal["in_flight"] == 0
+
+
+# ---------------- syslog columnar parity ----------------
+
+def test_syslog_columnar_parity(tmp_path):
+    """Syslog ingest now batches into LogColumns and rides the same
+    rows_to_columns -> must_add_columns block-build path as jsonline:
+    the stored result matches a row-path ingest of the identically
+    parsed fields."""
+    from victorialogs_tpu.engine.block_result import parse_rfc3339
+    from victorialogs_tpu.server.syslog import (SyslogServer,
+                                                parse_syslog_message)
+
+    lines = [
+        "<34>1 2025-07-28T06:14:%02d.003Z host%d app %d - - boom %d"
+        % (i % 60, i % 4, i, i)
+        for i in range(200)
+    ]
+
+    s_sys = Storage(str(tmp_path / "sys"), retention_days=100000,
+                    flush_interval=3600)
+    srv = SyslogServer(s_sys, tcp_port=-1, udp_port=-1)
+    for ln in lines:
+        srv.ingest_line(ln)
+    srv.close()
+    s_sys.debug_flush()
+
+    s_row = Storage(str(tmp_path / "row"), retention_days=100000,
+                    flush_interval=3600)
+    lr = LogRows(stream_fields=["hostname", "app_name"])
+    for ln in lines:
+        fields = parse_syslog_message(ln)
+        ts = parse_rfc3339(dict(fields)["timestamp"])
+        lr.add(TEN, ts, fields)
+    s_row.must_add_rows(lr)
+    s_row.debug_flush()
+
+    got, want = _query_lines(s_sys), _query_lines(s_row)
+    s_sys.close()
+    s_row.close()
+    assert len(want) == len(lines)
+    assert got == want

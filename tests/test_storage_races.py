@@ -141,13 +141,13 @@ def test_merge_failure_injection_never_loses_rows(tmp_path, monkeypatch):
     real_write = ddb_mod.write_part
     fail_on = {"armed": True}
 
-    def flaky_write(path, blocks, big=False):
+    def flaky_write(path, blocks, big=False, pool=None):
         if fail_on["armed"] and rnd.random() < 0.3:
             # consume part of the iterator first (mid-write crash shape)
             it = iter(blocks)
             next(it, None)
             raise OSError("injected write failure")
-        return real_write(path, blocks, big=big)
+        return real_write(path, blocks, big=big, pool=pool)
     monkeypatch.setattr(ddb_mod, "write_part", flaky_write)
 
     ddb = DataDB(str(tmp_path / "flaky"), flush_interval=3600)

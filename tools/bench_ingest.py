@@ -58,6 +58,8 @@ if REPO not in sys.path:
 NS = 1_000_000_000
 T0 = 1_753_660_800_000_000_000  # 2025-07-28T00:00:00Z
 BASELINE_ROWS_PER_S = 277_000   # PERF.md ingestion table, jsonline lib
+BUILD_BASELINE_ROWS_PER_S = 352_000  # PERF.md round 17: typed hop
+#                                      (decode+store) with serial build
 
 
 def make_body(n: int) -> bytes:
@@ -208,6 +210,89 @@ def _timeit(fn) -> float:
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+def make_build_columns(n: int):
+    """The build round's corpus: a typical access-log schema with the
+    full typed spread (dict/uint/float/ipv4/iso/string), where the
+    values-encode detection cascade — not just bloom construction —
+    carries real weight."""
+    from victorialogs_tpu.server import wire_ingest
+    from victorialogs_tpu.storage.log_rows import LogRows, TenantID
+    lr = LogRows(stream_fields=["app"])
+    ten = TenantID(0, 0)
+    for i in range(n):
+        lr.add(ten, T0 + i * 1_000_000, [
+            ("app", f"app{i % 8}"),
+            ("_msg", f"GET /api/v{i % 4}/items/{i} "
+                     f"status={200 + i % 3} dur={i % 97}ms"),
+            ("level", "error" if i % 11 == 0 else "info"),
+            ("status", str(200 + i % 3)),
+            ("dur_ms", f"{i % 97}.{i % 10}"),
+            ("bytes_out", str(512 + (i * 37) % 100_000)),
+            ("remote_ip", f"10.{i % 4}.{(i >> 2) % 256}.{i % 254 + 1}"),
+            ("ts", "2025-07-28T%02d:%02d:%02d.%03dZ"
+             % (i % 24, i % 60, (i * 7) % 60, i % 1000)),
+        ])
+    return wire_ingest.rows_to_columns(lr)
+
+
+def round_build(n_rows: int, runs: int) -> dict:
+    """Sharded block build (storage/block_build.py): the columnar
+    (arena) values-encode vs the materialized-string path, both
+    serial, and the full decode+store hop with the build pool at
+    core width vs pinned serial — flushed parts byte-identical either
+    way (tests/test_block_build.py), so this round is pure speed."""
+    from victorialogs_tpu.server import wire_ingest
+    from victorialogs_tpu.storage import block_build
+    from victorialogs_tpu.utils import zstd as _zstd
+    # encode comparison on the typed-spread corpus (where detection
+    # cost lives); hop comparison on the SAME corpus round_hop measures
+    # (make_columns), so vs_baseline is apples-to-apples with the
+    # recorded 352k serial figure
+    rich = wire_ingest.encode_columns(make_build_columns(n_rows))
+    payload = _zstd.decompress(rich, max_output_size=1 << 30)
+    typed = wire_ingest.encode_columns(make_columns(n_rows))
+
+    def encode_once(arena: str) -> float:
+        # fresh decode per run: ArenaColumn caches materialized rows,
+        # so a reused batch would hand the list path a warm start
+        os.environ["VL_ARENA_BUILD"] = arena
+        lc = wire_ingest.decode_frame(payload)
+        gc.collect()
+        t0 = time.perf_counter()
+        blocks = lc.build_blocks()
+        el = time.perf_counter() - t0
+        assert sum(len(b.timestamps) for b in blocks) == n_rows
+        return el
+
+    el_arena = min(encode_once("1") for _ in range(runs))
+    el_list = min(encode_once("0") for _ in range(runs))
+
+    cores = os.cpu_count() or 1
+    os.environ["VL_ARENA_BUILD"] = "1"
+    os.environ["VL_BLOCK_BUILD_THREADS"] = "0"
+    el_serial = _hop_store(typed, n_rows, runs)
+    os.environ["VL_BLOCK_BUILD_THREADS"] = str(min(cores, 8))
+    el_sharded = _hop_store(typed, n_rows, runs)
+    del os.environ["VL_BLOCK_BUILD_THREADS"]
+    del os.environ["VL_ARENA_BUILD"]
+    assert block_build.live_build_pools() == 0, "bench leaked a pool"
+    return {
+        "rows": n_rows, "runs": runs, "cores": cores,
+        "build_threads": min(cores, 8),
+        "encode_arena_rows_per_s": round(n_rows / el_arena),
+        "encode_list_rows_per_s": round(n_rows / el_list),
+        "columnar_encode_speedup": round(el_list / el_arena, 2),
+        "serial_hop_rows_per_s": round(n_rows / el_serial),
+        "sharded_hop_rows_per_s": round(n_rows / el_sharded),
+        "sharded_speedup": round(el_serial / el_sharded, 2),
+        "baseline_rows_per_s": BUILD_BASELINE_ROWS_PER_S,
+        "vs_baseline": round((n_rows / el_sharded)
+                             / BUILD_BASELINE_ROWS_PER_S, 2),
+        "note": "encode_* is build_blocks alone on a decoded batch; "
+                "*_hop_* is the full /internal/insert decode+store",
+    }
 
 
 def round_spool(n_blocks: int, rows_per_block: int) -> dict:
@@ -407,6 +492,17 @@ def main():
           f"({hop['speedup']}x); per-row json.loads on typed: "
           f"{hop['rx_rows_json_during_typed']}")
 
+    build = round_build(args.rows, args.runs)
+    print(f"block build: columnar encode "
+          f"{build['encode_arena_rows_per_s']:,} rows/s vs list "
+          f"{build['encode_list_rows_per_s']:,} rows/s "
+          f"({build['columnar_encode_speedup']}x); sharded hop "
+          f"{build['sharded_hop_rows_per_s']:,} rows/s vs serial "
+          f"{build['serial_hop_rows_per_s']:,} rows/s "
+          f"({build['sharded_speedup']}x on {build['cores']} cores, "
+          f"{build['vs_baseline']}x the {BUILD_BASELINE_ROWS_PER_S:,} "
+          f"baseline)")
+
     spool = round_spool(n_blocks=6,
                         rows_per_block=max(args.rows // 12, 1000))
     print(f"spool replay: {spool['rows']} rows in {spool['blocks']} "
@@ -427,7 +523,7 @@ def main():
           f"(tracing off) {fresh['tracing_off_overhead_x']}x")
 
     out = {"baseline_rows_per_s": BASELINE_ROWS_PER_S,
-           "library": lib, "hop": hop, "spool": spool,
+           "library": lib, "hop": hop, "build": build, "spool": spool,
            "differential": diff, "freshness": fresh}
     if args.json:
         with open(args.json, "w") as f:
@@ -445,6 +541,16 @@ def main():
             "typed hop paid per-row json.loads"
         assert lib["rows_per_s_1thread"] >= BASELINE_ROWS_PER_S, \
             f"library regressed under the {BASELINE_ROWS_PER_S} baseline"
+        assert build["columnar_encode_speedup"] >= 1.5, \
+            f"columnar encode only " \
+            f"{build['columnar_encode_speedup']}x the list path"
+        if build["cores"] >= 2:
+            floor = 2 * BUILD_BASELINE_ROWS_PER_S
+            assert build["sharded_hop_rows_per_s"] >= floor, \
+                f"sharded hop {build['sharded_hop_rows_per_s']} < " \
+                f"2x the {BUILD_BASELINE_ROWS_PER_S} serial baseline"
+        # report-only on 1-core CI: the sharded figure degenerates to
+        # serial there by design (pool never constructed)
         assert spool["rows_lost"] == 0, "spool replay lost rows"
         assert spool["replay_reencodes"] == 0, \
             "spool replay re-encoded blocks"

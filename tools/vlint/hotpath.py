@@ -34,9 +34,13 @@ from .locks import _dotted, _module_jit_names
 # hidden host sync or jit-closure there is a query-path regression.
 # storage/filterindex/ too: its maplet/xor probes sit directly on the
 # per-part prune path of every query over sealed parts.
+# storage/block_build.py: the columnar values-encode/bloom builder is
+# the ingest flush hot path — per-row Python work there is exactly the
+# regression the sharded build exists to remove.
 SCOPE_RE = re.compile(
     r"(^|/)(tpu|engine)(/|$)|(^|/)obs/explain\.py$"
-    r"|(^|/)storage/filterindex(/|$)")
+    r"|(^|/)storage/filterindex(/|$)"
+    r"|(^|/)storage/block_build\.py$")
 # the emit-shape rule runs where response/row materialization lives
 EMIT_SCOPE_RE = re.compile(r"(^|/)(server|engine)(/|$)")
 

@@ -281,6 +281,17 @@ class Sanitizer:
                 owned = batch.live_prefetch_pools() if batch else 0
                 if len(prefetch) <= owned:
                     leaked = [t for t in leaked if t not in prefetch]
+            # vl-block-build workers: same ThreadPoolExecutor pattern —
+            # a pool owned by a still-open DataDB is infrastructure
+            # (DataDB.close() shuts it down); only ownerless survivors
+            # count
+            builders = [t for t in leaked
+                        if t.name.startswith("vl-block-build")]
+            if builders:
+                bb = _mod("victorialogs_tpu.storage.block_build")
+                owned = bb.live_build_pools() if bb else 0
+                if len(builders) <= owned:
+                    leaked = [t for t in leaked if t not in builders]
             if leaked:
                 # an abandoned ThreadPoolExecutor's workers exit once
                 # the executor is collected (its weakref callback

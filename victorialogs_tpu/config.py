@@ -406,6 +406,28 @@ declare_env(
     "own columnar batch and handing it to the sink on the worker "
     "(`server/vlinsert.py`)", display="auto")
 declare_env(
+    "VL_BLOCK_BUILD_THREADS", None, "int",
+    "block-build shard parallelism on the storage flush path: each "
+    "size-bounded block chunk's values-encode + token blooms builds "
+    "on a per-DataDB thread pool, and part seals compress columns / "
+    "build filter-index sidecar columns on the same pool "
+    "(`storage/block_build.py`; flushed parts are byte-identical to "
+    "the serial build; `0`/`1` = serial; default min(cores, 8))",
+    display="auto")
+declare_env(
+    "VL_ARENA_BUILD", "1", "flag",
+    "`1` = columnar values-encode: ASCII i1 wire columns feed block "
+    "build as offset slices over the decoded arena, with vectorized "
+    "const/dict/int/float detection — no per-row Python strings "
+    "between `decode_frame` and the encoded block; `0` = always "
+    "materialize per-row strings first (same bytes either way)")
+declare_env(
+    "VL_INSERT_PIPELINE", "0", "int",
+    "storage-node `/internal/insert` hop overlap: depth of the "
+    "bounded decode->store hand-off queue, letting frame N+1 decode "
+    "while frame N builds blocks (rows count as ledger in-flight "
+    "until stored; `0` = synchronous store on the request thread)")
+declare_env(
     "VL_NO_NATIVE", None, "str",
     "`1` = skip the C++ host core, numpy fallbacks", display="off")
 declare_env(
@@ -694,6 +716,21 @@ declare_metric("vl_ingest_batches_in_flight", "gauge",
 declare_metric("vl_ingest_watermark_seconds", "gauge",
                "per-tenant freshness lag: seconds since the max stored "
                "row timestamp", single_roll=True)
+
+# -- /internal/insert decode/build overlap (server/cluster.py) --
+declare_metric("vl_insert_pipeline_batches_total", "counter",
+               "typed insert batches handed to the decode/build overlap "
+               "pipeline (VL_INSERT_PIPELINE > 0)", single_roll=True)
+declare_metric("vl_insert_pipeline_rows_stored_total", "counter",
+               "rows stored by the insert pipeline drainer",
+               single_roll=True)
+declare_metric("vl_insert_pipeline_rows_dropped_total", "counter",
+               "rows dropped by the insert pipeline drainer on store "
+               "failure (also rolled into the ledger as "
+               "pipeline_store_error)", single_roll=True)
+declare_metric("vl_insert_pipeline_queue_depth", "gauge",
+               "batches currently queued behind the insert pipeline "
+               "drainer", single_roll=True)
 
 # -- cluster observability plane (obs/clusterstats.py, federated
 #    registry + cancel propagation in server/cluster.py + app.py) --

@@ -222,3 +222,12 @@ MERGE_SECONDS = histogram(
     "compactions and force merges, storage/datadb.py)",
     (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
      30.0, 60.0))
+
+INGEST_BLOCK_BUILD = histogram(
+    "vl_ingest_block_build_seconds",
+    "wall time of one format-independent block build: values encode + "
+    "token blooms for one ingested batch, serial or sharded across the "
+    "VL_BLOCK_BUILD_THREADS pool (storage/block_build.py, observed at "
+    "the DataDB must_add chokepoint)",
+    (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+     2.5, 5.0))

@@ -171,6 +171,10 @@ class Metrics:
         from . import cluster as _cluster
         for base, labels, v in _cluster.wire_metrics_samples():
             add(metric_name(base, **labels), v)
+        # storage-node insert pipeline (VL_INSERT_PIPELINE hop overlap):
+        # queued-batch depth + stored/dropped row totals
+        for base, labels, v in _cluster.INSERT_PIPELINE.metrics_samples():
+            add(metric_name(base, **labels), v)
         # typed ingest wire accounting: i1 vs legacy insert bodies by
         # direction + sticky fallbacks (server/wire_ingest.py)
         from . import wire_ingest as _wire_ingest

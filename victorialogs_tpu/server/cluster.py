@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import struct
 import threading
 import time
@@ -534,6 +535,99 @@ def handle_internal_select(storage, args, runner=None):
 
 # ---------------- server side: /internal/insert ----------------
 
+class _InsertPipeline:
+    """Decode/store hop overlap for typed /internal/insert frames.
+
+    With ``VL_INSERT_PIPELINE`` > 0 the request thread stops at the
+    decode + ledger-entry rolls and hands the decoded batch to a
+    bounded queue (maxsize = the configured depth, latched at first
+    use); one daemon drainer re-enters the batch's ledger record via
+    ``use_batch`` and runs the storage chokepoint, so frame N+1's
+    decompress/decode overlaps frame N's block build.  The ledger
+    stays exact: ``received`` rolls on the request thread, ``stored``
+    (or ``dropped`` on a store error) rolls on the drainer under the
+    SAME batch record, so derived in_flight counts queued rows until
+    they land.  ``queue.Queue.put`` blocking on a full queue is the
+    backpressure — at most ``depth`` batches ever wait.  Default 0
+    keeps the store synchronous on the request thread (read-your-
+    writes for every existing caller)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._q = None
+        self.enqueued_total = 0
+        self.stored_total = 0
+        self.dropped_total = 0
+
+    def submit(self, storage, lc, per_tenant: dict, nbytes: int) -> bool:
+        depth = config.env_int("VL_INSERT_PIPELINE") or 0
+        if depth <= 0:
+            return False
+        with self._mu:
+            if self._q is None:
+                self._q = queue.Queue(maxsize=max(1, depth))
+                threading.Thread(target=self._run, daemon=True,
+                                 name="vl-insert-pipeline").start()
+            self.enqueued_total += 1
+            q = self._q
+        q.put((storage, lc, dict(per_tenant), nbytes,
+               ingestledger.current_batch()))
+        return True
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                self._store(*item)
+            # vlint: allow-broad-except(drainer thread must survive)
+            except Exception:  # pragma: no cover - keep draining
+                pass
+            finally:
+                self._q.task_done()
+
+    def _store(self, storage, lc, per_tenant, nbytes, ctx) -> None:
+        try:
+            with ingestledger.use_batch(ctx):
+                with ingestledger.hop("store"):
+                    storage.must_add_columns(lc)
+        # vlint: allow-broad-except(async store: any failure must roll dropped so the ledger balances)
+        except Exception:
+            for tenant, rows in per_tenant.items():
+                ingestledger.note_dropped(
+                    tenant, rows, "pipeline_store_error",
+                    batch_id=ctx.batch_id if ctx is not None else None)
+            with self._mu:
+                self.dropped_total += lc.nrows
+            return
+        for tenant, rows in per_tenant.items():
+            activity.note_ingest(tenant, rows,
+                                 nbytes=nbytes * rows // lc.nrows)
+        with self._mu:
+            self.stored_total += lc.nrows
+
+    def drain(self) -> None:
+        """Block until every queued batch has stored (tests + shutdown)."""
+        q = self._q
+        if q is not None:
+            q.join()
+
+    def metrics_samples(self) -> list:
+        with self._mu:
+            depth = self._q.qsize() if self._q is not None else 0
+            return [
+                ("vl_insert_pipeline_batches_total", {},
+                 self.enqueued_total),
+                ("vl_insert_pipeline_rows_stored_total", {},
+                 self.stored_total),
+                ("vl_insert_pipeline_rows_dropped_total", {},
+                 self.dropped_total),
+                ("vl_insert_pipeline_queue_depth", {}, depth),
+            ]
+
+
+INSERT_PIPELINE = _InsertPipeline()
+
+
 def handle_internal_insert(storage, args, body: bytes) -> int:
     if args.get("version", PROTOCOL_VERSION) != PROTOCOL_VERSION:
         raise ValueError(f"unsupported protocol version "
@@ -581,11 +675,13 @@ def _internal_insert(storage, args, body: bytes) -> int:
             per_tenant = wire_ingest.columns_tenant_rows(lc)
             for tenant, rows in per_tenant.items():
                 ingestledger.note_received(tenant, rows)
-            with ingestledger.hop("store"):
-                storage.must_add_columns(lc)
-            for tenant, rows in per_tenant.items():
-                activity.note_ingest(
-                    tenant, rows, nbytes=len(data) * rows // lc.nrows)
+            if not INSERT_PIPELINE.submit(storage, lc, per_tenant,
+                                          len(data)):
+                with ingestledger.hop("store"):
+                    storage.must_add_columns(lc)
+                for tenant, rows in per_tenant.items():
+                    activity.note_ingest(
+                        tenant, rows, nbytes=len(data) * rows // lc.nrows)
         return lc.nrows
     lr = LogRows()
     n = 0

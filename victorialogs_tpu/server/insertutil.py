@@ -156,9 +156,13 @@ class LogMessageProcessor:
     common_params.go:199-223."""
 
     def __init__(self, cp: CommonParams, sink: LogRowsStorage,
-                 periodic_flush: bool = False):
+                 periodic_flush: bool = False, columnar: bool = False):
         self.cp = cp
         self.sink = sink
+        # columnar: flushes convert the accumulated rows to a LogColumns
+        # batch and ride must_add_columns -> the i1 columnar block-build
+        # path (syslog sets this; silently off when the sink can't)
+        self.columnar = columnar
         self.lr = LogRows(stream_fields=list(cp.stream_fields),
                           ignore_fields=list(cp.ignore_fields),
                           extra_fields=list(cp.extra_fields),
@@ -218,7 +222,12 @@ class LogMessageProcessor:
             # stay off the ledger entirely, entry AND terminal side.
             if ingestledger.current_batch() is not None:
                 ingestledger.note_accepted(self.cp.tenant, len(self.lr))
-            self.sink.must_add_rows(self.lr)
+            if self.columnar and self.supports_columns():
+                from . import wire_ingest
+                self.sink.must_add_columns(
+                    wire_ingest.rows_to_columns(self.lr))
+            else:
+                self.sink.must_add_rows(self.lr)
             self.lr = LogRows(stream_fields=list(self.lr.stream_fields),
                               ignore_fields=list(self.cp.ignore_fields),
                               extra_fields=list(self.cp.extra_fields),

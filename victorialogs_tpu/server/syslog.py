@@ -172,7 +172,11 @@ class SyslogServer:
         from ..storage.log_rows import TenantID
         cp = CommonParams(tenant=tenant or TenantID(),
                           stream_fields=["hostname", "app_name"])
-        self.lmp = LogMessageProcessor(cp, sink, periodic_flush=True)
+        # columnar: flushed syslog batches build LogColumns and ride the
+        # same rows_to_columns -> must_add_columns block-build path as
+        # jsonline ingest (parity-tested against the row path)
+        self.lmp = LogMessageProcessor(cp, sink, periodic_flush=True,
+                                       columnar=True)
         self.tcp_port = self.udp_port = 0
         self._tcp = self._udp = None
         outer = self

@@ -53,6 +53,8 @@ import numpy as np
 
 from .. import config
 from ..obs import tracing
+from ..storage.block_build import ArenaColumn as _ArenaColumn
+from ..storage.block_build import arena_build_enabled as _arena_cols
 from ..storage.log_rows import (LogColumns, LogRows, StreamID, TenantID)
 from ..utils import zstd as _zstd
 from ..utils.hashing import stream_id_hash
@@ -115,10 +117,19 @@ def metrics_samples() -> list:
 
 # ---- encode ----
 
-def _arena(vals: list) -> tuple[bytes, np.ndarray, np.ndarray]:
+def _arena(vals) -> tuple[bytes, np.ndarray, np.ndarray]:
     """One dense utf-8 arena + u32 offsets/lengths for a value list.
     ASCII fast path: byte lengths == str lengths, so ONE encode of the
     joined string replaces per-value encodes."""
+    wa = getattr(vals, "wire_arena", None)
+    if wa is not None:
+        # decoded ArenaColumn (storage/block_build): the wire arena IS
+        # the value arena — a shard re-route or spool re-encode of a
+        # decoded frame skips the join+encode entirely
+        arena, offs, lens = wa()
+        if len(arena) >= 1 << 32:
+            raise ValueError("i1 frame arena overflow")
+        return arena, offs, lens
     joined = "".join(vals)
     arena = joined.encode("utf-8")
     n = len(vals)
@@ -393,6 +404,12 @@ def decode_frame(data: bytes) -> LogColumns:
             lens = r.array("<u4", n)
             _check_slices(offs, lens, alen, "value")
             text = _arena_text(raw, "value")
+            if len(text) == len(raw) and n and _arena_cols():
+                # ASCII arena: keep it dense all the way to the block
+                # build (storage/block_build) — no per-row strings
+                # exist between here and BlockData
+                cols.append(_ArenaColumn(raw, offs, lens, text))
+                continue
             try:
                 cols.append(_slice_all(text, raw, offs, lens))
             except UnicodeDecodeError as e:

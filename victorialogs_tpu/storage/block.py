@@ -117,6 +117,35 @@ def build_column_bloom(col: EncodedColumn, nrows: int) -> None:
     col.bloom = bloom_build(hashes)
 
 
+def row_cost_cum(rows: list[list[tuple[str, str]]]) -> np.ndarray:
+    """Inclusive running total of per-row encoded-size estimates for
+    tuple-list rows: len(k)+len(v)+16 per field plus 8 per row — the
+    same accounting the columnar path reaches by summing per-column
+    value lengths + (len(name)+16) per column."""
+    return np.cumsum(np.fromiter(
+        (sum(len(k) + len(v) for k, v in r) + 16 * len(r) + 8
+         for r in rows), dtype=np.int64, count=len(rows)))
+
+
+def chunk_end(cum: np.ndarray, start: int,
+              max_rows: int = MAX_ROWS_PER_BLOCK,
+              max_bytes: int = MAX_UNCOMPRESSED_BLOCK_SIZE) -> int:
+    """End (exclusive) of the size-bounded block chunk starting at
+    `start`, given the inclusive cumsum of per-row size estimates.
+
+    A row joins while the byte budget before it is still positive
+    (strict `<`), with at least one row per chunk and at most
+    `max_rows`.  This is THE single chunking rule: the row path here
+    and the columnar path (storage/block_build) used to carry separate
+    copies that disagreed when a row landed exactly on the byte
+    boundary."""
+    n = int(cum.shape[0])
+    base = int(cum[start - 1]) if start else 0
+    e = start + 1 + int(np.searchsorted(cum[start:], base + max_bytes,
+                                        side="left"))
+    return min(e, start + max_rows, n)
+
+
 def build_blocks(
     stream_id: StreamID,
     timestamps: np.ndarray,
@@ -128,16 +157,12 @@ def build_blocks(
     """Build columnar blocks from time-sorted rows of one stream."""
     out: list[BlockData] = []
     n = len(rows)
+    if n == 0:
+        return out
+    cum = row_cost_cum(rows)
     i = 0
     while i < n:
-        # size-bounded chunk
-        j = i
-        budget = max_bytes
-        while j < n and j - i < max_rows and budget > 0:
-            for k, v in rows[j]:
-                budget -= len(k) + len(v) + 16
-            budget -= 8
-            j += 1
+        j = chunk_end(cum, i, max_rows, max_bytes)
         out.append(_build_one_block(stream_id, timestamps[i:j], rows[i:j],
                                     stream_tags_str))
         i = j
@@ -213,35 +238,10 @@ def blocks_from_log_rows(lr) -> list[BlockData]:
     """Sort a LogRows batch by (stream_id, timestamp) and build blocks.
 
     Reference: datadb flush sorts rows the same way before building an
-    in-memory part (datadb.go:749-763).
+    in-memory part (datadb.go:749-763).  The planning + encoding body
+    lives in storage/block_build so a DataDB can run the independent
+    (stream, chunk) tasks on its build pool; this serial entry point is
+    kept for callers without one.
     """
-    n = len(lr)
-    if n == 0:
-        return []
-    # vectorized (stream_id, ts) sort: np.lexsort beats a per-row Python
-    # key lambda ~20x on large batches (the ingest hot path)
-    acct = np.fromiter((s.tenant.account_id for s in lr.stream_ids),
-                       dtype=np.int64, count=n)
-    proj = np.fromiter((s.tenant.project_id for s in lr.stream_ids),
-                       dtype=np.int64, count=n)
-    hi = np.fromiter((s.hi for s in lr.stream_ids), dtype=np.uint64,
-                     count=n)
-    lo = np.fromiter((s.lo for s in lr.stream_ids), dtype=np.uint64,
-                     count=n)
-    ts_arr = np.asarray(lr.timestamps, dtype=np.int64)
-    order = np.lexsort((ts_arr, lo, hi, proj, acct)).tolist()
-    out: list[BlockData] = []
-    i = 0
-    while i < n:
-        sid = lr.stream_ids[order[i]]
-        j = i
-        while j < n and lr.stream_ids[order[j]] == sid:
-            j += 1
-        idxs = order[i:j]
-        ts = np.fromiter((lr.timestamps[k] for k in idxs), dtype=np.int64,
-                         count=j - i)
-        rows = [lr.rows[k] for k in idxs]
-        out.extend(build_blocks(sid, ts, rows,
-                                stream_tags_str=lr.stream_tags_str[idxs[0]]))
-        i = j
-    return out
+    from .block_build import build_log_rows_blocks
+    return build_log_rows_blocks(lr)

@@ -1,0 +1,563 @@
+"""Sharded, arena-fed block build: the storage flush path's encoder.
+
+PR 18 typed the ingest wire end-to-end and moved the profile: a storage
+node decodes i1 frames ~4x faster than the format-independent block
+build (values encoder + token blooms + filter-index sidecar) consumes
+them, and the build cost is dominated by per-row Python string handling.
+This module closes the gap from both ends:
+
+- **columnar encode** (`ArenaColumn` + `encode_arena_column`): a decoded
+  i1 value column stays ONE dense byte arena + offset/length tables all
+  the way from `wire_ingest.decode_frame` to `BlockData`.  Const/dict
+  detection and the numeric trial gates run vectorized over the arena,
+  and a VT_STRING payload is gathered with one fancy index — no per-row
+  Python string objects exist in between.  Every outcome is byte-exact
+  with the row path's `encode_values` (the numeric trial cascade itself
+  is SHARED — `values_encoder.try_typed_encoding`), and any input the
+  vectorized gates can't prove (non-ASCII arenas never get here; NUL
+  bytes fall through) takes the materialized-list path wholesale, so
+  parity holds by construction.
+
+- **cross-core sharding** (`BuildPool` + the builders' ``pool=``):
+  block chunks are independent by construction — one (stream,
+  size-bounded chunk) each — so they encode on a
+  ``VL_BLOCK_BUILD_THREADS`` pool owned by the partition's `DataDB`
+  (numpy, the native tokenizer and zstd all drop the GIL).  Tasks are
+  collected in SUBMISSION order, so the block list — and every flushed
+  part downstream — is byte-identical to the serial build at any
+  thread count.  ``0``/``1`` threads = serial, no pool ever spun.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import deque
+
+import numpy as np
+
+from .. import config
+from .block import (BlockData, _build_one_block, build_column_bloom,
+                    build_blocks, chunk_end, row_cost_cum)
+from .bloom import bloom_build
+from .values_encoder import (MAX_DICT_BYTES, MAX_DICT_ENTRIES, VT_CONST,
+                             VT_DICT, VT_STRING, EncodedColumn,
+                             encode_values, try_typed_encoding)
+
+# threads a freshly-created pool will spawn when the env knob is unset:
+# the build is the storage chokepoint, so default to real parallelism,
+# capped — a 128-core host should not give every per-day DataDB 128
+# workers
+_DEFAULT_THREAD_CAP = 8
+
+
+def build_threads() -> int:
+    """Resolved VL_BLOCK_BUILD_THREADS (<=1 means serial build)."""
+    n = config.env_int("VL_BLOCK_BUILD_THREADS",
+                       min(os.cpu_count() or 1, _DEFAULT_THREAD_CAP))
+    return max(0, int(n))
+
+
+def arena_build_enabled() -> bool:
+    """VL_ARENA_BUILD kill switch: `0` keeps decode_frame materializing
+    per-value strings (the pre-arena behavior, bit-identical output)."""
+    return config.env_flag("VL_ARENA_BUILD")
+
+
+class ArenaColumn:
+    """One decoded i1 value column kept AS its wire arena.
+
+    ASCII-only by construction (`decode_frame` builds one only when the
+    decoded text length equals the raw byte length): byte offsets ==
+    char offsets, so the chunker's char-length row costs equal byte
+    lengths, numpy's S->U casts are exact, and slicing the decoded text
+    is exact.  Behaves like a read-only list of str for the slow paths
+    (split_by_day, multi-group streams, legacy re-encode) while the
+    block build consumes raw/offs/lens directly."""
+
+    __slots__ = ("raw", "u8", "offs", "lens", "text", "_mat")
+
+    def __init__(self, raw: bytes, offs, lens, text: str):
+        self.raw = raw
+        self.u8 = np.frombuffer(raw, dtype=np.uint8)
+        self.offs = np.asarray(offs).astype(np.int64)
+        self.lens = np.asarray(lens).astype(np.int64)
+        self.text = text
+        self._mat = None
+
+    def __len__(self) -> int:
+        return int(self.lens.shape[0])
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self.materialize()[i]
+        o = int(self.offs[i])
+        return self.text[o:o + int(self.lens[i])]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def materialize(self) -> list:
+        """Per-value string list (cached: the slow paths that need one
+        value usually go on to need them all)."""
+        m = self._mat
+        if m is None:
+            t = self.text
+            ends = (self.offs + self.lens).tolist()
+            m = self._mat = [t[s:e]
+                             for s, e in zip(self.offs.tolist(), ends)]
+        return m
+
+    def wire_arena(self):
+        """(arena bytes, u32 offsets, u32 lengths) for re-encoding this
+        column into a fresh i1 frame (shard re-route / spool) without
+        re-joining strings."""
+        return (self.raw, self.offs.astype(np.uint32),
+                self.lens.astype(np.uint32))
+
+
+def _gather(ac: ArenaColumn, idx: np.ndarray):
+    """Rows `idx` of an arena column -> one dense (sub, offs, lens)
+    sub-arena in `idx` order (a single fancy index, no Python loop)."""
+    lens = ac.lens[idx]
+    total = int(lens.sum())
+    offs = np.zeros(idx.shape[0], dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    if total:
+        src = np.repeat(ac.offs[idx] - offs, lens) \
+            + np.arange(total, dtype=np.int64)
+        sub = ac.u8[src]
+    else:
+        sub = np.zeros(0, dtype=np.uint8)
+    return sub, offs, lens
+
+
+def _materialize(sub: np.ndarray, offs: np.ndarray,
+                 lens: np.ndarray) -> list:
+    t = sub.tobytes().decode("utf-8")
+    ends = (offs + lens).tolist()
+    return [t[s:e] for s, e in zip(offs.tolist(), ends)]
+
+
+def encode_arena_column(name: str, sub: np.ndarray, offs: np.ndarray,
+                        lens: np.ndarray) -> EncodedColumn:
+    """`encode_values` over one dense ASCII sub-arena (offs = exclusive
+    cumsum of lens), without materializing per-row strings on the
+    proven paths.
+
+    BYTE-EXACT contract: returns exactly what
+    ``encode_values(name, _materialize(sub, offs, lens))`` would — the
+    differential test in tests/test_block_build.py pins it.  Every gate
+    below either proves the serial outcome vectorized or falls back to
+    the serial code itself."""
+    n = int(lens.shape[0])
+    assert n > 0
+    # NUL bytes defeat the padded-matrix trials (numpy S/U dtypes pad
+    # with NUL, so "12\x00" would alias "12" and wrongly round-trip);
+    # vanishingly rare in log data -> serial path wholesale
+    if int(sub.shape[0]) and bool((sub == 0).any()):
+        return encode_values(name, _materialize(sub, offs, lens))
+
+    # const: uniform length + every padded row equals the first
+    first_len = int(lens[0])
+    if bool((lens == first_len).all()):
+        if first_len == 0 or bool(
+                (sub.reshape(n, first_len) == sub[:first_len]).all()):
+            return EncodedColumn(
+                name=name, vtype=VT_CONST,
+                const_value=sub[:first_len].tobytes().decode("utf-8"))
+
+    W = int(lens.max())
+    # dict (<=8 distinct, <=256 total distinct bytes): any single value
+    # over MAX_DICT_BYTES already overflows the distinct-bytes budget,
+    # so W also bounds the padded matrix
+    if W <= MAX_DICT_BYTES:
+        col = _try_dict_arena(name, sub, offs, lens, n, W)
+        if col is not None:
+            return col
+
+    first = sub[:first_len].tobytes().decode("utf-8")
+    if _typed_gate(first):
+        # pad into S<W> then cast to U<W>: exact for ASCII, and
+        # identical to np.asarray(values, dtype="U") because no value
+        # carries a NUL (guarded above) and W == max char length
+        arr = _padded_u(sub, offs, lens, n, W)
+        col = try_typed_encoding(
+            name, arr, first, lambda: _materialize(sub, offs, lens))
+        if col is not None:
+            return col
+
+    # raw string arena: the gathered sub-arena IS the payload
+    return EncodedColumn(name=name, vtype=VT_STRING, arena=sub,
+                         offsets=offs, lengths=lens)
+
+
+def _typed_gate(first: str) -> bool:
+    """True when ANY numeric/IPv4/ISO8601 trial could fire for a column
+    whose first value is `first` — the padded-matrix cast is only paid
+    when it can pay off.  Exact: each serial trial's own gate is either
+    a first-value check replicated here, or (float64) numpy's astype,
+    which parses element 0 first — so a False here means every serial
+    trial returns None too."""
+    from .values_encoder import _IPV4_RE
+    if first[:1].isdigit() or first[:1] == "-":
+        return True
+    if _IPV4_RE.match(first):
+        return True
+    if len(first) >= 20 and first[4:5] == "-" and first.endswith("Z"):
+        return True
+    try:
+        np.asarray([first], dtype="U").astype(np.float64)
+        return True
+    except ValueError:
+        return False
+
+
+def _padded_u(sub: np.ndarray, offs: np.ndarray, lens: np.ndarray,
+              n: int, W: int) -> np.ndarray:
+    """The rows as one U<W> array — element-for-element what
+    ``np.asarray(values, dtype="U")`` gives the serial encoder: W is
+    the max byte length (== max char length: the arena is ASCII here),
+    NUL-free values make the S->U zero-padding unambiguous, and the
+    S->U cast decodes ASCII strictly."""
+    mat = np.zeros((n, W), dtype=np.uint8)
+    total = int(lens.sum())
+    if total:
+        row = np.repeat(np.arange(n, dtype=np.int64) * W, lens)
+        inrow = np.arange(total, dtype=np.int64) - np.repeat(offs, lens)
+        mat.reshape(-1)[row + inrow] = sub
+    return mat.reshape(-1).view(f"S{W}").astype(f"U{W}")
+
+
+def _void_rows(sub: np.ndarray, offs: np.ndarray, lens: np.ndarray,
+               n: int, W: int) -> np.ndarray:
+    """(n,) void view of the rows padded to W bytes, with a u16
+    little-endian length suffix so "a" and "a\\x00...pad" can never
+    collide (the length is part of the key)."""
+    Wp = W + 2
+    mat = np.zeros((n, Wp), dtype=np.uint8)
+    total = int(lens.sum())
+    if total:
+        row = np.repeat(np.arange(n, dtype=np.int64) * Wp, lens)
+        inrow = np.arange(total, dtype=np.int64) - np.repeat(offs, lens)
+        mat.reshape(-1)[row + inrow] = sub
+    mat[:, W] = (lens & 0xFF).astype(np.uint8)
+    mat[:, W + 1] = (lens >> 8).astype(np.uint8)
+    return mat.reshape(-1).view(np.dtype((np.void, Wp)))
+
+
+_DICT_PREGATE_ROWS = 512
+
+
+def _try_dict_arena(name: str, sub: np.ndarray, offs: np.ndarray,
+                    lens: np.ndarray, n: int, W: int):
+    """Vectorized VT_DICT trial: distinct rows via np.unique over a
+    padded void view, ids remapped to FIRST-SEEN order (the serial
+    scan's assignment order).  None on any budget overflow — exactly
+    when the serial scan rejects."""
+    if n > _DICT_PREGATE_ROWS:
+        # exact pre-gate on a prefix: distinctness and the distinct-
+        # bytes total only grow with more rows, so a prefix that
+        # already overflows either budget rejects the whole column —
+        # high-cardinality string columns never pay the full matrix
+        p = _DICT_PREGATE_ROWS
+        pend = int(offs[p - 1] + lens[p - 1])
+        pu, pidx = np.unique(
+            _void_rows(sub[:pend], offs[:p], lens[:p], p, W),
+            return_index=True)
+        if pu.shape[0] > MAX_DICT_ENTRIES or \
+                int(lens[pidx].sum()) > MAX_DICT_BYTES:
+            return None
+    uniq, first_idx, inv = np.unique(
+        _void_rows(sub, offs, lens, n, W),
+        return_index=True, return_inverse=True)
+    k = int(uniq.shape[0])
+    if k > MAX_DICT_ENTRIES:
+        return None
+    if int(lens[first_idx].sum()) > MAX_DICT_BYTES:
+        return None
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(k, dtype=np.uint8)
+    rank[order] = np.arange(k, dtype=np.uint8)
+    dvals = []
+    for i in first_idx[order].tolist():
+        o = int(offs[i])
+        dvals.append(sub[o:o + int(lens[i])].tobytes().decode("utf-8"))
+    return EncodedColumn(name=name, vtype=VT_DICT, dict_values=dvals,
+                         ids=rank[inv.reshape(-1)])
+
+
+# ---------------- the shared build pool ----------------
+
+# live (unclosed) pools, for the vlsan thread sweep: a vl-block-build
+# worker owned by a still-reachable DataDB is infrastructure, not a
+# leak (mirrors tpu/batch.py's live_prefetch_pools contract)
+_live_pools: "weakref.WeakSet[BuildPool]" = weakref.WeakSet()
+
+
+def live_build_pools() -> int:
+    """Total worker threads live un-closed pools may own.  A pool whose
+    DataDB closed contributes 0 — close() joins its workers."""
+    total = 0
+    for p in list(_live_pools):
+        ex = p._ex
+        if ex is not None:
+            total += ex._max_workers
+    return total
+
+
+class BuildPool:
+    """Lazily-spun ThreadPoolExecutor for block builds, owned by one
+    DataDB: created on the first parallel build, joined by close().
+    At VL_BLOCK_BUILD_THREADS<=1, executor() returns None and every
+    caller runs serial — the 0/1 fallback the tests pin."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._ex = None
+        self._closed = False
+        _live_pools.add(self)
+
+    def executor(self):
+        n = build_threads()
+        if n <= 1:
+            return None
+        with self._mu:
+            if self._closed:
+                return None
+            if self._ex is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._ex = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="vl-block-build")
+            return self._ex
+
+    def close(self) -> None:
+        with self._mu:
+            ex, self._ex = self._ex, None
+            self._closed = True
+        if ex is not None:
+            # join: an un-joined worker is a non-daemon thread the
+            # vlsan leak sweep rightly flags once its owner is gone
+            ex.shutdown(wait=True)
+
+
+_WINDOW_PER_WORKER = 2
+
+
+def run_tasks(tasks, pool) -> list:
+    """Run zero-arg build tasks, returning results in SUBMISSION order
+    (deterministic output regardless of worker scheduling).  `tasks`
+    may be a lazy iterable: with a pool, a bounded window of 2x workers
+    keeps the planner (chunk slicing, arena gathers) one step ahead of
+    the encoders without materializing every chunk up front."""
+    if pool is None:
+        return [t() for t in tasks]
+    window = max(2, pool._max_workers * _WINDOW_PER_WORKER)
+    out: list = []
+    pending: deque = deque()
+    for t in tasks:
+        pending.append(pool.submit(t))
+        if len(pending) >= window:
+            out.append(pending.popleft().result())
+    while pending:
+        out.append(pending.popleft().result())
+    return out
+
+
+# ---------------- batch -> block tasks ----------------
+
+def _chunk_task(sid, ts: np.ndarray, chunk_cols: list, tags: str):
+    """One (stream, chunk) build task.  chunk_cols: (name, payload)
+    pairs in schema order; payload is either a value list (serial
+    encode) or an (ArenaColumn, row-index array) pair gathered and
+    encoded inside the task — on a pool, the gather itself runs on the
+    worker."""
+    def task() -> BlockData:
+        nrows = int(ts.shape[0])
+        columns: list = []
+        const_columns: list = []
+        for name, payload in chunk_cols:
+            arena = None
+            if type(payload) is tuple:
+                ac, idx = payload
+                arena = _gather(ac, idx)
+                col = encode_arena_column(name, *arena)
+            else:
+                col = encode_values(name, payload)
+            if col.vtype == VT_CONST:
+                const_columns.append((name, col.const_value))
+            else:
+                if arena is not None and col.vtype not in (VT_CONST,
+                                                           VT_DICT,
+                                                           VT_STRING):
+                    _typed_column_bloom(col, arena)
+                else:
+                    build_column_bloom(col, nrows)
+                columns.append(col)
+        return BlockData(stream_id=sid, timestamps=ts, columns=columns,
+                         const_columns=const_columns,
+                         stream_tags_str=tags)
+    return task
+
+
+def _typed_column_bloom(col: EncodedColumn, arena) -> None:
+    """Token bloom for a typed (numeric/ipv4/iso) column straight from
+    its pre-encode arena slice, skipping the serial path's per-row
+    decode_values + tokenize_string loop.  Same stored bytes: the VT
+    round trip is exact (encode verified it), so the decoded strings
+    ARE the arena's values and the distinct-token-hash SET is equal —
+    and bloom/sb/xor/maplet builds are all order-independent bit
+    scatters or sorts over that set."""
+    sub, offs, lens = arena
+    from .. import native
+    hashes = native.unique_token_hashes_native(sub, offs, lens)
+    if hashes is None:
+        from ..utils.hashing import hash_tokens
+        from ..utils.tokenizer import tokenize_arena, unique_tokens_bytes
+        ts_, te_, _ = tokenize_arena(sub, offs, lens)
+        hashes = hash_tokens(unique_tokens_bytes(sub, ts_, te_))
+    col.token_hashes = hashes
+    col.bloom = bloom_build(hashes)
+
+
+def build_columns_blocks(lc, pool=None) -> list:
+    """LogColumns -> (stream, time)-sorted BlockData list: the body of
+    LogColumns.build_blocks, lifted here so the independent chunk
+    tasks can run on a DataDB's BuildPool.  Streams spanning MULTIPLE
+    schema groups route through the row path so one call still yields
+    non-overlapping time-sorted blocks per stream (the flush merger's
+    within-part invariant).  Task submission order and the final
+    stable sort are both deterministic, so the result is identical at
+    any thread count."""
+    gcount: dict = {}
+    for g in lc.groups.values():
+        for sid, _t, _s in g.streams:
+            gcount[sid] = gcount.get(sid, 0) + 1
+    slow: list = []          # (sid, ts, fields, tags) across groups
+
+    def plan():
+        for g in lc.groups.values():
+            n = len(g.ts)
+            if not n:
+                continue
+            ts = np.asarray(g.ts, dtype=np.int64)
+            # per-stream rank in StreamID order == the row path's
+            # (tenant, hi, lo) lexsort order (StreamID is order=True)
+            by_sid = sorted(range(len(g.streams)),
+                            key=lambda k: g.streams[k][0])
+            rank = np.empty(len(g.streams), dtype=np.int64)
+            for r, k in enumerate(by_sid):
+                rank[k] = r
+            rr = rank[np.asarray(g.sref, dtype=np.int64)]
+            order = np.lexsort((ts, rr))
+            rro = rr[order]
+            bounds = [0] + (np.nonzero(np.diff(rro))[0] + 1).tolist() \
+                + [n]
+            for b in range(len(bounds) - 1):
+                idxs = order[bounds[b]:bounds[b + 1]]
+                sid, _tenant, tags = g.streams[g.sref[idxs[0]]]
+                if gcount[sid] > 1:
+                    for k in idxs.tolist():
+                        fields = [(nm, c[k])
+                                  for nm, c in zip(g.names, g.cols)]
+                        slow.append((sid, g.ts[k], fields, tags))
+                    continue
+                run_ts = ts[idxs]
+                # per-row size estimates: arena columns read byte
+                # lengths directly (== char lengths, ASCII-gated);
+                # list columns pay map(len) once per run
+                rb = np.zeros(idxs.shape[0], dtype=np.int64)
+                il = None
+                mats: list = []
+                for nm, c in zip(g.names, g.cols):
+                    if type(c) is ArenaColumn:
+                        rb += c.lens[idxs]
+                        mats.append(None)
+                    else:
+                        if il is None:
+                            il = idxs.tolist()
+                        vals = [c[k] for k in il]
+                        rb += np.fromiter(map(len, vals),
+                                          dtype=np.int64,
+                                          count=len(vals))
+                        mats.append(vals)
+                    rb += len(nm) + 16
+                cum = np.cumsum(rb + 8)
+                s = 0
+                nrun = int(idxs.shape[0])
+                while s < nrun:
+                    e = chunk_end(cum, s)
+                    chunk_cols = []
+                    for nm, c, vals in zip(g.names, g.cols, mats):
+                        if vals is None:
+                            chunk_cols.append((nm, (c, idxs[s:e])))
+                        else:
+                            chunk_cols.append((nm, vals[s:e]))
+                    yield _chunk_task(sid, run_ts[s:e], chunk_cols,
+                                      tags)
+                    s = e
+
+    out = run_tasks(plan(), pool)
+    if slow:
+        slow.sort(key=lambda r: (r[0], r[1]))
+        i = 0
+        while i < len(slow):
+            sid = slow[i][0]
+            j = i
+            while j < len(slow) and slow[j][0] == sid:
+                j += 1
+            run = slow[i:j]
+            out.extend(build_blocks(
+                sid, np.array([r[1] for r in run], dtype=np.int64),
+                [r[2] for r in run], stream_tags_str=run[0][3]))
+            i = j
+    # global (stream_id, min_ts) order across schema groups: the flush
+    # merger's k-way heap requires each part's block list sorted this
+    # way (datadb.merge_block_streams input invariant)
+    out.sort(key=lambda b: (b.stream_id, int(b.timestamps[0])))
+    return out
+
+
+def build_log_rows_blocks(lr, pool=None) -> list:
+    """LogRows -> (stream_id, ts)-sorted BlockData list (the body of
+    block.blocks_from_log_rows, chunk tasks pool-runnable)."""
+    n = len(lr)
+    if n == 0:
+        return []
+    # vectorized (stream_id, ts) sort: np.lexsort beats a per-row
+    # Python key lambda ~20x on large batches (the ingest hot path)
+    acct = np.fromiter((s.tenant.account_id for s in lr.stream_ids),
+                       dtype=np.int64, count=n)
+    proj = np.fromiter((s.tenant.project_id for s in lr.stream_ids),
+                       dtype=np.int64, count=n)
+    hi = np.fromiter((s.hi for s in lr.stream_ids), dtype=np.uint64,
+                     count=n)
+    lo = np.fromiter((s.lo for s in lr.stream_ids), dtype=np.uint64,
+                     count=n)
+    ts_arr = np.asarray(lr.timestamps, dtype=np.int64)
+    order = np.lexsort((ts_arr, lo, hi, proj, acct)).tolist()
+
+    def plan():
+        i = 0
+        while i < n:
+            sid = lr.stream_ids[order[i]]
+            j = i
+            while j < n and lr.stream_ids[order[j]] == sid:
+                j += 1
+            idxs = order[i:j]
+            ts = np.fromiter((lr.timestamps[k] for k in idxs),
+                             dtype=np.int64, count=j - i)
+            rows = [lr.rows[k] for k in idxs]
+            tags = lr.stream_tags_str[idxs[0]]
+            cum = row_cost_cum(rows)
+            s = 0
+            while s < len(rows):
+                e = chunk_end(cum, s)
+                yield (lambda sid=sid, cts=ts[s:e], crows=rows[s:e],
+                       ctags=tags:
+                       _build_one_block(sid, cts, crows, ctags))
+                s = e
+            i = j
+
+    return run_tasks(plan(), pool)

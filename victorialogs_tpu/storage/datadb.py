@@ -24,11 +24,13 @@ import time
 
 import numpy as np
 
-from .block import BlockData, blocks_from_log_rows, build_blocks
+from . import block_build
+from .block import BlockData, build_blocks
 from .part import Part, write_part
 from .values_encoder import decode_values
 from ..obs import events as _events
 from ..obs import hist as _hist
+from ..obs import ingestledger as _ingestledger
 
 
 def _all_system_tenant(parts) -> bool:
@@ -291,6 +293,11 @@ class DataDB:
         self.big_parts: list[Part] = []
         self._next_part_id = 0
         self._stop = threading.Event()
+        # block-build shard pool (VL_BLOCK_BUILD_THREADS): lazily spun
+        # on the first parallel build, joined by close(); the flush and
+        # merge part writers ride the same pool for per-column
+        # compression + sidecar builds
+        self.build_pool = block_build.BuildPool()
         self._open_existing()
         # ingest never merges inline: a flusher thread turns in-memory
         # parts into small file parts (woken early under buffer pressure),
@@ -374,7 +381,26 @@ class DataDB:
                 self._buffer_drained.wait(timeout=1.0)
 
     def must_add_log_rows(self, lr) -> None:
-        self.must_add_blocks(blocks_from_log_rows(lr))
+        """Row-batch entry: build blocks (sharded on the build pool when
+        VL_BLOCK_BUILD_THREADS > 1) and buffer them."""
+        self.must_add_blocks(self._build_blocks_timed(
+            lambda ex: block_build.build_log_rows_blocks(lr, pool=ex)))
+
+    def must_add_columns(self, lc) -> None:
+        """Columnar-batch entry (LogColumns, possibly arena-backed from
+        the typed wire): the storage chokepoint's block build.  The
+        build extent is the ledger's `build` hop (nested inside the
+        caller's `store` hop) and feeds the
+        vl_ingest_block_build_seconds histogram."""
+        self.must_add_blocks(self._build_blocks_timed(
+            lambda ex: block_build.build_columns_blocks(lc, pool=ex)))
+
+    def _build_blocks_timed(self, build) -> list[BlockData]:
+        t0 = time.perf_counter()
+        with _ingestledger.hop("build"):
+            blocks = build(self.build_pool.executor())
+        _hist.INGEST_BLOCK_BUILD.observe(time.perf_counter() - t0)
+        return blocks
 
     # ---- flush / merge ----
     def _flush_loop(self) -> None:
@@ -433,7 +459,8 @@ class DataDB:
                 merged = merge_block_streams([im.blocks for im in imps])
             with self._lock:
                 name = self._new_part_name_locked()
-            fi_stats = write_part(os.path.join(self.path, name), merged)
+            fi_stats = write_part(os.path.join(self.path, name), merged,
+                                  pool=self.build_pool.executor())
             p = Part(os.path.join(self.path, name))
             p.name = name
             with self._lock:
@@ -546,7 +573,8 @@ class DataDB:
             name = self._new_part_name_locked()
         out_path = os.path.join(self.path, name)
         try:
-            fi_stats = write_part(out_path, merged, big=big)
+            fi_stats = write_part(out_path, merged, big=big,
+                                  pool=self.build_pool.executor())
         except BaseException:
             # a failed write must not leave its .tmp dir eating the very
             # disk space the merge ran out of
@@ -639,6 +667,10 @@ class DataDB:
         self._flusher.join(timeout=5)
         self._merge_worker.join(timeout=5)
         self.flush_inmemory_parts()
+        # after the final flush: nothing can submit build/compress work
+        # anymore, so join the shard pool (vlsan sweeps vl-block-build
+        # workers whose owner closed)
+        self.build_pool.close()
         with self._lock:
             for p in self.small_parts + self.big_parts:
                 p.close()

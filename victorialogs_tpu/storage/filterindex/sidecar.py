@@ -80,30 +80,43 @@ class SidecarBuilder:
         (dict-encoded / bloom-less) — the block stays uncovered."""
         self._cols.setdefault(name, []).append((block_idx, hashes))
 
-    def build(self, nblocks: int) -> dict[str, ColumnArtifacts]:
-        out: dict[str, ColumnArtifacts] = {}
-        for name, per_block in self._cols.items():
-            nsb = np.zeros(nblocks, dtype=np.int32)
-            lane_parts = []
-            for bi, h in per_block:
-                if h is None:
-                    continue
-                lanes = sb_build(np.asarray(h, dtype=np.uint64))
-                nsb[bi] = lanes.shape[0] // SB_LANES
-                lane_parts.append((bi, lanes))
-            lane_parts.sort(key=lambda t: t[0])
-            lanes = np.concatenate([lp for _bi, lp in lane_parts]) \
-                if lane_parts else np.zeros(0, dtype=np.uint32)
-            mp = maplet_build(per_block, nblocks)
-            xf = xor_build(mp.uhashes) if mp.all_covered() else None
-            out[name] = ColumnArtifacts(nsb=nsb, lanes=lanes, xor=xf,
-                                        maplet=mp)
-        return out
+    def build(self, nblocks: int,
+              pool=None) -> dict[str, ColumnArtifacts]:
+        """Per-column artifact builds are independent (each reads only
+        its own hash lists), so with `pool` they run concurrently —
+        the DataDB's block-build pool at part-seal time.  Assembly
+        order is the accumulation (dict) order either way, so the
+        serialized sidecar bytes never depend on the pool."""
+        names = list(self._cols)
+        if pool is None:
+            arts = [self._build_column(nm, nblocks) for nm in names]
+        else:
+            arts = [f.result() for f in
+                    [pool.submit(self._build_column, nm, nblocks)
+                     for nm in names]]
+        return dict(zip(names, arts))
+
+    def _build_column(self, name: str, nblocks: int) -> ColumnArtifacts:
+        per_block = self._cols[name]
+        nsb = np.zeros(nblocks, dtype=np.int32)
+        lane_parts = []
+        for bi, h in per_block:
+            if h is None:
+                continue
+            lanes = sb_build(np.asarray(h, dtype=np.uint64))
+            nsb[bi] = lanes.shape[0] // SB_LANES
+            lane_parts.append((bi, lanes))
+        lane_parts.sort(key=lambda t: t[0])
+        lanes = np.concatenate([lp for _bi, lp in lane_parts]) \
+            if lane_parts else np.zeros(0, dtype=np.uint32)
+        mp = maplet_build(per_block, nblocks)
+        xf = xor_build(mp.uhashes) if mp.all_covered() else None
+        return ColumnArtifacts(nsb=nsb, lanes=lanes, xor=xf, maplet=mp)
 
 
-def build_sidecar(builder: SidecarBuilder, nblocks: int):
+def build_sidecar(builder: SidecarBuilder, nblocks: int, pool=None):
     """build + stats, no IO (the bench rides this directly)."""
-    cols = builder.build(nblocks)
+    cols = builder.build(nblocks, pool=pool)
     nbytes = sum(c.nbytes() for c in cols.values())
     keys = sum(int(c.maplet.uhashes.shape[0]) for c in cols.values())
     agg_bits = sum(8 * c.xor.fingerprints.shape[0]

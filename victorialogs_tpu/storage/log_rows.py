@@ -300,90 +300,16 @@ class LogColumns:
             out[day] = sub
         return out, old, new
 
-    def build_blocks(self) -> list:
-        """Encode the batch into columnar blocks, sorted by (stream, time)
-        within each schema group.  Streams that span MULTIPLE groups are
-        routed through the row path so one call still yields
-        non-overlapping time-sorted blocks per stream (the flush merger's
-        within-part invariant)."""
-        import numpy as np
-        from .block import (MAX_ROWS_PER_BLOCK, MAX_UNCOMPRESSED_BLOCK_SIZE,
-                            build_block_from_columns, build_blocks)
-        gcount: dict = {}
-        for g in self.groups.values():
-            for sid, _t, _s in g.streams:
-                gcount[sid] = gcount.get(sid, 0) + 1
-        out = []
-        slow: list = []          # (sid, ts, fields, tags) across groups
-        for g in self.groups.values():
-            n = len(g.ts)
-            if not n:
-                continue
-            ts = np.asarray(g.ts, dtype=np.int64)
-            # per-stream rank in StreamID order == the row path's
-            # (tenant, hi, lo) lexsort order (StreamID is order=True)
-            by_sid = sorted(range(len(g.streams)),
-                            key=lambda k: g.streams[k][0])
-            rank = np.empty(len(g.streams), dtype=np.int64)
-            for r, k in enumerate(by_sid):
-                rank[k] = r
-            rr = rank[np.asarray(g.sref, dtype=np.int64)]
-            order = np.lexsort((ts, rr))
-            rro = rr[order]
-            bounds = [0] + (np.nonzero(np.diff(rro))[0] + 1).tolist() \
-                + [n]
-            for b in range(len(bounds) - 1):
-                idxs = order[bounds[b]:bounds[b + 1]]
-                sid, _tenant, tags = g.streams[g.sref[idxs[0]]]
-                if gcount[sid] > 1:
-                    for k in idxs.tolist():
-                        fields = [(nm, c[k])
-                                  for nm, c in zip(g.names, g.cols)]
-                        slow.append((sid, g.ts[k], fields, tags))
-                    continue
-                il = idxs.tolist()
-                cols = {nm: [c[k] for k in il]
-                        for nm, c in zip(g.names, g.cols)}
-                run_ts = ts[idxs]
-                # size-bounded chunks (same bounds as build_blocks)
-                rb = np.zeros(len(il), dtype=np.int64)
-                for nm, vals in cols.items():
-                    rb += np.fromiter(map(len, vals), dtype=np.int64,
-                                      count=len(vals))
-                    rb += len(nm) + 16
-                cum = np.cumsum(rb + 8)
-                s = 0
-                while s < len(il):
-                    base = cum[s - 1] if s else 0
-                    e = int(np.searchsorted(
-                        cum, base + MAX_UNCOMPRESSED_BLOCK_SIZE,
-                        side="right")) + 1
-                    e = min(max(e, s + 1), s + MAX_ROWS_PER_BLOCK,
-                            len(il))
-                    out.append(build_block_from_columns(
-                        sid, run_ts[s:e],
-                        {nm: v[s:e] for nm, v in cols.items()},
-                        stream_tags_str=tags))
-                    s = e
-        if slow:
-            slow.sort(key=lambda r: (r[0], r[1]))
-            i = 0
-            while i < len(slow):
-                sid = slow[i][0]
-                j = i
-                while j < len(slow) and slow[j][0] == sid:
-                    j += 1
-                run = slow[i:j]
-                out.extend(build_blocks(
-                    sid,
-                    np.array([r[1] for r in run], dtype=np.int64),
-                    [r[2] for r in run], stream_tags_str=run[0][3]))
-                i = j
-        # global (stream_id, min_ts) order across schema groups: the
-        # flush merger's k-way heap requires each part's block list
-        # sorted this way (datadb.merge_block_streams input invariant)
-        out.sort(key=lambda b: (b.stream_id, int(b.timestamps[0])))
-        return out
+    def build_blocks(self, pool=None) -> list:
+        """Encode the batch into columnar blocks, sorted by (stream,
+        time).  The planning + encoding body lives in
+        storage/block_build (ONE copy of the size-bounded chunking rule
+        for the row and columnar paths): each independent (stream,
+        chunk) task optionally runs on `pool` (a DataDB's build pool),
+        assembled in submission order — the result is identical at any
+        thread count."""
+        from .block_build import build_columns_blocks
+        return build_columns_blocks(self, pool)
 
 
 class _ColGroup:

@@ -69,11 +69,19 @@ def _compress(data: bytes, hi: bool = False) -> bytes:
     return _zstd.compress(data, level=3 if hi else 1)
 
 
+def _seal_column(c, hi: bool) -> bytes:
+    """One column's compressed payload (pool-runnable: the payload
+    gather + zstd both release the GIL; the compressed bytes are a pure
+    function of the column, so pooled and serial parts are identical)."""
+    return _compress(_column_payload(c), hi=hi)
+
+
 def _decompress(data: bytes) -> bytes:
     return _zstd.decompress(data)
 
 
-def write_part(path: str, blocks, big: bool = False) -> dict | None:
+def write_part(path: str, blocks, big: bool = False,
+               pool=None) -> dict | None:
     """Write blocks (already sorted by (stream_id, ts)) as a part directory.
 
     blocks may be any iterable of BlockData (e.g. the streaming merger) —
@@ -82,7 +90,13 @@ def write_part(path: str, blocks, big: bool = False) -> dict | None:
     aggregates, token→block maplets — storage/filterindex) is built here
     and written as a sidecar into the same directory, published by the
     same atomic rename.  Returns the filter-index build stats (or None
-    when the build is pinned off / declined)."""
+    when the build is pinned off / declined).
+
+    pool: optional executor (the owning DataDB's block-build pool) —
+    each block's timestamp + column payloads compress concurrently
+    (zstd drops the GIL) and the sidecar builds per column on the same
+    pool; results are written in deterministic order, so the part
+    bytes never depend on the pool."""
     from . import filterindex as _fidx
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
@@ -109,15 +123,23 @@ def write_part(path: str, blocks, big: bool = False) -> dict | None:
             deltas = np.empty_like(ts)
             deltas[0] = ts[0] if len(ts) else 0
             np.subtract(ts[1:], ts[:-1], out=deltas[1:])
-            ts_z = _compress(deltas.tobytes(), hi=big)
+            if pool is not None:
+                ts_fut = pool.submit(_compress, deltas.tobytes(), big)
+                col_futs = [pool.submit(_seal_column, c, big)
+                            for c in b.columns]
+                ts_z = ts_fut.result()
+                sealed = [f.result() for f in col_futs]
+            else:
+                ts_z = _compress(deltas.tobytes(), hi=big)
+                sealed = None
             ts_f.write(ts_z)
             ts_region = [ts_off, len(ts_z)]
             ts_off += len(ts_z)
 
             cols_hdr = []
-            for c in b.columns:
-                payload = _column_payload(c)
-                cz = _compress(payload, hi=big)
+            for ci, c in enumerate(b.columns):
+                cz = sealed[ci] if sealed is not None \
+                    else _seal_column(c, big)
                 col_f.write(cz)
                 ch = {"n": c.name, "t": c.vtype, "r": [col_off, len(cz)]}
                 col_off += len(cz)
@@ -172,7 +194,8 @@ def write_part(path: str, blocks, big: bool = False) -> dict | None:
         t0 = _time.perf_counter()
         try:
             fi_cols, fi_stats = _fidx.build_sidecar(fi_builder,
-                                                    len(headers))
+                                                    len(headers),
+                                                    pool=pool)
             fi_stats["file_bytes"] = _fidx.write_sidecar(
                 tmp, fi_cols, len(headers))
         # a part without a sidecar is correct, just slower — but a

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import os
 
-from .block import blocks_from_log_rows
 from .datadb import DataDB
 from .indexdb import IndexDB
 from .log_rows import LogRows
@@ -41,7 +40,7 @@ class Partition:
                 unseen.append((sid, tags))
         if unseen:
             self.idb.must_register_streams(unseen)
-        self.ddb.must_add_blocks(blocks_from_log_rows(lr))
+        self.ddb.must_add_log_rows(lr)
 
     def must_add_columns(self, lc) -> None:
         """Columnar-batch twin of must_add_rows (LogColumns fast path)."""
@@ -49,7 +48,7 @@ class Partition:
                   if not self.idb.has_stream_id(sid)]
         if unseen:
             self.idb.must_register_streams(unseen)
-        self.ddb.must_add_blocks(lc.build_blocks())
+        self.ddb.must_add_columns(lc)
 
     def debug_flush(self) -> None:
         self.idb.flush()

@@ -147,59 +147,10 @@ def encode_values(name: str, values: list[str]) -> EncodedColumn:
                                  ids=ids, _strings_cache=values)
 
     arr = np.asarray(values, dtype="U")
-
-    # uint8..uint64
-    if first[:1].isdigit():
-        u = _round_trip_uint(arr)
-        if u is not None:
-            mx = int(u.max())
-            for vt, dt in _UINT_DTYPES:
-                if mx <= int(np.iinfo(dt).max):
-                    return EncodedColumn(
-                        name=name, vtype=vt, nums=u.astype(dt),
-                        min_val=float(u.min()), max_val=float(mx),
-                        _strings_cache=values)
-
-    # int64
-    if first[:1] == "-" or first[:1].isdigit():
-        try:
-            i = arr.astype(np.int64)
-        except (ValueError, OverflowError):
-            i = None
-        if i is not None and np.array_equal(i.astype(arr.dtype), arr):
-            return EncodedColumn(name=name, vtype=VT_INT64, nums=i,
-                                 min_val=float(i.min()), max_val=float(i.max()),
-                                 _strings_cache=values)
-
-    # float64 (round-trip through canonical formatting)
-    try:
-        f = arr.astype(np.float64)
-    except ValueError:
-        f = None
-    if f is not None and np.isfinite(f).all():
-        if np.array_equal(_format_floats(f).astype(arr.dtype), arr):
-            return EncodedColumn(name=name, vtype=VT_FLOAT64, nums=f,
-                                 min_val=float(f.min()), max_val=float(f.max()),
-                                 _strings_cache=values)
-
-    # IPv4
-    if _IPV4_RE.match(first):
-        ip = _try_ipv4(values)
-        if ip is not None:
-            return EncodedColumn(name=name, vtype=VT_IPV4, nums=ip,
-                                 min_val=float(ip.min()),
-                                 max_val=float(ip.max()),
-                                 _strings_cache=values)
-
-    # ISO8601 timestamp (uniform fractional width)
-    if len(first) >= 20 and first[4:5] == "-" and first.endswith("Z"):
-        parsed = _try_iso8601(values)
-        if parsed is not None:
-            ts, frac_w = parsed
-            return EncodedColumn(name=name, vtype=VT_TIMESTAMP_ISO8601,
-                                 nums=ts, min_val=float(ts.min()),
-                                 max_val=float(ts.max()), iso_frac_w=frac_w,
-                                 _strings_cache=values)
+    col = try_typed_encoding(name, arr, first, lambda: values)
+    if col is not None:
+        col._strings_cache = values
+        return col
 
     # raw string arena
     bvals = [v.encode("utf-8") for v in values]
@@ -210,6 +161,69 @@ def encode_values(name: str, values: list[str]) -> EncodedColumn:
     return EncodedColumn(name=name, vtype=VT_STRING, arena=arena,
                          offsets=offsets, lengths=lengths,
                          _strings_cache=values)
+
+
+def try_typed_encoding(name: str, arr: np.ndarray, first: str,
+                       get_values) -> EncodedColumn | None:
+    """The uint{8..64} -> int64 -> float64 -> IPv4 -> ISO8601 trial
+    cascade over a prepared U-dtype array, or None when no typed
+    encoding round-trips.  Shared by the row path above and the
+    arena-fed columnar path (storage/block_build.encode_arena_column)
+    so the two can never drift — both accept an encoding on exactly the
+    same evidence.  `get_values` materializes the Python string list
+    lazily: only the per-value IPv4/ISO8601 parsers walk it, so the
+    arena path pays for it only when those trials actually fire."""
+    # uint8..uint64
+    if first[:1].isdigit():
+        u = _round_trip_uint(arr)
+        if u is not None:
+            mx = int(u.max())
+            for vt, dt in _UINT_DTYPES:
+                if mx <= int(np.iinfo(dt).max):
+                    return EncodedColumn(
+                        name=name, vtype=vt, nums=u.astype(dt),
+                        min_val=float(u.min()), max_val=float(mx))
+
+    # int64
+    if first[:1] == "-" or first[:1].isdigit():
+        try:
+            i = arr.astype(np.int64)
+        except (ValueError, OverflowError):
+            i = None
+        if i is not None and np.array_equal(i.astype(arr.dtype), arr):
+            return EncodedColumn(name=name, vtype=VT_INT64, nums=i,
+                                 min_val=float(i.min()),
+                                 max_val=float(i.max()))
+
+    # float64 (round-trip through canonical formatting)
+    try:
+        f = arr.astype(np.float64)
+    except ValueError:
+        f = None
+    if f is not None and np.isfinite(f).all():
+        if np.array_equal(_format_floats(f).astype(arr.dtype), arr):
+            return EncodedColumn(name=name, vtype=VT_FLOAT64, nums=f,
+                                 min_val=float(f.min()),
+                                 max_val=float(f.max()))
+
+    # IPv4
+    if _IPV4_RE.match(first):
+        ip = _try_ipv4(get_values())
+        if ip is not None:
+            return EncodedColumn(name=name, vtype=VT_IPV4, nums=ip,
+                                 min_val=float(ip.min()),
+                                 max_val=float(ip.max()))
+
+    # ISO8601 timestamp (uniform fractional width)
+    if len(first) >= 20 and first[4:5] == "-" and first.endswith("Z"):
+        parsed = _try_iso8601(get_values())
+        if parsed is not None:
+            ts, frac_w = parsed
+            return EncodedColumn(name=name, vtype=VT_TIMESTAMP_ISO8601,
+                                 nums=ts, min_val=float(ts.min()),
+                                 max_val=float(ts.max()),
+                                 iso_frac_w=frac_w)
+    return None
 
 
 def _try_ipv4(values: list[str]) -> np.ndarray | None:
